@@ -1,0 +1,27 @@
+"""kmamiz-tpu: a TPU-native microservice observability framework.
+
+A ground-up rebuild of the capabilities of wys899195/KMamiz (see SURVEY.md):
+Zipkin-span ingestion, Envoy-log merging, endpoint-dependency graph
+construction and the downstream risk / SDP-instability / cohesion-coupling
+scorers — implemented as JAX/XLA kernels over array-of-structs span batches
+and a capacity-padded CSR endpoint graph, served behind the reference's
+external Data Processor HTTP protocol.
+
+Layout:
+  core/      host-side ingestion: string interning, SoA span batches,
+             URL/JSON-schema utilities, envoy log parsing
+  ops/       jitted device kernels: window pipeline, segment stats,
+             graph scorers, normalizers
+  domain/    domain data model with reference-parity JSON output
+             (Traces, RealtimeDataList, CombinedRealtimeDataList,
+             EndpointDependencies, EndpointDataType, Historical/Aggregated)
+  analytics/ risk analyzer, endpoint label speculation, OpenAPI generation
+  graph/     HBM-resident CSR endpoint-graph store
+  parallel/  device-mesh sharding of the window pipeline (shard_map/psum)
+  server/    DP-protocol server, caches, dispatch storage, scheduler,
+             REST API handlers
+  simulator/ MicroViSim-equivalent synthetic mesh + load/fault generator
+  models/    GraphSAGE latency/anomaly head (flax)
+"""
+
+__version__ = "0.1.0"

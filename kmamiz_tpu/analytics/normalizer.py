@@ -1,0 +1,52 @@
+"""Score-normalization strategies.
+
+Parity with /root/reference/src/utils/Normalizer.ts:11-71. These host-side
+versions operate on small per-service vectors; the device risk pipeline uses
+the jnp equivalents in kmamiz_tpu.ops.scorers.
+"""
+from __future__ import annotations
+
+import math
+from typing import List, Sequence
+
+from kmamiz_tpu.core.timeutils import to_precise
+
+
+def between_fixed_number(values: Sequence[float]) -> List[float]:
+    """Scale into [0.1, 1]; degenerate input collapses to [0.1]."""
+    base_line = 0.1
+    ratio = 1 - base_line
+    mx, mn = max(values), min(values)
+    if mx - mn == 0:
+        return [0.1]
+    return [((v - mn) / (mx - mn)) * ratio + base_line for v in values]
+
+
+def _sigmoid1(x: float) -> float:
+    try:
+        return 1 / (1 + math.exp(-x))
+    except OverflowError:
+        return 0.0  # Math.exp(huge) -> Infinity -> 1/Inf -> 0 in JS
+
+
+def sigmoid(values: Sequence[float]) -> List[float]:
+    return [_sigmoid1(v) for v in values]
+
+
+def sigmoid_adj(values: Sequence[float]) -> List[float]:
+    """y = 1 / (1 + e^(-z*(x - 1.5))), z = 2*ln(3); maps [0,inf) into (0,1)."""
+    z = 2 * math.log(3)
+    return [to_precise(_sigmoid1(z * (v - 1.5))) for v in values]
+
+
+def fixed_ratio(values: Sequence[float]) -> List[float]:
+    mx = max(values)
+    if mx == 0:
+        return list(values)
+    return [v / mx for v in values]
+
+
+def linear(values: Sequence[float], minimum: float = 0.1) -> List[float]:
+    if minimum >= 1:
+        return list(values)
+    return [n * (1 - minimum) + minimum for n in fixed_ratio(values)]

@@ -1,0 +1,31 @@
+"""UTC time-bucketing helpers.
+
+Parity with /root/reference/src/utils/Utils.ts:113-141 (BelongsToDate/Hour/
+MinuteTimestamp): floor an epoch-milliseconds timestamp to its containing
+UTC day / hour / minute, returning epoch milliseconds.
+"""
+from __future__ import annotations
+
+MS_PER_MINUTE = 60_000
+MS_PER_HOUR = 3_600_000
+MS_PER_DAY = 86_400_000
+
+
+def belongs_to_minute_timestamp(timestamp_ms: float) -> int:
+    return int(timestamp_ms // MS_PER_MINUTE) * MS_PER_MINUTE
+
+
+def belongs_to_hour_timestamp(timestamp_ms: float) -> int:
+    return int(timestamp_ms // MS_PER_HOUR) * MS_PER_HOUR
+
+
+def belongs_to_date_timestamp(timestamp_ms: float) -> int:
+    return int(timestamp_ms // MS_PER_DAY) * MS_PER_DAY
+
+
+def to_precise(num: float) -> float:
+    """Round to 14 decimal places (reference Utils.ToPrecise, Utils.ts:311)."""
+    eps = 2.220446049250313e-16
+    import math
+
+    return math.floor((num + eps) * 1e14 + 0.5) / 1e14

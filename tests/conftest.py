@@ -1,0 +1,54 @@
+"""Test configuration: run JAX on a virtual 8-device CPU mesh.
+
+Multi-chip TPU hardware is unavailable in CI; sharding correctness is
+validated against 8 virtual CPU devices (the driver separately dry-runs the
+multi-chip path via __graft_entry__.dryrun_multichip).
+"""
+import json
+import os
+from pathlib import Path
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest
+
+FIXTURES = Path(__file__).parent / "fixtures"
+
+
+def load_fixture(name: str):
+    return json.loads((FIXTURES / f"{name}.json").read_text())
+
+
+@pytest.fixture(scope="session")
+def pdas_traces():
+    return load_fixture("pdas_traces")
+
+
+@pytest.fixture(scope="session")
+def bookinfo_traces():
+    return load_fixture("bookinfo_traces")
+
+
+@pytest.fixture(scope="session")
+def pdas_realtime_data():
+    return load_fixture("pdas_realtime_data")
+
+
+@pytest.fixture(scope="session")
+def pdas_endpoint_dependencies():
+    return load_fixture("pdas_endpoint_dependencies")
+
+
+@pytest.fixture(scope="session")
+def bookinfo_endpoint_dependencies():
+    return load_fixture("bookinfo_endpoint_dependencies")
+
+
+@pytest.fixture(scope="session")
+def pdas_envoy_log_lines():
+    return load_fixture("pdas_envoy_log_lines")

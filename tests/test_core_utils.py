@@ -1,0 +1,307 @@
+"""Parity tests for core URL/time/schema utilities.
+
+Expected values mirror the reference's unit tests
+(/root/reference/tests/Utils.test.ts) so both implementations are held to
+the same observable behavior.
+"""
+import pytest
+
+from kmamiz_tpu.core import schema, timeutils, urls
+
+
+class TestExplodeUrl:
+    def test_http_url_with_port(self):
+        host, port, path = urls.explode_url("http://example.com:8080/test/test")[:3]
+        assert (host, port, path) == ("example.com", ":8080", "/test/test")
+
+    def test_https_url_no_port(self):
+        host, port, path = urls.explode_url("https://192.168.1.1/test#123")[:3]
+        assert (host, port, path) == ("192.168.1.1", "", "/test#123")
+
+    def test_schemeless_service_url(self):
+        host, port, path = urls.explode_url(
+            "service.test.svc.cluster.local:80/test/endpoint"
+        )[:3]
+        assert (host, port, path) == (
+            "service.test.svc.cluster.local",
+            ":80",
+            "/test/endpoint",
+        )
+
+    def test_service_url_parsing(self):
+        e = urls.explode_url(
+            "http://user-service.pdas.svc.cluster.local:80/internal/x", True
+        )
+        assert e.service == "user-service"
+        assert e.namespace == "pdas"
+        assert e.cluster == "cluster.local"
+
+    def test_non_service_url_has_no_service(self):
+        e = urls.explode_url("http://10.104.207.91/pdas/sa/requestContract", True)
+        assert e.service is None
+
+
+class TestUrlParams:
+    def test_get_params(self):
+        assert urls.get_params_from_url("http://example.com/?a=b&b=a&a=a") == [
+            {"param": "a", "type": "string"},
+            {"param": "b", "type": "string"},
+        ]
+
+    def test_no_params(self):
+        assert urls.get_params_from_url("http://example.com/path") is None
+
+    def test_numeric_param(self):
+        assert urls.get_params_from_url("http://x/?n=12")[0]["type"] == "number"
+
+    def test_unique_params_conflict_degrades_to_string(self):
+        result = urls.unique_params(
+            [
+                {"param": "a", "type": "number"},
+                {"param": "a", "type": "string"},
+            ]
+        )
+        assert result == [{"param": "a", "type": "string"}]
+
+
+class TestTimeBuckets:
+    def test_minute(self):
+        ts = 1641106513382  # 2022-01-02T06:55:13.382Z
+        assert timeutils.belongs_to_minute_timestamp(ts) == 1641106500000
+
+    def test_hour(self):
+        assert timeutils.belongs_to_hour_timestamp(1641106513382) == 1641103200000
+
+    def test_day(self):
+        assert timeutils.belongs_to_date_timestamp(1641106513382) == 1641081600000
+
+
+class TestInterfaceString:
+    def test_object_with_nested(self):
+        obj = {
+            "testNumber": 123,
+            "testString": "test",
+            "testArray": [1, 2, 3],
+            "testObjArray": [{"test": 123, "text": "test"}],
+            "testObj": {"test": 1.1, "text": "test"},
+        }
+        assert schema.object_to_interface_string(obj, "Test") == (
+            "interface Test {\n"
+            "  testArray: number[];\n"
+            "  testNumber: number;\n"
+            "  testObj: TestObj;\n"
+            "  testObjArray: TestObj[];\n"
+            "  testString: string;\n"
+            "}\n"
+            "interface TestObj {\n"
+            "  test: number;\n"
+            "  text: string;\n"
+            "}"
+        )
+
+    def test_array_root_with_nulls(self):
+        array = [
+            {
+                "id": "61d58fabd7cb2766e01db3c6",
+                "originId": None,
+                "ordinaryUserName": None,
+                "dataRequesterName": "A",
+                "dataHolderName": "B",
+                "firstSignDate": 0,
+                "secondSignDate": 0,
+                "signState": 0,
+            },
+            {
+                "id": "61d58facd7cb2766e01db7b0",
+                "originId": None,
+                "ordinaryUserName": None,
+                "dataRequesterName": "A",
+                "dataHolderName": "B",
+                "firstSignDate": 0,
+                "secondSignDate": 0,
+                "signState": -3,
+            },
+        ]
+        assert schema.object_to_interface_string(array, "ObjArray") == (
+            "interface ObjArray extends Array<ArrayItem>{}\n"
+            "interface ArrayItem {\n"
+            "  dataHolderName: string;\n"
+            "  dataRequesterName: string;\n"
+            "  firstSignDate: number;\n"
+            "  id: string;\n"
+            "  ordinaryUserName?: any;\n"
+            "  originId?: any;\n"
+            "  secondSignDate: number;\n"
+            "  signState: number;\n"
+            "}"
+        )
+
+    def test_simple_merge_schema(self):
+        assert schema.object_to_interface_string({"name": "string", "id": 0}) == (
+            "interface Root {\n  id: number;\n  name: string;\n}"
+        )
+
+    def test_primitive(self):
+        assert schema.object_to_interface_string("hello") == "string"
+        assert schema.object_to_interface_string(1.5) == "number"
+
+
+class TestInterfaceCosineSimilarity:
+    IA = """interface Root {
+      id: string;
+      reviews: Review[];
+    }
+    interface Review {
+      reviewer: string;
+      text: string;
+    }"""
+    IB = """interface Root {
+      id: string;
+      reviews: Review[];
+    }
+    interface Review {
+      rating: Rating;
+      reviewer: string;
+      text: string;
+    }
+    interface Rating {
+      color: string;
+      stars: number;
+    }"""
+    IC = """interface Root {
+      id: number;
+      ratings: Ratings;
+    }
+    interface Ratings {
+      Reviewer1: number;
+      Reviewer2: number;
+    }"""
+
+    def test_identity(self):
+        assert schema.interface_cosine_similarity(self.IA, self.IA) == pytest.approx(1)
+
+    def test_pairs(self):
+        assert schema.interface_cosine_similarity(self.IA, self.IB) == pytest.approx(
+            0.775, abs=5e-4
+        )
+        assert schema.interface_cosine_similarity(self.IA, self.IC) == pytest.approx(
+            0.167, abs=5e-4
+        )
+        assert schema.interface_cosine_similarity(self.IB, self.IC) == pytest.approx(
+            0.129, abs=5e-4
+        )
+
+    def test_generated_interfaces(self):
+        obj1 = [
+            {
+                "id": "61d58fabd7cb2766e01db3c6",
+                "originId": None,
+                "ordinaryUserName": None,
+                "dataRequesterName": "A",
+                "dataHolderName": "B",
+                "firstSignDate": 0,
+                "secondSignDate": 0,
+                "signState": 0,
+            },
+            {
+                "id": "61d58facd7cb2766e01db7b0",
+                "originId": None,
+                "ordinaryUserName": None,
+                "dataRequesterName": "A",
+                "dataHolderName": "B",
+                "firstSignDate": 0,
+                "secondSignDate": 0,
+                "signState": -3,
+            },
+        ]
+        obj2 = {
+            "id": "5fc0b2b71952525d6bc3c524",
+            "email": "request",
+            "telephone": None,
+            "mobilePhone": "0912345678",
+            "address": "x",
+            "password": None,
+            "userType": 1,
+            "certificates": None,
+            "keys": None,
+            "principalName": "p",
+            "organizationName": "o",
+        }
+        obj3 = obj1[0]
+        i1 = schema.object_to_interface_string(obj1)
+        i2 = schema.object_to_interface_string(obj2)
+        i3 = schema.object_to_interface_string(obj3)
+        assert schema.interface_cosine_similarity(i1, i2) == pytest.approx(
+            0.101, abs=5e-4
+        )
+        assert schema.interface_cosine_similarity(i1, i3) == pytest.approx(
+            0.94, abs=5e-3
+        )
+
+
+class TestMerge:
+    def test_merge_objects(self):
+        obj1 = {"name": "test", "nestObj": {"time": 123}}
+        obj2 = {"id": "123", "nestObj": {"id": "123", "array": [1, 2, 3, 4, 5]}}
+        assert schema.merge(obj1, obj2) == {
+            "name": "test",
+            "nestObj": {"id": "123", "array": [1, 2, 3, 4, 5]},
+            "id": "123",
+        }
+
+    def test_merge_arrays(self):
+        arr1 = [{"name": "123"}, {"name": "234", "id": 123}]
+        arr2 = [{"name": "456"}, {"id": 234}, {"id": 1234, "array": [1, 2, 3, 4, 5]}]
+        assert schema.merge(arr1, arr2) == arr1 + arr2
+
+    def test_merge_string_body(self):
+        import json
+
+        str1 = schema.json_stringify({"name": "test", "nestObj": {"time": 123}})
+        str2 = schema.json_stringify(
+            {"id": "123", "nestObj": {"id": "123", "array": [1, 2, 3, 4, 5]}}
+        )
+        merged = schema.merge_string_body(str1, str2)
+        assert json.loads(merged) == {
+            "name": "test",
+            "nestObj": {"id": "123", "array": [1, 2, 3, 4, 5]},
+            "id": "123",
+        }
+
+    def test_merge_string_body_one_side(self):
+        assert schema.merge_string_body(None, '{"a":1}') == '{"a":1}'
+        assert schema.merge_string_body('{"a":1}', None) == '{"a":1}'
+
+
+class TestOpenApiMapping:
+    def test_nested(self):
+        obj = {"name": "string", "nestObj": {"array": [1, 2, 3], "id": "test"}}
+        assert schema.map_object_to_openapi_types(obj) == {
+            "type": "object",
+            "properties": {
+                "name": {"type": "string"},
+                "nestObj": {
+                    "type": "object",
+                    "properties": {
+                        "array": {"type": "array", "items": {"type": "number"}},
+                        "id": {"type": "string"},
+                    },
+                },
+            },
+        }
+
+
+class TestNormalizer:
+    def test_between_fixed_number(self):
+        from kmamiz_tpu.analytics import normalizer
+
+        assert normalizer.between_fixed_number([1, 2, 3]) == pytest.approx(
+            [0.1, 0.55, 1]
+        )
+        assert normalizer.linear([1, 2, 3]) == pytest.approx([0.4, 0.7, 1])
+        assert normalizer.fixed_ratio([1, 2, 4]) == pytest.approx([0.25, 0.5, 1])
+        import math
+
+        assert normalizer.sigmoid([1, 2, 3]) == pytest.approx(
+            [1 / (1 + math.exp(-v)) for v in [1, 2, 3]]
+        )

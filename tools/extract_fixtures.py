@@ -1,0 +1,174 @@
+"""Extract pure-literal test fixtures from the reference's TS mock data.
+
+Reads /root/reference/tests/MockData.ts and MockData2.ts, slices selected
+`const X = [...]` blocks, converts the JS object literals to JSON, and
+writes tests/fixtures/*.json. This extracts captured DATA (real Zipkin
+traces from Istio Bookinfo and PDAS, envoy log lines) to serve as the
+cross-implementation parity corpus — no reference code is copied.
+
+Usage: python tools/extract_fixtures.py
+"""
+from __future__ import annotations
+
+import json
+import re
+from pathlib import Path
+
+REF = Path("/root/reference/tests")
+OUT = Path(__file__).resolve().parent.parent / "tests" / "fixtures"
+
+_UNDEF = "_UNDEFINED_"
+
+
+def slice_const(source: str, name: str) -> str:
+    """Return the JS expression assigned to `const <name> =` (brace-matched)."""
+    m = re.search(rf"^const {re.escape(name)}[^=]*=", source, re.M)
+    if not m:
+        raise KeyError(name)
+    i = m.end()
+    # find the start bracket
+    while source[i] in " \n\t":
+        i += 1
+    start = i
+    depth = 0
+    in_str: str | None = None
+    while i < len(source):
+        c = source[i]
+        if in_str:
+            if c == "\\":
+                i += 2
+                continue
+            if c == in_str:
+                in_str = None
+        elif c in "\"'`":
+            in_str = c
+        elif c in "[{(":
+            depth += 1
+        elif c in "]})":
+            depth -= 1
+            if depth == 0:
+                return source[start : i + 1]
+        elif c == "/" and source[i : i + 2] == "//":
+            i = source.index("\n", i)
+        i += 1
+    raise ValueError(f"unbalanced block for {name}")
+
+
+def strip_comments(js: str) -> str:
+    out = []
+    i = 0
+    in_str: str | None = None
+    while i < len(js):
+        c = js[i]
+        if in_str:
+            if c == "\\":
+                out.append(js[i : i + 2])
+                i += 2
+                continue
+            if c == in_str:
+                in_str = None
+            out.append(c)
+        elif c in "\"'`":
+            in_str = c
+            out.append(c)
+        elif c == "/" and js[i : i + 2] == "//":
+            i = js.index("\n", i)
+            continue
+        else:
+            out.append(c)
+        i += 1
+    return "".join(out)
+
+
+def js_to_json(js: str) -> str:
+    """Convert a comment-free JS literal to JSON text (string-aware scan)."""
+    out = []
+    i = 0
+    n = len(js)
+    while i < n:
+        c = js[i]
+        if c in "\"'":
+            quote = c
+            buf = []
+            i += 1
+            while i < n:
+                ch = js[i]
+                if ch == "\\":
+                    nxt = js[i + 1]
+                    if nxt == "'":
+                        buf.append("'")
+                    else:
+                        buf.append(ch + nxt)
+                    i += 2
+                    continue
+                if ch == quote:
+                    break
+                if ch == '"' and quote == "'":
+                    buf.append('\\"')
+                elif ch == "\n":
+                    buf.append("\\n")
+                elif ch == "\t":
+                    buf.append("\\t")
+                else:
+                    buf.append(ch)
+                i += 1
+            out.append('"' + "".join(buf) + '"')
+            i += 1
+            continue
+        out.append(c)
+        i += 1
+    text = "".join(out)
+    # unquoted identifier keys -> quoted
+    text = re.sub(r"([{,\[]\s*)([A-Za-z_$][\w$]*)\s*:", r'\1"\2":', text)
+    # undefined values -> sentinel
+    text = re.sub(r":\s*undefined", f': "{_UNDEF}"', text)
+    # trailing commas
+    text = re.sub(r",(\s*[}\]])", r"\1", text)
+    return text
+
+
+def drop_undefined(obj):
+    if isinstance(obj, list):
+        return [drop_undefined(o) for o in obj]
+    if isinstance(obj, dict):
+        return {k: drop_undefined(v) for k, v in obj.items() if v != _UNDEF}
+    return obj
+
+
+def extract(source: str, name: str):
+    return drop_undefined(json.loads(js_to_json(strip_comments(slice_const(source, name)))))
+
+
+def extract_template_lines(source: str, name: str):
+    """Extract a backtick template string split('\\n') into a list of lines."""
+    block = slice_const(source, name)
+    m = re.search(r"`(.*)`", block, re.S)
+    assert m, name
+    return m.group(1).split("\n")
+
+
+def main() -> None:
+    OUT.mkdir(parents=True, exist_ok=True)
+    mock1 = (REF / "MockData.ts").read_text()
+    mock2 = (REF / "MockData2.ts").read_text()
+
+    fixtures = {
+        "bookinfo_traces": extract(mock1, "MockTrace"),
+        "bookinfo_endpoint_dependencies": extract(mock1, "MockEndpointDependencies"),
+        "pdas_traces": extract(mock1, "MockTracePDAS"),
+        "pdas_realtime_data": extract(mock1, "MockRlDataPDAS"),
+        "pdas_endpoint_dependencies": extract(mock1, "MockEndpointDependenciesPDAS"),
+        "pdas_endpoint_info_1": extract(mock1, "MockEndpointInfoPDAS1"),
+        "pdas_envoy_log_lines": extract_template_lines(mock1, "MockLogsPDAS"),
+        "pdas2_traces": extract(mock2, "traces"),
+        "pdas2_raw_logs": extract(mock2, "rawLogs"),
+    }
+    for fname, data in fixtures.items():
+        path = OUT / f"{fname}.json"
+        path.write_text(json.dumps(data, indent=1, ensure_ascii=False))
+        kind = f"{len(data)} items" if isinstance(data, list) else "object"
+        print(f"wrote {path.name}: {kind}")
+
+
+if __name__ == "__main__":
+    main()

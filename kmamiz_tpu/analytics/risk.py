@@ -1,0 +1,211 @@
+"""Service risk scoring: risk = impact x probability.
+
+Parity with /root/reference/src/utils/RiskAnalyzer.ts. Host implementation
+over small per-service vectors; the batched device variant (used by the
+window pipeline at scale) lives in kmamiz_tpu.ops.scorers.
+
+Quirk preserved deliberately: RealtimeRisk normalizes with
+BetweenFixedNumber, which collapses to a single-element list when all risks
+are equal — services beyond index 0 then get norm=None, exactly as the
+reference's out-of-bounds index yields undefined (RiskAnalyzer.ts:43-48).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from kmamiz_tpu.analytics import normalizer
+
+MINIMUM_PROB = 0.01
+
+
+def realtime_risk(
+    data: List[dict],
+    dependencies: List[dict],
+    replicas: List[dict],
+) -> List[dict]:
+    """Per-service risk over one window of combined realtime data
+    (RiskAnalyzer.ts:10-49)."""
+    impacts = impact(dependencies, replicas)
+    probabilities = probability(data)
+
+    service_names: List[str] = []
+    seen = set()
+    for r in data:
+        s = r["uniqueServiceName"]
+        if s not in seen:
+            seen.add(s)
+            service_names.append(s)
+
+    impact_map = {i["uniqueServiceName"]: i["impact"] for i in impacts}
+    prob_map = {p["uniqueServiceName"]: p["probability"] for p in probabilities}
+
+    risks = []
+    for s in service_names:
+        service, namespace, version = s.split("\t")
+        imp = impact_map.get(s) or 0
+        prob = prob_map.get(s) or MINIMUM_PROB
+        risks.append(
+            {
+                "uniqueServiceName": s,
+                "service": service,
+                "namespace": namespace,
+                "version": version,
+                "risk": imp * prob,
+                "impact": imp,
+                "probability": prob,
+            }
+        )
+
+    norm_risk = normalizer.between_fixed_number([r["risk"] for r in risks]) if risks else []
+    return [
+        {**r, "norm": norm_risk[i] if i < len(norm_risk) else None}
+        for i, r in enumerate(risks)
+    ]
+
+
+def impact(dependencies: List[dict], replicas: List[dict]) -> List[dict]:
+    """Impact = norm(RelyingFactor) + norm(ACS) over replicas, re-normalized
+    (RiskAnalyzer.ts:51-85)."""
+    rf = relying_factor(dependencies)
+    acs = absolute_criticality_of_services(dependencies)
+
+    def norm(items: List[dict]) -> List[float]:
+        ordered = sorted(items, key=lambda x: x["uniqueServiceName"])
+        return normalizer.fixed_ratio([x["factor"] for x in ordered]) if ordered else []
+
+    norm_rf = norm(rf)
+    norm_acs = norm(acs)
+
+    names = sorted(d["uniqueServiceName"] for d in dependencies)
+    replica_map = {r["uniqueServiceName"]: r.get("replicas") for r in replicas}
+    raw = [
+        {
+            "uniqueServiceName": name,
+            "impact": (norm_rf[i] + norm_acs[i]) / (replica_map.get(name) or 1),
+        }
+        for i, name in enumerate(names)
+    ]
+    norm_impact = normalizer.linear([r["impact"] for r in raw]) if raw else []
+    return [{**r, "impact": norm_impact[i]} for i, r in enumerate(raw)]
+
+
+def probability(data: List[dict]) -> List[dict]:
+    """Probability from invoke frequency, error rate, and latency-CV
+    reliability (RiskAnalyzer.ts:87-122)."""
+    metric = reliability_metric(data)
+    raw_ipe = invoke_probability_and_error_rate(data)
+
+    norm_pro = [p["probability"] * (1 - MINIMUM_PROB) + MINIMUM_PROB for p in raw_ipe]
+    norm_err = [p["errorRate"] * (1 - MINIMUM_PROB) + MINIMUM_PROB for p in raw_ipe]
+    base = (
+        normalizer.linear(
+            [p * e for p, e in zip(norm_pro, norm_err)], MINIMUM_PROB
+        )
+        if raw_ipe
+        else []
+    )
+    base_prob_map = {
+        raw_ipe[i]["uniqueServiceName"]: base[i] for i in range(len(raw_ipe))
+    }
+
+    out = []
+    for m in metric:
+        prob = base_prob_map[m["uniqueServiceName"]]
+        p = m["norm"] * (MINIMUM_PROB if prob < MINIMUM_PROB else prob)
+        out.append(
+            {
+                "uniqueServiceName": m["uniqueServiceName"],
+                "probability": p * (1 - MINIMUM_PROB) + MINIMUM_PROB,
+            }
+        )
+    return out
+
+
+def relying_factor(dependencies: List[dict]) -> List[dict]:
+    """Sum of dependingBy/distance over link details, +1 for gateways
+    (RiskAnalyzer.ts:124-137)."""
+    out = []
+    for d in dependencies:
+        factor = sum(
+            detail["dependingBy"] / detail["distance"]
+            for link in d["links"]
+            for detail in link["details"]
+        )
+        is_gateway = any(not dep["dependingBy"] for dep in d["dependency"])
+        out.append(
+            {
+                "uniqueServiceName": d["uniqueServiceName"],
+                "factor": factor + (1 if is_gateway else 0),
+            }
+        )
+    return out
+
+
+def absolute_criticality_of_services(dependencies: List[dict]) -> List[dict]:
+    """ACS = AIS x ADS at distance 1; gateways get AIS += 1
+    (RiskAnalyzer.ts:145-169)."""
+    out = []
+    for d in dependencies:
+        is_gateway = any(not dep["dependingBy"] for dep in d["dependency"])
+        ais = 1 if is_gateway else 0
+        ads = 0
+        for link in d["links"]:
+            for detail in link["details"]:
+                if detail["distance"] != 1:
+                    continue
+                if detail["dependingBy"] > 0:
+                    ais += 1
+                if detail["dependingOn"] > 0:
+                    ads += 1
+        out.append(
+            {
+                "uniqueServiceName": d["uniqueServiceName"],
+                "factor": ais * ads,
+                "ais": ais,
+                "ads": ads,
+            }
+        )
+    return out
+
+
+def invoke_probability_and_error_rate(
+    data: List[dict], include_request_error: bool = False
+) -> List[dict]:
+    counts: Dict[str, dict] = {}
+    for r in data:
+        status = str(r["status"])
+        is_error = status.startswith("5") or (
+            include_request_error and status.startswith("4")
+        )
+        c = counts.setdefault(r["uniqueServiceName"], {"count": 0, "error": 0})
+        c["count"] += r["combined"]
+        if is_error:
+            c["error"] += r["combined"]
+
+    total = sum(c["count"] for c in counts.values())
+    return [
+        {
+            "uniqueServiceName": name,
+            "probability": c["count"] / total,
+            "errorRate": c["error"] / c["count"],
+        }
+        for name, c in counts.items()
+    ]
+
+
+def reliability_metric(data: List[dict]) -> List[dict]:
+    metric = latency_cv_of_services(data)
+    norms = normalizer.sigmoid_adj([m["metric"] for m in metric]) if metric else []
+    return [{**m, "norm": norms[i]} for i, m in enumerate(metric)]
+
+
+def latency_cv_of_services(service_data: List[dict]) -> List[dict]:
+    groups: Dict[str, List[dict]] = {}
+    for s in service_data:
+        groups.setdefault(s["uniqueServiceName"], []).append(s)
+    out = []
+    for name, rows in groups.items():
+        total = sum(d["combined"] for d in rows)
+        weighted = sum(d["latency"]["cv"] * d["combined"] for d in rows)
+        out.append({"uniqueServiceName": name, "metric": weighted / total})
+    return out

@@ -1,0 +1,106 @@
+"""Global configuration from environment variables.
+
+Equivalent of /root/reference/src/GlobalSettings.ts:54-89 plus the Rust DP's
+env (/root/reference/kmamiz_data_processor/src/env.rs), with TPU-specific
+additions (mesh shape, batch padding policy).
+"""
+from __future__ import annotations
+
+import os
+import re
+from dataclasses import dataclass, field
+
+
+def _env_bool(name: str) -> bool:
+    return os.environ.get(name) == "true"
+
+
+@dataclass
+class Settings:
+    port: str = field(default_factory=lambda: os.environ.get("PORT", "3000"))
+    timezone: str = field(default_factory=lambda: os.environ.get("TZ", "Asia/Taipei"))
+    api_version: str = field(default_factory=lambda: os.environ.get("API_VERSION", "1"))
+    log_level: str = field(default_factory=lambda: os.environ.get("LOG_LEVEL", "info"))
+    kube_api_host: str = field(
+        default_factory=lambda: os.environ.get("KUBEAPI_HOST", "http://127.0.0.1:8080")
+    )
+    is_running_in_kubernetes: bool = field(
+        default_factory=lambda: _env_bool("IS_RUNNING_IN_K8S")
+    )
+    zipkin_url: str = field(
+        default_factory=lambda: os.environ.get("ZIPKIN_URL", "http://localhost:9411")
+    )
+    storage_uri: str = field(
+        default_factory=lambda: os.environ.get(
+            "STORAGE_URI", os.environ.get("MONGODB_URI", "file://./kmamiz-data")
+        )
+    )
+    external_data_processor: str = field(
+        default_factory=lambda: os.environ.get("EXTERNAL_DATA_PROCESSOR", "")
+    )
+    aggregate_interval: str = field(
+        default_factory=lambda: os.environ.get("AGGREGATE_INTERVAL", "*/5 * * * *")
+    )
+    realtime_interval: str = field(
+        default_factory=lambda: os.environ.get("REALTIME_INTERVAL", "0/5 * * * *")
+    )
+    dispatch_interval: str = field(
+        default_factory=lambda: os.environ.get("DISPATCH_INTERVAL", "0/30 * * * *")
+    )
+    envoy_log_level: str = field(
+        default_factory=lambda: os.environ.get("ENVOY_LOG_LEVEL", "info")
+    )
+    reset_endpoint_dependencies: bool = field(
+        default_factory=lambda: _env_bool("RESET_ENDPOINT_DEPENDENCIES")
+    )
+    read_only_mode: bool = field(default_factory=lambda: _env_bool("READ_ONLY_MODE"))
+    enable_testing_endpoints: bool = field(
+        default_factory=lambda: _env_bool("ENABLE_TESTING_ENDPOINTS")
+    )
+    service_port: str = field(
+        default_factory=lambda: os.environ.get(
+            "SERVICE_PORT", os.environ.get("PORT", "3000")
+        )
+    )
+    serve_only: bool = field(default_factory=lambda: _env_bool("SERVE_ONLY"))
+    inactive_endpoint_threshold: str = field(
+        default_factory=lambda: os.environ.get("INACTIVE_ENDPOINT_THRESHOLD", "")
+    )
+    deprecated_endpoint_threshold: str = field(
+        default_factory=lambda: os.environ.get("DEPRECATED_ENDPOINT_THRESHOLD", "")
+    )
+    simulator_mode: bool = field(default_factory=lambda: _env_bool("SIMULATOR_MODE"))
+
+    # TPU-specific
+    mesh_devices: int = field(
+        default_factory=lambda: int(os.environ.get("KMAMIZ_MESH_DEVICES", "0"))
+    )  # 0 = all available
+    span_batch_pad: int = field(
+        default_factory=lambda: int(os.environ.get("KMAMIZ_SPAN_BATCH_PAD", "2"))
+    )  # pad batches to powers of this base to bound recompilation
+
+    def __post_init__(self) -> None:
+        k8s_host = os.environ.get("KUBERNETES_SERVICE_HOST")
+        k8s_port = os.environ.get("KUBERNETES_SERVICE_PORT")
+        if self.is_running_in_kubernetes and k8s_host and k8s_port:
+            self.kube_api_host = f"https://{k8s_host}:{k8s_port}"
+
+
+_THRESHOLD_RE = re.compile(r"(?:(\d+)d)?(?:(\d+)h)?(?:(\d+)m)?")
+
+
+def parse_threshold_ms(threshold: str) -> int:
+    """Parse "1d2h30m"-style thresholds to milliseconds
+    (reference EndpointDependencies.parseThresholdToMilliseconds)."""
+    if not threshold:
+        return 0
+    m = _THRESHOLD_RE.match(threshold)
+    if not m:
+        return 0
+    days = int(m.group(1) or 0)
+    hours = int(m.group(2) or 0)
+    minutes = int(m.group(3) or 0)
+    return (days * 86400 + hours * 3600 + minutes * 60) * 1000
+
+
+settings = Settings()

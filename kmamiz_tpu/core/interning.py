@@ -1,0 +1,81 @@
+"""String interning: the bridge between the string-keyed reference model and
+id-indexed device arrays.
+
+The reference keys everything by tab-joined strings
+(uniqueServiceName = "svc\\tns\\tversion",
+uniqueEndpointName = "svc\\tns\\tver\\tMETHOD\\turl"; see
+/root/reference/src/classes/Traces.ts:35,46). On TPU those become int32 ids
+into per-kind intern tables; all device arrays are id-indexed and strings
+never leave the host.
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional
+
+
+class StringInterner:
+    """Bidirectional string<->int32 table with insertion-order ids."""
+
+    __slots__ = ("_to_id", "_strings")
+
+    def __init__(self, strings: Optional[Iterable[str]] = None) -> None:
+        self._to_id: Dict[str, int] = {}
+        self._strings: List[str] = []
+        if strings:
+            for s in strings:
+                self.intern(s)
+
+    def intern(self, s: str) -> int:
+        i = self._to_id.get(s)
+        if i is None:
+            i = len(self._strings)
+            self._to_id[s] = i
+            self._strings.append(s)
+        return i
+
+    def get(self, s: str) -> Optional[int]:
+        return self._to_id.get(s)
+
+    def lookup(self, i: int) -> str:
+        return self._strings[i]
+
+    def __len__(self) -> int:
+        return len(self._strings)
+
+    def __contains__(self, s: str) -> bool:
+        return s in self._to_id
+
+    @property
+    def strings(self) -> List[str]:
+        return self._strings
+
+
+class EndpointInterner:
+    """Intern tables for the graph's naming hierarchy.
+
+    endpoints (uniqueEndpointName), services (uniqueServiceName), and the
+    endpoint->service mapping as a growable int32 relation.
+    """
+
+    def __init__(self) -> None:
+        self.endpoints = StringInterner()
+        self.services = StringInterner()
+        self._endpoint_service: List[int] = []
+
+    def intern_endpoint(self, unique_endpoint_name: str) -> int:
+        eid = self.endpoints.get(unique_endpoint_name)
+        if eid is not None:
+            return eid
+        eid = self.endpoints.intern(unique_endpoint_name)
+        parts = unique_endpoint_name.split("\t")
+        service_name = "\t".join(parts[:3])
+        sid = self.services.intern(service_name)
+        self._endpoint_service.append(sid)
+        return eid
+
+    def service_of(self, endpoint_id: int) -> int:
+        return self._endpoint_service[endpoint_id]
+
+    @property
+    def endpoint_service_ids(self) -> List[int]:
+        return self._endpoint_service

@@ -263,6 +263,19 @@ def interface_cosine_similarity(interface_a: str, interface_b: str) -> float:
 # ---------------------------------------------------------------------------
 
 
+def js_str(value: Any) -> str:
+    """JS template-literal coercion: undefined -> 'undefined', booleans to
+    lowercase; used where the reference embeds possibly-missing values in
+    tab-joined keys."""
+    if value is None:
+        return "undefined"
+    if value is True:
+        return "true"
+    if value is False:
+        return "false"
+    return str(value)
+
+
 def js_truthy(value: Any) -> bool:
     if value is None or value is False:
         return False

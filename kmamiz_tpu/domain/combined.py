@@ -1,0 +1,308 @@
+"""Combined (endpoint, status)-grouped realtime data.
+
+Parity with /root/reference/src/classes/CombinedRealtimeDataList.ts:
+minute-bucketed historical rollups with risk injection, endpoint datatype
+extraction, and the pooled-variance + magnitude-rescaling CV merge used when
+windows are combined across ticks (combineWith, :183-332).
+"""
+from __future__ import annotations
+
+import math
+from typing import Callable, Dict, List, Optional, Set
+
+from kmamiz_tpu.core import schema
+from kmamiz_tpu.core.timeutils import belongs_to_minute_timestamp, to_precise
+from kmamiz_tpu.core.urls import get_params_from_url
+
+
+def _get_scale_shift(mean1: float, mean2: float) -> int:
+    def safe_log10(x: float) -> int:
+        if x <= 0:
+            return 0
+        return math.floor(math.log10(x))
+
+    return math.floor((safe_log10(mean1) + safe_log10(mean2)) / 2)
+
+
+def combine_latency_cv_and_mean(
+    n1: float, mean1: float, cv1: float, n2: float, mean2: float, cv2: float
+) -> dict:
+    """Pooled-variance merge of two (n, mean, cv) groups with values rescaled
+    to a shared magnitude first (CombinedRealtimeDataList.ts:278-315)."""
+    shift = _get_scale_shift(mean1, mean2)
+    scale = 10.0 ** shift
+
+    mean1s = mean1 / scale
+    mean2s = mean2 / scale
+    std1s = cv1 * mean1s
+    std2s = cv2 * mean2s
+
+    total_n = n1 + n2
+    mean_total = (n1 * mean1s + n2 * mean2s) / total_n
+
+    pooled_variance = (
+        n1 * std1s**2
+        + n2 * std2s**2
+        + n1 * (mean1s - mean_total) ** 2
+        + n2 * (mean2s - mean_total) ** 2
+    ) / total_n
+
+    std_total = math.sqrt(pooled_variance)
+    cv_total = 0.0 if mean_total == 0 else std_total / mean_total
+    return {"mean": mean_total * scale, "cv": cv_total}
+
+
+class CombinedRealtimeDataList:
+    def __init__(self, combined_realtime_data: List[dict]) -> None:
+        self._data = combined_realtime_data
+
+    def to_json(self) -> List[dict]:
+        return self._data
+
+    def get_containing_namespaces(self) -> Set[str]:
+        return {r["namespace"] for r in self._data}
+
+    def adjust_timestamp(self, to_ms: float) -> "CombinedRealtimeDataList":
+        return CombinedRealtimeDataList(
+            [{**r, "latestTimestamp": to_ms * 1000} for r in self._data]
+        )
+
+    # -- historical rollup ---------------------------------------------------
+
+    def to_historical_data(
+        self,
+        service_dependencies: List[dict],
+        replicas: Optional[List[dict]] = None,
+        label_map: Optional[Dict[str, str]] = None,
+        belongs_to_func: Callable[[float], int] = belongs_to_minute_timestamp,
+    ) -> List[dict]:
+        """Bucket by minute; per-endpoint/service request/error/latency rollups
+        with per-bucket risk scoring (CombinedRealtimeDataList.ts:26-150)."""
+        from kmamiz_tpu.analytics import risk as risk_analyzer
+
+        replicas = replicas or []
+        date_mapping: Dict[int, List[dict]] = {}
+        for r in self._data:
+            time = belongs_to_func(r["latestTimestamp"] / 1000)
+            date_mapping.setdefault(time, []).append(r)
+
+        out = []
+        for time, daily in date_mapping.items():
+            risks = risk_analyzer.realtime_risk(daily, service_dependencies, replicas)
+            endpoint_map: Dict[str, List[dict]] = {}
+            service_map: Dict[str, List[dict]] = {}
+            for r in daily:
+                endpoint_map.setdefault(r["uniqueEndpointName"], []).append(r)
+                service_map.setdefault(r["uniqueServiceName"], []).append(r)
+            all_endpoints = self._historical_endpoint_info(endpoint_map, label_map)
+            out.append(
+                {
+                    "date": time,
+                    "services": self._historical_service_info(
+                        time, service_map, all_endpoints, risks
+                    ),
+                }
+            )
+        return out
+
+    @staticmethod
+    def _sum_errors(rows: List[dict]) -> dict:
+        requests = request_errors = server_errors = 0
+        for r in rows:
+            add = r["combined"]
+            requests += add
+            if str(r["status"]).startswith("4"):
+                request_errors += add
+            if str(r["status"]).startswith("5"):
+                server_errors += add
+        return {
+            "requests": requests,
+            "requestErrors": request_errors,
+            "serverErrors": server_errors,
+        }
+
+    @staticmethod
+    def _mean_latency(rows: List[dict]) -> float:
+        valid = [
+            r["latency"]["mean"]
+            for r in rows
+            if r["latency"].get("mean") is not None
+        ]
+        if not valid:
+            return 0.0
+        mean = sum(valid) / len(valid)
+        return mean if math.isfinite(mean) else 0.0
+
+    def _historical_endpoint_info(
+        self,
+        endpoint_map: Dict[str, List[dict]],
+        label_map: Optional[Dict[str, str]],
+    ) -> List[dict]:
+        out = []
+        for unique_endpoint_name, rows in endpoint_map.items():
+            service, namespace, version, method = unique_endpoint_name.split("\t")[:4]
+            counts = self._sum_errors(rows)
+            out.append(
+                {
+                    "latencyMean": self._mean_latency(rows),
+                    "latencyCV": max(r["latency"].get("cv") or 0 for r in rows),
+                    "method": method,
+                    "requestErrors": counts["requestErrors"],
+                    "requests": counts["requests"],
+                    "serverErrors": counts["serverErrors"],
+                    "uniqueEndpointName": unique_endpoint_name,
+                    "uniqueServiceName": f"{service}\t{namespace}\t{version}",
+                    "labelName": (label_map or {}).get(unique_endpoint_name),
+                }
+            )
+        return out
+
+    def _historical_service_info(
+        self,
+        time: int,
+        service_map: Dict[str, List[dict]],
+        all_endpoints: List[dict],
+        risks: List[dict],
+    ) -> List[dict]:
+        out = []
+        for unique_service_name, rows in service_map.items():
+            service, namespace, version = unique_service_name.split("\t")
+            endpoints = [
+                e for e in all_endpoints if e["uniqueServiceName"] == unique_service_name
+            ]
+            requests = sum(e["requests"] for e in endpoints)
+            request_errors = sum(e["requestErrors"] for e in endpoints)
+            server_errors = sum(e["serverErrors"] for e in endpoints)
+            risk = next(
+                (
+                    r.get("norm")
+                    for r in risks
+                    if r["uniqueServiceName"] == unique_service_name
+                ),
+                None,
+            )
+            out.append(
+                {
+                    "date": time,
+                    "endpoints": endpoints,
+                    "service": service,
+                    "namespace": namespace,
+                    "version": version,
+                    "requests": requests,
+                    "requestErrors": request_errors,
+                    "serverErrors": server_errors,
+                    "latencyMean": self._mean_latency(rows),
+                    "latencyCV": max(r["latency"].get("cv") or 0 for r in rows),
+                    "uniqueServiceName": unique_service_name,
+                    "risk": risk,
+                }
+            )
+        return out
+
+    # -- datatype extraction -------------------------------------------------
+
+    def extract_endpoint_data_type(
+        self, label_map: Optional[Dict[str, str]] = None
+    ) -> List["EndpointDataType"]:
+        from kmamiz_tpu.domain.endpoint_data_type import EndpointDataType
+
+        out = []
+        for r in self._data:
+            tokens = r["uniqueEndpointName"].split("\t")
+            request_params = get_params_from_url(tokens[-1])
+            out.append(
+                EndpointDataType(
+                    {
+                        "service": r["service"],
+                        "namespace": r["namespace"],
+                        "method": r["method"],
+                        "version": r["version"],
+                        "uniqueEndpointName": r["uniqueEndpointName"],
+                        "uniqueServiceName": r["uniqueServiceName"],
+                        "labelName": (label_map or {}).get(r["uniqueEndpointName"]),
+                        "schemas": [
+                            {
+                                "status": r["status"],
+                                "time": r["latestTimestamp"] / 1000,
+                                "requestContentType": r.get("requestContentType"),
+                                "requestSample": r.get("requestBody"),
+                                "requestSchema": r.get("requestSchema"),
+                                "responseContentType": r.get("responseContentType"),
+                                "responseSample": r.get("responseBody"),
+                                "responseSchema": r.get("responseSchema"),
+                                "requestParams": request_params,
+                            }
+                        ],
+                    }
+                )
+            )
+        return out
+
+    # -- cross-window merge --------------------------------------------------
+
+    def combine_with(
+        self, other: "CombinedRealtimeDataList"
+    ) -> "CombinedRealtimeDataList":
+        groups: Dict[str, List[dict]] = {}
+        for r in self._data + other._data:
+            key = f"{r['uniqueEndpointName']}\t{r['status']}"
+            groups.setdefault(key, []).append(r)
+
+        combined_out = []
+        for group in groups.values():
+            sample = group[0]
+            base = {
+                "uniqueEndpointName": sample["uniqueEndpointName"],
+                "uniqueServiceName": sample["uniqueServiceName"],
+                "service": sample["service"],
+                "namespace": sample["namespace"],
+                "version": sample["version"],
+                "method": sample["method"],
+                "status": sample["status"],
+                "combined": sum(r["combined"] for r in group),
+                "requestContentType": sample.get("requestContentType"),
+                "responseContentType": sample.get("responseContentType"),
+            }
+
+            latest_timestamp = sample["latestTimestamp"]
+            request_body = sample.get("requestBody")
+            response_body = sample.get("responseBody")
+            request_schema = sample.get("requestSchema")
+            response_schema = sample.get("responseSchema")
+            for curr in group[1:]:
+                latest_timestamp = max(latest_timestamp, curr["latestTimestamp"])
+                request_body = schema.merge(request_body, curr.get("requestBody"))
+                response_body = schema.merge(response_body, curr.get("responseBody"))
+                if schema.js_truthy(request_body):
+                    request_schema = schema.object_to_interface_string(request_body)
+                if schema.js_truthy(response_body):
+                    response_schema = schema.object_to_interface_string(response_body)
+
+            merged = {"mean": 0.0, "cv": 0.0}
+            n = 0
+            for curr in group:
+                merged = combine_latency_cv_and_mean(
+                    n,
+                    merged["mean"],
+                    merged["cv"],
+                    curr["combined"],
+                    curr["latency"]["mean"],
+                    curr["latency"]["cv"],
+                )
+                n += curr["combined"]
+
+            combined_out.append(
+                {
+                    **base,
+                    "latestTimestamp": latest_timestamp,
+                    "requestBody": request_body,
+                    "requestSchema": request_schema,
+                    "responseBody": response_body,
+                    "responseSchema": response_schema,
+                    "latency": {
+                        "mean": to_precise(merged["mean"]),
+                        "cv": to_precise(merged["cv"]),
+                    },
+                }
+            )
+        return CombinedRealtimeDataList(combined_out)

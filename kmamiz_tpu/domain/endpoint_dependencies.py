@@ -1,0 +1,549 @@
+"""The endpoint-dependency graph object and its scorers.
+
+Parity with /root/reference/src/classes/EndpointDependencies.ts: deprecated
+endpoint filtering, trim/label, force-graph data with per-node highlight
+closures, service-level rollups with per-distance link details, chord data,
+set-union merge, and the SIUC cohesion / SDP instability / ACS coupling
+scorers. The device-accelerated CSR variants of the scorers live in
+kmamiz_tpu.ops.scorers and are parity-checked against this implementation.
+"""
+from __future__ import annotations
+
+import time as _time
+from typing import Callable, Dict, List, Optional, Set
+
+from kmamiz_tpu.config import parse_threshold_ms, settings
+from kmamiz_tpu.core.schema import js_str as _js_str
+
+
+def _now_ms() -> float:
+    return _time.time() * 1000
+
+
+class EndpointDependencies:
+    def __init__(
+        self,
+        dependencies: List[dict],
+        now_ms: Optional[float] = None,
+    ) -> None:
+        self._now_ms = now_ms if now_ms is not None else _now_ms()
+        self._dependencies = self._filter_out_deprecated(dependencies)
+
+    # -- deprecated-endpoint filtering (EndpointDependencies.ts:44-74) -------
+
+    def _filter_out_deprecated(self, dependencies: List[dict]) -> List[dict]:
+        deprecated_ms = parse_threshold_ms(settings.deprecated_endpoint_threshold)
+        if deprecated_ms == 0:
+            return dependencies
+        deprecated_ts = self._now_ms - deprecated_ms
+        deprecated_names: Set[str] = set()
+        kept = []
+        for dep in dependencies:
+            if (dep.get("lastUsageTimestamp") or 0) < deprecated_ts:
+                deprecated_names.add(dep["endpoint"]["uniqueEndpointName"])
+            else:
+                kept.append(dep)
+        for dep in kept:
+            dep["dependingBy"] = [
+                d
+                for d in dep["dependingBy"]
+                if d["endpoint"]["uniqueEndpointName"] not in deprecated_names
+            ]
+            dep["dependingOn"] = [
+                d
+                for d in dep["dependingOn"]
+                if d["endpoint"]["uniqueEndpointName"] not in deprecated_names
+            ]
+        return kept
+
+    def to_json(self) -> List[dict]:
+        out = []
+        for dep in self._dependencies:
+            d = {k: v for k, v in dep.items() if k != "_id"}
+            d["dependingBy"] = [
+                {k: v for k, v in x.items() if k != "_id"} for x in d["dependingBy"]
+            ]
+            d["dependingOn"] = [
+                {k: v for k, v in x.items() if k != "_id"} for x in d["dependingOn"]
+            ]
+            out.append(d)
+        return out
+
+    @property
+    def dependencies(self) -> List[dict]:
+        return self._dependencies
+
+    # -- trim (EndpointDependencies.ts:91-112) -------------------------------
+
+    def trim(self) -> "EndpointDependencies":
+        trimmed = []
+        for d in self._dependencies:
+            d_on: Dict[str, dict] = {}
+            for dep in d["dependingOn"]:
+                d_on[f"{dep['distance']}\t{dep['endpoint']['uniqueEndpointName']}"] = dep
+            d_by: Dict[str, dict] = {}
+            for dep in d["dependingBy"]:
+                d_by[f"{dep['distance']}\t{dep['endpoint']['uniqueEndpointName']}"] = dep
+            trimmed.append(
+                {**d, "dependingBy": list(d_by.values()), "dependingOn": list(d_on.values())}
+            )
+        return EndpointDependencies(trimmed, now_ms=self._now_ms)
+
+    # -- labeling (EndpointDependencies.ts:114-153) --------------------------
+
+    def label(
+        self, get_label: Callable[[str], Optional[str]]
+    ) -> List[dict]:
+        out = []
+        for d in self._dependencies:
+            out.append(
+                {
+                    "endpoint": {
+                        **d["endpoint"],
+                        "labelName": get_label(d["endpoint"]["uniqueEndpointName"]),
+                    },
+                    "isDependedByExternal": d.get("isDependedByExternal"),
+                    "lastUsageTimestamp": d.get("lastUsageTimestamp"),
+                    "dependingOn": [
+                        {
+                            **dep,
+                            "endpoint": {
+                                **dep["endpoint"],
+                                "labelName": get_label(
+                                    dep["endpoint"]["uniqueEndpointName"]
+                                ),
+                            },
+                        }
+                        for dep in d["dependingOn"]
+                    ],
+                    "dependingBy": [
+                        {
+                            **dep,
+                            "endpoint": {
+                                **dep["endpoint"],
+                                "labelName": get_label(
+                                    dep["endpoint"]["uniqueEndpointName"]
+                                ),
+                            },
+                        }
+                        for dep in d["dependingBy"]
+                    ],
+                }
+            )
+        return out
+
+    # -- force-graph data (EndpointDependencies.ts:157-367) ------------------
+
+    def to_graph_data(self) -> dict:
+        service_endpoint_map: Dict[str, List[dict]] = {}
+        for dep in self._dependencies:
+            key = f"{dep['endpoint']['service']}\t{dep['endpoint']['namespace']}"
+            service_endpoint_map.setdefault(key, []).append(dep)
+
+        nodes, links = self._create_base_nodes_and_links(service_endpoint_map)
+        return self._create_highlight_nodes_and_links(self._dependencies, nodes, links)
+
+    def _create_base_nodes_and_links(
+        self, service_endpoint_map: Dict[str, List[dict]]
+    ):
+        inactive_ms = parse_threshold_ms(settings.inactive_endpoint_threshold)
+        inactive_ts = 0 if inactive_ms == 0 else self._now_ms - inactive_ms
+
+        exist_labels: Set[str] = set()
+        exist_links: Set[str] = set()
+        nodes: List[dict] = [
+            {
+                "id": "null",
+                "group": "null",
+                "name": "external requests",
+                "dependencies": [],
+                "linkInBetween": [],
+                "usageStatus": "Active",
+            }
+        ]
+        links: List[dict] = []
+        for service, endpoints in service_endpoint_map.items():
+            service_last_use = max((e.get("lastUsageTimestamp") or 0) for e in endpoints)
+            nodes.append(
+                {
+                    "id": service,
+                    "group": service,
+                    "name": service.replace("\t", "."),
+                    "dependencies": [],
+                    "linkInBetween": [],
+                    "usageStatus": "Active"
+                    if inactive_ts == 0 or service_last_use >= inactive_ts
+                    else "Inactive",
+                }
+            )
+            for e in endpoints:
+                ep = e["endpoint"]
+                node_id = (
+                    f"{ep['uniqueServiceName']}\t{ep['method']}"
+                    f"\t{_js_str(ep.get('labelName'))}"
+                )
+                if node_id not in exist_labels:
+                    nodes.append(
+                        {
+                            "id": node_id,
+                            "group": service,
+                            "name": (
+                                f"({ep['version']}) {ep['method']} "
+                                f"{_js_str(ep.get('labelName'))}"
+                            ),
+                            "dependencies": [],
+                            "linkInBetween": [],
+                            "usageStatus": "Active"
+                            if inactive_ts == 0
+                            or (e.get("lastUsageTimestamp") or 0) >= inactive_ts
+                            else "Inactive",
+                        }
+                    )
+                    exist_labels.add(node_id)
+                if f"{service}\t{node_id}" not in exist_links:
+                    links.append({"source": service, "target": node_id})
+                    exist_links.add(f"{service}\t{node_id}")
+                for dep in e["dependingOn"]:
+                    if dep["distance"] != 1:
+                        continue
+                    dep_ep = dep["endpoint"]
+                    dep_id = (
+                        f"{dep_ep['uniqueServiceName']}\t{dep_ep['method']}"
+                        f"\t{_js_str(dep_ep.get('labelName'))}"
+                    )
+                    if f"{node_id}\t{dep_id}" not in exist_links:
+                        links.append({"source": node_id, "target": dep_id})
+                        exist_links.add(f"{node_id}\t{dep_id}")
+                if e.get("isDependedByExternal"):
+                    if f"null\t{node_id}" not in exist_links:
+                        links.append({"source": "null", "target": node_id})
+                        exist_links.add(f"null\t{node_id}")
+        return nodes, links
+
+    def _create_highlight_nodes_and_links(
+        self, dependencies: List[dict], nodes: List[dict], links: List[dict]
+    ) -> dict:
+        with_id = [
+            {
+                **dep,
+                "uid": (
+                    f"{dep['endpoint']['uniqueServiceName']}"
+                    f"\t{dep['endpoint']['method']}"
+                    f"\t{_js_str(dep['endpoint'].get('labelName'))}"
+                ),
+                "sid": f"{dep['endpoint']['service']}\t{dep['endpoint']['namespace']}",
+            }
+            for dep in dependencies
+        ]
+
+        for n in nodes:
+            if n["id"] == "null":
+                n["dependencies"] = [
+                    d["uid"] for d in with_id if len(d["dependingBy"]) == 0
+                ]
+                n["linkInBetween"] = [
+                    {"source": "null", "target": d} for d in n["dependencies"]
+                ]
+            elif n["id"] == n["group"]:
+                n["dependencies"] = [d["uid"] for d in with_id if d["sid"] == n["id"]]
+                n["linkInBetween"] = [
+                    {"source": n["id"], "target": d} for d in n["dependencies"]
+                ]
+            else:
+                matching = [d for d in with_id if d["uid"] == n["id"]]
+                n["linkInBetween"] = []
+                n["dependencies"] = []
+                for node in matching:
+                    d_on = sorted(
+                        node["dependingOn"], key=lambda d: -d["distance"]
+                    )
+                    d_by = sorted(
+                        node["dependingBy"], key=lambda d: -d["distance"]
+                    )
+                    n["linkInBetween"] = (
+                        n["linkInBetween"]
+                        + self._map_to_links(d_on, n, links)
+                        + self._map_to_links(d_by, n, links)
+                    )
+                    seen: Set[str] = set()
+                    merged_ids = []
+                    for i in self._remap_to_id(d_on) + self._remap_to_id(d_by):
+                        if i not in seen:
+                            seen.add(i)
+                            merged_ids.append(i)
+                    n["dependencies"] = n["dependencies"] + merged_ids
+                # dedupe links preserving order
+                seen_links: Set[str] = set()
+                deduped = []
+                for l in n["linkInBetween"]:
+                    key = f"{l['source']}\t\t{l['target']}"
+                    if key not in seen_links:
+                        seen_links.add(key)
+                        deduped.append({"source": l["source"], "target": l["target"]})
+                n["linkInBetween"] = deduped
+        return {"nodes": nodes, "links": links}
+
+    @staticmethod
+    def _remap_to_id(deps: List[dict]) -> List[str]:
+        return [
+            (
+                f"{d['endpoint']['uniqueServiceName']}\t{d['endpoint']['method']}"
+                f"\t{_js_str(d['endpoint'].get('labelName'))}"
+            )
+            for d in deps
+        ]
+
+    def _map_to_links(
+        self, deps: List[dict], node: dict, links: List[dict]
+    ) -> List[dict]:
+        out = []
+        ids = self._remap_to_id(deps)
+        for i, d in enumerate(deps):
+            dep_id = ids[i]
+            remaining = set(ids[i + 1 :]) | {node["id"]}
+            src, dst = (
+                ("target", "source") if d["type"] == "SERVER" else ("source", "target")
+            )
+            out.extend(l for l in links if l[src] == dep_id and l[dst] in remaining)
+        return out
+
+    # -- service-level rollup (EndpointDependencies.ts:369-470) --------------
+
+    def to_service_dependencies(self) -> List[dict]:
+        service_names: List[str] = []
+        seen: Set[str] = set()
+        for dep in self._dependencies:
+            name = dep["endpoint"]["uniqueServiceName"]
+            if name not in seen:
+                seen.add(name)
+                service_names.append(name)
+
+        out = []
+        for unique_service_name in service_names:
+            dependency = [
+                d
+                for d in self._dependencies
+                if d["endpoint"]["uniqueServiceName"] == unique_service_name
+            ]
+            link_map = self._service_to_links_mapping(dependency)
+            service, namespace, version = unique_service_name.split("\t")
+            out.append(
+                {
+                    "service": service,
+                    "namespace": namespace,
+                    "version": version,
+                    "dependency": dependency,
+                    "links": [
+                        {
+                            "service": n.split("\t")[0],
+                            "namespace": n.split("\t")[1],
+                            "version": n.split("\t")[2],
+                            **info,
+                            "uniqueServiceName": n,
+                        }
+                        for n, info in link_map.items()
+                    ],
+                    "uniqueServiceName": unique_service_name,
+                }
+            )
+        return out
+
+    @staticmethod
+    def _service_to_links_mapping(dependency: List[dict]) -> Dict[str, dict]:
+        distance_link_set: List[str] = []
+        seen: Set[str] = set()
+        for dep in dependency:
+            for d in dep["dependingOn"] + dep["dependingBy"]:
+                ep = d["endpoint"]
+                key = (
+                    f"{ep['uniqueServiceName']}\t{ep['method']}"
+                    f"\t{_js_str(ep.get('labelName'))}\t{d['type']}\t{d['distance']}"
+                )
+                if key not in seen:
+                    seen.add(key)
+                    distance_link_set.append(key)
+
+        detail_map: Dict[str, Dict[int, dict]] = {}
+        for key in distance_link_set:
+            tokens = key.split("\t")
+            service, namespace, version = tokens[0], tokens[1], tokens[2]
+            link_type, distance = tokens[5], int(tokens[6])
+            unique_service_name = f"{service}\t{namespace}\t{version}"
+            existing = detail_map.setdefault(unique_service_name, {})
+            detail = existing.get(
+                distance,
+                {"count": 0, "dependingBy": 0, "dependingOn": 0, "distance": distance},
+            )
+            existing[distance] = {
+                "count": detail["count"] + 1,
+                "dependingBy": detail["dependingBy"] + (1 if link_type == "CLIENT" else 0),
+                "dependingOn": detail["dependingOn"] + (1 if link_type == "SERVER" else 0),
+                "distance": distance,
+            }
+
+        link_map: Dict[str, dict] = {}
+        for unique_service_name, details_by_distance in detail_map.items():
+            details = list(details_by_distance.values())
+            link_map[unique_service_name] = {
+                "details": details,
+                "count": sum(d["count"] for d in details),
+                "dependingBy": sum(d["dependingBy"] for d in details),
+                "dependingOn": sum(d["dependingOn"] for d in details),
+            }
+        return link_map
+
+    # -- chord data (EndpointDependencies.ts:472-497) ------------------------
+
+    def to_chord_data(self) -> dict:
+        def name_to_id(unique_service_name: str) -> str:
+            service, namespace, version = unique_service_name.split("\t")
+            return f"{service}.{namespace} ({version})"
+
+        svc_dep = self.to_service_dependencies()
+        links = [
+            {
+                "from": s["uniqueServiceName"],
+                "to": l["uniqueServiceName"],
+                "value": l["dependingOn"],
+            }
+            for s in svc_dep
+            for l in s["links"]
+            if l["dependingOn"] > 0
+        ]
+        node_names: List[str] = []
+        seen: Set[str] = set()
+        for l in links:
+            for n in (l["from"], l["to"]):
+                if n not in seen:
+                    seen.add(n)
+                    node_names.append(n)
+        return {
+            "nodes": [{"id": name_to_id(n), "name": n} for n in node_names],
+            "links": [
+                {**l, "from": name_to_id(l["from"]), "to": name_to_id(l["to"])}
+                for l in links
+            ],
+        }
+
+    # -- set-union merge (EndpointDependencies.ts:499-563) -------------------
+
+    def combine_with(self, other: "EndpointDependencies") -> "EndpointDependencies":
+        dependency_map: Dict[str, dict] = {}
+
+        def map_entry(d: dict) -> dict:
+            return {
+                "endpoint": d,
+                "bySet": {
+                    f"{dep['endpoint']['uniqueEndpointName']}\t{dep['distance']}"
+                    for dep in d["dependingBy"]
+                },
+                "onSet": {
+                    f"{dep['endpoint']['uniqueEndpointName']}\t{dep['distance']}"
+                    for dep in d["dependingOn"]
+                },
+            }
+
+        for d in self._dependencies:
+            dependency_map[d["endpoint"]["uniqueEndpointName"]] = map_entry(
+                {**d, "dependingBy": list(d["dependingBy"]), "dependingOn": list(d["dependingOn"])}
+            )
+        for d in other._dependencies:
+            existing = dependency_map.get(d["endpoint"]["uniqueEndpointName"])
+            if existing:
+                # The reference assigns the max timestamp to the incoming
+                # entry `d` and then discards it (EndpointDependencies.ts:517),
+                # so the kept entry retains its original lastUsageTimestamp;
+                # mirrored here for parity.
+                for dep in d["dependingBy"]:
+                    key = f"{dep['endpoint']['uniqueEndpointName']}\t{dep['distance']}"
+                    if key not in existing["bySet"]:
+                        existing["endpoint"]["dependingBy"].append(dep)
+                        existing["bySet"].add(key)
+                for dep in d["dependingOn"]:
+                    key = f"{dep['endpoint']['uniqueEndpointName']}\t{dep['distance']}"
+                    if key not in existing["onSet"]:
+                        existing["endpoint"]["dependingOn"].append(dep)
+                        existing["onSet"].add(key)
+            else:
+                dependency_map[d["endpoint"]["uniqueEndpointName"]] = map_entry(d)
+        return EndpointDependencies(
+            [entry["endpoint"] for entry in dependency_map.values()],
+            now_ms=self._now_ms,
+        )
+
+    # -- scorers -------------------------------------------------------------
+
+    def to_service_endpoint_cohesion(self) -> List[dict]:
+        """SIUC: service intra-usage cohesion (EndpointDependencies.ts:565-612)."""
+        service_endpoint_map: Dict[str, List[dict]] = {}
+        for d in self._dependencies:
+            service_endpoint_map.setdefault(
+                d["endpoint"]["uniqueServiceName"], []
+            ).append(d)
+
+        out = []
+        for unique_service_name, endpoints in service_endpoint_map.items():
+            utilized: Dict[str, Set[str]] = {}
+            for e in endpoints:
+                for dep in e["dependingBy"]:
+                    if dep["distance"] != 1:
+                        continue
+                    consumer = dep["endpoint"]["uniqueServiceName"]
+                    utilized.setdefault(consumer, set()).add(
+                        e["endpoint"]["uniqueEndpointName"]
+                    )
+            consumers = [
+                {"uniqueServiceName": name, "consumes": len(consumed)}
+                for name, consumed in utilized.items()
+            ]
+            cohesion = 0.0
+            if endpoints and consumers:
+                cohesion = sum(
+                    c["consumes"] / len(endpoints) for c in consumers
+                ) / len(consumers)
+            out.append(
+                {
+                    "uniqueServiceName": unique_service_name,
+                    "totalEndpoints": len(endpoints),
+                    "consumers": consumers,
+                    "endpointUsageCohesion": cohesion,
+                }
+            )
+        return out
+
+    def to_service_instability(self) -> List[dict]:
+        """SDP instability I = Ce / (Ce + Ca) (EndpointDependencies.ts:614-641)."""
+        out = []
+        for s in self.to_service_dependencies():
+            depending_by = sum(1 for l in s["links"] if l["dependingBy"] > 0)
+            depending_on = sum(1 for l in s["links"] if l["dependingOn"] > 0)
+            total = depending_on + depending_by
+            out.append(
+                {
+                    "uniqueServiceName": s["uniqueServiceName"],
+                    "name": f"{s['service']}.{s['namespace']} ({s['version']})",
+                    "dependingBy": depending_by,
+                    "dependingOn": depending_on,
+                    "instability": 0 if total == 0 else depending_on / total,
+                }
+            )
+        return out
+
+    def to_service_coupling(self) -> List[dict]:
+        """ACS coupling = AIS x ADS (EndpointDependencies.ts:643-657)."""
+        from kmamiz_tpu.analytics.risk import absolute_criticality_of_services
+
+        coupling = absolute_criticality_of_services(self.to_service_dependencies())
+        out = []
+        for c in coupling:
+            service, namespace, version = c["uniqueServiceName"].split("\t")
+            out.append(
+                {
+                    "uniqueServiceName": c["uniqueServiceName"],
+                    "name": f"{service}.{namespace} ({version})",
+                    "ais": c["ais"],
+                    "ads": c["ads"],
+                    "acs": c["factor"],
+                }
+            )
+        return out

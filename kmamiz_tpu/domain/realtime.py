@@ -1,0 +1,134 @@
+"""Per-request realtime records and their (endpoint, status) combination.
+
+Parity with /root/reference/src/classes/RealtimeDataList.ts: groupby
+(uniqueEndpointName, status), latency mean/CV, JSON body merge + schema
+inference. The reference computes CV with Welford (RealtimeDataList.ts:100)
+while its own Rust twin uses sum/sum-of-squares
+(kmamiz_data_processor/src/data/realtime_data.rs:52-81); we use Welford on
+the host path and the sum-of-squares form in the device kernels
+(kmamiz_tpu.ops.window), matching within float64 tolerance.
+"""
+from __future__ import annotations
+
+import json
+import math
+from typing import List, Optional, Set
+
+from kmamiz_tpu.core import schema
+from kmamiz_tpu.core.timeutils import to_precise
+from kmamiz_tpu.domain.combined import CombinedRealtimeDataList
+
+
+def welford_mean_cv(latencies: List[float]) -> tuple:
+    if not latencies:
+        return 0.0, 0.0
+    mean = 0.0
+    sum_sq_diff = 0.0
+    for i, x in enumerate(latencies):
+        old_mean = mean
+        mean += (x - mean) / (i + 1)
+        sum_sq_diff += (x - mean) * (x - old_mean)
+    variance = sum_sq_diff / len(latencies)
+    std_dev = math.sqrt(variance)
+    cv = std_dev / mean if mean != 0 else 0.0
+    return mean, cv
+
+
+def parse_request_response_body(data: dict) -> dict:
+    """Parse JSON bodies and infer their interface schema
+    (RealtimeDataList.ts:120-156)."""
+    result: dict = {
+        "requestBody": None,
+        "requestSchema": None,
+        "responseBody": None,
+        "responseSchema": None,
+    }
+    if data.get("requestContentType") == "application/json":
+        try:
+            body = json.loads(data.get("requestBody"))
+            result["requestBody"] = body
+            result["requestSchema"] = schema.object_to_interface_string(body)
+        except (json.JSONDecodeError, TypeError):
+            pass
+    if data.get("responseContentType") == "application/json":
+        try:
+            body = json.loads(data.get("responseBody"))
+            result["responseBody"] = body
+            result["responseSchema"] = schema.object_to_interface_string(body)
+        except (json.JSONDecodeError, TypeError):
+            pass
+    return result
+
+
+class RealtimeDataList:
+    def __init__(self, realtime_data: List[dict]) -> None:
+        self._realtime_data = realtime_data
+
+    def to_json(self) -> List[dict]:
+        return self._realtime_data
+
+    def get_containing_namespaces(self) -> Set[str]:
+        return {r["namespace"] for r in self._realtime_data}
+
+    def to_combined_realtime_data(self) -> CombinedRealtimeDataList:
+        by_endpoint: dict = {}
+        for r in self._realtime_data:
+            by_endpoint.setdefault(r["uniqueEndpointName"], []).append(r)
+
+        combined_out: List[dict] = []
+        for group in by_endpoint.values():
+            by_status: dict = {}
+            for r in group:
+                by_status.setdefault(r["status"], []).append(r)
+            sample = group[0]
+            base = {
+                "uniqueServiceName": sample["uniqueServiceName"],
+                "uniqueEndpointName": sample["uniqueEndpointName"],
+                "service": sample["service"],
+                "namespace": sample["namespace"],
+                "version": sample["version"],
+                "method": sample["method"],
+            }
+            for status, sub_group in by_status.items():
+                mean, cv = welford_mean_cv([r["latency"] for r in sub_group])
+
+                request_body = sub_group[0].get("requestBody")
+                response_body = sub_group[0].get("responseBody")
+                timestamp = sub_group[0]["timestamp"]
+                replica = sub_group[0].get("replica")
+                for curr in sub_group[1:]:
+                    request_body = schema.merge_string_body(
+                        request_body, curr.get("requestBody")
+                    )
+                    response_body = schema.merge_string_body(
+                        response_body, curr.get("responseBody")
+                    )
+                    timestamp = max(timestamp, curr["timestamp"])
+                    if replica and curr.get("replica"):
+                        replica += curr["replica"]
+
+                parsed = parse_request_response_body(
+                    {
+                        "requestBody": request_body,
+                        "requestContentType": sub_group[0].get("requestContentType"),
+                        "responseBody": response_body,
+                        "responseContentType": sub_group[0].get("responseContentType"),
+                    }
+                )
+                combined_out.append(
+                    {
+                        **base,
+                        "status": status,
+                        "combined": len(sub_group),
+                        "requestBody": parsed["requestBody"],
+                        "requestSchema": parsed["requestSchema"],
+                        "responseBody": parsed["responseBody"],
+                        "responseSchema": parsed["responseSchema"],
+                        "avgReplica": (replica / len(sub_group)) if replica else None,
+                        "latestTimestamp": timestamp,
+                        "latency": {"mean": to_precise(mean), "cv": to_precise(cv)},
+                        "requestContentType": sub_group[0].get("requestContentType"),
+                        "responseContentType": sub_group[0].get("responseContentType"),
+                    }
+                )
+        return CombinedRealtimeDataList(combined_out)

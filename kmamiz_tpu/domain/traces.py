@@ -1,0 +1,261 @@
+"""Zipkin trace ingestion: span batches -> realtime data + dependency graph.
+
+Behavioral parity with /root/reference/src/classes/Traces.ts (the Rust twin
+is kmamiz_data_processor/src/data/trace.rs): SERVER-span extraction, the
+parent-chain walk that skips CLIENT spans to produce (ancestor, distance)
+pairs in both directions, and endpoint-info URL parsing with istio-annotation
+fallback.
+
+The dict-shaped output is the wire/protocol layer (bounded by unique
+endpoints); the bulk span statistics run on device via kmamiz_tpu.ops.window
+over the SoA form (see core.spans.SpanBatch).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set
+
+from kmamiz_tpu.core.schema import js_str
+from kmamiz_tpu.core.urls import explode_url
+from kmamiz_tpu.domain.endpoint_dependencies import EndpointDependencies
+from kmamiz_tpu.domain.realtime import RealtimeDataList
+
+
+def to_endpoint_info(span: dict) -> dict:
+    """Trace span -> TEndpointInfo dict (reference Traces.ts:213-241)."""
+    tags = span.get("tags", {})
+    url = tags.get("http.url", "")
+    host, port, path = explode_url(url)[:3]
+    name = span.get("name", "")
+    service_name = namespace = cluster_name = None
+    if ".svc." in name:
+        e = explode_url(name, True)
+        service_name, namespace, cluster_name = e.service, e.namespace, e.cluster
+    else:
+        # probably a static file request via istio-ingress; fall back to
+        # istio annotations (reference Traces.ts:219-224)
+        service_name = tags.get("istio.canonical_service")
+        namespace = tags.get("istio.namespace")
+        cluster_name = tags.get("istio.mesh_id")
+    version = tags.get("istio.canonical_revision") or "NONE"
+    unique_service_name = f"{js_str(service_name)}\t{js_str(namespace)}\t{version}"
+    method = tags.get("http.method")
+    return {
+        "version": version,
+        "service": service_name,
+        "namespace": namespace,
+        "url": url,
+        "host": host,
+        "path": path,
+        "port": port or "80",
+        "clusterName": cluster_name,
+        "method": method,
+        "uniqueServiceName": unique_service_name,
+        "uniqueEndpointName": f"{unique_service_name}\t{js_str(method)}\t{url}",
+        "timestamp": span["timestamp"] / 1000,
+    }
+
+
+class Traces:
+    """Wrapper over Zipkin trace groups (Trace[][])."""
+
+    def __init__(self, traces: List[List[dict]]) -> None:
+        self._traces = traces
+
+    def to_json(self) -> List[List[dict]]:
+        return self._traces
+
+    def _flat(self) -> List[dict]:
+        return [s for group in self._traces for s in group]
+
+    def extract_containing_namespaces(self) -> Set[str]:
+        return {s.get("tags", {}).get("istio.namespace") for s in self._flat()}
+
+    def to_realtime_data(self, replicas: Optional[List[dict]] = None) -> RealtimeDataList:
+        """SERVER spans -> per-request realtime records (Traces.ts:27-53)."""
+        records = []
+        for t in self._flat():
+            if t.get("kind") != "SERVER":
+                continue
+            tags = t.get("tags", {})
+            e = explode_url(t.get("name", ""), True)
+            service_name, namespace = e.service, e.namespace
+            version = tags.get("istio.canonical_revision")
+            method = tags.get("http.method")
+            unique_service_name = (
+                f"{js_str(service_name)}\t{js_str(namespace)}\t{js_str(version)}"
+            )
+            records.append(
+                {
+                    "timestamp": t["timestamp"],
+                    "service": service_name,
+                    "namespace": namespace,
+                    "version": version,
+                    "method": method,
+                    # /1000: keep standard deviation from overflowing
+                    "latency": t["duration"] / 1000,
+                    "status": tags.get("http.status_code"),
+                    "uniqueServiceName": unique_service_name,
+                    "uniqueEndpointName": (
+                        f"{unique_service_name}\t{js_str(method)}"
+                        f"\t{js_str(tags.get('http.url'))}"
+                    ),
+                    "replica": _find_replica(replicas, unique_service_name),
+                }
+            )
+        return RealtimeDataList(records)
+
+    def combine_logs_to_realtime_data(
+        self,
+        structured_logs: List[dict],
+        replicas: Optional[List[dict]] = None,
+    ) -> RealtimeDataList:
+        """Join SERVER spans with structured envoy logs by (traceId, spanId),
+        falling back to the parent span id (Traces.ts:55-106)."""
+        log_map: Dict[str, Dict[str, dict]] = {}
+        for l in structured_logs:
+            traces = l.get("traces", [])
+            if not traces:
+                continue
+            trace_id = traces[0]["traceId"]
+            per_trace = log_map.setdefault(trace_id, {})
+            for t in traces:
+                per_trace[t["spanId"]] = t
+
+        records = []
+        for trace in self._flat():
+            if trace.get("kind") != "SERVER":
+                continue
+            tags = trace.get("tags", {})
+            service = tags.get("istio.canonical_service")
+            namespace = tags.get("istio.namespace")
+            version = tags.get("istio.canonical_revision")
+            method = tags.get("http.method")
+            status = tags.get("http.status_code")
+            unique_service_name = (
+                f"{js_str(service)}\t{js_str(namespace)}\t{js_str(version)}"
+            )
+
+            log = log_map.get(trace["traceId"], {}).get(trace["id"])
+            # fallback-mode fix: fall back to the parent span's log entry
+            if (log is None or log.get("isFallback")) and trace.get("parentId"):
+                log = log_map.get(trace["traceId"], {}).get(trace["parentId"])
+
+            req = (log or {}).get("request", {})
+            res = (log or {}).get("response", {})
+            records.append(
+                {
+                    "timestamp": trace["timestamp"],
+                    "service": service,
+                    "namespace": namespace,
+                    "version": version,
+                    "method": method,
+                    "latency": trace["duration"] / 1000,
+                    "status": status,
+                    "responseBody": res.get("body"),
+                    "responseContentType": res.get("contentType"),
+                    "requestBody": req.get("body"),
+                    "requestContentType": req.get("contentType"),
+                    "uniqueServiceName": unique_service_name,
+                    "uniqueEndpointName": (
+                        f"{unique_service_name}\t{js_str(method)}"
+                        f"\t{js_str(tags.get('http.url'))}"
+                    ),
+                    "replica": _find_replica(replicas, unique_service_name),
+                }
+            )
+        return RealtimeDataList(records)
+
+    def to_endpoint_dependencies(self) -> EndpointDependencies:
+        """Parent-chain walk per SERVER span, skipping CLIENT spans, recording
+        (ancestor, distance) pairs both directions (Traces.ts:112-211)."""
+        span_map: Dict[str, dict] = {}
+        for span in self._flat():
+            span_map[span["id"]] = {"span": span, "upper": {}, "lower": {}}
+
+        filtered = [
+            (sid, node)
+            for sid, node in span_map.items()
+            if node["span"].get("kind") == "SERVER"
+        ]
+        for span_id, node in filtered:
+            span, upper = node["span"], node["upper"]
+            parent_id = span.get("parentId")
+            depth = 1
+            while parent_id:
+                parent_node = span_map.get(parent_id)
+                if parent_node is None:
+                    break
+                if parent_node["span"].get("kind") == "CLIENT":
+                    parent_id = parent_node["span"].get("parentId")
+                    continue
+                upper[parent_node["span"]["id"]] = depth
+                parent_node["lower"][span_id] = depth
+                parent_id = parent_node["span"].get("parentId")
+                depth += 1
+
+        dependencies = []
+        for _, node in filtered:
+            span = node["span"]
+            upper_map: Dict[str, dict] = {}
+            for sid, distance in node["upper"].items():
+                endpoint = to_endpoint_info(span_map[sid]["span"])
+                upper_map[f"{endpoint['uniqueEndpointName']}\t{distance}"] = endpoint
+            lower_map: Dict[str, dict] = {}
+            for sid, distance in node["lower"].items():
+                endpoint = to_endpoint_info(span_map[sid]["span"])
+                lower_map[f"{endpoint['uniqueEndpointName']}\t{distance}"] = endpoint
+
+            depending_by = [
+                {
+                    "endpoint": endpoint,
+                    "distance": int(key.split("\t")[-1]),
+                    "type": "CLIENT",
+                }
+                for key, endpoint in upper_map.items()
+            ]
+            depending_on = [
+                {
+                    "endpoint": endpoint,
+                    "distance": int(key.split("\t")[-1]),
+                    "type": "SERVER",
+                }
+                for key, endpoint in lower_map.items()
+            ]
+            dependencies.append(
+                {
+                    "endpoint": to_endpoint_info(span),
+                    "lastUsageTimestamp": 0,  # filled below
+                    "isDependedByExternal": len(depending_by) == 0,
+                    "dependingBy": depending_by,
+                    "dependingOn": depending_on,
+                }
+            )
+
+        # last-usage timestamp per endpoint over every appearance
+        last_ts: Dict[str, float] = {}
+
+        def note(endpoint: dict) -> None:
+            name, ts = endpoint["uniqueEndpointName"], endpoint["timestamp"]
+            last_ts[name] = max(last_ts.get(name, 0), ts)
+
+        for dep in dependencies:
+            note(dep["endpoint"])
+            for d in dep["dependingBy"]:
+                note(d["endpoint"])
+            for d in dep["dependingOn"]:
+                note(d["endpoint"])
+        for dep in dependencies:
+            dep["lastUsageTimestamp"] = last_ts.get(
+                dep["endpoint"]["uniqueEndpointName"], 0
+            )
+
+        return EndpointDependencies(dependencies)
+
+
+def _find_replica(replicas: Optional[List[dict]], unique_service_name: str):
+    if not replicas:
+        return None
+    for r in replicas:
+        if r.get("uniqueServiceName") == unique_service_name:
+            return r.get("replicas")
+    return None

@@ -1,0 +1,304 @@
+"""Parity: realtime -> combined -> historical/aggregate transforms.
+
+Mirrors /root/reference/tests/RealtimeDataList.test.ts,
+CombinedRealtimeDataList.test.ts, AggregateData.test.ts and
+EndpointDataType.test.ts, with the computed fixtures rebuilt in Python
+(the reference builds them with Date.now()/Utils calls at import time).
+"""
+import math
+
+import pytest
+
+from kmamiz_tpu.core.schema import object_to_interface_string
+from kmamiz_tpu.core.timeutils import belongs_to_minute_timestamp, to_precise
+from kmamiz_tpu.domain.aggregated import AggregatedData
+from kmamiz_tpu.domain.combined import CombinedRealtimeDataList
+from kmamiz_tpu.domain.endpoint_data_type import EndpointDataType
+from kmamiz_tpu.domain.historical import HistoricalData
+from kmamiz_tpu.domain.realtime import RealtimeDataList
+
+SERVICE, NAMESPACE, VERSION = "srv", "ns", "latest"
+USN = f"{SERVICE}\t{NAMESPACE}\t{VERSION}"
+UEN = f"{USN}\tGET\thttp://srv/api/a"
+METHOD, STATUS = "GET", "200"
+TODAY = 1722211200000  # fixed epoch ms
+YESTERDAY = TODAY - 86400000
+
+LATENCIES_1 = [100, 120, 80, 100, 120, 80, 120, 80, 120, 80]
+LATENCIES_2 = [150, 170, 130, 130, 170, 150, 120, 180, 120, 180]
+
+
+def make_rl_data_1():
+    return [
+        {
+            "uniqueServiceName": USN,
+            "uniqueEndpointName": UEN,
+            "service": SERVICE,
+            "namespace": NAMESPACE,
+            "version": VERSION,
+            "latency": lat,
+            "method": METHOD,
+            "status": STATUS,
+            "timestamp": YESTERDAY * 1000,
+            "replica": 1,
+            "requestBody": '{"name":"test request"}',
+            "requestContentType": "application/json",
+            "responseBody": '{"name":"test response"}',
+            "responseContentType": "application/json",
+        }
+        for lat in LATENCIES_1
+    ]
+
+
+def expected_cv(latencies):
+    n = len(latencies)
+    mean = sum(latencies) / n
+    var = sum(x * x for x in latencies) / n - mean * mean
+    return math.sqrt(var) / mean
+
+
+def make_crl_data(latencies, timestamp_us):
+    mean = sum(latencies) / len(latencies)
+    return [
+        {
+            "service": SERVICE,
+            "namespace": NAMESPACE,
+            "version": VERSION,
+            "latestTimestamp": timestamp_us,
+            "combined": len(latencies),
+            "latency": {"mean": mean, "cv": expected_cv(latencies)},
+            "method": METHOD,
+            "status": STATUS,
+            "uniqueServiceName": USN,
+            "uniqueEndpointName": UEN,
+            "avgReplica": 1,
+            "requestBody": {"name": "test request"},
+            "requestContentType": "application/json",
+            "requestSchema": object_to_interface_string({"name": "x"}),
+            "responseBody": {"name": "test response"},
+            "responseContentType": "application/json",
+            "responseSchema": object_to_interface_string({"name": "x"}),
+        }
+    ]
+
+
+MOCK_DEPENDENCIES = [
+    {
+        "service": SERVICE,
+        "namespace": NAMESPACE,
+        "version": VERSION,
+        "uniqueServiceName": USN,
+        "dependency": [],
+        "links": [],
+    }
+]
+MOCK_REPLICAS = [
+    {
+        "service": SERVICE,
+        "namespace": NAMESPACE,
+        "version": VERSION,
+        "uniqueServiceName": USN,
+        "replicas": 1,
+    }
+]
+
+
+class TestRealtimeDataList:
+    def test_containing_namespaces(self):
+        rl = RealtimeDataList(make_rl_data_1())
+        assert rl.get_containing_namespaces() == {NAMESPACE}
+
+    def test_to_combined(self):
+        combined = RealtimeDataList(make_rl_data_1()).to_combined_realtime_data()
+        (c,) = combined.to_json()
+        assert c["uniqueEndpointName"] == UEN
+        assert c["combined"] == 10
+        assert c["status"] == STATUS
+        assert c["avgReplica"] == 1
+        assert c["latestTimestamp"] == YESTERDAY * 1000
+        assert c["latency"]["mean"] == pytest.approx(100)
+        assert c["latency"]["cv"] == pytest.approx(0.17888543819998, abs=1e-10)
+        assert c["requestBody"] == {"name": "test request"}
+        assert c["requestSchema"] == "interface Root {\n  name: string;\n}"
+        assert c["responseBody"] == {"name": "test response"}
+        assert c["responseSchema"] == "interface Root {\n  name: string;\n}"
+
+
+class TestCombinedRealtimeDataList:
+    def test_to_historical_data(self):
+        data = CombinedRealtimeDataList(make_crl_data(LATENCIES_1, YESTERDAY * 1000))
+        historical = data.to_historical_data(MOCK_DEPENDENCIES, MOCK_REPLICAS)
+        assert len(historical) == 1
+        h = historical[0]
+        assert h["date"] == belongs_to_minute_timestamp(YESTERDAY)
+        (svc,) = h["services"]
+        assert svc["requests"] == 10
+        assert svc["requestErrors"] == 0
+        assert svc["serverErrors"] == 0
+        assert svc["latencyMean"] == pytest.approx(100)
+        assert svc["latencyCV"] == pytest.approx(0.17888543819998, abs=1e-10)
+        assert svc["risk"] == 0.1
+        (ep,) = svc["endpoints"]
+        assert ep["uniqueEndpointName"] == UEN
+        assert ep["requests"] == 10
+        assert ep["latencyMean"] == pytest.approx(100)
+
+    def test_extract_endpoint_data_type(self):
+        data = CombinedRealtimeDataList(make_crl_data(LATENCIES_1, YESTERDAY * 1000))
+        (dt,) = [d.to_json() for d in data.extract_endpoint_data_type()]
+        assert dt["uniqueEndpointName"] == UEN
+        (s,) = dt["schemas"]
+        assert s["status"] == "200"
+        assert s["requestSample"] == {"name": "test request"}
+        assert s["requestSchema"] == "interface Root {\n  name: string;\n}"
+
+    def test_combine_with(self):
+        data1 = CombinedRealtimeDataList(make_crl_data(LATENCIES_1, YESTERDAY * 1000))
+        data2 = CombinedRealtimeDataList(make_crl_data(LATENCIES_2, TODAY * 1000))
+        (c,) = data1.combine_with(data2).to_json()
+        assert c["combined"] == 20
+        assert c["latestTimestamp"] == TODAY * 1000
+        assert c["latency"]["mean"] == pytest.approx(125)
+        assert c["latency"]["cv"] == pytest.approx(0.25861167800391, abs=1e-10)
+        assert c["requestBody"] == {"name": "test request"}
+        assert c["requestSchema"] == "interface Root {\n  name: string;\n}"
+        assert "avgReplica" not in c
+
+    def test_containing_namespaces(self):
+        data = CombinedRealtimeDataList(make_crl_data(LATENCIES_1, YESTERDAY * 1000))
+        assert data.get_containing_namespaces() == {NAMESPACE}
+
+
+def make_endpoint_data_type():
+    return {
+        "service": SERVICE,
+        "namespace": NAMESPACE,
+        "version": VERSION,
+        "method": METHOD,
+        "uniqueServiceName": USN,
+        "uniqueEndpointName": UEN,
+        "schemas": [
+            {
+                "status": "200",
+                "time": YESTERDAY,
+                "requestContentType": "application/json",
+                "responseContentType": "application/json",
+                "requestSample": {"name": "test request"},
+                "responseSample": {"name": "test response"},
+                "requestSchema": object_to_interface_string({"name": "x"}),
+                "responseSchema": object_to_interface_string({"name": "x"}),
+            }
+        ],
+    }
+
+
+class TestEndpointDataType:
+    def test_trim_duplicates(self):
+        dt = make_endpoint_data_type()
+        dt["schemas"] = dt["schemas"] + dt["schemas"]
+        trimmed = EndpointDataType(dt).trim().to_json()
+        assert trimmed["schemas"] == make_endpoint_data_type()["schemas"]
+
+    def test_schema_match(self):
+        d1 = CombinedRealtimeDataList(
+            make_crl_data(LATENCIES_1, YESTERDAY * 1000)
+        ).extract_endpoint_data_type()[0]
+        d2 = CombinedRealtimeDataList(
+            make_crl_data(LATENCIES_2, TODAY * 1000)
+        ).extract_endpoint_data_type()[0]
+        assert d1.has_matched_schema(d2) is True
+
+    def test_merge_schemas(self):
+        dt1 = make_endpoint_data_type()
+        dt2 = make_endpoint_data_type()
+        dt2["schemas"][0] = {
+            **dt2["schemas"][0],
+            "responseSample": {"name": "string", "id": 0},
+            "responseSchema": object_to_interface_string({"name": "string", "id": 0}),
+        }
+        merged = EndpointDataType(dt1).merge_schema_with(EndpointDataType(dt2))
+        # the merged per-status schema is appended after the originals
+        # (the reference test observes it at index 0 only through an aliasing
+        # quirk of its fixture construction)
+        assert (
+            merged.to_json()["schemas"][-1]["responseSchema"]
+            == "interface Root {\n  id: number;\n  name: string;\n}"
+        )
+
+    def test_service_cohesion(self):
+        d1 = CombinedRealtimeDataList(
+            make_crl_data(LATENCIES_1, YESTERDAY * 1000)
+        ).extract_endpoint_data_type()[0]
+        d2 = CombinedRealtimeDataList(
+            make_crl_data(LATENCIES_2, TODAY * 1000)
+        ).extract_endpoint_data_type()[0]
+        assert len(EndpointDataType.get_service_cohesion([d1, d2])) == 1
+
+
+def make_aggregated(total_requests, avg_risk, from_ms, to_ms):
+    return {
+        "fromDate": from_ms,
+        "toDate": to_ms,
+        "services": [
+            {
+                "uniqueServiceName": USN,
+                "service": SERVICE,
+                "namespace": NAMESPACE,
+                "version": VERSION,
+                "totalRequests": total_requests,
+                "totalServerErrors": 0,
+                "totalRequestErrors": 0,
+                "avgRisk": avg_risk,
+                "avgLatencyCV": 0.2,
+                "endpoints": [
+                    {
+                        "uniqueServiceName": USN,
+                        "uniqueEndpointName": UEN,
+                        "method": METHOD,
+                        "totalRequests": total_requests,
+                        "totalServerErrors": 0,
+                        "totalRequestErrors": 0,
+                        "avgLatencyCV": 0.2,
+                    }
+                ],
+            }
+        ],
+    }
+
+
+class TestAggregatedData:
+    def test_merge(self):
+        a = make_aggregated(10, 0.1, YESTERDAY, YESTERDAY)
+        b = make_aggregated(30, 0.3, TODAY, TODAY)
+        merged = AggregatedData(a).combine(b).to_json()
+        assert merged["fromDate"] == YESTERDAY
+        assert merged["toDate"] == TODAY
+        (svc,) = merged["services"]
+        assert svc["totalRequests"] == 40
+        # weighted by request counts: (10/40)*0.1 + (30/40)*0.3
+        assert svc["avgRisk"] == pytest.approx(0.25)
+        (ep,) = svc["endpoints"]
+        assert ep["totalRequests"] == 40
+
+
+class TestHistoricalData:
+    def test_round_trip_to_combined(self):
+        data = CombinedRealtimeDataList(make_crl_data(LATENCIES_1, YESTERDAY * 1000))
+        historical = data.to_historical_data(MOCK_DEPENDENCIES, MOCK_REPLICAS)
+        crl = HistoricalData(historical[0]).to_combined_realtime_data_list()
+        (row,) = crl.to_json()
+        assert row["combined"] == 10
+        assert row["status"] == "200"
+        assert row["latency"]["mean"] == 100  # fixed mean on the inverse path
+        assert row["latency"]["cv"] == pytest.approx(0.17888543819998, abs=1e-10)
+
+    def test_to_aggregated(self):
+        data = CombinedRealtimeDataList(make_crl_data(LATENCIES_1, YESTERDAY * 1000))
+        historical = data.to_historical_data(MOCK_DEPENDENCIES, MOCK_REPLICAS)
+        agg = HistoricalData(historical[0]).to_aggregated_data()
+        (svc,) = agg["services"]
+        assert svc["totalRequests"] == 10
+        assert svc["avgRisk"] == 0.1
+        assert svc["avgLatencyCV"] == pytest.approx(0.17888543819998, abs=1e-10)
+        (ep,) = svc["endpoints"]
+        assert ep["totalRequests"] == 10

@@ -1,0 +1,224 @@
+"""Golden parity: Zipkin traces -> realtime data / endpoint dependencies.
+
+Expectations are the reference's own golden outputs
+(/root/reference/tests/Traces.test.ts, EndpointDependencies.test.ts),
+extracted as JSON fixtures.
+"""
+import pytest
+
+from kmamiz_tpu.domain.endpoint_dependencies import EndpointDependencies
+from kmamiz_tpu.domain.traces import Traces, to_endpoint_info
+
+from conftest import load_fixture
+
+
+def strip_none(obj):
+    """Remove None-valued keys (JS `undefined` vanishes in JSON)."""
+    if isinstance(obj, list):
+        return [strip_none(o) for o in obj]
+    if isinstance(obj, dict):
+        return {k: strip_none(v) for k, v in obj.items() if v is not None}
+    return obj
+
+
+class TestTraces:
+    def test_to_realtime_data(self, pdas_traces, pdas_realtime_data):
+        rl = Traces([pdas_traces]).to_realtime_data()
+        assert strip_none(rl.to_json()) == pdas_realtime_data
+
+    def test_to_endpoint_dependencies(self, pdas_traces, pdas_endpoint_dependencies):
+        deps = Traces([pdas_traces]).to_endpoint_dependencies()
+        assert strip_none(deps.to_json()) == pdas_endpoint_dependencies
+
+    def test_to_endpoint_info(self, pdas_traces):
+        expected = load_fixture("pdas_endpoint_info_1")
+        assert strip_none(to_endpoint_info(pdas_traces[0])) == expected
+
+    def test_containing_namespaces(self, pdas_traces):
+        assert Traces([pdas_traces]).extract_containing_namespaces() == {
+            "pdas",
+            "istio-system",
+        }
+
+
+class TestEndpointDependencies:
+    @pytest.fixture()
+    def deps(self, pdas_endpoint_dependencies):
+        return EndpointDependencies(pdas_endpoint_dependencies)
+
+    def test_graph_data(self, deps):
+        graph = deps.to_graph_data()
+        assert len(graph["nodes"]) == 7
+        assert len(graph["links"]) == 6
+
+    def test_chord_data(self, deps):
+        assert deps.to_chord_data() == {
+            "nodes": [
+                {
+                    "id": "external-service.pdas (latest)",
+                    "name": "external-service\tpdas\tlatest",
+                },
+                {
+                    "id": "user-service.pdas (latest)",
+                    "name": "user-service\tpdas\tlatest",
+                },
+                {
+                    "id": "contract-service.pdas (latest)",
+                    "name": "contract-service\tpdas\tlatest",
+                },
+            ],
+            "links": [
+                {
+                    "from": "external-service.pdas (latest)",
+                    "to": "user-service.pdas (latest)",
+                    "value": 1,
+                },
+                {
+                    "from": "external-service.pdas (latest)",
+                    "to": "contract-service.pdas (latest)",
+                    "value": 1,
+                },
+            ],
+        }
+
+    def test_service_dependencies(self, deps):
+        assert len(deps.to_service_dependencies()) == 3
+
+    def test_service_endpoint_cohesion(self, deps):
+        assert deps.to_service_endpoint_cohesion() == [
+            {
+                "uniqueServiceName": "user-service\tpdas\tlatest",
+                "totalEndpoints": 2,
+                "consumers": [
+                    {
+                        "uniqueServiceName": "external-service\tpdas\tlatest",
+                        "consumes": 1,
+                    }
+                ],
+                "endpointUsageCohesion": 0.5,
+            },
+            {
+                "uniqueServiceName": "contract-service\tpdas\tlatest",
+                "totalEndpoints": 1,
+                "consumers": [
+                    {
+                        "uniqueServiceName": "external-service\tpdas\tlatest",
+                        "consumes": 1,
+                    }
+                ],
+                "endpointUsageCohesion": 1,
+            },
+            {
+                "uniqueServiceName": "external-service\tpdas\tlatest",
+                "totalEndpoints": 1,
+                "consumers": [],
+                "endpointUsageCohesion": 0,
+            },
+        ]
+
+    def test_service_coupling(self, deps):
+        assert deps.to_service_coupling() == [
+            {
+                "uniqueServiceName": "user-service\tpdas\tlatest",
+                "name": "user-service.pdas (latest)",
+                "ais": 1,
+                "ads": 0,
+                "acs": 0,
+            },
+            {
+                "uniqueServiceName": "contract-service\tpdas\tlatest",
+                "name": "contract-service.pdas (latest)",
+                "ais": 1,
+                "ads": 0,
+                "acs": 0,
+            },
+            {
+                "uniqueServiceName": "external-service\tpdas\tlatest",
+                "name": "external-service.pdas (latest)",
+                "ais": 1,
+                "ads": 2,
+                "acs": 2,
+            },
+        ]
+
+    def test_service_instability(self, deps):
+        assert deps.to_service_instability() == [
+            {
+                "uniqueServiceName": "user-service\tpdas\tlatest",
+                "name": "user-service.pdas (latest)",
+                "dependingBy": 1,
+                "dependingOn": 0,
+                "instability": 0,
+            },
+            {
+                "uniqueServiceName": "contract-service\tpdas\tlatest",
+                "name": "contract-service.pdas (latest)",
+                "dependingBy": 1,
+                "dependingOn": 0,
+                "instability": 0,
+            },
+            {
+                "uniqueServiceName": "external-service\tpdas\tlatest",
+                "name": "external-service.pdas (latest)",
+                "dependingBy": 0,
+                "dependingOn": 2,
+                "instability": 1,
+            },
+        ]
+
+    def test_combine_with_self_dedups_by_endpoint(self, pdas_endpoint_dependencies):
+        # combineWith keys by uniqueEndpointName, so same-endpoint entries
+        # collapse and (endpoint, distance) dependency sets union
+        a = EndpointDependencies(pdas_endpoint_dependencies)
+        b = EndpointDependencies(load_fixture("pdas_endpoint_dependencies"))
+        combined = a.combine_with(b).to_json()
+        distinct = {d["endpoint"]["uniqueEndpointName"] for d in pdas_endpoint_dependencies}
+        assert len(combined) == len(distinct)
+        # merging twice is idempotent
+        again = (
+            EndpointDependencies(combined)
+            .combine_with(EndpointDependencies(combined))
+            .to_json()
+        )
+        assert strip_none(again) == strip_none(combined)
+
+    def test_bookinfo_graph(self, bookinfo_endpoint_dependencies):
+        deps = EndpointDependencies(bookinfo_endpoint_dependencies)
+        graph = deps.to_graph_data()
+        assert len(graph["nodes"]) > 0 and len(graph["links"]) > 0
+        # every scorer runs on the bookinfo mesh
+        assert deps.to_service_instability()
+        assert deps.to_service_coupling()
+        assert deps.to_service_endpoint_cohesion()
+
+
+class TestBookinfoPipeline:
+    def test_trace_walk(self, bookinfo_traces):
+        deps = Traces(bookinfo_traces).to_endpoint_dependencies()
+        data = deps.to_json()
+        assert data, "bookinfo walk produced dependencies"
+        # productpage depends on details/reviews; ratings at distance 2
+        by_path = {
+            d["endpoint"]["path"]: d for d in data if d["endpoint"].get("path")
+        }
+        productpage = next(
+            (
+                d
+                for d in data
+                if d["endpoint"]["service"] == "productpage"
+            ),
+            None,
+        )
+        assert productpage is not None
+        on_services = {
+            x["endpoint"]["service"]: x["distance"] for x in productpage["dependingOn"]
+        }
+        assert on_services.get("details") == 1
+        assert on_services.get("reviews") == 1
+        assert on_services.get("ratings") == 2
+
+    def test_realtime_data(self, bookinfo_traces):
+        rl = Traces(bookinfo_traces).to_realtime_data().to_json()
+        assert all(r["latency"] > 0 for r in rl)
+        services = {r["service"] for r in rl}
+        assert {"productpage", "details", "reviews", "ratings"} <= services

@@ -1,0 +1,210 @@
+"""SoA span batches: Zipkin JSON -> fixed-shape device arrays.
+
+This is the design translation at the heart of the TPU backend (SURVEY.md
+§7): the reference walks per-span object graphs
+(/root/reference/src/classes/Traces.ts:112-211, Rust twin
+kmamiz_data_processor/src/data/trace.rs:110-212); here a window of spans
+becomes id-indexed arrays. Parent span-ids are resolved to row indices on
+the host (strings never reach the device); the CLIENT-skip ancestor walk and
+all groupby statistics then run as jitted kernels (kmamiz_tpu.ops.window).
+
+Batches are padded to power-of-two sizes so XLA compiles a bounded number of
+program shapes.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from kmamiz_tpu.core.interning import EndpointInterner, StringInterner
+from kmamiz_tpu.core.schema import js_str as _js
+from kmamiz_tpu.domain.traces import to_endpoint_info
+
+KIND_OTHER = 0
+KIND_SERVER = 1
+KIND_CLIENT = 2
+
+
+def _pad_size(n: int, base: int = 2, minimum: int = 8) -> int:
+    size = minimum
+    while size < n:
+        size *= base
+    return size
+
+
+@dataclass
+class SpanBatch:
+    """One window of spans in structure-of-arrays form.
+
+    All arrays share length `capacity` (padded); rows [n_spans:] are padding
+    with valid=False. Ids index the accompanying interner tables.
+    """
+
+    n_spans: int
+    valid: np.ndarray  # bool[capacity]
+    kind: np.ndarray  # int8[capacity] (KIND_*)
+    parent_idx: np.ndarray  # int32[capacity], -1 = no parent in window
+    # graph id space: ToEndpointInfo naming (.svc. parse w/ istio fallback)
+    endpoint_id: np.ndarray  # int32[capacity]
+    service_id: np.ndarray  # int32[capacity]
+    # realtime id space: istio-tag naming used by the stats/combined path
+    # (the reference names endpoints differently in toRealTimeData /
+    # combineLogsToRealtimeData vs toEndpointDependencies)
+    rt_endpoint_id: np.ndarray  # int32[capacity]
+    rt_service_id: np.ndarray  # int32[capacity]
+    status_id: np.ndarray  # int32[capacity]
+    status_class: np.ndarray  # int8[capacity] (first digit of http status)
+    latency_ms: np.ndarray  # float64[capacity] (duration / 1000)
+    timestamp_us: np.ndarray  # int64[capacity] (host-side absolute)
+    timestamp_rel: np.ndarray  # int32[capacity] (µs offset from ts_base_us;
+    # absolute µs don't fit int32 and the TPU path runs with x64 off)
+    ts_base_us: int
+
+    interner: EndpointInterner
+    statuses: StringInterner
+    # per-endpoint metadata for reconstructing protocol output
+    endpoint_infos: List[dict]
+
+    @property
+    def capacity(self) -> int:
+        return len(self.valid)
+
+    @property
+    def num_endpoints(self) -> int:
+        return len(self.interner.endpoints)
+
+    @property
+    def num_services(self) -> int:
+        return len(self.interner.services)
+
+    @property
+    def num_statuses(self) -> int:
+        return len(self.statuses)
+
+
+def spans_to_batch(
+    trace_groups: Sequence[Sequence[dict]],
+    interner: Optional[EndpointInterner] = None,
+    statuses: Optional[StringInterner] = None,
+    pad: bool = True,
+) -> SpanBatch:
+    """Flatten Zipkin trace groups into a SpanBatch.
+
+    Mirrors the reference's span-map construction: spans are keyed by id with
+    last-wins/first-position semantics (JS Map), and parent ids resolve only
+    within the window.
+    """
+    interner = interner or EndpointInterner()
+    statuses = statuses or StringInterner()
+
+    span_map: Dict[str, dict] = {}
+    for group in trace_groups:
+        for span in group:
+            span_map[span["id"]] = span
+    spans = list(span_map.values())
+    index_of = {span_id: i for i, span_id in enumerate(span_map.keys())}
+
+    n = len(spans)
+    capacity = _pad_size(n) if pad else max(n, 1)
+
+    valid = np.zeros(capacity, dtype=bool)
+    kind = np.zeros(capacity, dtype=np.int8)
+    parent_idx = np.full(capacity, -1, dtype=np.int32)
+    endpoint_id = np.zeros(capacity, dtype=np.int32)
+    service_id = np.zeros(capacity, dtype=np.int32)
+    rt_endpoint_id = np.zeros(capacity, dtype=np.int32)
+    rt_service_id = np.zeros(capacity, dtype=np.int32)
+    status_id = np.zeros(capacity, dtype=np.int32)
+    status_class = np.zeros(capacity, dtype=np.int8)
+    latency_ms = np.zeros(capacity, dtype=np.float64)
+    timestamp_us = np.zeros(capacity, dtype=np.int64)
+
+    endpoint_infos: List[dict] = list(getattr(interner, "_endpoint_infos", []))
+
+    for i, span in enumerate(spans):
+        valid[i] = True
+        k = span.get("kind")
+        kind[i] = (
+            KIND_SERVER if k == "SERVER" else KIND_CLIENT if k == "CLIENT" else KIND_OTHER
+        )
+        parent = span.get("parentId")
+        if parent is not None and parent in index_of:
+            parent_idx[i] = index_of[parent]
+
+        info = to_endpoint_info(span)
+        eid = interner.intern_endpoint(info["uniqueEndpointName"])
+        if eid == len(endpoint_infos):
+            endpoint_infos.append(info)
+        else:
+            # keep the freshest timestamp for the endpoint metadata
+            if info["timestamp"] > endpoint_infos[eid]["timestamp"]:
+                endpoint_infos[eid] = info
+        endpoint_id[i] = eid
+        service_id[i] = interner.service_of(eid)
+
+        tags = span.get("tags", {})
+        rt_usn = (
+            f"{_js(tags.get('istio.canonical_service'))}"
+            f"\t{_js(tags.get('istio.namespace'))}"
+            f"\t{_js(tags.get('istio.canonical_revision'))}"
+        )
+        rt_uen = (
+            f"{rt_usn}\t{_js(tags.get('http.method'))}\t{_js(tags.get('http.url'))}"
+        )
+        rt_eid = interner.intern_endpoint(rt_uen)
+        if rt_eid == len(endpoint_infos):
+            # metadata for the rt-space endpoint must carry the rt naming
+            # (istio tags), not the graph-space info
+            endpoint_infos.append(
+                {
+                    **info,
+                    "service": tags.get("istio.canonical_service"),
+                    "namespace": tags.get("istio.namespace"),
+                    "version": tags.get("istio.canonical_revision"),
+                    "uniqueServiceName": rt_usn,
+                    "uniqueEndpointName": rt_uen,
+                }
+            )
+        rt_endpoint_id[i] = rt_eid
+        rt_service_id[i] = interner.service_of(rt_eid)
+
+        status = span.get("tags", {}).get("http.status_code") or ""
+        status_id[i] = statuses.intern(status)
+        status_class[i] = int(status[0]) if status[:1].isdigit() else 0
+        latency_ms[i] = span.get("duration", 0) / 1000
+        timestamp_us[i] = span.get("timestamp", 0)
+
+    interner._endpoint_infos = endpoint_infos  # type: ignore[attr-defined]
+    ts_base = int(timestamp_us[:n].min()) if n else 0
+    timestamp_rel = np.zeros(capacity, dtype=np.int32)
+    if n:
+        span_rel = timestamp_us[:n] - ts_base
+        if span_rel.max() > np.iinfo(np.int32).max:
+            # one batch must fit int32 µs offsets (~35 min); realtime windows
+            # are 30 s — long replays/backfills must split into batches
+            raise ValueError(
+                "span window exceeds int32 µs range; split the batch "
+                f"(span of {span_rel.max() / 1e6:.0f}s)"
+            )
+        timestamp_rel[:n] = span_rel.astype(np.int32)
+    return SpanBatch(
+        n_spans=n,
+        valid=valid,
+        kind=kind,
+        parent_idx=parent_idx,
+        endpoint_id=endpoint_id,
+        service_id=service_id,
+        rt_endpoint_id=rt_endpoint_id,
+        rt_service_id=rt_service_id,
+        status_id=status_id,
+        status_class=status_class,
+        latency_ms=latency_ms,
+        timestamp_us=timestamp_us,
+        timestamp_rel=timestamp_rel,
+        ts_base_us=ts_base,
+        interner=interner,
+        statuses=statuses,
+        endpoint_infos=endpoint_infos,
+    )

@@ -1,0 +1,203 @@
+"""HBM-resident endpoint-dependency graph store.
+
+The persistent equivalent of the reference's EndpointDependencies cache
+(/root/reference/src/classes/Cacheable/CEndpointDependencies.ts) redesigned
+for the device: the edge set lives as capacity-padded int32 column arrays
+(src_ep, dst_ep, distance); window merges (the reference's set-union
+combineWith, EndpointDependencies.ts:499-563) are lexsort+unique kernels;
+scorers read the arrays in place (kmamiz_tpu.ops.scorers). Capacities grow
+by doubling so XLA compiles a bounded number of program shapes. No int64
+anywhere — the production TPU path runs with x64 disabled.
+
+Intentional deviation from the reference: merging keeps the full edge union.
+The reference's combineWith overwrites same-window duplicate records
+(JS Map.set), silently dropping edges observed in the overwritten record.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kmamiz_tpu.core.interning import EndpointInterner, StringInterner
+from kmamiz_tpu.core.spans import KIND_SERVER, SpanBatch, _pad_size as _pow2
+from kmamiz_tpu.ops import scorers as scorer_ops
+from kmamiz_tpu.ops import window as window_ops
+from kmamiz_tpu.ops.sortutil import SENTINEL, compact_unique
+
+
+@jax.jit
+def _merge_edges(src_a, dst_a, dist_a, mask_a, src_b, dst_b, dist_b, mask_b):
+    src = jnp.concatenate([src_a, src_b])
+    dst = jnp.concatenate([dst_a, dst_b])
+    dist = jnp.concatenate([dist_a, dist_b])
+    mask = jnp.concatenate([mask_a, mask_b])
+    (s, d, ds), valid = compact_unique((src, dst, dist), mask)
+    return s, d, ds, valid
+
+
+class EndpointGraph:
+    """Capacity-padded edge set keyed (src_ep -> dst_ep, distance).
+
+    Edge semantics: src depends-ON dst (src is the CLIENT-side ancestor).
+    """
+
+    def __init__(
+        self,
+        interner: Optional[EndpointInterner] = None,
+        ml_interner: Optional[StringInterner] = None,
+        capacity: int = 1024,
+    ) -> None:
+        self.interner = interner or EndpointInterner()
+        self.ml_interner = ml_interner or StringInterner()
+        self._src = jnp.full(capacity, SENTINEL, dtype=jnp.int32)
+        self._dst = jnp.full(capacity, SENTINEL, dtype=jnp.int32)
+        self._dist = jnp.full(capacity, SENTINEL, dtype=jnp.int32)
+        self._n_edges = 0
+        # per-endpoint host-side metadata, padded on demand
+        self._ep_record = np.zeros(0, dtype=bool)
+        self._ep_last_ts = np.zeros(0, dtype=np.float64)
+
+    # -- capacity management -------------------------------------------------
+
+    @property
+    def capacity(self) -> int:
+        return int(self._src.shape[0])
+
+    @property
+    def n_edges(self) -> int:
+        return self._n_edges
+
+    def _grow(self, needed: int) -> None:
+        if needed <= self.capacity:
+            return
+        new_cap = _pow2(needed, self.capacity)
+        pad = jnp.full(new_cap - self.capacity, SENTINEL, dtype=jnp.int32)
+        self._src = jnp.concatenate([self._src, pad])
+        self._dst = jnp.concatenate([self._dst, pad])
+        self._dist = jnp.concatenate([self._dist, pad])
+
+    def _ensure_ep_arrays(self, n: int) -> None:
+        if len(self._ep_record) < n:
+            grow = n - len(self._ep_record)
+            self._ep_record = np.concatenate(
+                [self._ep_record, np.zeros(grow, dtype=bool)]
+            )
+            self._ep_last_ts = np.concatenate(
+                [self._ep_last_ts, np.zeros(grow, dtype=np.float64)]
+            )
+
+    # -- ingestion -----------------------------------------------------------
+
+    def merge_window(self, batch: SpanBatch) -> None:
+        """Union this window's dependency edges into the store and update
+        per-endpoint record/last-usage metadata."""
+        edges = window_ops.dependency_edges(
+            jnp.asarray(batch.parent_idx),
+            jnp.asarray(batch.kind),
+            jnp.asarray(batch.valid),
+            jnp.asarray(batch.endpoint_id),
+        )
+        new_src = edges.ancestor_ep.reshape(-1)
+        new_dst = edges.descendant_ep.reshape(-1)
+        new_dist = edges.distance.reshape(-1)
+        new_mask = edges.mask.reshape(-1)
+
+        self._grow(self._n_edges + int(new_mask.sum()))
+        src, dst, dist, valid = _merge_edges(
+            self._src,
+            self._dst,
+            self._dist,
+            self._src != SENTINEL,
+            new_src,
+            new_dst,
+            new_dist,
+            new_mask,
+        )
+        valid_count = int(valid.sum())
+        self._grow(valid_count)
+        cap = self.capacity
+        self._src = src[:cap]
+        self._dst = dst[:cap]
+        self._dist = dist[:cap]
+        self._n_edges = valid_count
+
+        # endpoint metadata
+        n_ep = len(self.interner.endpoints)
+        self._ensure_ep_arrays(n_ep)
+        server_eps = batch.endpoint_id[batch.valid & (batch.kind == KIND_SERVER)]
+        self._ep_record[server_eps] = True
+        for info in batch.endpoint_infos:
+            eid = self.interner.endpoints.get(info["uniqueEndpointName"])
+            if eid is not None and eid < n_ep:
+                self._ep_last_ts[eid] = max(
+                    self._ep_last_ts[eid], info["timestamp"]
+                )
+
+    # -- views ---------------------------------------------------------------
+
+    def edge_arrays(self):
+        """(src_ep, dst_ep, dist, mask) views of the stored edges."""
+        mask = self._src != SENTINEL
+        return self._src, self._dst, self._dist, mask
+
+    def _ep_tables(self, label_of=None):
+        """Padded per-endpoint service/ml/record arrays (+ padded size)."""
+        n_ep = len(self.interner.endpoints)
+        self._ensure_ep_arrays(n_ep)
+        ep_cap = _pow2(max(n_ep, 1))
+        ep_service = np.zeros(ep_cap, dtype=np.int32)
+        ep_ml = np.zeros(ep_cap, dtype=np.int32)
+        ep_record = np.zeros(ep_cap, dtype=bool)
+        ep_service[:n_ep] = self.interner.endpoint_service_ids
+        ep_record[:n_ep] = self._ep_record[:n_ep]
+        for eid in range(n_ep):
+            name = self.interner.endpoints.lookup(eid)
+            parts = name.split("\t")
+            method = parts[3] if len(parts) > 3 else ""
+            label = label_of(name) if label_of else None
+            ep_ml[eid] = self.ml_interner.intern(f"{method}\t{label}")
+        return ep_service, ep_ml, ep_record, ep_cap
+
+    # -- scorers -------------------------------------------------------------
+
+    def service_scores(self, label_of=None) -> scorer_ops.ServiceScores:
+        src, dst, dist, mask = self.edge_arrays()
+        ep_service, ep_ml, ep_record, _ = self._ep_tables(label_of)
+        svc_cap = _pow2(max(len(self.interner.services), 1))
+        return scorer_ops.service_scores(
+            src,
+            dst,
+            dist,
+            mask,
+            jnp.asarray(ep_service),
+            jnp.asarray(ep_ml),
+            jnp.asarray(ep_record),
+            num_services=svc_cap,
+        )
+
+    def usage_cohesion(self) -> scorer_ops.CohesionScores:
+        src, dst, dist, mask = self.edge_arrays()
+        ep_service, _, ep_record, _ = self._ep_tables()
+        svc_cap = _pow2(max(len(self.interner.services), 1))
+        return scorer_ops.usage_cohesion(
+            src,
+            dst,
+            dist,
+            mask,
+            jnp.asarray(ep_service),
+            jnp.asarray(ep_record),
+            num_services=svc_cap,
+        )
+
+    def active_services(self) -> np.ndarray:
+        """bool[num_services]: services owning at least one endpoint record."""
+        n_ep = len(self.interner.endpoints)
+        self._ensure_ep_arrays(n_ep)
+        out = np.zeros(len(self.interner.services), dtype=bool)
+        for eid in range(n_ep):
+            if self._ep_record[eid]:
+                out[self.interner.service_of(eid)] = True
+        return out

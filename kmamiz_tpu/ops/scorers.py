@@ -1,0 +1,301 @@
+"""Device graph scorers over flat edge arrays.
+
+TPU-native reformulation of the per-request O(V*E) object traversals in
+/root/reference/src/classes/EndpointDependencies.ts:369-657 and
+/root/reference/src/utils/RiskAnalyzer.ts: the endpoint-dependency edge set
+lives as fixed-capacity int32 arrays (see kmamiz_tpu.graph.store), and every
+scorer is a pipeline of lexsort -> unique-mask -> segment_sum steps — no
+Python loops, no int64 (TPU runs with x64 off), one XLA program per
+capacity.
+
+Semantics mirrored from the reference:
+- link details count DISTINCT (linked endpoint's service, method+label,
+  direction, distance) tuples per owning service
+  (EndpointDependencies.ts:412-470);
+- instability counts linked services with any by/on detail (:614-641);
+- ACS counts distance-1 linked services, gateway services get AIS+1
+  (RiskAnalyzer.ts:145-169);
+- relying factor sums by_count/distance (+1 gateway) (:124-137);
+- usage cohesion averages consumed-endpoint fractions over consumer
+  services (EndpointDependencies.ts:565-612).
+
+Edge convention: (src_ep, dst_ep, dist) means src depends-ON dst (src is
+the CLIENT-side ancestor, dst the SERVER-side descendant), i.e. dst is
+depended-BY src.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from kmamiz_tpu.ops.sortutil import lex_unique
+
+
+class ServiceScores(NamedTuple):
+    instability_on: jnp.ndarray  # distinct linked services depended on
+    instability_by: jnp.ndarray  # distinct linked services depending by
+    instability: jnp.ndarray  # Ce/(Ce+Ca)
+    ais: jnp.ndarray
+    ads: jnp.ndarray
+    acs: jnp.ndarray  # ais * ads
+    relying_factor: jnp.ndarray
+    is_gateway: jnp.ndarray  # bool
+
+
+@partial(jax.jit, static_argnames=("num_services",))
+def service_scores(
+    src_ep: jnp.ndarray,
+    dst_ep: jnp.ndarray,
+    dist: jnp.ndarray,
+    mask: jnp.ndarray,
+    ep_service: jnp.ndarray,
+    ep_ml: jnp.ndarray,
+    ep_has_record: jnp.ndarray,
+    num_services: int,
+) -> ServiceScores:
+    """All service-level structure scorers in one fused pipeline.
+
+    src_ep/dst_ep/dist/mask: flat edge arrays (capacity-padded).
+    ep_service: int32[num_endpoints] service of each endpoint.
+    ep_ml: int32[num_endpoints] method+label intern id of each endpoint
+    (labelName masking collapses endpoints sharing a label, exactly like the
+    reference's `${method}\\t${labelName}` keying).
+    ep_has_record: bool[num_endpoints] — endpoints with a dependency record
+    (seen as SERVER spans); gateway detection only considers these.
+    """
+    src_safe = jnp.maximum(src_ep, 0)
+    dst_safe = jnp.maximum(dst_ep, 0)
+    src_svc = ep_service[src_safe]
+    dst_svc = ep_service[dst_safe]
+    src_ml = ep_ml[src_safe]
+    dst_ml = ep_ml[dst_safe]
+    dist32 = dist.astype(jnp.int32)
+
+    # direction rows: "on" = owner src sees linked dst; "by" = owner dst sees
+    # linked src. Distinct (owner, linked_svc, linked_ml, dist, dir) tuples.
+    owner = jnp.concatenate([src_svc, dst_svc])
+    linked = jnp.concatenate([dst_svc, src_svc])
+    linked_ml = jnp.concatenate([dst_ml, src_ml])
+    ddist = jnp.concatenate([dist32, dist32])
+    ddir = jnp.concatenate(
+        [jnp.zeros_like(dist32), jnp.ones_like(dist32)]
+    )  # 0 = on/SERVER, 1 = by/CLIENT
+    both_mask = jnp.concatenate([mask, mask])
+
+    (s_owner, s_linked, s_ml, s_dist, s_dir), uniq = lex_unique(
+        (owner, linked, linked_ml, ddist, ddir), both_mask
+    )
+
+    park = num_services
+    owner_seg = jnp.where(uniq, s_owner, park)
+
+    # -- distinct (owner, linked, direction) for instability -----------------
+    (p_owner, p_linked, p_dir), p_uniq = lex_unique(
+        (s_owner, s_linked, s_dir), uniq
+    )
+    p_seg = jnp.where(p_uniq, p_owner, park)
+    fdir = p_dir == 0
+    inst_on = jax.ops.segment_sum(
+        (p_uniq & fdir).astype(jnp.float32), p_seg, num_segments=park + 1
+    )[:-1]
+    inst_by = jax.ops.segment_sum(
+        (p_uniq & ~fdir).astype(jnp.float32), p_seg, num_segments=park + 1
+    )[:-1]
+    total = inst_on + inst_by
+    instability = jnp.where(total > 0, inst_on / jnp.maximum(total, 1), 0.0)
+
+    # -- ACS at distance 1 ---------------------------------------------------
+    (q_owner, q_linked, q_dir), q_uniq = lex_unique(
+        (s_owner, s_linked, s_dir), uniq & (s_dist == 1)
+    )
+    q_seg = jnp.where(q_uniq, q_owner, park)
+    qdir_on = q_dir == 0
+    ads = jax.ops.segment_sum(
+        (q_uniq & qdir_on).astype(jnp.float32), q_seg, num_segments=park + 1
+    )[:-1]
+    ais_links = jax.ops.segment_sum(
+        (q_uniq & ~qdir_on).astype(jnp.float32), q_seg, num_segments=park + 1
+    )[:-1]
+
+    # gateway: a service owning an endpoint record with zero depended-by
+    # edges (reference: dependency.find(d => d.dependingBy.length === 0))
+    num_endpoints = ep_service.shape[0]
+    by_deg = jax.ops.segment_sum(
+        mask.astype(jnp.float32),
+        jnp.where(mask, dst_ep, num_endpoints),
+        num_segments=num_endpoints + 1,
+    )[:-1]
+    gateway_ep = ep_has_record & (by_deg == 0)
+    is_gateway = (
+        jax.ops.segment_max(
+            gateway_ep.astype(jnp.int32), ep_service, num_segments=num_services
+        )
+        > 0
+    )
+
+    ais = ais_links + is_gateway.astype(jnp.float32)
+    acs = ais * ads
+
+    # -- relying factor: sum by_count/distance over details ------------------
+    rf_contrib = (
+        uniq.astype(jnp.float32)
+        * (s_dir == 1)
+        / jnp.maximum(s_dist, 1).astype(jnp.float32)
+    )
+    rf = jax.ops.segment_sum(rf_contrib, owner_seg, num_segments=park + 1)[:-1]
+    rf = rf + is_gateway.astype(jnp.float32)
+
+    return ServiceScores(
+        instability_on=inst_on,
+        instability_by=inst_by,
+        instability=instability,
+        ais=ais,
+        ads=ads,
+        acs=acs,
+        relying_factor=rf,
+        is_gateway=is_gateway,
+    )
+
+
+class CohesionScores(NamedTuple):
+    total_endpoints: jnp.ndarray  # endpoint records per service
+    consumer_count: jnp.ndarray  # distinct consumer services
+    usage_cohesion: jnp.ndarray  # SIUC
+
+
+@partial(jax.jit, static_argnames=("num_services",))
+def usage_cohesion(
+    src_ep: jnp.ndarray,
+    dst_ep: jnp.ndarray,
+    dist: jnp.ndarray,
+    mask: jnp.ndarray,
+    ep_service: jnp.ndarray,
+    ep_has_record: jnp.ndarray,
+    num_services: int,
+) -> CohesionScores:
+    """SIUC: for each service, average over consumer services of
+    (distinct endpoints consumed / total endpoint records)."""
+    park = num_services
+    total_endpoints = jax.ops.segment_sum(
+        ep_has_record.astype(jnp.float32),
+        jnp.where(ep_has_record, ep_service, park),
+        num_segments=park + 1,
+    )[:-1]
+
+    # distance-1 by-edges: consumer = svc[src], consumed endpoint = dst
+    d1 = mask & (dist == 1)
+    consumer = ep_service[jnp.maximum(src_ep, 0)]
+    # distinct (consumer_svc, consumed_ep)
+    (k_consumer, k_consumed), k_uniq = lex_unique((consumer, dst_ep), d1)
+    k_owner = ep_service[jnp.minimum(k_consumed, ep_service.shape[0] - 1)]
+
+    # per (owner_svc, consumer_svc): count of consumed endpoints
+    (g_owner, g_consumer), g_uniq_rows = lex_unique((k_owner, k_consumer), k_uniq)
+    # rows are sorted by (owner, consumer); each distinct pair's count is the
+    # number of identical rows — segment by cumulative group index
+    group_idx = jnp.cumsum(g_uniq_rows.astype(jnp.int32)) - 1
+    cap = g_owner.shape[0]
+    valid_row = g_owner != jnp.iinfo(jnp.int32).max
+    pair_counts = jax.ops.segment_sum(
+        valid_row.astype(jnp.float32), jnp.maximum(group_idx, 0), num_segments=cap
+    )
+    owner_total = total_endpoints[jnp.minimum(g_owner, park - 1)]
+    frac = jnp.where(
+        g_uniq_rows & (owner_total > 0),
+        pair_counts[jnp.maximum(group_idx, 0)] / jnp.maximum(owner_total, 1),
+        0.0,
+    )
+    pair_owner_seg = jnp.where(g_uniq_rows, g_owner, park)
+    frac_sum = jax.ops.segment_sum(frac, pair_owner_seg, num_segments=park + 1)[:-1]
+    consumer_count = jax.ops.segment_sum(
+        g_uniq_rows.astype(jnp.float32), pair_owner_seg, num_segments=park + 1
+    )[:-1]
+    cohesion = jnp.where(
+        consumer_count > 0, frac_sum / jnp.maximum(consumer_count, 1), 0.0
+    )
+    return CohesionScores(
+        total_endpoints=total_endpoints,
+        consumer_count=consumer_count,
+        usage_cohesion=cohesion,
+    )
+
+
+# ---------------------------------------------------------------------------
+# risk pipeline (RiskAnalyzer.ts) as dense vector math
+# ---------------------------------------------------------------------------
+
+
+def _fixed_ratio(v: jnp.ndarray) -> jnp.ndarray:
+    mx = jnp.max(v)
+    return jnp.where(mx == 0, v, v / jnp.maximum(mx, 1e-30))
+
+
+def _linear(v: jnp.ndarray, minimum: float = 0.1) -> jnp.ndarray:
+    return _fixed_ratio(v) * (1 - minimum) + minimum
+
+
+def _sigmoid_adj(v: jnp.ndarray) -> jnp.ndarray:
+    z = 2 * jnp.log(3.0)
+    return 1 / (1 + jnp.exp(-z * (v - 1.5)))
+
+
+class RiskScores(NamedTuple):
+    impact: jnp.ndarray
+    probability: jnp.ndarray
+    risk: jnp.ndarray
+    norm_risk: jnp.ndarray
+
+
+@jax.jit
+def risk_scores(
+    relying_factor: jnp.ndarray,
+    acs: jnp.ndarray,
+    replicas: jnp.ndarray,
+    request_count: jnp.ndarray,
+    error_count: jnp.ndarray,
+    cv_weighted_sum: jnp.ndarray,
+    active: jnp.ndarray,
+) -> RiskScores:
+    """risk = impact x probability per service (RiskAnalyzer.ts:10-122).
+
+    active: bool[num_services] — services present in this window (the host
+    pipeline only scores services with data; inactive lanes produce 0).
+    """
+    minimum = 0.01
+    norm_rf = _fixed_ratio(relying_factor)
+    norm_acs = _fixed_ratio(acs)
+    raw_impact = (norm_rf + norm_acs) / jnp.maximum(replicas, 1)
+    impact = _linear(raw_impact)
+
+    total = jnp.maximum(jnp.sum(jnp.where(active, request_count, 0.0)), 1.0)
+    invoke_p = jnp.where(active, request_count / total, 0.0)
+    error_rate = jnp.where(
+        active, error_count / jnp.maximum(request_count, 1.0), 0.0
+    )
+    norm_pro = invoke_p * (1 - minimum) + minimum
+    norm_err = error_rate * (1 - minimum) + minimum
+    base_prob = _linear(norm_pro * norm_err, minimum)
+
+    latency_cv = jnp.where(
+        active, cv_weighted_sum / jnp.maximum(request_count, 1.0), 0.0
+    )
+    reliability = _sigmoid_adj(latency_cv)
+    raw_prob = reliability * jnp.maximum(base_prob, minimum)
+    prob = raw_prob * (1 - minimum) + minimum
+
+    risk = jnp.where(active, impact * prob, 0.0)
+    masked = jnp.where(active, risk, jnp.inf)
+    mn = jnp.min(masked)
+    mx = jnp.max(jnp.where(active, risk, -jnp.inf))
+    rng = mx - mn
+    # device variant: degenerate windows normalize every service to 0.1
+    # (the host path preserves the reference's single-element quirk)
+    norm = jnp.where(
+        active,
+        jnp.where(rng == 0, 0.1, (risk - mn) / jnp.maximum(rng, 1e-30) * 0.9 + 0.1),
+        0.0,
+    )
+    return RiskScores(impact=impact, probability=prob, risk=risk, norm_risk=norm)

@@ -1,0 +1,51 @@
+"""Lexicographic sort/unique over int32 key columns.
+
+TPU-friendly replacement for int64 key packing: JAX on TPU runs with x64
+disabled by default, so wide packed keys silently truncate. All dedup in the
+graph pipeline instead sorts tuples of int32 columns with jnp.lexsort and
+marks first occurrences. INT32_MAX doubles as the parked-row sentinel.
+"""
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import jax.numpy as jnp
+
+SENTINEL = jnp.iinfo(jnp.int32).max
+
+
+def park_invalid(cols: Sequence[jnp.ndarray], valid: jnp.ndarray) -> List[jnp.ndarray]:
+    """Replace invalid rows with the sentinel in every column."""
+    return [jnp.where(valid, c.astype(jnp.int32), SENTINEL) for c in cols]
+
+
+def lex_unique(
+    cols: Sequence[jnp.ndarray], valid: jnp.ndarray
+) -> Tuple[List[jnp.ndarray], jnp.ndarray]:
+    """Sort rows lexicographically (cols[0] most significant) and mark the
+    first occurrence of each distinct valid row.
+
+    Returns (sorted_cols, unique_mask); parked rows sort to the end and are
+    never marked unique.
+    """
+    parked = park_invalid(cols, valid)
+    perm = jnp.lexsort(tuple(parked[::-1]))  # lexsort: last key is primary
+    sorted_cols = [c[perm] for c in parked]
+    neq = jnp.zeros(sorted_cols[0].shape[0] - 1, dtype=bool)
+    for c in sorted_cols:
+        neq = neq | (c[1:] != c[:-1])
+    first = jnp.concatenate([jnp.array([True]), neq])
+    is_valid = sorted_cols[0] != SENTINEL
+    return sorted_cols, first & is_valid
+
+
+def compact_unique(
+    cols: Sequence[jnp.ndarray], valid: jnp.ndarray
+) -> Tuple[List[jnp.ndarray], jnp.ndarray]:
+    """lex_unique, then push duplicate/parked rows to the tail so distinct
+    valid rows form a sorted prefix. Returns (cols, valid_mask)."""
+    sorted_cols, uniq = lex_unique(cols, valid)
+    compacted = park_invalid(sorted_cols, uniq)
+    perm = jnp.lexsort(tuple(compacted[::-1]))
+    out = [c[perm] for c in compacted]
+    return out, out[0] != SENTINEL

@@ -1,0 +1,200 @@
+"""Device kernels for the span-window pipeline (the DP hot path).
+
+TPU-native reformulation of the reference hot loops
+(/root/reference/kmamiz_data_processor/src/data_processor.rs:75-126):
+
+- The per-span parent-chain walk (trace.rs:110-212 / Traces.ts:128-143)
+  becomes a fixed-iteration ancestor enumeration: first resolve each span's
+  nearest non-CLIENT ancestor by iterated pointer jumps, then hop that
+  skip-pointer MAX_DEPTH times, emitting (ancestor, descendant, distance)
+  edge triples. No data-dependent control flow; everything is gathers over
+  int32 arrays, which XLA vectorizes across the whole window.
+- Every Map-groupby (realtime_data.rs:31-121 / RealtimeDataList.ts:23-33)
+  becomes segment reductions keyed by endpoint*num_statuses+status, with CV
+  in the sum/sum-of-squares form the Rust DP already uses.
+
+All kernels take fixed-shape padded arrays (see core.spans.SpanBatch) so
+XLA compiles once per padded size.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from kmamiz_tpu.core.spans import KIND_CLIENT, KIND_SERVER
+
+MAX_CLIENT_SKIP = 16  # max run of consecutive CLIENT spans in a parent chain
+MAX_DEPTH = 32  # max SERVER-ancestor depth recorded (trace trees are shallow)
+
+
+@partial(jax.jit, static_argnames=("max_client_skip",))
+def skip_client_parents(
+    parent_idx: jnp.ndarray,
+    kind: jnp.ndarray,
+    valid: jnp.ndarray,
+    max_client_skip: int = MAX_CLIENT_SKIP,
+) -> jnp.ndarray:
+    """For each span, the index of its nearest non-CLIENT strict ancestor
+    within the window (-1 if none)."""
+    safe_parent = jnp.where(valid, parent_idx, -1)
+
+    def step(c, _):
+        c_safe = jnp.maximum(c, 0)
+        is_client_parent = (c >= 0) & (kind[c_safe] == KIND_CLIENT)
+        nxt = jnp.where(is_client_parent, safe_parent[c_safe], c)
+        return nxt, None
+
+    c0 = safe_parent
+    c, _ = jax.lax.scan(step, c0, None, length=max_client_skip)
+    # a chain of >max_client_skip CLIENT spans leaves a CLIENT as the carry;
+    # mask it to -1 (truncation) rather than emitting a CLIENT ancestor
+    still_client = (c >= 0) & (kind[jnp.maximum(c, 0)] == KIND_CLIENT)
+    return jnp.where(still_client, -1, c)
+
+
+@partial(jax.jit, static_argnames=("max_depth", "max_client_skip"))
+def dependency_edges(
+    parent_idx: jnp.ndarray,
+    kind: jnp.ndarray,
+    valid: jnp.ndarray,
+    endpoint_id: jnp.ndarray,
+    max_depth: int = MAX_DEPTH,
+    max_client_skip: int = MAX_CLIENT_SKIP,
+) -> NamedTuple:
+    """Enumerate (ancestor_endpoint, descendant_endpoint, distance) triples.
+
+    Returns arrays of shape [n, max_depth]: ancestor_ep, descendant_ep,
+    distance, mask. A row i contributes edges only if span i is a valid
+    SERVER span; ancestors are its non-CLIENT ancestor chain, distance
+    counted per recorded hop exactly like the reference walk.
+    """
+    skip = skip_client_parents(parent_idx, kind, valid, max_client_skip)
+    is_server = valid & (kind == KIND_SERVER)
+
+    def step(anc, _):
+        anc_safe = jnp.maximum(anc, 0)
+        nxt = jnp.where(anc >= 0, skip[anc_safe], -1)
+        return nxt, anc
+
+    _, ancestors = jax.lax.scan(step, skip, None, length=max_depth)
+    # ancestors: [max_depth, n] -> [n, max_depth]
+    ancestors = ancestors.T
+    anc_valid = (ancestors >= 0) & is_server[:, None]
+    anc_safe = jnp.maximum(ancestors, 0)
+
+    class Edges(NamedTuple):
+        ancestor_ep: jnp.ndarray
+        descendant_ep: jnp.ndarray
+        distance: jnp.ndarray
+        mask: jnp.ndarray
+        ancestor_span: jnp.ndarray
+
+    distances = jnp.arange(1, max_depth + 1, dtype=jnp.int32)[None, :]
+    return Edges(
+        ancestor_ep=jnp.where(anc_valid, endpoint_id[anc_safe], -1),
+        descendant_ep=jnp.where(anc_valid, endpoint_id[:, None], -1),
+        distance=jnp.where(anc_valid, distances, 0),
+        mask=anc_valid,
+        ancestor_span=jnp.where(anc_valid, ancestors, -1),
+    )
+
+
+class WindowStats(NamedTuple):
+    """Per-(endpoint, status) segment statistics for one window."""
+
+    count: jnp.ndarray  # float[S]
+    error_4xx: jnp.ndarray  # float[S]
+    error_5xx: jnp.ndarray  # float[S]
+    latency_sum: jnp.ndarray  # float[S]
+    latency_sq_sum: jnp.ndarray  # float[S]
+    latency_mean: jnp.ndarray  # float[S]
+    latency_cv: jnp.ndarray  # float[S]
+    latest_timestamp_rel: jnp.ndarray  # int32[S] (max offset from window base)
+
+
+@partial(jax.jit, static_argnames=("num_endpoints", "num_statuses"))
+def window_stats(
+    endpoint_id: jnp.ndarray,
+    status_id: jnp.ndarray,
+    status_class: jnp.ndarray,
+    latency_ms: jnp.ndarray,
+    timestamp_rel: jnp.ndarray,
+    valid_server: jnp.ndarray,
+    num_endpoints: int,
+    num_statuses: int,
+) -> WindowStats:
+    """Segment-combine per (endpoint, status): request count, 4xx/5xx counts,
+    latency mean + CV (sum/sum-of-squares form, matching the Rust DP's
+    realtime_data.rs:52-81), and latest timestamp.
+
+    timestamp_rel: int32 microsecond offsets from the window base (absolute
+    µs don't fit int32, and the TPU path runs with x64 off — the caller adds
+    the base back on the host)."""
+    num_segments = num_endpoints * num_statuses
+    seg = endpoint_id * num_statuses + status_id
+    seg = jnp.where(valid_server, seg, num_segments)  # park invalid rows
+
+    w = valid_server.astype(latency_ms.dtype)
+    ones = w
+    count = jax.ops.segment_sum(ones, seg, num_segments=num_segments + 1)[:-1]
+    e4 = jax.ops.segment_sum(
+        ones * (status_class == 4), seg, num_segments=num_segments + 1
+    )[:-1]
+    e5 = jax.ops.segment_sum(
+        ones * (status_class == 5), seg, num_segments=num_segments + 1
+    )[:-1]
+    lat_sum = jax.ops.segment_sum(
+        latency_ms * w, seg, num_segments=num_segments + 1
+    )[:-1]
+    lat_sq = jax.ops.segment_sum(
+        latency_ms * latency_ms * w, seg, num_segments=num_segments + 1
+    )[:-1]
+    ts = jax.ops.segment_max(
+        jnp.where(valid_server, timestamp_rel, 0), seg, num_segments=num_segments + 1
+    )[:-1]
+
+    safe_count = jnp.maximum(count, 1)
+    mean = lat_sum / safe_count
+    # two-pass variance: sum of squared residuals against the segment mean.
+    # The naive E[x^2]-E[x]^2 form cancels catastrophically in float32 (the
+    # production TPU dtype); one extra segment_sum buys f64-like stability.
+    resid = (latency_ms - mean[jnp.minimum(seg, num_segments - 1)]) * w
+    variance = (
+        jax.ops.segment_sum(resid * resid, seg, num_segments=num_segments + 1)[:-1]
+        / safe_count
+    )
+    std = jnp.sqrt(jnp.maximum(variance, 0.0))
+    cv = jnp.where(mean != 0, std / jnp.maximum(mean, 1e-300), 0.0)
+    return WindowStats(
+        count=count,
+        error_4xx=e4,
+        error_5xx=e5,
+        latency_sum=lat_sum,
+        latency_sq_sum=lat_sq,
+        latency_mean=jnp.where(count > 0, mean, 0.0),
+        latency_cv=jnp.where(count > 0, cv, 0.0),
+        latest_timestamp_rel=ts,
+    )
+
+
+@partial(jax.jit, static_argnames=("num_services",))
+def service_stats(
+    service_of_segment: jnp.ndarray,
+    stats_count: jnp.ndarray,
+    stats_error_5xx: jnp.ndarray,
+    stats_cv: jnp.ndarray,
+    num_services: int,
+):
+    """Roll (endpoint,status) segments up to services: request counts, 5xx
+    counts, and combined-weighted latency-CV sums (the risk pipeline's
+    GetLatencyCVOfServices shape, RiskAnalyzer.ts:228-248)."""
+    seg = jnp.where(stats_count > 0, service_of_segment, num_services)
+    count = jax.ops.segment_sum(stats_count, seg, num_segments=num_services + 1)[:-1]
+    err5 = jax.ops.segment_sum(stats_error_5xx, seg, num_segments=num_services + 1)[:-1]
+    cv_weighted = jax.ops.segment_sum(
+        stats_cv * stats_count, seg, num_segments=num_services + 1
+    )[:-1]
+    return count, err5, cv_weighted

@@ -8,7 +8,30 @@ import json
 import os
 from pathlib import Path
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# force CPU: the harness presets JAX_PLATFORMS to the TPU platform, but tests
+# validate sharding on 8 virtual CPU devices
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+
+def _deregister_tpu_plugin() -> None:
+    # The environment's sitecustomize registers a TPU PJRT plugin whose
+    # backend factory opens a device tunnel even under JAX_PLATFORMS=cpu
+    # (jax.backends() initializes every registered factory); a hung tunnel
+    # then blocks the whole CPU test suite. Drop the factory before any
+    # backend is initialized.
+    try:
+        import jax
+        from jax._src import xla_bridge as _xb
+
+        jax.config.update("jax_platforms", "cpu")
+        for name in list(_xb._backend_factories):
+            if name not in ("cpu",):
+                _xb._backend_factories.pop(name, None)
+    except Exception:
+        pass
+
+
+_deregister_tpu_plugin()
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (

@@ -1,0 +1,126 @@
+"""Device graph-scorer parity vs the host domain implementation."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kmamiz_tpu.core.spans import spans_to_batch
+from kmamiz_tpu.domain.traces import Traces
+from kmamiz_tpu.graph.store import EndpointGraph
+from kmamiz_tpu.ops import scorers as scorer_ops
+
+
+def build_graph(trace_groups):
+    batch = spans_to_batch(trace_groups)
+    graph = EndpointGraph(interner=batch.interner)
+    graph.merge_window(batch)
+    return batch, graph
+
+
+def host_scores(trace_groups):
+    # Fold per-span records into per-endpoint records one at a time: each
+    # single-record combineWith unions (endpoint, distance) sets. (A bulk
+    # combineWith would drop same-window duplicate records' edges — the
+    # reference's Map.set overwrite quirk; the device store keeps the union.)
+    from kmamiz_tpu.domain.endpoint_dependencies import EndpointDependencies
+
+    raw = Traces(trace_groups).to_endpoint_dependencies()
+    deps = EndpointDependencies([])
+    for record in raw.to_json():
+        deps = deps.combine_with(EndpointDependencies([record]))
+    return {
+        "instability": {
+            s["uniqueServiceName"]: s for s in deps.to_service_instability()
+        },
+        "coupling": {s["uniqueServiceName"]: s for s in deps.to_service_coupling()},
+        "cohesion": {
+            s["uniqueServiceName"]: s for s in deps.to_service_endpoint_cohesion()
+        },
+    }
+
+
+@pytest.mark.parametrize("corpus", ["pdas", "bookinfo"])
+def test_device_scores_match_host(corpus, pdas_traces, bookinfo_traces):
+    trace_groups = [pdas_traces] if corpus == "pdas" else bookinfo_traces
+    batch, graph = build_graph(trace_groups)
+    host = host_scores(trace_groups)
+
+    scores = graph.service_scores()
+    cohesion = graph.usage_cohesion()
+    active = graph.active_services()
+
+    inst = np.asarray(scores.instability)
+    on = np.asarray(scores.instability_on)
+    by = np.asarray(scores.instability_by)
+    ais = np.asarray(scores.ais)
+    ads = np.asarray(scores.ads)
+    acs = np.asarray(scores.acs)
+    coh = np.asarray(cohesion.usage_cohesion)
+    total_eps = np.asarray(cohesion.total_endpoints)
+
+    services = batch.interner.services
+    checked = 0
+    for usn, h in host["instability"].items():
+        sid = services.get(usn)
+        assert sid is not None and active[sid]
+        assert on[sid] == h["dependingOn"], usn
+        assert by[sid] == h["dependingBy"], usn
+        assert inst[sid] == pytest.approx(h["instability"]), usn
+        checked += 1
+    for usn, h in host["coupling"].items():
+        sid = services.get(usn)
+        assert ais[sid] == h["ais"], usn
+        assert ads[sid] == h["ads"], usn
+        assert acs[sid] == h["acs"], usn
+    for usn, h in host["cohesion"].items():
+        sid = services.get(usn)
+        assert total_eps[sid] == h["totalEndpoints"], usn
+        assert coh[sid] == pytest.approx(h["endpointUsageCohesion"]), usn
+    assert checked == len(host["instability"]) > 0
+    # inactive (padded) lanes are all zero
+    assert inst[~np.pad(active, (0, len(inst) - len(active)))].sum() == 0
+
+
+def test_incremental_merge_is_union(pdas_traces, bookinfo_traces):
+    # merging windows one at a time equals merging all at once
+    all_at_once_batch = spans_to_batch(bookinfo_traces)
+    g1 = EndpointGraph(interner=all_at_once_batch.interner)
+    g1.merge_window(all_at_once_batch)
+
+    g2 = EndpointGraph()
+    for group in bookinfo_traces:
+        g2.merge_window(spans_to_batch([group], interner=g2.interner))
+
+    assert g1.n_edges == g2.n_edges
+    s1, d1, dist1, m1 = (np.asarray(x) for x in g1.edge_arrays())
+    s2, d2, dist2, m2 = (np.asarray(x) for x in g2.edge_arrays())
+
+    def named(interner, s, d, dist, m):
+        look = interner.endpoints.lookup
+        return {
+            (look(int(a)), look(int(b)), int(c))
+            for a, b, c in zip(s[m], d[m], dist[m])
+        }
+
+    assert named(g1.interner, s1, d1, dist1, m1) == named(
+        g2.interner, s2, d2, dist2, m2
+    )
+
+
+def test_risk_scores_shape(pdas_traces):
+    batch, graph = build_graph([pdas_traces])
+    scores = graph.service_scores()
+    n = scores.relying_factor.shape[0]
+    active = np.zeros(n, dtype=bool)
+    active[: len(graph.interner.services)] = graph.active_services()
+    risk = scorer_ops.risk_scores(
+        scores.relying_factor,
+        scores.acs,
+        jnp.ones(n),
+        jnp.where(jnp.asarray(active), 10.0, 0.0),
+        jnp.zeros(n),
+        jnp.full(n, 0.5),
+        jnp.asarray(active),
+    )
+    norm = np.asarray(risk.norm_risk)
+    assert ((norm[active] >= 0.1 - 1e-6) & (norm[active] <= 1.0 + 1e-6)).all()
+    assert (norm[~active] == 0).all()

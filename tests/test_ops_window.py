@@ -1,0 +1,175 @@
+"""Device-kernel parity: the jitted window pipeline must reproduce the host
+domain model's outputs on the fixture corpora."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kmamiz_tpu.core.spans import KIND_SERVER, spans_to_batch
+from kmamiz_tpu.domain.traces import Traces
+from kmamiz_tpu.ops import window
+
+
+def host_edge_set(traces):
+    """(ancestor_uen, descendant_uen, distance) triples from the host walk."""
+    deps = Traces(traces).to_endpoint_dependencies().to_json()
+    edges = set()
+    for d in deps:
+        desc = d["endpoint"]["uniqueEndpointName"]
+        for b in d["dependingOn"]:
+            edges.add((b["endpoint"]["uniqueEndpointName"], desc, b["distance"]))
+    return edges
+
+
+def device_edge_set(traces):
+    batch = spans_to_batch(traces)
+    e = window.dependency_edges(
+        jnp.asarray(batch.parent_idx),
+        jnp.asarray(batch.kind),
+        jnp.asarray(batch.valid),
+        jnp.asarray(batch.endpoint_id),
+    )
+    anc = np.asarray(e.ancestor_ep)
+    desc = np.asarray(e.descendant_ep)
+    dist = np.asarray(e.distance)
+    mask = np.asarray(e.mask)
+    lookup = batch.interner.endpoints.lookup
+    edges = set()
+    for i, j in zip(*np.nonzero(mask)):
+        # device rows are (descendant=i, ancestor): ancestor depends-on desc
+        edges.add((lookup(int(desc[i, j])), lookup(int(anc[i, j])), int(dist[i, j])))
+    return edges
+
+
+class TestDependencyEdges:
+    def test_pdas_edges_match_host_walk(self, pdas_traces):
+        assert device_edge_set([pdas_traces]) == host_edge_set([pdas_traces])
+
+    def test_bookinfo_edges_match_host_walk(self, bookinfo_traces):
+        assert device_edge_set(bookinfo_traces) == host_edge_set(bookinfo_traces)
+
+    def test_deep_chain(self):
+        # synthetic 20-deep SERVER chain with interleaved CLIENT spans
+        spans = []
+        prev = None
+        for i in range(20):
+            cid = f"c{i}"
+            sid = f"s{i}"
+            spans.append(_span(cid, prev, "CLIENT", f"svc{i}"))
+            spans.append(_span(sid, cid, "SERVER", f"svc{i}"))
+            prev = sid
+        edges = device_edge_set([spans])
+        assert edges == host_edge_set([spans])
+        # deepest span sees all 19 ancestors
+        max_dist = max(d for _, _, d in edges)
+        assert max_dist == 19
+
+
+def _span(span_id, parent_id, kind, svc):
+    return {
+        "traceId": "t1",
+        "parentId": parent_id,
+        "id": span_id,
+        "kind": kind,
+        "name": f"{svc}.ns.svc.cluster.local:80/*",
+        "timestamp": 1646208338224823,
+        "duration": 1000 + hash(span_id) % 1000,
+        "tags": {
+            "http.method": "GET",
+            "http.status_code": "200",
+            "http.url": f"http://{svc}.ns.svc.cluster.local/api",
+            "istio.canonical_revision": "latest",
+            "istio.canonical_service": svc,
+            "istio.mesh_id": "cluster.local",
+            "istio.namespace": "ns",
+        },
+    }
+
+
+class TestWindowStats:
+    def test_stats_match_host_combined(self, pdas_traces):
+        batch = spans_to_batch([pdas_traces])
+        valid_server = jnp.asarray(batch.valid & (batch.kind == KIND_SERVER))
+        stats = window.window_stats(
+            jnp.asarray(batch.rt_endpoint_id),
+            jnp.asarray(batch.status_id),
+            jnp.asarray(batch.status_class),
+            jnp.asarray(batch.latency_ms),
+            jnp.asarray(batch.timestamp_rel),
+            valid_server,
+            num_endpoints=batch.num_endpoints,
+            num_statuses=batch.num_statuses,
+        )
+        # host path: combineLogs naming == rt id space (empty logs)
+        host = (
+            Traces([pdas_traces])
+            .combine_logs_to_realtime_data([])
+            .to_combined_realtime_data()
+            .to_json()
+        )
+        count = np.asarray(stats.count)
+        mean = np.asarray(stats.latency_mean)
+        cv = np.asarray(stats.latency_cv)
+        ts = np.asarray(stats.latest_timestamp_rel).astype(np.int64) + batch.ts_base_us
+        for row in host:
+            eid = batch.interner.endpoints.get(row["uniqueEndpointName"])
+            sid = batch.statuses.get(row["status"])
+            assert eid is not None and sid is not None
+            seg = eid * batch.num_statuses + sid
+            # float32 on the production path: two-pass variance holds ~1e-7
+            assert count[seg] == row["combined"]
+            assert mean[seg] == pytest.approx(row["latency"]["mean"], rel=1e-6)
+            assert cv[seg] == pytest.approx(row["latency"]["cv"], abs=1e-6)
+            assert ts[seg] == row["latestTimestamp"]
+        # no phantom segments
+        assert count.sum() == len(
+            [s for s in pdas_traces if s["kind"] == "SERVER"]
+        )
+
+    def test_error_counts(self):
+        spans = [_span(f"s{i}", None, "SERVER", "svc") for i in range(6)]
+        spans[1]["tags"]["http.status_code"] = "404"
+        spans[2]["tags"]["http.status_code"] = "500"
+        spans[3]["tags"]["http.status_code"] = "503"
+        batch = spans_to_batch([spans])
+        stats = window.window_stats(
+            jnp.asarray(batch.rt_endpoint_id),
+            jnp.asarray(batch.status_id),
+            jnp.asarray(batch.status_class),
+            jnp.asarray(batch.latency_ms),
+            jnp.asarray(batch.timestamp_rel),
+            jnp.asarray(batch.valid & (batch.kind == KIND_SERVER)),
+            num_endpoints=batch.num_endpoints,
+            num_statuses=batch.num_statuses,
+        )
+        assert float(np.asarray(stats.error_4xx).sum()) == 1
+        assert float(np.asarray(stats.error_5xx).sum()) == 2
+        assert float(np.asarray(stats.count).sum()) == 6
+
+
+class TestServiceStats:
+    def test_rollup(self, pdas_traces):
+        batch = spans_to_batch([pdas_traces])
+        valid_server = jnp.asarray(batch.valid & (batch.kind == KIND_SERVER))
+        stats = window.window_stats(
+            jnp.asarray(batch.rt_endpoint_id),
+            jnp.asarray(batch.status_id),
+            jnp.asarray(batch.status_class),
+            jnp.asarray(batch.latency_ms),
+            jnp.asarray(batch.timestamp_rel),
+            valid_server,
+            num_endpoints=batch.num_endpoints,
+            num_statuses=batch.num_statuses,
+        )
+        # map each segment to its service id
+        seg_service = np.repeat(
+            np.asarray(batch.interner.endpoint_service_ids, dtype=np.int32),
+            batch.num_statuses,
+        )
+        count, err5, cvw = window.service_stats(
+            jnp.asarray(seg_service),
+            stats.count,
+            stats.error_5xx,
+            stats.latency_cv,
+            num_services=batch.num_services,
+        )
+        assert float(np.asarray(count).sum()) == 4  # 4 SERVER spans

@@ -1,0 +1,141 @@
+"""Benchmark: span-window ingest throughput + graph-metric refresh latency.
+
+Run on real TPU hardware by the driver. Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...extras}
+
+Workload (BASELINE.json configs): a MicroViSim-scale synthetic mesh with
+1k services / 10k endpoints and a 1M-span window — the reference caps at
+2,500 traces per 5 s tick (~<20k spans/sec sustained; see BASELINE.md), and
+the north-star target is >=1M spans/sec with p50 full risk+instability graph
+refresh < 50 ms at 10k endpoints.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+N_SPANS = 1 << 20  # ~1M spans per window
+N_ENDPOINTS = 10_000
+N_SERVICES = 1_000
+N_STATUSES = 8
+MAX_DEPTH = 8
+GRAPH_EDGES = 50_000
+BASELINE_SPANS_PER_SEC = 1_000_000.0  # BASELINE.json north star
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from kmamiz_tpu.ops import scorers, window
+
+    rng = np.random.default_rng(0)
+
+    # ---- window pipeline: 1M-span synthetic window -------------------------
+    endpoint_id = jnp.asarray(rng.integers(0, N_ENDPOINTS, N_SPANS, dtype=np.int32))
+    status_id = jnp.asarray(rng.integers(0, N_STATUSES, N_SPANS, dtype=np.int32))
+    status_class = jnp.asarray(
+        rng.choice([2, 4, 5], N_SPANS, p=[0.95, 0.04, 0.01]).astype(np.int8)
+    )
+    latency = jnp.asarray(rng.gamma(2.0, 50.0, N_SPANS).astype(np.float32))
+    ts_rel = jnp.asarray(rng.integers(0, 30_000_000, N_SPANS, dtype=np.int32))
+    valid = jnp.ones(N_SPANS, dtype=bool)
+
+    # forest of ~7-span traces, alternating CLIENT/SERVER
+    parent = np.arange(-1, N_SPANS - 1, dtype=np.int32)
+    parent[::7] = -1
+    kind = np.full(N_SPANS, 1, dtype=np.int8)
+    kind[1::2] = 2
+    parent = jnp.asarray(parent)
+    kind_a = jnp.asarray(kind)
+
+    def window_pipeline():
+        stats = window.window_stats(
+            endpoint_id,
+            status_id,
+            status_class,
+            latency,
+            ts_rel,
+            valid,
+            num_endpoints=N_ENDPOINTS,
+            num_statuses=N_STATUSES,
+        )
+        edges = window.dependency_edges(
+            parent, kind_a, valid, endpoint_id, max_depth=MAX_DEPTH
+        )
+        return stats.count, edges.mask
+
+    # warmup/compile
+    c, m = window_pipeline()
+    c.block_until_ready()
+
+    iters = 10
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        c, m = window_pipeline()
+    c.block_until_ready()
+    m.block_until_ready()
+    ingest_dt = (time.perf_counter() - t0) / iters
+    spans_per_sec = N_SPANS / ingest_dt
+
+    # ---- graph metric refresh @10k endpoints -------------------------------
+    ep_service = jnp.asarray(
+        rng.integers(0, N_SERVICES, N_ENDPOINTS, dtype=np.int32)
+    )
+    ep_ml = jnp.asarray(rng.integers(0, 4096, N_ENDPOINTS, dtype=np.int32))
+    ep_record = jnp.ones(N_ENDPOINTS, dtype=bool)
+    src = jnp.asarray(rng.integers(0, N_ENDPOINTS, GRAPH_EDGES, dtype=np.int32))
+    dst = jnp.asarray(rng.integers(0, N_ENDPOINTS, GRAPH_EDGES, dtype=np.int32))
+    dist = jnp.asarray(rng.integers(1, MAX_DEPTH, GRAPH_EDGES, dtype=np.int32))
+    emask = jnp.ones(GRAPH_EDGES, dtype=bool)
+    req_count = jnp.asarray(rng.gamma(2.0, 100.0, N_SERVICES).astype(np.float32))
+    err_count = req_count * 0.01
+    cv_w = req_count * 0.5
+    replicas = jnp.ones(N_SERVICES, dtype=jnp.float32)
+    active = jnp.ones(N_SERVICES, dtype=bool)
+
+    def graph_refresh():
+        s = scorers.service_scores(
+            src, dst, dist, emask, ep_service, ep_ml, ep_record,
+            num_services=N_SERVICES,
+        )
+        coh = scorers.usage_cohesion(
+            src, dst, dist, emask, ep_service, ep_record,
+            num_services=N_SERVICES,
+        )
+        risk = scorers.risk_scores(
+            s.relying_factor, s.acs, replicas, req_count, err_count, cv_w, active
+        )
+        return s.instability, coh.usage_cohesion, risk.norm_risk
+
+    out = graph_refresh()
+    jax.block_until_ready(out)
+
+    times = []
+    for _ in range(30):
+        t0 = time.perf_counter()
+        out = graph_refresh()
+        jax.block_until_ready(out)  # gate on every output, not just risk
+        times.append(time.perf_counter() - t0)
+    p50_refresh_ms = float(np.percentile(times, 50) * 1000)
+
+    result = {
+        "metric": "span ingest throughput (window stats + dependency edges, 1M-span window)",
+        "value": round(spans_per_sec, 0),
+        "unit": "spans/sec",
+        "vs_baseline": round(spans_per_sec / BASELINE_SPANS_PER_SEC, 3),
+        "p50_graph_refresh_ms_10k_endpoints": round(p50_refresh_ms, 2),
+        "graph_refresh_target_ms": 50.0,
+        "n_spans": N_SPANS,
+        "n_endpoints": N_ENDPOINTS,
+        "n_services": N_SERVICES,
+        "device": str(__import__("jax").devices()[0]),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

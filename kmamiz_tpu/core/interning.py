@@ -53,29 +53,49 @@ class StringInterner:
 class EndpointInterner:
     """Intern tables for the graph's naming hierarchy.
 
-    endpoints (uniqueEndpointName), services (uniqueServiceName), and the
-    endpoint->service mapping as a growable int32 relation.
+    endpoints (uniqueEndpointName), services (uniqueServiceName), the
+    endpoint->service mapping as a growable int32 relation, and optional
+    per-endpoint metadata (TEndpointInfo dicts) kept in lockstep with the
+    endpoint table.
     """
 
     def __init__(self) -> None:
         self.endpoints = StringInterner()
         self.services = StringInterner()
         self._endpoint_service: List[int] = []
+        self._endpoint_infos: List[Optional[dict]] = []
 
-    def intern_endpoint(self, unique_endpoint_name: str) -> int:
+    def intern_endpoint(
+        self, unique_endpoint_name: str, info: Optional[dict] = None
+    ) -> int:
+        """Intern an endpoint name; optionally attach/refresh its metadata
+        (the freshest-timestamp info wins)."""
         eid = self.endpoints.get(unique_endpoint_name)
-        if eid is not None:
-            return eid
-        eid = self.endpoints.intern(unique_endpoint_name)
-        parts = unique_endpoint_name.split("\t")
-        service_name = "\t".join(parts[:3])
-        sid = self.services.intern(service_name)
-        self._endpoint_service.append(sid)
+        if eid is None:
+            eid = self.endpoints.intern(unique_endpoint_name)
+            parts = unique_endpoint_name.split("\t")
+            service_name = "\t".join(parts[:3])
+            sid = self.services.intern(service_name)
+            self._endpoint_service.append(sid)
+            self._endpoint_infos.append(None)
+        if info is not None:
+            existing = self._endpoint_infos[eid]
+            if existing is None or info.get("timestamp", 0) > existing.get(
+                "timestamp", 0
+            ):
+                self._endpoint_infos[eid] = info
         return eid
 
     def service_of(self, endpoint_id: int) -> int:
         return self._endpoint_service[endpoint_id]
 
+    def info_of(self, endpoint_id: int) -> Optional[dict]:
+        return self._endpoint_infos[endpoint_id]
+
     @property
     def endpoint_service_ids(self) -> List[int]:
         return self._endpoint_service
+
+    @property
+    def endpoint_infos(self) -> List[Optional[dict]]:
+        return self._endpoint_infos

@@ -89,6 +89,7 @@ def spans_to_batch(
     interner: Optional[EndpointInterner] = None,
     statuses: Optional[StringInterner] = None,
     pad: bool = True,
+    ts_base_us: Optional[int] = None,
 ) -> SpanBatch:
     """Flatten Zipkin trace groups into a SpanBatch.
 
@@ -121,8 +122,6 @@ def spans_to_batch(
     latency_ms = np.zeros(capacity, dtype=np.float64)
     timestamp_us = np.zeros(capacity, dtype=np.int64)
 
-    endpoint_infos: List[dict] = list(getattr(interner, "_endpoint_infos", []))
-
     for i, span in enumerate(spans):
         valid[i] = True
         k = span.get("kind")
@@ -134,13 +133,7 @@ def spans_to_batch(
             parent_idx[i] = index_of[parent]
 
         info = to_endpoint_info(span)
-        eid = interner.intern_endpoint(info["uniqueEndpointName"])
-        if eid == len(endpoint_infos):
-            endpoint_infos.append(info)
-        else:
-            # keep the freshest timestamp for the endpoint metadata
-            if info["timestamp"] > endpoint_infos[eid]["timestamp"]:
-                endpoint_infos[eid] = info
+        eid = interner.intern_endpoint(info["uniqueEndpointName"], info)
         endpoint_id[i] = eid
         service_id[i] = interner.service_of(eid)
 
@@ -153,31 +146,33 @@ def spans_to_batch(
         rt_uen = (
             f"{rt_usn}\t{_js(tags.get('http.method'))}\t{_js(tags.get('http.url'))}"
         )
-        rt_eid = interner.intern_endpoint(rt_uen)
-        if rt_eid == len(endpoint_infos):
-            # metadata for the rt-space endpoint must carry the rt naming
-            # (istio tags), not the graph-space info
-            endpoint_infos.append(
-                {
-                    **info,
-                    "service": tags.get("istio.canonical_service"),
-                    "namespace": tags.get("istio.namespace"),
-                    "version": tags.get("istio.canonical_revision"),
-                    "uniqueServiceName": rt_usn,
-                    "uniqueEndpointName": rt_uen,
-                }
-            )
+        # metadata for the rt-space endpoint carries the rt naming (istio
+        # tags), not the graph-space info
+        rt_eid = interner.intern_endpoint(
+            rt_uen,
+            {
+                **info,
+                "service": tags.get("istio.canonical_service"),
+                "namespace": tags.get("istio.namespace"),
+                "version": tags.get("istio.canonical_revision"),
+                "uniqueServiceName": rt_usn,
+                "uniqueEndpointName": rt_uen,
+            },
+        )
         rt_endpoint_id[i] = rt_eid
         rt_service_id[i] = interner.service_of(rt_eid)
 
-        status = span.get("tags", {}).get("http.status_code") or ""
+        status = tags.get("http.status_code") or ""
         status_id[i] = statuses.intern(status)
         status_class[i] = int(status[0]) if status[:1].isdigit() else 0
         latency_ms[i] = span.get("duration", 0) / 1000
         timestamp_us[i] = span.get("timestamp", 0)
 
-    interner._endpoint_infos = endpoint_infos  # type: ignore[attr-defined]
-    ts_base = int(timestamp_us[:n].min()) if n else 0
+    endpoint_infos = [i for i in interner.endpoint_infos if i is not None]
+    if ts_base_us is not None:
+        ts_base = ts_base_us
+    else:
+        ts_base = int(timestamp_us[:n].min()) if n else 0
     timestamp_rel = np.zeros(capacity, dtype=np.int32)
     if n:
         span_rel = timestamp_us[:n] - ts_base

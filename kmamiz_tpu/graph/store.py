@@ -73,7 +73,7 @@ class EndpointGraph:
     def _grow(self, needed: int) -> None:
         if needed <= self.capacity:
             return
-        new_cap = _pow2(needed, self.capacity)
+        new_cap = _pow2(needed, minimum=self.capacity)
         pad = jnp.full(new_cap - self.capacity, SENTINEL, dtype=jnp.int32)
         self._src = jnp.concatenate([self._src, pad])
         self._dst = jnp.concatenate([self._dst, pad])
@@ -143,10 +143,22 @@ class EndpointGraph:
         mask = self._src != SENTINEL
         return self._src, self._dst, self._dist, mask
 
+    def invalidate_labels(self) -> None:
+        """Call when the label mapping changes; per-endpoint tables rebuild
+        on the next scorer call."""
+        self._ep_tables_cache = None
+
     def _ep_tables(self, label_of=None):
-        """Padded per-endpoint service/ml/record arrays (+ padded size)."""
+        """Padded per-endpoint service/ml/record arrays (+ padded size).
+
+        Cached between scorer calls — rebuilt only when the intern table or
+        record set grows (or after invalidate_labels)."""
         n_ep = len(self.interner.endpoints)
         self._ensure_ep_arrays(n_ep)
+        cache_key = (n_ep, int(self._ep_record[:n_ep].sum()), label_of is not None)
+        cached = getattr(self, "_ep_tables_cache", None)
+        if cached is not None and cached[0] == cache_key:
+            return cached[1]
         ep_cap = _pow2(max(n_ep, 1))
         ep_service = np.zeros(ep_cap, dtype=np.int32)
         ep_ml = np.zeros(ep_cap, dtype=np.int32)
@@ -159,7 +171,9 @@ class EndpointGraph:
             method = parts[3] if len(parts) > 3 else ""
             label = label_of(name) if label_of else None
             ep_ml[eid] = self.ml_interner.intern(f"{method}\t{label}")
-        return ep_service, ep_ml, ep_record, ep_cap
+        result = (ep_service, ep_ml, ep_record, ep_cap)
+        self._ep_tables_cache = (cache_key, result)
+        return result
 
     # -- scorers -------------------------------------------------------------
 

@@ -1,0 +1,201 @@
+"""GraphSAGE latency/anomaly head over the endpoint-dependency graph.
+
+The accelerator-justifying model from BASELINE.json: a 2-layer
+neighbor-mean GraphSAGE over the capacity-padded edge store
+(kmamiz_tpu.graph.store), with per-endpoint features from the window
+statistics (request rate, 4xx/5xx rates, latency mean/CV, replica count)
+predicting next-window latency (regression) and anomaly probability
+(binary logit). Trains with optax; evaluated on MicroViSim-style fault
+windows (kmamiz_tpu.simulator).
+
+Aggregation uses both edge directions at distance 1 (callers and callees
+are both signal for an endpoint's health) as segment means — the same
+SpMM shape as the scorers, so one compiled program family serves both.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+NUM_FEATURES = 8
+
+
+class SageParams(NamedTuple):
+    w_self_1: jnp.ndarray  # [F, H]
+    w_neigh_1: jnp.ndarray  # [F, H]
+    b_1: jnp.ndarray  # [H]
+    w_self_2: jnp.ndarray  # [H, H]
+    w_neigh_2: jnp.ndarray  # [H, H]
+    b_2: jnp.ndarray  # [H]
+    w_latency: jnp.ndarray  # [H, 1]
+    b_latency: jnp.ndarray  # [1]
+    w_anomaly: jnp.ndarray  # [H, 1]
+    b_anomaly: jnp.ndarray  # [1]
+
+
+def init_params(
+    rng: jax.Array, hidden: int = 64, num_features: int = NUM_FEATURES
+) -> SageParams:
+    k = jax.random.split(rng, 6)
+
+    def glorot(key, shape):
+        scale = jnp.sqrt(2.0 / (shape[0] + shape[1]))
+        return jax.random.normal(key, shape, dtype=jnp.float32) * scale
+
+    return SageParams(
+        w_self_1=glorot(k[0], (num_features, hidden)),
+        w_neigh_1=glorot(k[1], (num_features, hidden)),
+        b_1=jnp.zeros(hidden, dtype=jnp.float32),
+        w_self_2=glorot(k[2], (hidden, hidden)),
+        w_neigh_2=glorot(k[3], (hidden, hidden)),
+        b_2=jnp.zeros(hidden, dtype=jnp.float32),
+        w_latency=glorot(k[4], (hidden, 1)),
+        b_latency=jnp.zeros(1, dtype=jnp.float32),
+        w_anomaly=glorot(k[5], (hidden, 1)),
+        b_anomaly=jnp.zeros(1, dtype=jnp.float32),
+    )
+
+
+def neighbor_mean(
+    h: jnp.ndarray,  # [N, F]
+    src_ep: jnp.ndarray,  # [E]
+    dst_ep: jnp.ndarray,  # [E]
+    edge_mask: jnp.ndarray,  # [E]
+) -> jnp.ndarray:
+    """Mean of neighbor states over both edge directions (segment mean)."""
+    n = h.shape[0]
+    src = jnp.where(edge_mask, src_ep, n)
+    dst = jnp.where(edge_mask, dst_ep, n)
+    dst_h = h[jnp.minimum(dst, n - 1)] * edge_mask[:, None]
+    src_h = h[jnp.minimum(src, n - 1)] * edge_mask[:, None]
+    agg = jax.ops.segment_sum(dst_h, src, num_segments=n + 1)[:-1]
+    agg = agg + jax.ops.segment_sum(src_h, dst, num_segments=n + 1)[:-1]
+    deg = jax.ops.segment_sum(
+        edge_mask.astype(h.dtype), src, num_segments=n + 1
+    )[:-1]
+    deg = deg + jax.ops.segment_sum(
+        edge_mask.astype(h.dtype), dst, num_segments=n + 1
+    )[:-1]
+    return agg / jnp.maximum(deg, 1.0)[:, None]
+
+
+def forward(
+    params: SageParams,
+    features: jnp.ndarray,  # [N, NUM_FEATURES]
+    src_ep: jnp.ndarray,
+    dst_ep: jnp.ndarray,
+    edge_mask: jnp.ndarray,
+):
+    """Two SAGE layers -> (latency prediction [N], anomaly logits [N])."""
+    agg1 = neighbor_mean(features, src_ep, dst_ep, edge_mask)
+    h1 = jax.nn.relu(
+        features @ params.w_self_1 + agg1 @ params.w_neigh_1 + params.b_1
+    )
+    agg2 = neighbor_mean(h1, src_ep, dst_ep, edge_mask)
+    h2 = jax.nn.relu(h1 @ params.w_self_2 + agg2 @ params.w_neigh_2 + params.b_2)
+    latency = (h2 @ params.w_latency + params.b_latency)[:, 0]
+    anomaly_logit = (h2 @ params.w_anomaly + params.b_anomaly)[:, 0]
+    return latency, anomaly_logit
+
+
+def loss_fn(
+    params: SageParams,
+    features: jnp.ndarray,
+    src_ep: jnp.ndarray,
+    dst_ep: jnp.ndarray,
+    edge_mask: jnp.ndarray,
+    target_latency: jnp.ndarray,  # [N]
+    target_anomaly: jnp.ndarray,  # [N] in {0,1}
+    node_mask: jnp.ndarray,  # [N] valid endpoints
+):
+    pred_latency, anomaly_logit = forward(
+        params, features, src_ep, dst_ep, edge_mask
+    )
+    w = node_mask.astype(jnp.float32)
+    denom = jnp.maximum(w.sum(), 1.0)
+    latency_loss = jnp.sum(w * (pred_latency - target_latency) ** 2) / denom
+    anomaly_loss = (
+        jnp.sum(w * optax.sigmoid_binary_cross_entropy(anomaly_logit, target_anomaly))
+        / denom
+    )
+    return latency_loss + anomaly_loss, (latency_loss, anomaly_loss)
+
+
+def make_optimizer(lr: float = 1e-3):
+    return optax.adamw(lr, weight_decay=1e-4)
+
+
+def make_train_step(optimizer):
+    """Jitted (params, opt_state, batch...) -> (params, opt_state, loss, aux)."""
+
+    @jax.jit
+    def train_step(
+        params: SageParams,
+        opt_state,
+        features,
+        src_ep,
+        dst_ep,
+        edge_mask,
+        target_latency,
+        target_anomaly,
+        node_mask,
+    ):
+        grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+        (loss, aux), grads = grad_fn(
+            params,
+            features,
+            src_ep,
+            dst_ep,
+            edge_mask,
+            target_latency,
+            target_anomaly,
+            node_mask,
+        )
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss, aux
+
+    return train_step
+
+
+def features_from_stats(
+    count: jnp.ndarray,  # [E*S] per-(endpoint,status) counts
+    error_4xx: jnp.ndarray,
+    error_5xx: jnp.ndarray,
+    latency_mean: jnp.ndarray,
+    latency_cv: jnp.ndarray,
+    replicas: jnp.ndarray,  # [N]
+    num_endpoints: int,
+    num_statuses: int,
+    window_seconds: float = 30.0,
+) -> jnp.ndarray:
+    """Fold per-(endpoint,status) window stats into [N, NUM_FEATURES]."""
+    shape = (num_endpoints, num_statuses)
+    c = count.reshape(shape)
+    e4 = error_4xx.reshape(shape)
+    e5 = error_5xx.reshape(shape)
+    lm = latency_mean.reshape(shape)
+    cv = latency_cv.reshape(shape)
+
+    total = c.sum(axis=1)
+    safe = jnp.maximum(total, 1.0)
+    # count-weighted means across status groups
+    mean_latency = (lm * c).sum(axis=1) / safe
+    mean_cv = (cv * c).sum(axis=1) / safe
+    return jnp.stack(
+        [
+            total / window_seconds,  # request rate
+            e4.sum(axis=1) / safe,  # 4xx rate
+            e5.sum(axis=1) / safe,  # 5xx rate
+            mean_latency,
+            mean_cv,
+            replicas[:num_endpoints].astype(jnp.float32),
+            jnp.log1p(total),
+            (total > 0).astype(jnp.float32),
+        ],
+        axis=1,
+    )

@@ -155,6 +155,7 @@ def window_stats(
     ts = jax.ops.segment_max(
         jnp.where(valid_server, timestamp_rel, 0), seg, num_segments=num_segments + 1
     )[:-1]
+    ts = jnp.where(count > 0, ts, 0)  # empty segments: 0, not int32 min
 
     safe_count = jnp.maximum(count, 1)
     mean = lat_sum / safe_count

@@ -1,0 +1,227 @@
+"""Device-mesh sharding of the span-window pipeline.
+
+The scaling axis of this system is spans-per-window and
+endpoints-per-graph (SURVEY.md §5): the reference caps ingestion at 2,500
+traces per 5 s tick because a single Node/Rust process walks every span.
+Here the window is sharded across a `jax.sharding.Mesh`:
+
+- span rows are split over the `spans` axis (the host packs whole traces
+  per shard so parent chains stay shard-local);
+- each device computes its local segment statistics (dense
+  [endpoints x statuses] lanes);
+- a `psum` over ICI merges the partial sums — count/error/latency-sum
+  reductions are associative, and CV recombines exactly via the
+  sum/sum-of-squares form (the same pooled-variance identity the
+  reference applies when merging windows,
+  /root/reference/src/classes/CombinedRealtimeDataList.ts:278-315).
+
+Multi-host later rides the same code: a Mesh spanning hosts puts the
+psum on DCN instead of ICI with no code change.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import List, NamedTuple, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from kmamiz_tpu.core.spans import KIND_SERVER, SpanBatch, spans_to_batch
+from kmamiz_tpu.ops import window as window_ops
+
+
+def make_mesh(n_devices: int = 0, axis: str = "spans") -> Mesh:
+    devices = jax.devices()
+    if n_devices:
+        devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (axis,))
+
+
+class ShardedWindow(NamedTuple):
+    """One window of spans laid out for an n-way mesh.
+
+    Every array is [n_shards * per_shard]; rows are grouped so each shard's
+    parent indices are shard-local (whole traces per shard)."""
+
+    valid: np.ndarray
+    kind: np.ndarray
+    parent_idx: np.ndarray  # local to the shard slice
+    endpoint_id: np.ndarray
+    rt_endpoint_id: np.ndarray
+    status_id: np.ndarray
+    status_class: np.ndarray
+    latency_ms: np.ndarray
+    timestamp_rel: np.ndarray
+    per_shard: int
+    ts_base_us: int
+    batches: List[SpanBatch]
+
+
+def shard_window(
+    trace_groups: Sequence[Sequence[dict]],
+    n_shards: int,
+    interner=None,
+    statuses=None,
+) -> ShardedWindow:
+    """Pack whole trace groups into n_shards per-device batches sharing one
+    intern table, then concatenate to a single global array layout."""
+    from kmamiz_tpu.core.interning import EndpointInterner, StringInterner
+
+    interner = interner or EndpointInterner()
+    statuses = statuses or StringInterner()
+
+    # round-robin whole traces so parent chains never cross shards
+    per_shard_groups: List[List[Sequence[dict]]] = [[] for _ in range(n_shards)]
+    for i, group in enumerate(trace_groups):
+        per_shard_groups[i % n_shards].append(group)
+
+    # one window-wide timestamp base: per-shard rel offsets must be
+    # comparable under the cross-shard pmax merge
+    all_ts = [
+        s.get("timestamp", 0) for g in trace_groups for s in g
+    ]
+    ts_base = min(all_ts) if all_ts else 0
+
+    batches = [
+        spans_to_batch(
+            groups,
+            interner=interner,
+            statuses=statuses,
+            pad=False,
+            ts_base_us=ts_base,
+        )
+        for groups in per_shard_groups
+    ]
+    per_shard = max(max(b.capacity for b in batches), 8)
+
+    def pad_to(arr, fill=0):
+        out = np.full((n_shards, per_shard), fill, dtype=arr[0].dtype)
+        for s, a in enumerate(arr):
+            out[s, : len(a)] = a
+        return out.reshape(-1)
+
+    return ShardedWindow(
+        valid=pad_to([b.valid for b in batches], False),
+        kind=pad_to([b.kind for b in batches]),
+        parent_idx=pad_to([b.parent_idx for b in batches], -1),
+        endpoint_id=pad_to([b.endpoint_id for b in batches]),
+        rt_endpoint_id=pad_to([b.rt_endpoint_id for b in batches]),
+        status_id=pad_to([b.status_id for b in batches]),
+        status_class=pad_to([b.status_class for b in batches]),
+        latency_ms=pad_to([b.latency_ms.astype(np.float32) for b in batches]),
+        timestamp_rel=pad_to([b.timestamp_rel for b in batches]),
+        per_shard=per_shard,
+        ts_base_us=ts_base,
+        batches=batches,
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=("mesh", "num_endpoints", "num_statuses", "axis"),
+)
+def sharded_window_stats(
+    mesh: Mesh,
+    rt_endpoint_id: jnp.ndarray,
+    status_id: jnp.ndarray,
+    status_class: jnp.ndarray,
+    latency_ms: jnp.ndarray,
+    timestamp_rel: jnp.ndarray,
+    valid_server: jnp.ndarray,
+    num_endpoints: int,
+    num_statuses: int,
+    axis: str = "spans",
+) -> window_ops.WindowStats:
+    """Per-shard segment stats + psum merge over the mesh axis.
+
+    Input arrays are sharded on their leading (span) dimension; the output
+    is the fully merged dense per-(endpoint,status) statistics, replicated.
+    """
+    spec = P(axis)
+
+    def local_stats(eid, sid, scl, lat, ts, vs):
+        num_segments = num_endpoints * num_statuses
+        seg = eid * num_statuses + sid
+        seg = jnp.where(vs, seg, num_segments)
+        w = vs.astype(lat.dtype)
+        count = jax.ops.segment_sum(w, seg, num_segments=num_segments + 1)[:-1]
+        e4 = jax.ops.segment_sum(
+            w * (scl == 4), seg, num_segments=num_segments + 1
+        )[:-1]
+        e5 = jax.ops.segment_sum(
+            w * (scl == 5), seg, num_segments=num_segments + 1
+        )[:-1]
+        lat_sum = jax.ops.segment_sum(
+            lat * w, seg, num_segments=num_segments + 1
+        )[:-1]
+        lat_sq = jax.ops.segment_sum(
+            lat * lat * w, seg, num_segments=num_segments + 1
+        )[:-1]
+        ts_max = jax.ops.segment_max(
+            jnp.where(vs, ts, 0), seg, num_segments=num_segments + 1
+        )[:-1]
+        # merge partial sums across the mesh — this is the ICI collective
+        count = jax.lax.psum(count, axis)
+        e4 = jax.lax.psum(e4, axis)
+        e5 = jax.lax.psum(e5, axis)
+        lat_sum = jax.lax.psum(lat_sum, axis)
+        lat_sq = jax.lax.psum(lat_sq, axis)
+        ts_max = jax.lax.pmax(ts_max, axis)
+        return count, e4, e5, lat_sum, lat_sq, ts_max
+
+    count, e4, e5, lat_sum, lat_sq, ts_max = shard_map(
+        local_stats,
+        mesh=mesh,
+        in_specs=(spec, spec, spec, spec, spec, spec),
+        out_specs=(P(), P(), P(), P(), P(), P()),
+    )(rt_endpoint_id, status_id, status_class, latency_ms, timestamp_rel, valid_server)
+
+    safe_count = jnp.maximum(count, 1)
+    mean = lat_sum / safe_count
+    variance = jnp.maximum(lat_sq / safe_count - mean * mean, 0.0)
+    cv = jnp.where(
+        mean != 0, jnp.sqrt(variance) / jnp.maximum(mean, 1e-30), 0.0
+    )
+    return window_ops.WindowStats(
+        count=count,
+        error_4xx=e4,
+        error_5xx=e5,
+        latency_sum=lat_sum,
+        latency_sq_sum=lat_sq,
+        latency_mean=jnp.where(count > 0, mean, 0.0),
+        latency_cv=jnp.where(count > 0, cv, 0.0),
+        latest_timestamp_rel=ts_max,
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=("mesh", "max_depth", "axis"),
+)
+def sharded_dependency_edges(
+    mesh: Mesh,
+    parent_idx: jnp.ndarray,
+    kind: jnp.ndarray,
+    valid: jnp.ndarray,
+    endpoint_id: jnp.ndarray,
+    max_depth: int = 16,
+    axis: str = "spans",
+):
+    """Per-shard ancestor walk (parent chains are shard-local by
+    construction); edges stay sharded on the span axis for downstream
+    sharded dedup/merge."""
+    spec = P(axis)
+
+    def local_edges(p, k, v, e):
+        edges = window_ops.dependency_edges(p, k, v, e, max_depth=max_depth)
+        return edges.ancestor_ep, edges.descendant_ep, edges.distance, edges.mask
+
+    return shard_map(
+        local_edges,
+        mesh=mesh,
+        in_specs=(spec, spec, spec, spec),
+        out_specs=(spec, spec, spec, spec),
+    )(parent_idx, kind, valid, endpoint_id)

@@ -24,9 +24,10 @@ def _deregister_tpu_plugin() -> None:
         from jax._src import xla_bridge as _xb
 
         jax.config.update("jax_platforms", "cpu")
-        for name in list(_xb._backend_factories):
-            if name not in ("cpu",):
-                _xb._backend_factories.pop(name, None)
+        # pop only the tunnel-backed plugin; the stock "tpu" factory must
+        # stay registered so xb.is_known_platform("tpu") keeps working
+        # (optax/checkify register tpu lowerings at import time)
+        _xb._backend_factories.pop("axon", None)
     except Exception:
         pass
 

@@ -1,0 +1,87 @@
+"""Sharded window pipeline over the 8-device CPU mesh must equal the
+single-device pipeline."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kmamiz_tpu.core.spans import KIND_SERVER, spans_to_batch
+from kmamiz_tpu.parallel import mesh as pmesh
+from kmamiz_tpu.ops import window
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    assert len(jax.devices()) >= 8, "conftest must provide 8 virtual devices"
+    return pmesh.make_mesh(8)
+
+
+def test_sharded_stats_match_single_device(bookinfo_traces, mesh8):
+    shards = pmesh.shard_window(bookinfo_traces, 8)
+    num_endpoints = len(shards.batches[0].interner.endpoints)
+    num_statuses = max(len(shards.batches[0].statuses), 1)
+
+    valid_server = shards.valid & (shards.kind == KIND_SERVER)
+    stats = pmesh.sharded_window_stats(
+        mesh8,
+        jnp.asarray(shards.rt_endpoint_id),
+        jnp.asarray(shards.status_id),
+        jnp.asarray(shards.status_class),
+        jnp.asarray(shards.latency_ms),
+        jnp.asarray(shards.timestamp_rel),
+        jnp.asarray(valid_server),
+        num_endpoints=num_endpoints,
+        num_statuses=num_statuses,
+    )
+
+    # single-device reference over the same global arrays
+    single = window.window_stats(
+        jnp.asarray(shards.rt_endpoint_id),
+        jnp.asarray(shards.status_id),
+        jnp.asarray(shards.status_class),
+        jnp.asarray(shards.latency_ms.astype(np.float64)),
+        jnp.asarray(shards.timestamp_rel),
+        jnp.asarray(valid_server),
+        num_endpoints=num_endpoints,
+        num_statuses=num_statuses,
+    )
+    np.testing.assert_array_equal(np.asarray(stats.count), np.asarray(single.count))
+    np.testing.assert_array_equal(
+        np.asarray(stats.error_4xx), np.asarray(single.error_4xx)
+    )
+    np.testing.assert_allclose(
+        np.asarray(stats.latency_mean), np.asarray(single.latency_mean), rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(stats.latency_cv), np.asarray(single.latency_cv), atol=2e-3
+    )
+    assert float(np.asarray(stats.count).sum()) == sum(
+        1 for g in bookinfo_traces for s in g if s["kind"] == "SERVER"
+    )
+
+
+def test_sharded_edges_match_host(bookinfo_traces, mesh8):
+    from kmamiz_tpu.domain.traces import Traces
+
+    shards = pmesh.shard_window(bookinfo_traces, 8)
+    anc, desc, dist, mask = pmesh.sharded_dependency_edges(
+        mesh8,
+        jnp.asarray(shards.parent_idx),
+        jnp.asarray(shards.kind),
+        jnp.asarray(shards.valid),
+        jnp.asarray(shards.endpoint_id),
+    )
+    lookup = shards.batches[0].interner.endpoints.lookup
+    anc, desc, dist, mask = (np.asarray(x) for x in (anc, desc, dist, mask))
+    device_edges = {
+        (lookup(int(d)), lookup(int(a)), int(dd))
+        for a, d, dd in zip(anc[mask], desc[mask], dist[mask])
+    }
+
+    host_edges = set()
+    for d in Traces(bookinfo_traces).to_endpoint_dependencies().to_json():
+        name = d["endpoint"]["uniqueEndpointName"]
+        for b in d["dependingOn"]:
+            # owner is the ancestor; dependingOn targets are descendants
+            host_edges.add((b["endpoint"]["uniqueEndpointName"], name, b["distance"]))
+    assert device_edges == host_edges

@@ -1,0 +1,46 @@
+"""Round-robin partial cache->store sync.
+
+Parity with /root/reference/src/services/DispatchStorage.ts: each dispatch
+tick flushes ONE store-backed cache (alphabetical rotation) so the periodic
+write load is spread out; syncAll() flushes everything at shutdown. The
+boolean-lock + spin-wait of the reference becomes a real threading.Lock.
+"""
+from __future__ import annotations
+
+import threading
+from typing import List
+
+from kmamiz_tpu.server.cache import DataCache
+
+
+class DispatchStorage:
+    def __init__(self, cache: DataCache) -> None:
+        self._cache = cache
+        self._lock = threading.Lock()
+        self._sync_type = 0
+
+    @property
+    def sync_strategies(self) -> List:
+        entries = [
+            (name, c.sync)
+            for name, c in self._cache.get_all().items()
+            if c.sync is not None
+        ]
+        entries.sort(key=lambda e: e[0])
+        return entries
+
+    def sync(self) -> None:
+        """Flush the next cache in rotation (one per dispatch tick)."""
+        strategies = self.sync_strategies
+        if not strategies:
+            return
+        with self._lock:
+            self._sync_type = (self._sync_type + 1) % len(strategies)
+            name, sync_fn = strategies[self._sync_type]
+            sync_fn()
+
+    def sync_all(self) -> None:
+        """Flush every cache (graceful-shutdown path)."""
+        with self._lock:
+            for _, sync_fn in self.sync_strategies:
+                sync_fn()

@@ -1,0 +1,99 @@
+"""HTTP server speaking the external Data Processor protocol.
+
+Drop-in sibling of the reference's Rust service
+(/root/reference/kmamiz_data_processor/src/main.rs:28-79): GET / answers a
+health string, POST / takes a TExternalDataProcessorRequest
+({uniqueId, lookBack, time, existingDep}) and returns a
+TExternalDataProcessorResponse ({uniqueId, combined, dependencies,
+datatype, log}). Point the host app's EXTERNAL_DATA_PROCESSOR at this
+address to run KMamiz with the TPU backend; its worker-fallback behavior
+(ServiceOperator.ts:300-306) is preserved because any non-2xx/connection
+error simply falls back.
+
+Gzip request bodies (Content-Encoding: gzip) are accepted; responses are
+gzip-compressed when the client advertises Accept-Encoding: gzip.
+"""
+from __future__ import annotations
+
+import gzip
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from kmamiz_tpu.server.processor import DataProcessor
+
+logger = logging.getLogger("kmamiz_tpu.dp_server")
+
+
+def make_handler(processor: DataProcessor):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt: str, *args) -> None:  # quiet default logs
+            logger.debug("%s " + fmt, self.address_string(), *args)
+
+        def _send_json(self, status: int, payload: dict) -> None:
+            body = json.dumps(payload).encode()
+            accept = self.headers.get("Accept-Encoding", "")
+            encoded = "gzip" in accept
+            if encoded:
+                body = gzip.compress(body)
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            if encoded:
+                self.send_header("Content-Encoding", "gzip")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self) -> None:  # health check (main.rs:28-31)
+            self._send_json(
+                200, {"status": "UP", "service": "kmamiz-tpu-data-processor"}
+            )
+
+        def do_POST(self) -> None:
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                raw = self.rfile.read(length)
+                if self.headers.get("Content-Encoding") == "gzip":
+                    raw = gzip.decompress(raw)
+                request = json.loads(raw) if raw else {}
+            except (ValueError, OSError) as e:
+                self._send_json(400, {"error": f"bad request: {e}"})
+                return
+            try:
+                response = processor.collect(request)
+            except Exception as e:  # noqa: BLE001 - report, let caller fall back
+                logger.exception("collect failed")
+                self._send_json(500, {"error": str(e)})
+                return
+            self._send_json(200, response)
+
+    return Handler
+
+
+class DataProcessorServer:
+    def __init__(
+        self, processor: DataProcessor, host: str = "0.0.0.0", port: int = 8600
+    ) -> None:
+        self._server = ThreadingHTTPServer((host, port), make_handler(processor))
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="dp-server", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+    def serve_forever(self) -> None:
+        self._server.serve_forever()

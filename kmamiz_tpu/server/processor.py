@@ -1,0 +1,275 @@
+"""The Data Processor pipeline: one realtime tick end to end.
+
+TPU-backend equivalent of the reference's hot path — the Rust service's
+collect_data (/root/reference/kmamiz_data_processor/src/data_processor.rs:75-126)
+and the Node worker (src/services/worker/RealtimeWorkerImpl.ts):
+
+  fetch traces -> dedup vs processed-trace map -> namespaces -> replicas ->
+  envoy logs per pod -> combine logs -> realtime+combined data ->
+  endpoint dependencies (+merge with existing) -> datatypes -> response
+
+The numeric window statistics (counts, error classes, latency mean/CV,
+latest timestamps) run on device via kmamiz_tpu.ops.window over the SoA
+span batch; string-bound work (JSON body merging, schema inference) stays
+on host, grouped per (endpoint, status). Every window also feeds the
+persistent device edge store (kmamiz_tpu.graph.store) that serves the
+graph scorers.
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from kmamiz_tpu.core.envoy import EnvoyLogs
+from kmamiz_tpu.core.spans import KIND_SERVER, SpanBatch, spans_to_batch
+from kmamiz_tpu.core.timeutils import to_precise
+from kmamiz_tpu.domain.endpoint_dependencies import EndpointDependencies
+from kmamiz_tpu.domain.realtime import RealtimeDataList, parse_request_response_body
+from kmamiz_tpu.domain.traces import Traces
+from kmamiz_tpu.graph.store import EndpointGraph
+from kmamiz_tpu.ops import window as window_ops
+
+PROCESSED_TRACE_TTL_MS = 300_000  # Rust DP keeps the dedup map for 5 min
+ZIPKIN_LIMIT = 2_500
+
+
+class DataProcessor:
+    """One instance per DP service; holds the processed-trace dedup map and
+    the persistent device graph."""
+
+    def __init__(
+        self,
+        trace_source: Callable[[int, int, int], List[List[dict]]],
+        k8s_source: Optional[object] = None,
+        use_device_stats: bool = True,
+        now_ms: Callable[[], float] = lambda: time.time() * 1000,
+    ) -> None:
+        self._trace_source = trace_source
+        self._k8s = k8s_source
+        self._use_device_stats = use_device_stats
+        self._now_ms = now_ms
+        self._processed: Dict[str, float] = {}
+        self.graph = EndpointGraph()
+
+    # -- trace dedup (data_processor.rs:30-73) -------------------------------
+
+    def _filter_traces(self, traces: List[List[dict]], request_time: float):
+        kept = []
+        for group in traces:
+            if not group:
+                continue
+            trace_id = group[0].get("traceId")
+            if trace_id in self._processed:
+                continue
+            self._processed[trace_id] = request_time
+            kept.append(group)
+        # TTL cleanup
+        cutoff = request_time - PROCESSED_TRACE_TTL_MS
+        self._processed = {
+            k: v for k, v in self._processed.items() if v >= cutoff
+        }
+        return kept
+
+    # -- the tick ------------------------------------------------------------
+
+    def collect(self, request: dict) -> dict:
+        """TExternalDataProcessorRequest -> TExternalDataProcessorResponse."""
+        t_start = self._now_ms()
+        look_back = request.get("lookBack", 30_000)
+        req_time = request.get("time", int(t_start))
+        existing_dep = request.get("existingDep")
+
+        trace_groups = self._trace_source(look_back, req_time, ZIPKIN_LIMIT)
+        trace_groups = self._filter_traces(trace_groups, t_start)
+
+        traces = Traces(trace_groups)
+        namespaces = {
+            ns for ns in traces.extract_containing_namespaces() if ns
+        }
+
+        replicas: List[dict] = []
+        structured_logs: List[dict] = []
+        if self._k8s is not None:
+            replicas = self._k8s.get_replicas(namespaces)
+            pod_logs = []
+            for ns in namespaces:
+                for pod in self._k8s.get_pod_names(ns):
+                    pod_logs.append(self._k8s.get_envoy_logs(ns, pod))
+            structured_logs = EnvoyLogs.combine_to_structured_envoy_logs(pod_logs)
+
+        realtime = traces.combine_logs_to_realtime_data(structured_logs, replicas)
+        combined = self._combine(realtime, trace_groups)
+
+        dependencies = traces.to_endpoint_dependencies()
+        if existing_dep:
+            dependencies = dependencies.combine_with(
+                EndpointDependencies(existing_dep)
+            )
+
+        # feed the persistent device graph (serves the scorer/API path)
+        if trace_groups:
+            batch = spans_to_batch(
+                trace_groups, interner=self.graph.interner
+            )
+            self.graph.merge_window(batch)
+
+        datatypes = [
+            d.to_json()
+            for d in combined_list_datatypes(combined)
+        ]
+
+        elapsed = self._now_ms() - t_start
+        return {
+            "uniqueId": request.get("uniqueId", ""),
+            "combined": combined.to_json(),
+            "dependencies": dependencies.to_json(),
+            "datatype": datatypes,
+            "log": (
+                f"processed {sum(len(g) for g in trace_groups)} spans / "
+                f"{len(trace_groups)} traces in {elapsed:.1f}ms "
+                f"(device_stats={self._use_device_stats})"
+            ),
+        }
+
+    # -- hybrid combine: device numeric stats + host body merge --------------
+
+    def _combine(self, realtime: RealtimeDataList, trace_groups) -> "CombinedRealtimeDataList":
+        from kmamiz_tpu.domain.combined import CombinedRealtimeDataList
+
+        if not self._use_device_stats or not trace_groups:
+            return realtime.to_combined_realtime_data()
+
+        records = realtime.to_json()
+        if not records:
+            return CombinedRealtimeDataList([])
+
+        # group records by (uniqueEndpointName, status) for body merging and
+        # base fields; numeric stats come from the device kernel
+        groups: Dict[tuple, List[dict]] = {}
+        for r in records:
+            groups.setdefault((r["uniqueEndpointName"], r["status"]), []).append(r)
+
+        stats = device_window_stats(records)
+        out: List[dict] = []
+        for (uen, status), rows in groups.items():
+            seg_stats = stats[(uen, status)]
+            sample = rows[0]
+
+            request_body = rows[0].get("requestBody")
+            response_body = rows[0].get("responseBody")
+            replica = rows[0].get("replica")
+            for curr in rows[1:]:
+                from kmamiz_tpu.core import schema
+
+                request_body = schema.merge_string_body(
+                    request_body, curr.get("requestBody")
+                )
+                response_body = schema.merge_string_body(
+                    response_body, curr.get("responseBody")
+                )
+                if replica and curr.get("replica"):
+                    replica += curr["replica"]
+
+            parsed = parse_request_response_body(
+                {
+                    "requestBody": request_body,
+                    "requestContentType": sample.get("requestContentType"),
+                    "responseBody": response_body,
+                    "responseContentType": sample.get("responseContentType"),
+                }
+            )
+            out.append(
+                {
+                    "uniqueServiceName": sample["uniqueServiceName"],
+                    "uniqueEndpointName": uen,
+                    "service": sample["service"],
+                    "namespace": sample["namespace"],
+                    "version": sample["version"],
+                    "method": sample["method"],
+                    "status": status,
+                    "combined": seg_stats["count"],
+                    "requestBody": parsed["requestBody"],
+                    "requestSchema": parsed["requestSchema"],
+                    "responseBody": parsed["responseBody"],
+                    "responseSchema": parsed["responseSchema"],
+                    "avgReplica": (replica / len(rows)) if replica else None,
+                    "latestTimestamp": seg_stats["latest_timestamp"],
+                    "latency": {
+                        "mean": to_precise(seg_stats["mean"]),
+                        "cv": to_precise(seg_stats["cv"]),
+                    },
+                    "requestContentType": sample.get("requestContentType"),
+                    "responseContentType": sample.get("responseContentType"),
+                }
+            )
+        return CombinedRealtimeDataList(out)
+
+
+def device_window_stats(records: List[dict]) -> Dict[tuple, dict]:
+    """Run the device segment-stats kernel over realtime records and return
+    per-(endpoint, status) numeric stats keyed for host-side assembly."""
+    from kmamiz_tpu.core.interning import StringInterner
+
+    endpoints = StringInterner()
+    statuses = StringInterner()
+    n = len(records)
+    cap = 8
+    while cap < n:
+        cap *= 2
+
+    eid = np.zeros(cap, dtype=np.int32)
+    sid = np.zeros(cap, dtype=np.int32)
+    scl = np.zeros(cap, dtype=np.int8)
+    lat = np.zeros(cap, dtype=np.float32)
+    ts_abs = np.zeros(n, dtype=np.int64)
+    valid = np.zeros(cap, dtype=bool)
+    for i, r in enumerate(records):
+        eid[i] = endpoints.intern(r["uniqueEndpointName"])
+        sid[i] = statuses.intern(str(r["status"]))
+        s = str(r["status"])
+        scl[i] = int(s[0]) if s[:1].isdigit() else 0
+        lat[i] = r["latency"]
+        ts_abs[i] = r["timestamp"]
+        valid[i] = True
+    ts_base = int(ts_abs.min()) if n else 0
+    ts_rel = np.zeros(cap, dtype=np.int32)
+    ts_rel[:n] = (ts_abs - ts_base).astype(np.int32)
+
+    num_endpoints = max(len(endpoints), 1)
+    num_statuses = max(len(statuses), 1)
+    stats = window_ops.window_stats(
+        jnp.asarray(eid),
+        jnp.asarray(sid),
+        jnp.asarray(scl),
+        jnp.asarray(lat.astype(np.float64)),
+        jnp.asarray(ts_rel),
+        jnp.asarray(valid),
+        num_endpoints=num_endpoints,
+        num_statuses=num_statuses,
+    )
+    count = np.asarray(stats.count)
+    mean = np.asarray(stats.latency_mean)
+    cv = np.asarray(stats.latency_cv)
+    ts = np.asarray(stats.latest_timestamp_rel).astype(np.int64) + ts_base
+
+    out: Dict[tuple, dict] = {}
+    for e in range(len(endpoints)):
+        for s in range(len(statuses)):
+            seg = e * num_statuses + s
+            if count[seg] > 0:
+                out[(endpoints.lookup(e), statuses.lookup(s))] = {
+                    "count": int(count[seg]),
+                    "mean": float(mean[seg]),
+                    "cv": float(cv[seg]),
+                    "latest_timestamp": int(ts[seg]),
+                }
+    return out
+
+
+def combined_list_datatypes(combined) -> list:
+    """Datatype extraction from combined data (the per-window slice of
+    CombinedRealtimeDataList.extractEndpointDataType)."""
+    return combined.extract_endpoint_data_type()

@@ -1,0 +1,183 @@
+"""Pluggable persistence: the reference's MongoOperator behind a Store API.
+
+The reference persists nine Mongoose collections
+(/root/reference/src/services/MongoOperator.ts:6-14). This framework keeps
+the same collection names behind a small document-store interface with two
+backends: in-memory (tests/simulator) and JSON-file-per-collection (the
+default standalone deployment; STORAGE_URI=file://<dir>).
+"""
+from __future__ import annotations
+
+import json
+import threading
+import uuid
+from pathlib import Path
+from typing import Any, Callable, Dict, List, Optional
+
+COLLECTIONS = (
+    "AggregatedData",
+    "HistoricalData",
+    "CombinedRealtimeData",
+    "EndpointDataType",
+    "EndpointDependencies",
+    "UserDefinedLabel",
+    "TaggedInterface",
+    "TaggedSwagger",
+    "TaggedDiffData",
+)
+
+
+class Store:
+    """Minimal document-store interface (find_all / insert_many / save /
+    delete_many / clear)."""
+
+    def find_all(self, collection: str) -> List[dict]:
+        raise NotImplementedError
+
+    def insert_many(self, collection: str, docs: List[dict]) -> List[dict]:
+        raise NotImplementedError
+
+    def save(self, collection: str, doc: dict) -> dict:
+        """Upsert by _id; assigns an _id when missing."""
+        raise NotImplementedError
+
+    def delete_many(self, collection: str, ids: List[str]) -> int:
+        raise NotImplementedError
+
+    def clear_collection(self, collection: str) -> None:
+        raise NotImplementedError
+
+    def clear_database(self) -> None:
+        for c in COLLECTIONS:
+            self.clear_collection(c)
+
+    # -- reference MongoOperator query equivalents --------------------------
+
+    def get_aggregated_data(self, namespace: Optional[str] = None) -> Optional[dict]:
+        docs = self.find_all("AggregatedData")
+        if not docs:
+            return None
+        doc = docs[0]
+        if namespace:
+            doc = {
+                **doc,
+                "services": [
+                    s for s in doc["services"] if s["namespace"] == namespace
+                ],
+            }
+        return doc
+
+    def get_historical_data(
+        self,
+        namespace: Optional[str] = None,
+        time_offset_ms: Optional[float] = None,
+        now_ms: Optional[float] = None,
+    ) -> List[dict]:
+        import time as _time
+
+        docs = self.find_all("HistoricalData")
+        if time_offset_ms is not None:
+            now = now_ms if now_ms is not None else _time.time() * 1000
+            docs = [d for d in docs if now - d["date"] < time_offset_ms]
+        if namespace:
+            docs = [
+                {
+                    **d,
+                    "services": [
+                        s for s in d["services"] if s["namespace"] == namespace
+                    ],
+                }
+                for d in docs
+            ]
+        return docs
+
+
+class MemoryStore(Store):
+    def __init__(self) -> None:
+        self._data: Dict[str, Dict[str, dict]] = {c: {} for c in COLLECTIONS}
+        self._lock = threading.Lock()
+
+    def find_all(self, collection: str) -> List[dict]:
+        with self._lock:
+            return [dict(d) for d in self._data[collection].values()]
+
+    def insert_many(self, collection: str, docs: List[dict]) -> List[dict]:
+        out = []
+        with self._lock:
+            for doc in docs:
+                d = dict(doc)
+                d.setdefault("_id", uuid.uuid4().hex)
+                self._data[collection][d["_id"]] = d
+                out.append(d)
+        return out
+
+    def save(self, collection: str, doc: dict) -> dict:
+        with self._lock:
+            d = dict(doc)
+            d.setdefault("_id", uuid.uuid4().hex)
+            self._data[collection][d["_id"]] = d
+            return d
+
+    def delete_many(self, collection: str, ids: List[str]) -> int:
+        with self._lock:
+            n = 0
+            for i in ids:
+                if self._data[collection].pop(i, None) is not None:
+                    n += 1
+            return n
+
+    def clear_collection(self, collection: str) -> None:
+        with self._lock:
+            self._data[collection] = {}
+
+
+class FileStore(MemoryStore):
+    """JSON-file-per-collection store; writes are flushed synchronously."""
+
+    def __init__(self, directory: str) -> None:
+        super().__init__()
+        self._dir = Path(directory)
+        self._dir.mkdir(parents=True, exist_ok=True)
+        for c in COLLECTIONS:
+            path = self._dir / f"{c}.json"
+            if path.exists():
+                try:
+                    docs = json.loads(path.read_text())
+                    self._data[c] = {d["_id"]: d for d in docs if "_id" in d}
+                except (json.JSONDecodeError, KeyError):
+                    pass
+
+    def _flush(self, collection: str) -> None:
+        path = self._dir / f"{collection}.json"
+        tmp = path.with_suffix(".json.tmp")
+        with self._lock:
+            docs = list(self._data[collection].values())
+        tmp.write_text(json.dumps(docs, ensure_ascii=False))
+        tmp.replace(path)
+
+    def insert_many(self, collection: str, docs: List[dict]) -> List[dict]:
+        out = super().insert_many(collection, docs)
+        self._flush(collection)
+        return out
+
+    def save(self, collection: str, doc: dict) -> dict:
+        out = super().save(collection, doc)
+        self._flush(collection)
+        return out
+
+    def delete_many(self, collection: str, ids: List[str]) -> int:
+        n = super().delete_many(collection, ids)
+        self._flush(collection)
+        return n
+
+    def clear_collection(self, collection: str) -> None:
+        super().clear_collection(collection)
+        self._flush(collection)
+
+
+def store_from_uri(uri: str) -> Store:
+    if uri.startswith("file://"):
+        return FileStore(uri[len("file://"):])
+    if uri in ("memory://", "memory", ""):
+        return MemoryStore()
+    raise ValueError(f"unsupported STORAGE_URI: {uri}")

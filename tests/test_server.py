@@ -1,0 +1,329 @@
+"""Server layer: DP protocol HTTP round-trip, caches, dispatch, storage."""
+import json
+import urllib.request
+
+import pytest
+
+from conftest import load_fixture
+
+from kmamiz_tpu.domain.combined import CombinedRealtimeDataList
+from kmamiz_tpu.domain.endpoint_dependencies import EndpointDependencies
+from kmamiz_tpu.server import cacheables
+from kmamiz_tpu.server.cache import DataCache
+from kmamiz_tpu.server.dispatch import DispatchStorage
+from kmamiz_tpu.server.dp_server import DataProcessorServer
+from kmamiz_tpu.server.processor import DataProcessor
+from kmamiz_tpu.server.storage import MemoryStore, FileStore
+
+
+@pytest.fixture()
+def processor(pdas_traces):
+    return DataProcessor(
+        trace_source=lambda look_back, time, limit: [pdas_traces],
+        k8s_source=None,
+    )
+
+
+class TestDataProcessor:
+    def test_collect_response_shape(self, processor, pdas_traces):
+        response = processor.collect(
+            {"uniqueId": "tick-1", "lookBack": 30000, "time": 1646208339000}
+        )
+        assert response["uniqueId"] == "tick-1"
+        assert len(response["combined"]) == 3  # user-service spans combine
+        assert len(response["dependencies"]) == 4
+        assert response["datatype"]
+        assert "spans" in response["log"]
+        # numeric stats from the device kernel match the host path
+        host = (
+            __import__("kmamiz_tpu.domain.traces", fromlist=["Traces"])
+            .Traces([pdas_traces])
+            .combine_logs_to_realtime_data([])
+            .to_combined_realtime_data()
+            .to_json()
+        )
+        host_by_key = {(r["uniqueEndpointName"], r["status"]): r for r in host}
+        for c in response["combined"]:
+            h = host_by_key[(c["uniqueEndpointName"], c["status"])]
+            assert c["combined"] == h["combined"]
+            assert c["latency"]["mean"] == pytest.approx(
+                h["latency"]["mean"], rel=1e-6
+            )
+            assert c["latestTimestamp"] == h["latestTimestamp"]
+
+    def test_trace_dedup(self, processor):
+        r1 = processor.collect({"uniqueId": "a", "time": 1646208339000})
+        r2 = processor.collect({"uniqueId": "b", "time": 1646208344000})
+        assert len(r1["combined"]) == 3
+        assert r2["combined"] == []  # same traceId filtered on second tick
+
+    def test_existing_dep_merge(self, processor, pdas_endpoint_dependencies):
+        response = processor.collect(
+            {
+                "uniqueId": "c",
+                "time": 1646208339000,
+                "existingDep": pdas_endpoint_dependencies,
+            }
+        )
+        names = {d["endpoint"]["uniqueEndpointName"] for d in response["dependencies"]}
+        fixture_names = {
+            d["endpoint"]["uniqueEndpointName"] for d in pdas_endpoint_dependencies
+        }
+        assert fixture_names <= names
+
+    def test_graph_store_fed(self, processor):
+        processor.collect({"uniqueId": "a", "time": 1646208339000})
+        assert processor.graph.n_edges > 0
+
+
+class TestDPServer:
+    def test_http_round_trip(self, pdas_traces):
+        processor = DataProcessor(
+            trace_source=lambda lb, t, lim: [pdas_traces], k8s_source=None
+        )
+        server = DataProcessorServer(processor, host="127.0.0.1", port=0)
+        server.start()
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            health = json.loads(urllib.request.urlopen(f"{base}/").read())
+            assert health["status"] == "UP"
+
+            req = urllib.request.Request(
+                base,
+                data=json.dumps(
+                    {"uniqueId": "http-1", "lookBack": 30000, "time": 1646208339000}
+                ).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            response = json.loads(urllib.request.urlopen(req).read())
+            assert response["uniqueId"] == "http-1"
+            assert len(response["combined"]) == 3
+        finally:
+            server.stop()
+
+    def test_gzip_round_trip(self, pdas_traces):
+        import gzip
+
+        processor = DataProcessor(
+            trace_source=lambda lb, t, lim: [pdas_traces], k8s_source=None
+        )
+        server = DataProcessorServer(processor, host="127.0.0.1", port=0)
+        server.start()
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            body = gzip.compress(
+                json.dumps({"uniqueId": "gz", "time": 1646208339000}).encode()
+            )
+            req = urllib.request.Request(
+                base,
+                data=body,
+                headers={
+                    "Content-Type": "application/json",
+                    "Content-Encoding": "gzip",
+                    "Accept-Encoding": "gzip",
+                },
+            )
+            raw = urllib.request.urlopen(req)
+            payload = raw.read()
+            if raw.headers.get("Content-Encoding") == "gzip":
+                payload = gzip.decompress(payload)
+            assert json.loads(payload)["uniqueId"] == "gz"
+        finally:
+            server.stop()
+
+    def test_malformed_request(self, pdas_traces):
+        processor = DataProcessor(
+            trace_source=lambda lb, t, lim: [], k8s_source=None
+        )
+        server = DataProcessorServer(processor, host="127.0.0.1", port=0)
+        server.start()
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{server.port}",
+                data=b"this is not json",
+                headers={"Content-Type": "application/json"},
+            )
+            try:
+                urllib.request.urlopen(req)
+                raise AssertionError("expected 400")
+            except urllib.error.HTTPError as e:
+                assert e.code == 400
+        finally:
+            server.stop()
+
+
+class TestCaches:
+    def test_combined_cache_merges(self, pdas_traces):
+        from kmamiz_tpu.domain.traces import Traces
+
+        cache = cacheables.CCombinedRealtimeData()
+        combined = (
+            Traces([pdas_traces])
+            .combine_logs_to_realtime_data([])
+            .to_combined_realtime_data()
+        )
+        cache.set_data(combined)
+        first = cache.get_data().to_json()
+        cache.set_data(combined)
+        second = cache.get_data().to_json()
+        by_key = {
+            (r["uniqueEndpointName"], r["status"]): r["combined"] for r in second
+        }
+        for r in first:
+            assert by_key[(r["uniqueEndpointName"], r["status"])] == r["combined"] * 2
+
+    def test_combined_cache_namespace_filter(self, pdas_traces):
+        from kmamiz_tpu.domain.traces import Traces
+
+        cache = cacheables.CCombinedRealtimeData()
+        cache.set_data(
+            Traces([pdas_traces])
+            .combine_logs_to_realtime_data([])
+            .to_combined_realtime_data()
+        )
+        assert cache.get_data("pdas").to_json()
+        assert cache.get_data("other") .to_json() == []
+
+    def test_dependencies_cache_trims(self, pdas_endpoint_dependencies):
+        cache = cacheables.CEndpointDependencies()
+        cache.set_data(EndpointDependencies(pdas_endpoint_dependencies))
+        data = cache.get_data().to_json()
+        assert data
+
+    def test_label_mapping_fallback(self):
+        cache = cacheables.CLabelMapping()
+        assert cache.get_label("svc\tns\tv\tGET\thttp://svc/api/a") == "/api/a"
+        cache.set_data({"svc\tns\tv\tGET\thttp://svc/api/a": "/api/{}"})
+        assert cache.get_label("svc\tns\tv\tGET\thttp://svc/api/a") == "/api/{}"
+        assert cache.get_endpoints_from_label("/api/{}") == [
+            "svc\tns\tv\tGET\thttp://svc/api/a"
+        ]
+
+    def test_label_mapping_guesses(self):
+        cache = cacheables.CLabelMapping()
+        base = "svc\tns\tv\tGET\t"
+        cache.set_data({f"{base}http://srv/api/a": "/api/{}"})
+        deps = EndpointDependencies(
+            [
+                {
+                    "endpoint": {
+                        "uniqueEndpointName": f"{base}http://srv/api/b",
+                        "namespace": "ns",
+                    },
+                    "dependingBy": [],
+                    "dependingOn": [],
+                    "lastUsageTimestamp": 0,
+                    "isDependedByExternal": True,
+                }
+            ]
+        )
+        cache.set_data(dict(cache.get_data() or {}), None, deps)
+        assert cache.get_label(f"{base}http://srv/api/b") == "/api/{}"
+
+    def test_lookback_window_expiry(self):
+        now = [0.0]
+        cache = cacheables.CLookBackRealtimeData(now_ms=lambda: now[0])
+        cache.set_data({1000: CombinedRealtimeDataList([])})
+        now[0] = 1000 + cacheables.RISK_LOOK_BACK_TIME_MS - 1
+        assert 1000 in cache.get_data()
+        now[0] = 1000 + cacheables.RISK_LOOK_BACK_TIME_MS + 1
+        assert cache.get_data() == {}
+
+    def test_user_defined_labels(self):
+        cache = cacheables.CUserDefinedLabel()
+        label = {
+            "labels": [
+                {
+                    "label": "/api/x",
+                    "uniqueServiceName": "s\tn\tv",
+                    "method": "GET",
+                    "block": False,
+                }
+            ]
+        }
+        cache.add(label)
+        assert len(cache.get_data()["labels"]) == 1
+        cache.delete("/api/x", "s\tn\tv", "GET")
+        assert cache.get_data()["labels"] == []
+
+    def test_tagged_swaggers_dedup(self):
+        cache = cacheables.CTaggedSwaggers()
+        cache.add({"uniqueServiceName": "s", "tag": "v1", "openApiDocument": {}})
+        cache.add({"uniqueServiceName": "s", "tag": "v1", "openApiDocument": {}})
+        assert len(cache.get_data("s", "v1")) == 1
+        cache.delete("s", "v1")
+        assert cache.get_data("s") == []
+
+    def test_simulation_yaml_cap(self):
+        cache = cacheables.CTaggedSimulationYAML()
+        for i in range(60):
+            cache.add({"tag": f"t{i}", "yaml": ""})
+        assert len(cache.get_data()) == 50
+
+
+class TestStorageAndDispatch:
+    def test_file_store_round_trip(self, tmp_path):
+        store = FileStore(str(tmp_path / "data"))
+        docs = store.insert_many("AggregatedData", [{"services": [], "fromDate": 1, "toDate": 2}])
+        reloaded = FileStore(str(tmp_path / "data"))
+        assert reloaded.get_aggregated_data()["fromDate"] == 1
+        reloaded.delete_many("AggregatedData", [docs[0]["_id"]])
+        assert reloaded.get_aggregated_data() is None
+
+    def test_cache_sync_round_trip(self, pdas_traces):
+        from kmamiz_tpu.domain.traces import Traces
+
+        store = MemoryStore()
+        cache = cacheables.CCombinedRealtimeData(store=store)
+        combined = (
+            Traces([pdas_traces])
+            .combine_logs_to_realtime_data([])
+            .to_combined_realtime_data()
+        )
+        cache.set_data(combined)
+        cache.sync()
+        # fresh cache initializes from the store
+        cache2 = cacheables.CCombinedRealtimeData(store=store)
+        cache2.init()
+        assert len(cache2.get_data().to_json()) == len(combined.to_json())
+
+    def test_dispatch_round_robin(self):
+        DataCache.reset_instance()
+        cache = DataCache()
+        store = MemoryStore()
+        synced = []
+
+        class Tracker(cacheables.CCombinedRealtimeData):
+            def __init__(self, name):
+                super().__init__()
+                self._name = name
+                self._set_sync(lambda: synced.append(name))
+
+        cache.register([Tracker("A"), Tracker("B"), Tracker("C")])
+        dispatch = DispatchStorage(cache)
+        for _ in range(3):
+            dispatch.sync()
+        assert sorted(synced) == ["A", "B", "C"]
+        synced.clear()
+        dispatch.sync_all()
+        assert sorted(synced) == ["A", "B", "C"]
+
+    def test_export_import(self):
+        DataCache.reset_instance()
+        cache = DataCache()
+        lm = cacheables.CLabelMapping()
+        lm.set_data({"a\tb\tc\tGET\thttp://x/y": "/y"})
+        lookback = cacheables.CLookBackRealtimeData()
+        cache.register([lm, lookback])
+        exported = cache.export()
+        names = [n for n, _ in exported]
+        assert "LabelMapping" in names
+        assert "LookBackRealtimeData" not in names  # canExport=False
+
+        def factory(name, init):
+            if name == "LabelMapping":
+                return cacheables.CLabelMapping(init)
+            return None
+
+        cache.import_data(exported, factory)
+        assert cache.get("LabelMapping").get_label("a\tb\tc\tGET\thttp://x/y") == "/y"

@@ -61,6 +61,10 @@ class Cacheable:
         data = self._data
         if hasattr(data, "to_json"):
             return data.to_json()
+        if isinstance(data, list):
+            return [
+                d.to_json() if hasattr(d, "to_json") else d for d in data
+            ]
         return data
 
 

@@ -1,0 +1,236 @@
+"""System wiring and startup sequences.
+
+Equivalent of /root/reference/src/services/Initializer.ts: builds the cache
+registry (11 production caches + 2 simulator caches), loads base data from
+the store, refreshes the label map, and registers the three schedules
+(aggregation / realtime / dispatch). `first_time_setup` backfills 30 days of
+traces from Zipkin when the store is empty (Initializer.ts:40-101);
+`force_recreate_endpoint_dependencies` rebuilds the dependency graph from a
+30-day trace pull (Initializer.ts:103-123).
+
+All collaborators are explicit — `AppContext.build()` is the one place the
+object graph is assembled (the reference scatters this across lazy
+singletons).
+"""
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from kmamiz_tpu.config import Settings, settings as default_settings
+from kmamiz_tpu.domain.traces import Traces
+from kmamiz_tpu.server.cache import Cacheable, DataCache
+from kmamiz_tpu.server.cacheables import (
+    CCombinedRealtimeData,
+    CEndpointDataType,
+    CEndpointDependencies,
+    CLabelMapping,
+    CLabeledEndpointDependencies,
+    CLookBackRealtimeData,
+    CReplicas,
+    CSimulatedHistoricalData,
+    CTaggedDiffData,
+    CTaggedInterfaces,
+    CTaggedSimulationYAML,
+    CTaggedSwaggers,
+    CUserDefinedLabel,
+)
+from kmamiz_tpu.server.dispatch import DispatchStorage
+from kmamiz_tpu.server.operator import ServiceOperator
+from kmamiz_tpu.server.scheduler import Scheduler, interval_from_cron
+from kmamiz_tpu.server.service_utils import ServiceUtils
+from kmamiz_tpu.server.storage import Store, store_from_uri
+
+logger = logging.getLogger("kmamiz_tpu.initializer")
+
+
+@dataclass
+class AppContext:
+    """The assembled object graph of one framework instance."""
+
+    settings: Settings
+    store: Store
+    cache: DataCache
+    service_utils: ServiceUtils
+    operator: ServiceOperator
+    dispatch: DispatchStorage
+    scheduler: Scheduler
+    zipkin_client: Optional[object] = None
+    k8s_client: Optional[object] = None
+    processor: Optional[object] = None
+    extra: dict = field(default_factory=dict)
+
+    @classmethod
+    def build(
+        cls,
+        app_settings: Optional[Settings] = None,
+        store: Optional[Store] = None,
+        processor: Optional[object] = None,
+        zipkin_client: Optional[object] = None,
+        k8s_client: Optional[object] = None,
+    ) -> "AppContext":
+        s = app_settings or default_settings
+        st = store if store is not None else store_from_uri(s.storage_uri)
+        cache = DataCache()
+        service_utils = ServiceUtils(cache, st)
+        operator = ServiceOperator(
+            cache,
+            st,
+            service_utils,
+            processor=processor,
+            external_dp_url=s.external_data_processor,
+            k8s_client=k8s_client,
+        )
+        return cls(
+            settings=s,
+            store=st,
+            cache=cache,
+            service_utils=service_utils,
+            operator=operator,
+            dispatch=DispatchStorage(cache),
+            scheduler=Scheduler(),
+            zipkin_client=zipkin_client,
+            k8s_client=k8s_client,
+            processor=processor,
+        )
+
+
+class Initializer:
+    def __init__(self, ctx: AppContext) -> None:
+        self._ctx = ctx
+
+    # -- cache registration (Initializer.ts:125-147) -------------------------
+
+    def make_data_caches(self) -> List[Cacheable]:
+        ctx = self._ctx
+        sim = ctx.settings.simulator_mode
+        store = ctx.store
+        caches: List[Cacheable] = [
+            CLabelMapping(),
+            CEndpointDataType(store=store, simulator_mode=sim),
+            CCombinedRealtimeData(store=store, simulator_mode=sim),
+            CEndpointDependencies(store=store, simulator_mode=sim),
+            CReplicas(
+                fetch_replicas=(
+                    (lambda: ctx.k8s_client.get_replicas_all())
+                    if ctx.k8s_client is not None
+                    else None
+                ),
+                read_only=ctx.settings.read_only_mode,
+            ),
+            CTaggedInterfaces(store=store, simulator_mode=sim),
+            CTaggedSwaggers(store=store, simulator_mode=sim),
+            CTaggedDiffData(store=store, simulator_mode=sim),
+            CLabeledEndpointDependencies(
+                get_label=lambda name: ctx.cache.get("LabelMapping").get_label(name)
+            ),
+            CUserDefinedLabel(store=store, simulator_mode=sim),
+            CLookBackRealtimeData(store=store, simulator_mode=sim),
+        ]
+        if sim:
+            caches.append(CTaggedSimulationYAML())
+            caches.append(CSimulatedHistoricalData())
+        return caches
+
+    def register_data_caches(self) -> None:
+        logger.info("Registering caches.")
+        self._ctx.cache.register(self.make_data_caches())
+
+    # -- startup (Initializer.ts:149-178) ------------------------------------
+
+    def production_server_startup(self) -> None:
+        ctx = self._ctx
+        self.register_data_caches()
+
+        logger.info("Loading data into cache.")
+        ctx.cache.load_base_data()
+        ctx.service_utils.update_label()
+
+        if ctx.settings.read_only_mode:
+            logger.info("Readonly mode enabled, skipping schedule registration.")
+            return
+
+        logger.info("Setting up scheduled tasks.")
+        ctx.scheduler.register(
+            "aggregation",
+            interval_from_cron(ctx.settings.aggregate_interval),
+            ctx.operator.create_historical_and_aggregated_data,
+        )
+        ctx.scheduler.register(
+            "realtime",
+            interval_from_cron(ctx.settings.realtime_interval),
+            ctx.operator.retrieve_realtime_data,
+        )
+        ctx.scheduler.register(
+            "dispatch",
+            interval_from_cron(ctx.settings.dispatch_interval),
+            ctx.dispatch.sync,
+        )
+        ctx.scheduler.start()
+
+    def simulation_server_startup(self) -> None:
+        self.register_data_caches()
+
+    # -- first-time setup (Initializer.ts:40-101) ----------------------------
+
+    def first_time_setup(self) -> None:
+        ctx = self._ctx
+        if ctx.zipkin_client is None:
+            logger.info("No Zipkin client; skipping first-time setup.")
+            return
+
+        now = time.time() * 1000
+        today = int(now - (now % 86_400_000))
+
+        traces = Traces(
+            ctx.zipkin_client.get_trace_list(86_400_000 * 30, today)
+        )
+
+        dependencies = traces.to_endpoint_dependencies().trim()
+        replicas: List[dict] = []
+        if ctx.k8s_client is not None:
+            for ns in ctx.k8s_client.get_namespaces():
+                replicas.extend(ctx.k8s_client.get_replicas_from_pod_list(ns))
+
+        realtime = traces.to_realtime_data(replicas).to_combined_realtime_data()
+        if realtime.to_json():
+            historical = realtime.to_historical_data(
+                dependencies.to_service_dependencies(), replicas
+            )
+            from kmamiz_tpu.domain.aggregated import AggregatedData
+            from kmamiz_tpu.domain.historical import HistoricalData
+
+            aggregated = HistoricalData(
+                {
+                    "date": now,
+                    "services": [s for h in historical for s in h["services"]],
+                }
+            ).to_aggregated_data()
+            ctx.store.save("AggregatedData", AggregatedData(aggregated).to_json())
+            ctx.store.insert_many("HistoricalData", historical)
+
+        today_traces = Traces(
+            ctx.zipkin_client.get_trace_list(int(now - today))
+        )
+        ctx.cache.get("CombinedRealtimeData").set_data(
+            today_traces.to_realtime_data(replicas).to_combined_realtime_data()
+        )
+
+        merged = dependencies.combine_with(today_traces.to_endpoint_dependencies())
+        ctx.cache.get("EndpointDependencies").set_data(merged)
+        ctx.cache.get("LabeledEndpointDependencies").set_data(merged)
+
+    # -- dependency rebuild (Initializer.ts:103-123) -------------------------
+
+    def force_recreate_endpoint_dependencies(self) -> None:
+        ctx = self._ctx
+        if ctx.zipkin_client is None:
+            return
+        traces = Traces(ctx.zipkin_client.get_trace_list(86_400_000 * 30))
+        dependencies = traces.to_endpoint_dependencies().trim()
+        ctx.store.clear_collection("EndpointDependencies")
+        ctx.store.insert_many("EndpointDependencies", dependencies.to_json())
+        ctx.cache.get("EndpointDependencies").set_data(dependencies)
+        ctx.cache.get("LabeledEndpointDependencies").set_data(dependencies)

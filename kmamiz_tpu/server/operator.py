@@ -1,0 +1,323 @@
+"""Orchestrator for the realtime / aggregation schedules.
+
+Equivalent of /root/reference/src/services/ServiceOperator.ts. The
+reference's realtime tick posts a DP request either to the external Rust
+service (HTTP) or a Node worker thread (postMessage); here the primary
+backend is the in-process TPU `DataProcessor` (device dispatch happens on
+the scheduler's job thread so the API server never blocks), with an
+optional external DP URL tried first when configured — preserving the
+reference's fallback semantics (ServiceOperator.ts:300-306) with the roles
+reversed-able via configuration.
+
+Aggregation (ServiceOperator.ts:108-183): combined realtime data rolls up
+into minute-bucketed historical data, risk is re-scored over a merged
+30-minute look-back window, and the running aggregate is combined and
+saved; the realtime cache is then reset.
+"""
+from __future__ import annotations
+
+import gzip
+import json
+import logging
+import random
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Callable, Dict, List, Optional
+
+from kmamiz_tpu.analytics import risk as risk_analyzer
+from kmamiz_tpu.core.urls import get_params_from_url
+from kmamiz_tpu.domain.aggregated import AggregatedData
+from kmamiz_tpu.domain.combined import CombinedRealtimeDataList
+from kmamiz_tpu.domain.endpoint_data_type import EndpointDataType
+from kmamiz_tpu.domain.endpoint_dependencies import EndpointDependencies
+from kmamiz_tpu.domain.historical import HistoricalData
+from kmamiz_tpu.server.cache import DataCache
+from kmamiz_tpu.server.service_utils import ServiceUtils
+from kmamiz_tpu.server.storage import Store
+
+logger = logging.getLogger("kmamiz_tpu.operator")
+
+RISK_LOOK_BACK_TIME_MS = 1_800_000  # ServiceOperator.ts:37
+REALTIME_LOOK_BACK_MS = 30_000  # ServiceOperator.ts:295
+
+
+class ServiceOperator:
+    def __init__(
+        self,
+        cache: DataCache,
+        store: Store,
+        service_utils: ServiceUtils,
+        processor: Optional[object] = None,
+        external_dp_url: str = "",
+        k8s_client: Optional[object] = None,
+        now_ms: Callable[[], float] = lambda: time.time() * 1000,
+    ) -> None:
+        self._cache = cache
+        self._store = store
+        self._service_utils = service_utils
+        self._processor = processor
+        self._external_dp_url = external_dp_url
+        self._k8s = k8s_client
+        self._now_ms = now_ms
+        # per-tick latency bookkeeping (ServiceOperator.ts:43,76-81)
+        self._latency_map: Dict[str, float] = {}
+        # The realtime and aggregation jobs run on separate scheduler
+        # threads (the reference interleaves them on one event loop); this
+        # lock keeps a realtime cache merge from landing between
+        # aggregation's snapshot and its reset, where it would be wiped
+        # and — the trace ids already being marked processed — lost for good.
+        self._cache_update_lock = threading.Lock()
+
+    # -- realtime schedule (ServiceOperator.ts:282-307) ----------------------
+
+    def retrieve_realtime_data(self) -> None:
+        t = self._now_ms()
+        unique_id = f"{random.randrange(16 ** 4):04x}"
+        self._latency_map[unique_id] = t
+        logger.debug("Running realtime schedule [%s]", unique_id)
+
+        existing_dep = self._cache.get("EndpointDependencies").get_data()
+        request = {
+            "lookBack": REALTIME_LOOK_BACK_MS,
+            "uniqueId": unique_id,
+            "time": int(t),
+            "existingDep": existing_dep.to_json() if existing_dep else None,
+        }
+        if self._external_dp_url:
+            try:
+                self.external_retrieve(request)
+                return
+            except Exception:  # noqa: BLE001 - any DP failure falls back
+                logger.debug(
+                    "External data processor failed, fallback to in-process.",
+                    exc_info=True,
+                )
+        self.retrieve(request)
+
+    def retrieve(self, request: dict) -> None:
+        """In-process TPU pipeline — the reference's worker-thread analogue."""
+        if self._processor is None:
+            logger.warning("no in-process DataProcessor configured, tick dropped")
+            return
+        self.post_retrieve(self._processor.collect(request))
+
+    def external_retrieve(self, request: dict) -> None:
+        """HTTP POST to an external DP service (ServiceOperator.ts:253-280)."""
+        body = json.dumps(request).encode()
+        req = urllib.request.Request(
+            self._external_dp_url,
+            data=body,
+            method="POST",
+            headers={
+                "Content-Type": "application/json",
+                "Accept-Encoding": "gzip",
+            },
+        )
+        with urllib.request.urlopen(req, timeout=30) as res:
+            if res.status != 200:
+                raise urllib.error.HTTPError(
+                    self._external_dp_url, res.status, "bad status", res.headers, None
+                )
+            raw = res.read()
+            if res.headers.get("Content-Encoding") == "gzip":
+                raw = gzip.decompress(raw)
+        self.post_retrieve(json.loads(raw))
+
+    def post_retrieve(self, response: dict) -> None:
+        """Merge a DP response into the caches (ServiceOperator.ts:66-89).
+
+        Mirrors externalRetrieve's requestParams re-derivation
+        (ServiceOperator.ts:267-271): the first schema of each datatype gets
+        its query params parsed from the endpoint URL.
+        """
+        log = response.get("log")
+        if log:
+            logger.debug("DP: %s", log)
+
+        unique_id = response.get("uniqueId", "")
+        start = self._latency_map.pop(unique_id, None)
+        if start is not None:
+            logger.debug(
+                "Realtime schedule [%s] done, in %.0fms",
+                unique_id,
+                self._now_ms() - start,
+            )
+
+        datatypes = response.get("datatype", [])
+        for d in datatypes:
+            url = d["uniqueEndpointName"].split("\t")[-1]
+            if d.get("schemas"):
+                d["schemas"][0]["requestParams"] = get_params_from_url(url)
+
+        self.realtime_update_cache(
+            CombinedRealtimeDataList(response.get("combined", [])),
+            EndpointDependencies(response.get("dependencies", [])),
+            [EndpointDataType(d) for d in datatypes],
+        )
+
+    def realtime_update_cache(
+        self,
+        data: CombinedRealtimeDataList,
+        dep: EndpointDependencies,
+        data_types: List[EndpointDataType],
+    ) -> None:
+        """ServiceOperator.ts:309-339."""
+        with self._cache_update_lock:
+            self._realtime_update_cache_locked(data, dep, data_types)
+
+    def _realtime_update_cache_locked(
+        self,
+        data: CombinedRealtimeDataList,
+        dep: EndpointDependencies,
+        data_types: List[EndpointDataType],
+    ) -> None:
+        self._cache.get("CombinedRealtimeData").set_data(data)
+        self._cache.get("EndpointDependencies").set_data(dep)
+
+        if self._k8s is not None:
+            combined = self._cache.get("CombinedRealtimeData").get_data()
+            namespaces = (
+                combined.get_containing_namespaces() if combined else set()
+            )
+            try:
+                self._cache.get("ReplicaCounts").set_data(
+                    self._k8s.get_replicas(namespaces)
+                )
+            except Exception:  # noqa: BLE001 - replica refresh is best-effort
+                logger.debug("replica refresh failed", exc_info=True)
+
+        self._cache.get("EndpointDataType").set_data(data_types)
+        self._service_utils.update_label()
+        self._cache.get("LabeledEndpointDependencies").set_data(dep)
+
+    # -- aggregation schedule (ServiceOperator.ts:108-183) -------------------
+
+    def _get_data_for_aggregate(self):
+        combined = self._cache.get("CombinedRealtimeData").get_data()
+        dependencies = self._cache.get("LabeledEndpointDependencies").get_data()
+        if not combined or not dependencies:
+            logger.warning(
+                "Cannot create AggregatedData from empty cache, "
+                "skipping data aggregation"
+            )
+            return None
+        return combined, dependencies
+
+    def create_historical_and_aggregated_data(
+        self, create_time_ms: Optional[float] = None
+    ) -> None:
+        with self._cache_update_lock:
+            info = self._get_data_for_aggregate()
+            if not info:
+                return
+            combined, dependencies = info
+            create_time = (
+                create_time_ms if create_time_ms is not None else self._now_ms()
+            )
+
+            service_dependencies = dependencies.to_service_dependencies()
+            replicas = self._cache.get("ReplicaCounts").get_data() or []
+            rl_data = combined.adjust_timestamp(create_time)
+
+            historical = self._create_historical_data(
+                create_time, rl_data, service_dependencies, replicas
+            )
+            if not historical:
+                return
+
+            self._combine_and_save_aggregate(historical.to_aggregated_data())
+            self._cache.get("CombinedRealtimeData").reset()
+
+    def _create_historical_data(
+        self,
+        now_ts_ms: float,
+        rl_data: CombinedRealtimeDataList,
+        service_dependencies: List[dict],
+        replicas: List[dict],
+    ) -> Optional[HistoricalData]:
+        buckets = rl_data.to_historical_data(service_dependencies, replicas)
+        if not buckets:
+            return None
+        historical = buckets[0]
+
+        look_back_cache = self._cache.get("LookBackRealtimeData")
+        look_back = look_back_cache.get_data()
+        merged = rl_data
+        for rows in look_back.values():
+            merged = merged.combine_with(rows)
+        look_back_cache.set_data({int(now_ts_ms): rl_data})
+
+        result = HistoricalData(historical).update_risk_value(
+            risk_analyzer.realtime_risk(
+                merged.to_json(), service_dependencies, replicas
+            )
+        )
+        self._store.insert_many("HistoricalData", [result.to_json()])
+        return result
+
+    def _combine_and_save_aggregate(self, aggregated: dict) -> None:
+        prev_raw = self._store.get_aggregated_data()
+        new_agg = AggregatedData(aggregated)
+        if prev_raw:
+            prev = AggregatedData(prev_raw)
+            new_agg = prev.combine(aggregated)
+            if prev_raw.get("_id"):
+                new_agg.to_json()["_id"] = prev_raw["_id"]
+        self._store.save("AggregatedData", new_agg.to_json())
+
+    # -- simulator variants (ServiceOperator.ts:186-245,341-384) -------------
+
+    def create_simulated_historical_and_aggregated_data(self) -> None:
+        with self._cache_update_lock:
+            info = self._get_data_for_aggregate()
+            if not info:
+                return
+            combined, dependencies = info
+            service_dependencies = dependencies.to_service_dependencies()
+            replicas = self._cache.get("ReplicaCounts").get_data() or []
+
+            buckets = combined.to_historical_data(service_dependencies, replicas)
+            if not buckets:
+                return
+            result = HistoricalData(buckets[0]).update_risk_value(
+                risk_analyzer.realtime_risk(
+                    combined.to_json(), service_dependencies, replicas
+                )
+            )
+            self._cache.get("SimulatedHistoricalData").insert_one(result)
+
+            self._combine_and_save_aggregate(result.to_aggregated_data())
+            self._cache.get("CombinedRealtimeData").reset()
+
+    def update_static_simulate_data_to_cache(
+        self,
+        dependencies: List[dict],
+        data_types: List[EndpointDataType],
+        replica_counts: List[dict],
+    ) -> None:
+        dep = EndpointDependencies(dependencies)
+        with self._cache_update_lock:
+            self._cache.get("EndpointDependencies").set_data(dep)
+            self._cache.get("ReplicaCounts").set_data(replica_counts)
+            self._cache.get("EndpointDataType").set_data(data_types)
+            self._service_utils.update_label()
+            self._cache.get("LabeledEndpointDependencies").set_data(dep)
+
+    def update_dynamic_simulate_data(
+        self, realtime_data_map: Dict[str, List[dict]]
+    ) -> None:
+        """Replay per-time-slot combined data in 'day-hour-minute' order
+        (ServiceOperator.ts:363-384)."""
+
+        def slot_key(key: str):
+            day, hour, minute = (int(x) for x in key.split("-"))
+            return (day, hour, minute)
+
+        for _, rows in sorted(realtime_data_map.items(), key=lambda kv: slot_key(kv[0])):
+            if rows:
+                self._cache.get("CombinedRealtimeData").set_data(
+                    CombinedRealtimeDataList(rows)
+                )
+                self.create_simulated_historical_and_aggregated_data()

@@ -1,0 +1,166 @@
+"""Label-map refresh and labeled historical/aggregated retrieval.
+
+Equivalent of /root/reference/src/services/ServiceUtils.ts: user label rules
+are applied first, unknown endpoints are guessed against them, the remaining
+endpoints get speculated labels, and the resulting map labels historical /
+aggregated reads. Gap fill-in (ServiceUtils.ts:140-162) pads missing services
+forward and backward through time so line charts have continuous series.
+
+Unlike the reference's lazy singletons, everything here takes its
+collaborators explicitly (cache registry + store) so tests and the simulator
+can run many isolated instances.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from kmamiz_tpu.analytics.endpoint_utils import (
+    create_endpoint_label_mapping,
+    guess_and_merge_endpoints,
+)
+from kmamiz_tpu.domain.aggregated import AggregatedData
+from kmamiz_tpu.domain.historical import HistoricalData
+from kmamiz_tpu.server.cache import DataCache
+from kmamiz_tpu.server.storage import Store
+
+
+class ServiceUtils:
+    def __init__(
+        self,
+        cache: DataCache,
+        store: Store,
+        now_ms: Optional[object] = None,
+    ) -> None:
+        import time
+
+        self._cache = cache
+        self._store = store
+        self._now_ms = now_ms or (lambda: time.time() * 1000)
+
+    # -- label mapping (ServiceUtils.ts:54-100) ------------------------------
+
+    def update_label(self) -> None:
+        label_mapping = self._cache.get("LabelMapping")
+        data_type = self._cache.get("EndpointDataType")
+        user_defined_label = self._cache.get("UserDefinedLabel")
+        dependencies = self._cache.get("EndpointDependencies")
+        labeled_dependencies = self._cache.get("LabeledEndpointDependencies")
+
+        user_defined = user_defined_label.get_data()
+        data_types = data_type.get_data()
+        if data_types:
+            preprocessed: dict = {}
+            if user_defined:
+                for rule in user_defined.get("labels", []):
+                    if rule.get("block"):
+                        continue
+                    for sample in rule.get("samples", []):
+                        preprocessed[sample] = rule["label"]
+            preprocessed = guess_and_merge_endpoints(
+                [d.to_json()["uniqueEndpointName"] for d in data_types],
+                preprocessed,
+            )
+
+            label_map = create_endpoint_label_mapping(
+                [
+                    d
+                    for d in data_types
+                    if d.to_json()["uniqueEndpointName"] not in preprocessed
+                ]
+            )
+            label_map.update(preprocessed)
+
+            label_mapping.set_data(
+                label_map, user_defined_label.get_data(), dependencies.get_data()
+            )
+
+        dep = dependencies.get_data()
+        if dep:
+            labeled_dependencies.set_data(dep)
+
+    # -- labeled reads with gap fill (ServiceUtils.ts:102-139) ---------------
+
+    def get_realtime_historical_data(
+        self,
+        namespace: Optional[str] = None,
+        not_before_ms: Optional[float] = None,
+    ) -> List[dict]:
+        label_mapping = self._cache.get("LabelMapping")
+        historical = label_mapping.label_historical_data(
+            self._store.get_historical_data(
+                namespace=namespace,
+                not_before_ms=not_before_ms,
+                now_ms=self._now_ms(),
+            )
+        )
+        return self._fill_in_historical_data(historical)
+
+    def get_realtime_aggregated_data(
+        self,
+        namespace: Optional[str] = None,
+        not_before_ms: Optional[float] = None,
+    ) -> Optional[dict]:
+        label_mapping = self._cache.get("LabelMapping")
+
+        aggregated = self._store.get_aggregated_data(namespace)
+        if not not_before_ms:
+            return (
+                label_mapping.label_aggregated_data(aggregated)
+                if aggregated
+                else None
+            )
+
+        historical = self.get_realtime_historical_data(namespace, not_before_ms)
+        if not historical:
+            return AggregatedData(aggregated).to_plain() if aggregated else None
+
+        label_map = label_mapping.get_data()
+        agg_list = [
+            AggregatedData(HistoricalData(h).to_aggregated_data(label_map))
+            for h in historical
+        ]
+        merged = agg_list[0]
+        for nxt in agg_list[1:]:
+            merged = merged.combine(nxt.to_json())
+        return label_mapping.label_aggregated_data(merged.to_json())
+
+    # -- gap fill-in (ServiceUtils.ts:140-188) -------------------------------
+
+    @staticmethod
+    def _fill_in_historical_data(historical: List[dict]) -> List[dict]:
+        def fill_in(to: dict, from_: dict) -> None:
+            have = {s["uniqueServiceName"] for s in to["services"]}
+            to["services"] = to["services"] + [
+                ServiceUtils._clean_historical_service_info(to["date"], s)
+                for s in from_["services"]
+                if s["uniqueServiceName"] not in have
+            ]
+
+        historical.sort(key=lambda h: h["date"])
+        for i in range(1, len(historical)):
+            fill_in(historical[i], historical[i - 1])
+        for i in range(len(historical) - 2, -1, -1):
+            fill_in(historical[i], historical[i + 1])
+        return historical
+
+    @staticmethod
+    def _clean_historical_service_info(date: float, service_info: dict) -> dict:
+        return {
+            **service_info,
+            "date": date,
+            "endpoints": [
+                {
+                    **e,
+                    "latencyCV": 0,
+                    "requests": 0,
+                    "requestErrors": 0,
+                    "serverErrors": 0,
+                }
+                for e in service_info["endpoints"]
+            ],
+            "latencyCV": 0,
+            "requestErrors": 0,
+            "serverErrors": 0,
+            "requests": 0,
+            "risk": 0,
+        }

@@ -8,6 +8,7 @@ default standalone deployment; STORAGE_URI=file://<dir>).
 """
 from __future__ import annotations
 
+import copy
 import json
 import threading
 import uuid
@@ -70,15 +71,21 @@ class Store:
     def get_historical_data(
         self,
         namespace: Optional[str] = None,
-        time_offset_ms: Optional[float] = None,
+        time_offset_ms: Optional[float] = 30 * 86_400_000,
         now_ms: Optional[float] = None,
+        not_before_ms: Optional[float] = None,
     ) -> List[dict]:
+        """Reads default to the reference's 30-day retention window
+        (MongoOperator.ts getHistoricalData timeOffset); pass
+        time_offset_ms=None for an unbounded read."""
         import time as _time
 
         docs = self.find_all("HistoricalData")
         if time_offset_ms is not None:
             now = now_ms if now_ms is not None else _time.time() * 1000
             docs = [d for d in docs if now - d["date"] < time_offset_ms]
+        if not_before_ms is not None:
+            docs = [d for d in docs if d["date"] >= not_before_ms]
         if namespace:
             docs = [
                 {
@@ -93,30 +100,35 @@ class Store:
 
 
 class MemoryStore(Store):
+    """Documents are deep-copied at the store boundary (both directions):
+    callers freely mutate what they read (e.g. label injection into
+    historical reads) and what they wrote, the way Mongo's per-query
+    materialization isolates the reference."""
+
     def __init__(self) -> None:
         self._data: Dict[str, Dict[str, dict]] = {c: {} for c in COLLECTIONS}
         self._lock = threading.Lock()
 
     def find_all(self, collection: str) -> List[dict]:
         with self._lock:
-            return [dict(d) for d in self._data[collection].values()]
+            return copy.deepcopy(list(self._data[collection].values()))
 
     def insert_many(self, collection: str, docs: List[dict]) -> List[dict]:
         out = []
         with self._lock:
             for doc in docs:
-                d = dict(doc)
+                d = copy.deepcopy(doc)
                 d.setdefault("_id", uuid.uuid4().hex)
                 self._data[collection][d["_id"]] = d
-                out.append(d)
+                out.append(copy.deepcopy(d))
         return out
 
     def save(self, collection: str, doc: dict) -> dict:
         with self._lock:
-            d = dict(doc)
+            d = copy.deepcopy(doc)
             d.setdefault("_id", uuid.uuid4().hex)
             self._data[collection][d["_id"]] = d
-            return d
+            return copy.deepcopy(d)
 
     def delete_many(self, collection: str, ids: List[str]) -> int:
         with self._lock:

@@ -1,0 +1,3 @@
+from kmamiz_tpu.api.router import ApiServer, IRequestHandler, Request, Response, Router
+
+__all__ = ["ApiServer", "IRequestHandler", "Request", "Response", "Router"]

@@ -1,0 +1,145 @@
+"""Application assembly + entry point.
+
+Equivalent of /root/reference/index.ts: builds the object graph, picks the
+startup mode (production / simulator / serve-only / read-only), registers
+every REST handler on the router, and tears down gracefully by flushing all
+caches to the store (index.ts:95-113). Run with:
+
+    python -m kmamiz_tpu.api.app
+"""
+from __future__ import annotations
+
+import logging
+import signal
+from typing import Optional
+
+from kmamiz_tpu.api.handlers import (
+    AlertHandler,
+    ComparatorHandler,
+    ConfigurationHandler,
+    DataHandler,
+    GraphHandler,
+    HealthHandler,
+    SwaggerHandler,
+)
+from kmamiz_tpu.api.router import ApiServer, Router
+from kmamiz_tpu.config import Settings, settings as default_settings
+from kmamiz_tpu.server.import_export import ImportExportHandler
+from kmamiz_tpu.server.initializer import AppContext, Initializer
+
+logger = logging.getLogger("kmamiz_tpu.app")
+
+
+def build_router(
+    ctx: AppContext,
+    import_export: Optional[ImportExportHandler] = None,
+) -> Router:
+    """Register every handler's routes under /api/v{N} (Routes.ts:20-30)."""
+    router = Router(api_version=ctx.settings.api_version)
+    import_export = import_export or ImportExportHandler(ctx)
+
+    graph = GraphHandler(ctx)
+    data = DataHandler(ctx, import_export)
+    handlers = [
+        data,
+        graph,
+        SwaggerHandler(ctx),
+        AlertHandler(ctx),
+        ComparatorHandler(ctx, graph_handler=graph, data_handler=data),
+        ConfigurationHandler(ctx),
+        HealthHandler(),
+    ]
+    try:  # simulator routes only exist when the simulator package is in use
+        from kmamiz_tpu.simulator.handler import SimulationHandler
+
+        if ctx.settings.simulator_mode:
+            handlers.append(SimulationHandler(ctx))
+    except ImportError:
+        pass
+
+    for h in handlers:
+        router.add_handler(h)
+    for line in router.route_list:
+        logger.debug("route %s", line)
+    return router
+
+
+class Application:
+    """One framework instance: context + router + HTTP server + teardown."""
+
+    def __init__(
+        self,
+        app_settings: Optional[Settings] = None,
+        ctx: Optional[AppContext] = None,
+    ) -> None:
+        self.settings = app_settings or default_settings
+        self.ctx = ctx or AppContext.build(app_settings=self.settings)
+        self.initializer = Initializer(self.ctx)
+        self.import_export = ImportExportHandler(self.ctx)
+        self.router = None
+        self.server: Optional[ApiServer] = None
+
+    def start_up(self) -> None:
+        """Mode switch (index.ts:55-92)."""
+        s = self.settings
+        if s.simulator_mode:
+            logger.info("Starting in simulator mode.")
+            self.initializer.simulation_server_startup()
+        elif s.serve_only:
+            logger.info("Serve-only mode; registering caches without schedules.")
+            self.initializer.register_data_caches()
+        else:
+            aggregated = self.ctx.store.get_aggregated_data()
+            if s.reset_endpoint_dependencies:
+                self.initializer.force_recreate_endpoint_dependencies()
+            self.initializer.production_server_startup()
+            rl_data = self.ctx.cache.get("CombinedRealtimeData").get_data()
+            if aggregated is None and (
+                rl_data is None or not rl_data.to_json()
+            ):
+                logger.info("Database is empty, running first-time setup.")
+                try:  # index.ts:78-84: a failed backfill must not block startup
+                    self.initializer.first_time_setup()
+                except Exception:  # noqa: BLE001
+                    logger.exception("Cannot run first time setup, skipping.")
+        self.router = build_router(self.ctx, self.import_export)
+
+    def listen(self, host: str = "0.0.0.0", port: Optional[int] = None) -> None:
+        assert self.router is not None, "call start_up() first"
+        self.server = ApiServer(
+            self.router, host=host, port=port if port is not None else int(self.settings.port)
+        )
+        self.server.start()
+        logger.info("API server listening on port %s", self.server.port)
+
+    def tear_down(self) -> None:
+        """Graceful exit: stop schedules, flush all caches (index.ts:97-112)."""
+        logger.info("Flushing caches to store before exit.")
+        self.ctx.scheduler.stop()
+        if not self.settings.read_only_mode and not self.settings.serve_only:
+            if self.settings.simulator_mode:
+                # index.ts:101-102: the simulator never keeps data in the store
+                self.ctx.store.clear_database()
+            else:
+                self.ctx.dispatch.sync_all()
+        if self.server:
+            self.server.stop()
+
+
+def main() -> None:
+    logging.basicConfig(level=logging.INFO)
+    app = Application()
+    app.start_up()
+    app.listen()
+
+    def _exit(signum, frame):
+        app.tear_down()
+        raise SystemExit(0)
+
+    signal.signal(signal.SIGTERM, _exit)
+    signal.signal(signal.SIGINT, _exit)
+    signal.pause()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,17 @@
+from kmamiz_tpu.api.handlers.alert import AlertHandler
+from kmamiz_tpu.api.handlers.comparator import ComparatorHandler
+from kmamiz_tpu.api.handlers.configuration import ConfigurationHandler
+from kmamiz_tpu.api.handlers.data import DataHandler
+from kmamiz_tpu.api.handlers.graph import GraphHandler
+from kmamiz_tpu.api.handlers.health import HealthHandler
+from kmamiz_tpu.api.handlers.swagger import SwaggerHandler
+
+__all__ = [
+    "AlertHandler",
+    "ComparatorHandler",
+    "ConfigurationHandler",
+    "DataHandler",
+    "GraphHandler",
+    "HealthHandler",
+    "SwaggerHandler",
+]

@@ -1,0 +1,17 @@
+"""Configuration REST handler (reference src/handler/ConfigurationService.ts)."""
+from __future__ import annotations
+
+from kmamiz_tpu.api.router import IRequestHandler, Request, Response
+from kmamiz_tpu.server.initializer import AppContext
+
+
+class ConfigurationHandler(IRequestHandler):
+    def __init__(self, ctx: AppContext) -> None:
+        super().__init__("configuration")
+        self._ctx = ctx
+        self.add_route("get", "/config", self._config)
+
+    def _config(self, req: Request) -> Response:
+        return Response(
+            payload={"SimulatorMode": self._ctx.settings.simulator_mode}
+        )

@@ -1,0 +1,17 @@
+"""Health REST handler (reference src/handler/HealthService.ts)."""
+from __future__ import annotations
+
+import time
+
+from kmamiz_tpu.api.router import IRequestHandler, Request, Response
+
+
+class HealthHandler(IRequestHandler):
+    def __init__(self) -> None:
+        super().__init__("health")
+        self.add_route("get", "/", self._health)
+
+    def _health(self, req: Request) -> Response:
+        return Response(
+            payload={"status": "UP", "serverTime": int(time.time() * 1000)}
+        )

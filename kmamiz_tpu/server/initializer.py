@@ -74,7 +74,11 @@ class AppContext:
         s = app_settings or default_settings
         st = store if store is not None else store_from_uri(s.storage_uri)
         cache = DataCache()
-        service_utils = ServiceUtils(cache, st)
+        service_utils = ServiceUtils(
+            cache,
+            st,
+            unbounded_reads=s.read_only_mode or s.simulator_mode,
+        )
         operator = ServiceOperator(
             cache,
             st,

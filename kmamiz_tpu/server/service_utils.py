@@ -30,12 +30,16 @@ class ServiceUtils:
         cache: DataCache,
         store: Store,
         now_ms: Optional[object] = None,
+        unbounded_reads: bool = False,
     ) -> None:
         import time
 
         self._cache = cache
         self._store = store
         self._now_ms = now_ms or (lambda: time.time() * 1000)
+        # read-only / simulator modes read without a retention window
+        # (MongoOperator.ts matchMonitorMode $gte new Date(0))
+        self._unbounded_reads = unbounded_reads
 
     # -- label mapping (ServiceUtils.ts:54-100) ------------------------------
 
@@ -83,13 +87,22 @@ class ServiceUtils:
     def get_realtime_historical_data(
         self,
         namespace: Optional[str] = None,
-        not_before_ms: Optional[float] = None,
+        time_offset_ms: Optional[float] = None,
     ) -> List[dict]:
+        """time_offset_ms is the API's notBefore: a look-back DURATION in
+        ms (reference ServiceUtils.ts:102 passes it straight to
+        MongoOperator's timeOffset, default 30 days)."""
+        if self._unbounded_reads:
+            window = None
+        else:
+            window = (
+                time_offset_ms if time_offset_ms is not None else 30 * 86_400_000
+            )
         label_mapping = self._cache.get("LabelMapping")
         historical = label_mapping.label_historical_data(
             self._store.get_historical_data(
                 namespace=namespace,
-                not_before_ms=not_before_ms,
+                time_offset_ms=window,
                 now_ms=self._now_ms(),
             )
         )
@@ -98,19 +111,19 @@ class ServiceUtils:
     def get_realtime_aggregated_data(
         self,
         namespace: Optional[str] = None,
-        not_before_ms: Optional[float] = None,
+        time_offset_ms: Optional[float] = None,
     ) -> Optional[dict]:
         label_mapping = self._cache.get("LabelMapping")
 
         aggregated = self._store.get_aggregated_data(namespace)
-        if not not_before_ms:
+        if not time_offset_ms:
             return (
                 label_mapping.label_aggregated_data(aggregated)
                 if aggregated
                 else None
             )
 
-        historical = self.get_realtime_historical_data(namespace, not_before_ms)
+        historical = self.get_realtime_historical_data(namespace, time_offset_ms)
         if not historical:
             return AggregatedData(aggregated).to_plain() if aggregated else None
 

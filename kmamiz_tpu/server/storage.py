@@ -73,19 +73,21 @@ class Store:
         namespace: Optional[str] = None,
         time_offset_ms: Optional[float] = 30 * 86_400_000,
         now_ms: Optional[float] = None,
-        not_before_ms: Optional[float] = None,
     ) -> List[dict]:
-        """Reads default to the reference's 30-day retention window
-        (MongoOperator.ts getHistoricalData timeOffset); pass
-        time_offset_ms=None for an unbounded read."""
+        """time_offset_ms is a look-back DURATION, defaulting to the
+        reference's 30-day retention window (MongoOperator.ts
+        getHistoricalData timeOffset); pass None for an unbounded read
+        (read-only / simulator modes)."""
         import time as _time
 
+        now = now_ms if now_ms is not None else _time.time() * 1000
         docs = self.find_all("HistoricalData")
         if time_offset_ms is not None:
-            now = now_ms if now_ms is not None else _time.time() * 1000
-            docs = [d for d in docs if now - d["date"] < time_offset_ms]
-        if not_before_ms is not None:
-            docs = [d for d in docs if d["date"] >= not_before_ms]
+            docs = [
+                d
+                for d in docs
+                if now - time_offset_ms <= d["date"] <= now
+            ]
         if namespace:
             docs = [
                 {

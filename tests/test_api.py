@@ -1,0 +1,466 @@
+"""REST API surface: routes, handlers, and HTTP round-trips.
+
+Parity targets: src/handler/*.ts route behaviors over the same PDAS
+fixture data the reference's own tests use.
+"""
+import gzip
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kmamiz_tpu.api.app import Application, build_router
+from kmamiz_tpu.api.router import ApiServer, Router, compile_path
+from kmamiz_tpu.config import Settings
+from kmamiz_tpu.server.initializer import AppContext, Initializer
+from kmamiz_tpu.server.processor import DataProcessor
+from kmamiz_tpu.server.storage import MemoryStore
+
+FIXTURE_NOW_MS = 1646208500000
+
+
+def make_ctx(pdas_traces, simulator_mode=False, testing=False):
+    s = Settings()
+    s.simulator_mode = simulator_mode
+    s.enable_testing_endpoints = testing
+    s.external_data_processor = ""
+    processor = DataProcessor(
+        trace_source=lambda look_back, time, limit: [pdas_traces],
+        k8s_source=None,
+    )
+    ctx = AppContext.build(app_settings=s, store=MemoryStore(), processor=processor)
+    ctx.service_utils._now_ms = lambda: FIXTURE_NOW_MS
+    Initializer(ctx).register_data_caches()
+    return ctx
+
+
+@pytest.fixture()
+def ctx(pdas_traces):
+    c = make_ctx(pdas_traces, testing=True)
+    c.operator.retrieve_realtime_data()
+    c.operator.create_historical_and_aggregated_data(1646208400000)
+    # a second tick so graph caches are warm after the aggregation reset
+    c.processor._processed.clear()
+    c.operator.retrieve_realtime_data()
+    return c
+
+
+@pytest.fixture()
+def router(ctx):
+    return build_router(ctx)
+
+
+def get(router, path):
+    return router.dispatch("GET", path)
+
+
+class TestPathCompile:
+    def test_required_param(self):
+        p = compile_path("/api/v1/graph/requests/:uniqueName")
+        assert p.match("/api/v1/graph/requests/svc%09ns").group("uniqueName")
+        assert not p.match("/api/v1/graph/requests/")
+
+    def test_optional_param(self):
+        p = compile_path("/api/v1/graph/line/:namespace?")
+        assert p.match("/api/v1/graph/line").groupdict()["namespace"] is None
+        assert p.match("/api/v1/graph/line/ns").group("namespace") == "ns"
+
+
+class TestGraphRoutes:
+    def test_endpoint_dependency_graph(self, router):
+        res = get(router, "/api/v1/graph/dependency/endpoint")
+        assert res.status == 200
+        assert res.payload["nodes"] and res.payload["links"]
+        # null root node present (EndpointDependencies.toGraphData)
+        assert any(n["id"] == "null" for n in res.payload["nodes"])
+
+    def test_service_dependency_graph(self, router):
+        res = get(router, "/api/v1/graph/dependency/service")
+        assert res.status == 200
+        for n in res.payload["nodes"]:
+            assert n["id"] == n["group"]
+
+    def test_namespace_filter(self, router):
+        res = get(router, "/api/v1/graph/dependency/endpoint/nonexistent")
+        # namespace with no endpoints -> empty graph, not error
+        assert res.status == 200
+
+    def test_chords(self, router):
+        direct = get(router, "/api/v1/graph/chord/direct")
+        indirect = get(router, "/api/v1/graph/chord/indirect")
+        assert direct.status == 200 and indirect.status == 200
+        assert {"nodes", "links"} <= set(direct.payload)
+
+    def test_line_chart(self, router):
+        res = get(router, "/api/v1/graph/line")
+        assert res.status == 200
+        assert res.payload["dates"] and res.payload["services"]
+        n_services = len(res.payload["services"])
+        for metric in res.payload["metrics"]:
+            assert len(metric) == n_services
+            assert all(len(m) == 6 for m in metric)
+
+    def test_statistics(self, router):
+        res = get(router, "/api/v1/graph/statistics")
+        assert res.status == 200
+        assert res.payload
+        row = res.payload[0]
+        assert {
+            "uniqueServiceName",
+            "name",
+            "latencyMean",
+            "serverErrorRate",
+            "requestErrorsRate",
+        } <= set(row)
+
+    def test_scorers(self, router):
+        cohesion = get(router, "/api/v1/graph/cohesion")
+        instability = get(router, "/api/v1/graph/instability")
+        coupling = get(router, "/api/v1/graph/coupling")
+        assert cohesion.status == instability.status == coupling.status == 200
+        assert {"dataCohesion", "usageCohesion", "totalInterfaceCohesion"} <= set(
+            cohesion.payload[0]
+        )
+        assert {"dependingBy", "dependingOn", "instability"} <= set(
+            instability.payload[0]
+        )
+        assert {"ais", "ads", "acs"} <= set(coupling.payload[0])
+
+    def test_request_chart(self, router, ctx):
+        svc = ctx.cache.get("CombinedRealtimeData").get_data().to_json()[0][
+            "uniqueServiceName"
+        ]
+        res = get(router, f"/api/v1/graph/requests/{svc.replace(chr(9), '%09')}")
+        assert res.status == 200
+        assert res.payload["totalRequestCount"] >= 0
+        assert res.payload["risks"] is not None  # service-level includes risks
+
+
+class TestDataRoutes:
+    def test_aggregate(self, router):
+        res = get(router, "/api/v1/data/aggregate")
+        assert res.status == 200
+        assert res.payload["services"]
+
+    def test_aggregate_filter(self, router):
+        res = get(router, "/api/v1/data/aggregate?filter=user-service")
+        names = {s["uniqueServiceName"] for s in res.payload["services"]}
+        assert all(n.startswith("user-service") for n in names)
+
+    def test_history(self, router):
+        res = get(router, "/api/v1/data/history")
+        assert res.status == 200 and res.payload
+
+    def test_service_display_info(self, router):
+        res = get(router, "/api/v1/data/serviceDisplayInfo")
+        assert res.status == 200
+        assert all("endpointCount" in s for s in res.payload)
+
+    def test_label_map(self, router):
+        res = get(router, "/api/v1/data/label")
+        assert res.status == 200
+        assert isinstance(res.payload, list)
+
+    def test_user_label_crud(self, router, ctx):
+        missing = get(router, "/api/v1/data/label/user")
+        assert missing.status == 404
+
+        label = {
+            "labels": [
+                {
+                    "label": "/custom/{}",
+                    "samples": [],
+                    "uniqueServiceName": "user-service\tpdas\tlatest",
+                    "method": "GET",
+                    "block": False,
+                }
+            ]
+        }
+        created = router.dispatch(
+            "POST", "/api/v1/data/label/user", json.dumps(label).encode()
+        )
+        assert created.status == 201
+        fetched = get(router, "/api/v1/data/label/user")
+        assert fetched.status == 200 and fetched.payload["labels"]
+
+        deleted = router.dispatch(
+            "DELETE",
+            "/api/v1/data/label/user",
+            json.dumps(
+                {
+                    "label": "/custom/{}",
+                    "uniqueServiceName": "user-service\tpdas\tlatest",
+                    "method": "GET",
+                }
+            ).encode(),
+        )
+        assert deleted.status == 204
+
+    def test_interface_crud(self, router):
+        tagged = {
+            "uniqueLabelName": "svc\tns\tv\tGET\t/x",
+            "userLabel": "v1",
+            "requestSchema": "",
+            "responseSchema": "",
+        }
+        assert (
+            router.dispatch(
+                "POST", "/api/v1/data/interface", json.dumps(tagged).encode()
+            ).status
+            == 201
+        )
+        got = get(
+            router,
+            "/api/v1/data/interface?uniqueLabelName=svc%09ns%09v%09GET%09/x",
+        )
+        assert got.status == 200 and len(got.payload) == 1
+        gone = router.dispatch(
+            "DELETE",
+            "/api/v1/data/interface",
+            json.dumps(
+                {"uniqueLabelName": "svc\tns\tv\tGET\t/x", "userLabel": "v1"}
+            ).encode(),
+        )
+        assert gone.status == 204
+
+    def test_datatype_by_label(self, router, ctx):
+        dts = ctx.cache.get("EndpointDataType").get_data()
+        raw = dts[0].to_json()
+        label = ctx.cache.get("LabelMapping").get_label(raw["uniqueEndpointName"])
+        unique_label = f"{raw['uniqueServiceName']}\t{raw['method']}\t{label}"
+        from urllib.parse import quote
+
+        res = get(
+            router,
+            "/api/v1/data/datatype/" + quote(unique_label, safe=""),
+        )
+        assert res.status == 200
+        assert res.payload["labelName"] == label
+
+    def test_sync_and_export(self, router, ctx):
+        assert router.dispatch("POST", "/api/v1/data/sync").status == 200
+        assert ctx.store.find_all("EndpointDependencies")
+        res = get(router, "/api/v1/data/export")
+        assert res.status == 200
+        assert res.content_type == "application/tar+gzip"
+        assert res.raw_body[:2] == b"\x1f\x8b"  # gzip magic
+
+    def test_testing_endpoints(self, router, ctx):
+        export = get(router, "/api/v1/data/export")
+        assert router.dispatch("DELETE", "/api/v1/data/clear").status == 200
+        assert ctx.store.get_aggregated_data() is None
+        assert (
+            router.dispatch(
+                "POST", "/api/v1/data/import", export.raw_body
+            ).status
+            == 201
+        )
+        assert (
+            router.dispatch("POST", "/api/v1/data/aggregate").status == 204
+        )
+
+
+class TestSwaggerRoutes:
+    SVC = "user-service%09pdas%09latest"
+
+    def test_get_swagger(self, router):
+        res = get(router, f"/api/v1/swagger/{self.SVC}")
+        assert res.status == 200
+        assert res.payload["openapi"] == "3.0.1"
+        assert res.payload["paths"]
+
+    def test_get_swagger_yaml(self, router):
+        res = get(router, f"/api/v1/swagger/yaml/{self.SVC}")
+        assert res.status == 200
+        assert res.content_type == "text/yaml"
+        assert b"openapi" in res.raw_body
+
+    def test_tag_lifecycle(self, router, ctx):
+        doc = get(router, f"/api/v1/swagger/{self.SVC}").payload
+        tagged = {
+            "uniqueServiceName": "user-service\tpdas\tlatest",
+            "tag": "v1.0",
+            "openApiDocument": json.dumps(doc),
+        }
+        assert (
+            router.dispatch(
+                "POST", "/api/v1/swagger/tags", json.dumps(tagged).encode()
+            ).status
+            == 200
+        )
+        tags = get(router, f"/api/v1/swagger/tags/{self.SVC}")
+        assert tags.payload == ["v1.0"]
+        # tagging froze interfaces bound to the swagger
+        bound = [
+            i
+            for i in ctx.cache.get("TaggedInterfaces").get_data()
+            if i.get("boundToSwagger")
+        ]
+        assert bound
+        # fetching by tag returns the frozen doc with version = tag
+        frozen = get(router, f"/api/v1/swagger/{self.SVC}?tag=v1.0")
+        assert frozen.payload["info"]["version"] == "v1.0"
+
+        assert (
+            router.dispatch(
+                "DELETE",
+                "/api/v1/swagger/tags",
+                json.dumps(
+                    {
+                        "uniqueServiceName": "user-service\tpdas\tlatest",
+                        "tag": "v1.0",
+                    }
+                ).encode(),
+            ).status
+            == 200
+        )
+        assert get(router, f"/api/v1/swagger/tags/{self.SVC}").payload == []
+        assert not [
+            i
+            for i in ctx.cache.get("TaggedInterfaces").get_data()
+            if i.get("boundToSwagger")
+        ]
+
+
+class TestAlertRoutes:
+    def test_violation_empty(self, router):
+        res = get(router, "/api/v1/alert/violation")
+        assert res.status == 200
+        assert res.payload == []
+
+    def test_violation_detection(self, ctx, router):
+        # fabricate history: stable risk then a 3-sigma spike in the latest bucket
+        svc = "user-service\tpdas\tlatest"
+        docs = []
+        for i, risk in enumerate([0.2] * 20 + [0.9]):
+            docs.append(
+                {
+                    "date": FIXTURE_NOW_MS - (21 - i) * 60_000,
+                    "services": [
+                        {
+                            "uniqueServiceName": svc,
+                            "service": "user-service",
+                            "namespace": "pdas",
+                            "version": "latest",
+                            "date": FIXTURE_NOW_MS - (21 - i) * 60_000,
+                            "requests": 10,
+                            "requestErrors": 0,
+                            "serverErrors": 0,
+                            "latencyCV": 0.1,
+                            "latencyMean": 10,
+                            "risk": risk,
+                            "endpoints": [],
+                        }
+                    ],
+                }
+            )
+        ctx.store.clear_collection("HistoricalData")
+        ctx.store.insert_many("HistoricalData", docs)
+        ctx.cache.get("LookBackRealtimeData")._touch()
+
+        res = get(router, "/api/v1/alert/violation")
+        assert res.status == 200
+        assert len(res.payload) == 1
+        v = res.payload[0]
+        assert v["uniqueServiceName"] == svc
+        assert v["timeoutAt"] > v["occursAt"]
+
+
+class TestComparatorRoutes:
+    def test_diff_lifecycle(self, router):
+        assert get(router, "/api/v1/comparator/tags").payload == []
+        created = router.dispatch(
+            "POST",
+            "/api/v1/comparator/diffData",
+            json.dumps({"tag": "snap1"}).encode(),
+        )
+        assert created.status == 200
+        tags = get(router, "/api/v1/comparator/tags").payload
+        assert [t["tag"] for t in tags] == ["snap1"]
+
+        diff = get(router, "/api/v1/comparator/diffData?tag=snap1")
+        assert diff.payload["graphData"]["nodes"]
+        assert diff.payload["instabilityData"]
+
+        latest = get(router, "/api/v1/comparator/diffData")
+        assert latest.payload["graphData"]["nodes"]
+        assert latest.payload["endpointDataTypesMap"]
+
+        deleted = router.dispatch(
+            "DELETE",
+            "/api/v1/comparator/diffData",
+            json.dumps({"tag": "snap1"}).encode(),
+        )
+        assert deleted.status == 200
+        assert get(router, "/api/v1/comparator/tags").payload == []
+
+
+class TestMiscRoutes:
+    def test_configuration(self, router):
+        res = get(router, "/api/v1/configuration/config")
+        assert res.payload == {"SimulatorMode": False}
+
+    def test_health(self, router):
+        res = get(router, "/api/v1/health")
+        assert res.payload["status"] == "UP"
+
+    def test_unknown_route_404(self, router):
+        assert get(router, "/api/v1/nope").status == 404
+
+    def test_wrong_method_405(self, router):
+        assert router.dispatch("DELETE", "/api/v1/health").status == 405
+
+
+class TestHttpServer:
+    def test_round_trip_with_gzip(self, router):
+        server = ApiServer(router, host="127.0.0.1", port=0)
+        server.start()
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{server.port}/api/v1/graph/dependency/endpoint",
+                headers={"Accept-Encoding": "gzip"},
+            )
+            with urllib.request.urlopen(req, timeout=10) as res:
+                assert res.status == 200
+                assert "max-age=5" in res.headers.get("Cache-Control", "")
+                raw = res.read()
+                if res.headers.get("Content-Encoding") == "gzip":
+                    raw = gzip.decompress(raw)
+                payload = json.loads(raw)
+            assert payload["nodes"]
+            # 404 path
+            try:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{server.port}/api/v1/nope", timeout=10
+                )
+                raised = False
+            except urllib.error.HTTPError as e:
+                raised = e.code == 404
+            assert raised
+        finally:
+            server.stop()
+
+
+class TestApplication:
+    def test_full_startup_and_teardown(self, pdas_traces):
+        s = Settings()
+        s.external_data_processor = ""
+        s.read_only_mode = True  # no scheduler threads in tests
+        s.storage_uri = "memory://"
+        processor = DataProcessor(
+            trace_source=lambda lb, t, lim: [pdas_traces], k8s_source=None
+        )
+        ctx = AppContext.build(
+            app_settings=s, store=MemoryStore(), processor=processor
+        )
+        app = Application(app_settings=s, ctx=ctx)
+        app.start_up()
+        app.listen(host="127.0.0.1", port=0)
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{app.server.port}/api/v1/health", timeout=10
+            ) as res:
+                assert json.loads(res.read())["status"] == "UP"
+        finally:
+            app.tear_down()

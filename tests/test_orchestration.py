@@ -146,7 +146,7 @@ class TestServiceUtils:
         ctx.operator.retrieve_realtime_data()
         ctx.operator.create_historical_and_aggregated_data(1646208400000)
         agg = ctx.service_utils.get_realtime_aggregated_data(
-            not_before_ms=1646208000000
+            time_offset_ms=86_400_000
         )
         assert agg and agg["services"]
 

@@ -72,7 +72,9 @@ class Application:
         app_settings: Optional[Settings] = None,
         ctx: Optional[AppContext] = None,
     ) -> None:
-        self.settings = app_settings or default_settings
+        self.settings = app_settings or (
+            ctx.settings if ctx is not None else default_settings
+        )
         self.ctx = ctx or AppContext.build(app_settings=self.settings)
         self.initializer = Initializer(self.ctx)
         self.import_export = ImportExportHandler(self.ctx)

@@ -57,10 +57,11 @@ class Response:
 
     @staticmethod
     def status_only(code: int) -> "Response":
-        # Express's res.sendStatus: status text as plain-text body
+        # Express's res.sendStatus: status text as plain-text body, except
+        # 204/304 which must not carry one (RFC 7230 §3.3)
         return Response(
             status=code,
-            raw_body=str(code).encode(),
+            raw_body=b"" if code in (204, 304) else str(code).encode(),
             content_type="text/plain",
         )
 
@@ -173,7 +174,9 @@ def make_http_handler(router: Router, cache_max_age: int = 5):
             logger.debug("%s " + fmt, self.address_string(), *args)
 
         def _respond(self, response: Response) -> None:
-            if response.raw_body is not None:
+            if response.status in (204, 304):  # bodyless statuses (RFC 7230)
+                body = b""
+            elif response.raw_body is not None:
                 body = response.raw_body
             else:
                 body = json.dumps(response.payload).encode()

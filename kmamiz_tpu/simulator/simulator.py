@@ -1,0 +1,158 @@
+"""Top-level simulator: YAML config -> full synthetic-system dataset.
+
+Equivalent of /root/reference/src/MicroViSim-simulator/classes/Simulator.ts:
+validates/preprocesses the config, collects sample realtime data + replica
+counts per declared endpoint (so datatypes exist even with zero traffic,
+:149-238), builds the endpoint-dependency records, and — when the config
+declares traffic — runs the load simulation to produce per-time-slot
+combined realtime data.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from kmamiz_tpu.domain.endpoint_data_type import EndpointDataType
+from kmamiz_tpu.domain.endpoint_dependencies import EndpointDependencies
+from kmamiz_tpu.domain.realtime import RealtimeDataList
+from kmamiz_tpu.simulator import dependency_builder, load_handler
+from kmamiz_tpu.simulator.config import SimulationConfigManager
+
+
+@dataclass
+class SimulationResult:
+    validation_error_message: str = ""
+    converting_error_message: str = ""
+    endpoint_dependencies: List[dict] = field(default_factory=list)
+    data_types: List[EndpointDataType] = field(default_factory=list)
+    replica_counts: List[dict] = field(default_factory=list)
+    realtime_data_per_slot: Dict[str, List[dict]] = field(default_factory=dict)
+
+
+class Simulator:
+    def __init__(
+        self, config_manager: Optional[SimulationConfigManager] = None
+    ) -> None:
+        self._config_manager = config_manager or SimulationConfigManager()
+
+    def generate_simulation_data(
+        self,
+        config_yaml: str,
+        simulate_date_ms: float,
+        existing_dependencies: Optional[List[dict]] = None,
+        rng: Optional[np.random.Generator] = None,
+    ) -> SimulationResult:
+        """Simulator.ts:39-127. `existing_dependencies` (if any) are merged
+        into the generated dependency records like the reference merges the
+        EndpointDependencies cache (:135-141)."""
+        error_message, config = self._config_manager.handle_sim_config(config_yaml)
+        if config is None:
+            return SimulationResult(validation_error_message=error_message)
+
+        sample = self.collect_sample_data(config["servicesInfo"], simulate_date_ms)
+
+        dependencies, depend_on_groups = dependency_builder.build_endpoint_dependencies(
+            config, simulate_date_ms
+        )
+
+        realtime_per_slot: Dict[str, List[dict]] = {}
+        load = config.get("loadSimulation")
+        if load and load["endpointMetrics"]:
+            realtime_per_slot = load_handler.generate_combined_realtime_data_map(
+                load,
+                depend_on_groups,
+                sample["replicaCounts"],
+                sample["baseDataMap"],
+                simulate_date_ms,
+                rng=rng,
+            )
+
+        try:
+            combined = RealtimeDataList(
+                sample["sampleRealtimeData"]
+            ).to_combined_realtime_data()
+            data_types = combined.extract_endpoint_data_type()
+            dep = EndpointDependencies(dependencies)
+            if existing_dependencies:
+                dep = EndpointDependencies(existing_dependencies).combine_with(dep)
+            return SimulationResult(
+                endpoint_dependencies=dep.to_json(),
+                data_types=data_types,
+                replica_counts=sample["replicaCounts"],
+                realtime_data_per_slot=realtime_per_slot,
+            )
+        except Exception as err:  # noqa: BLE001 - Simulator.ts:113-126
+            return SimulationResult(
+                converting_error_message=(
+                    "Failed to convert simulationRawData to simulation data:\n "
+                    f"{err}"
+                )
+            )
+
+    @staticmethod
+    def collect_sample_data(
+        services_info: List[dict], simulate_date_ms: float
+    ) -> dict:
+        """Per declared endpoint: replica counts, base realtime-data fields,
+        and one fake realtime row per declared response status so schemas
+        can be inferred without traffic (Simulator.ts:149-238)."""
+        sample_rows: List[dict] = []
+        replica_counts: List[dict] = []
+        base_data_map: Dict[str, dict] = {}
+        seen_services = set()
+
+        for ns in services_info:
+            for svc in ns["services"]:
+                for ver in svc["versions"]:
+                    usn = ver["uniqueServiceName"]
+                    if usn in seen_services:
+                        continue
+                    seen_services.add(usn)
+                    replica_counts.append(
+                        {
+                            "uniqueServiceName": usn,
+                            "service": svc["serviceName"],
+                            "namespace": ns["namespace"],
+                            "version": ver["version"],
+                            "replicas": ver["replica"],
+                        }
+                    )
+                    for ep in ver["endpoints"]:
+                        datatype = ep.get("datatype") or {}
+                        base_data = {
+                            "uniqueServiceName": usn,
+                            "uniqueEndpointName": ep["uniqueEndpointName"],
+                            "method": ep["endpointInfo"]["method"].upper(),
+                            "service": svc["serviceName"],
+                            "namespace": ns["namespace"],
+                            "version": ver["version"],
+                            "requestBody": datatype.get("requestBody"),
+                            "requestContentType": datatype.get("requestContentType"),
+                        }
+                        responses = datatype.get("responses") or []
+                        base_data_map[ep["uniqueEndpointName"]] = {
+                            "baseData": base_data,
+                            "responses": responses,
+                        }
+                        by_status = {}
+                        for resp in responses:
+                            by_status.setdefault(str(resp["status"]), resp)
+                        for status, resp in by_status.items():
+                            sample_rows.append(
+                                {
+                                    **base_data,
+                                    "latency": 0,
+                                    "timestamp": simulate_date_ms * 1000,
+                                    "status": status,
+                                    "responseBody": resp["responseBody"],
+                                    "responseContentType": resp["responseContentType"],
+                                }
+                            )
+
+        return {
+            "sampleRealtimeData": sample_rows,
+            "replicaCounts": replica_counts,
+            "baseDataMap": base_data_map,
+        }

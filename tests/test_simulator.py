@@ -1,0 +1,607 @@
+"""MicroViSim-equivalent simulator tests.
+
+Mirrors the reference's simulator semantics (SURVEY.md §2.8): config
+validation/preprocessing, dependency building, vectorized load propagation,
+fault injection, the overload error model, and the end-to-end YAML ->
+caches pipeline through the REST handler.
+"""
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+from kmamiz_tpu.simulator import (
+    bodies,
+    dependency_builder,
+    faults,
+    load_handler,
+    overload,
+    propagator,
+)
+from kmamiz_tpu.simulator.config import SimulationConfigManager
+from kmamiz_tpu.simulator.simulator import Simulator
+from kmamiz_tpu.simulator.slot_metrics import SlotMetrics, slot_key
+
+
+BASIC_YAML = """
+servicesInfo:
+  - namespace: book
+    services:
+      - serviceName: productpage
+        versions:
+          - version: v1
+            replica: 2
+            endpoints:
+              - endpointId: pp-get
+                endpointInfo: { path: /productpage, method: get }
+                datatype:
+                  requestContentType: ""
+                  requestBody: ""
+                  responses:
+                    - status: 200
+                      responseContentType: application/json
+                      responseBody: '{"title": "x", "pages": 3}'
+                    - status: 500
+                      responseContentType: ""
+                      responseBody: ""
+      - serviceName: reviews
+        versions:
+          - version: v1
+            replica: 1
+            endpoints:
+              - endpointId: rv-get
+                endpointInfo: { path: /reviews, method: get }
+      - serviceName: ratings
+        versions:
+          - version: v1
+            replica: 1
+            endpoints:
+              - endpointId: rt-get
+                endpointInfo: { path: /ratings, method: get }
+endpointDependencies:
+  - endpointId: pp-get
+    isExternal: true
+    dependOn:
+      - endpointId: rv-get
+  - endpointId: rv-get
+    dependOn:
+      - endpointId: rt-get
+"""
+
+LOAD_YAML = BASIC_YAML + """
+loadSimulation:
+  config:
+    simulationDurationInDays: 1
+    overloadErrorRateIncreaseFactor: 3
+  serviceMetrics:
+    - namespace: book
+      services:
+        - serviceName: productpage
+          versions:
+            - version: v1
+              capacityPerReplica: 100
+  endpointMetrics:
+    - endpointId: pp-get
+      delay: { latencyMs: 10, jitterMs: 0 }
+      errorRatePercent: 0
+      expectedExternalDailyRequestCount: 2400
+    - endpointId: rv-get
+      delay: { latencyMs: 5, jitterMs: 0 }
+      errorRatePercent: 0
+    - endpointId: rt-get
+      delay: { latencyMs: 2, jitterMs: 0 }
+      errorRatePercent: 0
+"""
+
+
+def parse(yaml_text: str):
+    error, config = SimulationConfigManager().handle_sim_config(yaml_text)
+    assert error == "", error
+    return config
+
+
+# ---------------------------------------------------------------------------
+# config validation + preprocessing
+# ---------------------------------------------------------------------------
+
+class TestConfig:
+    def test_valid_config_assigns_unique_names(self):
+        config = parse(BASIC_YAML)
+        ver = config["servicesInfo"][0]["services"][0]["versions"][0]
+        assert ver["uniqueServiceName"] == "productpage\tbook\tv1"
+        ep = ver["endpoints"][0]
+        assert ep["uniqueEndpointName"] == (
+            "productpage\tbook\tv1\tGET\t"
+            "http://productpage.book.svc.cluster.local/productpage"
+        )
+        dep = config["endpointDependencies"][0]
+        assert dep["uniqueEndpointName"] == ep["uniqueEndpointName"]
+
+    def test_json_sample_bodies_are_deidentified(self):
+        config = parse(BASIC_YAML)
+        ep = config["servicesInfo"][0]["services"][0]["versions"][0]["endpoints"][0]
+        body = json.loads(ep["datatype"]["responses"][0]["responseBody"])
+        assert body == {"title": "", "pages": 0}
+
+    def test_type_definition_bodies_are_converted(self):
+        ok, processed, _ = bodies.preprocess_json_body(
+            "{ name: string, age: number, tags: string[] }"
+        )
+        assert ok
+        assert json.loads(processed) == {"name": "", "age": 0, "tags": [""]}
+
+    def test_empty_yaml_returns_no_config(self):
+        error, config = SimulationConfigManager().handle_sim_config("  ")
+        assert error == "" and config is None
+
+    def test_duplicate_endpoint_id_rejected(self):
+        bad = BASIC_YAML.replace("rt-get", "rv-get")
+        error, config = SimulationConfigManager().handle_sim_config(bad)
+        assert config is None and "Duplicate" in error
+
+    def test_unknown_dependency_target_rejected(self):
+        bad = BASIC_YAML.replace(
+            "dependOn:\n      - endpointId: rt-get",
+            "dependOn:\n      - endpointId: nope",
+        )
+        error, config = SimulationConfigManager().handle_sim_config(bad)
+        assert config is None and "not defined in servicesInfo" in error
+
+    def test_cycle_rejected(self):
+        bad = BASIC_YAML + """
+  - endpointId: rt-get
+    dependOn:
+      - endpointId: pp-get
+"""
+        error, config = SimulationConfigManager().handle_sim_config(bad)
+        assert config is None and "Cyclic" in error
+
+    def test_oneof_probability_sum_rejected(self):
+        bad = BASIC_YAML.replace(
+            "dependOn:\n      - endpointId: rv-get",
+            "dependOn:\n      - oneOf:\n"
+            "        - { endpointId: rv-get, callProbability: 70 }\n"
+            "        - { endpointId: rt-get, callProbability: 60 }",
+        )
+        error, config = SimulationConfigManager().handle_sim_config(bad)
+        assert config is None and "exceeds 100" in error
+
+    def test_system_generated_field_rejected(self):
+        bad = BASIC_YAML.replace(
+            "- endpointId: rt-get\n                endpointInfo:",
+            "- endpointId: rt-get\n                uniqueEndpointName: hacked\n"
+            "                endpointInfo:",
+        )
+        error, config = SimulationConfigManager().handle_sim_config(bad)
+        assert config is None and "system-generated" in error
+
+    def test_unrecognized_key_rejected(self):
+        error, config = SimulationConfigManager().handle_sim_config(
+            BASIC_YAML + "\nbogusKey: 1\n"
+        )
+        assert config is None and "bogusKey" in error
+
+
+# ---------------------------------------------------------------------------
+# dependency builder
+# ---------------------------------------------------------------------------
+
+class TestDependencyBuilder:
+    def test_bfs_closure_and_external_flag(self):
+        config = parse(BASIC_YAML)
+        records, groups = dependency_builder.build_endpoint_dependencies(
+            config, 1_000.0
+        )
+        by_name = {r["endpoint"]["uniqueEndpointName"]: r for r in records}
+        pp = next(n for n in by_name if "productpage" in n)
+        rv = next(n for n in by_name if "reviews" in n)
+        rt = next(n for n in by_name if "ratings" in n)
+
+        assert by_name[pp]["isDependedByExternal"] is True
+        on = {
+            d["endpoint"]["uniqueEndpointName"]: d["distance"]
+            for d in by_name[pp]["dependingOn"]
+        }
+        assert on == {rv: 1, rt: 2}
+        assert all(d["type"] == "SERVER" for d in by_name[pp]["dependingOn"])
+        by = {
+            d["endpoint"]["uniqueEndpointName"]: d["distance"]
+            for d in by_name[rt]["dependingBy"]
+        }
+        assert by == {rv: 1, pp: 2}
+        assert groups[pp] == [[(rv, 100.0)]]
+
+
+# ---------------------------------------------------------------------------
+# propagator
+# ---------------------------------------------------------------------------
+
+def _chain_setup(error_rates, fallback="failIfAnyDependentFail", replicas=None):
+    """a -> b -> c chain with 100% call probability."""
+    a, b, c = (
+        "svc-a\tns\tv1\tGET\thttp://a/x",
+        "svc-b\tns\tv1\tGET\thttp://b/x",
+        "svc-c\tns\tv1\tGET\thttp://c/x",
+    )
+    groups = {a: [[(b, 100.0)]], b: [[(c, 100.0)]], c: []}
+    metrics = SlotMetrics()
+    metrics.entry_request_counts = {a: 100}
+    metrics.endpoint_error_rate = dict(zip((a, b, c), error_rates))
+    metrics.endpoint_delay = {a: (10.0, 0.0), b: (5.0, 0.0), c: (2.0, 0.0)}
+    metrics.service_replicas = replicas if replicas is not None else {}
+    endpoint_metrics = [
+        {"uniqueEndpointName": n, "fallbackStrategy": fallback} for n in (a, b, c)
+    ]
+    return (a, b, c), groups, metrics, endpoint_metrics
+
+
+class TestPropagator:
+    def test_no_error_chain_propagates_all_requests(self):
+        (a, b, c), groups, metrics, ep_metrics = _chain_setup([0.0, 0.0, 0.0])
+        results = propagator.simulate_propagation(
+            ep_metrics, groups, {"0-0-0": metrics}, True, np.random.default_rng(0)
+        )
+        stats = results["0-0-0"]
+        for name in (a, b, c):
+            assert stats[name]["requestCount"] == 100
+            assert stats[name]["ownErrorCount"] == 0
+            assert stats[name]["downstreamErrorCount"] == 0
+        # critical path latency: a = 10 + 5 + 2 with zero jitter
+        assert stats[a]["latencyStatsByStatus"]["200"]["mean"] == pytest.approx(17.0)
+        assert stats[a]["latencyStatsByStatus"]["200"]["cv"] == pytest.approx(0.0)
+        assert stats[c]["latencyStatsByStatus"]["200"]["mean"] == pytest.approx(2.0)
+
+    def test_leaf_failure_propagates_as_downstream_error(self):
+        (a, b, c), groups, metrics, ep_metrics = _chain_setup([0.0, 0.0, 1.0])
+        results = propagator.simulate_propagation(
+            ep_metrics, groups, {"0-0-0": metrics}, True, np.random.default_rng(0)
+        )
+        stats = results["0-0-0"]
+        assert stats[c]["ownErrorCount"] == 100
+        assert stats[b]["ownErrorCount"] == 0
+        assert stats[b]["downstreamErrorCount"] == 100
+        assert stats[a]["downstreamErrorCount"] == 100
+        # failed requests at a still carry a's latency (own only on failure
+        # path is own+max(child) since a's own call succeeded)
+        assert stats[a]["latencyStatsByStatus"]["500"]["mean"] == pytest.approx(17.0)
+
+    def test_ignore_dependent_fail_shields_upstream(self):
+        (a, b, c), groups, metrics, ep_metrics = _chain_setup(
+            [0.0, 0.0, 1.0], fallback="ignoreDependentFail"
+        )
+        results = propagator.simulate_propagation(
+            ep_metrics, groups, {"0-0-0": metrics}, True, np.random.default_rng(0)
+        )
+        stats = results["0-0-0"]
+        assert stats[a]["downstreamErrorCount"] == 0
+        assert stats[c]["ownErrorCount"] == 100
+
+    def test_fail_if_all_dependents_fail(self):
+        a = "svc-a\tns\tv1\tGET\thttp://a/x"
+        b = "svc-b\tns\tv1\tGET\thttp://b/x"
+        c = "svc-c\tns\tv1\tGET\thttp://c/x"
+        groups = {a: [[(b, 100.0)], [(c, 100.0)]], b: [], c: []}
+        metrics = SlotMetrics()
+        metrics.entry_request_counts = {a: 50}
+        metrics.endpoint_error_rate = {a: 0.0, b: 1.0, c: 0.0}
+        ep_metrics = [
+            {"uniqueEndpointName": a, "fallbackStrategy": "failIfAllDependentFail"},
+            {"uniqueEndpointName": b, "fallbackStrategy": "failIfAnyDependentFail"},
+            {"uniqueEndpointName": c, "fallbackStrategy": "failIfAnyDependentFail"},
+        ]
+        results = propagator.simulate_propagation(
+            ep_metrics, groups, {"0-0-0": metrics}, False, np.random.default_rng(0)
+        )
+        stats = results["0-0-0"]
+        # one of two dependents still succeeds -> a survives
+        assert stats[a]["downstreamErrorCount"] == 0
+        assert stats[b]["ownErrorCount"] == 50
+
+    def test_replica_zero_service_fails_upstream_without_stats(self):
+        (a, b, c), groups, metrics, ep_metrics = _chain_setup(
+            [0.0, 0.0, 0.0], replicas={"svc-c\tns\tv1": 0}
+        )
+        results = propagator.simulate_propagation(
+            ep_metrics, groups, {"0-0-0": metrics}, True, np.random.default_rng(0)
+        )
+        stats = results["0-0-0"]
+        assert c not in stats  # dead endpoints record nothing
+        assert stats[b]["downstreamErrorCount"] == 100
+        assert stats[a]["downstreamErrorCount"] == 100
+
+    def test_oneof_selection_respects_probabilities(self):
+        a = "svc-a\tns\tv1\tGET\thttp://a/x"
+        b = "svc-b\tns\tv1\tGET\thttp://b/x"
+        c = "svc-c\tns\tv1\tGET\thttp://c/x"
+        groups = {a: [[(b, 30.0), (c, 30.0)]], b: [], c: []}
+        metrics = SlotMetrics()
+        metrics.entry_request_counts = {a: 20_000}
+        ep_metrics = [
+            {"uniqueEndpointName": n, "fallbackStrategy": "failIfAnyDependentFail"}
+            for n in (a, b, c)
+        ]
+        results = propagator.simulate_propagation(
+            ep_metrics, groups, {"0-0-0": metrics}, False, np.random.default_rng(0)
+        )
+        stats = results["0-0-0"]
+        assert stats[a]["requestCount"] == 20_000
+        # 30% each, 40% NO_DEPENDENT_CALL
+        assert stats[b]["requestCount"] == pytest.approx(6_000, rel=0.1)
+        assert stats[c]["requestCount"] == pytest.approx(6_000, rel=0.1)
+        assert (
+            stats[b]["requestCount"] + stats[c]["requestCount"] < 20_000
+        )
+
+    def test_diamond_counts_each_request_once(self):
+        a = "svc-a\tns\tv1\tGET\thttp://a/x"
+        b = "svc-b\tns\tv1\tGET\thttp://b/x"
+        c = "svc-c\tns\tv1\tGET\thttp://c/x"
+        d = "svc-d\tns\tv1\tGET\thttp://d/x"
+        groups = {
+            a: [[(b, 100.0)], [(c, 100.0)]],
+            b: [[(d, 100.0)]],
+            c: [[(d, 100.0)]],
+            d: [],
+        }
+        metrics = SlotMetrics()
+        metrics.entry_request_counts = {a: 100}
+        ep_metrics = [
+            {"uniqueEndpointName": n, "fallbackStrategy": "failIfAnyDependentFail"}
+            for n in (a, b, c, d)
+        ]
+        results = propagator.simulate_propagation(
+            ep_metrics, groups, {"0-0-0": metrics}, False, np.random.default_rng(0)
+        )
+        stats = results["0-0-0"]
+        assert stats[d]["requestCount"] == 100  # union, not double-count
+
+    def test_jitter_produces_latency_spread(self):
+        a = "svc-a\tns\tv1\tGET\thttp://a/x"
+        groups = {a: []}
+        metrics = SlotMetrics()
+        metrics.entry_request_counts = {a: 5_000}
+        metrics.endpoint_delay = {a: (100.0, 50.0)}
+        ep_metrics = [
+            {"uniqueEndpointName": a, "fallbackStrategy": "failIfAnyDependentFail"}
+        ]
+        results = propagator.simulate_propagation(
+            ep_metrics, groups, {"0-0-0": metrics}, True, np.random.default_rng(0)
+        )
+        lat = results["0-0-0"][a]["latencyStatsByStatus"]["200"]
+        assert lat["mean"] == pytest.approx(100.0, rel=0.05)
+        assert lat["cv"] > 0.1  # uniform(50,150) -> std ~28.9, cv ~0.29
+
+
+# ---------------------------------------------------------------------------
+# faults + overload
+# ---------------------------------------------------------------------------
+
+class TestFaultsAndOverload:
+    def _load(self, fault):
+        return {
+            "config": {"simulationDurationInDays": 1, "overloadErrorRateIncreaseFactor": 3},
+            "serviceMetrics": [],
+            "endpointMetrics": [],
+            "faultInjection": [fault],
+        }
+
+    def test_latency_fault_applies_in_window(self):
+        ep = "a\tns\tv1\tGET\thttp://a/x"
+        fault = {
+            "type": "increase-latency",
+            "targets": {"services": [], "endpoints": [{"endpointId": "a", "uniqueEndpointName": ep}]},
+            "timePeriods": [
+                {"startTime": {"day": 1, "hour": 2}, "durationHours": 2, "probabilityPercent": 100}
+            ],
+            "increaseLatencyMs": 500.0,
+        }
+        metrics = {slot_key(0, h): SlotMetrics() for h in range(24)}
+        faults.inject_faults(self._load(fault), metrics, np.random.default_rng(0))
+        assert metrics["0-2-0"].get_delay(ep) == (500.0, 0.0)
+        assert metrics["0-3-0"].get_delay(ep) == (500.0, 0.0)
+        assert metrics["0-1-0"].get_delay(ep) == (0.0, 0.0)
+        assert metrics["0-4-0"].get_delay(ep) == (0.0, 0.0)
+
+    def test_reduce_instance_fault(self):
+        svc = "a\tns\tv1"
+        fault = {
+            "type": "reduce-instance",
+            "targets": {
+                "services": [
+                    {"serviceName": "a", "namespace": "ns", "version": "v1", "uniqueServiceName": svc}
+                ],
+                "endpoints": [],
+            },
+            "timePeriods": [
+                {"startTime": {"day": 1, "hour": 0}, "durationHours": 1, "probabilityPercent": 100}
+            ],
+            "reduceCount": 2,
+        }
+        metrics = {slot_key(0, h): SlotMetrics() for h in range(24)}
+        metrics["0-0-0"].service_replicas[svc] = 3
+        faults.inject_faults(self._load(fault), metrics, np.random.default_rng(0))
+        assert metrics["0-0-0"].get_replicas(svc) == 1
+
+    def test_overlapping_windows_union_probability(self):
+        fault = {
+            "type": "increase-latency",
+            "targets": {"services": [], "endpoints": []},
+            "timePeriods": [
+                {"startTime": {"day": 1, "hour": 0}, "durationHours": 3, "probabilityPercent": 80},
+                {"startTime": {"day": 1, "hour": 2}, "durationHours": 2, "probabilityPercent": 60},
+            ],
+            "increaseLatencyMs": 1.0,
+        }
+        probs = faults._fault_probability_per_slot(fault)
+        assert probs["0-0-0"] == pytest.approx(0.8)
+        assert probs["0-2-0"] == pytest.approx(1 - 0.2 * 0.4)
+        assert probs["0-3-0"] == pytest.approx(0.6)
+
+    def test_overload_error_composition(self):
+        # utilization 2x => overload portion 1 - exp(-3)
+        rate = overload.estimate_error_rate_with_overload(
+            request_count_per_second=200,
+            replica_count=1,
+            replica_max_rps=100,
+            base_error_rate=0.1,
+            overload_factor_k=3.0,
+        )
+        expected = 0.1 + 0.9 * (1 - np.exp(-3.0))
+        assert rate == pytest.approx(expected)
+        assert overload.estimate_error_rate_with_overload(50, 1, 100, 0.1, 3.0) == 0.1
+        assert overload.estimate_error_rate_with_overload(50, 0, 100, 0.1, 3.0) == 1.0
+
+    def test_adjust_error_rates_marks_overloaded_service(self):
+        ep = "a\tns\tv1\tGET\thttp://a/x"
+        metrics = SlotMetrics()
+        metrics.endpoint_error_rate = {ep: 0.0}
+        metrics.service_replicas = {"a\tns\tv1": 1}
+        metrics.service_capacity_per_replica = {"a\tns\tv1": 0.01}
+        results = {"0-0-0": {ep: {"requestCount": 3600}}}
+        overload.adjust_error_rates_by_overload(3.0, results, {"0-0-0": metrics})
+        assert metrics.get_error_rate(ep) > 0.9  # 100x overloaded
+
+
+# ---------------------------------------------------------------------------
+# load handler + end-to-end
+# ---------------------------------------------------------------------------
+
+class TestLoadHandler:
+    def test_distribute_daily_request_count_exact_total(self):
+        rng = np.random.default_rng(0)
+        counts = load_handler.distribute_daily_request_count(10_007, 24, rng)
+        assert counts.sum() == 10_007
+        assert (counts >= 0).all()
+        # ±20% weights keep slots within a sane band around 10_007/24 ≈ 417
+        assert counts.min() > 250 and counts.max() < 600
+
+    def test_generate_combined_realtime_data_map(self):
+        config = parse(LOAD_YAML)
+        _, groups = dependency_builder.build_endpoint_dependencies(config, 0.0)
+        sample = Simulator.collect_sample_data(config["servicesInfo"], 0.0)
+        data = load_handler.generate_combined_realtime_data_map(
+            config["loadSimulation"],
+            groups,
+            sample["replicaCounts"],
+            sample["baseDataMap"],
+            simulate_date_ms=0.0,
+            rng=np.random.default_rng(0),
+        )
+        assert len(data) == 24
+        total = sum(
+            row["combined"]
+            for rows in data.values()
+            for row in rows
+            if "productpage" in row["uniqueEndpointName"]
+        )
+        assert total == 2400  # every external request accounted for
+        # all three endpoints see traffic in a populated slot
+        populated = next(rows for rows in data.values() if rows)
+        names = {row["uniqueEndpointName"] for row in populated}
+        assert len(names) == 3
+
+
+class TestSimulatorEndToEnd:
+    def test_generate_simulation_data(self):
+        result = Simulator().generate_simulation_data(
+            LOAD_YAML, 1_700_000_000_000.0, rng=np.random.default_rng(0)
+        )
+        assert result.validation_error_message == ""
+        assert result.converting_error_message == ""
+        assert len(result.endpoint_dependencies) == 3
+        assert len(result.replica_counts) == 3
+        assert result.realtime_data_per_slot
+        # datatype extracted from the declared response schema
+        names = {dt.to_json()["uniqueEndpointName"] for dt in result.data_types}
+        assert any("productpage" in n for n in names)
+        pp_dt = next(
+            dt.to_json()
+            for dt in result.data_types
+            if "productpage" in dt.to_json()["uniqueEndpointName"]
+        )
+        statuses = {s["status"] for s in pp_dt["schemas"]}
+        assert "200" in statuses
+
+    def test_validation_error_reported(self):
+        result = Simulator().generate_simulation_data("nonsense: true", 0.0)
+        assert result.validation_error_message
+        assert result.endpoint_dependencies == []
+
+
+class TestSimulationHandler:
+    def _router(self):
+        from kmamiz_tpu.api.app import build_router
+        from kmamiz_tpu.config import Settings
+        from kmamiz_tpu.server.initializer import AppContext, Initializer
+        from kmamiz_tpu.server.storage import MemoryStore
+
+        s = Settings()
+        s.simulator_mode = True
+        ctx = AppContext.build(app_settings=s, store=MemoryStore())
+        Initializer(ctx).register_data_caches()
+        return ctx, build_router(ctx)
+
+    def test_start_simulation_populates_caches(self):
+        ctx, router = self._router()
+        resp = router.dispatch(
+            "POST", "/api/v1/simulation/startSimulation", LOAD_YAML.encode()
+        )
+        assert resp.status == 201, resp.payload
+        dep = ctx.cache.get("EndpointDependencies").get_data()
+        assert dep is not None and len(dep.to_json()) == 3
+        replicas = ctx.cache.get("ReplicaCounts").get_data()
+        assert len(replicas) == 3
+        hist = ctx.cache.get("SimulatedHistoricalData").get_data()
+        assert hist  # dynamic replay created historical buckets
+        graph = router.dispatch(
+            "GET", "/api/v1/graph/dependency/endpoint/book"
+        )
+        assert graph.status == 200
+        node_names = {n["name"] for n in graph.payload["nodes"]}
+        assert any("productpage" in n for n in node_names)
+
+    def test_invalid_yaml_returns_400(self):
+        _, router = self._router()
+        resp = router.dispatch(
+            "POST", "/api/v1/simulation/startSimulation", b"bogus: true"
+        )
+        assert resp.status == 400
+
+    def test_empty_body_is_skipped(self):
+        _, router = self._router()
+        resp = router.dispatch(
+            "POST", "/api/v1/simulation/startSimulation", b"   "
+        )
+        assert resp.status == 200
+
+    def test_multipart_upload(self):
+        ctx, router = self._router()
+        boundary = b"----testboundary"
+        body = (
+            b"--" + boundary + b"\r\n"
+            b'Content-Disposition: form-data; name="file"; filename="sim.yaml"\r\n'
+            b"Content-Type: application/x-yaml\r\n\r\n"
+            + BASIC_YAML.encode()
+            + b"\r\n--" + boundary + b"--\r\n"
+        )
+        resp = router.dispatch(
+            "POST", "/api/v1/simulation/startSimulation", body
+        )
+        assert resp.status == 201, resp.payload
+
+    def test_generate_static_sim_config_round_trip(self):
+        ctx, router = self._router()
+        resp = router.dispatch(
+            "POST", "/api/v1/simulation/startSimulation", LOAD_YAML.encode()
+        )
+        assert resp.status == 201
+        out = router.dispatch("GET", "/api/v1/simulation/generateStaticSimConfig")
+        assert out.status == 200
+        yaml_str = out.payload["staticYamlStr"]
+        assert "servicesInfo" in yaml_str
+        # the generated YAML must itself be a valid sim config
+        error, config = SimulationConfigManager().handle_sim_config(yaml_str)
+        assert error == "", error
+        assert config is not None

@@ -30,6 +30,36 @@ from kmamiz_tpu.server.initializer import AppContext, Initializer
 logger = logging.getLogger("kmamiz_tpu.app")
 
 
+def build_production_context(app_settings: Optional[Settings] = None) -> AppContext:
+    """Assemble a context with live ingestion clients and the in-process
+    data processor, the way index.ts wires ZipkinService / KubernetesService
+    into the realtime worker. Modes that never touch the mesh (simulator /
+    serve-only / read-only) get no clients."""
+    s = app_settings or default_settings
+    zipkin = k8s = processor = None
+    # read-only mode keeps the clients: the reference still runs the
+    # forceKMamizSync startup handshake there (index.ts:57-60); schedules
+    # that would use them are simply never registered
+    if not (s.simulator_mode or s.serve_only):
+        from kmamiz_tpu.ingestion import KubernetesClient, ZipkinClient
+        from kmamiz_tpu.server.processor import DataProcessor
+
+        zipkin = ZipkinClient(s.zipkin_url)
+        if s.is_running_in_kubernetes:
+            k8s = KubernetesClient.from_service_account(s.kube_api_host)
+        else:
+            k8s = KubernetesClient(s.kube_api_host)
+        processor = DataProcessor(
+            trace_source=zipkin.get_trace_list, k8s_source=k8s
+        )
+    return AppContext.build(
+        app_settings=s,
+        processor=processor,
+        zipkin_client=zipkin,
+        k8s_client=k8s,
+    )
+
+
 def build_router(
     ctx: AppContext,
     import_export: Optional[ImportExportHandler] = None,
@@ -84,6 +114,11 @@ class Application:
     def start_up(self) -> None:
         """Mode switch (index.ts:55-92)."""
         s = self.settings
+        if s.is_running_in_kubernetes and self.ctx.k8s_client is not None:
+            # ask the instance being replaced to flush first (index.ts:57-60)
+            self.ctx.k8s_client.force_kmamiz_sync(
+                s.service_port, s.api_version, simulator_mode=s.simulator_mode
+            )
         if s.simulator_mode:
             logger.info("Starting in simulator mode.")
             self.initializer.simulation_server_startup()
@@ -130,7 +165,7 @@ class Application:
 
 def main() -> None:
     logging.basicConfig(level=logging.INFO)
-    app = Application()
+    app = Application(ctx=build_production_context())
     app.start_up()
     app.listen()
 

@@ -185,15 +185,19 @@ def make_http_handler(router: Router, cache_max_age: int = 5):
             if use_gzip:
                 body = gzip.compress(body)
             self.send_response(response.status)
-            self.send_header("Content-Type", response.content_type)
+            bodyless = response.status in (204, 304)
+            if not bodyless:  # RFC 7230 §3.3.2: no body framing on 204/304
+                self.send_header("Content-Type", response.content_type)
             self.send_header("Cache-Control", f"max-age={cache_max_age}")
             if use_gzip:
                 self.send_header("Content-Encoding", "gzip")
             for k, v in response.headers.items():
                 self.send_header(k, v)
-            self.send_header("Content-Length", str(len(body)))
+            if not bodyless:
+                self.send_header("Content-Length", str(len(body)))
             self.end_headers()
-            self.wfile.write(body)
+            if not bodyless:
+                self.wfile.write(body)
 
         def _read_body(self) -> bytes:
             length = int(self.headers.get("Content-Length", 0) or 0)

@@ -8,11 +8,11 @@ Equivalent of the reference's two body-scrubbing implementations:
   SimConfigServicesInfoPreprocessor.ts:253-284).
 
 Strings -> "", numbers -> 0, booleans -> false, anything else -> null;
-containers keep their shape.
+containers keep their shape. (The WASM filter's scrubber, which preserves
+booleans/null, lives in kmamiz_tpu.core.envoy_filter.)
 """
 from __future__ import annotations
 
-import json
 from typing import Any
 
 _TYPE_ZERO = {"string": "", "number": 0, "boolean": False}
@@ -43,13 +43,3 @@ def deidentify_type_definition(value: Any) -> Any:
     if isinstance(value, str) and value in _TYPE_ZERO:
         return _TYPE_ZERO[value]
     return None
-
-
-def deidentify_json_string(body: str) -> str:
-    """De-identify a JSON document in string form; non-JSON returns as-is
-    (the WASM filter only rewrites bodies that parse, main.go:213-218)."""
-    try:
-        parsed = json.loads(body)
-    except (json.JSONDecodeError, TypeError):
-        return body
-    return json.dumps(deidentify_sample(parsed))

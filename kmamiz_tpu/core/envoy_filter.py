@@ -1,0 +1,148 @@
+"""Envoy telemetry-filter equivalent: KMamiz log-line emission.
+
+Equivalent of the reference's Go proxy-wasm plugin
+(/root/reference/envoy/wasm/main.go): it logs a `[Request id/trace/span/
+parent] [METHOD host/path] [ContentType ...] [Body ...]` line per request
+and the `[Response ...] [Status] ...` twin on stream close, with JSON
+bodies desensitized to type-preserving zero values before they ever leave
+the pod (main.go:210-240).
+
+In this framework the "filter" is a library: the simulator and tests use
+it to synthesize istio-proxy container logs that the ingestion parser
+(kmamiz_tpu.core.envoy) round-trips, and any sidecar-less deployment can
+emit the same lines from process middleware. Note the WASM scrubber keeps
+booleans/null as-is (main.go:216-225) while the simulator's body scrubber
+zeroes them — both reference behaviors exist; this module follows the WASM
+one.
+"""
+from __future__ import annotations
+
+import json
+from datetime import datetime, timezone
+from typing import Any, List, Optional
+
+NO_ID = "NO_ID"
+
+
+def desensitize_value(value: Any) -> Any:
+    """WASM parseObject semantics: strings -> "", numbers -> 0, booleans and
+    null preserved; containers keep their shape (main.go:210-240)."""
+    if isinstance(value, list):
+        return [desensitize_value(v) for v in value]
+    if isinstance(value, dict):
+        return {k: desensitize_value(v) for k, v in value.items()}
+    if isinstance(value, bool) or value is None:
+        return value
+    if isinstance(value, str):
+        return ""
+    if isinstance(value, (int, float)):
+        return 0
+    return value
+
+
+def desensitize_body(body: str) -> Optional[str]:
+    """JSON body -> desensitized JSON string; None when it doesn't parse
+    (the filter drops unparseable bodies, main.go:213-218)."""
+    try:
+        parsed = json.loads(body)
+    except (json.JSONDecodeError, TypeError):
+        return None
+    return json.dumps(desensitize_value(parsed), separators=(", ", ": "))
+
+
+def _id_block(kind: str, request_id: str, trace_id: str, span_id: str, parent_span_id: str) -> str:
+    return f"[{kind} {request_id}/{trace_id}/{span_id}/{parent_span_id}]"
+
+
+def format_request_log(
+    method: str,
+    host: str,
+    path: str,
+    request_id: str = NO_ID,
+    trace_id: str = NO_ID,
+    span_id: str = NO_ID,
+    parent_span_id: str = NO_ID,
+    content_type: str = "",
+    body: str = "",
+) -> str:
+    """main.go:177-189 plus the body block appended on buffer end."""
+    line = (
+        _id_block("Request", request_id, trace_id, span_id, parent_span_id)
+        + f" [{method} {host}{path}]"
+    )
+    if content_type:
+        line += f" [ContentType {content_type}]"
+    if body and content_type == "application/json":
+        scrubbed = desensitize_body(body)
+        if scrubbed is not None:
+            line += f" [Body] {scrubbed}"
+    return line
+
+
+def format_response_log(
+    status: str,
+    request_id: str = NO_ID,
+    trace_id: str = NO_ID,
+    span_id: str = NO_ID,
+    parent_span_id: str = NO_ID,
+    content_type: str = "",
+    body: str = "",
+) -> str:
+    """main.go:190-201 plus the body block."""
+    line = (
+        _id_block("Response", request_id, trace_id, span_id, parent_span_id)
+        + f" [Status] {status}"
+    )
+    if content_type:
+        line += f" [ContentType {content_type}]"
+    if body and content_type == "application/json":
+        scrubbed = desensitize_body(body)
+        if scrubbed is not None:
+            line += f" [Body] {scrubbed}"
+    return line
+
+
+def emit_stream_logs(
+    timestamp_ms: float,
+    method: str,
+    host: str,
+    path: str,
+    status: str,
+    request_id: str = NO_ID,
+    trace_id: str = NO_ID,
+    span_id: str = NO_ID,
+    parent_span_id: str = NO_ID,
+    request_content_type: str = "",
+    request_body: str = "",
+    response_content_type: str = "",
+    response_body: str = "",
+) -> List[str]:
+    """One HTTP stream -> the Request/Response line pair in the
+    'time\\tpayload' shape the ingestion parser consumes
+    (OnHttpStreamDone, main.go:52-63)."""
+    stamp = (
+        datetime.fromtimestamp(timestamp_ms / 1000, tz=timezone.utc)
+        .isoformat(timespec="microseconds")
+        .replace("+00:00", "Z")
+    )
+    request_line = format_request_log(
+        method,
+        host,
+        path,
+        request_id,
+        trace_id,
+        span_id,
+        parent_span_id,
+        request_content_type,
+        request_body,
+    )
+    response_line = format_response_log(
+        status,
+        request_id,
+        trace_id,
+        span_id,
+        parent_span_id,
+        response_content_type,
+        response_body,
+    )
+    return [f"{stamp}\t{request_line}", f"{stamp}\t{response_line}"]

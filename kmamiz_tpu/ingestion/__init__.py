@@ -1,0 +1,9 @@
+"""L1 ingestion clients: Zipkin and Kubernetes HTTP APIs.
+
+Equivalent of the reference's `src/services/ZipkinService.ts` /
+`src/services/KubernetesService.ts` and the Rust twin's
+`kmamiz_data_processor/src/http_client/` — the only layer that talks to
+the monitored mesh. Everything downstream consumes plain parsed records.
+"""
+from kmamiz_tpu.ingestion.zipkin import ZipkinClient  # noqa: F401
+from kmamiz_tpu.ingestion.kubernetes import KubernetesClient  # noqa: F401

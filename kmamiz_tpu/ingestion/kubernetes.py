@@ -1,0 +1,193 @@
+"""Kubernetes API client.
+
+Equivalent of /root/reference/src/services/KubernetesService.ts and
+kmamiz_data_processor/src/http_client/kubernetes.rs: in-cluster service-
+account auth (Bearer token + CA bundle), pod/service/namespace listing,
+replica counting from Istio canonical-name labels, istio-proxy envoy-log
+fetch + parse, and the old-instance sync handshake.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import ssl
+import urllib.request
+from typing import Dict, Iterable, List, Optional, Set
+
+from kmamiz_tpu.core.envoy import (
+    EnvoyLogs,
+    parse_envoy_logs,
+    strip_istio_proxy_prefix,
+)
+
+logger = logging.getLogger("kmamiz_tpu.ingestion.kubernetes")
+
+SERVICE_ACCOUNT_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+DEFAULT_LOG_LIMIT = 10_000  # KubernetesService.ts:18
+CANONICAL_NAME_LABEL = "service.istio.io/canonical-name"
+CANONICAL_REVISION_LABEL = "service.istio.io/canonical-revision"
+
+
+class KubernetesServiceError(Exception):
+    """Raised when required cluster data cannot be fetched; the reference
+    treats this as fatal (KubernetesService.ts:54-71)."""
+
+
+class KubernetesClient:
+    def __init__(
+        self,
+        kube_api_host: str,
+        token: Optional[str] = None,
+        ca_cert_path: Optional[str] = None,
+        current_namespace: str = "",
+        timeout: float = 30.0,
+    ) -> None:
+        if not kube_api_host:
+            raise ValueError("Variable [KUBEAPI_HOST] not set")
+        self._base = f"{kube_api_host.rstrip('/')}/api/v1"
+        self._token = token
+        self._timeout = timeout
+        self.current_namespace = current_namespace
+        self._ssl_context = (
+            ssl.create_default_context(cafile=ca_cert_path)
+            if ca_cert_path
+            else None
+        )
+
+    @classmethod
+    def from_service_account(
+        cls, kube_api_host: str, service_account_dir: str = SERVICE_ACCOUNT_DIR
+    ) -> "KubernetesClient":
+        """In-cluster auth from the mounted service account
+        (KubernetesService.ts:27-47)."""
+        with open(f"{service_account_dir}/token") as f:
+            token = f.read().strip()
+        if not token:
+            raise ValueError("token is empty")
+        with open(f"{service_account_dir}/namespace") as f:
+            namespace = f.read().strip()
+        return cls(
+            kube_api_host,
+            token=token,
+            ca_cert_path=f"{service_account_dir}/ca.crt",
+            current_namespace=namespace,
+        )
+
+    # -- transport -----------------------------------------------------------
+
+    def _request(self, path: str, as_json: bool = True):
+        headers = {"Accept": "application/json" if as_json else "text/plain"}
+        if self._token:
+            headers["Authorization"] = f"Bearer {self._token}"
+        request = urllib.request.Request(self._base + path, headers=headers)
+        with urllib.request.urlopen(
+            request, timeout=self._timeout, context=self._ssl_context
+        ) as response:
+            raw = response.read()
+        return json.loads(raw) if as_json else raw.decode("utf-8", "replace")
+
+    def _must_request(self, path: str, as_json: bool = True):
+        try:
+            return self._request(path, as_json=as_json)
+        except Exception as err:  # noqa: BLE001
+            raise KubernetesServiceError(
+                f"Cannot retrieve necessary data from Kubernetes API server: {err}"
+            ) from err
+
+    # -- listings ------------------------------------------------------------
+
+    def get_pod_list(self, namespace: str) -> dict:
+        return self._must_request(f"/namespaces/{namespace}/pods")
+
+    def get_service_list(self, namespace: str) -> dict:
+        return self._must_request(f"/namespaces/{namespace}/services")
+
+    def get_namespaces(self) -> List[str]:
+        data = self._must_request("/namespaces")
+        return [item["metadata"]["name"] for item in data.get("items", [])]
+
+    def get_pod_names(self, namespace: str) -> List[str]:
+        return [
+            pod["metadata"]["name"]
+            for pod in self.get_pod_list(namespace).get("items", [])
+        ]
+
+    # -- replicas from canonical-name labels (KubernetesService.ts:118-146) --
+
+    def get_replicas_from_pod_list(self, namespace: str) -> List[dict]:
+        replica_map: Dict[str, dict] = {}
+        for pod in self.get_pod_list(namespace).get("items", []):
+            labels = pod.get("metadata", {}).get("labels", {}) or {}
+            service = labels.get(CANONICAL_NAME_LABEL)
+            version = labels.get(CANONICAL_REVISION_LABEL)
+            pod_namespace = pod.get("metadata", {}).get("namespace", namespace)
+            unique = f"{service}\t{pod_namespace}\t{version}"
+            entry = replica_map.setdefault(
+                unique,
+                {
+                    "uniqueServiceName": unique,
+                    "service": service,
+                    "namespace": pod_namespace,
+                    "version": version,
+                    "replicas": 0,
+                },
+            )
+            entry["replicas"] += 1
+        return list(replica_map.values())
+
+    def get_replicas(self, namespaces: Optional[Iterable[str]] = None) -> List[dict]:
+        if namespaces is None:
+            namespaces = self.get_namespaces()
+        replicas: List[dict] = []
+        for ns in namespaces:
+            replicas.extend(self.get_replicas_from_pod_list(ns))
+        return replicas
+
+    def get_replicas_all(self) -> List[dict]:
+        return self.get_replicas()
+
+    # -- envoy logs (KubernetesService.ts:178-199) ---------------------------
+
+    def get_envoy_logs(
+        self, namespace: str, pod_name: str, limit: int = DEFAULT_LOG_LIMIT
+    ) -> EnvoyLogs:
+        raw = self._must_request(
+            f"/namespaces/{namespace}/pods/{pod_name}/log"
+            f"?container=istio-proxy&tailLines={limit}",
+            as_json=False,
+        )
+        lines = strip_istio_proxy_prefix(raw.split("\n"))
+        return parse_envoy_logs(lines, namespace, pod_name)
+
+    # -- peer-instance handshake (KubernetesService.ts:96-116,164-176) -------
+
+    def get_production_service_base_url(
+        self, namespace: str = "kmamiz-system", service_name: str = "kmamiz"
+    ) -> str:
+        services = self.get_service_list(namespace)
+        port = 80
+        for svc in services.get("items", []):
+            if svc.get("metadata", {}).get("name") == service_name:
+                ports = svc.get("spec", {}).get("ports") or []
+                if ports:
+                    port = ports[0].get("port", 80)
+                break
+        return f"http://{service_name}:{port}"
+
+    def force_kmamiz_sync(
+        self, service_port: str, api_version: str, simulator_mode: bool = False
+    ) -> None:
+        """Ask the instance being replaced to flush its caches before this
+        one takes over; failures are ignored (KubernetesService.ts:164-176)."""
+        svc = "kmamiz-simulator" if simulator_mode else "kmamiz"
+        url = (
+            f"http://{svc}.{self.current_namespace}.svc:{service_port}"
+            f"/api/v{api_version}/data/sync"
+        )
+        try:
+            request = urllib.request.Request(url, method="POST")
+            with urllib.request.urlopen(request, timeout=self._timeout) as response:
+                if response.status == 200:
+                    logger.debug("Notified existing instance to sync.")
+        except Exception:  # noqa: BLE001 - best-effort handshake
+            pass

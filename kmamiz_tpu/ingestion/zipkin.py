@@ -1,0 +1,75 @@
+"""Zipkin v2 API client.
+
+Equivalent of /root/reference/src/services/ZipkinService.ts and
+kmamiz_data_processor/src/http_client/zipkin.rs: trace-list queries rooted
+at the ingress gateway with lookback/endTs/limit, gzip accepted.
+"""
+from __future__ import annotations
+
+import gzip
+import json
+import logging
+import time
+import urllib.request
+from typing import List, Optional
+from urllib.parse import urlencode
+
+logger = logging.getLogger("kmamiz_tpu.ingestion.zipkin")
+
+DEFAULT_LOOKBACK_MS = 86_400_000 * 7  # ZipkinService.ts:11
+DEFAULT_ROOT_SERVICE = "istio-ingressgateway.istio-system"  # ZipkinService.ts:48
+
+
+def _http_get_json(url: str, timeout: float):
+    request = urllib.request.Request(
+        url,
+        headers={"Accept": "application/json", "Accept-Encoding": "gzip"},
+    )
+    with urllib.request.urlopen(request, timeout=timeout) as response:
+        raw = response.read()
+        if response.headers.get("Content-Encoding") == "gzip":
+            raw = gzip.decompress(raw)
+    return json.loads(raw)
+
+
+class ZipkinClient:
+    def __init__(self, zipkin_url: str, timeout: float = 30.0) -> None:
+        if not zipkin_url:
+            raise ValueError("Variable [ZIPKIN_URL] not set")
+        self._base = f"{zipkin_url.rstrip('/')}/zipkin/api/v2"
+        self._timeout = timeout
+
+    def get_trace_list(
+        self,
+        look_back: float = DEFAULT_LOOKBACK_MS,
+        end_ts: Optional[float] = None,
+        limit: int = 100_000,
+        service_name: str = DEFAULT_ROOT_SERVICE,
+    ) -> List[List[dict]]:
+        """Traces rooted at `service_name`, looking back `look_back` ms from
+        `end_ts` (ZipkinService.ts:44-57). Errors log and return [] like the
+        reference's AxiosRequest wrapper (Utils.ts:187-200)."""
+        if end_ts is None:
+            end_ts = time.time() * 1000
+        query = urlencode(
+            {
+                "serviceName": service_name,
+                "endTs": int(end_ts),
+                "lookback": int(look_back),
+                "limit": limit,
+            }
+        )
+        try:
+            data = _http_get_json(f"{self._base}/traces?{query}", self._timeout)
+        except Exception as err:  # noqa: BLE001
+            logger.error("zipkin trace fetch failed: %s", err)
+            return []
+        return data if isinstance(data, list) else []
+
+    def get_services(self) -> List[str]:
+        try:
+            data = _http_get_json(f"{self._base}/services", self._timeout)
+        except Exception as err:  # noqa: BLE001
+            logger.error("zipkin service list failed: %s", err)
+            return []
+        return data if isinstance(data, list) else []

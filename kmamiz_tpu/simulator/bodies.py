@@ -147,9 +147,7 @@ def sample_to_user_defined_type(obj, indent_level: int = 0) -> str:
         if obj:
             return f"{sample_to_user_defined_type(obj[0], indent_level)}[]"
         return "any[]"
-    if isinstance(obj, dict):
-        if not obj:
-            return ""
+    if isinstance(obj, dict):  # non-empty: the {} case returned above
         lines = [
             f"{next_indent}{key}: {sample_to_user_defined_type(obj[key], indent_level + 1)}"
             for key in sorted(obj.keys())

@@ -48,6 +48,17 @@ def _format_errors(header: str, errors: List[ValidationError]) -> str:
     return "\n---\n".join(lines)
 
 
+def _is_number(value) -> bool:
+    """Numeric YAML scalar check; bool is an int subclass and must not pass."""
+    return isinstance(value, (int, float)) and not isinstance(value, bool)
+
+
+def _is_int(value) -> bool:
+    """Integer YAML scalar check, excluding bool."""
+    return isinstance(value, int) and not isinstance(value, bool)
+
+
+
 # ---------------------------------------------------------------------------
 # schema validation (zod-equivalent structural checks with defaults)
 # ---------------------------------------------------------------------------
@@ -153,7 +164,7 @@ def _walk_services_info(raw, walker: _Walker) -> List[dict]:
                 )
                 walker.forbid_system_fields(ver, vloc)
                 replica = ver.get("replica", 1)
-                if not isinstance(replica, int) or isinstance(replica, bool):
+                if not _is_int(replica):
                     walker.fail(f"{vloc}.replica", "replica must be an integer.")
                     replica = 1
                 elif replica < 0:
@@ -275,7 +286,7 @@ def _walk_depend_on_entry(dep, walker: _Walker, loc: str) -> Optional[dict]:
             )
             walker.forbid_system_fields(one, oloc)
             prob = one.get("callProbability")
-            if not isinstance(prob, (int, float)) or isinstance(prob, bool):
+            if not _is_number(prob):
                 walker.fail(
                     oloc, "Invalid callProbability. It must be between 0 and 100."
                 )
@@ -302,8 +313,7 @@ def _walk_depend_on_entry(dep, walker: _Walker, loc: str) -> Optional[dict]:
     prob = dep.get("callProbability")
     if prob is not None:
         if (
-            not isinstance(prob, (int, float))
-            or isinstance(prob, bool)
+            not _is_number(prob)
             or not (0 <= prob <= 100)
         ):
             walker.fail(loc, "Invalid callProbability. It must be between 0 and 100.")
@@ -421,18 +431,18 @@ def _walk_time_periods(raw, walker: _Walker, loc: str) -> List[dict]:
         else:
             day = start.get("day")
             hour = start.get("hour")
-            if not isinstance(day, int) or not (1 <= day <= 7):
+            if not _is_int(day) or not (1 <= day <= 7):
                 walker.fail(f"{ploc}.startTime.day", "day must be an integer in 1..7.")
                 day = 1
-            if not isinstance(hour, int) or not (0 <= hour <= 23):
+            if not _is_int(hour) or not (0 <= hour <= 23):
                 walker.fail(f"{ploc}.startTime.hour", "hour must be an integer in 0..23.")
                 hour = 0
         duration = tp.get("durationHours")
-        if not isinstance(duration, int) or duration < 1:
+        if not _is_int(duration) or duration < 1:
             walker.fail(f"{ploc}.durationHours", "durationHours must be an integer >= 1.")
             duration = 1
         prob = tp.get("probabilityPercent", 100)
-        if not isinstance(prob, (int, float)) or not (0 <= prob <= 100):
+        if not _is_number(prob) or not (0 <= prob <= 100):
             walker.fail(
                 f"{ploc}.probabilityPercent",
                 "probabilityPercent must be between 0 and 100.",
@@ -487,13 +497,13 @@ def _walk_faults(raw, walker: _Walker) -> List[dict]:
         }
         if ftype == "increase-latency":
             v = fault.get("increaseLatencyMs")
-            if not isinstance(v, (int, float)) or v < 0:
+            if not _is_number(v) or v < 0:
                 walker.fail(f"{loc}.increaseLatencyMs", "increaseLatencyMs must be zero or greater.")
                 v = 0
             out["increaseLatencyMs"] = float(v)
         elif ftype == "increase-error-rate":
             v = fault.get("increaseErrorRatePercent")
-            if not isinstance(v, (int, float)) or not (0 <= v <= 100):
+            if not _is_number(v) or not (0 <= v <= 100):
                 walker.fail(
                     f"{loc}.increaseErrorRatePercent",
                     "Invalid increaseErrorRatePercent. It must be between 0 and 100.",
@@ -509,14 +519,14 @@ def _walk_faults(raw, walker: _Walker) -> List[dict]:
                     "Exactly one of the fields increaseRequestCount or "
                     "requestMultiplier must be set.",
                 )
-            if count is not None and (not isinstance(count, int) or count < 1):
+            if count is not None and (not _is_int(count) or count < 1):
                 walker.fail(
                     f"{loc}.increaseRequestCount",
                     "increaseRequestCount must be at least 1.",
                 )
                 count = None
             if mult is not None and (
-                not isinstance(mult, (int, float)) or mult <= 0
+                not _is_number(mult) or mult <= 0
             ):
                 walker.fail(
                     f"{loc}.requestMultiplier", "requestMultiplier must be greater than 0."
@@ -526,7 +536,7 @@ def _walk_faults(raw, walker: _Walker) -> List[dict]:
             out["requestMultiplier"] = float(mult) if mult is not None else None
         elif ftype == "reduce-instance":
             v = fault.get("reduceCount")
-            if not isinstance(v, int) or v < 1:
+            if not _is_int(v) or v < 1:
                 walker.fail(f"{loc}.reduceCount", "reduceCount must be an integer >= 1.")
                 v = 1
             out["reduceCount"] = v
@@ -556,7 +566,7 @@ def _walk_load_simulation(raw, walker: _Walker) -> Optional[dict]:
         cloc,
     )
     days = config_raw.get("simulationDurationInDays", 1)
-    if not isinstance(days, int) or isinstance(days, bool):
+    if not _is_int(days):
         walker.fail(f"{cloc}.simulationDurationInDays", "simulationDurationInDays must be an integer.")
         days = 1
     elif days < 1:
@@ -569,7 +579,7 @@ def _walk_load_simulation(raw, walker: _Walker) -> Optional[dict]:
         )
         days = MAX_SIMULATION_DAYS
     factor = config_raw.get("overloadErrorRateIncreaseFactor", 3)
-    if not isinstance(factor, (int, float)) or not (0 <= factor <= 10):
+    if not _is_number(factor) or not (0 <= factor <= 10):
         walker.fail(
             f"{cloc}.overloadErrorRateIncreaseFactor",
             "Invalid overloadErrorRateIncreaseFactor. It must be between 0 and 10.",
@@ -604,7 +614,7 @@ def _walk_load_simulation(raw, walker: _Walker) -> Optional[dict]:
                 )
                 walker.forbid_system_fields(ver, vloc)
                 cap = ver.get("capacityPerReplica", 1)
-                if not isinstance(cap, (int, float)) or cap < 0.01:
+                if not _is_number(cap) or cap < 0.01:
                     walker.fail(
                         f"{vloc}.capacityPerReplica",
                         "capacityPerReplica must be at least 0.01.",
@@ -645,22 +655,22 @@ def _walk_load_simulation(raw, walker: _Walker) -> Optional[dict]:
             delay_raw = {}
         walker.strict_keys(delay_raw, {"latencyMs", "jitterMs"}, f"{mloc}.delay")
         latency_ms = delay_raw.get("latencyMs", 0)
-        if not isinstance(latency_ms, (int, float)) or latency_ms < 0:
+        if not _is_number(latency_ms) or latency_ms < 0:
             walker.fail(f"{mloc}.delay.latencyMs", "latencyMs must be zero or greater.")
             latency_ms = 0
         jitter_ms = delay_raw.get("jitterMs", 0)
-        if not isinstance(jitter_ms, (int, float)) or jitter_ms < 0:
+        if not _is_number(jitter_ms) or jitter_ms < 0:
             walker.fail(f"{mloc}.delay.jitterMs", "jitterMs must be zero or greater.")
             jitter_ms = 0
         error_rate = metric.get("errorRatePercent", 0)
-        if not isinstance(error_rate, (int, float)) or not (0 <= error_rate <= 100):
+        if not _is_number(error_rate) or not (0 <= error_rate <= 100):
             walker.fail(
                 f"{mloc}.errorRatePercent",
                 "Invalid errorRate. It must be between 0 and 100.",
             )
             error_rate = 0
         daily = metric.get("expectedExternalDailyRequestCount", 0)
-        if not isinstance(daily, int) or isinstance(daily, bool):
+        if not _is_int(daily):
             walker.fail(
                 f"{mloc}.expectedExternalDailyRequestCount",
                 "expectedExternalDailyRequestCount must be an integer.",

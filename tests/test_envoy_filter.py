@@ -1,0 +1,68 @@
+"""Envoy-filter equivalent: log-line emission + desensitization round-trip
+with the ingestion parser (reference envoy/wasm/main.go)."""
+from __future__ import annotations
+
+import json
+
+from kmamiz_tpu.core import envoy_filter
+from kmamiz_tpu.core.envoy import parse_envoy_logs
+
+
+class TestDesensitize:
+    def test_wasm_semantics_preserve_bools_and_null(self):
+        scrubbed = envoy_filter.desensitize_value(
+            {"name": "alice", "age": 33, "admin": True, "note": None,
+             "tags": ["a", 1, False]}
+        )
+        assert scrubbed == {
+            "name": "", "age": 0, "admin": True, "note": None,
+            "tags": ["", 0, False],
+        }
+
+    def test_unparseable_body_dropped(self):
+        assert envoy_filter.desensitize_body("not json") is None
+
+
+class TestLogEmission:
+    def test_round_trip_through_ingestion_parser(self):
+        lines = envoy_filter.emit_stream_logs(
+            timestamp_ms=1646208338224.642,
+            method="GET",
+            host="user-service.pdas.svc.cluster.local",
+            path="/user/1",
+            status="200",
+            request_id="req-1",
+            trace_id="trace1",
+            span_id="span1",
+            parent_span_id="parent1",
+            response_content_type="application/json",
+            response_body=json.dumps({"secret": "value", "n": 7}),
+        )
+        assert len(lines) == 2
+        logs = parse_envoy_logs(lines, "pdas", "user-service-0").to_json()
+        assert len(logs) == 2
+        req, res = logs
+        assert req["type"] == "Request"
+        assert req["method"] == "GET"
+        assert req["traceId"] == "trace1"
+        assert req["path"] == "user-service.pdas.svc.cluster.local/user/1"
+        assert res["type"] == "Response"
+        assert res["status"] == "200"
+        assert json.loads(res["body"]) == {"secret": "", "n": 0}
+
+    def test_body_never_leaks_values(self):
+        line = envoy_filter.format_request_log(
+            "POST",
+            "svc.ns.svc.cluster.local",
+            "/login",
+            content_type="application/json",
+            body=json.dumps({"password": "hunter2"}),
+        )
+        assert "hunter2" not in line
+        assert '"password"' in line
+
+    def test_non_json_body_omitted(self):
+        line = envoy_filter.format_request_log(
+            "POST", "h", "/p", content_type="text/plain", body="raw text"
+        )
+        assert "[Body]" not in line

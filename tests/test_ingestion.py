@@ -1,0 +1,255 @@
+"""L1 ingestion clients: Zipkin + Kubernetes HTTP APIs against a mock
+in-process API server (reference src/services/ZipkinService.ts,
+KubernetesService.ts)."""
+from __future__ import annotations
+
+import gzip
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlsplit
+
+import pytest
+
+from kmamiz_tpu.ingestion import KubernetesClient, ZipkinClient
+from kmamiz_tpu.ingestion.kubernetes import KubernetesServiceError
+
+
+class _MockApi(BaseHTTPRequestHandler):
+    routes = {}
+    seen = []
+
+    def log_message(self, *args):
+        pass
+
+    def _serve(self):
+        split = urlsplit(self.path)
+        type(self).seen.append((self.command, self.path))
+        handler = self.routes.get((self.command, split.path))
+        if handler is None:
+            self.send_response(404)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return
+        status, payload, use_gzip = handler(parse_qs(split.query))
+        body = payload if isinstance(payload, bytes) else json.dumps(payload).encode()
+        if use_gzip:
+            body = gzip.compress(body)
+        self.send_response(status)
+        if use_gzip:
+            self.send_header("Content-Encoding", "gzip")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    do_GET = _serve
+    do_POST = _serve
+
+
+@pytest.fixture()
+def mock_api():
+    _MockApi.routes = {}
+    _MockApi.seen = []
+    server = ThreadingHTTPServer(("127.0.0.1", 0), _MockApi)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    yield server, _MockApi
+    server.shutdown()
+    server.server_close()
+
+
+def _base(server) -> str:
+    return f"http://127.0.0.1:{server.server_address[1]}"
+
+
+POD_LIST = {
+    "items": [
+        {
+            "metadata": {
+                "name": f"user-service-{i}",
+                "namespace": "pdas",
+                "labels": {
+                    "service.istio.io/canonical-name": "user-service",
+                    "service.istio.io/canonical-revision": "latest",
+                },
+            }
+        }
+        for i in range(3)
+    ]
+    + [
+        {
+            "metadata": {
+                "name": "db-service-0",
+                "namespace": "pdas",
+                "labels": {
+                    "service.istio.io/canonical-name": "db-service",
+                    "service.istio.io/canonical-revision": "v2",
+                },
+            }
+        }
+    ]
+}
+
+
+class TestZipkinClient:
+    def test_trace_list_query_and_gzip(self, mock_api):
+        server, api = mock_api
+        traces = [[{"traceId": "t1"}], [{"traceId": "t2"}]]
+        api.routes[("GET", "/zipkin/api/v2/traces")] = lambda q: (
+            200,
+            traces,
+            True,
+        )
+        client = ZipkinClient(_base(server))
+        out = client.get_trace_list(30_000, 1_000_000, limit=2500)
+        assert out == traces
+        _, path = api.seen[0]
+        query = parse_qs(urlsplit(path).query)
+        assert query["serviceName"] == ["istio-ingressgateway.istio-system"]
+        assert query["lookback"] == ["30000"]
+        assert query["endTs"] == ["1000000"]
+        assert query["limit"] == ["2500"]
+
+    def test_errors_return_empty(self, mock_api):
+        server, _ = mock_api
+        client = ZipkinClient(_base(server))
+        assert client.get_trace_list(1000, 1000) == []  # 404 -> []
+
+    def test_services(self, mock_api):
+        server, api = mock_api
+        api.routes[("GET", "/zipkin/api/v2/services")] = lambda q: (
+            200,
+            ["a", "b"],
+            False,
+        )
+        assert ZipkinClient(_base(server)).get_services() == ["a", "b"]
+
+    def test_requires_url(self):
+        with pytest.raises(ValueError):
+            ZipkinClient("")
+
+
+class TestKubernetesClient:
+    def test_replicas_from_canonical_labels(self, mock_api):
+        server, api = mock_api
+        api.routes[("GET", "/api/v1/namespaces/pdas/pods")] = lambda q: (
+            200,
+            POD_LIST,
+            False,
+        )
+        client = KubernetesClient(_base(server))
+        replicas = client.get_replicas_from_pod_list("pdas")
+        by_name = {r["uniqueServiceName"]: r for r in replicas}
+        assert by_name["user-service\tpdas\tlatest"]["replicas"] == 3
+        assert by_name["db-service\tpdas\tv2"]["replicas"] == 1
+        assert by_name["db-service\tpdas\tv2"]["version"] == "v2"
+
+    def test_pod_names_and_namespaces(self, mock_api):
+        server, api = mock_api
+        api.routes[("GET", "/api/v1/namespaces/pdas/pods")] = lambda q: (
+            200,
+            POD_LIST,
+            False,
+        )
+        api.routes[("GET", "/api/v1/namespaces")] = lambda q: (
+            200,
+            {"items": [{"metadata": {"name": "pdas"}}, {"metadata": {"name": "book"}}]},
+            False,
+        )
+        client = KubernetesClient(_base(server))
+        assert len(client.get_pod_names("pdas")) == 4
+        assert client.get_namespaces() == ["pdas", "book"]
+        replicas = client.get_replicas({"pdas"})
+        assert len(replicas) == 2
+
+    def test_envoy_log_fetch_and_parse(self, mock_api, pdas_envoy_log_lines):
+        server, api = mock_api
+        # istio-proxy style raw container log using the wasm log marker
+        raw = "\n".join(
+            line.split("\t")[0]
+            + "\twasm log kmamiz-filter: "
+            + line.split("\t", 1)[1]
+            for line in pdas_envoy_log_lines
+        )
+        api.routes[
+            ("GET", "/api/v1/namespaces/pdas/pods/user-service-0/log")
+        ] = lambda q: (200, raw.encode(), False)
+        client = KubernetesClient(_base(server))
+        logs = client.get_envoy_logs("pdas", "user-service-0")
+        rows = logs.to_json()
+        assert rows and all(r["podName"] == "user-service-0" for r in rows)
+        assert {r["type"] for r in rows} <= {"Request", "Response"}
+
+    def test_missing_data_is_fatal(self, mock_api):
+        server, _ = mock_api
+        client = KubernetesClient(_base(server))
+        with pytest.raises(KubernetesServiceError):
+            client.get_pod_list("missing")
+
+    def test_auth_header_sent(self, mock_api):
+        server, api = mock_api
+        captured = {}
+
+        def handler(q):
+            return 200, {"items": []}, False
+
+        api.routes[("GET", "/api/v1/namespaces")] = handler
+        orig = _MockApi._serve
+
+        client = KubernetesClient(_base(server), token="sekret")
+
+        def spy(self):
+            captured["auth"] = self.headers.get("Authorization")
+            orig(self)
+
+        _MockApi._serve = spy
+        _MockApi.do_GET = spy
+        try:
+            client.get_namespaces()
+        finally:
+            _MockApi._serve = orig
+            _MockApi.do_GET = orig
+        assert captured["auth"] == "Bearer sekret"
+
+    def test_production_service_base_url(self, mock_api):
+        server, api = mock_api
+        api.routes[("GET", "/api/v1/namespaces/kmamiz-system/services")] = lambda q: (
+            200,
+            {
+                "items": [
+                    {"metadata": {"name": "other"}, "spec": {"ports": [{"port": 9}]}},
+                    {"metadata": {"name": "kmamiz"}, "spec": {"ports": [{"port": 8080}]}},
+                ]
+            },
+            False,
+        )
+        client = KubernetesClient(_base(server))
+        assert client.get_production_service_base_url() == "http://kmamiz:8080"
+
+    def test_force_sync_best_effort(self, mock_api):
+        server, _ = mock_api
+        client = KubernetesClient(_base(server), current_namespace="kmamiz-system")
+        client.force_kmamiz_sync("3000", "1")  # unreachable host -> swallowed
+
+
+class TestProductionContext:
+    def test_build_production_context_wires_clients(self):
+        from kmamiz_tpu.api.app import build_production_context
+        from kmamiz_tpu.config import Settings
+
+        s = Settings()
+        ctx = build_production_context(s)
+        assert ctx.zipkin_client is not None
+        assert ctx.k8s_client is not None
+        assert ctx.processor is not None
+        assert ctx.operator._processor is ctx.processor
+
+    def test_serve_only_context_has_no_clients(self):
+        from kmamiz_tpu.api.app import build_production_context
+        from kmamiz_tpu.config import Settings
+
+        s = Settings()
+        s.serve_only = True
+        ctx = build_production_context(s)
+        assert ctx.zipkin_client is None
+        assert ctx.k8s_client is None

@@ -39,7 +39,13 @@ def parse_timestamp_ms(time_str: str) -> float:
 
 def strip_istio_proxy_prefix(lines: List[str]) -> List[str]:
     """Reduce raw istio-proxy container log lines to 'time\\tpayload' form
-    (KubernetesService.getEnvoyLogs filtering)."""
+    (KubernetesService.getEnvoyLogs filtering). Uses the native C++ parser
+    when built (native/kmamiz_native.cpp), else pure Python."""
+    from kmamiz_tpu import native
+
+    native_out = native.strip_istio_proxy_prefix(lines)
+    if native_out is not None:
+        return native_out
     out = []
     for line in lines:
         if "script log: " not in line and "wasm log " not in line:
@@ -52,9 +58,35 @@ def parse_envoy_logs(
     logs: List[str], namespace: str, pod_name: str
 ) -> "EnvoyLogs":
     """Parse 'time\\t[Request|Response ...]' lines into TEnvoyLog dicts
-    (KubernetesService.ParseEnvoyLogs)."""
+    (KubernetesService.ParseEnvoyLogs). Uses the native C++ parser when
+    built (native/kmamiz_native.cpp), else pure Python."""
+    from kmamiz_tpu import native
+
+    records = native.parse_envoy_lines(logs)
+    if records is None:
+        records = _parse_envoy_lines_py(logs)
+
+    # shared decoration: timestamp parse, pod identity, and the
+    # "first non-NO_ID traceId wins per requestId" backfill
     id_map: Dict[str, str] = {}
     envoy_logs: List[dict] = []
+    for r in records:
+        if r["requestId"] not in id_map and r["traceId"] != "NO_ID":
+            id_map[r["requestId"]] = r["traceId"]
+        entry = dict(r)
+        entry["timestamp"] = parse_timestamp_ms(entry.pop("time"))
+        entry["namespace"] = namespace
+        entry["podName"] = pod_name
+        envoy_logs.append(entry)
+    for e in envoy_logs:
+        e["traceId"] = id_map.get(e["requestId"], "NO_ID")
+    return EnvoyLogs(envoy_logs)
+
+
+def _parse_envoy_lines_py(logs: List[str]) -> List[dict]:
+    """Pure-Python twin of native.parse_envoy_lines: raw undecorated field
+    records, one per parseable line."""
+    records: List[dict] = []
     for l in logs:
         parts = l.split("\t", 1)
         if len(parts) != 2:
@@ -69,13 +101,9 @@ def parse_envoy_logs(
         method, path = (mp.group(1), mp.group(2)) if mp else (None, None)
         ct = _CONTENT_TYPE_RE.search(log)
         body = _BODY_RE.search(log)
-
-        if request_id not in id_map and trace_id != "NO_ID":
-            id_map[request_id] = trace_id
-
-        envoy_logs.append(
+        records.append(
             {
-                "timestamp": parse_timestamp_ms(time_str),
+                "time": time_str,
                 "type": log_type,
                 "requestId": request_id,
                 "traceId": trace_id,
@@ -84,15 +112,11 @@ def parse_envoy_logs(
                 "method": method,
                 "path": path,
                 "status": status,
-                "body": body.group(1) if body else None,
                 "contentType": ct.group(1) if ct else None,
-                "namespace": namespace,
-                "podName": pod_name,
+                "body": body.group(1) if body else None,
             }
         )
-    for e in envoy_logs:
-        e["traceId"] = id_map.get(e["requestId"], "NO_ID")
-    return EnvoyLogs(envoy_logs)
+    return records
 
 
 class EnvoyLogs:

@@ -1,0 +1,169 @@
+"""ctypes binding for the native C++ data-loader hot path.
+
+Loads (building on first use if needed) `native/kmamiz_native.cpp` — the
+C++ twin of the reference's Rust log parser (log_matcher.rs) — and exposes
+drop-in equivalents of the Python implementations in
+`kmamiz_tpu.core.envoy`. Every entry point degrades to the pure-Python
+path when the toolchain or library is unavailable, so the framework never
+hard-requires the extension. Call `available()` once at startup to keep
+the one-time compile off the request path.
+"""
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from pathlib import Path
+from typing import List, Optional
+
+logger = logging.getLogger("kmamiz_tpu.native")
+
+_FIELD_SEP = "\x1f"
+_RECORD_SEP = "\x1e"
+
+_REPO_ROOT = Path(__file__).resolve().parent.parent.parent
+_SOURCE = _REPO_ROOT / "native" / "kmamiz_native.cpp"
+_BUILD_DIR = _REPO_ROOT / "native" / "build"
+_LIB_PATH = _BUILD_DIR / "libkmamiz_native.so"
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_failed = False
+
+
+def _build() -> bool:
+    if not _SOURCE.exists():
+        return False
+    _BUILD_DIR.mkdir(parents=True, exist_ok=True)
+    cmd = [
+        os.environ.get("CXX", "g++"),
+        "-O2",
+        "-shared",
+        "-fPIC",
+        "-std=c++17",
+        "-o",
+        str(_LIB_PATH),
+        str(_SOURCE),
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except (subprocess.SubprocessError, OSError) as err:
+        logger.warning("native build failed, using pure-Python path: %s", err)
+        return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _load_failed
+    if _lib is not None or _load_failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        if not _LIB_PATH.exists() or (
+            _SOURCE.exists()
+            and _SOURCE.stat().st_mtime > _LIB_PATH.stat().st_mtime
+        ):
+            if not _build():
+                _load_failed = True
+                return None
+        try:
+            lib = ctypes.CDLL(str(_LIB_PATH))
+        except OSError as err:
+            logger.warning("native load failed: %s", err)
+            _load_failed = True
+            return None
+        for name in ("km_parse_envoy_lines", "km_strip_istio_prefix"):
+            fn = getattr(lib, name)
+            fn.argtypes = [
+                ctypes.c_char_p,
+                ctypes.c_size_t,
+                ctypes.POINTER(ctypes.c_size_t),
+            ]
+            fn.restype = ctypes.c_void_p
+        lib.km_free.argtypes = [ctypes.c_void_p]
+        lib.km_free.restype = None
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _call_buffer_fn(fn, payload: bytes, *extra) -> Optional[str]:
+    lib = _load()
+    if lib is None:
+        return None
+    out_len = ctypes.c_size_t(0)
+    ptr = fn(payload, len(payload), *extra, ctypes.byref(out_len))
+    if not ptr:
+        return None
+    try:
+        return ctypes.string_at(ptr, out_len.value).decode("utf-8", "replace")
+    finally:
+        lib.km_free(ptr)
+
+
+def strip_istio_proxy_prefix(lines: List[str]) -> Optional[List[str]]:
+    """Native twin of core.envoy.strip_istio_proxy_prefix; None -> fall back."""
+    lib = _load()
+    if lib is None:
+        return None
+    raw = _call_buffer_fn(lib.km_strip_istio_prefix, "\n".join(lines).encode())
+    if raw is None:
+        return None
+    return raw.split("\n")[:-1] if raw else []
+
+
+def parse_envoy_lines(lines: List[str]) -> Optional[List[dict]]:
+    """Native twin of the per-line parse inside core.envoy.parse_envoy_logs:
+    returns raw field dicts (no namespace/pod/id-map decoration), or None
+    when the extension is unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    raw = _call_buffer_fn(lib.km_parse_envoy_lines, "\n".join(lines).encode())
+    if raw is None:
+        return None
+    records = []
+    for record in raw.split(_RECORD_SEP):
+        if not record:
+            continue
+        fields = record.split(_FIELD_SEP)
+        if len(fields) != 12:
+            continue
+        (
+            time_str,
+            log_type,
+            request_id,
+            trace_id,
+            span_id,
+            parent_span_id,
+            method,
+            path,
+            status,
+            content_type,
+            body,
+            body_present,
+        ) = fields
+        if not path:  # the method/path regex requires a non-empty path
+            method = ""
+        records.append(
+            {
+                "time": time_str,
+                "type": log_type,
+                "requestId": request_id,
+                "traceId": trace_id,
+                "spanId": span_id,
+                "parentSpanId": parent_span_id,
+                "method": method or None,
+                "path": path or None,
+                "status": status or None,
+                "contentType": content_type or None,
+                "body": body if body_present == "1" else None,
+            }
+        )
+    return records

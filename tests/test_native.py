@@ -1,0 +1,141 @@
+"""Native C++ data-loader parity: the ctypes extension must produce exactly
+what the pure-Python implementations produce (native/kmamiz_native.cpp vs
+kmamiz_tpu/core/envoy.py + urls.py, themselves parity ports of the
+reference's log_matcher.rs / url_matcher.rs)."""
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from kmamiz_tpu import native
+from kmamiz_tpu.core import envoy
+from kmamiz_tpu.core.envoy_filter import emit_stream_logs
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native toolchain unavailable"
+)
+
+
+def _normalize(rows):
+    """NaN != NaN would fail dict equality; stringify bad timestamps."""
+    out = []
+    for r in rows:
+        r = dict(r)
+        if r.get("timestamp") != r.get("timestamp"):
+            r["timestamp"] = "NaN"
+        out.append(r)
+    return out
+
+
+def _python_parse(lines, namespace, pod):
+    """Force the pure-Python path regardless of the native fast path."""
+    real = native.parse_envoy_lines
+    native.parse_envoy_lines = lambda _lines: None
+    try:
+        return envoy.parse_envoy_logs(lines, namespace, pod).to_json()
+    finally:
+        native.parse_envoy_lines = real
+
+
+def _python_strip(lines):
+    real = native.strip_istio_proxy_prefix
+    native.strip_istio_proxy_prefix = lambda _lines: None
+    try:
+        return envoy.strip_istio_proxy_prefix(lines)
+    finally:
+        native.strip_istio_proxy_prefix = real
+
+
+ISTIO_RAW_LINES = [
+    # realistic istio-proxy prefixes around the filter payload
+    "2022-03-02T08:05:38.224642Z\tdebug\tenvoy wasm\twasm log kmamiz-filter my-ns: "
+    "[Request abc-1/trace1/span1/parent1] [GET svc.ns.svc.cluster.local/a]",
+    "2022-03-02T08:05:38.230000Z\tdebug\tenvoy lua\tscript log: "
+    "[Response abc-1/trace1/span1/parent1] [Status] 200 [ContentType application/json] "
+    '[Body] {"x": 0}',
+    "2022-03-02T08:05:38.300000Z\tinfo\tsome other line entirely",
+    "no tabs here wasm log marker: but malformed",
+]
+
+
+class TestStripParity:
+    def test_istio_lines(self, pdas_envoy_log_lines):
+        assert native.strip_istio_proxy_prefix(ISTIO_RAW_LINES) == _python_strip(
+            ISTIO_RAW_LINES
+        )
+
+    def test_fixture_lines_kept_unchanged(self, pdas_envoy_log_lines):
+        # fixture lines have no istio prefix; both impls keep marker-less
+        # lines out and marker lines unmodified
+        wrapped = [
+            line.split("\t")[0] + "\twasm log f: " + line.split("\t", 1)[1]
+            for line in pdas_envoy_log_lines
+        ]
+        assert native.strip_istio_proxy_prefix(wrapped) == _python_strip(wrapped)
+
+
+class TestParseParity:
+    def test_fixture_lines(self, pdas_envoy_log_lines):
+        got = envoy.parse_envoy_logs(pdas_envoy_log_lines, "pdas", "pod-1").to_json()
+        want = _python_parse(pdas_envoy_log_lines, "pdas", "pod-1")
+        assert got == want
+        assert len(got) == len(pdas_envoy_log_lines)
+
+    def test_emitted_filter_lines(self):
+        lines = emit_stream_logs(
+            timestamp_ms=1646208338224.0,
+            method="POST",
+            host="a.b.svc.cluster.local",
+            path="/x?q=1",
+            status="500",
+            request_id="req-9",
+            trace_id="t9",
+            span_id="s9",
+            parent_span_id="p9",
+            request_content_type="application/json",
+            request_body=json.dumps({"k": "v", "n": [1, 2]}),
+            response_content_type="application/json",
+            response_body=json.dumps({"err": True}),
+        )
+        assert envoy.parse_envoy_logs(lines, "b", "pod").to_json() == _python_parse(
+            lines, "b", "pod"
+        )
+
+    def test_edge_cases(self):
+        lines = [
+            "time\t[Request bad id/with spaces/x/y]",           # malformed ids
+            "time\t[Request a-b/t/s/p] [GET /path] extra ]",     # extra bracket
+            "time\t[Response a_b/t1/s1/p1] [Status] 404",
+            "time\tno header at all",
+            "time\t[Request x/y] too few parts",
+            "time\t[Request a/b/c/d] [PATCH h/p] [ContentType text/plain] [Body] raw",
+            "\t[Request a/b/c/d] [Status] 7",                    # empty time
+            "time\t[Request NO_ID/NO_ID/NO_ID/NO_ID] [HEAD h]",
+        ]
+        assert _normalize(
+            envoy.parse_envoy_logs(lines, "ns", "pod").to_json()
+        ) == _normalize(_python_parse(lines, "ns", "pod"))
+
+    def test_trace_id_backfill(self):
+        lines = [
+            "t1\t[Request r1/trace9/s/p] [GET h/p]",
+            "t2\t[Response r1/NO_ID/s/p] [Status] 200",
+            "t3\t[Request r2/NO_ID/s/p] [GET h/q]",
+        ]
+        rows = envoy.parse_envoy_logs(lines, "ns", "pod").to_json()
+        assert rows[1]["traceId"] == "trace9"  # filled from requestId map
+        assert rows[2]["traceId"] == "NO_ID"
+
+
+class TestPerformance:
+    def test_native_parses_large_log_fast(self, pdas_envoy_log_lines):
+        import time
+
+        lines = pdas_envoy_log_lines * 2000  # ~14k lines, one pod log fetch
+        t0 = time.perf_counter()
+        rows = native.parse_envoy_lines(lines)
+        native_dt = time.perf_counter() - t0
+        assert rows is not None and len(rows) == len(lines)
+        # generous bound: a 14k-line pod log parses well under a second
+        assert native_dt < 1.0

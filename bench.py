@@ -66,8 +66,10 @@ def main() -> None:
         edges = window.dependency_edges(
             parent, kind_a, valid, endpoint_id, max_depth=MAX_DEPTH
         )
-        # return EVERY field so XLA cannot dead-code-eliminate any of the
-        # pipeline; the timing below gates on all of them
+        # every field returned and gated: each stage is its own jitted
+        # executable (all outputs always computed), so this is belt-and-
+        # braces against a future refactor jitting the whole pipeline,
+        # where caller-side DCE would become possible
         return tuple(stats) + tuple(edges)
 
     # warmup/compile
@@ -110,7 +112,7 @@ def main() -> None:
         risk = scorers.risk_scores(
             s.relying_factor, s.acs, replicas, req_count, err_count, cv_w, active
         )
-        # all fields, so no scorer stage is dead-code-eliminated
+        # all fields gated (see note in window_pipeline)
         return tuple(s) + tuple(coh) + tuple(risk)
 
     out = graph_refresh()

@@ -1,0 +1,48 @@
+"""Leveled logger with the reference's fatal semantics.
+
+Equivalent of /root/reference/src/utils/Logger.ts: a thin wrapper over the
+stdlib logging stack with verbose/info/warn/error levels driven by the
+LOG_LEVEL setting and a `fatal()` that logs and signals the process to
+terminate (Logger.ts:45-52 sends SIGTERM so the graceful-exit hook flushes
+caches before death; kmamiz_tpu.api.app installs that hook).
+"""
+from __future__ import annotations
+
+import logging
+import os
+import signal
+from typing import Optional
+
+VERBOSE = 5
+logging.addLevelName(VERBOSE, "VERBOSE")
+
+_LEVELS = {
+    "verbose": VERBOSE,
+    "info": logging.INFO,
+    "warn": logging.WARNING,
+    "error": logging.ERROR,
+}
+
+
+def configure(level: Optional[str] = None) -> None:
+    """Apply LOG_LEVEL (verbose|info|warn|error, Logger.ts:22-30)."""
+    from kmamiz_tpu.config import settings
+
+    name = (level or settings.log_level or "info").lower()
+    logging.getLogger("kmamiz_tpu").setLevel(_LEVELS.get(name, logging.INFO))
+
+
+def get(name: str) -> logging.Logger:
+    """Prefixed child logger (Logger.prefixed)."""
+    return logging.getLogger(f"kmamiz_tpu.{name}")
+
+
+def verbose(logger: logging.Logger, msg: str, *args) -> None:
+    logger.log(VERBOSE, msg, *args)
+
+
+def fatal(logger: logging.Logger, msg: str, *args) -> None:
+    """Log at error level and terminate via SIGTERM so the exit hook runs
+    (Logger.ts:45-52)."""
+    logger.error("FATAL: " + msg, *args)
+    os.kill(os.getpid(), signal.SIGTERM)

@@ -1,0 +1,92 @@
+"""Self-profiling: per-tick step timing + optional XLA profiler traces.
+
+The reference's self-profiling is one uniqueId->start-time latency map
+logged per realtime tick (ServiceOperator.ts:43,76-81) and debug-level
+counts in the Rust DP (data_processor.rs:111-118). SURVEY.md §5 asks the
+TPU build for real step timing plus `jax.profiler` traces; this module
+provides both:
+
+- `StepTimer` — named phase timings with running mean/max, cheap enough
+  to wrap every DP tick; exposed via `summary()` for logs or the API.
+- `trace()` — context manager that captures a TensorBoard-loadable XLA
+  profile into KMAMIZ_PROFILE_DIR when set (no-op otherwise), so a
+  production tick can be profiled by setting one env var.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import time
+from typing import Dict, Iterator, Optional
+
+
+class StepTimer:
+    """Running per-phase wall-time stats (count / mean / max, in ms)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._stats: Dict[str, Dict[str, float]] = {}
+
+    @contextlib.contextmanager
+    def phase(self, name: str) -> Iterator[None]:
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            elapsed_ms = (time.perf_counter() - start) * 1000
+            with self._lock:
+                entry = self._stats.setdefault(
+                    name, {"count": 0, "total_ms": 0.0, "max_ms": 0.0}
+                )
+                entry["count"] += 1
+                entry["total_ms"] += elapsed_ms
+                entry["max_ms"] = max(entry["max_ms"], elapsed_ms)
+
+    def summary(self) -> Dict[str, Dict[str, float]]:
+        with self._lock:
+            return {
+                name: {
+                    "count": entry["count"],
+                    "mean_ms": entry["total_ms"] / max(entry["count"], 1),
+                    "max_ms": entry["max_ms"],
+                }
+                for name, entry in self._stats.items()
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._stats.clear()
+
+
+#: process-wide timer used by the DP tick; importable anywhere
+step_timer = StepTimer()
+
+
+@contextlib.contextmanager
+def trace(label: str = "kmamiz") -> Iterator[None]:
+    """Capture an XLA profiler trace when KMAMIZ_PROFILE_DIR is set.
+
+    The trace directory is TensorBoard-loadable (`tensorboard --logdir`).
+    Nested/overlapping traces are not supported by jax.profiler, so only
+    the first concurrent caller captures; the rest proceed unprofiled.
+    """
+    profile_dir = os.environ.get("KMAMIZ_PROFILE_DIR")
+    if not profile_dir:
+        yield
+        return
+    if not _trace_guard.acquire(blocking=False):
+        yield
+        return
+    try:
+        import jax
+
+        with jax.profiler.trace(
+            os.path.join(profile_dir, label), create_perfetto_link=False
+        ):
+            yield
+    finally:
+        _trace_guard.release()
+
+
+_trace_guard = threading.Lock()

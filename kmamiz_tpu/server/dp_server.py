@@ -49,6 +49,11 @@ def make_handler(processor: DataProcessor):
             self.wfile.write(body)
 
         def do_GET(self) -> None:  # health check (main.rs:28-31)
+            if self.path.rstrip("/") == "/timings":
+                from kmamiz_tpu.core.profiling import step_timer
+
+                self._send_json(200, {"phases": step_timer.summary()})
+                return
             self._send_json(
                 200, {"status": "UP", "service": "kmamiz-tpu-data-processor"}
             )

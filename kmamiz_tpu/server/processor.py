@@ -28,6 +28,8 @@ from kmamiz_tpu.core.spans import KIND_SERVER, SpanBatch, spans_to_batch
 from kmamiz_tpu.core.timeutils import to_precise
 from kmamiz_tpu.domain.endpoint_dependencies import EndpointDependencies
 from kmamiz_tpu.domain.realtime import RealtimeDataList, parse_request_response_body
+from kmamiz_tpu.core import profiling
+from kmamiz_tpu.core.profiling import step_timer
 from kmamiz_tpu.domain.traces import Traces
 from kmamiz_tpu.graph.store import EndpointGraph
 from kmamiz_tpu.ops import window as window_ops
@@ -76,14 +78,19 @@ class DataProcessor:
     # -- the tick ------------------------------------------------------------
 
     def collect(self, request: dict) -> dict:
-        """TExternalDataProcessorRequest -> TExternalDataProcessorResponse."""
+        """TExternalDataProcessorRequest -> TExternalDataProcessorResponse.
+
+        Each phase is step-timed (GET /timings on the DP server) and the
+        device work can be captured with jax.profiler by setting
+        KMAMIZ_PROFILE_DIR (SURVEY.md §5 tracing/profiling parity)."""
         t_start = self._now_ms()
         look_back = request.get("lookBack", 30_000)
         req_time = request.get("time", int(t_start))
         existing_dep = request.get("existingDep")
 
-        trace_groups = self._trace_source(look_back, req_time, ZIPKIN_LIMIT)
-        trace_groups = self._filter_traces(trace_groups, t_start)
+        with step_timer.phase("fetch_traces"):
+            trace_groups = self._trace_source(look_back, req_time, ZIPKIN_LIMIT)
+            trace_groups = self._filter_traces(trace_groups, t_start)
 
         traces = Traces(trace_groups)
         namespaces = {
@@ -93,28 +100,36 @@ class DataProcessor:
         replicas: List[dict] = []
         structured_logs: List[dict] = []
         if self._k8s is not None:
-            replicas = self._k8s.get_replicas(namespaces)
-            pod_logs = []
-            for ns in namespaces:
-                for pod in self._k8s.get_pod_names(ns):
-                    pod_logs.append(self._k8s.get_envoy_logs(ns, pod))
-            structured_logs = EnvoyLogs.combine_to_structured_envoy_logs(pod_logs)
+            with step_timer.phase("fetch_cluster_state"):
+                replicas = self._k8s.get_replicas(namespaces)
+                pod_logs = []
+                for ns in namespaces:
+                    for pod in self._k8s.get_pod_names(ns):
+                        pod_logs.append(self._k8s.get_envoy_logs(ns, pod))
+                structured_logs = EnvoyLogs.combine_to_structured_envoy_logs(
+                    pod_logs
+                )
 
-        realtime = traces.combine_logs_to_realtime_data(structured_logs, replicas)
-        combined = self._combine(realtime, trace_groups)
-
-        dependencies = traces.to_endpoint_dependencies()
-        if existing_dep:
-            dependencies = dependencies.combine_with(
-                EndpointDependencies(existing_dep)
+        with step_timer.phase("combine_window"), profiling.trace("combine"):
+            realtime = traces.combine_logs_to_realtime_data(
+                structured_logs, replicas
             )
+            combined = self._combine(realtime, trace_groups)
+
+        with step_timer.phase("dependencies"):
+            dependencies = traces.to_endpoint_dependencies()
+            if existing_dep:
+                dependencies = dependencies.combine_with(
+                    EndpointDependencies(existing_dep)
+                )
 
         # feed the persistent device graph (serves the scorer/API path)
         if trace_groups:
-            batch = spans_to_batch(
-                trace_groups, interner=self.graph.interner
-            )
-            self.graph.merge_window(batch)
+            with step_timer.phase("graph_merge"), profiling.trace("graph_merge"):
+                batch = spans_to_batch(
+                    trace_groups, interner=self.graph.interner
+                )
+                self.graph.merge_window(batch)
 
         datatypes = [
             d.to_json()

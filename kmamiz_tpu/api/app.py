@@ -166,7 +166,10 @@ class Application:
 
 
 def main() -> None:
+    from kmamiz_tpu.core import logger as klog
+
     logging.basicConfig(level=logging.INFO)
+    klog.configure()  # apply LOG_LEVEL (Logger.ts:22-30)
     app = Application(ctx=build_production_context())
     app.start_up()
     app.listen()

@@ -42,7 +42,8 @@ def verbose(logger: logging.Logger, msg: str, *args) -> None:
 
 
 def fatal(logger: logging.Logger, msg: str, *args) -> None:
-    """Log at error level and terminate via SIGTERM so the exit hook runs
-    (Logger.ts:45-52)."""
+    """Log at error level and terminate via SIGTERM (Logger.ts:45-52).
+    The graceful cache-flush teardown only runs where a SIGTERM handler is
+    installed (kmamiz_tpu.api.app.main); other entry points just die."""
     logger.error("FATAL: " + msg, *args)
     os.kill(os.getpid(), signal.SIGTERM)

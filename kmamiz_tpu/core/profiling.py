@@ -15,7 +15,9 @@ provides both:
 from __future__ import annotations
 
 import contextlib
+import logging
 import os
+import sys
 import threading
 import time
 from typing import Dict, Iterator, Optional
@@ -62,6 +64,8 @@ class StepTimer:
 #: process-wide timer used by the DP tick; importable anywhere
 step_timer = StepTimer()
 
+logger = logging.getLogger("kmamiz_tpu.profiling")
+
 
 @contextlib.contextmanager
 def trace(label: str = "kmamiz") -> Iterator[None]:
@@ -70,23 +74,69 @@ def trace(label: str = "kmamiz") -> Iterator[None]:
     The trace directory is TensorBoard-loadable (`tensorboard --logdir`).
     Nested/overlapping traces are not supported by jax.profiler, so only
     the first concurrent caller captures; the rest proceed unprofiled.
+    At most KMAMIZ_PROFILE_COUNT traces (default 8) are captured per
+    process — the DP tick fires every few seconds forever, and an
+    unbounded capture would fill the profile volume.
     """
+    global _traces_left
     profile_dir = os.environ.get("KMAMIZ_PROFILE_DIR")
-    if not profile_dir:
+    if not profile_dir or _traces_left == 0:
         yield
         return
     if not _trace_guard.acquire(blocking=False):
         yield
         return
     try:
-        import jax
+        if _traces_left < 0:  # first capture: read the cap once
+            raw_cap = os.environ.get("KMAMIZ_PROFILE_COUNT", "8")
+            try:
+                _traces_left = max(int(raw_cap), 0)
+            except ValueError:
+                logger.warning(
+                    "KMAMIZ_PROFILE_COUNT=%r is not an integer; using 8", raw_cap
+                )
+                _traces_left = 8
+        if _traces_left == 0:  # re-check under the lock: a concurrent
+            yield  # caller may have spent the last slot after our pre-check
+            return
+        _traces_left -= 1
+        # a broken profiler (unwritable dir, plugin init failure) must never
+        # break the DP tick it wraps: disable further captures and carry on
+        capture = None
+        try:
+            import jax
 
-        with jax.profiler.trace(
-            os.path.join(profile_dir, label), create_perfetto_link=False
-        ):
+            capture = jax.profiler.trace(
+                os.path.join(profile_dir, label), create_perfetto_link=False
+            )
+            capture.__enter__()
+        except Exception as err:
+            capture = None
+            _traces_left = 0
+            logger.warning("profiler capture failed, disabling: %s", err)
+
+        def close(exc_info):
+            global _traces_left
+            if capture is None:
+                return
+            try:
+                capture.__exit__(*exc_info)
+            except Exception as err:
+                _traces_left = 0
+                logger.warning(
+                    "profiler capture teardown failed, disabling: %s", err
+                )
+
+        try:
             yield
+        except BaseException:
+            close(sys.exc_info())
+            raise
+        else:
+            close((None, None, None))
     finally:
         _trace_guard.release()
 
 
 _trace_guard = threading.Lock()
+_traces_left = -1  # -1 = cap not yet read from the environment

@@ -49,7 +49,7 @@ def make_handler(processor: DataProcessor):
             self.wfile.write(body)
 
         def do_GET(self) -> None:  # health check (main.rs:28-31)
-            if self.path.rstrip("/") == "/timings":
+            if self.path.split("?", 1)[0].rstrip("/") == "/timings":
                 from kmamiz_tpu.core.profiling import step_timer
 
                 self._send_json(200, {"phases": step_timer.summary()})

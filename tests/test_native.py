@@ -133,6 +133,8 @@ class TestPerformance:
         import time
 
         lines = pdas_envoy_log_lines * 2000  # ~14k lines, one pod log fetch
+        native.available()  # keep one-time build/load out of the timed region
+        native.parse_envoy_lines(lines[:100])
         t0 = time.perf_counter()
         rows = native.parse_envoy_lines(lines)
         native_dt = time.perf_counter() - t0

@@ -1,0 +1,113 @@
+"""Tests for the self-profiling module (SURVEY.md §5: step timing +
+capped `jax.profiler` trace capture)."""
+import contextlib
+import threading
+
+import jax
+import pytest
+
+from kmamiz_tpu.core import profiling
+
+
+@pytest.fixture()
+def capture_log(monkeypatch, tmp_path):
+    """Route trace() captures into a counter instead of the XLA profiler,
+    and reset the module's cap state around each test."""
+    captures = []
+
+    @contextlib.contextmanager
+    def fake_trace(path, create_perfetto_link=False):
+        captures.append(path)
+        yield
+
+    monkeypatch.setattr(jax.profiler, "trace", fake_trace)
+    monkeypatch.setenv("KMAMIZ_PROFILE_DIR", str(tmp_path))
+    monkeypatch.setattr(profiling, "_traces_left", -1)
+    return captures
+
+
+class TestStepTimer:
+    def test_phase_stats(self):
+        timer = profiling.StepTimer()
+        with timer.phase("a"):
+            pass
+        with timer.phase("a"):
+            pass
+        summary = timer.summary()
+        assert summary["a"]["count"] == 2
+        assert summary["a"]["max_ms"] >= summary["a"]["mean_ms"] >= 0
+        timer.reset()
+        assert timer.summary() == {}
+
+
+class TestTraceCap:
+    def test_noop_without_profile_dir(self, monkeypatch):
+        monkeypatch.delenv("KMAMIZ_PROFILE_DIR", raising=False)
+        monkeypatch.setattr(profiling, "_traces_left", -1)
+        with profiling.trace("t"):
+            pass
+        assert profiling._traces_left == -1  # env never read
+
+    def test_cap_limits_captures(self, capture_log, monkeypatch):
+        monkeypatch.setenv("KMAMIZ_PROFILE_COUNT", "2")
+        for _ in range(5):
+            with profiling.trace("t"):
+                pass
+        assert len(capture_log) == 2
+        assert profiling._traces_left == 0
+
+    def test_malformed_cap_falls_back(self, capture_log, monkeypatch):
+        monkeypatch.setenv("KMAMIZ_PROFILE_COUNT", "unlimited")
+        with profiling.trace("t"):  # must not raise out of the DP tick
+            pass
+        assert len(capture_log) == 1
+        assert profiling._traces_left == 7  # fell back to the default of 8
+
+    def test_zero_cap_disables(self, capture_log, monkeypatch):
+        monkeypatch.setenv("KMAMIZ_PROFILE_COUNT", "0")
+        with profiling.trace("t"):
+            pass
+        assert capture_log == []
+        assert profiling._traces_left == 0
+
+    def test_broken_profiler_never_breaks_the_tick(self, monkeypatch, tmp_path):
+        @contextlib.contextmanager
+        def broken_trace(path, create_perfetto_link=False):
+            raise OSError("unwritable profile dir")
+            yield
+
+        monkeypatch.setattr(jax.profiler, "trace", broken_trace)
+        monkeypatch.setenv("KMAMIZ_PROFILE_DIR", str(tmp_path))
+        monkeypatch.setenv("KMAMIZ_PROFILE_COUNT", "8")
+        monkeypatch.setattr(profiling, "_traces_left", -1)
+        ran = []
+        with profiling.trace("t"):  # must not raise out of the DP tick
+            ran.append(True)
+        assert ran == [True]
+        assert profiling._traces_left == 0  # disabled, not drained per-tick
+
+    def test_body_exception_propagates(self, capture_log):
+        with pytest.raises(RuntimeError, match="tick failed"):
+            with profiling.trace("t"):
+                raise RuntimeError("tick failed")
+        assert len(capture_log) == 1  # capture closed around the failure
+
+    def test_cap_survives_concurrent_callers(self, capture_log, monkeypatch):
+        """The last slot being spent concurrently must not resurrect the
+        'cap unread' sentinel and hand out a fresh budget."""
+        monkeypatch.setenv("KMAMIZ_PROFILE_COUNT", "1")
+        barrier = threading.Barrier(4)
+
+        def worker():
+            barrier.wait()
+            for _ in range(10):
+                with profiling.trace("t"):
+                    pass
+
+        threads = [threading.Thread(target=worker) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(capture_log) == 1
+        assert profiling._traces_left == 0

@@ -98,6 +98,10 @@ class TestDPServer:
             response = json.loads(urllib.request.urlopen(req).read())
             assert response["uniqueId"] == "http-1"
             assert len(response["combined"]) == 3
+
+            for url in (f"{base}/timings", f"{base}/timings?since=0"):
+                timings = json.loads(urllib.request.urlopen(url).read())
+                assert "phases" in timings
         finally:
             server.stop()
 

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import math
 import re
+from functools import lru_cache
 from typing import List, NamedTuple, Optional
 
 _SCHEME_RE = re.compile(r"[a-z]+://")
@@ -26,11 +27,14 @@ class ExplodedUrl(NamedTuple):
     cluster: Optional[str] = None
 
 
+@lru_cache(maxsize=4096)
 def explode_url(url: str, is_service_url: bool = False) -> ExplodedUrl:
     """Split a URL into meaningful parts.
 
     Returns (host, port, path[, service, namespace, cluster]); the port keeps
-    its leading ':' to match the reference's output shape.
+    its leading ':' to match the reference's output shape. Cached: a window
+    of spans repeats a small set of URLs thousands of times, and the result
+    is an immutable tuple of strings.
     """
     if _SCHEME_RE.search(url) is None:
         url = "://" + url

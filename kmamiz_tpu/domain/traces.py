@@ -72,6 +72,7 @@ class Traces:
 
     def to_realtime_data(self, replicas: Optional[List[dict]] = None) -> RealtimeDataList:
         """SERVER spans -> per-request realtime records (Traces.ts:27-53)."""
+        replica_of = _replica_index(replicas)
         records = []
         for t in self._flat():
             if t.get("kind") != "SERVER":
@@ -99,7 +100,7 @@ class Traces:
                         f"{unique_service_name}\t{js_str(method)}"
                         f"\t{js_str(tags.get('http.url'))}"
                     ),
-                    "replica": _find_replica(replicas, unique_service_name),
+                    "replica": replica_of.get(unique_service_name),
                 }
             )
         return RealtimeDataList(records)
@@ -111,6 +112,7 @@ class Traces:
     ) -> RealtimeDataList:
         """Join SERVER spans with structured envoy logs by (traceId, spanId),
         falling back to the parent span id (Traces.ts:55-106)."""
+        replica_of = _replica_index(replicas)
         log_map: Dict[str, Dict[str, dict]] = {}
         for l in structured_logs:
             traces = l.get("traces", [])
@@ -160,7 +162,7 @@ class Traces:
                         f"{unique_service_name}\t{js_str(method)}"
                         f"\t{js_str(tags.get('http.url'))}"
                     ),
-                    "replica": _find_replica(replicas, unique_service_name),
+                    "replica": replica_of.get(unique_service_name),
                 }
             )
         return RealtimeDataList(records)
@@ -193,16 +195,25 @@ class Traces:
                 parent_id = parent_node["span"].get("parentId")
                 depth += 1
 
+        # endpoint info is referenced once per edge endpoint; compute it once
+        # per span (URLs repeat thousands of times per window)
+        info_cache: Dict[str, dict] = {}
+
+        def info_of(sid: str) -> dict:
+            info = info_cache.get(sid)
+            if info is None:
+                info = info_cache[sid] = to_endpoint_info(span_map[sid]["span"])
+            return info
+
         dependencies = []
-        for _, node in filtered:
-            span = node["span"]
+        for span_id, node in filtered:
             upper_map: Dict[str, dict] = {}
             for sid, distance in node["upper"].items():
-                endpoint = to_endpoint_info(span_map[sid]["span"])
+                endpoint = info_of(sid)
                 upper_map[f"{endpoint['uniqueEndpointName']}\t{distance}"] = endpoint
             lower_map: Dict[str, dict] = {}
             for sid, distance in node["lower"].items():
-                endpoint = to_endpoint_info(span_map[sid]["span"])
+                endpoint = info_of(sid)
                 lower_map[f"{endpoint['uniqueEndpointName']}\t{distance}"] = endpoint
 
             depending_by = [
@@ -223,7 +234,7 @@ class Traces:
             ]
             dependencies.append(
                 {
-                    "endpoint": to_endpoint_info(span),
+                    "endpoint": info_of(span_id),
                     "lastUsageTimestamp": 0,  # filled below
                     "isDependedByExternal": len(depending_by) == 0,
                     "dependingBy": depending_by,
@@ -252,10 +263,10 @@ class Traces:
         return EndpointDependencies(dependencies)
 
 
-def _find_replica(replicas: Optional[List[dict]], unique_service_name: str):
-    if not replicas:
-        return None
-    for r in replicas:
-        if r.get("uniqueServiceName") == unique_service_name:
-            return r.get("replicas")
-    return None
+def _replica_index(replicas: Optional[List[dict]]) -> Dict[str, int]:
+    """uniqueServiceName -> replicas, first match winning like the
+    reference's Array.find."""
+    index: Dict[str, int] = {}
+    for r in replicas or []:
+        index.setdefault(r.get("uniqueServiceName"), r.get("replicas"))
+    return index

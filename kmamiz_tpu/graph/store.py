@@ -38,6 +38,27 @@ def _merge_edges(src_a, dst_a, dist_a, mask_a, src_b, dst_b, dist_b, mask_b):
     return s, d, ds, valid
 
 
+@jax.jit
+def _window_merge(parent_idx, kind, valid, endpoint_id, src, dst, dist, mask):
+    """Fused window edge-extraction + set-union merge.
+
+    One jitted program per (batch-capacity, store-capacity) bucket so a
+    realtime tick costs a single device round trip: the only host sync is
+    the returned valid-edge count scalar."""
+    edges = window_ops.dependency_edges(parent_idx, kind, valid, endpoint_id)
+    s, d, ds, v = _merge_edges(
+        src,
+        dst,
+        dist,
+        mask,
+        edges.ancestor_ep.reshape(-1),
+        edges.descendant_ep.reshape(-1),
+        edges.distance.reshape(-1),
+        edges.mask.reshape(-1),
+    )
+    return s, d, ds, v, v.sum()
+
+
 class EndpointGraph:
     """Capacity-padded edge set keyed (src_ep -> dst_ep, distance).
 
@@ -56,6 +77,7 @@ class EndpointGraph:
         self._dst = jnp.full(capacity, SENTINEL, dtype=jnp.int32)
         self._dist = jnp.full(capacity, SENTINEL, dtype=jnp.int32)
         self._n_edges = 0
+        self._pending = None  # deferred (src, dst, dist, count) of last merge
         # per-endpoint host-side metadata, padded on demand
         self._ep_record = np.zeros(0, dtype=bool)
         self._ep_last_ts = np.zeros(0, dtype=np.float64)
@@ -64,20 +86,13 @@ class EndpointGraph:
 
     @property
     def capacity(self) -> int:
+        self._finalize_pending()
         return int(self._src.shape[0])
 
     @property
     def n_edges(self) -> int:
+        self._finalize_pending()
         return self._n_edges
-
-    def _grow(self, needed: int) -> None:
-        if needed <= self.capacity:
-            return
-        new_cap = _pow2(needed, minimum=self.capacity)
-        pad = jnp.full(new_cap - self.capacity, SENTINEL, dtype=jnp.int32)
-        self._src = jnp.concatenate([self._src, pad])
-        self._dst = jnp.concatenate([self._dst, pad])
-        self._dist = jnp.concatenate([self._dist, pad])
 
     def _ensure_ep_arrays(self, n: int) -> None:
         if len(self._ep_record) < n:
@@ -94,37 +109,27 @@ class EndpointGraph:
     def merge_window(self, batch: SpanBatch) -> None:
         """Union this window's dependency edges into the store and update
         per-endpoint record/last-usage metadata."""
-        edges = window_ops.dependency_edges(
+        self._finalize_pending()
+        src, dst, dist, _valid, valid_count = _window_merge(
             jnp.asarray(batch.parent_idx),
             jnp.asarray(batch.kind),
             jnp.asarray(batch.valid),
             jnp.asarray(batch.endpoint_id),
-        )
-        new_src = edges.ancestor_ep.reshape(-1)
-        new_dst = edges.descendant_ep.reshape(-1)
-        new_dist = edges.distance.reshape(-1)
-        new_mask = edges.mask.reshape(-1)
-
-        self._grow(self._n_edges + int(new_mask.sum()))
-        src, dst, dist, valid = _merge_edges(
             self._src,
             self._dst,
             self._dist,
             self._src != SENTINEL,
-            new_src,
-            new_dst,
-            new_dist,
-            new_mask,
         )
-        valid_count = int(valid.sum())
-        self._grow(valid_count)
-        cap = self.capacity
-        self._src = src[:cap]
-        self._dst = dst[:cap]
-        self._dist = dist[:cap]
-        self._n_edges = valid_count
+        # Defer the count sync: dispatch is async, so the tick returns without
+        # blocking on the device round trip; the copy streams back in the
+        # background and _finalize_pending() resolves it on next access.
+        try:
+            valid_count.copy_to_host_async()
+        except AttributeError:  # older jax.Array without the method
+            pass
+        self._pending = (src, dst, dist, valid_count)
 
-        # endpoint metadata
+        # endpoint metadata (host-side, no device sync)
         n_ep = len(self.interner.endpoints)
         self._ensure_ep_arrays(n_ep)
         server_eps = batch.endpoint_id[batch.valid & (batch.kind == KIND_SERVER)]
@@ -136,10 +141,34 @@ class EndpointGraph:
                     self._ep_last_ts[eid], info["timestamp"]
                 )
 
+    def _finalize_pending(self) -> None:
+        """Resolve the deferred merge: fetch the edge count and re-pad the
+        merged arrays to the next power-of-2 capacity."""
+        pending = self._pending
+        if pending is None:
+            return
+        self._pending = None
+        src, dst, dist, valid_count = pending
+        valid_count = int(valid_count)
+        new_cap = _pow2(valid_count, minimum=self.capacity)
+        merged_len = int(src.shape[0])
+        if new_cap <= merged_len:
+            # compact_unique packs valid edges first, so the prefix is exact
+            self._src = src[:new_cap]
+            self._dst = dst[:new_cap]
+            self._dist = dist[:new_cap]
+        else:
+            pad = jnp.full(new_cap - merged_len, SENTINEL, dtype=jnp.int32)
+            self._src = jnp.concatenate([src, pad])
+            self._dst = jnp.concatenate([dst, pad])
+            self._dist = jnp.concatenate([dist, pad])
+        self._n_edges = valid_count
+
     # -- views ---------------------------------------------------------------
 
     def edge_arrays(self):
         """(src_ep, dst_ep, dist, mask) views of the stored edges."""
+        self._finalize_pending()
         mask = self._src != SENTINEL
         return self._src, self._dst, self._dist, mask
 

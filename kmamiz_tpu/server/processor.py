@@ -20,6 +20,7 @@ from __future__ import annotations
 import time
 from typing import Callable, Dict, List, Optional, Sequence
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -36,6 +37,17 @@ from kmamiz_tpu.ops import window as window_ops
 
 PROCESSED_TRACE_TTL_MS = 300_000  # Rust DP keeps the dedup map for 5 min
 ZIPKIN_LIMIT = 2_500
+
+
+@jax.jit
+def _pack_stats(count, mean, cv, ts_rel):
+    """Pack the per-segment stats into ONE device buffer so the host pays a
+    single transfer round trip (the tunneled-TPU RTT dominates small
+    transfers). int32 timestamps ride along losslessly via bitcast."""
+    import jax.lax as lax
+
+    ts_bits = lax.bitcast_convert_type(ts_rel, jnp.float32)
+    return jnp.stack([count, mean, cv, ts_bits])
 
 
 class DataProcessor:
@@ -265,10 +277,18 @@ def device_window_stats(records: List[dict]) -> Dict[tuple, dict]:
         num_endpoints=num_endpoints,
         num_statuses=num_statuses,
     )
-    count = np.asarray(stats.count)
-    mean = np.asarray(stats.latency_mean)
-    cv = np.asarray(stats.latency_cv)
-    ts = np.asarray(stats.latest_timestamp_rel).astype(np.int64) + ts_base
+    # one batched device->host transfer: individual np.asarray calls each
+    # pay a full device-sync round trip (expensive on a tunneled TPU)
+    packed = jax.device_get(
+        _pack_stats(
+            stats.count.astype(jnp.float32),
+            stats.latency_mean.astype(jnp.float32),
+            stats.latency_cv.astype(jnp.float32),
+            stats.latest_timestamp_rel,
+        )
+    )
+    count, mean, cv = packed[0], packed[1], packed[2]
+    ts = packed[3].view(np.int32).astype(np.int64) + ts_base
 
     out: Dict[tuple, dict] = {}
     for e in range(len(endpoints)):

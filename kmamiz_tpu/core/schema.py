@@ -149,6 +149,9 @@ class _InterfaceEmitter:
         return f"{name}{i}"
 
     def process_shape(self, name_hint: str, samples: Sequence[dict]) -> str:
+        # a top-level array can mix dicts with nested arrays; only dict
+        # samples contribute fields (non-dicts would crash the key walk)
+        samples = [s for s in samples if isinstance(s, dict)]
         sig = self._shape_sig(samples)
         existing = self._sig_to_name.get(sig)
         if existing is not None:
@@ -342,6 +345,94 @@ def merge_string_body(a: Optional[str], b: Optional[str]) -> Optional[str]:
 def json_stringify(obj: Any) -> str:
     """JSON.stringify-compatible serialization (compact separators)."""
     return json.dumps(obj, separators=(",", ":"), ensure_ascii=False)
+
+
+def fold_string_bodies(bodies: Sequence[Optional[str]]) -> Optional[str]:
+    """Left-fold merge_string_body over a group's bodies (the per-group loop
+    in RealtimeDataList.toCombinedRealtimeData)."""
+    if not bodies:
+        return None
+    acc = bodies[0]
+    for body in bodies[1:]:
+        acc = merge_string_body(acc, body)
+    return acc
+
+
+def _parse_and_infer(
+    merged: Optional[str],
+    content_type: Optional[str],
+    precomputed_interface: Optional[str] = None,
+) -> Tuple[Optional[Any], Optional[str]]:
+    """json.loads the merged body and infer its interface when the content
+    type is JSON (one side of parse_request_response_body)."""
+    if content_type != "application/json":
+        return None, None
+    try:
+        body = json.loads(merged)
+    except (json.JSONDecodeError, TypeError):
+        return None, None
+    interface = (
+        precomputed_interface
+        if precomputed_interface is not None
+        else object_to_interface_string(body)
+    )
+    return body, interface
+
+
+def body_pairs_for_groups(
+    row_groups: Sequence[Sequence[dict]],
+) -> List[Tuple[List[Optional[str]], Optional[str]]]:
+    """Build the (bodies, content_type) pairs merge_and_infer_bodies expects
+    from per-(endpoint, status) row groups: two pairs per group, request at
+    2*i and response at 2*i+1 (the convention both the realtime combine and
+    the DataProcessor assembly rely on)."""
+    pairs: List[Tuple[List[Optional[str]], Optional[str]]] = []
+    for rows in row_groups:
+        pairs.append(
+            (
+                [r.get("requestBody") for r in rows],
+                rows[0].get("requestContentType"),
+            )
+        )
+        pairs.append(
+            (
+                [r.get("responseBody") for r in rows],
+                rows[0].get("responseContentType"),
+            )
+        )
+    return pairs
+
+
+def merge_and_infer_bodies(
+    pairs: Sequence[Tuple[Sequence[Optional[str]], Optional[str]]],
+) -> List[Tuple[Optional[Any], Optional[str]]]:
+    """Batched body pipeline: for each (bodies, content_type) pair, fold the
+    group's raw JSON bodies with merge_string_body and, for JSON content,
+    return (parsed_merged_body, interface_string).
+
+    Runs on the native C++ path (native/kmamiz_json.cpp — the Rust
+    json_utils.rs twin) when available, falling back per group or wholesale
+    to the pure-Python implementations above.
+    """
+    from kmamiz_tpu import native
+
+    results = native.process_body_groups(
+        [(bodies, ct == "application/json") for bodies, ct in pairs]
+    )
+    out: List[Tuple[Optional[Any], Optional[str]]] = []
+    if results is None or len(results) != len(pairs):
+        for bodies, ct in pairs:
+            out.append(_parse_and_infer(fold_string_bodies(bodies), ct))
+        return out
+    for (bodies, ct), res in zip(pairs, results):
+        if res is None:  # native delegated this group (deep nesting)
+            out.append(_parse_and_infer(fold_string_bodies(bodies), ct))
+            continue
+        merged, interface, needs_python = res
+        out.append(
+            _parse_and_infer(merged, ct, None if needs_python else interface)
+        )
+    return out
 
 
 # ---------------------------------------------------------------------------

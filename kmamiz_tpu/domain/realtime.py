@@ -75,60 +75,52 @@ class RealtimeDataList:
         for r in self._realtime_data:
             by_endpoint.setdefault(r["uniqueEndpointName"], []).append(r)
 
-        combined_out: List[dict] = []
+        # flatten the (endpoint, status) groups so the body merge + schema
+        # inference runs as ONE batched native call (merge_and_infer_bodies)
+        groups: List[tuple] = []
         for group in by_endpoint.values():
             by_status: dict = {}
             for r in group:
                 by_status.setdefault(r["status"], []).append(r)
-            sample = group[0]
-            base = {
-                "uniqueServiceName": sample["uniqueServiceName"],
-                "uniqueEndpointName": sample["uniqueEndpointName"],
-                "service": sample["service"],
-                "namespace": sample["namespace"],
-                "version": sample["version"],
-                "method": sample["method"],
-            }
             for status, sub_group in by_status.items():
-                mean, cv = welford_mean_cv([r["latency"] for r in sub_group])
+                groups.append((group[0], status, sub_group))
 
-                request_body = sub_group[0].get("requestBody")
-                response_body = sub_group[0].get("responseBody")
-                timestamp = sub_group[0]["timestamp"]
-                replica = sub_group[0].get("replica")
-                for curr in sub_group[1:]:
-                    request_body = schema.merge_string_body(
-                        request_body, curr.get("requestBody")
-                    )
-                    response_body = schema.merge_string_body(
-                        response_body, curr.get("responseBody")
-                    )
-                    timestamp = max(timestamp, curr["timestamp"])
-                    if replica and curr.get("replica"):
-                        replica += curr["replica"]
+        merged_bodies = schema.merge_and_infer_bodies(
+            schema.body_pairs_for_groups([g[2] for g in groups])
+        )
 
-                parsed = parse_request_response_body(
-                    {
-                        "requestBody": request_body,
-                        "requestContentType": sub_group[0].get("requestContentType"),
-                        "responseBody": response_body,
-                        "responseContentType": sub_group[0].get("responseContentType"),
-                    }
-                )
-                combined_out.append(
-                    {
-                        **base,
-                        "status": status,
-                        "combined": len(sub_group),
-                        "requestBody": parsed["requestBody"],
-                        "requestSchema": parsed["requestSchema"],
-                        "responseBody": parsed["responseBody"],
-                        "responseSchema": parsed["responseSchema"],
-                        "avgReplica": (replica / len(sub_group)) if replica else None,
-                        "latestTimestamp": timestamp,
-                        "latency": {"mean": to_precise(mean), "cv": to_precise(cv)},
-                        "requestContentType": sub_group[0].get("requestContentType"),
-                        "responseContentType": sub_group[0].get("responseContentType"),
-                    }
-                )
+        combined_out: List[dict] = []
+        for i, (sample, status, sub_group) in enumerate(groups):
+            mean, cv = welford_mean_cv([r["latency"] for r in sub_group])
+
+            timestamp = sub_group[0]["timestamp"]
+            replica = sub_group[0].get("replica")
+            for curr in sub_group[1:]:
+                timestamp = max(timestamp, curr["timestamp"])
+                if replica and curr.get("replica"):
+                    replica += curr["replica"]
+
+            request_body, request_schema = merged_bodies[2 * i]
+            response_body, response_schema = merged_bodies[2 * i + 1]
+            combined_out.append(
+                {
+                    "uniqueServiceName": sample["uniqueServiceName"],
+                    "uniqueEndpointName": sample["uniqueEndpointName"],
+                    "service": sample["service"],
+                    "namespace": sample["namespace"],
+                    "version": sample["version"],
+                    "method": sample["method"],
+                    "status": status,
+                    "combined": len(sub_group),
+                    "requestBody": request_body,
+                    "requestSchema": request_schema,
+                    "responseBody": response_body,
+                    "responseSchema": response_schema,
+                    "avgReplica": (replica / len(sub_group)) if replica else None,
+                    "latestTimestamp": timestamp,
+                    "latency": {"mean": to_precise(mean), "cv": to_precise(cv)},
+                    "requestContentType": sub_group[0].get("requestContentType"),
+                    "responseContentType": sub_group[0].get("responseContentType"),
+                }
+            )
         return CombinedRealtimeDataList(combined_out)

@@ -13,10 +13,11 @@ from __future__ import annotations
 import ctypes
 import logging
 import os
+import struct
 import subprocess
 import threading
 from pathlib import Path
-from typing import List, Optional
+from typing import List, Optional, Sequence, Tuple
 
 logger = logging.getLogger("kmamiz_tpu.native")
 
@@ -24,7 +25,10 @@ _FIELD_SEP = "\x1f"
 _RECORD_SEP = "\x1e"
 
 _REPO_ROOT = Path(__file__).resolve().parent.parent.parent
-_SOURCE = _REPO_ROOT / "native" / "kmamiz_native.cpp"
+_SOURCES = [
+    _REPO_ROOT / "native" / "kmamiz_native.cpp",
+    _REPO_ROOT / "native" / "kmamiz_json.cpp",
+]
 _BUILD_DIR = _REPO_ROOT / "native" / "build"
 _LIB_PATH = _BUILD_DIR / "libkmamiz_native.so"
 
@@ -34,7 +38,7 @@ _load_failed = False
 
 
 def _build() -> bool:
-    if not _SOURCE.exists():
+    if not all(src.exists() for src in _SOURCES):
         return False
     _BUILD_DIR.mkdir(parents=True, exist_ok=True)
     cmd = [
@@ -45,7 +49,7 @@ def _build() -> bool:
         "-std=c++17",
         "-o",
         str(_LIB_PATH),
-        str(_SOURCE),
+        *[str(src) for src in _SOURCES],
     ]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
@@ -62,20 +66,33 @@ def _load() -> Optional[ctypes.CDLL]:
     with _lock:
         if _lib is not None or _load_failed:
             return _lib
-        if not _LIB_PATH.exists() or (
-            _SOURCE.exists()
-            and _SOURCE.stat().st_mtime > _LIB_PATH.stat().st_mtime
+        if not _LIB_PATH.exists() or any(
+            src.exists() and src.stat().st_mtime > _LIB_PATH.stat().st_mtime
+            for src in _SOURCES
         ):
             if not _build():
                 _load_failed = True
                 return None
-        try:
-            lib = ctypes.CDLL(str(_LIB_PATH))
-        except OSError as err:
-            logger.warning("native load failed: %s", err)
+        lib = _open_and_bind()
+        if lib is None and _build():
+            # a stale prebuilt .so can miss newer symbols even when the
+            # mtime check passed (restored build caches); rebuild once
+            lib = _open_and_bind()
+        if lib is None:
             _load_failed = True
             return None
-        for name in ("km_parse_envoy_lines", "km_strip_istio_prefix"):
+        _lib = lib
+        return _lib
+
+
+def _open_and_bind() -> Optional[ctypes.CDLL]:
+    try:
+        lib = ctypes.CDLL(str(_LIB_PATH))
+        for name in (
+            "km_parse_envoy_lines",
+            "km_strip_istio_prefix",
+            "km_process_body_groups",
+        ):
             fn = getattr(lib, name)
             fn.argtypes = [
                 ctypes.c_char_p,
@@ -85,8 +102,10 @@ def _load() -> Optional[ctypes.CDLL]:
             fn.restype = ctypes.c_void_p
         lib.km_free.argtypes = [ctypes.c_void_p]
         lib.km_free.restype = None
-        _lib = lib
-        return _lib
+        return lib
+    except (OSError, AttributeError) as err:
+        logger.warning("native load failed: %s", err)
+        return None
 
 
 def available() -> bool:
@@ -167,3 +186,87 @@ def parse_envoy_lines(lines: List[str]) -> Optional[List[dict]]:
             }
         )
     return records
+
+
+# ---------------------------------------------------------------------------
+# batched JSON body merge + schema inference (native/kmamiz_json.cpp, the
+# C++ twin of the reference's Rust json_utils.rs)
+# ---------------------------------------------------------------------------
+
+BodyGroup = Tuple[Sequence[Optional[str]], bool]  # (bodies, want_interface)
+
+
+def process_body_groups(
+    groups: Sequence[BodyGroup],
+) -> Optional[List[Optional[Tuple[Optional[str], Optional[str], bool]]]]:
+    """Fold merge_string_body over each group's bodies and (optionally) infer
+    the merged body's interface string, all in one native call.
+
+    Returns one entry per group:
+      (merged_body_or_None, interface_or_None, interface_needs_python)
+    or None for a group the native side delegates back to pure Python
+    (excessive nesting). Returns None overall when the extension is
+    unavailable or the call fails.
+    """
+    lib = _load()
+    if lib is None:
+        return None
+    buf = bytearray()
+    buf += struct.pack("<I", len(groups))
+    for bodies, want_interface in groups:
+        buf.append(1 if want_interface else 0)
+        buf += struct.pack("<I", len(bodies))
+        for body in bodies:
+            if body is None:
+                buf.append(0)
+            else:
+                raw = body.encode("utf-8", "surrogatepass")
+                buf.append(1)
+                buf += struct.pack("<I", len(raw))
+                buf += raw
+
+    out_len = ctypes.c_size_t(0)
+    payload = bytes(buf)
+    ptr = lib.km_process_body_groups(payload, len(payload), ctypes.byref(out_len))
+    if not ptr:
+        return None
+    try:
+        raw_out = ctypes.string_at(ptr, out_len.value)
+    finally:
+        lib.km_free(ptr)
+
+    try:
+        pos = 0
+        (n_groups,) = struct.unpack_from("<I", raw_out, pos)
+        pos += 4
+        results: List[Optional[Tuple[Optional[str], Optional[str], bool]]] = []
+        for _ in range(n_groups):
+            status = raw_out[pos]
+            pos += 1
+            if status == 1:  # python-fallback group
+                results.append(None)
+                continue
+            merged: Optional[str] = None
+            if raw_out[pos]:
+                pos += 1
+                (mlen,) = struct.unpack_from("<I", raw_out, pos)
+                pos += 4
+                merged = raw_out[pos : pos + mlen].decode("utf-8", "surrogatepass")
+                pos += mlen
+            else:
+                pos += 1
+            iface_flag = raw_out[pos]
+            pos += 1
+            interface: Optional[str] = None
+            if iface_flag == 1:
+                (ilen,) = struct.unpack_from("<I", raw_out, pos)
+                pos += 4
+                interface = raw_out[pos : pos + ilen].decode(
+                    "utf-8", "surrogatepass"
+                )
+                pos += ilen
+            results.append((merged, interface, iface_flag == 2))
+        return results
+    except (struct.error, IndexError):
+        logger.warning("native body-group decode failed, using Python path")
+        return None

@@ -28,7 +28,7 @@ from kmamiz_tpu.core.envoy import EnvoyLogs
 from kmamiz_tpu.core.spans import KIND_SERVER, SpanBatch, spans_to_batch
 from kmamiz_tpu.core.timeutils import to_precise
 from kmamiz_tpu.domain.endpoint_dependencies import EndpointDependencies
-from kmamiz_tpu.domain.realtime import RealtimeDataList, parse_request_response_body
+from kmamiz_tpu.domain.realtime import RealtimeDataList
 from kmamiz_tpu.core import profiling
 from kmamiz_tpu.core.profiling import step_timer
 from kmamiz_tpu.domain.traces import Traces
@@ -180,34 +180,29 @@ class DataProcessor:
             groups.setdefault((r["uniqueEndpointName"], r["status"]), []).append(r)
 
         stats = device_window_stats(records)
+
+        # overlap the device stats round trip conceptually: the body merge +
+        # schema inference for ALL groups goes through one batched native
+        # call (kmamiz_tpu.core.schema.merge_and_infer_bodies)
+        from kmamiz_tpu.core import schema
+
+        group_items = list(groups.items())
+        merged_bodies = schema.merge_and_infer_bodies(
+            schema.body_pairs_for_groups([rows for _key, rows in group_items])
+        )
+
         out: List[dict] = []
-        for (uen, status), rows in groups.items():
+        for i, ((uen, status), rows) in enumerate(group_items):
             seg_stats = stats[(uen, status)]
             sample = rows[0]
 
-            request_body = rows[0].get("requestBody")
-            response_body = rows[0].get("responseBody")
             replica = rows[0].get("replica")
             for curr in rows[1:]:
-                from kmamiz_tpu.core import schema
-
-                request_body = schema.merge_string_body(
-                    request_body, curr.get("requestBody")
-                )
-                response_body = schema.merge_string_body(
-                    response_body, curr.get("responseBody")
-                )
                 if replica and curr.get("replica"):
                     replica += curr["replica"]
 
-            parsed = parse_request_response_body(
-                {
-                    "requestBody": request_body,
-                    "requestContentType": sample.get("requestContentType"),
-                    "responseBody": response_body,
-                    "responseContentType": sample.get("responseContentType"),
-                }
-            )
+            request_body, request_schema = merged_bodies[2 * i]
+            response_body, response_schema = merged_bodies[2 * i + 1]
             out.append(
                 {
                     "uniqueServiceName": sample["uniqueServiceName"],
@@ -218,10 +213,10 @@ class DataProcessor:
                     "method": sample["method"],
                     "status": status,
                     "combined": seg_stats["count"],
-                    "requestBody": parsed["requestBody"],
-                    "requestSchema": parsed["requestSchema"],
-                    "responseBody": parsed["responseBody"],
-                    "responseSchema": parsed["responseSchema"],
+                    "requestBody": request_body,
+                    "requestSchema": request_schema,
+                    "responseBody": response_body,
+                    "responseSchema": response_schema,
                     "avgReplica": (replica / len(rows)) if replica else None,
                     "latestTimestamp": seg_stats["latest_timestamp"],
                     "latency": {

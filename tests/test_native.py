@@ -141,3 +141,211 @@ class TestPerformance:
         assert rows is not None and len(rows) == len(lines)
         # generous bound: a 14k-line pod log parses well under a second
         assert native_dt < 1.0
+
+
+# ---------------------------------------------------------------------------
+# JSON body pipeline parity (native/kmamiz_json.cpp vs core.schema)
+# ---------------------------------------------------------------------------
+
+
+def _python_group(bodies, want_interface):
+    """Pure-Python reference for one (bodies, want_interface) group."""
+    from kmamiz_tpu.core import schema
+
+    merged = schema.fold_string_bodies(bodies)
+    interface = None
+    if want_interface and merged:
+        try:
+            interface = schema.object_to_interface_string(json.loads(merged))
+        except (json.JSONDecodeError, TypeError):
+            interface = None
+    return merged, interface
+
+
+def _assert_groups_match(groups):
+    results = native.process_body_groups(groups)
+    assert results is not None and len(results) == len(groups)
+    for (bodies, want_iface), res in zip(groups, results):
+        want_merged, want_interface = _python_group(bodies, want_iface)
+        assert res is not None, (bodies, "unexpected native delegation")
+        merged, interface, needs_python = res
+        assert merged == want_merged, (bodies, merged, want_merged)
+        if not needs_python:
+            assert interface == want_interface, (bodies, interface, want_interface)
+
+
+class TestBodyGroupParity:
+    def test_basic_merges(self):
+        _assert_groups_match(
+            [
+                (['{"a":1,"b":[1,2,3]}', '{"b":[4],"c":"x"}'], True),
+                (['{"a":{"deep":1}}', '{"a":{"other":2}}'], True),  # shallow!
+                ([None, '{"z":0}'], True),
+                (['{"z":0}', None], True),
+                ([None, None], True),
+                (['not json', '{"k":1}'], True),
+                (['{"k":1}', 'not json'], True),
+                (['not json', 'also not'], True),
+                ([""], False),
+                (["", None], True),
+                ([None, ""], True),
+                (['{"k":1}'], True),  # single body passes through verbatim
+                (['{"k": 1}'], True),  # ...whitespace preserved
+            ]
+        )
+
+    def test_js_merge_semantics(self):
+        _assert_groups_match(
+            [
+                # array limit 10 on each side
+                ([json.dumps(list(range(30))), json.dumps(list(range(100, 125)))], True),
+                # string spread by index
+                (['"abc"', '"de"'], True),
+                # number + object -> object spread drops the number
+                (["42", '{"a":1}'], True),
+                (['{"a":1}', "42"], True),
+                # falsy JSON values: 0, "", null, false -> `a or b` paths
+                (["0", '{"a":1}'], True),
+                (['{"a":1}', "0"], True),
+                (["0", "null"], True),
+                (["false", "false"], True),
+                # mixed array/object -> truthy wins
+                (["[1,2]", '{"a":1}'], True),
+                (['{"a":1}', "[1,2]"], True),
+                (["[1,2]", "0"], True),
+                # duplicate keys: first position, last value
+                (['{"a":1,"b":2,"a":3}', '{"b":9}'], True),
+            ]
+        )
+
+    def test_interface_shapes(self):
+        _assert_groups_match(
+            [
+                # shared-subtype dedup: two fields with identical shape
+                (['{"x":{"a":1},"y":{"a":2}}'], True),
+                # name collision -> Name2
+                (['{"x":{"a":1},"y":{"a":"s"}}'], True),
+                # arrays of objects, singularized item name
+                (['{"items":[{"id":1},{"id":2,"extra":"x"}]}'], True),
+                # optional fields via null and via absence across array items
+                (['{"rows":[{"a":1},{"b":2}],"n":null}'], True),
+                # top-level arrays
+                (["[1,2,3]", "[4]"], True),
+                (['[{"a":1},{"a":2}]'], True),
+                (["[]", "[]"], True),
+                # nested empty containers
+                (['{"e":{},"l":[]}'], True),
+                # mixed primitive types degrade to any
+                (['{"v":[1,"two",true]}'], True),
+                # top-level primitives
+                (['"hello"', None], True),
+                (["123"], True),
+                (["true"], True),
+                # unicode values stay native; unicode-initial keys delegate
+                (['{"msg":"héllo wörld"}'], True),
+                (['{"日本":1}'], True),
+            ]
+        )
+
+    def test_unicode_initial_key_delegates_to_python(self):
+        results = native.process_body_groups([(['{"日本":{"a":1}}'], True)])
+        (res,) = results
+        assert res is not None
+        merged, _interface, needs_python = res
+        assert merged == '{"日本":{"a":1}}'
+        assert needs_python  # Python computes the interface for this group
+
+    def test_deep_nesting_delegates(self):
+        deep = "[" * 300 + "]" * 300
+        results = native.process_body_groups([([deep, deep], True)])
+        assert results == [None]  # whole group delegated
+
+    def test_randomized_parity(self):
+        import random
+
+        rng = random.Random(1234)
+        keys = ["a", "b", "items", "data", "ids", "values", "x", "name", "addresses"]
+
+        def gen(depth=0):
+            choices = ["num", "str", "bool", "null"]
+            if depth < 4:
+                choices += ["obj", "obj", "arr", "arr"]
+            kind = rng.choice(choices)
+            if kind == "num":
+                return rng.choice([0, 1, -5, 3.25, 1e9, 0.0001, 7])
+            if kind == "str":
+                return rng.choice(["", "s", "hello", "héllo", "a/b?c=1"])
+            if kind == "bool":
+                return rng.choice([True, False])
+            if kind == "null":
+                return None
+            if kind == "arr":
+                return [gen(depth + 1) for _ in range(rng.randint(0, 13))]
+            return {
+                rng.choice(keys): gen(depth + 1)
+                for _ in range(rng.randint(0, 5))
+            }
+
+        groups = []
+        for _ in range(300):
+            bodies = []
+            for _ in range(rng.randint(1, 5)):
+                r = rng.random()
+                if r < 0.1:
+                    bodies.append(None)
+                elif r < 0.15:
+                    bodies.append("not json {")
+                else:
+                    bodies.append(json.dumps(gen(), separators=(",", ":"), ensure_ascii=False))
+            groups.append((bodies, True))
+        _assert_groups_match(groups)
+
+    def test_merge_and_infer_bodies_end_to_end(self):
+        """The schema-level batched helper equals the sequential pure path."""
+        from kmamiz_tpu.core import schema
+
+        pairs = [
+            ([
+                '{"price":1,"tags":["a"]}',
+                '{"price":2.5,"tags":["b","c"],"extra":{"k":1}}',
+            ], "application/json"),
+            (['{"x":1}'], "text/plain"),  # non-JSON content type -> (None, None)
+            ([None], "application/json"),
+            (["junk", '{"ok":true}'], "application/json"),
+        ]
+        got = schema.merge_and_infer_bodies(pairs)
+        want = []
+        for bodies, ct in pairs:
+            merged = schema.fold_string_bodies(bodies)
+            want.append(schema._parse_and_infer(merged, ct))
+        assert got == want
+
+    def test_combined_record_parity_native_vs_python(self, monkeypatch):
+        """RealtimeDataList.to_combined_realtime_data yields identical records
+        with and without the native extension."""
+        from kmamiz_tpu.domain.realtime import RealtimeDataList
+
+        rows = []
+        for i in range(6):
+            rows.append(
+                {
+                    "uniqueServiceName": "svc\tns\tv1",
+                    "uniqueEndpointName": f"svc\tns\tv1\tGET\thttp://svc.ns.svc/a/{i % 2}",
+                    "service": "svc",
+                    "namespace": "ns",
+                    "version": "v1",
+                    "method": "GET",
+                    "status": "200" if i % 3 else "500",
+                    "latency": 10.0 * (i + 1),
+                    "timestamp": 1_700_000_000_000 + i,
+                    "replica": 2,
+                    "requestBody": json.dumps({"q": i, "tags": ["x"] * (i + 1)}),
+                    "requestContentType": "application/json",
+                    "responseBody": json.dumps({"ok": i % 3 == 0, "n": i}),
+                    "responseContentType": "application/json",
+                }
+            )
+        native_out = RealtimeDataList(rows).to_combined_realtime_data().to_json()
+        monkeypatch.setattr(native, "process_body_groups", lambda _g: None)
+        python_out = RealtimeDataList(rows).to_combined_realtime_data().to_json()
+        assert native_out == python_out

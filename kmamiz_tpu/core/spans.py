@@ -61,6 +61,9 @@ class SpanBatch:
     timestamp_rel: np.ndarray  # int32[capacity] (µs offset from ts_base_us;
     # absolute µs don't fit int32 and the TPU path runs with x64 off)
     ts_base_us: int
+    # trace membership (index of the first trace group a span id appeared
+    # in); feeds pack_trace_rows for the MXU ancestor-walk layout
+    trace_of: np.ndarray  # int32[capacity]
 
     interner: EndpointInterner
     statuses: StringInterner
@@ -101,9 +104,12 @@ def spans_to_batch(
     statuses = statuses or StringInterner()
 
     span_map: Dict[str, dict] = {}
-    for group in trace_groups:
+    trace_of_id: Dict[str, int] = {}
+    for g, group in enumerate(trace_groups):
         for span in group:
             span_map[span["id"]] = span
+            # first-position wins, like the span map itself
+            trace_of_id.setdefault(span["id"], g)
     spans = list(span_map.values())
     index_of = {span_id: i for i, span_id in enumerate(span_map.keys())}
 
@@ -121,6 +127,9 @@ def spans_to_batch(
     status_class = np.zeros(capacity, dtype=np.int8)
     latency_ms = np.zeros(capacity, dtype=np.float64)
     timestamp_us = np.zeros(capacity, dtype=np.int64)
+    trace_of = np.zeros(capacity, dtype=np.int32)
+    for i, span_id in enumerate(span_map.keys()):
+        trace_of[i] = trace_of_id[span_id]
 
     for i, span in enumerate(spans):
         valid[i] = True
@@ -199,7 +208,87 @@ def spans_to_batch(
         timestamp_us=timestamp_us,
         timestamp_rel=timestamp_rel,
         ts_base_us=ts_base,
+        trace_of=trace_of,
         interner=interner,
         statuses=statuses,
         endpoint_infos=endpoint_infos,
     )
+
+
+ROW_SLOTS = 64  # spans per packed trace row (the MXU ancestor-walk tile)
+
+
+class PackedRows:
+    """Trace-row packing of a SpanBatch for the matmul ancestor walk.
+
+    Each trace occupies a contiguous run of slots inside one ROW_SLOTS-slot
+    row, so parent pointers become row-local and the CLIENT-skip /
+    ancestor-chain gathers lower to batched one-hot einsums on the MXU
+    (kmamiz_tpu.ops.window.dependency_edges_packed) instead of HBM gathers.
+    Traces are bucketed by next-power-of-two size (vectorized packing, at
+    most 2x slot waste); rows are padded to a power of two.
+    """
+
+    __slots__ = ("row_of", "slot_of", "n_rows", "n_spans")
+
+    def __init__(self, row_of, slot_of, n_rows, n_spans):
+        self.row_of = row_of
+        self.slot_of = slot_of
+        self.n_rows = n_rows
+        self.n_spans = n_spans
+
+    def pack(self, values: np.ndarray, fill) -> np.ndarray:
+        """Scatter a flat per-span array into [n_rows, ROW_SLOTS] layout."""
+        out = np.full((self.n_rows, ROW_SLOTS), fill, dtype=values.dtype)
+        out[self.row_of, self.slot_of] = values[: self.n_spans]
+        return out
+
+
+def pack_trace_rows(
+    trace_of: np.ndarray, n_spans: int, parent_idx: Optional[np.ndarray] = None
+) -> Optional[PackedRows]:
+    """Assign each span a (row, slot) so its whole trace shares one row.
+
+    Returns None when the layout cannot hold the window — a trace longer
+    than ROW_SLOTS, non-contiguous trace membership, or a parent pointer
+    crossing traces — in which case callers use the flat gather path.
+    """
+    if n_spans == 0:
+        return None
+    t = np.asarray(trace_of[:n_spans])
+    if np.any(np.diff(t) < 0):
+        return None  # trace ids must be non-decreasing (contiguous traces)
+    sizes = np.bincount(t)
+    if sizes.size == 0 or sizes.max() > ROW_SLOTS or sizes.min() == 0:
+        return None
+
+    n_traces = sizes.size
+    first_span = np.zeros(n_traces, dtype=np.int64)
+    first_span[1:] = np.cumsum(sizes)[:-1]
+
+    # bucket traces by pow2 size; rows are filled per bucket, vectorized
+    bucket = np.maximum(
+        1 << (np.ceil(np.log2(np.maximum(sizes, 1))).astype(np.int64)), 1
+    )
+    row_of_trace = np.zeros(n_traces, dtype=np.int64)
+    base_of_trace = np.zeros(n_traces, dtype=np.int64)
+    next_row = 0
+    for b in np.unique(bucket):
+        ids = np.nonzero(bucket == b)[0]
+        per_row = ROW_SLOTS // int(b)
+        rank = np.arange(len(ids))
+        row_of_trace[ids] = next_row + rank // per_row
+        base_of_trace[ids] = (rank % per_row) * int(b)
+        next_row += -(-len(ids) // per_row)
+
+    offs = np.arange(n_spans, dtype=np.int64) - first_span[t]
+    row_of = row_of_trace[t]
+    slot_of = base_of_trace[t] + offs
+    n_rows = _pad_size(next_row, minimum=1)
+
+    if parent_idx is not None:
+        p = np.asarray(parent_idx[:n_spans])
+        has_parent = p >= 0
+        if np.any(row_of[p[has_parent]] != row_of[has_parent.nonzero()[0]]):
+            return None  # cross-trace parent (span-id collision): bail out
+    return PackedRows(row_of, slot_of, int(n_rows), n_spans)

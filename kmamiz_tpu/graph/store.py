@@ -22,7 +22,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from kmamiz_tpu.core.interning import EndpointInterner, StringInterner
-from kmamiz_tpu.core.spans import KIND_SERVER, SpanBatch, _pad_size as _pow2
+from kmamiz_tpu.core.spans import (
+    KIND_SERVER,
+    SpanBatch,
+    _pad_size as _pow2,
+    pack_trace_rows,
+)
 from kmamiz_tpu.ops import scorers as scorer_ops
 from kmamiz_tpu.ops import window as window_ops
 from kmamiz_tpu.ops.sortutil import SENTINEL, compact_unique
@@ -46,6 +51,29 @@ def _window_merge(parent_idx, kind, valid, endpoint_id, src, dst, dist, mask):
     realtime tick costs a single device round trip: the only host sync is
     the returned valid-edge count scalar."""
     edges = window_ops.dependency_edges(parent_idx, kind, valid, endpoint_id)
+    s, d, ds, v = _merge_edges(
+        src,
+        dst,
+        dist,
+        mask,
+        edges.ancestor_ep.reshape(-1),
+        edges.descendant_ep.reshape(-1),
+        edges.distance.reshape(-1),
+        edges.mask.reshape(-1),
+    )
+    return s, d, ds, v, v.sum()
+
+
+@jax.jit
+def _window_merge_packed(
+    parent_slot, kind, valid, endpoint_id, src, dst, dist, mask
+):
+    """_window_merge over trace-packed [T, L] rows: the ancestor walk runs
+    as batched one-hot einsums on the MXU (dependency_edges_packed), ~10x
+    cheaper than the flat gather walk at 1M spans."""
+    edges = window_ops.dependency_edges_packed(
+        parent_slot, kind, valid, endpoint_id
+    )
     s, d, ds, v = _merge_edges(
         src,
         dst,
@@ -110,16 +138,35 @@ class EndpointGraph:
         """Union this window's dependency edges into the store and update
         per-endpoint record/last-usage metadata."""
         self._finalize_pending()
-        src, dst, dist, _valid, valid_count = _window_merge(
-            jnp.asarray(batch.parent_idx),
-            jnp.asarray(batch.kind),
-            jnp.asarray(batch.valid),
-            jnp.asarray(batch.endpoint_id),
-            self._src,
-            self._dst,
-            self._dist,
-            self._src != SENTINEL,
+        packed = pack_trace_rows(
+            batch.trace_of, batch.n_spans, batch.parent_idx
         )
+        if packed is not None:
+            n = batch.n_spans
+            pslot = np.full(n, -1, dtype=np.int32)
+            has = batch.parent_idx[:n] >= 0
+            pslot[has] = packed.slot_of[batch.parent_idx[:n][has]]
+            src, dst, dist, _valid, valid_count = _window_merge_packed(
+                jnp.asarray(packed.pack(pslot, -1)),
+                jnp.asarray(packed.pack(batch.kind, 0)),
+                jnp.asarray(packed.pack(batch.valid, False)),
+                jnp.asarray(packed.pack(batch.endpoint_id, 0)),
+                self._src,
+                self._dst,
+                self._dist,
+                self._src != SENTINEL,
+            )
+        else:  # overlong trace / cross-trace parent: flat gather fallback
+            src, dst, dist, _valid, valid_count = _window_merge(
+                jnp.asarray(batch.parent_idx),
+                jnp.asarray(batch.kind),
+                jnp.asarray(batch.valid),
+                jnp.asarray(batch.endpoint_id),
+                self._src,
+                self._dst,
+                self._dist,
+                self._src != SENTINEL,
+            )
         # Defer the count sync: dispatch is async, so the tick returns without
         # blocking on the device round trip; the copy streams back in the
         # background and _finalize_pending() resolves it on next access.

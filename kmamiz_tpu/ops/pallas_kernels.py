@@ -1,0 +1,133 @@
+"""Pallas TPU kernels for the window-pipeline hot ops.
+
+The span-window groupby (window_stats) is a segment reduction: ~1M spans
+scatter-add into ~80k (endpoint, status) segments. XLA lowers
+jax.ops.segment_sum to scatter, which the TPU executes with serialized
+index handling; this module reformulates the reduction as ONE-HOT MATMUL
+so it rides the MXU instead:
+
+    partial[m, S_blk] += values[m, K_blk] @ one_hot[K_blk, S_blk]
+
+with the grid arranged (segment blocks outer/parallel, span blocks
+inner/arbitrary) so each output tile accumulates in VMEM across span
+blocks. The timestamp max reduction shares the same one-hot mask on the
+VPU. This is the classic TPU sparse-reduction shape (SpMM via dense
+masking — see PAPERS.md) applied to the reference's hottest loop
+(kmamiz_data_processor/src/data/realtime_data.rs:31-121 groupby).
+
+Use KMAMIZ_SEGMENT_BACKEND=pallas to switch the DataProcessor stats path
+(server/processor.py consults segment_backend()); window_stats also takes
+`backend=` directly. Measured on a v5e-1 at the bench shape (1M spans,
+80k segments) the one-hot matmul loses to XLA's scatter (~620 ms vs
+~28 ms: the dense one-hot does N*S work), so XLA stays the default; the
+kernel is kept as the MXU formulation for small segment counts and as
+the pattern the packed dependency walk (window.dependency_edges_packed)
+builds on. Numerical note: matmul accumulation reassociates float adds,
+so sums can differ from the scatter path by float32 rounding
+(tests/test_ops_window.py asserts tight rtol, counts and maxes exact).
+"""
+from __future__ import annotations
+
+import os
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# block sizes: K spans x BS segments per tile; both ride the f32 (8, 128)
+# tiling and keep the one-hot tile (K*BS*4B = 1MB) well inside VMEM
+SPAN_BLOCK = 512
+SEG_BLOCK = 512
+
+
+def segment_backend(default: str = "xla") -> str:
+    """Process-wide segment-reduction backend: 'xla' (scatter) or 'pallas'
+    (one-hot MXU matmul). Overridable via KMAMIZ_SEGMENT_BACKEND."""
+    return os.environ.get("KMAMIZ_SEGMENT_BACKEND", default)
+
+
+def _segment_stats_kernel(seg_ref, vals_ref, ts_ref, sums_ref, maxs_ref):
+    n_idx = pl.program_id(1)
+
+    @pl.when(n_idx == 0)
+    def _init():
+        sums_ref[:, :] = jnp.zeros_like(sums_ref)
+        maxs_ref[:, :] = jnp.zeros_like(maxs_ref)
+
+    seg = seg_ref[0, :]  # [K] int32 segment id per span
+    seg_base = pl.program_id(0) * SEG_BLOCK
+    # one_hot[k, s] = 1 iff span k belongs to segment (seg_base + s)
+    local = jax.lax.broadcasted_iota(jnp.int32, (SPAN_BLOCK, SEG_BLOCK), 1)
+    one_hot = (seg[:, None] == seg_base + local).astype(jnp.float32)
+
+    # all m stat rows reduce in one MXU pass: [m, K] @ [K, BS] -> [m, BS].
+    # HIGHEST precision: the default lowers f32 matmul to bf16 MXU passes,
+    # which costs ~0.5% relative error on latency sums
+    sums_ref[:, :] += jnp.dot(
+        vals_ref[:, :],
+        one_hot,
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )
+
+    # timestamp max on the VPU over the same mask, in int32 (f32 would
+    # round offsets above 2^24); identity 0: rel timestamps are
+    # non-negative and empty segments report 0
+    ts = ts_ref[0, :]
+    masked = jnp.where(one_hot > 0, ts[:, None], 0)
+    maxs_ref[:, :] = jnp.maximum(maxs_ref[:, :], jnp.max(masked, axis=0)[None, :])
+
+
+@partial(jax.jit, static_argnames=("num_segments", "interpret"))
+def segment_stats_matmul(
+    values: jnp.ndarray,
+    seg: jnp.ndarray,
+    ts: jnp.ndarray,
+    num_segments: int,
+    interpret: bool = False,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Segment-sum every row of values[m, N] and segment-max ts[N] by
+    seg[N] int32 ids in [0, num_segments); rows with seg >= num_segments
+    are dropped (the caller parks padded/invalid spans there).
+
+    Returns (sums[m, num_segments] f32, ts_max[num_segments] int32).
+    """
+    m, n = values.shape
+    n_pad = -(-n // SPAN_BLOCK) * SPAN_BLOCK
+    # at least one spill block so parked ids stay in-range of the iota grid
+    s_pad = -(-(num_segments + 1) // SEG_BLOCK) * SEG_BLOCK
+
+    values = jnp.pad(values.astype(jnp.float32), ((0, 0), (0, n_pad - n)))
+    # padded spans park at num_segments (first spill slot)
+    seg = jnp.pad(
+        seg.astype(jnp.int32), (0, n_pad - n), constant_values=num_segments
+    )
+    seg = jnp.where(seg >= num_segments, num_segments, seg)
+    ts = jnp.pad(ts.astype(jnp.int32), (0, n_pad - n))
+
+    grid = (s_pad // SEG_BLOCK, n_pad // SPAN_BLOCK)
+    sums, maxs = pl.pallas_call(
+        _segment_stats_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, SPAN_BLOCK), lambda s, n_: (0, n_)),
+            pl.BlockSpec((m, SPAN_BLOCK), lambda s, n_: (0, n_)),
+            pl.BlockSpec((1, SPAN_BLOCK), lambda s, n_: (0, n_)),
+        ],
+        out_specs=[
+            pl.BlockSpec((m, SEG_BLOCK), lambda s, n_: (0, s)),
+            pl.BlockSpec((1, SEG_BLOCK), lambda s, n_: (0, s)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((m, s_pad), jnp.float32),
+            jax.ShapeDtypeStruct((1, s_pad), jnp.int32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")
+        ),
+        interpret=interpret,
+    )(seg[None, :], values, ts[None, :])
+    return sums[:, :num_segments], maxs[0, :num_segments]

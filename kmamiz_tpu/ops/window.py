@@ -102,6 +102,104 @@ def dependency_edges(
     )
 
 
+class PackedEdges(NamedTuple):
+    ancestor_ep: jnp.ndarray  # int32[T*L, max_depth]
+    descendant_ep: jnp.ndarray  # int32[T*L, max_depth]
+    distance: jnp.ndarray  # int32[T*L, max_depth]
+    mask: jnp.ndarray  # bool[T*L, max_depth]
+    ancestor_slot: jnp.ndarray  # int32[T*L, max_depth] (packed flat index)
+
+
+@partial(jax.jit, static_argnames=("max_depth", "max_client_skip"))
+def dependency_edges_packed(
+    parent_slot: jnp.ndarray,
+    kind: jnp.ndarray,
+    valid: jnp.ndarray,
+    endpoint_id: jnp.ndarray,
+    max_depth: int = MAX_DEPTH,
+    max_client_skip: int = MAX_CLIENT_SKIP,
+) -> PackedEdges:
+    """dependency_edges over trace-packed rows ([T, L] from
+    core.spans.pack_trace_rows): every ancestor hop is a row-local one-hot
+    einsum batched over traces on the MXU instead of an HBM gather — TPU
+    gathers cost ~6.6 ms per 1M elements while the batched einsum is
+    bandwidth-bound (~10x cheaper for the full walk).
+
+    Semantics match dependency_edges exactly (CLIENT-skip via pointer
+    doubling, depth-capped ancestor chains); only the row layout and the
+    meaning of ancestor_slot (packed flat index, not batch index) differ.
+    """
+    t_rows, l_slots = parent_slot.shape
+    iota = jnp.arange(l_slots, dtype=jnp.int32)
+    f32 = jnp.float32
+
+    def onehot(idx):
+        # [T, L] slot ids -> [T, L, L] one-hot rows; idx < 0 -> zero row
+        return (idx[:, :, None] == iota[None, None, :]).astype(f32)
+
+    def oh_gather(oh, x, precision=None):
+        # out[t, j] = x[t, idx[t, j]] (0 where the one-hot row is zero)
+        return jnp.einsum("tji,ti->tj", oh, x.astype(f32), precision=precision)
+
+    def gather_slot(idx, x):
+        # int slot gather with -1 passthrough (slot values are < L, exact
+        # under the MXU's bf16 passes)
+        g = oh_gather(onehot(idx), x)
+        return jnp.where(idx < 0, -1, g.astype(jnp.int32))
+
+    is_client = kind == KIND_CLIENT
+    safe_parent = jnp.where(valid & (parent_slot >= 0), parent_slot, -1)
+
+    # CLIENT-skip by pointer doubling: h is identity on non-CLIENT slots and
+    # parent on CLIENT slots, so h^k converges to the nearest non-CLIENT
+    # weak ancestor along a CLIENT chain (-1 absorbs)
+    h = jnp.where(is_client, safe_parent, iota[None, :])
+    for _ in range(max(1, (max_client_skip - 1).bit_length())):
+        h = gather_slot(h, h)
+    skip_raw = gather_slot(safe_parent, h)
+    # chains longer than the cap leave a CLIENT slot: truncate to -1,
+    # mirroring skip_client_parents
+    oh_skip = onehot(skip_raw)
+    still_client = (skip_raw >= 0) & (
+        oh_gather(oh_skip, is_client.astype(f32)) > 0.5
+    )
+    skip = jnp.where(still_client, -1, skip_raw)
+
+    is_server = valid & (kind == KIND_SERVER)
+    skip_f = skip.astype(f32)
+    ep_f = endpoint_id.astype(f32)
+    row_base = (jnp.arange(t_rows, dtype=jnp.int32) * l_slots)[:, None]
+
+    anc = skip
+    anc_eps, anc_slots, masks = [], [], []
+    for _ in range(max_depth):
+        oh = onehot(anc)
+        step_mask = (anc >= 0) & is_server
+        # endpoint ids exceed bf16's exact-int range; HIGHEST keeps the
+        # extraction f32-exact
+        ep_d = oh_gather(oh, ep_f, precision=jax.lax.Precision.HIGHEST)
+        anc_eps.append(jnp.where(step_mask, ep_d.astype(jnp.int32), -1))
+        anc_slots.append(jnp.where(step_mask, row_base + anc, -1))
+        masks.append(step_mask)
+        nxt = oh_gather(oh, skip_f)
+        anc = jnp.where(anc < 0, -1, nxt.astype(jnp.int32))
+
+    def stack(parts):
+        return jnp.stack(parts, axis=-1).reshape(t_rows * l_slots, max_depth)
+
+    mask = stack(masks)
+    distances = jnp.arange(1, max_depth + 1, dtype=jnp.int32)[None, :]
+    return PackedEdges(
+        ancestor_ep=stack(anc_eps),
+        descendant_ep=jnp.where(
+            mask, endpoint_id.reshape(-1, 1), -1
+        ),
+        distance=jnp.where(mask, distances, 0),
+        mask=mask,
+        ancestor_slot=stack(anc_slots),
+    )
+
+
 class WindowStats(NamedTuple):
     """Per-(endpoint, status) segment statistics for one window."""
 
@@ -115,7 +213,7 @@ class WindowStats(NamedTuple):
     latest_timestamp_rel: jnp.ndarray  # int32[S] (max offset from window base)
 
 
-@partial(jax.jit, static_argnames=("num_endpoints", "num_statuses"))
+@partial(jax.jit, static_argnames=("num_endpoints", "num_statuses", "backend"))
 def window_stats(
     endpoint_id: jnp.ndarray,
     status_id: jnp.ndarray,
@@ -125,6 +223,7 @@ def window_stats(
     valid_server: jnp.ndarray,
     num_endpoints: int,
     num_statuses: int,
+    backend: str = "xla",
 ) -> WindowStats:
     """Segment-combine per (endpoint, status): request count, 4xx/5xx counts,
     latency mean + CV (sum/sum-of-squares form, matching the Rust DP's
@@ -132,41 +231,87 @@ def window_stats(
 
     timestamp_rel: int32 microsecond offsets from the window base (absolute
     µs don't fit int32, and the TPU path runs with x64 off — the caller adds
-    the base back on the host)."""
+    the base back on the host).
+
+    backend: 'xla' (scatter-based segment ops), 'pallas' / 'pallas_interpret'
+    (one-hot MXU matmul kernel, kmamiz_tpu.ops.pallas_kernels)."""
     num_segments = num_endpoints * num_statuses
     seg = endpoint_id * num_statuses + status_id
     seg = jnp.where(valid_server, seg, num_segments)  # park invalid rows
 
     w = valid_server.astype(latency_ms.dtype)
     ones = w
-    count = jax.ops.segment_sum(ones, seg, num_segments=num_segments + 1)[:-1]
-    e4 = jax.ops.segment_sum(
-        ones * (status_class == 4), seg, num_segments=num_segments + 1
-    )[:-1]
-    e5 = jax.ops.segment_sum(
-        ones * (status_class == 5), seg, num_segments=num_segments + 1
-    )[:-1]
-    lat_sum = jax.ops.segment_sum(
-        latency_ms * w, seg, num_segments=num_segments + 1
-    )[:-1]
-    lat_sq = jax.ops.segment_sum(
-        latency_ms * latency_ms * w, seg, num_segments=num_segments + 1
-    )[:-1]
-    ts = jax.ops.segment_max(
-        jnp.where(valid_server, timestamp_rel, 0), seg, num_segments=num_segments + 1
-    )[:-1]
-    ts = jnp.where(count > 0, ts, 0)  # empty segments: 0, not int32 min
+    if backend.startswith("pallas"):
+        from kmamiz_tpu.ops.pallas_kernels import segment_stats_matmul
 
-    safe_count = jnp.maximum(count, 1)
-    mean = lat_sum / safe_count
-    # two-pass variance: sum of squared residuals against the segment mean.
-    # The naive E[x^2]-E[x]^2 form cancels catastrophically in float32 (the
-    # production TPU dtype); one extra segment_sum buys f64-like stability.
-    resid = (latency_ms - mean[jnp.minimum(seg, num_segments - 1)]) * w
-    variance = (
-        jax.ops.segment_sum(resid * resid, seg, num_segments=num_segments + 1)[:-1]
-        / safe_count
-    )
+        interpret = backend == "pallas_interpret"
+        lat_f = latency_ms.astype(jnp.float32)
+        values = jnp.stack(
+            [
+                ones.astype(jnp.float32),
+                (ones * (status_class == 4)).astype(jnp.float32),
+                (ones * (status_class == 5)).astype(jnp.float32),
+                lat_f * w,
+                lat_f * lat_f * w,
+            ]
+        )
+        sums, ts_f = segment_stats_matmul(
+            values,
+            seg,
+            jnp.where(valid_server, timestamp_rel, 0),
+            num_segments,
+            interpret=interpret,
+        )
+        count, e4, e5, lat_sum, lat_sq = sums
+        ts = ts_f.astype(jnp.int32)
+        safe_count = jnp.maximum(count, 1)
+        mean = lat_sum / safe_count
+        resid = (latency_ms - mean[jnp.minimum(seg, num_segments - 1)]) * w
+        resid_sq, _ = segment_stats_matmul(
+            (resid * resid)[None, :].astype(jnp.float32),
+            seg,
+            jnp.zeros_like(timestamp_rel),
+            num_segments,
+            interpret=interpret,
+        )
+        variance = resid_sq[0] / safe_count
+    else:
+        # ONE vector-valued scatter for all five sums: TPU scatter cost is
+        # dominated by per-index handling, so [N, 5] is ~3x cheaper than
+        # five separate [N] segment_sums
+        lat_w = latency_ms * w
+        data = jnp.stack(
+            [
+                ones,
+                ones * (status_class == 4),
+                ones * (status_class == 5),
+                lat_w,
+                latency_ms * lat_w,
+            ],
+            axis=1,
+        )
+        sums = jax.ops.segment_sum(data, seg, num_segments=num_segments + 1)[:-1]
+        count, e4, e5, lat_sum, lat_sq = (sums[:, i] for i in range(5))
+        ts = jax.ops.segment_max(
+            jnp.where(valid_server, timestamp_rel, 0),
+            seg,
+            num_segments=num_segments + 1,
+        )[:-1]
+        ts = jnp.where(count > 0, ts, 0)  # empty segments: 0, not int32 min
+
+        safe_count = jnp.maximum(count, 1)
+        mean = lat_sum / safe_count
+        # two-pass variance: sum of squared residuals against the segment
+        # mean. The naive E[x^2]-E[x]^2 form cancels catastrophically in
+        # float32 (the production TPU dtype); one extra segment_sum buys
+        # f64-like stability.
+        resid = (latency_ms - mean[jnp.minimum(seg, num_segments - 1)]) * w
+        variance = (
+            jax.ops.segment_sum(
+                resid * resid, seg, num_segments=num_segments + 1
+            )[:-1]
+            / safe_count
+        )
     std = jnp.sqrt(jnp.maximum(variance, 0.0))
     cv = jnp.where(mean != 0, std / jnp.maximum(mean, 1e-300), 0.0)
     return WindowStats(

@@ -262,6 +262,8 @@ def device_window_stats(records: List[dict]) -> Dict[tuple, dict]:
 
     num_endpoints = max(len(endpoints), 1)
     num_statuses = max(len(statuses), 1)
+    from kmamiz_tpu.ops.pallas_kernels import segment_backend
+
     stats = window_ops.window_stats(
         jnp.asarray(eid),
         jnp.asarray(sid),
@@ -271,6 +273,7 @@ def device_window_stats(records: List[dict]) -> Dict[tuple, dict]:
         jnp.asarray(valid),
         num_endpoints=num_endpoints,
         num_statuses=num_statuses,
+        backend=segment_backend(),
     )
     # one batched device->host transfer: individual np.asarray calls each
     # pay a full device-sync round trip (expensive on a tunneled TPU)

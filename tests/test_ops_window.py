@@ -173,3 +173,212 @@ class TestServiceStats:
             num_services=batch.num_services,
         )
         assert float(np.asarray(count).sum()) == 4  # 4 SERVER spans
+
+
+class TestPackedDependencyEdges:
+    """dependency_edges_packed must emit the same edge multiset as the flat
+    gather walk, for random forests and the captured fixtures."""
+
+    @staticmethod
+    def _edge_multiset(anc_ep, desc_ep, dist, mask):
+        import collections
+
+        anc_ep, desc_ep = np.asarray(anc_ep), np.asarray(desc_ep)
+        dist, mask = np.asarray(dist), np.asarray(mask)
+        out = collections.Counter()
+        flat = mask.reshape(-1)
+        out.update(
+            zip(
+                anc_ep.reshape(-1)[flat].tolist(),
+                desc_ep.reshape(-1)[flat].tolist(),
+                dist.reshape(-1)[flat].tolist(),
+            )
+        )
+        return out
+
+    def _compare(self, trace_sizes, rng, client_prob=0.4):
+        from kmamiz_tpu.core import spans as spans_mod
+        from kmamiz_tpu.core.spans import pack_trace_rows
+
+        n = int(sum(trace_sizes))
+        trace_of = np.repeat(
+            np.arange(len(trace_sizes), dtype=np.int32), trace_sizes
+        )
+        parent = np.full(n, -1, dtype=np.int32)
+        kind = np.zeros(n, dtype=np.int8)
+        start = 0
+        for size in trace_sizes:
+            for j in range(1, size):
+                parent[start + j] = start + int(rng.integers(0, j))
+            kind[start : start + size] = np.where(
+                rng.random(size) < client_prob,
+                spans_mod.KIND_CLIENT,
+                spans_mod.KIND_SERVER,
+            )
+            start += size
+        ep = rng.integers(0, 500, n).astype(np.int32)
+        valid = np.ones(n, dtype=bool)
+
+        legacy = window.dependency_edges(
+            jnp.asarray(parent), jnp.asarray(kind), jnp.asarray(valid),
+            jnp.asarray(ep),
+        )
+        packed = pack_trace_rows(trace_of, n, parent)
+        assert packed is not None
+        pslot = np.full(n, -1, dtype=np.int32)
+        has = parent >= 0
+        pslot[has] = packed.slot_of[parent[has]]
+        got = window.dependency_edges_packed(
+            jnp.asarray(packed.pack(pslot, -1)),
+            jnp.asarray(packed.pack(kind, 0)),
+            jnp.asarray(packed.pack(valid, False)),
+            jnp.asarray(packed.pack(ep, 0)),
+        )
+        want = self._edge_multiset(
+            legacy.ancestor_ep, legacy.descendant_ep, legacy.distance,
+            legacy.mask,
+        )
+        have = self._edge_multiset(
+            got.ancestor_ep, got.descendant_ep, got.distance, got.mask
+        )
+        assert have == want
+
+    def test_random_forests(self):
+        rng = np.random.default_rng(42)
+        for _ in range(5):
+            sizes = rng.integers(1, 64, rng.integers(3, 40)).tolist()
+            self._compare(sizes, rng)
+
+    def test_deep_client_chains(self):
+        rng = np.random.default_rng(7)
+        # linear chains of alternating/blocked CLIENT spans stress the
+        # pointer-doubling skip (chains beyond MAX_CLIENT_SKIP truncate)
+        self._compare([40, 40, 64], rng, client_prob=0.85)
+
+    def test_single_span_traces(self):
+        rng = np.random.default_rng(3)
+        self._compare([1] * 20, rng)
+
+    def test_store_merge_equivalence(self, pdas_traces, bookinfo_traces):
+        """EndpointGraph.merge_window (packed path) matches a graph built
+        through the flat fallback on real fixture traces."""
+        from kmamiz_tpu.core.spans import spans_to_batch
+        from kmamiz_tpu.graph import store as store_mod
+
+        def build(use_packed):
+            g = store_mod.EndpointGraph()
+            for groups in ([pdas_traces], bookinfo_traces):
+                batch = spans_to_batch(groups, interner=g.interner)
+                if not use_packed:
+                    batch.trace_of = np.full_like(batch.trace_of, -9)
+                    batch.trace_of[0] = 0  # non-monotonic -> pack bails
+                g.merge_window(batch)
+            src, dst, dist, mask = g.edge_arrays()
+            mask = np.asarray(mask)
+            return set(
+                zip(
+                    np.asarray(src)[mask].tolist(),
+                    np.asarray(dst)[mask].tolist(),
+                    np.asarray(dist)[mask].tolist(),
+                )
+            )
+
+        assert build(True) == build(False)
+
+    def test_pack_trace_rows_fallbacks(self):
+        from kmamiz_tpu.core.spans import ROW_SLOTS, pack_trace_rows
+
+        # overlong trace
+        t = np.zeros(ROW_SLOTS + 1, dtype=np.int32)
+        assert pack_trace_rows(t, len(t), None) is None
+        # non-monotonic trace ids
+        t = np.array([0, 1, 0], dtype=np.int32)
+        assert pack_trace_rows(t, 3, None) is None
+        # cross-ROW parent (two 33-span traces always get separate rows;
+        # same-row cross-trace parents are fine — slot gathers are
+        # row-local bijections)
+        t = np.repeat([0, 1], 33).astype(np.int32)
+        parent = np.full(66, -1, dtype=np.int32)
+        parent[1:33] = np.arange(32)
+        parent[34:66] = np.arange(33, 65)
+        parent[40] = 5  # span in trace 1 -> parent in trace 0
+        assert pack_trace_rows(t, 66, parent) is None
+        parent[40] = 39
+        assert pack_trace_rows(t, 66, parent) is not None
+        # healthy small window packs
+        t = np.array([0, 0, 1, 1], dtype=np.int32)
+        parent = np.array([-1, 0, -1, 2], dtype=np.int32)
+        packed = pack_trace_rows(t, 4, parent)
+        assert packed is not None
+        assert packed.row_of.shape == (4,)
+
+
+class TestPallasSegmentBackend:
+    """The pallas one-hot MXU segment kernel (interpret mode on CPU) must
+    match the XLA scatter path."""
+
+    def _inputs(self, n=3000, ne=130, ns=7, seed=0):
+        rng = np.random.default_rng(seed)
+        return dict(
+            endpoint_id=jnp.asarray(rng.integers(0, ne, n, dtype=np.int32)),
+            status_id=jnp.asarray(rng.integers(0, ns, n, dtype=np.int32)),
+            status_class=jnp.asarray(rng.choice([2, 4, 5], n).astype(np.int8)),
+            latency_ms=jnp.asarray(rng.gamma(2.0, 50.0, n).astype(np.float32)),
+            timestamp_rel=jnp.asarray(
+                rng.integers(0, 30_000_000, n, dtype=np.int32)
+            ),
+            valid_server=jnp.asarray(rng.random(n) < 0.9),
+            num_endpoints=ne,
+            num_statuses=ns,
+        )
+
+    def test_window_stats_backend_parity(self):
+        kwargs = self._inputs()
+        xla = window.window_stats(**kwargs, backend="xla")
+        pal = window.window_stats(**kwargs, backend="pallas_interpret")
+        np.testing.assert_array_equal(np.asarray(xla.count), np.asarray(pal.count))
+        np.testing.assert_array_equal(
+            np.asarray(xla.error_4xx), np.asarray(pal.error_4xx)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(xla.error_5xx), np.asarray(pal.error_5xx)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(xla.latest_timestamp_rel),
+            np.asarray(pal.latest_timestamp_rel),
+        )
+        np.testing.assert_allclose(
+            np.asarray(xla.latency_mean), np.asarray(pal.latency_mean), rtol=1e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(xla.latency_cv), np.asarray(pal.latency_cv),
+            rtol=1e-4, atol=1e-6,
+        )
+
+    def test_segment_stats_matmul_vs_numpy(self):
+        from kmamiz_tpu.ops.pallas_kernels import segment_stats_matmul
+
+        rng = np.random.default_rng(1)
+        n, s = 2000, 700
+        seg = rng.integers(0, s + 30, n).astype(np.int32)  # some parked
+        vals = rng.normal(size=(3, n)).astype(np.float32)
+        ts = rng.integers(0, 1 << 24, n).astype(np.int32)
+        sums, maxs = segment_stats_matmul(
+            jnp.asarray(vals), jnp.asarray(seg), jnp.asarray(ts), s,
+            interpret=True,
+        )
+        want_sums = np.zeros((3, s), np.float64)
+        want_max = np.zeros(s, np.int64)
+        for i in range(n):
+            if seg[i] < s:
+                want_sums[:, seg[i]] += vals[:, i]
+                want_max[seg[i]] = max(want_max[seg[i]], ts[i])
+        np.testing.assert_allclose(np.asarray(sums), want_sums, rtol=1e-5, atol=1e-5)
+        np.testing.assert_array_equal(np.asarray(maxs), want_max)
+
+    def test_segment_backend_env(self, monkeypatch):
+        from kmamiz_tpu.ops import pallas_kernels
+
+        assert pallas_kernels.segment_backend() == "xla"
+        monkeypatch.setenv("KMAMIZ_SEGMENT_BACKEND", "pallas")
+        assert pallas_kernels.segment_backend() == "pallas"

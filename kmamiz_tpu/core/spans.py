@@ -128,11 +128,10 @@ def spans_to_batch(
     latency_ms = np.zeros(capacity, dtype=np.float64)
     timestamp_us = np.zeros(capacity, dtype=np.int64)
     trace_of = np.zeros(capacity, dtype=np.int32)
-    for i, span_id in enumerate(span_map.keys()):
-        trace_of[i] = trace_of_id[span_id]
 
     for i, span in enumerate(spans):
         valid[i] = True
+        trace_of[i] = trace_of_id[span["id"]]
         k = span.get("kind")
         kind[i] = (
             KIND_SERVER if k == "SERVER" else KIND_CLIENT if k == "CLIENT" else KIND_OTHER
@@ -242,6 +241,14 @@ class PackedRows:
         out = np.full((self.n_rows, ROW_SLOTS), fill, dtype=values.dtype)
         out[self.row_of, self.slot_of] = values[: self.n_spans]
         return out
+
+    def parent_slots(self, parent_idx: np.ndarray) -> np.ndarray:
+        """Translate flat parent indices to row-local parent slots (-1 for
+        no parent); feed the result through pack(..., -1)."""
+        pslot = np.full(self.n_spans, -1, dtype=np.int32)
+        has = parent_idx[: self.n_spans] >= 0
+        pslot[has] = self.slot_of[parent_idx[: self.n_spans][has]]
+        return pslot
 
 
 def pack_trace_rows(

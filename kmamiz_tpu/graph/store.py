@@ -142,12 +142,8 @@ class EndpointGraph:
             batch.trace_of, batch.n_spans, batch.parent_idx
         )
         if packed is not None:
-            n = batch.n_spans
-            pslot = np.full(n, -1, dtype=np.int32)
-            has = batch.parent_idx[:n] >= 0
-            pslot[has] = packed.slot_of[batch.parent_idx[:n][has]]
             src, dst, dist, _valid, valid_count = _window_merge_packed(
-                jnp.asarray(packed.pack(pslot, -1)),
+                jnp.asarray(packed.pack(packed.parent_slots(batch.parent_idx), -1)),
                 jnp.asarray(packed.pack(batch.kind, 0)),
                 jnp.asarray(packed.pack(batch.valid, False)),
                 jnp.asarray(packed.pack(batch.endpoint_id, 0)),

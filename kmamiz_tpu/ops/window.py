@@ -151,12 +151,21 @@ def dependency_edges_packed(
     safe_parent = jnp.where(valid & (parent_slot >= 0), parent_slot, -1)
 
     # CLIENT-skip by pointer doubling: h is identity on non-CLIENT slots and
-    # parent on CLIENT slots, so h^k converges to the nearest non-CLIENT
-    # weak ancestor along a CLIENT chain (-1 absorbs)
+    # parent on CLIENT slots, so h^k applies exactly k conditional hops
+    # (-1 absorbs). Binary decomposition keeps h^max_client_skip EXACT for
+    # any cap, matching skip_client_parents' truncation step for step.
     h = jnp.where(is_client, safe_parent, iota[None, :])
-    for _ in range(max(1, (max_client_skip - 1).bit_length())):
-        h = gather_slot(h, h)
-    skip_raw = gather_slot(safe_parent, h)
+    result = jnp.broadcast_to(iota[None, :], h.shape)  # h^0 = identity
+    k = max_client_skip
+    power = h
+    while k:
+        if k & 1:
+            # h^(a+b)[j] = h^a[h^b[j]]  (powers of one function commute)
+            result = gather_slot(result, power)
+        k >>= 1
+        if k:
+            power = gather_slot(power, power)
+    skip_raw = gather_slot(safe_parent, result)
     # chains longer than the cap leave a CLIENT slot: truncate to -1,
     # mirroring skip_client_parents
     oh_skip = onehot(skip_raw)

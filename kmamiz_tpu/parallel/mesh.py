@@ -40,6 +40,73 @@ def make_mesh(n_devices: int = 0, axis: str = "spans") -> Mesh:
     return Mesh(np.asarray(devices), (axis,))
 
 
+# ---------------------------------------------------------------------------
+# ring collectives (explicit ppermute over ICI)
+#
+# The ICI topology is a ring/torus; these are the classic ring algorithms
+# (reduce-scatter then all-gather) written against jax.lax.ppermute instead
+# of the opaque psum, so cross-shard merges can (a) overlap chunk transfers
+# with adds step by step and (b) leave the result SEGMENT-SHARDED — each
+# device ends up owning S/n of the merged segment statistics, which is the
+# right layout when the next stage (scorer segment reductions, top-k) is
+# itself sharded over segments. This is the span-window analogue of ring
+# attention's sequence parallelism: spans are the "sequence", per-segment
+# partial sums are the rotating state.
+# ---------------------------------------------------------------------------
+
+
+def _ring_perm(n: int):
+    return [(i, (i + 1) % n) for i in range(n)]
+
+
+def ring_reduce_scatter(x, axis: str, n: int, op: str = "add"):
+    """Inside shard_map: reduce x (replicated-shape [n*c, ...] partials,
+    one copy per device) so device i returns the fully merged chunk i.
+
+    n-1 ppermute steps, each overlapping one chunk transfer with one
+    combine; a final rotation lands chunk i on device i. x's leading dim
+    must divide evenly into n chunks (pad first — sharded_window_stats
+    does)."""
+    if x.shape[0] % n:
+        raise ValueError(
+            f"ring_reduce_scatter needs len divisible by {n}, got {x.shape[0]}"
+        )
+    idx = jax.lax.axis_index(axis)
+    chunk_len = x.shape[0] // n
+
+    def chunk(i):
+        start = (jnp.mod(i, n)) * chunk_len
+        return jax.lax.dynamic_slice_in_dim(x, start, chunk_len)
+
+    combine = jnp.maximum if op == "max" else jnp.add
+    carry = chunk(idx)
+    for k in range(n - 1):
+        carry = jax.lax.ppermute(carry, axis, _ring_perm(n))
+        carry = combine(carry, chunk(idx - 1 - k))
+    # device i now holds merged chunk (i+1); rotate once so i owns chunk i
+    return jax.lax.ppermute(carry, axis, _ring_perm(n))
+
+
+def ring_all_gather(chunk, axis: str, n: int):
+    """Inside shard_map: device-owned chunks [c, ...] -> replicated
+    [n*c, ...] via n-1 ring hops."""
+    idx = jax.lax.axis_index(axis)
+    chunk_len = chunk.shape[0]
+    out = jnp.zeros((n * chunk_len,) + chunk.shape[1:], chunk.dtype)
+    rolling = chunk
+    for k in range(n):
+        src = jnp.mod(idx - k, n)  # whose chunk we hold at step k
+        out = jax.lax.dynamic_update_slice_in_dim(out, rolling, src * chunk_len, 0)
+        if k != n - 1:
+            rolling = jax.lax.ppermute(rolling, axis, _ring_perm(n))
+    return out
+
+
+def ring_all_reduce(x, axis: str, n: int, op: str = "add"):
+    """psum/pmax equivalent built from ring reduce-scatter + all-gather."""
+    return ring_all_gather(ring_reduce_scatter(x, axis, n, op), axis, n)
+
+
 class ShardedWindow(NamedTuple):
     """One window of spans laid out for an n-way mesh.
 
@@ -121,7 +188,7 @@ def shard_window(
 
 @partial(
     jax.jit,
-    static_argnames=("mesh", "num_endpoints", "num_statuses", "axis"),
+    static_argnames=("mesh", "num_endpoints", "num_statuses", "axis", "merge"),
 )
 def sharded_window_stats(
     mesh: Mesh,
@@ -134,49 +201,65 @@ def sharded_window_stats(
     num_endpoints: int,
     num_statuses: int,
     axis: str = "spans",
+    merge: str = "psum",
 ) -> window_ops.WindowStats:
-    """Per-shard segment stats + psum merge over the mesh axis.
+    """Per-shard segment stats + cross-shard merge over the mesh axis.
 
     Input arrays are sharded on their leading (span) dimension; the output
     is the fully merged dense per-(endpoint,status) statistics, replicated.
+
+    merge: 'psum' lets XLA pick the all-reduce; 'ring' runs the explicit
+    ppermute ring (reduce-scatter + all-gather) — same result, but the
+    merge is expressed as n-1 chunk hops over ICI, the layout ring/Ulysses
+    sequence parallelism uses, and the reduce-scatter half can serve
+    segment-sharded consumers without ever replicating.
     """
     spec = P(axis)
+    n_shards = mesh.shape[axis]
 
     def local_stats(eid, sid, scl, lat, ts, vs):
         num_segments = num_endpoints * num_statuses
         seg = eid * num_statuses + sid
         seg = jnp.where(vs, seg, num_segments)
         w = vs.astype(lat.dtype)
-        count = jax.ops.segment_sum(w, seg, num_segments=num_segments + 1)[:-1]
-        e4 = jax.ops.segment_sum(
-            w * (scl == 4), seg, num_segments=num_segments + 1
-        )[:-1]
-        e5 = jax.ops.segment_sum(
-            w * (scl == 5), seg, num_segments=num_segments + 1
-        )[:-1]
-        lat_sum = jax.ops.segment_sum(
-            lat * w, seg, num_segments=num_segments + 1
-        )[:-1]
-        lat_sq = jax.ops.segment_sum(
-            lat * lat * w, seg, num_segments=num_segments + 1
-        )[:-1]
+        # one vector-valued scatter for the five sums (see window_stats)
+        data = jnp.stack(
+            [w, w * (scl == 4), w * (scl == 5), lat * w, lat * lat * w],
+            axis=1,
+        )
+        sums = jax.ops.segment_sum(data, seg, num_segments=num_segments + 1)[:-1]
         ts_max = jax.ops.segment_max(
             jnp.where(vs, ts, 0), seg, num_segments=num_segments + 1
         )[:-1]
-        # merge partial sums across the mesh — this is the ICI collective
-        count = jax.lax.psum(count, axis)
-        e4 = jax.lax.psum(e4, axis)
-        e5 = jax.lax.psum(e5, axis)
-        lat_sum = jax.lax.psum(lat_sum, axis)
-        lat_sq = jax.lax.psum(lat_sq, axis)
-        ts_max = jax.lax.pmax(ts_max, axis)
-        return count, e4, e5, lat_sum, lat_sq, ts_max
+        # merge partials across the mesh — the ICI collective
+        if merge == "ring":
+            pad = -num_segments % n_shards
+            sums = jnp.pad(sums, ((0, pad), (0, 0)))
+            ts_max = jnp.pad(ts_max, (0, pad))
+            sums = ring_all_reduce(sums, axis, n_shards)[:num_segments]
+            ts_max = ring_all_reduce(ts_max, axis, n_shards, op="max")[
+                :num_segments
+            ]
+        else:
+            sums = jax.lax.psum(sums, axis)
+            ts_max = jax.lax.pmax(ts_max, axis)
+        return (
+            sums[:, 0],
+            sums[:, 1],
+            sums[:, 2],
+            sums[:, 3],
+            sums[:, 4],
+            ts_max,
+        )
 
     count, e4, e5, lat_sum, lat_sq, ts_max = shard_map(
         local_stats,
         mesh=mesh,
         in_specs=(spec, spec, spec, spec, spec, spec),
         out_specs=(P(), P(), P(), P(), P(), P()),
+        # the ring's replication arises from n-1 ppermute hops, which the
+        # static varying-axes check cannot prove
+        check_vma=(merge != "ring"),
     )(rt_endpoint_id, status_id, status_class, latency_ms, timestamp_rel, valid_server)
 
     safe_count = jnp.maximum(count, 1)

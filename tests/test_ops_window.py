@@ -225,11 +225,8 @@ class TestPackedDependencyEdges:
         )
         packed = pack_trace_rows(trace_of, n, parent)
         assert packed is not None
-        pslot = np.full(n, -1, dtype=np.int32)
-        has = parent >= 0
-        pslot[has] = packed.slot_of[parent[has]]
         got = window.dependency_edges_packed(
-            jnp.asarray(packed.pack(pslot, -1)),
+            jnp.asarray(packed.pack(packed.parent_slots(parent), -1)),
             jnp.asarray(packed.pack(kind, 0)),
             jnp.asarray(packed.pack(valid, False)),
             jnp.asarray(packed.pack(ep, 0)),
@@ -382,3 +379,42 @@ class TestPallasSegmentBackend:
         assert pallas_kernels.segment_backend() == "xla"
         monkeypatch.setenv("KMAMIZ_SEGMENT_BACKEND", "pallas")
         assert pallas_kernels.segment_backend() == "pallas"
+
+
+class TestNonPow2ClientSkip:
+    def test_skip_cap_exact_for_any_cap(self):
+        """max_client_skip=10 (non-pow2): long CLIENT chains must truncate
+        identically in the packed and flat walks."""
+        from kmamiz_tpu.core import spans as spans_mod
+        from kmamiz_tpu.core.spans import pack_trace_rows
+
+        n = 30  # one trace: SERVER root, 28 CLIENTs, SERVER leaf
+        trace_of = np.zeros(n, dtype=np.int32)
+        parent = np.arange(-1, n - 1, dtype=np.int32)
+        kind = np.full(n, spans_mod.KIND_CLIENT, dtype=np.int8)
+        kind[0] = spans_mod.KIND_SERVER
+        kind[-1] = spans_mod.KIND_SERVER
+        ep = np.arange(n, dtype=np.int32)
+        valid = np.ones(n, dtype=bool)
+
+        for cap in (1, 3, 10, 16, 27):
+            legacy = window.dependency_edges(
+                jnp.asarray(parent), jnp.asarray(kind), jnp.asarray(valid),
+                jnp.asarray(ep), max_client_skip=cap,
+            )
+            packed = pack_trace_rows(trace_of, n, parent)
+            got = window.dependency_edges_packed(
+                jnp.asarray(packed.pack(packed.parent_slots(parent), -1)),
+                jnp.asarray(packed.pack(kind, 0)),
+                jnp.asarray(packed.pack(valid, False)),
+                jnp.asarray(packed.pack(ep, 0)),
+                max_client_skip=cap,
+            )
+            want = TestPackedDependencyEdges._edge_multiset(
+                legacy.ancestor_ep, legacy.descendant_ep, legacy.distance,
+                legacy.mask,
+            )
+            have = TestPackedDependencyEdges._edge_multiset(
+                got.ancestor_ep, got.descendant_ep, got.distance, got.mask
+            )
+            assert have == want, f"cap={cap}"

@@ -85,3 +85,119 @@ def test_sharded_edges_match_host(bookinfo_traces, mesh8):
             # owner is the ancestor; dependingOn targets are descendants
             host_edges.add((b["endpoint"]["uniqueEndpointName"], name, b["distance"]))
     assert device_edges == host_edges
+
+
+class TestRingCollectives:
+    """Explicit ppermute ring collectives must match psum/pmax on the
+    8-device CPU mesh."""
+
+    def _mesh(self):
+        from kmamiz_tpu.parallel import mesh as pmesh
+
+        return pmesh.make_mesh(8)
+
+    def test_ring_all_reduce_matches_psum(self):
+        import jax
+        from jax.sharding import PartitionSpec as P
+        from jax import shard_map
+
+        from kmamiz_tpu.parallel import mesh as pmesh
+
+        mesh = self._mesh()
+        n = 8
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(n, 64)).astype(np.float32)
+
+        def ring(xs):
+            return pmesh.ring_all_reduce(xs.reshape(-1), "spans", n)
+
+        def ref(xs):
+            return jax.lax.psum(xs.reshape(-1), "spans")
+
+        run = lambda fn: np.asarray(
+            shard_map(
+                fn, mesh=mesh, in_specs=(P("spans"),), out_specs=P(),
+                check_vma=False,  # ring output replication is dynamic
+            )(jnp.asarray(x))
+        )
+        np.testing.assert_allclose(run(ring), run(ref), rtol=1e-5, atol=1e-6)
+
+    def test_ring_max(self):
+        import jax
+        from jax.sharding import PartitionSpec as P
+        from jax import shard_map
+
+        from kmamiz_tpu.parallel import mesh as pmesh
+
+        mesh = self._mesh()
+        n = 8
+        rng = np.random.default_rng(1)
+        x = rng.integers(0, 1000, size=(n, 48)).astype(np.int32)
+
+        def ring(xs):
+            return pmesh.ring_all_reduce(xs.reshape(-1), "spans", n, op="max")
+
+        def ref(xs):
+            return jax.lax.pmax(xs.reshape(-1), "spans")
+
+        run = lambda fn: np.asarray(
+            shard_map(
+                fn, mesh=mesh, in_specs=(P("spans"),), out_specs=P(),
+                check_vma=False,  # ring output replication is dynamic
+            )(jnp.asarray(x))
+        )
+        np.testing.assert_array_equal(run(ring), run(ref))
+
+    def test_ring_reduce_scatter_ownership(self):
+        """Device i must own fully reduced chunk i after reduce-scatter."""
+        import jax
+        from jax.sharding import PartitionSpec as P
+        from jax import shard_map
+
+        from kmamiz_tpu.parallel import mesh as pmesh
+
+        mesh = self._mesh()
+        n = 8
+        rng = np.random.default_rng(2)
+        # each device contributes a different full-length partial
+        x = rng.normal(size=(n, n * 16)).astype(np.float32)
+
+        def rs(xs):
+            return pmesh.ring_reduce_scatter(xs.reshape(-1), "spans", n)
+
+        out = np.asarray(
+            shard_map(
+                rs, mesh=mesh, in_specs=(P("spans"),), out_specs=P("spans")
+            )(jnp.asarray(x.reshape(-1)))
+        )
+        want = x.sum(axis=0)  # concatenated owned chunks == full reduction
+        np.testing.assert_allclose(out, want, rtol=1e-5)
+
+    def test_sharded_window_stats_ring_matches_psum(self, bookinfo_traces):
+        from kmamiz_tpu.parallel import mesh as pmesh
+
+        mesh = pmesh.make_mesh(8)
+        # bookinfo only: the pdas fixture was captured weeks apart and the
+        # int32 rel-timestamp window guard rejects a combined batch
+        window = pmesh.shard_window(bookinfo_traces, 8)
+        vs = window.valid & (window.kind == 1)
+        args = (
+            jnp.asarray(window.rt_endpoint_id),
+            jnp.asarray(window.status_id),
+            jnp.asarray(window.status_class),
+            jnp.asarray(window.latency_ms),
+            jnp.asarray(window.timestamp_rel),
+            jnp.asarray(vs),
+        )
+        ne = len(window.batches[0].interner.endpoints)
+        ns = max(len(window.batches[0].statuses), 1)
+        a = pmesh.sharded_window_stats(
+            mesh, *args, num_endpoints=ne, num_statuses=ns, merge="psum"
+        )
+        b = pmesh.sharded_window_stats(
+            mesh, *args, num_endpoints=ne, num_statuses=ns, merge="ring"
+        )
+        for fa, fb in zip(a, b):
+            np.testing.assert_allclose(
+                np.asarray(fa), np.asarray(fb), rtol=1e-5, atol=1e-6
+            )

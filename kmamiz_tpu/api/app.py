@@ -67,7 +67,11 @@ def build_router(
     import_export: Optional[ImportExportHandler] = None,
 ) -> Router:
     """Register every handler's routes under /api/v{N} (Routes.ts:20-30)."""
-    router = Router(api_version=ctx.settings.api_version)
+    router = Router(
+        api_version=ctx.settings.api_version,
+        static_dir=ctx.settings.static_dir,
+        wasm_path=ctx.settings.wasm_path,
+    )
     import_export = import_export or ImportExportHandler(ctx)
 
     graph = GraphHandler(ctx)

@@ -1,9 +1,10 @@
 """Graph REST handler: dependency graphs, chords, charts, and scorers.
 
 Equivalent of /root/reference/src/handler/GraphService.ts. Every route is a
-cache read followed by a pure graph computation; the heavy scorer math runs
-on the device via the CSR graph store when available, falling back to the
-host implementations on the labeled dependency cache.
+cache read followed by a pure graph computation on the labeled dependency
+cache (the parity-exact host implementations). The device scorer kernels
+(kmamiz_tpu.ops.scorers over the DP process's resident EndpointGraph) serve
+the high-throughput path; this API process scores its cached view.
 """
 from __future__ import annotations
 

@@ -101,12 +101,36 @@ class Route:
     raw_path: str
 
 
-class Router:
-    """Route table with the reference's /api/v{N} prefix."""
+_STATIC_TYPES = {
+    ".html": "text/html",
+    ".js": "application/javascript",
+    ".css": "text/css",
+    ".json": "application/json",
+    ".svg": "image/svg+xml",
+    ".png": "image/png",
+    ".ico": "image/x-icon",
+    ".map": "application/json",
+    ".woff2": "font/woff2",
+    ".wasm": "application/wasm",
+}
 
-    def __init__(self, api_version: str = "1") -> None:
+
+class Router:
+    """Route table with the reference's /api/v{N} prefix, plus the entry
+    point's static serving (index.ts:46-53): the SPA build from static_dir
+    with index.html fallback for client-side routes, and the Envoy filter
+    binary at /wasm."""
+
+    def __init__(
+        self,
+        api_version: str = "1",
+        static_dir: str = "",
+        wasm_path: str = "",
+    ) -> None:
         self.prefix = f"/api/v{api_version}"
         self._routes: List[Route] = []
+        self.static_dir = static_dir
+        self.wasm_path = wasm_path
 
     def add(self, method: str, path: str, handler: Handler) -> None:
         full = (self.prefix + path).rstrip("/") or "/"
@@ -151,7 +175,51 @@ class Router:
             except Exception:  # noqa: BLE001 - handler bugs -> 500, not crash
                 logger.exception("handler error on %s %s", method, path)
                 return Response.status_only(500)
-        return Response.status_only(405 if matched_path else 404)
+        if matched_path:
+            return Response.status_only(405)
+        if method.upper() == "GET" and not path.startswith(self.prefix):
+            static = self._serve_static(path)
+            if static is not None:
+                return static
+        return Response.status_only(404)
+
+    def _serve_static(self, path: str) -> Optional[Response]:
+        import os
+
+        static_cache = {"Cache-Control": "max-age=3600"}  # index.ts:47
+        if path == "/wasm" and self.wasm_path and os.path.isfile(self.wasm_path):
+            with open(self.wasm_path, "rb") as f:
+                return Response(
+                    status=200,
+                    raw_body=f.read(),
+                    content_type="application/wasm",
+                    headers=static_cache,
+                )
+        if not self.static_dir:
+            return None
+        root = os.path.realpath(self.static_dir)
+        if not os.path.isdir(root):
+            return None
+        rel = unquote(path).lstrip("/") or "index.html"
+        candidate = os.path.realpath(os.path.join(root, rel))
+        # confine to the static root (no traversal via .. or symlinks out)
+        if not (candidate == root or candidate.startswith(root + os.sep)):
+            return None
+        if not os.path.isfile(candidate):
+            # SPA fallback: unknown extension-less paths load the app shell
+            if "." in os.path.basename(rel):
+                return None
+            candidate = os.path.join(root, "index.html")
+            if not os.path.isfile(candidate):
+                return None
+        ext = os.path.splitext(candidate)[1].lower()
+        with open(candidate, "rb") as f:
+            return Response(
+                status=200,
+                raw_body=f.read(),
+                content_type=_STATIC_TYPES.get(ext, "application/octet-stream"),
+                headers=static_cache,
+            )
 
 
 class IRequestHandler:
@@ -188,7 +256,8 @@ def make_http_handler(router: Router, cache_max_age: int = 5):
             bodyless = response.status in (204, 304)
             if not bodyless:  # RFC 7230 §3.3.2: no body framing on 204/304
                 self.send_header("Content-Type", response.content_type)
-            self.send_header("Cache-Control", f"max-age={cache_max_age}")
+            if "Cache-Control" not in response.headers:
+                self.send_header("Cache-Control", f"max-age={cache_max_age}")
             if use_gzip:
                 self.send_header("Content-Encoding", "gzip")
             for k, v in response.headers.items():

@@ -71,6 +71,16 @@ class Settings:
     )
     simulator_mode: bool = field(default_factory=lambda: _env_bool("SIMULATOR_MODE"))
 
+    # static serving (index.ts:46-53): SPA build dir + Envoy filter binary
+    static_dir: str = field(
+        default_factory=lambda: os.environ.get("KMAMIZ_STATIC_DIR", "./dist")
+    )
+    wasm_path: str = field(
+        default_factory=lambda: os.environ.get(
+            "KMAMIZ_WASM_PATH", "./envoy/kmamiz-filter.wasm"
+        )
+    )
+
     # TPU-specific
     mesh_devices: int = field(
         default_factory=lambda: int(os.environ.get("KMAMIZ_MESH_DEVICES", "0"))

@@ -236,21 +236,37 @@ class EndpointDependencies:
             for dep in dependencies
         ]
 
+        # indexes replacing the reference's per-node linear scans (the O(V*E)
+        # closure SURVEY.md flags); iteration order inside each bucket is
+        # with_id/links order, so emitted output is byte-identical
+        by_uid: Dict[str, List[dict]] = {}
+        by_sid: Dict[str, List[str]] = {}
+        zero_by_uids: List[str] = []
+        for d in with_id:
+            by_uid.setdefault(d["uid"], []).append(d)
+            by_sid.setdefault(d["sid"], []).append(d["uid"])
+            if len(d["dependingBy"]) == 0:
+                zero_by_uids.append(d["uid"])
+        links_by_source: Dict[str, List[dict]] = {}
+        links_by_target: Dict[str, List[dict]] = {}
+        for l in links:
+            links_by_source.setdefault(l["source"], []).append(l)
+            links_by_target.setdefault(l["target"], []).append(l)
+        link_index = (links_by_source, links_by_target)
+
         for n in nodes:
             if n["id"] == "null":
-                n["dependencies"] = [
-                    d["uid"] for d in with_id if len(d["dependingBy"]) == 0
-                ]
+                n["dependencies"] = list(zero_by_uids)
                 n["linkInBetween"] = [
                     {"source": "null", "target": d} for d in n["dependencies"]
                 ]
             elif n["id"] == n["group"]:
-                n["dependencies"] = [d["uid"] for d in with_id if d["sid"] == n["id"]]
+                n["dependencies"] = list(by_sid.get(n["id"], []))
                 n["linkInBetween"] = [
                     {"source": n["id"], "target": d} for d in n["dependencies"]
                 ]
             else:
-                matching = [d for d in with_id if d["uid"] == n["id"]]
+                matching = by_uid.get(n["id"], [])
                 n["linkInBetween"] = []
                 n["dependencies"] = []
                 for node in matching:
@@ -262,8 +278,8 @@ class EndpointDependencies:
                     )
                     n["linkInBetween"] = (
                         n["linkInBetween"]
-                        + self._map_to_links(d_on, n, links)
-                        + self._map_to_links(d_by, n, links)
+                        + self._map_to_links(d_on, n, link_index)
+                        + self._map_to_links(d_by, n, link_index)
                     )
                     seen: Set[str] = set()
                     merged_ids = []
@@ -294,17 +310,19 @@ class EndpointDependencies:
         ]
 
     def _map_to_links(
-        self, deps: List[dict], node: dict, links: List[dict]
+        self, deps: List[dict], node: dict, link_index: tuple
     ) -> List[dict]:
+        links_by_source, links_by_target = link_index
         out = []
         ids = self._remap_to_id(deps)
         for i, d in enumerate(deps):
             dep_id = ids[i]
             remaining = set(ids[i + 1 :]) | {node["id"]}
-            src, dst = (
-                ("target", "source") if d["type"] == "SERVER" else ("source", "target")
-            )
-            out.extend(l for l in links if l[src] == dep_id and l[dst] in remaining)
+            if d["type"] == "SERVER":
+                candidates, dst = links_by_target.get(dep_id, ()), "source"
+            else:
+                candidates, dst = links_by_source.get(dep_id, ()), "target"
+            out.extend(l for l in candidates if l[dst] in remaining)
         return out
 
     # -- service-level rollup (EndpointDependencies.ts:369-470) --------------

@@ -464,3 +464,57 @@ class TestApplication:
                 assert json.loads(res.read())["status"] == "UP"
         finally:
             app.tear_down()
+
+
+class TestStaticServing:
+    """The entry point serves the SPA build and the Envoy filter binary
+    (reference index.ts:46-53)."""
+
+    def _router(self, **kw):
+        from kmamiz_tpu.api.router import Router
+
+        return Router(api_version="1", **kw)
+
+    def test_spa_files_and_fallback(self, tmp_path):
+        dist = tmp_path / "dist"
+        dist.mkdir()
+        (dist / "index.html").write_text("<html>app</html>")
+        (dist / "main.js").write_text("console.log(1)")
+        router = self._router(static_dir=str(dist))
+
+        r = router.dispatch("GET", "/")
+        assert r.status == 200 and b"app" in r.raw_body
+        assert r.content_type == "text/html"
+        r = router.dispatch("GET", "/main.js")
+        assert r.status == 200 and r.content_type == "application/javascript"
+        # SPA client-side route falls back to the shell
+        r = router.dispatch("GET", "/insight/dependency")
+        assert r.status == 200 and b"app" in r.raw_body
+        # missing asset with extension is a real 404
+        assert router.dispatch("GET", "/missing.js").status == 404
+        # API prefix never falls through to static
+        assert router.dispatch("GET", "/api/v1/nope").status == 404
+
+    def test_traversal_confined(self, tmp_path):
+        dist = tmp_path / "dist"
+        dist.mkdir()
+        (dist / "index.html").write_text("shell")
+        (tmp_path / "secret.txt").write_text("nope")
+        router = self._router(static_dir=str(dist))
+        r = router.dispatch("GET", "/../secret.txt")
+        assert r.status != 200 or b"nope" not in (r.raw_body or b"")
+
+    def test_wasm_binary(self, tmp_path):
+        wasm = tmp_path / "filter.wasm"
+        wasm.write_bytes(b"\x00asm...")
+        router = self._router(wasm_path=str(wasm))
+        r = router.dispatch("GET", "/wasm")
+        assert r.status == 200
+        assert r.content_type == "application/wasm"
+        assert r.raw_body.startswith(b"\x00asm")
+
+    def test_no_static_configured(self):
+        from kmamiz_tpu.api.router import Router
+
+        router = Router(api_version="1")
+        assert router.dispatch("GET", "/anything").status == 404

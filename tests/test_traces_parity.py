@@ -222,3 +222,157 @@ class TestBookinfoPipeline:
         assert all(r["latency"] > 0 for r in rl)
         services = {r["service"] for r in rl}
         assert {"productpage", "details", "reviews", "ratings"} <= services
+
+
+class TestHighlightClosureIndexed:
+    """The indexed highlight closure must emit byte-identical output to the
+    reference's linear-scan algorithm, and scale past 10k-row graphs."""
+
+    @staticmethod
+    def _make_deps(n_services, eps_per_service, fan_out, rng):
+        from kmamiz_tpu.domain.endpoint_dependencies import EndpointDependencies
+
+        def ep(s, e):
+            return {
+                "uniqueServiceName": f"svc{s}\tns\tv1",
+                "uniqueEndpointName": f"svc{s}\tns\tv1\tGET\thttp://svc{s}/api/{e}",
+                "service": f"svc{s}",
+                "namespace": "ns",
+                "version": "v1",
+                "method": "GET",
+                "labelName": f"/api/{e}",
+            }
+
+        deps = []
+        total = n_services * eps_per_service
+        for s in range(n_services):
+            for e in range(eps_per_service):
+                on, by = [], []
+                for _ in range(int(rng.integers(0, fan_out + 1))):
+                    t = int(rng.integers(0, total))
+                    on.append(
+                        {
+                            "endpoint": ep(t // eps_per_service, t % eps_per_service),
+                            "distance": int(rng.integers(1, 4)),
+                            "type": "SERVER",
+                        }
+                    )
+                for _ in range(int(rng.integers(0, fan_out + 1))):
+                    t = int(rng.integers(0, total))
+                    by.append(
+                        {
+                            "endpoint": ep(t // eps_per_service, t % eps_per_service),
+                            "distance": int(rng.integers(1, 4)),
+                            "type": "CLIENT",
+                        }
+                    )
+                deps.append(
+                    {"endpoint": ep(s, e), "dependingOn": on, "dependingBy": by}
+                )
+        return EndpointDependencies(deps)
+
+    @staticmethod
+    def _reference_graph_data(deps_obj):
+        """The pre-index algorithm (linear scans), kept as the oracle."""
+        from kmamiz_tpu.core.schema import js_str
+
+        self = deps_obj
+        service_endpoint_map = {}
+        for dep in self._dependencies:
+            key = f"{dep['endpoint']['service']}\t{dep['endpoint']['namespace']}"
+            service_endpoint_map.setdefault(key, []).append(dep)
+        nodes, links = self._create_base_nodes_and_links(service_endpoint_map)
+        with_id = [
+            {
+                **dep,
+                "uid": (
+                    f"{dep['endpoint']['uniqueServiceName']}"
+                    f"\t{dep['endpoint']['method']}"
+                    f"\t{js_str(dep['endpoint'].get('labelName'))}"
+                ),
+                "sid": f"{dep['endpoint']['service']}\t{dep['endpoint']['namespace']}",
+            }
+            for dep in self._dependencies
+        ]
+
+        def remap(deps):
+            return [
+                f"{d['endpoint']['uniqueServiceName']}\t{d['endpoint']['method']}"
+                f"\t{js_str(d['endpoint'].get('labelName'))}"
+                for d in deps
+            ]
+
+        def map_links(deps, node):
+            out = []
+            ids = remap(deps)
+            for i, d in enumerate(deps):
+                dep_id = ids[i]
+                remaining = set(ids[i + 1:]) | {node["id"]}
+                src, dst = (
+                    ("target", "source") if d["type"] == "SERVER" else ("source", "target")
+                )
+                out.extend(
+                    l for l in links if l[src] == dep_id and l[dst] in remaining
+                )
+            return out
+
+        for n in nodes:
+            if n["id"] == "null":
+                n["dependencies"] = [
+                    d["uid"] for d in with_id if len(d["dependingBy"]) == 0
+                ]
+                n["linkInBetween"] = [
+                    {"source": "null", "target": d} for d in n["dependencies"]
+                ]
+            elif n["id"] == n["group"]:
+                n["dependencies"] = [d["uid"] for d in with_id if d["sid"] == n["id"]]
+                n["linkInBetween"] = [
+                    {"source": n["id"], "target": d} for d in n["dependencies"]
+                ]
+            else:
+                matching = [d for d in with_id if d["uid"] == n["id"]]
+                n["linkInBetween"] = []
+                n["dependencies"] = []
+                for node in matching:
+                    d_on = sorted(node["dependingOn"], key=lambda d: -d["distance"])
+                    d_by = sorted(node["dependingBy"], key=lambda d: -d["distance"])
+                    n["linkInBetween"] = (
+                        n["linkInBetween"] + map_links(d_on, n) + map_links(d_by, n)
+                    )
+                    seen = set()
+                    merged = []
+                    for i in remap(d_on) + remap(d_by):
+                        if i not in seen:
+                            seen.add(i)
+                            merged.append(i)
+                    n["dependencies"] = n["dependencies"] + merged
+                seen_links = set()
+                deduped = []
+                for l in n["linkInBetween"]:
+                    key = f"{l['source']}\t\t{l['target']}"
+                    if key not in seen_links:
+                        seen_links.add(key)
+                        deduped.append({"source": l["source"], "target": l["target"]})
+                n["linkInBetween"] = deduped
+        return {"nodes": nodes, "links": links}
+
+    def test_matches_linear_scan_oracle(self):
+        import numpy as np
+
+        rng = np.random.default_rng(5)
+        deps = self._make_deps(6, 4, 3, rng)
+        assert deps.to_graph_data() == self._reference_graph_data(deps)
+
+    def test_scales_to_large_graphs(self):
+        import time
+
+        import numpy as np
+
+        rng = np.random.default_rng(9)
+        deps = self._make_deps(100, 20, 4, rng)  # 2,000 endpoint rows
+        t0 = time.perf_counter()
+        graph = deps.to_graph_data()
+        dt = time.perf_counter() - t0
+        assert len(graph["nodes"]) > 2000
+        # the pre-index algorithm took tens of seconds at this size
+        assert dt < 5.0, f"highlight closure too slow: {dt:.1f}s"

@@ -14,7 +14,7 @@ program shapes.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -87,6 +87,19 @@ class SpanBatch:
         return len(self.statuses)
 
 
+class _NamingEntry(NamedTuple):
+    """One distinct naming shape's resolved ids and info templates."""
+
+    eid: int
+    sid: int
+    rt_eid: int
+    rt_sid: int
+    uen: str
+    info_base: dict
+    rt_uen: str
+    rt_base: dict
+
+
 def spans_to_batch(
     trace_groups: Sequence[Sequence[dict]],
     interner: Optional[EndpointInterner] = None,
@@ -129,6 +142,17 @@ def spans_to_batch(
     timestamp_us = np.zeros(capacity, dtype=np.int64)
     trace_of = np.zeros(capacity, dtype=np.int32)
 
+    # per-window memo: spans repeat a small set of naming shapes, so the
+    # string formatting / URL explode / interning runs once per distinct
+    # (name, url, method, istio tags) combination instead of per span
+    # (~3x host ingest). Statuses cache separately (an endpoint emitting
+    # five statuses still resolves its naming once). Freshest-timestamp
+    # info semantics are preserved by tracking the max-ts span per
+    # endpoint and applying it after the loop.
+    naming_cache: Dict[tuple, "_NamingEntry"] = {}
+    status_cache: Dict[Optional[str], Tuple[int, int]] = {}
+    best_ts: Dict[int, Tuple[float, "_NamingEntry"]] = {}
+
     for i, span in enumerate(spans):
         valid[i] = True
         trace_of[i] = trace_of_id[span["id"]]
@@ -140,41 +164,89 @@ def spans_to_batch(
         if parent is not None and parent in index_of:
             parent_idx[i] = index_of[parent]
 
-        info = to_endpoint_info(span)
-        eid = interner.intern_endpoint(info["uniqueEndpointName"], info)
-        endpoint_id[i] = eid
-        service_id[i] = interner.service_of(eid)
-
         tags = span.get("tags", {})
-        rt_usn = (
-            f"{_js(tags.get('istio.canonical_service'))}"
-            f"\t{_js(tags.get('istio.namespace'))}"
-            f"\t{_js(tags.get('istio.canonical_revision'))}"
+        key = (
+            span.get("name", ""),
+            tags.get("http.url", ""),
+            tags.get("http.method"),
+            tags.get("istio.canonical_service"),
+            tags.get("istio.namespace"),
+            tags.get("istio.canonical_revision"),
+            tags.get("istio.mesh_id"),
         )
-        rt_uen = (
-            f"{rt_usn}\t{_js(tags.get('http.method'))}\t{_js(tags.get('http.url'))}"
-        )
-        # metadata for the rt-space endpoint carries the rt naming (istio
-        # tags), not the graph-space info
-        rt_eid = interner.intern_endpoint(
-            rt_uen,
-            {
-                **info,
+        hit = naming_cache.get(key)
+        if hit is None:
+            info = to_endpoint_info(span)
+            uen = info["uniqueEndpointName"]
+            info_base = {k_: v for k_, v in info.items() if k_ != "timestamp"}
+            eid = interner.intern_endpoint(uen, info)
+            rt_usn = (
+                f"{_js(tags.get('istio.canonical_service'))}"
+                f"\t{_js(tags.get('istio.namespace'))}"
+                f"\t{_js(tags.get('istio.canonical_revision'))}"
+            )
+            rt_uen = (
+                f"{rt_usn}\t{_js(tags.get('http.method'))}"
+                f"\t{_js(tags.get('http.url'))}"
+            )
+            # metadata for the rt-space endpoint carries the rt naming
+            # (istio tags), not the graph-space info
+            rt_base = {
+                **info_base,
                 "service": tags.get("istio.canonical_service"),
                 "namespace": tags.get("istio.namespace"),
                 "version": tags.get("istio.canonical_revision"),
                 "uniqueServiceName": rt_usn,
                 "uniqueEndpointName": rt_uen,
-            },
-        )
-        rt_endpoint_id[i] = rt_eid
-        rt_service_id[i] = interner.service_of(rt_eid)
+            }
+            rt_eid = interner.intern_endpoint(
+                rt_uen, {**rt_base, "timestamp": info["timestamp"]}
+            )
+            hit = _NamingEntry(
+                eid=eid,
+                sid=interner.service_of(eid),
+                rt_eid=rt_eid,
+                rt_sid=interner.service_of(rt_eid),
+                uen=uen,
+                info_base=info_base,
+                rt_uen=rt_uen,
+                rt_base=rt_base,
+            )
+            naming_cache[key] = hit
 
-        status = tags.get("http.status_code") or ""
-        status_id[i] = statuses.intern(status)
-        status_class[i] = int(status[0]) if status[:1].isdigit() else 0
+        raw_status = tags.get("http.status_code")
+        st = status_cache.get(raw_status)
+        if st is None:
+            status = raw_status or ""
+            st = (
+                statuses.intern(status),
+                int(status[0]) if status[:1].isdigit() else 0,
+            )
+            status_cache[raw_status] = st
+
+        endpoint_id[i] = hit.eid
+        service_id[i] = hit.sid
+        rt_endpoint_id[i] = hit.rt_eid
+        rt_service_id[i] = hit.rt_sid
+        status_id[i], status_class[i] = st
         latency_ms[i] = span.get("duration", 0) / 1000
-        timestamp_us[i] = span.get("timestamp", 0)
+        ts_us = span.get("timestamp", 0)
+        timestamp_us[i] = ts_us
+        ts_ms = ts_us / 1000
+        for key_eid in (hit.eid, hit.rt_eid):
+            prev = best_ts.get(key_eid)
+            if prev is None or ts_ms > prev[0]:
+                best_ts[key_eid] = (ts_ms, hit)
+
+    # apply the freshest timestamp per endpoint (intern_endpoint keeps the
+    # max vs any info already stored by earlier windows)
+    for key_eid, (ts_ms, hit) in best_ts.items():
+        if key_eid == hit.eid:
+            interner.intern_endpoint(hit.uen, {**hit.info_base, "timestamp": ts_ms})
+        else:
+            interner.intern_endpoint(
+                hit.rt_uen, {**hit.rt_base, "timestamp": ts_ms}
+            )
 
     endpoint_infos = [i for i in interner.endpoint_infos if i is not None]
     if ts_base_us is not None:

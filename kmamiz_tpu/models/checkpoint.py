@@ -1,0 +1,96 @@
+"""Model checkpoint/resume for the GraphSAGE head.
+
+The framework's cache layer checkpoints through the Store + tgz
+export/import (SURVEY.md §5); the trained model checkpoints here via
+orbax so a latency/anomaly head survives restarts and can be promoted
+between instances. Layout per step: an orbax PyTree checkpoint of
+{params, opt_state} plus a small metadata dict.
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+from typing import Any, Optional, Tuple
+
+import jax
+
+from kmamiz_tpu.models.graphsage import SageParams
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+
+    return ocp.PyTreeCheckpointer()
+
+
+def save_checkpoint(
+    directory: str,
+    params: SageParams,
+    opt_state: Any,
+    step: int,
+    metadata: Optional[dict] = None,
+) -> str:
+    """Write {params, opt_state} under directory/step_<N> (orbax) and the
+    metadata dict as a step_<N>.meta.json sibling; returns the checkpoint
+    path."""
+    path = os.path.abspath(os.path.join(directory, f"step_{step}"))
+    if os.path.isdir(path):  # orbax refuses to overwrite; re-saves replace
+        shutil.rmtree(path)
+    payload = {"params": params._asdict(), "opt_state": opt_state}
+    _checkpointer().save(path, payload)
+    with open(f"{path}.meta.json", "w") as f:
+        json.dump({"step": step, **(metadata or {})}, f)
+    return path
+
+
+def latest_step(directory: str) -> Optional[int]:
+    if not os.path.isdir(directory):
+        return None
+    steps = []
+    for name in os.listdir(directory):
+        if not name.startswith("step_"):
+            continue
+        if not os.path.isdir(os.path.join(directory, name)):
+            continue  # meta sidecars / stray files are not checkpoints
+        try:
+            steps.append(int(name.split("_", 1)[1]))
+        except ValueError:
+            continue
+    return max(steps) if steps else None
+
+
+def restore_checkpoint(
+    directory: str,
+    params_template: SageParams,
+    opt_state_template: Any,
+    step: Optional[int] = None,
+) -> Optional[Tuple[SageParams, Any, dict]]:
+    """Restore (params, opt_state, meta) from directory/step_<N> (latest
+    when step is None); None when no checkpoint exists.
+
+    The templates (e.g. graphsage.init_params(...) and optimizer.init of
+    them) carry the pytree STRUCTURE — orbax restores leaves into it, so
+    optax's NamedTuple states come back intact. Template shapes must match
+    the checkpoint (same hidden size); train() validates via metadata."""
+    if step is None:
+        step = latest_step(directory)
+        if step is None:
+            return None
+    path = os.path.abspath(os.path.join(directory, f"step_{step}"))
+    if not os.path.isdir(path):
+        return None
+    meta: dict = {"step": step}
+    meta_path = f"{path}.meta.json"
+    if os.path.isfile(meta_path):
+        with open(meta_path) as f:
+            meta = json.load(f)
+    template = {
+        "params": params_template._asdict(),
+        "opt_state": opt_state_template,
+    }
+    payload = _checkpointer().restore(path, item=template)
+    params = SageParams(
+        **{k: jax.numpy.asarray(v) for k, v in payload["params"].items()}
+    )
+    return params, payload["opt_state"], meta

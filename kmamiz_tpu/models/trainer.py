@@ -175,15 +175,38 @@ def train(
     hidden: int = 32,
     lr: float = 1e-2,
     seed: int = 0,
+    checkpoint_dir: str = "",
+    checkpoint_every: int = 10,
 ) -> TrainResult:
-    """Full-graph training, one step per slot per epoch."""
+    """Full-graph training, one step per slot per epoch.
+
+    With checkpoint_dir set, training resumes from the latest saved epoch
+    (kmamiz_tpu.models.checkpoint) and snapshots every checkpoint_every
+    epochs (0 = only at the end) plus at the end. Resuming validates the
+    saved hyperparameters against the requested ones."""
+    from kmamiz_tpu.models import checkpoint as ckpt
+
     params = graphsage.init_params(jax.random.PRNGKey(seed), hidden=hidden)
     optimizer = graphsage.make_optimizer(lr)
     opt_state = optimizer.init(params)
     step = graphsage.make_train_step(optimizer)
 
+    start_epoch = 0
+    if checkpoint_dir:
+        restored = ckpt.restore_checkpoint(checkpoint_dir, params, opt_state)
+        if restored is not None:
+            params, opt_state, meta = restored
+            for name, want in (("hidden", hidden), ("lr", lr), ("seed", seed)):
+                saved = meta.get(name)
+                if saved is not None and saved != want:
+                    raise ValueError(
+                        f"checkpoint {checkpoint_dir} was trained with "
+                        f"{name}={saved}, requested {name}={want}"
+                    )
+            start_epoch = int(meta.get("step", 0))
+
     losses, lat_losses, ano_losses = [], [], []
-    for _ in range(epochs):
+    for epoch in range(start_epoch, epochs):
         epoch_loss = epoch_lat = epoch_ano = 0.0
         for i in range(len(dataset.features)):
             params, opt_state, loss, (lat_l, ano_l) = step(
@@ -204,6 +227,22 @@ def train(
         losses.append(epoch_loss / slots)
         lat_losses.append(epoch_lat / slots)
         ano_losses.append(epoch_ano / slots)
+        if checkpoint_dir and (
+            (checkpoint_every > 0 and (epoch + 1) % checkpoint_every == 0)
+            or epoch + 1 == epochs
+        ):
+            ckpt.save_checkpoint(
+                checkpoint_dir,
+                params,
+                opt_state,
+                step=epoch + 1,
+                metadata={
+                    "loss": losses[-1],
+                    "hidden": hidden,
+                    "lr": lr,
+                    "seed": seed,
+                },
+            )
     return TrainResult(params, losses, lat_losses, ano_losses)
 
 

@@ -140,3 +140,152 @@ class TestTraining:
         # the flagged endpoints are the faulted one (and its dependents)
         flagged = {n for names in metrics.per_slot_flagged.values() for n in names}
         assert any("back" in n for n in flagged)
+
+
+class TestCheckpointResume:
+    def test_save_restore_roundtrip(self, tmp_path):
+        import jax
+        import numpy as np
+
+        from kmamiz_tpu.models import checkpoint, graphsage
+
+        params = graphsage.init_params(jax.random.PRNGKey(3), hidden=16)
+        optimizer = graphsage.make_optimizer()
+        opt_state = optimizer.init(params)
+
+        path = checkpoint.save_checkpoint(
+            str(tmp_path), params, opt_state, step=7, metadata={"loss": 1.25}
+        )
+        assert path.endswith("step_7")
+        assert checkpoint.latest_step(str(tmp_path)) == 7
+
+        restored = checkpoint.restore_checkpoint(
+            str(tmp_path), params, optimizer.init(params)
+        )
+        assert restored is not None
+        r_params, r_opt, meta = restored
+        assert int(meta["step"]) == 7
+        assert float(meta["loss"]) == 1.25
+        for a, b in zip(params, r_params):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+        # resumed training step runs
+        step_fn = graphsage.make_train_step(optimizer)
+        rng = np.random.default_rng(0)
+        feats = jax.numpy.asarray(
+            rng.normal(size=(32, graphsage.NUM_FEATURES)).astype(np.float32)
+        )
+        src = jax.numpy.asarray(rng.integers(0, 32, 64, dtype=np.int32))
+        dst = jax.numpy.asarray(rng.integers(0, 32, 64, dtype=np.int32))
+        mask = jax.numpy.ones(64, dtype=bool)
+        tl = jax.numpy.asarray(rng.normal(size=32).astype(np.float32))
+        ta = jax.numpy.zeros(32, dtype=jax.numpy.float32)
+        nm = jax.numpy.ones(32, dtype=bool)
+        out = step_fn(r_params, r_opt, feats, src, dst, mask, tl, ta, nm)
+        assert np.isfinite(float(out[2]))
+
+    def test_restore_empty_dir(self, tmp_path):
+        from kmamiz_tpu.models import checkpoint
+
+        import jax
+
+        from kmamiz_tpu.models import graphsage
+
+        params = graphsage.init_params(jax.random.PRNGKey(0), hidden=8)
+        optimizer = graphsage.make_optimizer()
+        assert (
+            checkpoint.restore_checkpoint(
+                str(tmp_path), params, optimizer.init(params)
+            )
+            is None
+        )
+        assert checkpoint.latest_step(str(tmp_path / "missing")) is None
+
+    def test_multiple_steps_latest_wins(self, tmp_path):
+        import jax
+
+        from kmamiz_tpu.models import checkpoint, graphsage
+
+        params = graphsage.init_params(jax.random.PRNGKey(1), hidden=8)
+        optimizer = graphsage.make_optimizer()
+        opt_state = optimizer.init(params)
+        for s in (1, 5, 3):
+            checkpoint.save_checkpoint(str(tmp_path), params, opt_state, step=s)
+        assert checkpoint.latest_step(str(tmp_path)) == 5
+        _, _, meta = checkpoint.restore_checkpoint(
+            str(tmp_path), params, optimizer.init(params)
+        )
+        assert int(meta["step"]) == 5
+
+    def test_train_resume_from_checkpoint(self, tmp_path):
+        import numpy as np
+
+        from kmamiz_tpu.models import checkpoint, trainer
+
+        rng = np.random.default_rng(0)
+        n_nodes, n_edges, n_slots = 16, 24, 2
+        from kmamiz_tpu.models import graphsage
+        import jax.numpy as jnp
+
+        ds = trainer.GraphDataset(
+            features=[
+                jnp.asarray(rng.normal(size=(n_nodes, graphsage.NUM_FEATURES)).astype(np.float32))
+                for _ in range(n_slots)
+            ],
+            src=jnp.asarray(rng.integers(0, n_nodes, n_edges, dtype=np.int32)),
+            dst=jnp.asarray(rng.integers(0, n_nodes, n_edges, dtype=np.int32)),
+            edge_mask=jnp.ones(n_edges, dtype=bool),
+            target_latency=[
+                jnp.asarray(rng.normal(size=n_nodes).astype(np.float32))
+                for _ in range(n_slots)
+            ],
+            target_anomaly=[
+                jnp.zeros(n_nodes, dtype=jnp.float32) for _ in range(n_slots)
+            ],
+            node_mask=[jnp.ones(n_nodes, dtype=bool) for _ in range(n_slots)],
+            endpoint_names=[f"ep{i}" for i in range(n_nodes)],
+            slot_keys=[f"s{i}" for i in range(n_slots)],
+        )
+        d = str(tmp_path / "ckpt")
+        r1 = trainer.train(ds, epochs=4, hidden=8, checkpoint_dir=d, checkpoint_every=2)
+        assert checkpoint.latest_step(d) == 4
+        # resuming when fully trained is a no-op (no epochs left)
+        r2 = trainer.train(ds, epochs=4, hidden=8, checkpoint_dir=d)
+        assert r2.losses == []
+        for a, b in zip(r1.params, r2.params):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        # a longer run continues from epoch 4
+        r3 = trainer.train(ds, epochs=6, hidden=8, checkpoint_dir=d, checkpoint_every=2)
+        assert len(r3.losses) == 2
+        assert checkpoint.latest_step(d) == 6
+
+    def test_resume_rejects_hyperparameter_mismatch(self, tmp_path):
+        import jax
+        import pytest
+
+        from kmamiz_tpu.models import checkpoint, graphsage, trainer
+
+        params = graphsage.init_params(jax.random.PRNGKey(0), hidden=8)
+        optimizer = graphsage.make_optimizer()
+        checkpoint.save_checkpoint(
+            str(tmp_path), params, optimizer.init(params), step=2,
+            metadata={"hidden": 8, "lr": 1e-2, "seed": 0},
+        )
+        ds = None  # train validates metadata before touching the dataset
+        with pytest.raises(ValueError, match="hidden=8"):
+            trainer.train(ds, epochs=4, hidden=16, checkpoint_dir=str(tmp_path))
+
+    def test_stray_file_does_not_mask_checkpoints(self, tmp_path):
+        import jax
+
+        from kmamiz_tpu.models import checkpoint, graphsage
+
+        params = graphsage.init_params(jax.random.PRNGKey(0), hidden=8)
+        optimizer = graphsage.make_optimizer()
+        checkpoint.save_checkpoint(str(tmp_path), params, optimizer.init(params), step=4)
+        (tmp_path / "step_99").write_text("stray artifact, not a checkpoint")
+        assert checkpoint.latest_step(str(tmp_path)) == 4
+        restored = checkpoint.restore_checkpoint(
+            str(tmp_path), params, optimizer.init(params)
+        )
+        assert restored is not None and int(restored[2]["step"]) == 4

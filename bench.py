@@ -22,6 +22,7 @@ on a trivial kernel and reported alongside.
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
@@ -181,6 +182,66 @@ def main() -> None:
     refresh_total = _timed(lambda: float(refresh_chain()), reps=7)
     refresh_ms = max(refresh_total - rtt, 0.0) / ITERS * 1000
 
+    # ---- end-to-end DP tick at the reference's own scale -------------------
+    # the reference caps realtime ticks at 2,500 traces / 5 s; this times the
+    # FULL DataProcessor.collect (host parse + device kernels + response
+    # assembly) on a 2,500-trace window, the product-level SLA
+    from kmamiz_tpu.server.processor import DataProcessor
+
+    base_spans = [
+        {
+            "traceId": "t0",
+            "id": f"s{j}",
+            "parentId": f"s{j-1}" if j else None,
+            "kind": "SERVER" if j % 2 == 0 else "CLIENT",
+            "name": f"svc{j % 5}.ns.svc.cluster.local:80/*",
+            "timestamp": 1_700_000_000_000_000 + j,
+            "duration": 1000 + j,
+            "tags": {
+                "http.method": "GET",
+                "http.status_code": "200",
+                "http.url": f"http://svc{j % 5}.ns.svc.cluster.local/api/{j % 7}",
+                "istio.canonical_revision": "v1",
+                "istio.canonical_service": f"svc{j % 5}",
+                "istio.mesh_id": "cluster.local",
+                "istio.namespace": "ns",
+            },
+        }
+        for j in range(7)
+    ]
+
+    def tick_traces(tick_id):
+        groups = []
+        for t in range(2500):
+            g = []
+            for s in base_spans:
+                c = dict(s)
+                c["id"] = f"{tick_id}-{t}-{s['id']}"
+                c["traceId"] = f"{tick_id}-t{t}"
+                if c["parentId"]:
+                    c["parentId"] = f"{tick_id}-{t}-{c['parentId']}"
+                if t % 17 == 0 and s["kind"] == "SERVER":
+                    c = {**c, "tags": {**c["tags"], "http.status_code": "503"}}
+                g.append(c)
+            groups.append(g)
+        return groups
+
+    # pre-generate every rep's window OUTSIDE the timed region: the metric
+    # charges only DataProcessor.collect, not test-data synthesis
+    prebuilt = [tick_traces(i) for i in range(6)]
+
+    def source(_lb, _t, _lim):
+        return prebuilt.pop(0)
+
+    dp = DataProcessor(trace_source=source, use_device_stats=True)
+    dp.collect({"uniqueId": "warm", "lookBack": 30_000, "time": 0})  # compile
+    tick_times = []
+    for rep in range(5):
+        t0 = time.perf_counter()
+        dp.collect({"uniqueId": f"b{rep}", "lookBack": 30_000, "time": rep})
+        tick_times.append(time.perf_counter() - t0)
+    dp_tick_ms = float(np.median(tick_times)) * 1000
+
     result = {
         "metric": "span ingest throughput (window stats + MXU dependency walk, 1M-span window)",
         "value": round(spans_per_sec, 0),
@@ -191,6 +252,8 @@ def main() -> None:
         "n_spans": N_SPANS,
         "n_endpoints": N_ENDPOINTS,
         "n_services": N_SERVICES,
+        "dp_tick_ms_2500_traces": round(dp_tick_ms, 1),
+        "dp_tick_budget_ms": 5000.0,  # the reference's realtime cadence
         "chained_iters": ITERS,
         "tunnel_rtt_ms": round(rtt * 1000, 1),
         "packing_host_ms": round(packing_host_ms, 1),
@@ -201,6 +264,11 @@ def main() -> None:
         "device": str(jax.devices()[0]),
     }
     print(json.dumps(result))
+    sys.stdout.flush()
+    # the axon PJRT plugin can panic in its destructor during interpreter
+    # teardown (client refs already destroyed); the result is printed, so
+    # exit cleanly without running destructors
+    os._exit(0)
 
 
 if __name__ == "__main__":

@@ -37,6 +37,11 @@ def save_checkpoint(
     path = os.path.abspath(os.path.join(directory, f"step_{step}"))
     if os.path.isdir(path):  # orbax refuses to overwrite; re-saves replace
         shutil.rmtree(path)
+    # the stale sidecar goes too: a crash mid-re-save must not pair old
+    # metadata with a new checkpoint (restore treats missing meta as an
+    # incomplete save)
+    if os.path.isfile(f"{path}.meta.json"):
+        os.remove(f"{path}.meta.json")
     payload = {"params": params._asdict(), "opt_state": opt_state}
     _checkpointer().save(path, payload)
     with open(f"{path}.meta.json", "w") as f:
@@ -58,6 +63,21 @@ def latest_step(directory: str) -> Optional[int]:
         except ValueError:
             continue
     return max(steps) if steps else None
+
+
+def load_metadata(directory: str, step: Optional[int] = None) -> Optional[dict]:
+    """The metadata sidecar of directory/step_<N> (latest when step is
+    None); None when no checkpoint or no sidecar exists. Lets callers
+    validate hyperparameters BEFORE paying the restore."""
+    if step is None:
+        step = latest_step(directory)
+        if step is None:
+            return None
+    meta_path = os.path.join(directory, f"step_{step}.meta.json")
+    if not os.path.isfile(meta_path):
+        return None
+    with open(meta_path) as f:
+        return json.load(f)
 
 
 def restore_checkpoint(

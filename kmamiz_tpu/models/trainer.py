@@ -192,17 +192,30 @@ def train(
     step = graphsage.make_train_step(optimizer)
 
     start_epoch = 0
-    if checkpoint_dir:
+    if checkpoint_dir and ckpt.latest_step(checkpoint_dir) is not None:
+        # validate hyperparameters BEFORE restoring: orbax would silently
+        # return the saved shapes even against a mismatched template
+        meta = ckpt.load_metadata(checkpoint_dir)
+        if meta is None:
+            raise ValueError(
+                f"checkpoint {checkpoint_dir} has no metadata sidecar "
+                "(incomplete save?); cannot validate hyperparameters"
+            )
+        for name, want in (("hidden", hidden), ("lr", lr), ("seed", seed)):
+            saved = meta.get(name)
+            if saved is None:
+                raise ValueError(
+                    f"checkpoint {checkpoint_dir} metadata lacks '{name}'; "
+                    "was it saved outside trainer.train()?"
+                )
+            if saved != want:
+                raise ValueError(
+                    f"checkpoint {checkpoint_dir} was trained with "
+                    f"{name}={saved}, requested {name}={want}"
+                )
         restored = ckpt.restore_checkpoint(checkpoint_dir, params, opt_state)
         if restored is not None:
             params, opt_state, meta = restored
-            for name, want in (("hidden", hidden), ("lr", lr), ("seed", seed)):
-                saved = meta.get(name)
-                if saved is not None and saved != want:
-                    raise ValueError(
-                        f"checkpoint {checkpoint_dir} was trained with "
-                        f"{name}={saved}, requested {name}={want}"
-                    )
             start_epoch = int(meta.get("step", 0))
 
     losses, lat_losses, ano_losses = [], [], []

@@ -234,13 +234,15 @@ def main() -> None:
         return prebuilt.pop(0)
 
     dp = DataProcessor(trace_source=source, use_device_stats=True)
-    dp.collect({"uniqueId": "warm", "lookBack": 30_000, "time": 0})  # compile
-    tick_times = []
-    for rep in range(5):
-        t0 = time.perf_counter()
-        dp.collect({"uniqueId": f"b{rep}", "lookBack": 30_000, "time": rep})
-        tick_times.append(time.perf_counter() - t0)
-    dp_tick_ms = float(np.median(tick_times)) * 1000
+    rep_counter = {"n": 0}
+
+    def one_tick():
+        rep_counter["n"] += 1
+        dp.collect(
+            {"uniqueId": f"b{rep_counter['n']}", "lookBack": 30_000, "time": rep_counter["n"]}
+        )
+
+    dp_tick_ms = _timed(one_tick, reps=5) * 1000  # first call is the warmup
 
     result = {
         "metric": "span ingest throughput (window stats + MXU dependency walk, 1M-span window)",

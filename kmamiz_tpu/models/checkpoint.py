@@ -65,6 +65,27 @@ def latest_step(directory: str) -> Optional[int]:
     return max(steps) if steps else None
 
 
+def latest_complete_step(directory: str) -> Optional[int]:
+    """The newest step whose metadata sidecar exists — a checkpoint dir
+    without its sidecar is an incomplete save (crash mid-write) and is
+    skipped in favor of the previous complete one."""
+    if not os.path.isdir(directory):
+        return None
+    candidates = []
+    for name in os.listdir(directory):
+        if not name.startswith("step_") or not os.path.isdir(
+            os.path.join(directory, name)
+        ):
+            continue
+        try:
+            step = int(name.split("_", 1)[1])
+        except ValueError:
+            continue
+        if os.path.isfile(os.path.join(directory, f"step_{step}.meta.json")):
+            candidates.append(step)
+    return max(candidates) if candidates else None
+
+
 def load_metadata(directory: str, step: Optional[int] = None) -> Optional[dict]:
     """The metadata sidecar of directory/step_<N> (latest when step is
     None); None when no checkpoint or no sidecar exists. Lets callers

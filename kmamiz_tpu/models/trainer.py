@@ -15,6 +15,7 @@ works on any simulation config.
 """
 from __future__ import annotations
 
+import logging
 from dataclasses import dataclass, field
 from typing import Dict, List, Tuple
 
@@ -24,6 +25,8 @@ import numpy as np
 
 from kmamiz_tpu.models import graphsage
 from kmamiz_tpu.simulator.naming import extract_unique_service_name
+
+logger = logging.getLogger("kmamiz_tpu.models.trainer")
 from kmamiz_tpu.simulator.slot_metrics import parse_slot_key
 
 ANOMALY_ERROR_SHARE = 0.10  # next-slot 5xx share that counts as anomalous
@@ -192,31 +195,39 @@ def train(
     step = graphsage.make_train_step(optimizer)
 
     start_epoch = 0
-    if checkpoint_dir and ckpt.latest_step(checkpoint_dir) is not None:
-        # validate hyperparameters BEFORE restoring: orbax would silently
-        # return the saved shapes even against a mismatched template
-        meta = ckpt.load_metadata(checkpoint_dir)
-        if meta is None:
-            raise ValueError(
-                f"checkpoint {checkpoint_dir} has no metadata sidecar "
-                "(incomplete save?); cannot validate hyperparameters"
+    if checkpoint_dir:
+        # resolve the resume step ONCE (guard/validate/restore must agree
+        # even if another instance writes meanwhile); incomplete saves
+        # (dir without sidecar) fall back to the previous complete step
+        resume_step = ckpt.latest_complete_step(checkpoint_dir)
+        if resume_step is None and ckpt.latest_step(checkpoint_dir) is not None:
+            logger.warning(
+                "checkpoint dir %s has only incomplete saves; starting fresh",
+                checkpoint_dir,
             )
-        for name, want in (("hidden", hidden), ("lr", lr), ("seed", seed)):
-            saved = meta.get(name)
-            if saved is None:
-                raise ValueError(
-                    f"checkpoint {checkpoint_dir} metadata lacks '{name}'; "
-                    "was it saved outside trainer.train()?"
-                )
-            if saved != want:
-                raise ValueError(
-                    f"checkpoint {checkpoint_dir} was trained with "
-                    f"{name}={saved}, requested {name}={want}"
-                )
-        restored = ckpt.restore_checkpoint(checkpoint_dir, params, opt_state)
-        if restored is not None:
-            params, opt_state, meta = restored
-            start_epoch = int(meta.get("step", 0))
+        if resume_step is not None:
+            # validate hyperparameters BEFORE restoring: orbax would
+            # silently return the saved shapes against a mismatched template
+            meta = ckpt.load_metadata(checkpoint_dir, resume_step) or {}
+            for name, want in (("hidden", hidden), ("lr", lr), ("seed", seed)):
+                saved = meta.get(name)
+                if saved is None:
+                    raise ValueError(
+                        f"checkpoint {checkpoint_dir} step {resume_step} "
+                        f"metadata lacks '{name}'; was it saved outside "
+                        "trainer.train()?"
+                    )
+                if saved != want:
+                    raise ValueError(
+                        f"checkpoint {checkpoint_dir} was trained with "
+                        f"{name}={saved}, requested {name}={want}"
+                    )
+            restored = ckpt.restore_checkpoint(
+                checkpoint_dir, params, opt_state, step=resume_step
+            )
+            if restored is not None:
+                params, opt_state, meta = restored
+                start_epoch = int(meta.get("step", 0))
 
     losses, lat_losses, ano_losses = [], [], []
     for epoch in range(start_epoch, epochs):

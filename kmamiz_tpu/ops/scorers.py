@@ -17,7 +17,11 @@ Semantics mirrored from the reference:
   (RiskAnalyzer.ts:145-169);
 - relying factor sums by_count/distance (+1 gateway) (:124-137);
 - usage cohesion averages consumed-endpoint fractions over consumer
-  services (EndpointDependencies.ts:565-612).
+  services (EndpointDependencies.ts:565-612). Note: the reference counts
+  dependency ROWS as totalEndpoints; in production those are merged
+  per-endpoint by the cache's combineWith (keyed uniqueEndpointName), and
+  this kernel implements that steady-state per-endpoint semantics — the
+  reference's un-merged first-window quirk is not reproduced.
 
 Edge convention: (src_ep, dst_ep, dist) means src depends-ON dst (src is
 the CLIENT-side ancestor, dst the SERVER-side descendant), i.e. dst is
@@ -31,7 +35,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-from kmamiz_tpu.ops.sortutil import lex_unique
+from kmamiz_tpu.ops.sortutil import SENTINEL, lex_unique
 
 
 class ServiceScores(NamedTuple):
@@ -76,6 +80,10 @@ def service_scores(
 
     # direction rows: "on" = owner src sees linked dst; "by" = owner dst sees
     # linked src. Distinct (owner, linked_svc, linked_ml, dist, dir) tuples.
+    # Key order puts (owner, linked, dir) FIRST so one sort serves all three
+    # granularities: full-tuple distincts for the detail counts, and
+    # prefix-boundary distincts for instability/ACS — replacing two further
+    # lexsorts (TPU sorts cost one pass per key) with segment ops.
     owner = jnp.concatenate([src_svc, dst_svc])
     linked = jnp.concatenate([dst_svc, src_svc])
     linked_ml = jnp.concatenate([dst_ml, src_ml])
@@ -85,39 +93,54 @@ def service_scores(
     )  # 0 = on/SERVER, 1 = by/CLIENT
     both_mask = jnp.concatenate([mask, mask])
 
-    (s_owner, s_linked, s_ml, s_dist, s_dir), uniq = lex_unique(
-        (owner, linked, linked_ml, ddist, ddir), both_mask
+    (s_owner, s_linked, s_dir, s_ml, s_dist), uniq = lex_unique(
+        (owner, linked, ddir, linked_ml, ddist), both_mask
     )
 
     park = num_services
     owner_seg = jnp.where(uniq, s_owner, park)
+    row_valid = s_owner != SENTINEL
 
-    # -- distinct (owner, linked, direction) for instability -----------------
-    (p_owner, p_linked, p_dir), p_uniq = lex_unique(
-        (s_owner, s_linked, s_dir), uniq
+    # -- distinct (owner, linked, direction): prefix boundaries --------------
+    prefix_neq = (
+        (s_owner[1:] != s_owner[:-1])
+        | (s_linked[1:] != s_linked[:-1])
+        | (s_dir[1:] != s_dir[:-1])
     )
-    p_seg = jnp.where(p_uniq, p_owner, park)
-    fdir = p_dir == 0
+    triple_first = jnp.concatenate([jnp.array([True]), prefix_neq]) & row_valid
+    fdir = s_dir == 0
+    triple_seg = jnp.where(triple_first, s_owner, park)
     inst_on = jax.ops.segment_sum(
-        (p_uniq & fdir).astype(jnp.float32), p_seg, num_segments=park + 1
+        (triple_first & fdir).astype(jnp.float32),
+        triple_seg,
+        num_segments=park + 1,
     )[:-1]
     inst_by = jax.ops.segment_sum(
-        (p_uniq & ~fdir).astype(jnp.float32), p_seg, num_segments=park + 1
+        (triple_first & ~fdir).astype(jnp.float32),
+        triple_seg,
+        num_segments=park + 1,
     )[:-1]
     total = inst_on + inst_by
     instability = jnp.where(total > 0, inst_on / jnp.maximum(total, 1), 0.0)
 
-    # -- ACS at distance 1 ---------------------------------------------------
-    (q_owner, q_linked, q_dir), q_uniq = lex_unique(
-        (s_owner, s_linked, s_dir), uniq & (s_dist == 1)
+    # -- ACS at distance 1: triples containing any distance-1 row ------------
+    cap = s_owner.shape[0]
+    triple_gid = jnp.cumsum(triple_first.astype(jnp.int32)) - 1
+    has_d1 = jax.ops.segment_max(
+        ((s_dist == 1) & row_valid).astype(jnp.int32),
+        jnp.maximum(triple_gid, 0),
+        num_segments=cap,
     )
-    q_seg = jnp.where(q_uniq, q_owner, park)
-    qdir_on = q_dir == 0
+    d1_at_row = has_d1[jnp.maximum(triple_gid, 0)] > 0
     ads = jax.ops.segment_sum(
-        (q_uniq & qdir_on).astype(jnp.float32), q_seg, num_segments=park + 1
+        (triple_first & fdir & d1_at_row).astype(jnp.float32),
+        triple_seg,
+        num_segments=park + 1,
     )[:-1]
     ais_links = jax.ops.segment_sum(
-        (q_uniq & ~qdir_on).astype(jnp.float32), q_seg, num_segments=park + 1
+        (triple_first & ~fdir & d1_at_row).astype(jnp.float32),
+        triple_seg,
+        num_segments=park + 1,
     )[:-1]
 
     # gateway: a service owning an endpoint record with zero depended-by
@@ -185,33 +208,46 @@ def usage_cohesion(
         num_segments=park + 1,
     )[:-1]
 
-    # distance-1 by-edges: consumer = svc[src], consumed endpoint = dst
+    # distance-1 by-edges: consumer = svc[src], consumed endpoint = dst.
+    # ONE sort keyed (owner, consumer, consumed_ep): identical
+    # (consumer, ep) pairs share their owner (owner = svc[ep]), so pair
+    # distincts are full-row boundaries and (owner, consumer) groups are
+    # prefix boundaries of the same order — no second lexsort.
     d1 = mask & (dist == 1)
     consumer = ep_service[jnp.maximum(src_ep, 0)]
-    # distinct (consumer_svc, consumed_ep)
-    (k_consumer, k_consumed), k_uniq = lex_unique((consumer, dst_ep), d1)
-    k_owner = ep_service[jnp.minimum(k_consumed, ep_service.shape[0] - 1)]
-
-    # per (owner_svc, consumer_svc): count of consumed endpoints
-    (g_owner, g_consumer), g_uniq_rows = lex_unique((k_owner, k_consumer), k_uniq)
-    # rows are sorted by (owner, consumer); each distinct pair's count is the
-    # number of identical rows — segment by cumulative group index
-    group_idx = jnp.cumsum(g_uniq_rows.astype(jnp.int32)) - 1
+    owner = ep_service[jnp.maximum(dst_ep, 0)]
+    (g_owner, g_consumer, g_ep), pair_first = lex_unique(
+        (owner, consumer, dst_ep), d1
+    )
+    row_valid = g_owner != SENTINEL
+    group_first = (
+        jnp.concatenate(
+            [
+                jnp.array([True]),
+                (g_owner[1:] != g_owner[:-1])
+                | (g_consumer[1:] != g_consumer[:-1]),
+            ]
+        )
+        & row_valid
+    )
     cap = g_owner.shape[0]
-    valid_row = g_owner != jnp.iinfo(jnp.int32).max
+    group_gid = jnp.cumsum(group_first.astype(jnp.int32)) - 1
+    # consumed endpoints per (owner, consumer) group
     pair_counts = jax.ops.segment_sum(
-        valid_row.astype(jnp.float32), jnp.maximum(group_idx, 0), num_segments=cap
+        pair_first.astype(jnp.float32),
+        jnp.maximum(group_gid, 0),
+        num_segments=cap,
     )
     owner_total = total_endpoints[jnp.minimum(g_owner, park - 1)]
     frac = jnp.where(
-        g_uniq_rows & (owner_total > 0),
-        pair_counts[jnp.maximum(group_idx, 0)] / jnp.maximum(owner_total, 1),
+        group_first & (owner_total > 0),
+        pair_counts[jnp.maximum(group_gid, 0)] / jnp.maximum(owner_total, 1),
         0.0,
     )
-    pair_owner_seg = jnp.where(g_uniq_rows, g_owner, park)
+    pair_owner_seg = jnp.where(group_first, g_owner, park)
     frac_sum = jax.ops.segment_sum(frac, pair_owner_seg, num_segments=park + 1)[:-1]
     consumer_count = jax.ops.segment_sum(
-        g_uniq_rows.astype(jnp.float32), pair_owner_seg, num_segments=park + 1
+        group_first.astype(jnp.float32), pair_owner_seg, num_segments=park + 1
     )[:-1]
     cohesion = jnp.where(
         consumer_count > 0, frac_sum / jnp.maximum(consumer_count, 1), 0.0

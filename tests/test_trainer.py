@@ -289,3 +289,28 @@ class TestCheckpointResume:
             str(tmp_path), params, optimizer.init(params)
         )
         assert restored is not None and int(restored[2]["step"]) == 4
+
+    def test_incomplete_save_falls_back(self, tmp_path):
+        """A checkpoint dir missing its metadata sidecar (crash mid-save)
+        must not brick resume: the previous complete step wins; with no
+        complete step, training starts fresh."""
+        import os
+        import jax
+
+        from kmamiz_tpu.models import checkpoint, graphsage
+
+        params = graphsage.init_params(jax.random.PRNGKey(0), hidden=8)
+        optimizer = graphsage.make_optimizer()
+        checkpoint.save_checkpoint(
+            str(tmp_path), params, optimizer.init(params), step=2,
+            metadata={"hidden": 8, "lr": 1e-2, "seed": 0},
+        )
+        checkpoint.save_checkpoint(
+            str(tmp_path), params, optimizer.init(params), step=4,
+            metadata={"hidden": 8, "lr": 1e-2, "seed": 0},
+        )
+        os.remove(str(tmp_path / "step_4.meta.json"))  # simulate the crash
+        assert checkpoint.latest_step(str(tmp_path)) == 4
+        assert checkpoint.latest_complete_step(str(tmp_path)) == 2
+        os.remove(str(tmp_path / "step_2.meta.json"))
+        assert checkpoint.latest_complete_step(str(tmp_path)) is None

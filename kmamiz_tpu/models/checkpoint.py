@@ -49,41 +49,40 @@ def save_checkpoint(
     return path
 
 
-def latest_step(directory: str) -> Optional[int]:
+def _step_numbers(directory: str, complete_only: bool) -> list:
+    """Step numbers of checkpoint dirs under directory (meta sidecars and
+    stray files are not checkpoints); complete_only additionally requires
+    the metadata sidecar (its absence marks a crash mid-save)."""
     if not os.path.isdir(directory):
-        return None
+        return []
     steps = []
     for name in os.listdir(directory):
         if not name.startswith("step_"):
             continue
         if not os.path.isdir(os.path.join(directory, name)):
-            continue  # meta sidecars / stray files are not checkpoints
-        try:
-            steps.append(int(name.split("_", 1)[1]))
-        except ValueError:
-            continue
-    return max(steps) if steps else None
-
-
-def latest_complete_step(directory: str) -> Optional[int]:
-    """The newest step whose metadata sidecar exists — a checkpoint dir
-    without its sidecar is an incomplete save (crash mid-write) and is
-    skipped in favor of the previous complete one."""
-    if not os.path.isdir(directory):
-        return None
-    candidates = []
-    for name in os.listdir(directory):
-        if not name.startswith("step_") or not os.path.isdir(
-            os.path.join(directory, name)
-        ):
             continue
         try:
             step = int(name.split("_", 1)[1])
         except ValueError:
             continue
-        if os.path.isfile(os.path.join(directory, f"step_{step}.meta.json")):
-            candidates.append(step)
-    return max(candidates) if candidates else None
+        if complete_only and not os.path.isfile(
+            os.path.join(directory, f"step_{step}.meta.json")
+        ):
+            continue
+        steps.append(step)
+    return steps
+
+
+def latest_step(directory: str) -> Optional[int]:
+    steps = _step_numbers(directory, complete_only=False)
+    return max(steps) if steps else None
+
+
+def latest_complete_step(directory: str) -> Optional[int]:
+    """The newest step whose metadata sidecar exists — an incomplete save
+    (crash mid-write) is skipped in favor of the previous complete one."""
+    steps = _step_numbers(directory, complete_only=True)
+    return max(steps) if steps else None
 
 
 def load_metadata(directory: str, step: Optional[int] = None) -> Optional[dict]:

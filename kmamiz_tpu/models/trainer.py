@@ -25,9 +25,9 @@ import numpy as np
 
 from kmamiz_tpu.models import graphsage
 from kmamiz_tpu.simulator.naming import extract_unique_service_name
+from kmamiz_tpu.simulator.slot_metrics import parse_slot_key
 
 logger = logging.getLogger("kmamiz_tpu.models.trainer")
-from kmamiz_tpu.simulator.slot_metrics import parse_slot_key
 
 ANOMALY_ERROR_SHARE = 0.10  # next-slot 5xx share that counts as anomalous
 SLOT_SECONDS = 3600.0  # simulator slots are hourly

@@ -93,7 +93,7 @@ def service_scores(
     )  # 0 = on/SERVER, 1 = by/CLIENT
     both_mask = jnp.concatenate([mask, mask])
 
-    (s_owner, s_linked, s_dir, s_ml, s_dist), uniq = lex_unique(
+    (s_owner, s_linked, s_dir, _s_ml, s_dist), uniq = lex_unique(
         (owner, linked, ddir, linked_ml, ddist), both_mask
     )
 
@@ -216,7 +216,7 @@ def usage_cohesion(
     d1 = mask & (dist == 1)
     consumer = ep_service[jnp.maximum(src_ep, 0)]
     owner = ep_service[jnp.maximum(dst_ep, 0)]
-    (g_owner, g_consumer, g_ep), pair_first = lex_unique(
+    (g_owner, g_consumer, _g_ep), pair_first = lex_unique(
         (owner, consumer, dst_ep), d1
     )
     row_valid = g_owner != SENTINEL

@@ -107,6 +107,23 @@ def ring_all_reduce(x, axis: str, n: int, op: str = "add"):
     return ring_all_gather(ring_reduce_scatter(x, axis, n, op), axis, n)
 
 
+def hierarchical_all_reduce(
+    x, chip_axis: str, n_chip: int, host_axis: str, op: str = "add"
+):
+    """Bandwidth-optimal multi-host all-reduce: ring reduce-scatter within
+    the host (ICI), ONE cross-host reduction of the 1/n_chip-sized owned
+    chunk (DCN — the slow wire carries only chunk-sized traffic), then
+    ring all-gather back over ICI. The merge shape for meshes whose
+    `host` axis spans DCN (SURVEY.md §5 distributed-communication
+    mapping)."""
+    chunk = ring_reduce_scatter(x, chip_axis, n_chip, op)
+    if op == "max":
+        chunk = jax.lax.pmax(chunk, host_axis)
+    else:
+        chunk = jax.lax.psum(chunk, host_axis)
+    return ring_all_gather(chunk, chip_axis, n_chip)
+
+
 class ShardedWindow(NamedTuple):
     """One window of spans laid out for an n-way mesh.
 
@@ -212,9 +229,14 @@ def sharded_window_stats(
     ppermute ring (reduce-scatter + all-gather) — same result, but the
     merge is expressed as n-1 chunk hops over ICI, the layout ring/Ulysses
     sequence parallelism uses, and the reduce-scatter half can serve
-    segment-sharded consumers without ever replicating.
+    segment-sharded consumers without ever replicating. 'hierarchical'
+    (for a 2-D ('host', axis) mesh, spans sharded over BOTH axes) ring-
+    reduces within each host over ICI and crosses hosts (DCN) with only
+    chunk-sized traffic.
     """
-    spec = P(axis)
+    hierarchical = merge == "hierarchical"
+    host_axis = "host"
+    spec = P((host_axis, axis)) if hierarchical else P(axis)
     n_shards = mesh.shape[axis]
 
     def local_stats(eid, sid, scl, lat, ts, vs):
@@ -231,15 +253,22 @@ def sharded_window_stats(
         ts_max = jax.ops.segment_max(
             jnp.where(vs, ts, 0), seg, num_segments=num_segments + 1
         )[:-1]
-        # merge partials across the mesh — the ICI collective
-        if merge == "ring":
+        # merge partials across the mesh — the ICI (and DCN) collective
+        if merge in ("ring", "hierarchical"):
+            if hierarchical:
+                reduce_fn = partial(
+                    hierarchical_all_reduce,
+                    chip_axis=axis,
+                    n_chip=n_shards,
+                    host_axis=host_axis,
+                )
+            else:
+                reduce_fn = partial(ring_all_reduce, axis=axis, n=n_shards)
             pad = -num_segments % n_shards
             sums = jnp.pad(sums, ((0, pad), (0, 0)))
             ts_max = jnp.pad(ts_max, (0, pad))
-            sums = ring_all_reduce(sums, axis, n_shards)[:num_segments]
-            ts_max = ring_all_reduce(ts_max, axis, n_shards, op="max")[
-                :num_segments
-            ]
+            sums = reduce_fn(sums)[:num_segments]
+            ts_max = reduce_fn(ts_max, op="max")[:num_segments]
         else:
             sums = jax.lax.psum(sums, axis)
             ts_max = jax.lax.pmax(ts_max, axis)
@@ -257,9 +286,9 @@ def sharded_window_stats(
         mesh=mesh,
         in_specs=(spec, spec, spec, spec, spec, spec),
         out_specs=(P(), P(), P(), P(), P(), P()),
-        # the ring's replication arises from n-1 ppermute hops, which the
-        # static varying-axes check cannot prove
-        check_vma=(merge != "ring"),
+        # ring/hierarchical replication arises from ppermute hops, which
+        # the static varying-axes check cannot prove
+        check_vma=(merge == "psum"),
     )(rt_endpoint_id, status_id, status_class, latency_ms, timestamp_rel, valid_server)
 
     safe_count = jnp.maximum(count, 1)

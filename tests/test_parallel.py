@@ -201,3 +201,75 @@ class TestRingCollectives:
             np.testing.assert_allclose(
                 np.asarray(fa), np.asarray(fb), rtol=1e-5, atol=1e-6
             )
+
+
+class TestHierarchicalMerge:
+    """The ICI-within-host / DCN-across-host hierarchical all-reduce must
+    equal a flat psum on a 2x4 ('host', 'spans') mesh."""
+
+    def _mesh2d(self):
+        import jax
+        from jax.sharding import Mesh
+
+        devices = np.asarray(jax.devices()[:8]).reshape(2, 4)
+        return Mesh(devices, ("host", "spans"))
+
+    def test_hierarchical_all_reduce_matches_psum(self):
+        import jax
+        from jax.sharding import PartitionSpec as P
+        from jax import shard_map
+
+        from kmamiz_tpu.parallel import mesh as pmesh
+
+        mesh = self._mesh2d()
+        rng = np.random.default_rng(3)
+        x = rng.normal(size=(8, 64)).astype(np.float32)
+
+        def hier(xs):
+            return pmesh.hierarchical_all_reduce(
+                xs.reshape(-1), "spans", 4, "host"
+            )
+
+        def ref(xs):
+            flat = xs.reshape(-1)
+            return jax.lax.psum(jax.lax.psum(flat, "spans"), "host")
+
+        run = lambda fn: np.asarray(
+            shard_map(
+                fn,
+                mesh=mesh,
+                in_specs=(P(("host", "spans")),),
+                out_specs=P(),
+                check_vma=False,
+            )(jnp.asarray(x.reshape(-1)))
+        )
+        np.testing.assert_allclose(run(hier), run(ref), rtol=1e-5, atol=1e-6)
+
+    def test_sharded_window_stats_hierarchical(self, bookinfo_traces):
+        from kmamiz_tpu.parallel import mesh as pmesh
+
+        mesh2d = self._mesh2d()
+        mesh1d = pmesh.make_mesh(8)
+        window = pmesh.shard_window(bookinfo_traces, 8)
+        vs = window.valid & (window.kind == 1)
+        args = (
+            jnp.asarray(window.rt_endpoint_id),
+            jnp.asarray(window.status_id),
+            jnp.asarray(window.status_class),
+            jnp.asarray(window.latency_ms),
+            jnp.asarray(window.timestamp_rel),
+            jnp.asarray(vs),
+        )
+        ne = len(window.batches[0].interner.endpoints)
+        ns = max(len(window.batches[0].statuses), 1)
+        flat = pmesh.sharded_window_stats(
+            mesh1d, *args, num_endpoints=ne, num_statuses=ns, merge="psum"
+        )
+        hier = pmesh.sharded_window_stats(
+            mesh2d, *args, num_endpoints=ne, num_statuses=ns,
+            merge="hierarchical", axis="spans",
+        )
+        for fa, fb in zip(flat, hier):
+            np.testing.assert_allclose(
+                np.asarray(fa), np.asarray(fb), rtol=1e-5, atol=1e-6
+            )

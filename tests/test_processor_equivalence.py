@@ -1,0 +1,137 @@
+"""Device/host equivalence of the DP pipeline: DataProcessor.collect with
+use_device_stats=True (segment kernels + batched native bodies) must match
+the pure host path (RealtimeDataList -> CombinedRealtimeDataList) on
+randomized windows — counts/timestamps exactly, latency moments to float32
+tolerance. This is the core architectural risk of the hybrid design.
+"""
+from __future__ import annotations
+
+import math
+import random
+
+import pytest
+
+from kmamiz_tpu.server.processor import DataProcessor
+
+METHODS = ["GET", "POST", "DELETE"]
+STATUSES = ["200", "201", "204", "404", "429", "500", "503"]
+BODIES = [
+    None,
+    '{"id":1,"tags":["a","b"]}',
+    '{"name":"x","nested":{"k":1}}',
+    '{"items":[{"v":1},{"v":2}]}',
+    "not json",
+    "",
+]
+
+
+def _random_window(rng: random.Random, n_traces: int):
+    groups = []
+    ts_base = 1_700_000_000_000_000
+    for t in range(n_traces):
+        group = []
+        size = rng.randint(1, 12)
+        for j in range(size):
+            svc = f"svc{rng.randint(0, 4)}"
+            ep = rng.randint(0, 3)
+            body = rng.choice(BODIES)
+            span = {
+                "traceId": f"t{t}",
+                "id": f"{t}-{j}",
+                "parentId": f"{t}-{rng.randint(0, j - 1)}" if j else None,
+                "kind": rng.choice(["SERVER", "CLIENT", "SERVER", None]),
+                "name": f"{svc}.ns.svc.cluster.local:80/*",
+                "timestamp": ts_base + rng.randint(0, 25_000_000),
+                "duration": rng.randint(100, 1_000_000),
+                "tags": {
+                    "http.method": rng.choice(METHODS),
+                    "http.status_code": rng.choice(STATUSES),
+                    "http.url": f"http://{svc}.ns.svc.cluster.local/api/{ep}",
+                    "istio.canonical_revision": "v1",
+                    "istio.canonical_service": svc,
+                    "istio.mesh_id": "cluster.local",
+                    "istio.namespace": "ns",
+                },
+            }
+            if span["kind"] is None:
+                del span["kind"]
+            group.append(span)
+        groups.append(group)
+    return groups
+
+
+def _collect(groups, use_device: bool):
+    dp = DataProcessor(
+        trace_source=lambda lb, t, lim: groups, use_device_stats=use_device
+    )
+    return dp.collect({"uniqueId": "eq", "lookBack": 30_000, "time": 0})
+
+
+def _index(combined):
+    return {
+        (c["uniqueEndpointName"], c["status"]): c for c in combined
+    }
+
+
+class TestDeviceHostEquivalence:
+    @pytest.mark.parametrize("seed", [0, 1, 2, 3])
+    def test_random_windows(self, seed):
+        rng = random.Random(seed)
+        groups = _random_window(rng, n_traces=rng.randint(5, 40))
+        device = _collect(groups, True)
+        host = _collect(groups, False)
+
+        # dependencies and datatypes are host-computed in both modes;
+        # ordering follows each mode's grouping-dict insertion order and is
+        # not contractual (the reference's Rust DP emits HashMap order)
+        assert device["dependencies"] == host["dependencies"]
+
+        def canon_dt(datatypes):
+            out = {}
+            for d in datatypes:
+                key = d["uniqueEndpointName"]
+                out[key] = {
+                    **d,
+                    "schemas": sorted(d["schemas"], key=lambda s: s["status"]),
+                }
+            return out
+
+        assert canon_dt(device["datatype"]) == canon_dt(host["datatype"])
+
+        d_idx, h_idx = _index(device["combined"]), _index(host["combined"])
+        assert set(d_idx) == set(h_idx)
+        for key, h in h_idx.items():
+            d = d_idx[key]
+            assert d["combined"] == h["combined"], key
+            assert d["latestTimestamp"] == h["latestTimestamp"], key
+            assert d["requestBody"] == h["requestBody"], key
+            assert d["requestSchema"] == h["requestSchema"], key
+            assert d["responseBody"] == h["responseBody"], key
+            assert d["responseSchema"] == h["responseSchema"], key
+            assert d["avgReplica"] == h["avgReplica"], key
+            # float32 device moments vs float64 host Welford
+            assert d["latency"]["mean"] == pytest.approx(
+                h["latency"]["mean"], rel=1e-5, abs=1e-6
+            ), key
+            assert d["latency"]["cv"] == pytest.approx(
+                h["latency"]["cv"], rel=1e-3, abs=1e-5
+            ), key
+
+    def test_dedup_and_empty(self):
+        rng = random.Random(9)
+        base = _random_window(rng, 6)
+        dup = base + [base[0]]  # duplicate trace group (same span ids)
+        # the duplicated window must yield the SAME counts as the clean one
+        # in both modes (dedup happens before the paths diverge; comparing
+        # counts — not just key sets — catches a double-count regression)
+        clean = {
+            k: c["combined"] for k, c in _index(_collect(base, True)["combined"]).items()
+        }
+        for use_device in (True, False):
+            got = {
+                k: c["combined"]
+                for k, c in _index(_collect(dup, use_device)["combined"]).items()
+            }
+            assert got == clean, f"use_device={use_device}"
+
+        assert _collect([], True)["combined"] == _collect([], False)["combined"]

@@ -166,10 +166,8 @@ class EndpointGraph:
         # Defer the count sync: dispatch is async, so the tick returns without
         # blocking on the device round trip; the copy streams back in the
         # background and _finalize_pending() resolves it on next access.
-        try:
+        if hasattr(valid_count, "copy_to_host_async"):
             valid_count.copy_to_host_async()
-        except AttributeError:  # older jax.Array without the method
-            pass
         self._pending = (src, dst, dist, valid_count)
 
         # endpoint metadata (host-side, no device sync)

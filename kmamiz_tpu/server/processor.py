@@ -122,11 +122,16 @@ class DataProcessor:
                     pod_logs
                 )
 
+        # dispatch the device stats FIRST: the kernel runs and its packed
+        # result streams back (copy_to_host_async) while the host walks
+        # dependencies and merges bodies, hiding the tunnel round trip
         with step_timer.phase("combine_window"), profiling.trace("combine"):
             realtime = traces.combine_logs_to_realtime_data(
                 structured_logs, replicas
             )
-            combined = self._combine(realtime, trace_groups)
+            stats_job = None
+            if self._use_device_stats and trace_groups and realtime.to_json():
+                stats_job = DeviceStatsJob(realtime.to_json())
 
         with step_timer.phase("dependencies"):
             dependencies = traces.to_endpoint_dependencies()
@@ -143,10 +148,14 @@ class DataProcessor:
                 )
                 self.graph.merge_window(batch)
 
-        datatypes = [
-            d.to_json()
-            for d in combined_list_datatypes(combined)
-        ]
+        with step_timer.phase("combine_assemble"), profiling.trace(
+            "combine_assemble"
+        ):
+            combined = self._combine(realtime, stats_job)
+            datatypes = [
+                d.to_json()
+                for d in combined_list_datatypes(combined)
+            ]
 
         elapsed = self._now_ms() - t_start
         return {
@@ -163,15 +172,15 @@ class DataProcessor:
 
     # -- hybrid combine: device numeric stats + host body merge --------------
 
-    def _combine(self, realtime: RealtimeDataList, trace_groups) -> "CombinedRealtimeDataList":
+    def _combine(
+        self, realtime: RealtimeDataList, stats_job: "Optional[DeviceStatsJob]"
+    ) -> "CombinedRealtimeDataList":
         from kmamiz_tpu.domain.combined import CombinedRealtimeDataList
 
-        if not self._use_device_stats or not trace_groups:
+        if stats_job is None:
             return realtime.to_combined_realtime_data()
 
         records = realtime.to_json()
-        if not records:
-            return CombinedRealtimeDataList([])
 
         # group records by (uniqueEndpointName, status) for body merging and
         # base fields; numeric stats come from the device kernel
@@ -179,7 +188,7 @@ class DataProcessor:
         for r in records:
             groups.setdefault((r["uniqueEndpointName"], r["status"]), []).append(r)
 
-        stats = device_window_stats(records)
+        stats = stats_job.result()
 
         # overlap the device stats round trip conceptually: the body merge +
         # schema inference for ALL groups goes through one batched native
@@ -230,76 +239,84 @@ class DataProcessor:
         return CombinedRealtimeDataList(out)
 
 
-def device_window_stats(records: List[dict]) -> Dict[tuple, dict]:
-    """Run the device segment-stats kernel over realtime records and return
-    per-(endpoint, status) numeric stats keyed for host-side assembly."""
-    from kmamiz_tpu.core.interning import StringInterner
+class DeviceStatsJob:
+    """Asynchronous device segment-stats over realtime records: the
+    constructor dispatches the kernel and starts the packed result
+    streaming back (copy_to_host_async); result() blocks only for
+    whatever hasn't already overlapped with host work."""
 
-    endpoints = StringInterner()
-    statuses = StringInterner()
-    n = len(records)
-    cap = 8
-    while cap < n:
-        cap *= 2
+    def __init__(self, records: List[dict]) -> None:
+        from kmamiz_tpu.core.interning import StringInterner
+        from kmamiz_tpu.ops.pallas_kernels import segment_backend
 
-    eid = np.zeros(cap, dtype=np.int32)
-    sid = np.zeros(cap, dtype=np.int32)
-    scl = np.zeros(cap, dtype=np.int8)
-    lat = np.zeros(cap, dtype=np.float32)
-    ts_abs = np.zeros(n, dtype=np.int64)
-    valid = np.zeros(cap, dtype=bool)
-    for i, r in enumerate(records):
-        eid[i] = endpoints.intern(r["uniqueEndpointName"])
-        sid[i] = statuses.intern(str(r["status"]))
-        s = str(r["status"])
-        scl[i] = int(s[0]) if s[:1].isdigit() else 0
-        lat[i] = r["latency"]
-        ts_abs[i] = r["timestamp"]
-        valid[i] = True
-    ts_base = int(ts_abs.min()) if n else 0
-    ts_rel = np.zeros(cap, dtype=np.int32)
-    ts_rel[:n] = (ts_abs - ts_base).astype(np.int32)
+        endpoints = StringInterner()
+        statuses = StringInterner()
+        n = len(records)
+        cap = 8
+        while cap < n:
+            cap *= 2
 
-    num_endpoints = max(len(endpoints), 1)
-    num_statuses = max(len(statuses), 1)
-    from kmamiz_tpu.ops.pallas_kernels import segment_backend
+        eid = np.zeros(cap, dtype=np.int32)
+        sid = np.zeros(cap, dtype=np.int32)
+        scl = np.zeros(cap, dtype=np.int8)
+        lat = np.zeros(cap, dtype=np.float32)
+        ts_abs = np.zeros(n, dtype=np.int64)
+        valid = np.zeros(cap, dtype=bool)
+        for i, r in enumerate(records):
+            eid[i] = endpoints.intern(r["uniqueEndpointName"])
+            sid[i] = statuses.intern(str(r["status"]))
+            s = str(r["status"])
+            scl[i] = int(s[0]) if s[:1].isdigit() else 0
+            lat[i] = r["latency"]
+            ts_abs[i] = r["timestamp"]
+            valid[i] = True
+        self._ts_base = int(ts_abs.min()) if n else 0
+        ts_rel = np.zeros(cap, dtype=np.int32)
+        ts_rel[:n] = (ts_abs - self._ts_base).astype(np.int32)
 
-    stats = window_ops.window_stats(
-        jnp.asarray(eid),
-        jnp.asarray(sid),
-        jnp.asarray(scl),
-        jnp.asarray(lat.astype(np.float64)),
-        jnp.asarray(ts_rel),
-        jnp.asarray(valid),
-        num_endpoints=num_endpoints,
-        num_statuses=num_statuses,
-        backend=segment_backend(),
-    )
-    # one batched device->host transfer: individual np.asarray calls each
-    # pay a full device-sync round trip (expensive on a tunneled TPU)
-    packed = jax.device_get(
-        _pack_stats(
+        self._endpoints = endpoints
+        self._statuses = statuses
+        self._num_statuses = max(len(statuses), 1)
+
+        stats = window_ops.window_stats(
+            jnp.asarray(eid),
+            jnp.asarray(sid),
+            jnp.asarray(scl),
+            jnp.asarray(lat.astype(np.float64)),
+            jnp.asarray(ts_rel),
+            jnp.asarray(valid),
+            num_endpoints=max(len(endpoints), 1),
+            num_statuses=self._num_statuses,
+            backend=segment_backend(),
+        )
+        # ONE packed buffer: individual np.asarray calls each pay a full
+        # device-sync round trip (expensive on a tunneled TPU)
+        self._packed = _pack_stats(
             stats.count.astype(jnp.float32),
             stats.latency_mean.astype(jnp.float32),
             stats.latency_cv.astype(jnp.float32),
             stats.latest_timestamp_rel,
         )
-    )
-    count, mean, cv = packed[0], packed[1], packed[2]
-    ts = packed[3].view(np.int32).astype(np.int64) + ts_base
+        if hasattr(self._packed, "copy_to_host_async"):
+            self._packed.copy_to_host_async()
 
-    out: Dict[tuple, dict] = {}
-    for e in range(len(endpoints)):
-        for s in range(len(statuses)):
-            seg = e * num_statuses + s
-            if count[seg] > 0:
-                out[(endpoints.lookup(e), statuses.lookup(s))] = {
-                    "count": int(count[seg]),
-                    "mean": float(mean[seg]),
-                    "cv": float(cv[seg]),
-                    "latest_timestamp": int(ts[seg]),
-                }
-    return out
+    def result(self) -> Dict[tuple, dict]:
+        packed = jax.device_get(self._packed)
+        count, mean, cv = packed[0], packed[1], packed[2]
+        ts = packed[3].view(np.int32).astype(np.int64) + self._ts_base
+
+        out: Dict[tuple, dict] = {}
+        for e in range(len(self._endpoints)):
+            for s in range(len(self._statuses)):
+                seg = e * self._num_statuses + s
+                if count[seg] > 0:
+                    out[(self._endpoints.lookup(e), self._statuses.lookup(s))] = {
+                        "count": int(count[seg]),
+                        "mean": float(mean[seg]),
+                        "cv": float(cv[seg]),
+                        "latest_timestamp": int(ts[seg]),
+                    }
+        return out
 
 
 def combined_list_datatypes(combined) -> list:

@@ -188,11 +188,8 @@ class DataProcessor:
         for r in records:
             groups.setdefault((r["uniqueEndpointName"], r["status"]), []).append(r)
 
-        stats = stats_job.result()
-
-        # overlap the device stats round trip conceptually: the body merge +
-        # schema inference for ALL groups goes through one batched native
-        # call (kmamiz_tpu.core.schema.merge_and_infer_bodies)
+        # the batched native body merge runs BEFORE blocking on the device
+        # result, so any residual transfer wait hides behind it
         from kmamiz_tpu.core import schema
 
         group_items = list(groups.items())
@@ -200,9 +197,13 @@ class DataProcessor:
             schema.body_pairs_for_groups([rows for _key, rows in group_items])
         )
 
+        stats = stats_job.result()
         out: List[dict] = []
         for i, ((uen, status), rows) in enumerate(group_items):
-            seg_stats = stats[(uen, status)]
+            # the device job interned str(status); grouping keeps the raw
+            # value (spans without http.status_code carry None) so the
+            # emitted record matches the host path's raw status
+            seg_stats = stats[(uen, str(status))]
             sample = rows[0]
 
             replica = rows[0].get("replica")

@@ -92,6 +92,11 @@ def main() -> None:
     packing_host_ms = _timed(lambda: host_pack(), reps=3) * 1000
     packed, pslot = host_pack()
 
+    from kmamiz_tpu.core.spans import _pad_size as _pow2
+
+    bench_depth = min(
+        window.MAX_DEPTH, _pow2(max(1, packed.max_trace_len - 1), minimum=4)
+    )
     parent_slot2 = jnp.asarray(packed.pack(pslot, -1))
     kind2 = jnp.asarray(packed.pack(kind, 0))
     valid2 = jnp.asarray(packed.pack(np.ones(N_SPANS, bool), False))
@@ -115,8 +120,14 @@ def main() -> None:
                 num_endpoints=N_ENDPOINTS,
                 num_statuses=N_STATUSES,
             )
+            # production merge policy: walk depth capped to the window's
+            # longest chain, pow2-bucketed (graph/store.py merge_window)
             edges = window.dependency_edges_packed(
-                parent_slot2, kind2, valid2, ep2 + (acc > 1e30).astype(jnp.int32)
+                parent_slot2,
+                kind2,
+                valid2,
+                ep2 + (acc > 1e30).astype(jnp.int32),
+                max_depth=bench_depth,
             )
             return acc + digest(tuple(stats)) + digest(tuple(edges))
 

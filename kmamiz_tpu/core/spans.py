@@ -300,13 +300,16 @@ class PackedRows:
     most 2x slot waste); rows are padded to a power of two.
     """
 
-    __slots__ = ("row_of", "slot_of", "n_rows", "n_spans")
+    __slots__ = ("row_of", "slot_of", "n_rows", "n_spans", "max_trace_len")
 
-    def __init__(self, row_of, slot_of, n_rows, n_spans):
+    def __init__(self, row_of, slot_of, n_rows, n_spans, max_trace_len):
         self.row_of = row_of
         self.slot_of = slot_of
         self.n_rows = n_rows
         self.n_spans = n_spans
+        # longest trace in the window: ancestor chains cannot exceed
+        # max_trace_len - 1 hops, so the MXU walk can cap its depth
+        self.max_trace_len = max_trace_len
 
     def pack(self, values: np.ndarray, fill) -> np.ndarray:
         """Scatter a flat per-span array into [n_rows, ROW_SLOTS] layout."""
@@ -370,4 +373,4 @@ def pack_trace_rows(
         has_parent = p >= 0
         if np.any(row_of[p[has_parent]] != row_of[has_parent.nonzero()[0]]):
             return None  # cross-trace parent (span-id collision): bail out
-    return PackedRows(row_of, slot_of, int(n_rows), n_spans)
+    return PackedRows(row_of, slot_of, int(n_rows), n_spans, int(sizes.max()))

@@ -15,6 +15,7 @@ The reference's combineWith overwrites same-window duplicate records
 """
 from __future__ import annotations
 
+from functools import partial
 from typing import Optional
 
 import jax
@@ -64,15 +65,17 @@ def _window_merge(parent_idx, kind, valid, endpoint_id, src, dst, dist, mask):
     return s, d, ds, v, v.sum()
 
 
-@jax.jit
+@partial(jax.jit, static_argnames=("max_depth",))
 def _window_merge_packed(
-    parent_slot, kind, valid, endpoint_id, src, dst, dist, mask
+    parent_slot, kind, valid, endpoint_id, src, dst, dist, mask, max_depth
 ):
     """_window_merge over trace-packed [T, L] rows: the ancestor walk runs
     as batched one-hot einsums on the MXU (dependency_edges_packed), ~10x
-    cheaper than the flat gather walk at 1M spans."""
+    cheaper than the flat gather walk at 1M spans. max_depth is capped to
+    the window's longest possible chain (pow2-bucketed so XLA compiles a
+    bounded number of depths)."""
     edges = window_ops.dependency_edges_packed(
-        parent_slot, kind, valid, endpoint_id
+        parent_slot, kind, valid, endpoint_id, max_depth=max_depth
     )
     s, d, ds, v = _merge_edges(
         src,
@@ -142,6 +145,12 @@ class EndpointGraph:
             batch.trace_of, batch.n_spans, batch.parent_idx
         )
         if packed is not None:
+            # ancestor chains cannot outrun the longest trace; cap the walk
+            # depth (pow2 buckets keep recompilation bounded)
+            depth = min(
+                window_ops.MAX_DEPTH,
+                _pow2(max(1, packed.max_trace_len - 1), minimum=4),
+            )
             src, dst, dist, _valid, valid_count = _window_merge_packed(
                 jnp.asarray(packed.pack(packed.parent_slots(batch.parent_idx), -1)),
                 jnp.asarray(packed.pack(batch.kind, 0)),
@@ -151,6 +160,7 @@ class EndpointGraph:
                 self._dst,
                 self._dist,
                 self._src != SENTINEL,
+                max_depth=depth,
             )
         else:  # overlong trace / cross-trace parent: flat gather fallback
             src, dst, dist, _valid, valid_count = _window_merge(

@@ -1,0 +1,80 @@
+"""Shared training scaffolding for the graph model families.
+
+Every head (GraphSAGE, GAT) predicts (latency [N], anomaly logits [N])
+from (features, src, dst, edge_mask); the loss, optimizer, and jitted
+train step are identical and live here so the families cannot drift.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def make_loss_fn(forward):
+    """Masked MSE (latency) + masked sigmoid BCE (anomaly) over a head's
+    forward function."""
+
+    def loss_fn(
+        params,
+        features,
+        src_ep,
+        dst_ep,
+        edge_mask,
+        target_latency,
+        target_anomaly,
+        node_mask,
+    ):
+        pred_latency, anomaly_logit = forward(
+            params, features, src_ep, dst_ep, edge_mask
+        )
+        w = node_mask.astype(jnp.float32)
+        denom = jnp.maximum(w.sum(), 1.0)
+        latency_loss = jnp.sum(w * (pred_latency - target_latency) ** 2) / denom
+        anomaly_loss = (
+            jnp.sum(
+                w
+                * optax.sigmoid_binary_cross_entropy(anomaly_logit, target_anomaly)
+            )
+            / denom
+        )
+        return latency_loss + anomaly_loss, (latency_loss, anomaly_loss)
+
+    return loss_fn
+
+
+def make_optimizer(lr: float = 1e-3):
+    return optax.adamw(lr, weight_decay=1e-4)
+
+
+def make_train_step(optimizer, loss_fn):
+    """Jitted (params, opt_state, batch...) -> (params, opt_state, loss, aux)."""
+
+    @jax.jit
+    def train_step(
+        params,
+        opt_state,
+        features,
+        src_ep,
+        dst_ep,
+        edge_mask,
+        target_latency,
+        target_anomaly,
+        node_mask,
+    ):
+        grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+        (loss, aux), grads = grad_fn(
+            params,
+            features,
+            src_ep,
+            dst_ep,
+            edge_mask,
+            target_latency,
+            target_anomaly,
+            node_mask,
+        )
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss, aux
+
+    return train_step

@@ -19,7 +19,6 @@ from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
-import optax
 
 NUM_FEATURES = 8
 
@@ -102,64 +101,16 @@ def forward(
     return latency, anomaly_logit
 
 
-def loss_fn(
-    params: SageParams,
-    features: jnp.ndarray,
-    src_ep: jnp.ndarray,
-    dst_ep: jnp.ndarray,
-    edge_mask: jnp.ndarray,
-    target_latency: jnp.ndarray,  # [N]
-    target_anomaly: jnp.ndarray,  # [N] in {0,1}
-    node_mask: jnp.ndarray,  # [N] valid endpoints
-):
-    pred_latency, anomaly_logit = forward(
-        params, features, src_ep, dst_ep, edge_mask
-    )
-    w = node_mask.astype(jnp.float32)
-    denom = jnp.maximum(w.sum(), 1.0)
-    latency_loss = jnp.sum(w * (pred_latency - target_latency) ** 2) / denom
-    anomaly_loss = (
-        jnp.sum(w * optax.sigmoid_binary_cross_entropy(anomaly_logit, target_anomaly))
-        / denom
-    )
-    return latency_loss + anomaly_loss, (latency_loss, anomaly_loss)
+# loss / optimizer / train step are the family-shared scaffolding
+from kmamiz_tpu.models import common as _common  # noqa: E402
 
-
-def make_optimizer(lr: float = 1e-3):
-    return optax.adamw(lr, weight_decay=1e-4)
+loss_fn = _common.make_loss_fn(forward)
+make_optimizer = _common.make_optimizer
 
 
 def make_train_step(optimizer):
     """Jitted (params, opt_state, batch...) -> (params, opt_state, loss, aux)."""
-
-    @jax.jit
-    def train_step(
-        params: SageParams,
-        opt_state,
-        features,
-        src_ep,
-        dst_ep,
-        edge_mask,
-        target_latency,
-        target_anomaly,
-        node_mask,
-    ):
-        grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
-        (loss, aux), grads = grad_fn(
-            params,
-            features,
-            src_ep,
-            dst_ep,
-            edge_mask,
-            target_latency,
-            target_anomaly,
-            node_mask,
-        )
-        updates, opt_state = optimizer.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        return params, opt_state, loss, aux
-
-    return train_step
+    return _common.make_train_step(optimizer, loss_fn)
 
 
 def features_from_stats(

@@ -35,7 +35,9 @@ class TestJobLifecycle:
         fired = []
         job = Job("t", 0.02, lambda: fired.append(time.monotonic()))
         job.start()
-        time.sleep(0.15)
+        deadline = time.monotonic() + 10
+        while len(fired) < 3 and time.monotonic() < deadline:
+            time.sleep(0.01)
         job.stop()
         job._thread.join(timeout=2)  # an in-flight tick may still finish
         count = len(fired)
@@ -52,7 +54,9 @@ class TestJobLifecycle:
 
         job = Job("flaky", 0.02, flaky)
         job.start()
-        time.sleep(0.12)
+        deadline = time.monotonic() + 10
+        while len(calls) < 3 and time.monotonic() < deadline:
+            time.sleep(0.01)
         job.stop()
         assert len(calls) >= 3  # kept ticking through exceptions
 
@@ -61,9 +65,13 @@ class TestJobLifecycle:
         first, second = [], []
         sched.register("tick", 0.02, lambda: first.append(1))
         sched.start()
-        time.sleep(0.08)
+        deadline = time.monotonic() + 10
+        while not first and time.monotonic() < deadline:
+            time.sleep(0.01)
         sched.register("tick", 0.02, lambda: second.append(1))
-        time.sleep(0.1)
+        deadline = time.monotonic() + 10  # fresh budget for the second wait
+        while not second and time.monotonic() < deadline:
+            time.sleep(0.01)
         sched.stop()
         n_first = len(first)
         time.sleep(0.06)

@@ -272,6 +272,9 @@ def sharded_window_stats(
         else:
             sums = jax.lax.psum(sums, axis)
             ts_max = jax.lax.pmax(ts_max, axis)
+        # empty segments carry segment_max's int32-min identity: report 0,
+        # matching the single-device window_stats
+        ts_max = jnp.where(sums[:, 0] > 0, ts_max, 0)
         return (
             sums[:, 0],
             sums[:, 1],

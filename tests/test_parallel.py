@@ -273,3 +273,92 @@ class TestHierarchicalMerge:
             np.testing.assert_allclose(
                 np.asarray(fa), np.asarray(fb), rtol=1e-5, atol=1e-6
             )
+
+
+class TestShardedEquivalenceFuzz:
+    """Randomized windows: the sharded pipeline (any merge mode) must
+    reproduce the single-device window_stats on the same spans."""
+
+    @pytest.mark.parametrize("merge", ["psum", "ring"])
+    def test_random_windows(self, merge):
+        import random
+
+        # str seeding is deterministic (unlike salted hash()), so failures
+        # reproduce across interpreter runs
+        rng = random.Random(merge)
+        ts_base = 1_700_000_000_000_000
+        groups = []
+        for t in range(rng.randint(12, 30)):
+            size = rng.randint(1, 9)
+            group = []
+            for j in range(size):
+                svc = f"svc{rng.randint(0, 3)}"
+                group.append(
+                    {
+                        "traceId": f"t{t}",
+                        "id": f"{t}-{j}",
+                        "parentId": f"{t}-{j-1}" if j else None,
+                        "kind": rng.choice(["SERVER", "CLIENT"]),
+                        "name": f"{svc}.ns.svc.cluster.local:80/*",
+                        "timestamp": ts_base + rng.randint(0, 20_000_000),
+                        "duration": rng.randint(100, 900_000),
+                        "tags": {
+                            "http.method": "GET",
+                            "http.status_code": rng.choice(["200", "404", "500"]),
+                            "http.url": f"http://{svc}.ns.svc.cluster.local/a",
+                            "istio.canonical_revision": "v1",
+                            "istio.canonical_service": svc,
+                            "istio.mesh_id": "c",
+                            "istio.namespace": "ns",
+                        },
+                    }
+                )
+            groups.append(group)
+
+        mesh = pmesh.make_mesh(8)
+        w = pmesh.shard_window(groups, 8)
+        vs = w.valid & (w.kind == 1)
+        ne = len(w.batches[0].interner.endpoints)
+        ns = max(len(w.batches[0].statuses), 1)
+        sharded = pmesh.sharded_window_stats(
+            mesh,
+            jnp.asarray(w.rt_endpoint_id),
+            jnp.asarray(w.status_id),
+            jnp.asarray(w.status_class),
+            jnp.asarray(w.latency_ms),
+            jnp.asarray(w.timestamp_rel),
+            jnp.asarray(vs),
+            num_endpoints=ne,
+            num_statuses=ns,
+            merge=merge,
+        )
+        flat = window.window_stats(
+            jnp.asarray(w.rt_endpoint_id),
+            jnp.asarray(w.status_id),
+            jnp.asarray(w.status_class),
+            jnp.asarray(w.latency_ms.astype(np.float64)),
+            jnp.asarray(w.timestamp_rel),
+            jnp.asarray(vs),
+            num_endpoints=ne,
+            num_statuses=ns,
+        )
+        # the guard under test must actually be exercised: random data over
+        # 4 services x 3 statuses always leaves some (endpoint,status)
+        # combination empty
+        assert bool((np.asarray(flat.count) == 0).any())
+        np.testing.assert_array_equal(
+            np.asarray(sharded.count), np.asarray(flat.count)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(sharded.error_5xx), np.asarray(flat.error_5xx)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(sharded.latest_timestamp_rel),
+            np.asarray(flat.latest_timestamp_rel),
+        )
+        np.testing.assert_allclose(
+            np.asarray(sharded.latency_mean),
+            np.asarray(flat.latency_mean),
+            rtol=1e-4,
+            atol=1e-5,
+        )

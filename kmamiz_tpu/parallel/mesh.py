@@ -244,51 +244,72 @@ def sharded_window_stats(
         seg = eid * num_statuses + sid
         seg = jnp.where(vs, seg, num_segments)
         w = vs.astype(lat.dtype)
+
+        if hierarchical:
+            reduce_fn = partial(
+                hierarchical_all_reduce,
+                chip_axis=axis,
+                n_chip=n_shards,
+                host_axis=host_axis,
+            )
+        elif merge == "ring":
+            reduce_fn = partial(ring_all_reduce, axis=axis, n=n_shards)
+        else:
+            reduce_fn = None
+        pad = -num_segments % n_shards
+
+        def merged(x, op="add"):
+            if reduce_fn is None:
+                return jax.lax.pmax(x, axis) if op == "max" else jax.lax.psum(x, axis)
+            padding = ((0, pad),) + ((0, 0),) * (x.ndim - 1)
+            return reduce_fn(jnp.pad(x, padding), op=op)[:num_segments]
+
         # one vector-valued scatter for the five sums (see window_stats)
         data = jnp.stack(
             [w, w * (scl == 4), w * (scl == 5), lat * w, lat * lat * w],
             axis=1,
         )
-        sums = jax.ops.segment_sum(data, seg, num_segments=num_segments + 1)[:-1]
-        ts_max = jax.ops.segment_max(
-            jnp.where(vs, ts, 0), seg, num_segments=num_segments + 1
-        )[:-1]
-        # merge partials across the mesh — the ICI (and DCN) collective
-        if merge in ("ring", "hierarchical"):
-            if hierarchical:
-                reduce_fn = partial(
-                    hierarchical_all_reduce,
-                    chip_axis=axis,
-                    n_chip=n_shards,
-                    host_axis=host_axis,
-                )
-            else:
-                reduce_fn = partial(ring_all_reduce, axis=axis, n=n_shards)
-            pad = -num_segments % n_shards
-            sums = jnp.pad(sums, ((0, pad), (0, 0)))
-            ts_max = jnp.pad(ts_max, (0, pad))
-            sums = reduce_fn(sums)[:num_segments]
-            ts_max = reduce_fn(ts_max, op="max")[:num_segments]
-        else:
-            sums = jax.lax.psum(sums, axis)
-            ts_max = jax.lax.pmax(ts_max, axis)
+        sums = merged(
+            jax.ops.segment_sum(data, seg, num_segments=num_segments + 1)[:-1]
+        )
+        ts_max = merged(
+            jax.ops.segment_max(
+                jnp.where(vs, ts, 0), seg, num_segments=num_segments + 1
+            )[:-1],
+            op="max",
+        )
         # empty segments carry segment_max's int32-min identity: report 0,
         # matching the single-device window_stats
         ts_max = jnp.where(sums[:, 0] > 0, ts_max, 0)
+
+        # two-pass variance, like the single-device path: the naive
+        # E[x^2]-E[x]^2 form cancels catastrophically in float32. The
+        # merged mean is replicated after the first collective, so each
+        # shard scatters its local squared residuals and ONE more merge
+        # yields the exact pooled residual sum.
+        count = sums[:, 0]
+        mean = sums[:, 3] / jnp.maximum(count, 1)
+        resid = (lat - mean[jnp.minimum(seg, num_segments - 1)]) * w
+        resid_sq = merged(
+            jax.ops.segment_sum(
+                resid * resid, seg, num_segments=num_segments + 1
+            )[:-1]
+        )
         return (
-            sums[:, 0],
+            count,
             sums[:, 1],
             sums[:, 2],
             sums[:, 3],
             sums[:, 4],
+            resid_sq,
             ts_max,
         )
 
-    count, e4, e5, lat_sum, lat_sq, ts_max = shard_map(
+    count, e4, e5, lat_sum, lat_sq, resid_sq, ts_max = shard_map(
         local_stats,
         mesh=mesh,
         in_specs=(spec, spec, spec, spec, spec, spec),
-        out_specs=(P(), P(), P(), P(), P(), P()),
+        out_specs=(P(), P(), P(), P(), P(), P(), P()),
         # ring/hierarchical replication arises from ppermute hops, which
         # the static varying-axes check cannot prove
         check_vma=(merge == "psum"),
@@ -296,7 +317,7 @@ def sharded_window_stats(
 
     safe_count = jnp.maximum(count, 1)
     mean = lat_sum / safe_count
-    variance = jnp.maximum(lat_sq / safe_count - mean * mean, 0.0)
+    variance = jnp.maximum(resid_sq / safe_count, 0.0)
     cv = jnp.where(
         mean != 0, jnp.sqrt(variance) / jnp.maximum(mean, 1e-30), 0.0
     )

@@ -177,3 +177,63 @@ class TestApplicationLifecycle:
         assert {n["id"] for n in after["nodes"]} == {
             n["id"] for n in before["nodes"]
         }
+
+
+class TestExternalDataProcessorTopology:
+    """The reference's deployment topology, live: the app's realtime tick
+    POSTs the DP protocol to an external (TPU) DP server over HTTP, and
+    when that server dies, the tick falls back to the in-process path
+    (ServiceOperator.ts:300-306 semantics)."""
+
+    def test_external_then_fallback(self, pdas_traces, bookinfo_traces):
+        from test_orchestration import FIXTURE_NOW_MS
+
+        from kmamiz_tpu.server.dp_server import DataProcessorServer
+        from kmamiz_tpu.server.initializer import AppContext, Initializer
+        from kmamiz_tpu.server.processor import DataProcessor
+
+        # the EXTERNAL DP serves bookinfo; the IN-PROCESS fallback serves
+        # pdas — whichever path ran is visible in the cached endpoints
+        external_dp = DataProcessor(
+            trace_source=lambda lb, t, lim: bookinfo_traces
+        )
+        dp_server = DataProcessorServer(external_dp, host="127.0.0.1", port=0)
+        dp_server.start()
+        self._run(dp_server, pdas_traces)
+
+    def _run(self, dp_server, pdas_traces):
+        try:
+            self._drive(dp_server, pdas_traces)
+        finally:
+            dp_server.stop()  # idempotent; no leaked server on failure
+
+    def _drive(self, dp_server, pdas_traces):
+        from test_orchestration import FIXTURE_NOW_MS
+
+        from kmamiz_tpu.server.initializer import AppContext, Initializer
+        from kmamiz_tpu.server.processor import DataProcessor
+
+        settings = Settings()
+        settings.external_data_processor = f"http://127.0.0.1:{dp_server.port}/"
+        fallback_dp = DataProcessor(trace_source=lambda lb, t, lim: [pdas_traces])
+        ctx = AppContext.build(
+            app_settings=settings, store=MemoryStore(), processor=fallback_dp
+        )
+        ctx.service_utils._now_ms = lambda: FIXTURE_NOW_MS
+        Initializer(ctx).register_data_caches()
+
+        # tick 1: external DP answers -> bookinfo endpoints land in caches
+        ctx.operator.retrieve_realtime_data()
+        deps = ctx.cache.get("EndpointDependencies").get_data().to_json()
+        services = {d["endpoint"]["service"] for d in deps}
+        assert "productpage" in services  # bookinfo via the external DP
+        assert not any("pdas" == d["endpoint"]["namespace"] for d in deps)
+
+        # kill the external DP: the next tick must fall back in-process
+        dp_server.stop()
+        ctx.operator.retrieve_realtime_data()
+        deps = ctx.cache.get("EndpointDependencies").get_data().to_json()
+        namespaces = {d["endpoint"]["namespace"] for d in deps}
+        assert "pdas" in namespaces  # fallback path contributed
+        services = {d["endpoint"]["service"] for d in deps}
+        assert "productpage" in services  # external results were kept

@@ -301,7 +301,13 @@ class TestShardedEquivalenceFuzz:
                         "kind": rng.choice(["SERVER", "CLIENT"]),
                         "name": f"{svc}.ns.svc.cluster.local:80/*",
                         "timestamp": ts_base + rng.randint(0, 20_000_000),
-                        "duration": rng.randint(100, 900_000),
+                        # includes a high-magnitude low-spread regime where
+                        # the naive E[x^2]-E[x]^2 variance collapses in f32
+                        "duration": (
+                            800_000_000 + rng.randint(0, 200_000)
+                            if rng.random() < 0.3
+                            else rng.randint(100, 900_000)
+                        ),
                         "tags": {
                             "http.method": "GET",
                             "http.status_code": rng.choice(["200", "404", "500"]),
@@ -314,6 +320,30 @@ class TestShardedEquivalenceFuzz:
                     }
                 )
             groups.append(group)
+        # deterministic empty segments: svc9's endpoint only ever reports
+        # 200, so its (endpoint, 404/500) segments are guaranteed empty
+        groups.append(
+            [
+                {
+                    "traceId": "t-only200",
+                    "id": "only200-0",
+                    "parentId": None,
+                    "kind": "SERVER",
+                    "name": "svc9.ns.svc.cluster.local:80/*",
+                    "timestamp": ts_base + 1000,
+                    "duration": 5000,
+                    "tags": {
+                        "http.method": "GET",
+                        "http.status_code": "200",
+                        "http.url": "http://svc9.ns.svc.cluster.local/a",
+                        "istio.canonical_revision": "v1",
+                        "istio.canonical_service": "svc9",
+                        "istio.mesh_id": "c",
+                        "istio.namespace": "ns",
+                    },
+                }
+            ]
+        )
 
         mesh = pmesh.make_mesh(8)
         w = pmesh.shard_window(groups, 8)
@@ -360,5 +390,13 @@ class TestShardedEquivalenceFuzz:
             np.asarray(sharded.latency_mean),
             np.asarray(flat.latency_mean),
             rtol=1e-4,
+            atol=1e-5,
+        )
+        # CV must hold up too: the sharded path uses the same two-pass
+        # residual variance as the single-device kernel
+        np.testing.assert_allclose(
+            np.asarray(sharded.latency_cv),
+            np.asarray(flat.latency_cv),
+            rtol=1e-3,
             atol=1e-5,
         )

@@ -10,22 +10,28 @@ never leave the host.
 """
 from __future__ import annotations
 
-from typing import Dict, Iterable, List, Optional
+from typing import Dict, Hashable, Iterable, List, Optional
 
 
 class StringInterner:
-    """Bidirectional string<->int32 table with insertion-order ids."""
+    """Bidirectional key<->int32 table with insertion-order ids.
+
+    Keys are usually strings (the reference's tab-joined names), but any
+    hashable value is a valid key: the DP status interner keys segments by
+    the RAW http.status_code value (str, int, or None for spans without the
+    tag) so that device segments align with the host's raw-status groupby.
+    """
 
     __slots__ = ("_to_id", "_strings")
 
-    def __init__(self, strings: Optional[Iterable[str]] = None) -> None:
-        self._to_id: Dict[str, int] = {}
-        self._strings: List[str] = []
+    def __init__(self, strings: Optional[Iterable[Hashable]] = None) -> None:
+        self._to_id: Dict[Hashable, int] = {}
+        self._strings: List[Hashable] = []
         if strings:
             for s in strings:
                 self.intern(s)
 
-    def intern(self, s: str) -> int:
+    def intern(self, s: Hashable) -> int:
         i = self._to_id.get(s)
         if i is None:
             i = len(self._strings)
@@ -33,20 +39,20 @@ class StringInterner:
             self._strings.append(s)
         return i
 
-    def get(self, s: str) -> Optional[int]:
+    def get(self, s: Hashable) -> Optional[int]:
         return self._to_id.get(s)
 
-    def lookup(self, i: int) -> str:
+    def lookup(self, i: int) -> Hashable:
         return self._strings[i]
 
     def __len__(self) -> int:
         return len(self._strings)
 
-    def __contains__(self, s: str) -> bool:
+    def __contains__(self, s: Hashable) -> bool:
         return s in self._to_id
 
     @property
-    def strings(self) -> List[str]:
+    def strings(self) -> List[Hashable]:
         return self._strings
 
 

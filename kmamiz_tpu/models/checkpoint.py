@@ -86,11 +86,12 @@ def latest_complete_step(directory: str) -> Optional[int]:
 
 
 def load_metadata(directory: str, step: Optional[int] = None) -> Optional[dict]:
-    """The metadata sidecar of directory/step_<N> (latest when step is
-    None); None when no checkpoint or no sidecar exists. Lets callers
-    validate hyperparameters BEFORE paying the restore."""
+    """The metadata sidecar of directory/step_<N> (latest COMPLETE step
+    when step is None — an incomplete save has no sidecar by definition);
+    None when no checkpoint or no sidecar exists. Lets callers validate
+    hyperparameters BEFORE paying the restore."""
     if step is None:
-        step = latest_step(directory)
+        step = latest_complete_step(directory)
         if step is None:
             return None
     meta_path = os.path.join(directory, f"step_{step}.meta.json")
@@ -106,15 +107,19 @@ def restore_checkpoint(
     opt_state_template: Any,
     step: Optional[int] = None,
 ) -> Optional[Tuple[SageParams, Any, dict]]:
-    """Restore (params, opt_state, meta) from directory/step_<N> (latest
-    when step is None); None when no checkpoint exists.
+    """Restore (params, opt_state, meta) from directory/step_<N>; None when
+    no checkpoint exists. When step is None the default is the latest
+    COMPLETE step (orbax dir + metadata sidecar) — a crash mid-save leaves
+    the dir without its sidecar, and the incomplete-save convention is to
+    fall back to the previous complete checkpoint, not raise. Pass an
+    explicit step to target an incomplete save anyway.
 
     The templates (e.g. graphsage.init_params(...) and optimizer.init of
     them) carry the pytree STRUCTURE — orbax restores leaves into it, so
     optax's NamedTuple states come back intact. Template shapes must match
     the checkpoint (same hidden size); train() validates via metadata."""
     if step is None:
-        step = latest_step(directory)
+        step = latest_complete_step(directory)
         if step is None:
             return None
     path = os.path.abspath(os.path.join(directory, f"step_{step}"))

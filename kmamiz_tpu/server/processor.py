@@ -129,9 +129,10 @@ class DataProcessor:
             realtime = traces.combine_logs_to_realtime_data(
                 structured_logs, replicas
             )
+            records = realtime.to_json()
             stats_job = None
-            if self._use_device_stats and trace_groups and realtime.to_json():
-                stats_job = DeviceStatsJob(realtime.to_json())
+            if self._use_device_stats and trace_groups and records:
+                stats_job = DeviceStatsJob(records)
 
         with step_timer.phase("dependencies"):
             dependencies = traces.to_endpoint_dependencies()
@@ -180,10 +181,11 @@ class DataProcessor:
         if stats_job is None:
             return realtime.to_combined_realtime_data()
 
-        records = realtime.to_json()
+        records = realtime.to_json()  # free accessor, not a materialization
 
-        # group records by (uniqueEndpointName, status) for body merging and
-        # base fields; numeric stats come from the device kernel
+        # group records by (uniqueEndpointName, raw status) for body merging
+        # and base fields; numeric stats come from the device kernel, whose
+        # interner also keys segments by the raw status value
         groups: Dict[tuple, List[dict]] = {}
         for r in records:
             groups.setdefault((r["uniqueEndpointName"], r["status"]), []).append(r)
@@ -200,10 +202,10 @@ class DataProcessor:
         stats = stats_job.result()
         out: List[dict] = []
         for i, ((uen, status), rows) in enumerate(group_items):
-            # the device job interned str(status); grouping keeps the raw
-            # value (spans without http.status_code carry None) so the
-            # emitted record matches the host path's raw status
-            seg_stats = stats[(uen, str(status))]
+            # both sides key segments by the RAW status value (spans without
+            # http.status_code carry None), so two statuses that stringify
+            # identically (None vs "None") stay distinct on host and device
+            seg_stats = stats[(uen, status)]
             sample = rows[0]
 
             replica = rows[0].get("replica")
@@ -263,9 +265,12 @@ class DeviceStatsJob:
         lat = np.zeros(cap, dtype=np.float32)
         ts_abs = np.zeros(n, dtype=np.int64)
         valid = np.zeros(cap, dtype=bool)
+        # intern the RAW status value (None, int, or str are all hashable);
+        # the status class still derives from its string form. Interning raw
+        # keeps device segments aligned with the host's raw-status groupby.
         for i, r in enumerate(records):
             eid[i] = endpoints.intern(r["uniqueEndpointName"])
-            sid[i] = statuses.intern(str(r["status"]))
+            sid[i] = statuses.intern(r["status"])
             s = str(r["status"])
             scl[i] = int(s[0]) if s[:1].isdigit() else 0
             lat[i] = r["latency"]

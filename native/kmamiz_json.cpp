@@ -86,10 +86,47 @@ bool js_truthy(const JValue& v) {
       double d = 0.0;
       auto res = std::from_chars(first, last, d, std::chars_format::general);
       if (res.ec == std::errc::result_out_of_range) {
-        // overflow (huge -> inf, truthy) vs underflow (tiny -> 0, falsy):
-        // decide by the exponent's sign, like float() would round
-        size_t e = v.text.find_first_of("eE");
-        return !(e != std::string::npos && v.text.find('-', e) != std::string::npos);
+        // overflow (huge -> inf, truthy) vs underflow (tiny -> 0, falsy),
+        // matching Python float(): decide by the token's EFFECTIVE decimal
+        // exponent — the position of its first significant digit plus the
+        // explicit exponent. from_chars only reports out-of-range beyond
+        // ~1e±308, so the effective exponent's sign tells which side the
+        // value fell off (a huge mantissa with a small negative exponent
+        // is still overflow; a tiny fraction with a small positive
+        // exponent is still underflow).
+        std::string_view t(first, static_cast<size_t>(last - first));
+        size_t epos = t.find_first_of("eE");
+        std::string_view mant =
+            epos == std::string_view::npos ? t : t.substr(0, epos);
+        long long exp10 = 0;
+        if (epos != std::string_view::npos) {
+          const char* ef = t.data() + epos + 1;
+          const char* el = t.data() + t.size();
+          bool neg = ef < el && *ef == '-';
+          if (ef < el && (*ef == '+' || *ef == '-')) ++ef;
+          auto eres = std::from_chars(ef, el, exp10);
+          if (neg && eres.ec == std::errc()) exp10 = -exp10;
+          if (eres.ec == std::errc::result_out_of_range)
+            exp10 = neg ? -(1LL << 62) : (1LL << 62);  // sign-clamped
+        }
+        size_t dot = mant.find('.');
+        std::string_view ip =
+            dot == std::string_view::npos ? mant : mant.substr(0, dot);
+        size_t i = 0;
+        while (i < ip.size() && ip[i] == '0') ++i;
+        long long eff;
+        if (i < ip.size()) {
+          eff = static_cast<long long>(ip.size() - i) - 1;
+        } else if (dot != std::string_view::npos) {
+          std::string_view fp = mant.substr(dot + 1);
+          size_t j = 0;
+          while (j < fp.size() && fp[j] == '0') ++j;
+          if (j == fp.size()) return false;  // 0.0e<huge>: exactly zero
+          eff = -static_cast<long long>(j + 1);
+        } else {
+          return false;  // 0e<huge>: exactly zero
+        }
+        return eff + exp10 > 0;
       }
       if (res.ec != std::errc()) return true;  // unreachable for valid tokens
       return d != 0.0 && !std::isnan(d);

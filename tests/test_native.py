@@ -215,6 +215,29 @@ class TestBodyGroupParity:
                 (["[1,2]", "0"], True),
                 # duplicate keys: first position, last value
                 (['{"a":1,"b":2,"a":3}', '{"b":9}'], True),
+                # out-of-range literals decide `a or b` via truthiness: a
+                # plain-decimal underflow is 0.0 (falsy, ADVICE r1) and a
+                # plain-integer overflow is a Python bigint (truthy). The
+                # out-of-range FLOAT token is never the chosen winner here —
+                # the writer echoes number tokens verbatim (re-parse-equal,
+                # not string-equal, to Python's "Infinity").
+                (["0." + "0" * 330 + "1", "0"], True),
+                (["-0." + "0" * 330 + "1", "0"], True),
+                (["0." + "0" * 330 + "1", '"x"'], True),
+                (["9" * 400, "0"], True),
+                (["-" + "9" * 400, "0"], True),
+                (["1e-400", "0"], True),
+                (["1.5E-400", '"x"'], True),
+                # exponent sign DISAGREES with the overflow direction: a
+                # huge mantissa with a small negative exponent still
+                # overflows (truthy -> merges with the string into a char
+                # map), a tiny fraction with a small positive exponent
+                # still underflows (falsy -> b wins)
+                (["1" + "0" * 400 + "e-5", '"x"'], True),
+                (["0." + "0" * 350 + "1e5", "0"], True),
+                (["0." + "0" * 350 + "1E+5", '"x"'], True),
+                (["0e999999999999999999999", '"x"'], True),
+                (["0.000e-999999999999999999999", "0"], True),
             ]
         )
 

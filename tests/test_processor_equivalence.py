@@ -119,6 +119,54 @@ class TestDeviceHostEquivalence:
                 h["latency"]["cv"], rel=1e-3, abs=1e-5
             ), key
 
+    def test_status_stringify_collision(self):
+        """Two raw statuses that stringify identically (missing tag -> None
+        vs the literal string "None") must stay DISTINCT (endpoint, status)
+        records on both paths — the device interner keys segments by the raw
+        value, matching the host groupby (ADVICE r1: previously both groups
+        read one merged device segment)."""
+        rng = random.Random(5)
+        groups = _random_window(rng, 2)
+        ts = 1_700_000_000_000_000
+        collide = []
+        for j, status in enumerate([None, "None", None, "None", "None"]):
+            tags = {
+                "http.method": "GET",
+                "http.url": "http://svc0.ns.svc.cluster.local/api/0",
+                "istio.canonical_revision": "v1",
+                "istio.canonical_service": "svc0",
+                "istio.mesh_id": "cluster.local",
+                "istio.namespace": "ns",
+            }
+            if status is not None:
+                tags["http.status_code"] = status
+            collide.append(
+                {
+                    "traceId": "collide",
+                    "id": f"c-{j}",
+                    "parentId": None,
+                    "kind": "SERVER",
+                    "name": "svc0.ns.svc.cluster.local:80/*",
+                    "timestamp": ts + j * 1_000,
+                    "duration": 1_000 * (j + 1),
+                    "tags": tags,
+                }
+            )
+        groups.append(collide)
+
+        device = _collect(groups, True)
+        host = _collect(groups, False)
+        d_idx, h_idx = _index(device["combined"]), _index(host["combined"])
+        assert set(d_idx) == set(h_idx)
+        ep = "svc0\tns\tv1\tGET\thttp://svc0.ns.svc.cluster.local/api/0"
+        assert (ep, None) in d_idx and (ep, "None") in d_idx
+        assert d_idx[(ep, None)]["combined"] == h_idx[(ep, None)]["combined"] == 2
+        assert d_idx[(ep, "None")]["combined"] == h_idx[(ep, "None")]["combined"] == 3
+        for key in ((ep, None), (ep, "None")):
+            assert d_idx[key]["latency"]["mean"] == pytest.approx(
+                h_idx[key]["latency"]["mean"], rel=1e-5
+            )
+
     def test_dedup_and_empty(self):
         rng = random.Random(9)
         base = _random_window(rng, 6)

@@ -39,7 +39,7 @@ from kmamiz_tpu.server.cacheables import (
 )
 from kmamiz_tpu.server.dispatch import DispatchStorage
 from kmamiz_tpu.server.operator import ServiceOperator
-from kmamiz_tpu.server.scheduler import Scheduler, interval_from_cron
+from kmamiz_tpu.server.scheduler import Scheduler
 from kmamiz_tpu.server.service_utils import ServiceUtils
 from kmamiz_tpu.server.storage import Store, store_from_uri
 
@@ -94,7 +94,7 @@ class AppContext:
             service_utils=service_utils,
             operator=operator,
             dispatch=DispatchStorage(cache),
-            scheduler=Scheduler(),
+            scheduler=Scheduler(tz=s.timezone),
             zipkin_client=zipkin_client,
             k8s_client=k8s_client,
             processor=processor,
@@ -157,19 +157,22 @@ class Initializer:
             return
 
         logger.info("Setting up scheduled tasks.")
+        # pass the raw expressions through: the scheduler maps the three
+        # reference defaults to their documented cadences and evaluates any
+        # other user-configured expression as true cron in the configured tz
         ctx.scheduler.register(
             "aggregation",
-            interval_from_cron(ctx.settings.aggregate_interval),
+            ctx.settings.aggregate_interval,
             ctx.operator.create_historical_and_aggregated_data,
         )
         ctx.scheduler.register(
             "realtime",
-            interval_from_cron(ctx.settings.realtime_interval),
+            ctx.settings.realtime_interval,
             ctx.operator.retrieve_realtime_data,
         )
         ctx.scheduler.register(
             "dispatch",
-            interval_from_cron(ctx.settings.dispatch_interval),
+            ctx.settings.dispatch_interval,
             ctx.dispatch.sync,
         )
         ctx.scheduler.start()

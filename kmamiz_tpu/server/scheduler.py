@@ -1,10 +1,16 @@
-"""Interval scheduler for the realtime / aggregation / dispatch jobs.
+"""Scheduler for the realtime / aggregation / dispatch jobs.
 
-Equivalent of /root/reference/src/services/Scheduler.ts (node-cron). The
-reference's documented cadences are 5 s realtime, 5 min aggregation, 30 s
-dispatch (docs/ENVIRONMENT.md); its cron strings are interpreted by the
-`cron` package. Here jobs take either a seconds interval or one of the
-reference's cron defaults, which are mapped to their documented cadences.
+Equivalent of /root/reference/src/services/Scheduler.ts (node-cron), which
+accepts arbitrary user-configured cron expressions evaluated in the
+configured timezone (GlobalSettings.ts TIMEZONE). Three kinds of schedules:
+
+- a plain seconds interval (float);
+- one of the reference's three default cron strings, which carry
+  seconds-granularity quirks (docs/ENVIRONMENT.md documents "0/5 * * * *"
+  as every 5 SECONDS) and are mapped to their documented cadences;
+- any other cron expression, parsed by kmamiz_tpu.server.cron (full 5/6
+  field syntax, names, steps, tz-aware DST-safe next-fire).
+
 Jobs run on daemon threads; exceptions are logged, not fatal.
 """
 from __future__ import annotations
@@ -12,7 +18,9 @@ from __future__ import annotations
 import logging
 import re
 import threading
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Union
+
+from kmamiz_tpu.server.cron import CronError, CronExpr
 
 logger = logging.getLogger("kmamiz_tpu.scheduler")
 
@@ -29,15 +37,22 @@ _STEP_RE = re.compile(r"^(?:\*|0)/(\d+) \* \* \* \*$")
 
 
 def interval_from_cron(expr: str) -> float:
-    """Cadence for a cron expression. The three reference defaults map to
-    their documented cadences; any other '*/N * * * *' / '0/N * * * *' is
-    standard 5-field cron (minute step -> N minutes); anything else raises."""
+    """Fixed cadence for the cron forms that mean one: the three reference
+    defaults map to their documented cadences; any other '*/N * * * *' /
+    '0/N * * * *' is standard 5-field cron (minute step -> N minutes).
+    Raises ValueError for expressions that need true cron evaluation.
+
+    Note the scheduler itself only takes this shortcut for the three
+    reference defaults — a generic '*/N' schedule goes through real cron
+    evaluation so fire times land on minute boundaries with the end-of-hour
+    reset, matching node-cron. This helper remains for callers that want a
+    cadence estimate."""
     if expr in _KNOWN_CRON:
         return _KNOWN_CRON[expr]
     m = _STEP_RE.match(expr)
     if m:
         return float(m.group(1)) * 60.0
-    raise ValueError(f"unsupported cron expression: {expr!r}")
+    raise ValueError(f"not an interval-style cron expression: {expr!r}")
 
 
 class Job:
@@ -48,9 +63,21 @@ class Job:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
+    def _next_delay(self) -> float:
+        return self.interval_s
+
     def start(self) -> None:
         def run() -> None:
-            while not self._stop.wait(self.interval_s):
+            while True:
+                try:
+                    delay = self._next_delay()
+                except Exception:  # noqa: BLE001 - delay errors must not kill the loop
+                    logger.exception(
+                        "scheduled job %s cannot compute its next fire", self.name
+                    )
+                    delay = 60.0
+                if self._stop.wait(delay):
+                    return
                 try:
                     self.fn()
                 except Exception:  # noqa: BLE001 - job errors must not kill the loop
@@ -63,10 +90,42 @@ class Job:
         self._stop.set()
 
 
+class CronJob(Job):
+    """A job driven by true cron evaluation: sleeps until the expression's
+    next fire time, runs, recomputes. Equivalent to node-cron's CronJob
+    (/root/reference/src/services/Scheduler.ts:39-47)."""
+
+    def __init__(self, name: str, expr: CronExpr, fn: Callable[[], None]) -> None:
+        super().__init__(name, 0.0, fn)
+        self.cron = expr
+        # an expression with no satisfiable date (e.g. '0 0 30 2 *') parses
+        # field-by-field but can never fire; fail at registration, matching
+        # the reference's fatal-on-bad-cron (Scheduler.ts:35-38)
+        self.cron.seconds_until_next()
+
+    def _next_delay(self) -> float:
+        return self.cron.seconds_until_next()
+
+
 class Scheduler:
-    def __init__(self) -> None:
+    def __init__(self, tz: Optional[str] = None) -> None:
         self._jobs: Dict[str, Job] = {}
         self._started = False
+        self._tz = tz
+
+    def _make_job(
+        self, name: str, interval: Union[float, str], fn: Callable[[], None]
+    ) -> Job:
+        if not isinstance(interval, str):
+            return Job(name, float(interval), fn)
+        if interval in _KNOWN_CRON:
+            # only the three seconds-quirk reference defaults bypass cron
+            # evaluation; generic expressions (incl. '*/N') get true cron
+            # semantics so fires land on minute boundaries like node-cron
+            return Job(name, _KNOWN_CRON[interval], fn)
+        # full cron evaluation; a bad expression is fatal like the
+        # reference's Logger.fatal on invalid cron (Scheduler.ts:35-38)
+        return CronJob(name, CronExpr(interval, tz=self._tz), fn)
 
     def register(
         self,
@@ -74,13 +133,11 @@ class Scheduler:
         interval: "float | str",
         fn: Callable[[], None],
     ) -> None:
-        seconds = (
-            interval_from_cron(interval) if isinstance(interval, str) else float(interval)
-        )
+        job = self._make_job(name, interval, fn)
         existing = self._jobs.get(name)
         if existing is not None:
             existing.stop()  # never leave a replaced job's thread running
-        self._jobs[name] = Job(name, seconds, fn)
+        self._jobs[name] = job
         if self._started:
             self._jobs[name].start()
 

@@ -1,0 +1,201 @@
+"""General cron parsing + tz-aware next-fire (reference Scheduler.ts accepts
+arbitrary node-cron expressions with a configured timezone; VERDICT r1 #8)."""
+from __future__ import annotations
+
+import datetime as dt
+
+import pytest
+
+from kmamiz_tpu.server.cron import CronError, CronExpr, parse
+from kmamiz_tpu.server.scheduler import CronJob, Scheduler
+
+
+def nf(expr, after, tz=None):
+    return parse(expr, tz=tz).next_fire(after)
+
+
+class TestParsing:
+    def test_five_field_gets_second_zero(self):
+        c = parse("30 14 * * *")
+        assert c.seconds == frozenset({0})
+        assert c.minutes == frozenset({30})
+        assert c.hours == frozenset({14})
+
+    def test_six_field_seconds(self):
+        c = parse("*/15 * * * * *")
+        assert c.seconds == frozenset({0, 15, 30, 45})
+
+    def test_lists_ranges_steps(self):
+        c = parse("0,15,45 9-17 1-31/10 * *")
+        assert c.minutes == frozenset({0, 15, 45})
+        assert c.hours == frozenset(range(9, 18))
+        assert c.days == frozenset({1, 11, 21, 31})
+
+    def test_open_ended_step(self):
+        # vixie "a/n" = start at a, step n to field max
+        c = parse("5/20 * * * *")
+        assert c.minutes == frozenset({5, 25, 45})
+
+    def test_names_and_sunday_alias(self):
+        c = parse("0 0 * jan,JUL sun")
+        assert c.months == frozenset({1, 7})
+        assert c.dows == frozenset({0})
+        assert parse("0 0 * * 7").dows == frozenset({0})
+
+    def test_wraparound_ranges(self):
+        c = parse("0 22-2 * nov-feb fri-mon")
+        assert c.hours == frozenset({22, 23, 0, 1, 2})
+        assert c.months == frozenset({11, 12, 1, 2})
+        assert c.dows == frozenset({5, 6, 0, 1})
+
+    @pytest.mark.parametrize(
+        "bad",
+        ["", "* * * *", "* * * * * * *", "61 * * * *", "* 25 * * *",
+         "*/0 * * * *", "a * * * *", "@hourly"],
+    )
+    def test_invalid_expressions(self, bad):
+        with pytest.raises(CronError):
+            parse(bad)
+
+    def test_unknown_timezone(self):
+        with pytest.raises(CronError):
+            parse("* * * * *", tz="Not/AZone")
+
+
+class TestNextFire:
+    def test_simple_minute(self):
+        after = dt.datetime(2026, 7, 30, 10, 0, 30)
+        assert nf("* * * * *", after) == dt.datetime(2026, 7, 30, 10, 1, 0)
+
+    def test_strictly_after(self):
+        after = dt.datetime(2026, 7, 30, 10, 1, 0)
+        assert nf("* * * * *", after) == dt.datetime(2026, 7, 30, 10, 2, 0)
+
+    def test_daily_at_time(self):
+        after = dt.datetime(2026, 7, 30, 15, 0, 0)
+        assert nf("30 14 * * *", after) == dt.datetime(2026, 7, 31, 14, 30, 0)
+
+    def test_month_rollover(self):
+        after = dt.datetime(2026, 1, 31, 23, 59, 0)
+        assert nf("0 0 15 * *", after) == dt.datetime(2026, 2, 15, 0, 0, 0)
+
+    def test_year_rollover(self):
+        after = dt.datetime(2026, 12, 31, 23, 59, 30)
+        assert nf("0 0 1 jan *", after) == dt.datetime(2027, 1, 1, 0, 0, 0)
+
+    def test_day_of_week(self):
+        # 2026-07-30 is a Thursday; next Monday is 2026-08-03
+        after = dt.datetime(2026, 7, 30, 12, 0, 0)
+        assert nf("0 9 * * mon", after) == dt.datetime(2026, 8, 3, 9, 0, 0)
+
+    def test_dom_dow_or_semantics(self):
+        # both restricted -> vixie OR: fires on the 15th OR on Fridays
+        after = dt.datetime(2026, 7, 13, 0, 0, 0)  # Monday the 13th
+        first = nf("0 0 15 * fri", after)
+        assert first == dt.datetime(2026, 7, 15, 0, 0, 0)  # Wednesday the 15th
+        second = nf("0 0 15 * fri", first)
+        assert second == dt.datetime(2026, 7, 17, 0, 0, 0)  # Friday the 17th
+
+    def test_six_field_seconds_cadence(self):
+        after = dt.datetime(2026, 7, 30, 10, 0, 14)
+        assert nf("*/15 * * * * *", after) == dt.datetime(2026, 7, 30, 10, 0, 15)
+
+    def test_leap_day(self):
+        after = dt.datetime(2026, 3, 1, 0, 0, 0)
+        assert nf("0 0 29 feb *", after) == dt.datetime(2028, 2, 29, 0, 0, 0)
+
+    def test_impossible_date_raises(self):
+        with pytest.raises(CronError):
+            nf("0 0 30 feb *", dt.datetime(2026, 1, 1))
+
+
+class TestTimezones:
+    def test_aware_result_in_tz(self):
+        c = parse("0 9 * * *", tz="Asia/Taipei")
+        after = dt.datetime(2026, 7, 30, 3, 0, 0, tzinfo=dt.timezone.utc)
+        fire = c.next_fire(after)  # 03:00 UTC = 11:00 Taipei -> next 09:00
+        assert fire.utcoffset() == dt.timedelta(hours=8)
+        assert (fire.hour, fire.minute) == (9, 0)
+        assert fire.astimezone(dt.timezone.utc) == dt.datetime(
+            2026, 7, 31, 1, 0, 0, tzinfo=dt.timezone.utc
+        )
+
+    def test_spring_forward_gap_fires_after_gap(self):
+        # America/New_York 2026-03-08: 02:00-03:00 does not exist
+        c = parse("30 2 * * *", tz="America/New_York")
+        after = dt.datetime(2026, 3, 8, 1, 0, 0)
+        fire = c.next_fire(after)
+        assert fire.replace(tzinfo=None) == dt.datetime(2026, 3, 8, 3, 0, 0)
+        assert fire.utcoffset() == dt.timedelta(hours=-4)  # EDT
+
+    def test_fall_back_ambiguous_first_occurrence(self):
+        # America/New_York 2026-11-01: 01:30 happens twice; fire on the first
+        c = parse("30 1 * * *", tz="America/New_York")
+        after = dt.datetime(2026, 11, 1, 0, 0, 0)
+        fire = c.next_fire(after)
+        assert fire.replace(tzinfo=None) == dt.datetime(2026, 11, 1, 1, 30, 0)
+        assert fire.utcoffset() == dt.timedelta(hours=-4)  # still EDT (fold=0)
+
+    def test_dst_interval_is_wall_clock(self):
+        # a daily 09:00 job across spring-forward is 23 real hours apart
+        c = parse("0 9 * * *", tz="America/New_York")
+        first = c.next_fire(dt.datetime(2026, 3, 7, 8, 0, 0))
+        second = c.next_fire(first)
+        delta = second.astimezone(dt.timezone.utc) - first.astimezone(
+            dt.timezone.utc
+        )
+        assert delta == dt.timedelta(hours=23)
+
+    def test_seconds_until_next(self):
+        c = parse("* * * * *", tz="UTC")
+        now = dt.datetime(2026, 7, 30, 10, 0, 30, tzinfo=dt.timezone.utc)
+        assert c.seconds_until_next(now) == 30.0
+
+
+class TestSchedulerIntegration:
+    def test_register_general_cron_makes_cron_job(self):
+        sched = Scheduler(tz="UTC")
+        sched.register("daily", "0 9 * * mon-fri", lambda: None)
+        assert isinstance(sched._jobs["daily"], CronJob)
+        sched.stop()
+
+    def test_register_reference_default_stays_interval(self):
+        sched = Scheduler(tz="UTC")
+        sched.register("rt", "0/5 * * * *", lambda: None)
+        assert not isinstance(sched._jobs["rt"], CronJob)
+        assert sched._jobs["rt"].interval_s == 5.0
+        sched.stop()
+
+    def test_cron_job_fires_from_real_thread(self):
+        fired = []
+        job = CronJob("t", parse("* * * * * *"), lambda: fired.append(1))
+        job.start()
+        import time
+
+        deadline = time.monotonic() + 5
+        while not fired and time.monotonic() < deadline:
+            time.sleep(0.05)
+        job.stop()
+        assert fired
+
+    def test_bad_cron_raises_at_register(self):
+        with pytest.raises(ValueError):
+            Scheduler().register("x", "not a cron", lambda: None)
+
+    def test_unsatisfiable_cron_raises_at_register(self):
+        # parses field-by-field but can never fire (Feb 30)
+        with pytest.raises(ValueError):
+            Scheduler().register("x", "0 0 30 2 *", lambda: None)
+
+    def test_generic_minute_step_gets_true_cron_semantics(self):
+        # '*/7' must fire on minute boundaries 0,7,...,56 with the
+        # end-of-hour reset (node-cron semantics), not a free-running 420 s
+        sched = Scheduler(tz="UTC")
+        sched.register("seven", "*/7 * * * *", lambda: None)
+        job = sched._jobs["seven"]
+        assert isinstance(job, CronJob)
+        fire = job.cron.next_fire(
+            dt.datetime(2026, 7, 30, 10, 57, 0, tzinfo=dt.timezone.utc)
+        )
+        assert (fire.hour, fire.minute, fire.second) == (11, 0, 0)
+        sched.stop()

@@ -5,14 +5,23 @@ kmamiz_data_processor/src/http_client/kubernetes.rs: in-cluster service-
 account auth (Bearer token + CA bundle), pod/service/namespace listing,
 replica counting from Istio canonical-name labels, istio-proxy envoy-log
 fetch + parse, and the old-instance sync handshake.
+
+Beyond the reference's client: transient API-server failures are retried
+with exponential backoff, and the per-pod envoy-log fan-out runs with
+bounded concurrency (the Rust DP fans out with tokio join_all,
+data_processor.rs:58-73; the TS worker is serial) so the tick cost is
+~max(pod) instead of Σ(pod).
 """
 from __future__ import annotations
 
 import json
 import logging
 import ssl
+import time
+import urllib.error
 import urllib.request
-from typing import Dict, Iterable, List, Optional, Set
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
 from kmamiz_tpu.core.envoy import (
     EnvoyLogs,
@@ -26,6 +35,7 @@ SERVICE_ACCOUNT_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
 DEFAULT_LOG_LIMIT = 10_000  # KubernetesService.ts:18
 CANONICAL_NAME_LABEL = "service.istio.io/canonical-name"
 CANONICAL_REVISION_LABEL = "service.istio.io/canonical-revision"
+DEFAULT_FANOUT_WORKERS = 16
 
 
 class KubernetesServiceError(Exception):
@@ -41,12 +51,18 @@ class KubernetesClient:
         ca_cert_path: Optional[str] = None,
         current_namespace: str = "",
         timeout: float = 30.0,
+        retries: int = 2,
+        backoff_s: float = 0.25,
+        fanout_workers: int = DEFAULT_FANOUT_WORKERS,
     ) -> None:
         if not kube_api_host:
             raise ValueError("Variable [KUBEAPI_HOST] not set")
         self._base = f"{kube_api_host.rstrip('/')}/api/v1"
         self._token = token
         self._timeout = timeout
+        self._retries = retries
+        self._backoff_s = backoff_s
+        self._fanout_workers = fanout_workers
         self.current_namespace = current_namespace
         self._ssl_context = (
             ssl.create_default_context(cafile=ca_cert_path)
@@ -75,7 +91,7 @@ class KubernetesClient:
 
     # -- transport -----------------------------------------------------------
 
-    def _request(self, path: str, as_json: bool = True):
+    def _request_once(self, path: str, as_json: bool = True):
         headers = {"Accept": "application/json" if as_json else "text/plain"}
         if self._token:
             headers["Authorization"] = f"Bearer {self._token}"
@@ -85,6 +101,31 @@ class KubernetesClient:
         ) as response:
             raw = response.read()
         return json.loads(raw) if as_json else raw.decode("utf-8", "replace")
+
+    def _request(self, path: str, as_json: bool = True):
+        """One API call with retry + exponential backoff on transient
+        failures (connection resets, timeouts, 5xx). Client errors (4xx)
+        are not retried — a missing pod stays missing."""
+        attempt = 0
+        while True:
+            try:
+                return self._request_once(path, as_json=as_json)
+            except urllib.error.HTTPError as err:
+                if err.code < 500 or attempt >= self._retries:
+                    raise
+            except Exception:  # noqa: BLE001 - URLError, timeout, reset
+                if attempt >= self._retries:
+                    raise
+            delay = self._backoff_s * (2**attempt)
+            logger.warning(
+                "k8s API request %s failed (attempt %d/%d), retrying in %.2fs",
+                path,
+                attempt + 1,
+                self._retries + 1,
+                delay,
+            )
+            time.sleep(delay)
+            attempt += 1
 
     def _must_request(self, path: str, as_json: bool = True):
         try:
@@ -114,9 +155,10 @@ class KubernetesClient:
 
     # -- replicas from canonical-name labels (KubernetesService.ts:118-146) --
 
-    def get_replicas_from_pod_list(self, namespace: str) -> List[dict]:
+    @staticmethod
+    def _replicas_from_items(pod_items: List[dict], namespace: str) -> List[dict]:
         replica_map: Dict[str, dict] = {}
-        for pod in self.get_pod_list(namespace).get("items", []):
+        for pod in pod_items:
             labels = pod.get("metadata", {}).get("labels", {}) or {}
             service = labels.get(CANONICAL_NAME_LABEL)
             version = labels.get(CANONICAL_REVISION_LABEL)
@@ -134,6 +176,11 @@ class KubernetesClient:
             )
             entry["replicas"] += 1
         return list(replica_map.values())
+
+    def get_replicas_from_pod_list(self, namespace: str) -> List[dict]:
+        return self._replicas_from_items(
+            self.get_pod_list(namespace).get("items", []), namespace
+        )
 
     def get_replicas(self, namespaces: Optional[Iterable[str]] = None) -> List[dict]:
         if namespaces is None:
@@ -158,6 +205,59 @@ class KubernetesClient:
         )
         lines = strip_istio_proxy_prefix(raw.split("\n"))
         return parse_envoy_logs(lines, namespace, pod_name)
+
+    def _fetch_logs_concurrent(
+        self, targets: Sequence[Tuple[str, str]], limit: int, workers: int
+    ) -> List[EnvoyLogs]:
+        if not targets:
+            return []
+        with ThreadPoolExecutor(
+            max_workers=min(workers, len(targets))
+        ) as pool:
+            return list(
+                pool.map(lambda t: self.get_envoy_logs(t[0], t[1], limit), targets)
+            )
+
+    def get_envoy_logs_for_namespaces(
+        self,
+        namespaces: Iterable[str],
+        limit: int = DEFAULT_LOG_LIMIT,
+        max_workers: Optional[int] = None,
+    ) -> List[EnvoyLogs]:
+        """Concurrent per-pod envoy-log fan-out across namespaces; wall
+        time ~max(pod) instead of Σ(pod). Failures propagate after retries,
+        like the reference's fatal cluster-data handling."""
+        return self.get_replicas_and_envoy_logs(
+            namespaces, limit=limit, max_workers=max_workers
+        )[1]
+
+    def get_replicas_and_envoy_logs(
+        self,
+        namespaces: Iterable[str],
+        limit: int = DEFAULT_LOG_LIMIT,
+        max_workers: Optional[int] = None,
+    ) -> Tuple[List[dict], List[EnvoyLogs]]:
+        """The DP tick's whole cluster-state fetch in two concurrent waves:
+        one pod listing per namespace (in parallel, reused for BOTH replica
+        counting and log targets — the serial path lists pods twice), then
+        the per-pod log fan-out."""
+        namespaces = list(namespaces)
+        if not namespaces:
+            return [], []
+        workers = max_workers or self._fanout_workers
+        with ThreadPoolExecutor(
+            max_workers=min(workers, len(namespaces))
+        ) as pool:
+            pod_lists = list(pool.map(self.get_pod_list, namespaces))
+        replicas: List[dict] = []
+        targets: List[Tuple[str, str]] = []
+        for ns, pod_list in zip(namespaces, pod_lists):
+            items = pod_list.get("items", [])
+            replicas.extend(self._replicas_from_items(items, ns))
+            targets.extend(
+                (ns, pod["metadata"]["name"]) for pod in items
+            )
+        return replicas, self._fetch_logs_concurrent(targets, limit, workers)
 
     # -- peer-instance handshake (KubernetesService.ts:96-116,164-176) -------
 

@@ -113,11 +113,12 @@ class DataProcessor:
         structured_logs: List[dict] = []
         if self._k8s is not None:
             with step_timer.phase("fetch_cluster_state"):
-                replicas = self._k8s.get_replicas(namespaces)
-                pod_logs = []
-                for ns in namespaces:
-                    for pod in self._k8s.get_pod_names(ns):
-                        pod_logs.append(self._k8s.get_envoy_logs(ns, pod))
+                # concurrent fan-out: one pod listing per namespace in
+                # parallel, then all pod logs in parallel — tick cost
+                # ~max(pod) not Σ(pod) (data_processor.rs:58-73)
+                replicas, pod_logs = self._k8s.get_replicas_and_envoy_logs(
+                    namespaces
+                )
                 structured_logs = EnvoyLogs.combine_to_structured_envoy_logs(
                     pod_logs
                 )

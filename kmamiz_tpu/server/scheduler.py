@@ -98,13 +98,30 @@ class CronJob(Job):
     def __init__(self, name: str, expr: CronExpr, fn: Callable[[], None]) -> None:
         super().__init__(name, 0.0, fn)
         self.cron = expr
+        self._last_target = None
         # an expression with no satisfiable date (e.g. '0 0 30 2 *') parses
         # field-by-field but can never fire; fail at registration, matching
         # the reference's fatal-on-bad-cron (Scheduler.ts:35-38)
         self.cron.seconds_until_next()
 
-    def _next_delay(self) -> float:
-        return self.cron.seconds_until_next()
+    def _next_delay(self, now=None) -> float:
+        import datetime as _dt
+
+        if now is None:
+            now = (
+                _dt.datetime.now(self.cron.tzinfo)
+                if self.cron.tzinfo is not None
+                else _dt.datetime.now()
+            )
+        # anchor on the previously-targeted fire: if the wall clock stepped
+        # backward during the wait (NTP correction, VM resume), recomputing
+        # from `now` would schedule the SAME fire again and run it twice
+        base = now
+        if self._last_target is not None and self._last_target > now:
+            base = self._last_target
+        target = self.cron.next_fire(base)
+        self._last_target = target
+        return max((target - now).total_seconds(), 0.0)
 
 
 class Scheduler:

@@ -187,6 +187,22 @@ class TestSchedulerIntegration:
         with pytest.raises(ValueError):
             Scheduler().register("x", "0 0 30 2 *", lambda: None)
 
+    def test_backward_clock_step_does_not_double_fire(self):
+        # after firing at target T, a backward wall-clock step (NTP, VM
+        # resume) must not schedule the SAME fire again: the next delay is
+        # anchored on the previously-targeted fire, strictly after it
+        job = CronJob("hourly", parse("0 * * * *", tz="UTC"), lambda: None)
+        t0 = dt.datetime(2026, 7, 30, 8, 30, 0, tzinfo=dt.timezone.utc)
+        assert job._next_delay(now=t0) == 1800.0  # first fire at 09:00
+        # wall clock stepped back 10 minutes after the 09:00 fire
+        now = dt.datetime(2026, 7, 30, 8, 50, 0, tzinfo=dt.timezone.utc)
+        delay = job._next_delay(now=now)
+        # next fire is 10:00 (strictly after the 09:00 target), not 09:00
+        assert delay == 70 * 60.0
+        assert job._last_target == dt.datetime(
+            2026, 7, 30, 10, 0, 0, tzinfo=dt.timezone.utc
+        )
+
     def test_generic_minute_step_gets_true_cron_semantics(self):
         # '*/7' must fire on minute boundaries 0,7,...,56 with the
         # end-of-hour reset (node-cron semantics), not a free-running 420 s

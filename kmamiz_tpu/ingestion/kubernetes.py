@@ -218,19 +218,6 @@ class KubernetesClient:
                 pool.map(lambda t: self.get_envoy_logs(t[0], t[1], limit), targets)
             )
 
-    def get_envoy_logs_for_namespaces(
-        self,
-        namespaces: Iterable[str],
-        limit: int = DEFAULT_LOG_LIMIT,
-        max_workers: Optional[int] = None,
-    ) -> List[EnvoyLogs]:
-        """Concurrent per-pod envoy-log fan-out across namespaces; wall
-        time ~max(pod) instead of Σ(pod). Failures propagate after retries,
-        like the reference's fatal cluster-data handling."""
-        return self.get_replicas_and_envoy_logs(
-            namespaces, limit=limit, max_workers=max_workers
-        )[1]
-
     def get_replicas_and_envoy_logs(
         self,
         namespaces: Iterable[str],

@@ -109,7 +109,9 @@ class MemoryStore(Store):
 
     def __init__(self) -> None:
         self._data: Dict[str, Dict[str, dict]] = {c: {} for c in COLLECTIONS}
-        self._lock = threading.Lock()
+        # reentrant: FileStore wraps mutate+journal-append in one critical
+        # section that nests these methods' own acquisition
+        self._lock = threading.RLock()
 
     def find_all(self, collection: str) -> List[dict]:
         with self._lock:
@@ -146,47 +148,150 @@ class MemoryStore(Store):
 
 
 class FileStore(MemoryStore):
-    """JSON-file-per-collection store; writes are flushed synchronously."""
+    """Snapshot + append-journal store: each collection persists as a JSON
+    snapshot (`<name>.json`) plus a JSONL journal of mutations since the
+    snapshot (`<name>.journal`). Mutations append one journal line — O(delta)
+    I/O per write instead of rewriting the collection (the aggregation tick
+    inserts per-minute historical docs every few seconds at 10k endpoints,
+    where full rewrites amplified to multi-MB; VERDICT r1 #9, reference sync
+    contract /root/reference/src/services/DispatchStorage.ts:24-36). The
+    journal compacts into the snapshot once it outgrows `compact_bytes` and
+    the snapshot, keeping reload cost bounded."""
 
-    def __init__(self, directory: str) -> None:
+    DEFAULT_COMPACT_BYTES = 1 << 20  # 1 MiB of journal before compaction
+
+    def __init__(
+        self, directory: str, compact_bytes: int = DEFAULT_COMPACT_BYTES
+    ) -> None:
         super().__init__()
         self._dir = Path(directory)
         self._dir.mkdir(parents=True, exist_ok=True)
+        self._compact_bytes = compact_bytes
+        self._journal_sizes: Dict[str, int] = {c: 0 for c in COLLECTIONS}
         for c in COLLECTIONS:
-            path = self._dir / f"{c}.json"
-            if path.exists():
-                try:
-                    docs = json.loads(path.read_text())
-                    self._data[c] = {d["_id"]: d for d in docs if "_id" in d}
-                except (json.JSONDecodeError, KeyError):
-                    pass
+            self._load_collection(c)
 
-    def _flush(self, collection: str) -> None:
-        path = self._dir / f"{collection}.json"
+    # -- load: snapshot + journal replay -------------------------------------
+
+    def _snapshot_path(self, collection: str) -> Path:
+        return self._dir / f"{collection}.json"
+
+    def _journal_path(self, collection: str) -> Path:
+        return self._dir / f"{collection}.journal"
+
+    def _load_collection(self, collection: str) -> None:
+        path = self._snapshot_path(collection)
+        if path.exists():
+            try:
+                docs = json.loads(path.read_text())
+                self._data[collection] = {
+                    d["_id"]: d for d in docs if "_id" in d
+                }
+            except (json.JSONDecodeError, KeyError):
+                pass
+        journal = self._journal_path(collection)
+        if not journal.exists():
+            return
+        # records are delimited by real newlines only — splitlines() would
+        # also split on U+2028/U+2029 inside JSON strings and corrupt replay
+        raw = journal.read_bytes()
+        parts = raw.split(b"\n")
+        # the final segment is only a record if the file ends with \n
+        # (parts[-1] == b""); otherwise it is a torn tail, even when it
+        # happens to parse — appending after an unterminated line would
+        # merge two records
+        complete, tail = parts[:-1], parts[-1]
+        valid_bytes = 0
+        torn = bool(tail)
+        for line in complete:
+            if not line:
+                valid_bytes += 1  # stray blank line
+                continue
+            try:
+                entry = json.loads(line)
+            except (json.JSONDecodeError, UnicodeDecodeError):
+                torn = True
+                break  # keep everything before the bad line
+            valid_bytes += len(line) + 1
+            op = entry.get("op")
+            if op == "put":
+                doc = entry["doc"]
+                self._data[collection][doc["_id"]] = doc
+            elif op == "delete":
+                for i in entry["ids"]:
+                    self._data[collection].pop(i, None)
+            elif op == "clear":
+                self._data[collection] = {}
+        if torn:
+            # truncate NOW so later appends don't land after a bad line
+            # and vanish on the following reload
+            with open(journal, "r+b") as f:
+                f.truncate(valid_bytes)
+        self._journal_sizes[collection] = valid_bytes
+
+    # -- write path: append one line, compact when outgrown ------------------
+
+    def _append(self, collection: str, entries: List[dict]) -> None:
+        """Append journal records in one write; caller holds self._lock."""
+        data = b"".join(
+            json.dumps(e, ensure_ascii=False).encode("utf-8") + b"\n"
+            for e in entries
+        )
+        with open(self._journal_path(collection), "ab") as f:
+            f.write(data)
+        self._journal_sizes[collection] += len(data)
+        if self._journal_sizes[collection] >= self._compact_bytes:
+            snapshot = self._snapshot_path(collection)
+            if (
+                not snapshot.exists()
+                or self._journal_sizes[collection]
+                >= snapshot.stat().st_size
+            ):
+                self._compact(collection)
+
+    def _compact(self, collection: str) -> None:
+        """Fold the journal into the snapshot atomically: write the new
+        snapshot to a temp file, rename over, then truncate the journal.
+        A crash between the two leaves a journal whose replay is a no-op
+        (puts of docs already in the snapshot). Caller holds self._lock."""
+        path = self._snapshot_path(collection)
         tmp = path.with_suffix(".json.tmp")
-        with self._lock:
-            docs = list(self._data[collection].values())
+        docs = list(self._data[collection].values())
         tmp.write_text(json.dumps(docs, ensure_ascii=False))
         tmp.replace(path)
+        open(self._journal_path(collection), "w").close()
+        self._journal_sizes[collection] = 0
 
     def insert_many(self, collection: str, docs: List[dict]) -> List[dict]:
-        out = super().insert_many(collection, docs)
-        self._flush(collection)
+        with self._lock:
+            out = super().insert_many(collection, docs)
+            self._append(collection, [{"op": "put", "doc": d} for d in out])
         return out
 
     def save(self, collection: str, doc: dict) -> dict:
-        out = super().save(collection, doc)
-        self._flush(collection)
+        with self._lock:
+            out = super().save(collection, doc)
+            self._append(collection, [{"op": "put", "doc": out}])
         return out
 
     def delete_many(self, collection: str, ids: List[str]) -> int:
-        n = super().delete_many(collection, ids)
-        self._flush(collection)
+        with self._lock:
+            n = super().delete_many(collection, ids)
+            self._append(collection, [{"op": "delete", "ids": list(ids)}])
         return n
 
     def clear_collection(self, collection: str) -> None:
-        super().clear_collection(collection)
-        self._flush(collection)
+        with self._lock:
+            super().clear_collection(collection)
+            # truncate the journal FIRST: crashing between the two steps
+            # must not leave an old journal whose replayed puts resurrect
+            # cleared docs over the emptied snapshot
+            open(self._journal_path(collection), "w").close()
+            self._journal_sizes[collection] = 0
+            path = self._snapshot_path(collection)
+            tmp = path.with_suffix(".json.tmp")
+            tmp.write_text("[]")
+            tmp.replace(path)
 
 
 def store_from_uri(uri: str) -> Store:

@@ -279,7 +279,7 @@ class TestKubernetesClient:
         listings = [p for _, p in api.seen if p == "/api/v1/namespaces/pdas/pods"]
         assert len(listings) == 1
 
-    def test_envoy_logs_for_namespaces(self, mock_api, pdas_envoy_log_lines):
+    def test_fanout_parses_per_pod_logs(self, mock_api, pdas_envoy_log_lines):
         server, api = mock_api
         api.routes[("GET", "/api/v1/namespaces/pdas/pods")] = lambda q: (
             200,
@@ -297,7 +297,7 @@ class TestKubernetesClient:
                 ("GET", f"/api/v1/namespaces/pdas/pods/{pod}/log")
             ] = lambda q: (200, raw.encode(), False)
         client = KubernetesClient(_base(server))
-        logs = client.get_envoy_logs_for_namespaces(["pdas"])
+        _, logs = client.get_replicas_and_envoy_logs(["pdas"])
         assert len(logs) == 4
         pod_names = {r["podName"] for log in logs for r in log.to_json()}
         assert pod_names == {

@@ -304,6 +304,104 @@ class TestStorageAndDispatch:
         reloaded.delete_many("AggregatedData", [docs[0]["_id"]])
         assert reloaded.get_aggregated_data() is None
 
+    def test_file_store_writes_are_o_delta(self, tmp_path):
+        """Each save appends ~one doc to the journal instead of rewriting
+        the whole collection (VERDICT r1 #9): with a big resident
+        collection, the bytes written per insert must be doc-sized, not
+        collection-sized."""
+        store = FileStore(str(tmp_path / "d"))
+        base = [{"date": i, "services": [{"pad": "x" * 200}]} for i in range(200)]
+        store.insert_many("HistoricalData", base)
+
+        journal = tmp_path / "d" / "HistoricalData.journal"
+        snapshot = tmp_path / "d" / "HistoricalData.json"
+        snap_before = snapshot.stat().st_size if snapshot.exists() else 0
+        j_before = journal.stat().st_size
+        store.save("HistoricalData", {"date": 999, "services": []})
+        grown = journal.stat().st_size - j_before
+        assert grown < 200  # one small doc's journal line
+        snap_after = snapshot.stat().st_size if snapshot.exists() else 0
+        assert snap_after == snap_before  # snapshot untouched by the save
+
+    def test_file_store_journal_replay(self, tmp_path):
+        store = FileStore(str(tmp_path / "d"))
+        a = store.save("EndpointDataType", {"k": 1})
+        b = store.save("EndpointDataType", {"k": 2})
+        store.save("EndpointDataType", {**a, "k": 10})  # update in place
+        store.delete_many("EndpointDataType", [b["_id"]])
+        reloaded = FileStore(str(tmp_path / "d"))
+        docs = reloaded.find_all("EndpointDataType")
+        assert [(d["_id"], d["k"]) for d in docs] == [(a["_id"], 10)]
+
+    def test_file_store_torn_journal_tail_is_ignored(self, tmp_path):
+        store = FileStore(str(tmp_path / "d"))
+        store.save("TaggedInterface", {"ok": True})
+        with open(tmp_path / "d" / "TaggedInterface.journal", "a") as f:
+            f.write('{"op": "put", "doc": {"_id": "trunc')  # crash mid-write
+        reloaded = FileStore(str(tmp_path / "d"))
+        docs = reloaded.find_all("TaggedInterface")
+        assert len(docs) == 1 and docs[0]["ok"] is True
+
+    def test_file_store_appends_after_torn_tail_survive(self, tmp_path):
+        """Reload must truncate a torn tail so post-restart writes don't
+        land after an unparseable line and vanish on the NEXT reload —
+        including the tail that parses but lacks its newline terminator."""
+        for tail in ('{"op": "put", "doc": {"_id": "trunc',  # mid-record
+                     '{"op": "put", "doc": {"_id": "x", "v": 1}}'):  # no \n
+            d = tmp_path / f"d-{abs(hash(tail))}"
+            store = FileStore(str(d))
+            store.save("TaggedInterface", {"ok": True})
+            with open(d / "TaggedInterface.journal", "a") as f:
+                f.write(tail)
+            after_crash = FileStore(str(d))
+            kept = after_crash.save("TaggedInterface", {"post": "crash"})
+            final = FileStore(str(d))
+            docs = {d_["_id"]: d_ for d_ in final.find_all("TaggedInterface")}
+            assert kept["_id"] in docs  # the post-crash write survived
+            assert "x" not in docs  # unterminated tail was discarded
+            assert len(docs) == 2
+
+    def test_file_store_unicode_line_separators_in_docs(self, tmp_path):
+        # U+2028/U+2029 inside strings must not split journal records
+        store = FileStore(str(tmp_path / "d"))
+        weird = {"label": "a\u2028b\u2029c\u0085d"}
+        a = store.save("UserDefinedLabel", weird)
+        b = store.save("UserDefinedLabel", {"label": "plain"})
+        reloaded = FileStore(str(tmp_path / "d"))
+        docs = {d_["_id"]: d_ for d_ in reloaded.find_all("UserDefinedLabel")}
+        assert docs[a["_id"]]["label"] == "a\u2028b\u2029c\u0085d"
+        assert docs[b["_id"]]["label"] == "plain"
+
+    def test_file_store_concurrent_writers_lose_nothing(self, tmp_path):
+        import threading as _threading
+
+        store = FileStore(str(tmp_path / "d"), compact_bytes=256)
+
+        def writer(k):
+            for i in range(40):
+                store.save("TaggedDiffData", {"w": k, "i": i})
+
+        threads = [_threading.Thread(target=writer, args=(k,)) for k in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        reloaded = FileStore(str(tmp_path / "d"))
+        docs = reloaded.find_all("TaggedDiffData")
+        assert len(docs) == 160  # every write persisted despite compactions
+
+    def test_file_store_compaction(self, tmp_path):
+        store = FileStore(str(tmp_path / "d"), compact_bytes=512)
+        doc_id = store.save("UserDefinedLabel", {"labels": []})["_id"]
+        for i in range(50):  # ~50 * ~60B > 512B -> compaction triggers
+            store.save("UserDefinedLabel", {"_id": doc_id, "labels": [i]})
+        journal = tmp_path / "d" / "UserDefinedLabel.journal"
+        assert journal.stat().st_size < 512  # journal was truncated
+        snapshot = json.loads((tmp_path / "d" / "UserDefinedLabel.json").read_text())
+        assert len(snapshot) == 1  # folded to the single live doc
+        reloaded = FileStore(str(tmp_path / "d"))
+        assert reloaded.find_all("UserDefinedLabel")[0]["labels"] == [49]
+
     def test_cache_sync_round_trip(self, pdas_traces):
         from kmamiz_tpu.domain.traces import Traces
 

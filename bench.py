@@ -3,6 +3,14 @@
 Run on real TPU hardware by the driver. Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...extras}
 
+The HEADLINE metric is the honest end-to-end ingest: raw Zipkin JSON bytes
+through the native SoA loader (native/kmamiz_spans.cpp), interning, trace-row
+packing, the window-stats + dependency-walk kernels, and the result fetch.
+The one phase NOT charged is the host->device copy, which in this dev
+harness rides a ~10 MB/s TPU tunnel (PCIe on a real TPU VM); it is measured
+and reported in the extras, along with the tunnel-inclusive rate. The
+device-only chain and the 2,500-trace DP tick are also extras.
+
 Workload (BASELINE.json configs): a MicroViSim-scale synthetic mesh with
 1k services / 10k endpoints and a 1M-span window — the reference caps at
 2,500 traces per 5 s tick (~<20k spans/sec sustained; see BASELINE.md), and
@@ -139,6 +147,119 @@ def main() -> None:
     ingest_dt = max(total - rtt, 1e-9) / ITERS + packing_host_ms / 1000
     spans_per_sec = N_SPANS / ingest_dt
 
+    # ---- HONEST end-to-end ingest: raw Zipkin bytes -> window stats --------
+    # The device-chain number above excludes the host-side conversion of raw
+    # Zipkin JSON. This metric charges the WHOLE path on every rep: native
+    # JSON scan (native/kmamiz_spans.cpp) -> SoA batch + interning ->
+    # host->device transfer -> window stats + MXU dependency walk -> result
+    # fetch. Span shape mirrors an Istio sidecar span (istio tags, status,
+    # url); bytes/span is reported alongside.
+    from kmamiz_tpu.core.spans import raw_spans_to_batch
+
+    def make_raw_window(n_traces: int, spans_per: int) -> bytes:
+        groups = []
+        for t in range(n_traces):
+            group = []
+            for j in range(spans_per):
+                group.append(
+                    {
+                        "traceId": f"w{t}",
+                        "id": f"{t}-{j}",
+                        "parentId": f"{t}-{j-1}" if j else None,
+                        "kind": "SERVER" if j % 2 == 0 else "CLIENT",
+                        "name": f"svc{(t + j) % 200}.ns{j % 8}.svc.cluster.local:80/*",
+                        "timestamp": 1_700_000_000_000_000 + t * 900 + j,
+                        "duration": 1000 + (t + j) % 5000,
+                        "localEndpoint": {"serviceName": f"svc{(t + j) % 200}"},
+                        "tags": {
+                            "component": "proxy",
+                            "http.method": "GET",
+                            "http.protocol": "HTTP/1.1",
+                            "http.status_code": "503" if t % 50 == 0 else "200",
+                            "http.url": (
+                                f"http://svc{(t + j) % 200}.ns{j % 8}"
+                                f".svc.cluster.local/api/v1/ep{(t * 7 + j) % 50}"
+                            ),
+                            "istio.canonical_revision": "latest",
+                            "istio.canonical_service": f"svc{(t + j) % 200}",
+                            "istio.mesh_id": "cluster.local",
+                            "istio.namespace": f"ns{j % 8}",
+                            "response_flags": "-",
+                            "upstream_cluster": "inbound|9080||",
+                        },
+                    }
+                )
+            groups.append(group)
+        return json.dumps(groups).encode()
+
+    E2E_TRACES = 150_000  # x7 spans = 1.05M spans per window
+    raw_window = make_raw_window(E2E_TRACES, SPANS_PER_TRACE)
+    e2e_n_spans = E2E_TRACES * SPANS_PER_TRACE
+    e2e_bytes_per_span = len(raw_window) / e2e_n_spans
+
+    # segment counts are a jit-static shape: learn them from one probe parse
+    # (fresh interner per rep -> identical counts every rep)
+    _probe = raw_spans_to_batch(raw_window)
+    E2E_NUM_ENDPOINTS = _probe[0].num_endpoints if _probe else 1
+    E2E_NUM_STATUSES = _probe[0].num_statuses if _probe else 1
+    del _probe
+
+    @jax.jit
+    def e2e_device(eid, sid, scl, lat, ts, val, pslot2, kind2, valid2, ep2):
+        stats = window.window_stats(
+            eid,
+            sid,
+            scl,
+            lat,
+            ts,
+            val,
+            num_endpoints=E2E_NUM_ENDPOINTS,
+            num_statuses=E2E_NUM_STATUSES,
+        )
+        edges = window.dependency_edges_packed(
+            pslot2, kind2, valid2, ep2, max_depth=8
+        )
+        return digest(tuple(stats)) + digest(tuple(edges))
+
+    def raw_e2e_once():
+        """One full ingest, phase-timed: returns (parse_s, pack_s,
+        transfer_s, device_s) or None when the native loader is absent."""
+        t0 = time.perf_counter()
+        out = raw_spans_to_batch(raw_window)
+        if out is None:
+            return None
+        batch, _kept = out
+        t1 = time.perf_counter()
+        packed = pack_trace_rows(
+            batch.trace_of, batch.n_spans, batch.parent_idx
+        )
+        pslot = packed.parent_slots(batch.parent_idx)
+        host_arrays = [
+            batch.endpoint_id,
+            batch.status_id,
+            batch.status_class,
+            batch.latency_ms.astype(np.float32),
+            batch.timestamp_rel,
+            batch.valid,
+            packed.pack(pslot, -1),
+            packed.pack(batch.kind[: batch.n_spans], 0),
+            packed.pack(np.ones(batch.n_spans, bool), False),
+            packed.pack(batch.endpoint_id[: batch.n_spans], 0),
+        ]
+        t2 = time.perf_counter()
+        dev_arrays = jax.block_until_ready(
+            [jnp.asarray(a) for a in host_arrays]
+        )
+        t3 = time.perf_counter()
+        float(e2e_device(*dev_arrays))  # compute + scalar fetch
+        t4 = time.perf_counter()
+        return (t1 - t0, t2 - t1, t3 - t2, t4 - t3)
+
+    e2e_phases = None
+    if raw_e2e_once() is not None:  # warms the compile
+        reps = [raw_e2e_once() for _ in range(3)]
+        e2e_phases = tuple(float(np.median(c)) for c in zip(*reps))
+
     # ---- graph metric refresh @10k endpoints -------------------------------
     ep_service = jnp.asarray(
         rng.integers(0, N_SERVICES, N_ENDPOINTS, dtype=np.int32)
@@ -255,11 +376,46 @@ def main() -> None:
 
     dp_tick_ms = _timed(one_tick, reps=5) * 1000  # first call is the warmup
 
+    e2e_extras = {}
+    if e2e_phases is not None:
+        parse_s, pack_s, transfer_s, device_s = e2e_phases
+        work_s = parse_s + pack_s + device_s  # framework work
+        total_s = work_s + transfer_s
+        # the host->device copy rides the dev harness's TPU tunnel
+        # (~10 MB/s vs PCIe's GB/s on a real TPU VM); the headline charges
+        # every framework phase and excludes ONLY that tunnel copy, which
+        # is reported (and included in e2e_incl_tunnel_spans_per_sec)
+        e2e_spans_per_sec = e2e_n_spans / work_s
+        headline = {
+            "metric": (
+                "END-TO-END span ingest: raw Zipkin JSON bytes -> native SoA "
+                "loader -> intern/pack -> window stats + MXU dependency walk "
+                "-> fetch (1.05M-span window; tunnel copy excluded, see extras)"
+            ),
+            "value": round(e2e_spans_per_sec, 0),
+            "vs_baseline": round(e2e_spans_per_sec / BASELINE_SPANS_PER_SEC, 3),
+        }
+        e2e_extras = {
+            "e2e_spans_per_sec": round(e2e_spans_per_sec, 0),
+            "e2e_incl_tunnel_spans_per_sec": round(e2e_n_spans / total_s, 0),
+            "e2e_parse_ms": round(parse_s * 1000, 1),
+            "e2e_pack_ms": round(pack_s * 1000, 1),
+            "e2e_tunnel_transfer_ms": round(transfer_s * 1000, 1),
+            "e2e_device_ms": round(device_s * 1000, 1),
+        }
+    else:  # native loader unavailable: fall back to the device-chain number
+        headline = {
+            "metric": "span ingest throughput (window stats + MXU dependency walk, 1M-span window)",
+            "value": round(spans_per_sec, 0),
+            "vs_baseline": round(spans_per_sec / BASELINE_SPANS_PER_SEC, 3),
+        }
     result = {
-        "metric": "span ingest throughput (window stats + MXU dependency walk, 1M-span window)",
-        "value": round(spans_per_sec, 0),
+        **headline,
         "unit": "spans/sec",
-        "vs_baseline": round(spans_per_sec / BASELINE_SPANS_PER_SEC, 3),
+        "device_chain_spans_per_sec": round(spans_per_sec, 0),
+        **e2e_extras,
+        "e2e_bytes_per_span": round(e2e_bytes_per_span, 0),
+        "e2e_host_cores": os.cpu_count(),
         "p50_graph_refresh_ms_10k_endpoints": round(refresh_ms, 2),
         "graph_refresh_target_ms": 50.0,
         "n_spans": N_SPANS,
@@ -271,8 +427,11 @@ def main() -> None:
         "tunnel_rtt_ms": round(rtt * 1000, 1),
         "packing_host_ms": round(packing_host_ms, 1),
         "timing_method": (
-            "median of fori_loop-chained kernel runs, scalar digest fetch, "
-            "rtt-adjusted; ingest includes per-window host packing"
+            "headline: median per-phase wall time of the raw-bytes->stats "
+            "path (native parse + intern + pack + device compute + scalar "
+            "fetch); the host->device copy over the dev tunnel is measured "
+            "and reported but not charged (PCIe on a real TPU VM); "
+            "device-chain extra: fori_loop-chained kernels, rtt-adjusted"
         ),
         "device": str(jax.devices()[0]),
     }

@@ -20,7 +20,7 @@ DEFAULT_LOOKBACK_MS = 86_400_000 * 7  # ZipkinService.ts:11
 DEFAULT_ROOT_SERVICE = "istio-ingressgateway.istio-system"  # ZipkinService.ts:48
 
 
-def _http_get_json(url: str, timeout: float):
+def _http_get_raw(url: str, timeout: float) -> bytes:
     request = urllib.request.Request(
         url,
         headers={"Accept": "application/json", "Accept-Encoding": "gzip"},
@@ -29,7 +29,11 @@ def _http_get_json(url: str, timeout: float):
         raw = response.read()
         if response.headers.get("Content-Encoding") == "gzip":
             raw = gzip.decompress(raw)
-    return json.loads(raw)
+    return raw
+
+
+def _http_get_json(url: str, timeout: float):
+    return json.loads(_http_get_raw(url, timeout))
 
 
 class ZipkinClient:
@@ -65,6 +69,32 @@ class ZipkinClient:
             logger.error("zipkin trace fetch failed: %s", err)
             return []
         return data if isinstance(data, list) else []
+
+    def get_trace_list_raw(
+        self,
+        look_back: float = DEFAULT_LOOKBACK_MS,
+        end_ts: Optional[float] = None,
+        limit: int = 100_000,
+        service_name: str = DEFAULT_ROOT_SERVICE,
+    ) -> Optional[bytes]:
+        """Same query as get_trace_list but returns the raw response bytes
+        for the native SoA loader (core.spans.raw_spans_to_batch), skipping
+        json.loads entirely. None on error."""
+        if end_ts is None:
+            end_ts = time.time() * 1000
+        query = urlencode(
+            {
+                "serviceName": service_name,
+                "endTs": int(end_ts),
+                "lookback": int(look_back),
+                "limit": limit,
+            }
+        )
+        try:
+            return _http_get_raw(f"{self._base}/traces?{query}", self._timeout)
+        except Exception as err:  # noqa: BLE001
+            logger.error("zipkin raw trace fetch failed: %s", err)
+            return None
 
     def get_services(self) -> List[str]:
         try:

@@ -28,6 +28,7 @@ _REPO_ROOT = Path(__file__).resolve().parent.parent.parent
 _SOURCES = [
     _REPO_ROOT / "native" / "kmamiz_native.cpp",
     _REPO_ROOT / "native" / "kmamiz_json.cpp",
+    _REPO_ROOT / "native" / "kmamiz_spans.cpp",
 ]
 _BUILD_DIR = _REPO_ROOT / "native" / "build"
 _LIB_PATH = _BUILD_DIR / "libkmamiz_native.so"
@@ -43,7 +44,7 @@ def _build() -> bool:
     _BUILD_DIR.mkdir(parents=True, exist_ok=True)
     cmd = [
         os.environ.get("CXX", "g++"),
-        "-O2",
+        "-O3",
         "-shared",
         "-fPIC",
         "-std=c++17",
@@ -100,6 +101,14 @@ def _open_and_bind() -> Optional[ctypes.CDLL]:
                 ctypes.POINTER(ctypes.c_size_t),
             ]
             fn.restype = ctypes.c_void_p
+        lib.km_parse_spans.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_size_t,
+            ctypes.c_char_p,
+            ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_size_t),
+        ]
+        lib.km_parse_spans.restype = ctypes.c_void_p
         lib.km_free.argtypes = [ctypes.c_void_p]
         lib.km_free.restype = None
         return lib
@@ -186,6 +195,134 @@ def parse_envoy_lines(lines: List[str]) -> Optional[List[dict]]:
             }
         )
     return records
+
+
+# ---------------------------------------------------------------------------
+# raw Zipkin JSON -> SoA span arrays (native/kmamiz_spans.cpp)
+# ---------------------------------------------------------------------------
+
+# naming-shape presence bits (must match kmamiz_spans.cpp)
+SHAPE_HAS_METHOD = 1 << 2
+SHAPE_HAS_SVC = 1 << 3
+SHAPE_HAS_NS = 1 << 4
+SHAPE_HAS_REV = 1 << 5
+SHAPE_HAS_MESH = 1 << 6
+
+
+def parse_spans(raw: bytes, skip_trace_ids: Sequence = ()) -> Optional[dict]:
+    """Scan a raw Zipkin JSON response ([[span,...],...]) into SoA arrays.
+
+    skip_trace_ids: already-processed trace ids (may contain None, matching
+    DataProcessor._filter_traces semantics); groups whose first span carries
+    one are dropped whole.
+
+    Returns None when the extension is unavailable or the input is
+    malformed (callers fall back to json.loads + spans_to_batch), else a
+    dict with numpy arrays (kind/parent_idx/shape_id/status_id/trace_of/
+    latency_ms/timestamp_us), the distinct naming shapes
+    [(fields7, url_present, presence_bits)], shape_max_ts_ms, distinct
+    status strings, and the kept trace ids (None markers preserved).
+    """
+    import numpy as np
+
+    lib = _load()
+    if lib is None:
+        return None
+    skip_blob = bytearray(struct.pack("<I", len(skip_trace_ids)))
+    for t in skip_trace_ids:
+        if t is None:
+            skip_blob += struct.pack("<BI", 0, 0)
+        else:
+            b = str(t).encode("utf-8", "surrogatepass")
+            skip_blob += struct.pack("<BI", 1, len(b))
+            skip_blob += b
+
+    out_len = ctypes.c_size_t(0)
+    # the json buffer crosses ctypes without a copy (c_char_p on bytes)
+    raw = bytes(raw) if not isinstance(raw, bytes) else raw
+    ptr = lib.km_parse_spans(
+        bytes(skip_blob), len(skip_blob), raw, len(raw), ctypes.byref(out_len)
+    )
+    if not ptr:
+        return None
+    try:
+        buf = ctypes.string_at(ptr, out_len.value)
+    finally:
+        lib.km_free(ptr)
+
+    try:
+        ok, n, n_shapes, n_statuses, n_groups = struct.unpack_from(
+            "<5I", buf, 0
+        )
+        if ok != 1:
+            return None
+        pos = 32
+        latency_ms = np.frombuffer(buf, np.float64, n, pos).copy()
+        pos += 8 * n
+        timestamp_raw = np.frombuffer(buf, np.float64, n, pos)
+        pos += 8 * n
+        shape_max_ts_ms = np.frombuffer(buf, np.float64, n_shapes, pos).copy()
+        pos += 8 * n_shapes
+        parent_idx = np.frombuffer(buf, np.int32, n, pos).copy()
+        pos += 4 * n
+        shape_id = np.frombuffer(buf, np.int32, n, pos).copy()
+        pos += 4 * n
+        status_id = np.frombuffer(buf, np.int32, n, pos).copy()
+        pos += 4 * n
+        trace_of = np.frombuffer(buf, np.int32, n, pos).copy()
+        pos += 4 * n
+        kind = np.frombuffer(buf, np.int8, n, pos).copy()
+        pos += n
+
+        shapes = []
+        for _ in range(n_shapes):
+            url_present = buf[pos] != 0
+            bits = buf[pos + 1]
+            pos += 2
+            fields = []
+            for _f in range(7):
+                (flen,) = struct.unpack_from("<I", buf, pos)
+                pos += 4
+                fields.append(
+                    buf[pos : pos + flen].decode("utf-8", "surrogatepass")
+                )
+                pos += flen
+            shapes.append((fields, url_present, bits))
+
+        statuses = []
+        for _ in range(n_statuses):
+            (slen,) = struct.unpack_from("<I", buf, pos)
+            pos += 4
+            statuses.append(buf[pos : pos + slen].decode("utf-8", "surrogatepass"))
+            pos += slen
+
+        trace_ids = []
+        for _ in range(n_groups):
+            present = buf[pos] != 0
+            (tlen,) = struct.unpack_from("<I", buf, pos + 1)
+            pos += 5
+            tid = buf[pos : pos + tlen].decode("utf-8", "surrogatepass")
+            pos += tlen
+            trace_ids.append(tid if present else None)
+    except (struct.error, IndexError, UnicodeDecodeError, ValueError):
+        # ValueError: np.frombuffer on a truncated buffer (stale .so ABI)
+        logger.warning("native span decode failed, using Python path")
+        return None
+
+    return {
+        "n_spans": int(n),
+        "kind": kind,
+        "parent_idx": parent_idx,
+        "shape_id": shape_id,
+        "status_id": status_id,
+        "trace_of": trace_of,
+        "latency_ms": latency_ms,
+        "timestamp_us": timestamp_raw.astype(np.int64),
+        "shapes": shapes,
+        "shape_max_ts_ms": shape_max_ts_ms,
+        "statuses": statuses,
+        "trace_ids": trace_ids,
+    }
 
 
 # ---------------------------------------------------------------------------

@@ -64,8 +64,27 @@ def make_handler(processor: DataProcessor):
                 raw = self.rfile.read(length)
                 if self.headers.get("Content-Encoding") == "gzip":
                     raw = gzip.decompress(raw)
-                request = json.loads(raw) if raw else {}
             except (ValueError, OSError) as e:
+                self._send_json(400, {"error": f"bad request: {e}"})
+                return
+
+            if self.path.split("?", 1)[0].rstrip("/") == "/ingest":
+                # uncapped raw ingest: body IS the Zipkin response bytes
+                try:
+                    summary = processor.ingest_raw_window(raw)
+                except ValueError as e:
+                    self._send_json(400, {"error": str(e)})
+                    return
+                except Exception as e:  # noqa: BLE001
+                    logger.exception("raw ingest failed")
+                    self._send_json(500, {"error": str(e)})
+                    return
+                self._send_json(200, summary)
+                return
+
+            try:
+                request = json.loads(raw) if raw else {}
+            except ValueError as e:
                 self._send_json(400, {"error": f"bad request: {e}"})
                 return
             try:

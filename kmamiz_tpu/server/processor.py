@@ -172,6 +172,54 @@ class DataProcessor:
             ),
         }
 
+    # -- uncapped raw ingest (VERDICT r1 #1) ---------------------------------
+
+    def ingest_raw_window(self, raw: bytes) -> dict:
+        """Raw Zipkin response bytes -> persistent device graph, uncapped.
+
+        The realtime tick (collect) honors the reference's 2,500-trace cap;
+        this is the scale path that lifts it: the native SoA loader
+        (native/kmamiz_spans.cpp) scans the bytes straight into device
+        arrays — no json.loads, no per-span dicts — applies the same
+        processed-trace dedup, and merges the window into the HBM edge
+        store serving the graph scorers. Feed it from
+        ZipkinClient.get_trace_list_raw (POST /ingest on the DP server).
+
+        Raises ValueError when the native loader is unavailable or the
+        payload is malformed (callers may fall back to collect)."""
+        from kmamiz_tpu.core.spans import raw_spans_to_batch
+
+        t_start = self._now_ms()
+        with step_timer.phase("raw_ingest_parse"):
+            out = raw_spans_to_batch(
+                raw,
+                interner=self.graph.interner,
+                skip_trace_ids=list(self._processed),
+            )
+        if out is None:
+            raise ValueError(
+                "native span loader unavailable or malformed payload"
+            )
+        batch, kept = out
+        for tid in kept:
+            self._processed[tid] = t_start
+        cutoff = t_start - PROCESSED_TRACE_TTL_MS
+        self._processed = {
+            k: v for k, v in self._processed.items() if v >= cutoff
+        }
+        if batch.n_spans:
+            with step_timer.phase("raw_ingest_graph"), profiling.trace(
+                "raw_ingest_graph"
+            ):
+                self.graph.merge_window(batch)
+        return {
+            "spans": batch.n_spans,
+            "traces": len(kept),
+            "endpoints": batch.num_endpoints,
+            "edges": int(self.graph.n_edges),
+            "ms": round(self._now_ms() - t_start, 1),
+        }
+
     # -- hybrid combine: device numeric stats + host body merge --------------
 
     def _combine(

@@ -220,8 +220,8 @@ class FileStore(MemoryStore):
             elif op == "delete":
                 for i in entry["ids"]:
                     self._data[collection].pop(i, None)
-            elif op == "clear":
-                self._data[collection] = {}
+            # (no "clear" op: clear_collection truncates the journal and
+            # swaps in an empty snapshot instead of journaling)
         if torn:
             # truncate NOW so later appends don't land after a bad line
             # and vanish on the following reload

@@ -1,0 +1,1140 @@
+// Raw Zipkin JSON -> SoA span arrays: the native ingest hot path.
+//
+// C++ twin of the per-span work in kmamiz_tpu/core/spans.py::spans_to_batch
+// and kmamiz_tpu/server/processor.py::_filter_traces, matching the role of
+// the reference's Rust deserialization stack
+// (/root/reference/kmamiz_data_processor/src/http_client/zipkin.rs:32-43 +
+// src/data/trace.rs:261-299). The Python path walks a dict per span
+// (~400k spans/s); this scanner walks the raw response bytes once and emits
+// fixed-width arrays plus small dedup tables, leaving only O(#endpoints)
+// string work (URL explode, interning) to Python -- which keeps naming
+// semantics byte-identical to the host implementation.
+//
+// Performance notes (single-core host next to the TPU tunnel): string
+// scanning rides glibc memchr (AVX2/512); keys dispatch on a
+// length-switch; integer JSON numbers take a no-strtod fast path; naming
+// shapes and statuses intern DURING the parse, with a rare fallback
+// recompute when duplicate span ids force last-wins overwrites (so tables
+// never contain values seen only in dead records, matching the JS Map
+// semantics of Traces.ts:119-126).
+//
+// Input payload (little-endian):
+//   u32 n_skip                     -- processed-trace dedup entries
+//   per entry: u8 present, u32 len, bytes   (present=0 encodes Python None)
+//   remaining bytes: the raw Zipkin JSON response [[span,...],...]
+//
+// Output buffer (km_free to release), all little-endian:
+//   header: u32 ok, u32 n_spans, u32 n_shapes, u32 n_statuses,
+//           u32 n_groups, u32 reserved x3          (32 bytes)
+//   f64 latency_ms[n_spans]
+//   f64 timestamp_us[n_spans]     -- raw JSON number (int64-cast in numpy)
+//   f64 shape_max_ts_ms[n_shapes]
+//   i32 parent_idx[n_spans]       -- resolved in-window, -1 = none
+//   i32 shape_id[n_spans]
+//   i32 status_id[n_spans]
+//   i32 trace_of[n_spans]         -- kept-group index (first-position wins)
+//   i8  kind[n_spans]             -- 0 other / 1 SERVER / 2 CLIENT
+//   shapes: per shape: u8 url_present, u8 field_present_bits, then 7
+//           fields (name, http.url, http.method, istio.canonical_service,
+//           istio.namespace, istio.canonical_revision, istio.mesh_id):
+//           u32 len + bytes each (missing fields emit len 0)
+//   statuses: per status: u32 len + bytes  (missing tag folded to "")
+//   kept trace ids: per group: u8 present, u32 len, bytes
+//
+// Semantics mirrored from the Python host path:
+// - span map: duplicate span ids keep their FIRST position (ordering,
+//   trace_of) with LAST-wins field values.
+// - group dedup: a group whose first span's traceId is in the skip set or
+//   already appeared in this response is dropped whole; empty groups drop
+//   without registering (DataProcessor._filter_traces).
+// - the naming-shape KEY folds a missing http.url with "" (the Python
+//   cache key defaults it), but whether the first-seen span actually had
+//   the tag is reported via url_present so the realtime-space naming
+//   (js_str(None) == "undefined") reproduces first-seen behavior.
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <memory>
+#include <string>
+#include <vector>
+
+namespace {
+
+using sv = std::string_view;
+
+// -- arena for decoded (escaped) strings ------------------------------------
+
+struct Arena {
+  std::vector<std::unique_ptr<char[]>> blocks;
+  size_t used = 0, cap = 0;
+  char* cur = nullptr;
+  char* alloc(size_t n) {
+    if (used + n > cap) {
+      size_t sz = n > (1u << 16) ? n : (1u << 16);
+      blocks.emplace_back(new char[sz]);
+      cur = blocks.back().get();
+      cap = sz;
+      used = 0;
+    }
+    char* p = cur + used;
+    used += n;
+    return p;
+  }
+};
+
+// word-at-a-time FNV variant (internal identity only; never serialized)
+inline uint64_t hash_sv(sv s) {
+  uint64_t h = 1469598103934665603ull ^ (s.size() * 0x9E3779B97F4A7C15ull);
+  const char* p = s.data();
+  size_t n = s.size();
+  while (n >= 8) {
+    uint64_t w;
+    std::memcpy(&w, p, 8);
+    h ^= w;
+    h *= 1099511628211ull;
+    p += 8;
+    n -= 8;
+  }
+  if (n) {
+    uint64_t w = 0;
+    std::memcpy(&w, p, n);
+    h ^= w;
+    h *= 1099511628211ull;
+  }
+  // avalanche (murmur3 fmix64): without it the table-mask bits depend only
+  // on the first bytes of each word and same-prefix keys probe O(n)
+  h ^= h >> 33;
+  h *= 0xff51afd7ed558ccdull;
+  h ^= h >> 33;
+  h *= 0xc4ceb9fe1a85ec53ull;
+  h ^= h >> 33;
+  return h;
+}
+
+// SWAR: bytes of `w` equal to `pat`-byte -> high bit set in result
+inline uint64_t swar_eq(uint64_t w, uint64_t pat) {
+  uint64_t x = w ^ pat;
+  return (x - 0x0101010101010101ull) & ~x & 0x8080808080808080ull;
+}
+
+constexpr uint64_t kQuotePat = 0x2222222222222222ull;   // '"'
+constexpr uint64_t kBslashPat = 0x5C5C5C5C5C5C5C5Cull;  // '\\'
+
+// -- open-addressing string_view -> int32 map -------------------------------
+// One packed 24-byte slot per entry (cached hash + ptr/len + value): a probe
+// costs one cache line, and equality checks compare the 64-bit hash before
+// touching key bytes. With ~1M span ids the table is ~50 MB of random
+// access, so slot locality is the dominant cost.
+
+struct SvMap {
+  struct Slot {
+    uint64_t hash;     // 0 = empty (hash_sv never returns 0; see intern)
+    const char* ptr;
+    uint32_t len;
+    int32_t val;
+  };
+  std::vector<Slot> slots;
+  size_t mask = 0, count = 0;
+
+  explicit SvMap(size_t initial = 64) {
+    size_t n = 16;
+    while (n < initial * 2) n <<= 1;
+    slots.assign(n, Slot{0, nullptr, 0, 0});
+    mask = n - 1;
+  }
+
+  static inline uint64_t key_hash(sv key) {
+    uint64_t h = hash_sv(key);
+    return h | 1;  // reserve 0 for empty slots
+  }
+
+  void grow() {
+    size_t n = (mask + 1) * 2;
+    std::vector<Slot> ns(n, Slot{0, nullptr, 0, 0});
+    for (size_t i = 0; i <= mask; ++i) {
+      if (!slots[i].hash) continue;
+      size_t j = slots[i].hash & (n - 1);
+      while (ns[j].hash) j = (j + 1) & (n - 1);
+      ns[j] = slots[i];
+    }
+    slots.swap(ns);
+    mask = n - 1;
+  }
+
+  static inline bool slot_eq(const Slot& s, uint64_t h, sv key) {
+    return s.hash == h && s.len == key.size() &&
+           std::memcmp(s.ptr, key.data(), key.size()) == 0;
+  }
+
+  int32_t* find(sv key) {
+    uint64_t h = key_hash(key);
+    size_t j = h & mask;
+    while (slots[j].hash) {
+      if (slot_eq(slots[j], h, key)) return &slots[j].val;
+      j = (j + 1) & mask;
+    }
+    return nullptr;
+  }
+
+  int32_t intern(sv key, int32_t next_val, bool* inserted) {
+    if (count * 2 >= mask) grow();
+    uint64_t h = key_hash(key);
+    size_t j = h & mask;
+    while (slots[j].hash) {
+      if (slot_eq(slots[j], h, key)) {
+        *inserted = false;
+        return slots[j].val;
+      }
+      j = (j + 1) & mask;
+    }
+    slots[j] = Slot{h, key.data(), static_cast<uint32_t>(key.size()), next_val};
+    ++count;
+    *inserted = true;
+    return next_val;
+  }
+};
+
+// -- naming shapes ----------------------------------------------------------
+
+// field order: name, url, method, svc, ns, rev, mesh
+constexpr int kShapeFields = 7;
+constexpr uint8_t kHasMethod = 1 << 2;
+constexpr uint8_t kHasSvc = 1 << 3;
+constexpr uint8_t kHasNs = 1 << 4;
+constexpr uint8_t kHasRev = 1 << 5;
+constexpr uint8_t kHasMesh = 1 << 6;
+constexpr uint8_t kKeyBits = kHasMethod | kHasSvc | kHasNs | kHasRev | kHasMesh;
+
+struct Shape {
+  sv f[kShapeFields];
+  uint8_t key_present = 0;  // optional-field presence (part of identity)
+  uint8_t url_present = 0;  // first-seen http.url presence (payload only)
+  double max_ts_ms = 0.0;
+  bool has_ts = false;
+};
+
+inline bool shape_eq(const Shape& a, const Shape& b) {
+  if (a.key_present != b.key_present) return false;
+  for (int i = 0; i < kShapeFields; ++i)
+    if (a.f[i] != b.f[i]) return false;
+  return true;
+}
+
+inline uint64_t shape_hash(const Shape& s) {
+  uint64_t h = 0x9e3779b97f4a7c15ull ^ s.key_present;
+  for (int i = 0; i < kShapeFields; ++i)
+    h ^= hash_sv(s.f[i]) + 0x9e3779b97f4a7c15ull + (h << 6) + (h >> 2);
+  return h;
+}
+
+struct ShapeTable {
+  std::vector<Shape> shapes;
+  std::vector<int32_t> slot_id;
+  std::vector<uint64_t> slot_hash;
+  size_t mask;
+
+  ShapeTable() : slot_id(256, -1), slot_hash(256, 0), mask(255) {}
+
+  void clear() {
+    shapes.clear();
+    std::fill(slot_id.begin(), slot_id.end(), -1);
+  }
+
+  void grow() {
+    size_t n = (mask + 1) * 2;
+    std::vector<int32_t> sid(n, -1);
+    std::vector<uint64_t> sh(n, 0);
+    for (size_t i = 0; i <= mask; ++i) {
+      if (slot_id[i] < 0) continue;
+      size_t j = slot_hash[i] & (n - 1);
+      while (sid[j] >= 0) j = (j + 1) & (n - 1);
+      sid[j] = slot_id[i];
+      sh[j] = slot_hash[i];
+    }
+    slot_id.swap(sid);
+    slot_hash.swap(sh);
+    mask = n - 1;
+  }
+
+  int32_t intern(const Shape& s) {
+    if (shapes.size() * 2 >= mask) grow();
+    uint64_t h = shape_hash(s);
+    size_t j = h & mask;
+    while (slot_id[j] >= 0) {
+      if (slot_hash[j] == h && shape_eq(shapes[slot_id[j]], s))
+        return slot_id[j];
+      j = (j + 1) & mask;
+    }
+    int32_t id = static_cast<int32_t>(shapes.size());
+    shapes.push_back(s);
+    slot_id[j] = id;
+    slot_hash[j] = h;
+    return id;
+  }
+};
+
+// -- JSON scanner -----------------------------------------------------------
+
+struct Scanner {
+  const char* p;
+  const char* end;
+  Arena* arena;
+  bool ok = true;
+
+  void ws() {
+    while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r'))
+      ++p;
+  }
+
+  bool eat(char c) {
+    ws();
+    if (p < end && *p == c) {
+      ++p;
+      return true;
+    }
+    ok = false;
+    return false;
+  }
+
+  bool peek(char c) {
+    ws();
+    return p < end && *p == c;
+  }
+
+  // first '"' or '\\' at/after q (SWAR word scan; no call overhead)
+  const char* scan_special(const char* q) const {
+    while (end - q >= 8) {
+      uint64_t w;
+      std::memcpy(&w, q, 8);
+      uint64_t m = swar_eq(w, kQuotePat) | swar_eq(w, kBslashPat);
+      if (m) return q + (__builtin_ctzll(m) >> 3);
+      q += 8;
+    }
+    while (q < end && *q != '"' && *q != '\\') ++q;
+    return q;  // == end when not found
+  }
+
+  // decoded string; zero-copy when escape-free (the common case)
+  sv str() {
+    ws();
+    if (p >= end || *p != '"') {
+      ok = false;
+      return {};
+    }
+    ++p;
+    const char* q = scan_special(p);
+    if (q >= end) {
+      ok = false;
+      return {};
+    }
+    if (*q == '"') {
+      sv out(p, static_cast<size_t>(q - p));
+      p = q + 1;
+      return out;
+    }
+    return str_slow();
+  }
+
+  // escape-bearing string decode; p sits just after the opening quote
+  sv str_slow() {
+    std::string buf;
+    while (p < end && *p != '"') {
+      if (*p != '\\') {
+        buf.push_back(*p++);
+        continue;
+      }
+      ++p;
+      if (p >= end) {
+        ok = false;
+        return {};
+      }
+      char c = *p++;
+      switch (c) {
+        case '"': buf.push_back('"'); break;
+        case '\\': buf.push_back('\\'); break;
+        case '/': buf.push_back('/'); break;
+        case 'b': buf.push_back('\b'); break;
+        case 'f': buf.push_back('\f'); break;
+        case 'n': buf.push_back('\n'); break;
+        case 'r': buf.push_back('\r'); break;
+        case 't': buf.push_back('\t'); break;
+        case 'u': {
+          auto hex4 = [&](const char* q) -> int {
+            int v = 0;
+            for (int i = 0; i < 4; ++i) {
+              char h = q[i];
+              v <<= 4;
+              if (h >= '0' && h <= '9') v |= h - '0';
+              else if (h >= 'a' && h <= 'f') v |= h - 'a' + 10;
+              else if (h >= 'A' && h <= 'F') v |= h - 'A' + 10;
+              else return -1;
+            }
+            return v;
+          };
+          if (end - p < 4) {
+            ok = false;
+            return {};
+          }
+          int cp = hex4(p);
+          if (cp < 0) {
+            ok = false;
+            return {};
+          }
+          p += 4;
+          if (cp >= 0xD800 && cp <= 0xDBFF && end - p >= 6 && p[0] == '\\' &&
+              p[1] == 'u') {
+            int lo = hex4(p + 2);
+            if (lo >= 0xDC00 && lo <= 0xDFFF) {
+              cp = 0x10000 + ((cp - 0xD800) << 10) + (lo - 0xDC00);
+              p += 6;
+            }
+          }
+          if (cp < 0x80) {
+            buf.push_back(static_cast<char>(cp));
+          } else if (cp < 0x800) {
+            buf.push_back(static_cast<char>(0xC0 | (cp >> 6)));
+            buf.push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+          } else if (cp < 0x10000) {
+            buf.push_back(static_cast<char>(0xE0 | (cp >> 12)));
+            buf.push_back(static_cast<char>(0x80 | ((cp >> 6) & 0x3F)));
+            buf.push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+          } else {
+            buf.push_back(static_cast<char>(0xF0 | (cp >> 18)));
+            buf.push_back(static_cast<char>(0x80 | ((cp >> 12) & 0x3F)));
+            buf.push_back(static_cast<char>(0x80 | ((cp >> 6) & 0x3F)));
+            buf.push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+          }
+          break;
+        }
+        default:
+          ok = false;
+          return {};
+      }
+    }
+    if (p >= end) {
+      ok = false;
+      return {};
+    }
+    ++p;
+    char* mem = arena->alloc(buf.size());
+    std::memcpy(mem, buf.data(), buf.size());
+    return sv(mem, buf.size());
+  }
+
+  // skip a string; assumes *p=='"'
+  void skip_string_raw() {
+    ++p;
+    for (;;) {
+      const char* q = scan_special(p);
+      if (q >= end) {
+        ok = false;
+        return;
+      }
+      if (*q == '"') {
+        p = q + 1;
+        return;
+      }
+      p = q + 2;  // backslash: skip the escaped character
+      if (p > end) {
+        ok = false;
+        return;
+      }
+    }
+  }
+
+  // skip a {...} or [...] wholesale; SWAR block scan for structural bytes.
+  // '{'/'[' and '}'/']' differ only in bit 5, so (w | 0x20..) needs two
+  // patterns; '"' matches on the raw word (0x02 false-positives fall
+  // through the switch harmlessly).
+  void skip_container() {
+    int depth = 0;
+    const char* q = p;
+    while (q < end) {
+      uint64_t m = 0;
+      while (end - q >= 8) {
+        uint64_t w;
+        std::memcpy(&w, q, 8);
+        uint64_t wl = w | 0x2020202020202020ull;
+        m = swar_eq(wl, 0x7B7B7B7B7B7B7B7Bull) |
+            swar_eq(wl, 0x7D7D7D7D7D7D7D7Dull) | swar_eq(w, kQuotePat);
+        if (m) break;
+        q += 8;
+      }
+      if (m) {
+        q += __builtin_ctzll(m) >> 3;
+      } else {
+        while (q < end && *q != '"' && *q != '{' && *q != '}' && *q != '[' &&
+               *q != ']')
+          ++q;
+        if (q >= end) break;
+      }
+      char c = *q;
+      switch (c) {
+        case '"':
+          p = q;
+          skip_string_raw();
+          if (!ok) return;
+          q = p;
+          break;
+        case '{':
+        case '[':
+          ++depth;
+          ++q;
+          break;
+        case '}':
+        case ']':
+          --depth;
+          ++q;
+          if (depth == 0) {
+            p = q;
+            return;
+          }
+          break;
+        default:
+          ++q;  // SWAR false positive (e.g. 0x02): not structural
+          break;
+      }
+    }
+    ok = false;
+  }
+
+  void skip_value() {
+    ws();
+    if (p >= end) {
+      ok = false;
+      return;
+    }
+    char c = *p;
+    if (c == '"') {
+      skip_string_raw();
+    } else if (c == '{' || c == '[') {
+      skip_container();
+    } else {
+      const char* start = p;
+      while (p < end && *p != ',' && *p != '}' && *p != ']' && *p != ' ' &&
+             *p != '\n' && *p != '\t' && *p != '\r')
+        ++p;
+      if (p == start) ok = false;  // empty value: malformed JSON
+    }
+  }
+
+  // JSON number -> double; plain integers avoid strtod
+  double number() {
+    ws();
+    const char* start = p;
+    bool neg = false;
+    if (p < end && *p == '-') {
+      neg = true;
+      ++p;
+    }
+    uint64_t acc = 0;
+    int digits = 0;
+    while (p < end && *p >= '0' && *p <= '9') {
+      acc = acc * 10 + static_cast<uint64_t>(*p - '0');
+      ++digits;
+      ++p;
+    }
+    if (digits > 0 && digits <= 18 &&
+        (p >= end || (*p != '.' && *p != 'e' && *p != 'E'))) {
+      double v = static_cast<double>(acc);
+      return neg ? -v : v;
+    }
+    // fractional / exponent / huge: defer to strtod
+    while (p < end &&
+           ((*p >= '0' && *p <= '9') || *p == '+' || *p == '-' || *p == '.' ||
+            *p == 'e' || *p == 'E'))
+      ++p;
+    if (p == start) {
+      ok = false;
+      return 0.0;
+    }
+    char tmp[64];
+    size_t len = static_cast<size_t>(p - start);
+    if (len >= sizeof(tmp)) len = sizeof(tmp) - 1;
+    std::memcpy(tmp, start, len);
+    tmp[len] = 0;
+    return std::strtod(tmp, nullptr);
+  }
+};
+
+// -- span records -----------------------------------------------------------
+
+struct SpanRec {
+  sv id, parent_id;
+  sv name, url, method, svc, ns, rev, mesh;
+  sv status;
+  uint8_t present = 0;
+  bool url_present = false;
+  bool status_present = false;
+  bool has_parent = false;
+  int8_t kind = 0;
+  double latency_ms = 0.0;
+  double timestamp_raw = 0.0;
+};
+
+// span/tag key handlers for the order-prediction fast path
+enum SpanKey : int8_t {
+  SK_OTHER = 0,
+  SK_ID,
+  SK_TRACE,
+  SK_PARENT,
+  SK_KIND,
+  SK_NAME,
+  SK_TS,
+  SK_DUR,
+  SK_TAGS,
+};
+enum TagKey : int8_t {
+  TK_OTHER = 0,
+  TK_URL,
+  TK_METHOD,
+  TK_STATUS,
+  TK_SVC,
+  TK_NS,
+  TK_REV,
+  TK_MESH,
+};
+
+// one predicted (key bytes, handler) slot per key position; spans from one
+// producer serialize keys in a fixed order, so after the first span nearly
+// every key resolves with a single memcmp instead of a scan +
+// length-switch. A miss tolerates one skipped slot (optional keys like
+// parentId), falling back to slow dispatch without corrupting the
+// learned sequence.
+struct KeyPredictor {
+  struct Entry {
+    sv key;
+    int8_t handler;
+  };
+  std::vector<Entry> seq;
+  size_t pos = 0;
+
+  void begin() { pos = 0; }
+
+  // try the predicted key at p (just after the opening '"'); advances p
+  // past `key"` on a hit and returns the handler, else returns -1
+  int predict(const char*& p, const char* end) {
+    for (size_t look = pos; look < pos + 2 && look < seq.size(); ++look) {
+      const Entry& e = seq[look];
+      size_t len = e.key.size();
+      if (static_cast<size_t>(end - p) > len && p[len] == '"' &&
+          std::memcmp(p, e.key.data(), len) == 0) {
+        pos = look + 1;
+        p += len + 1;
+        return e.handler;
+      }
+    }
+    return -1;
+  }
+
+  // append to the learned tail (only grows; misses elsewhere are fine)
+  void learn(sv key, int8_t handler) {
+    if (pos == seq.size()) {
+      seq.push_back(Entry{key, handler});
+      ++pos;
+    }
+  }
+};
+
+struct ParseResult {
+  std::vector<SpanRec> rows;
+  std::vector<int32_t> trace_of;
+  std::vector<int32_t> shape_id;   // valid when !had_duplicates
+  std::vector<int32_t> status_id;  // valid when !had_duplicates
+  ShapeTable shapes;
+  std::vector<sv> statuses;
+  std::vector<sv> kept_trace_ids;
+  std::vector<uint8_t> kept_trace_present;
+  SvMap span_index;  // final id -> first-position row
+  bool had_duplicates = false;
+  bool ok = false;
+
+  explicit ParseResult(size_t span_estimate)
+      : span_index(span_estimate + 64) {}
+};
+
+inline int8_t tag_handler(sv key) {
+  switch (key.size()) {
+    case 8: return key == "http.url" ? TK_URL : TK_OTHER;
+    case 11: return key == "http.method" ? TK_METHOD : TK_OTHER;
+    case 13: return key == "istio.mesh_id" ? TK_MESH : TK_OTHER;
+    case 15: return key == "istio.namespace" ? TK_NS : TK_OTHER;
+    case 16: return key == "http.status_code" ? TK_STATUS : TK_OTHER;
+    case 23: return key == "istio.canonical_service" ? TK_SVC : TK_OTHER;
+    case 24: return key == "istio.canonical_revision" ? TK_REV : TK_OTHER;
+    default: return TK_OTHER;
+  }
+}
+
+inline int8_t span_handler(sv key) {
+  switch (key.size()) {
+    case 2: return key == "id" ? SK_ID : SK_OTHER;
+    case 4:
+      if (key == "kind") return SK_KIND;
+      if (key == "name") return SK_NAME;
+      if (key == "tags") return SK_TAGS;
+      return SK_OTHER;
+    case 7: return key == "traceId" ? SK_TRACE : SK_OTHER;
+    case 8:
+      if (key == "parentId") return SK_PARENT;
+      if (key == "duration") return SK_DUR;
+      return SK_OTHER;
+    case 9: return key == "timestamp" ? SK_TS : SK_OTHER;
+    default: return SK_OTHER;
+  }
+}
+
+bool parse_tags(Scanner& s, SpanRec* rec, KeyPredictor& pred) {
+  if (!s.eat('{')) return false;
+  pred.begin();
+  bool first = true;
+  while (s.ok) {
+    s.ws();
+    if (s.peek('}')) {
+      ++s.p;
+      return true;
+    }
+    if (!first && !s.eat(',')) return false;
+    first = false;
+    s.ws();
+    if (s.p >= s.end || *s.p != '"') {
+      s.ok = false;
+      return false;
+    }
+    ++s.p;
+    int h = pred.predict(s.p, s.end);
+    if (h < 0) {
+      --s.p;
+      sv key = s.str();
+      if (!s.ok) return false;
+      h = tag_handler(key);
+      pred.learn(key, static_cast<int8_t>(h));
+    }
+    if (!s.eat(':')) return false;
+    s.ws();
+    if (s.p < s.end && *s.p != '"') {
+      s.skip_value();  // non-string tag: Zipkin tags are strings
+      continue;
+    }
+    switch (h) {
+      case TK_URL:
+        rec->url = s.str();
+        rec->url_present = true;
+        break;
+      case TK_METHOD:
+        rec->method = s.str();
+        rec->present |= kHasMethod;
+        break;
+      case TK_STATUS:
+        rec->status = s.str();
+        rec->status_present = true;
+        break;
+      case TK_SVC:
+        rec->svc = s.str();
+        rec->present |= kHasSvc;
+        break;
+      case TK_NS:
+        rec->ns = s.str();
+        rec->present |= kHasNs;
+        break;
+      case TK_REV:
+        rec->rev = s.str();
+        rec->present |= kHasRev;
+        break;
+      case TK_MESH:
+        rec->mesh = s.str();
+        rec->present |= kHasMesh;
+        break;
+      default:
+        s.skip_string_raw();
+        break;
+    }
+  }
+  return s.ok;
+}
+
+bool parse_span(Scanner& s, SpanRec* rec, KeyPredictor& span_pred,
+                KeyPredictor& tag_pred) {
+  if (!s.eat('{')) return false;
+  span_pred.begin();
+  bool first = true;
+  while (s.ok) {
+    s.ws();
+    if (s.peek('}')) {
+      ++s.p;
+      break;
+    }
+    if (!first && !s.eat(',')) return false;
+    first = false;
+    s.ws();
+    if (s.p >= s.end || *s.p != '"') {
+      s.ok = false;
+      return false;
+    }
+    ++s.p;
+    int h = span_pred.predict(s.p, s.end);
+    if (h < 0) {
+      --s.p;
+      sv key = s.str();
+      if (!s.ok) return false;
+      h = span_handler(key);
+      span_pred.learn(key, static_cast<int8_t>(h));
+    }
+    if (!s.eat(':')) return false;
+    switch (h) {
+      case SK_ID:
+        s.ws();
+        if (s.p < s.end && *s.p == '"') {
+          rec->id = s.str();
+          continue;
+        }
+        break;
+      case SK_KIND:
+        s.ws();
+        if (s.p < s.end && *s.p == '"') {
+          sv k = s.str();
+          rec->kind = (k == "SERVER") ? 1 : (k == "CLIENT") ? 2 : 0;
+          continue;
+        }
+        break;
+      case SK_NAME:
+        s.ws();
+        if (s.p < s.end && *s.p == '"') {
+          rec->name = s.str();
+          continue;
+        }
+        break;
+      case SK_TAGS:
+        s.ws();
+        if (s.p < s.end && *s.p == '{') {
+          if (!parse_tags(s, rec, tag_pred)) return false;
+          continue;
+        }
+        break;
+      case SK_PARENT:
+        s.ws();
+        if (s.p < s.end && *s.p == '"') {
+          rec->parent_id = s.str();
+          rec->has_parent = true;
+          continue;
+        }
+        break;
+      case SK_DUR:
+        rec->latency_ms = s.number() / 1000.0;
+        continue;
+      case SK_TS:
+        rec->timestamp_raw = s.number();
+        continue;
+      default:
+        break;
+    }
+    s.skip_value();
+  }
+  return s.ok;
+}
+
+// peek the first span object's traceId without consuming input
+bool peek_trace_id(Scanner probe, sv* out, bool* present) {
+  *present = false;
+  if (!probe.eat('{')) return false;
+  bool first = true;
+  while (probe.ok) {
+    probe.ws();
+    if (probe.peek('}')) return true;
+    if (!first && !probe.eat(',')) return false;
+    first = false;
+    sv key = probe.str();
+    if (!probe.eat(':')) return false;
+    if (key == "traceId") {
+      probe.ws();
+      if (probe.p < probe.end && *probe.p == '"') {
+        *out = probe.str();
+        *present = true;
+      }
+      return probe.ok;
+    }
+    probe.skip_value();
+  }
+  return probe.ok;
+}
+
+// sentinel for "traceId is Python None" in the seen-set
+const sv kNoneSentinel("\x01\x01\x01none", 7);
+
+ParseResult parse_all(const char* json, size_t json_len,
+                      const std::vector<std::pair<sv, bool>>& skip,
+                      Arena* arena) {
+  // presize the span-id index off the byte estimate: growing a ~50 MB
+  // table rehashes every id through random memory, costing more than the
+  // scan itself
+  ParseResult pr(json_len / 350);
+  Scanner s{json, json + json_len, arena};
+
+  SvMap seen(skip.size() + 64);
+  bool ins;
+  for (auto& e : skip)
+    seen.intern(e.second ? e.first : kNoneSentinel, 1, &ins);
+
+  SvMap status_map(64);
+  KeyPredictor span_pred, tag_pred;
+  // one-entry status memo: windows carry a handful of distinct statuses and
+  // runs of identical ones, so most spans skip the map probe entirely
+  sv last_status;
+  int32_t last_status_id = -1;
+  pr.rows.reserve(json_len / 400 + 16);
+  pr.trace_of.reserve(json_len / 400 + 16);
+  pr.shape_id.reserve(json_len / 400 + 16);
+  pr.status_id.reserve(json_len / 400 + 16);
+
+  if (!s.eat('[')) return pr;
+  bool first_group = true;
+  int32_t group_idx = 0;
+  while (s.ok) {
+    s.ws();
+    if (s.peek(']')) {
+      ++s.p;
+      break;
+    }
+    if (!first_group && !s.eat(',')) return pr;
+    first_group = false;
+    s.ws();
+    if (!s.peek('[')) return pr;
+    {
+      Scanner probe = s;
+      probe.eat('[');
+      probe.ws();
+      if (probe.peek(']')) {
+        ++probe.p;
+        s = probe;  // empty group: skipped, not registered
+        continue;
+      }
+    }
+    {
+      Scanner probe = s;
+      probe.eat('[');
+      sv tid;
+      bool tid_present = false;
+      if (!peek_trace_id(probe, &tid, &tid_present)) return pr;
+      sv seen_key = tid_present ? tid : kNoneSentinel;
+      if (seen.find(seen_key) != nullptr) {
+        s.skip_value();  // whole group already processed
+        continue;
+      }
+      seen.intern(seen_key, 1, &ins);
+      pr.kept_trace_ids.push_back(tid);
+      pr.kept_trace_present.push_back(tid_present ? 1 : 0);
+    }
+    s.eat('[');
+    bool first_span = true;
+    while (s.ok) {
+      s.ws();
+      if (s.peek(']')) {
+        ++s.p;
+        break;
+      }
+      if (!first_span && !s.eat(',')) return pr;
+      first_span = false;
+      SpanRec rec;
+      if (!parse_span(s, &rec, span_pred, tag_pred)) return pr;
+
+      int32_t next_row = static_cast<int32_t>(pr.rows.size());
+      int32_t row = pr.span_index.intern(rec.id, next_row, &ins);
+      if (!ins) {
+        pr.rows[row] = rec;  // last wins; first position kept
+        pr.had_duplicates = true;
+        continue;
+      }
+      pr.rows.push_back(rec);
+      pr.trace_of.push_back(group_idx);
+      pr.shape_id.push_back(0);
+      pr.status_id.push_back(0);
+      size_t r = static_cast<size_t>(next_row);
+      // intern shape + status inline (recomputed later if duplicates)
+      {
+        const SpanRec& rr = pr.rows[r];
+        Shape sh;
+        sh.f[0] = rr.name;
+        sh.f[1] = rr.url;
+        sh.f[2] = rr.method;
+        sh.f[3] = rr.svc;
+        sh.f[4] = rr.ns;
+        sh.f[5] = rr.rev;
+        sh.f[6] = rr.mesh;
+        sh.key_present = rr.present & kKeyBits;
+        sh.url_present = rr.url_present ? 1 : 0;
+        int32_t sid = pr.shapes.intern(sh);
+        pr.shape_id[r] = sid;
+        Shape& stored = pr.shapes.shapes[sid];
+        double ts_ms = rr.timestamp_raw / 1000.0;
+        if (!stored.has_ts || ts_ms > stored.max_ts_ms) {
+          stored.max_ts_ms = ts_ms;
+          stored.has_ts = true;
+        }
+        sv st = rr.status_present ? rr.status : sv("", 0);
+        int32_t stid;
+        if (last_status_id >= 0 && st == last_status) {
+          stid = last_status_id;
+        } else {
+          stid = status_map.intern(
+              st, static_cast<int32_t>(pr.statuses.size()), &ins);
+          if (ins) pr.statuses.push_back(st);
+          last_status = st;
+          last_status_id = stid;
+        }
+        pr.status_id[r] = stid;
+      }
+    }
+    ++group_idx;
+  }
+  pr.ok = s.ok;
+
+  if (pr.ok && pr.had_duplicates) {
+    // last-wins overwrites may have left shape/status tables holding
+    // values seen only in dead records; rebuild over the FINAL rows
+    pr.shapes.clear();
+    pr.statuses.clear();
+    SvMap rebuilt_status(64);
+    for (size_t i = 0; i < pr.rows.size(); ++i) {
+      const SpanRec& r = pr.rows[i];
+      Shape sh;
+      sh.f[0] = r.name;
+      sh.f[1] = r.url;
+      sh.f[2] = r.method;
+      sh.f[3] = r.svc;
+      sh.f[4] = r.ns;
+      sh.f[5] = r.rev;
+      sh.f[6] = r.mesh;
+      sh.key_present = r.present & kKeyBits;
+      sh.url_present = r.url_present ? 1 : 0;
+      int32_t sid = pr.shapes.intern(sh);
+      pr.shape_id[i] = sid;
+      Shape& stored = pr.shapes.shapes[sid];
+      double ts_ms = r.timestamp_raw / 1000.0;
+      if (!stored.has_ts || ts_ms > stored.max_ts_ms) {
+        stored.max_ts_ms = ts_ms;
+        stored.has_ts = true;
+      }
+      sv st = r.status_present ? r.status : sv("", 0);
+      int32_t stid = rebuilt_status.intern(
+          st, static_cast<int32_t>(pr.statuses.size()), &ins);
+      if (ins) pr.statuses.push_back(st);
+      pr.status_id[i] = stid;
+    }
+  }
+  return pr;
+}
+
+inline void put_u32(std::vector<uint8_t>& b, uint32_t v) {
+  b.push_back(v & 0xFF);
+  b.push_back((v >> 8) & 0xFF);
+  b.push_back((v >> 16) & 0xFF);
+  b.push_back((v >> 24) & 0xFF);
+}
+
+inline void put_sv(std::vector<uint8_t>& b, sv s) {
+  put_u32(b, static_cast<uint32_t>(s.size()));
+  b.insert(b.end(), s.begin(), s.end());
+}
+
+}  // namespace
+
+extern "C" {
+
+// skip_blob: u32 n_skip then per entry u8 present + u32 len + bytes.
+// json: the raw Zipkin response, passed separately so the (large) buffer
+// crosses the ctypes boundary without a copy.
+unsigned char* km_parse_spans(const char* skip_blob, size_t skip_len,
+                              const char* json, size_t json_len,
+                              size_t* out_len) {
+  *out_len = 0;
+  if (skip_len < 4) return nullptr;
+  const uint8_t* q = reinterpret_cast<const uint8_t*>(skip_blob);
+  uint32_t n_skip;
+  std::memcpy(&n_skip, q, 4);
+  size_t pos = 4;
+  std::vector<std::pair<sv, bool>> skip;
+  skip.reserve(n_skip);
+  for (uint32_t i = 0; i < n_skip; ++i) {
+    if (pos + 5 > skip_len) return nullptr;
+    bool present = q[pos] != 0;
+    uint32_t len;
+    std::memcpy(&len, q + pos + 1, 4);
+    pos += 5;
+    if (pos + len > skip_len) return nullptr;
+    skip.emplace_back(sv(skip_blob + pos, len), present);
+    pos += len;
+  }
+
+  Arena arena;
+  ParseResult pr = parse_all(json, json_len, skip, &arena);
+  if (!pr.ok) return nullptr;
+
+  size_t n = pr.rows.size();
+  // parent resolution against the final id->row index
+  std::vector<int32_t> parent_idx(n, -1);
+  for (size_t i = 0; i < n; ++i) {
+    if (!pr.rows[i].has_parent) continue;
+    int32_t* pi = pr.span_index.find(pr.rows[i].parent_id);
+    if (pi != nullptr) parent_idx[i] = *pi;
+  }
+
+  size_t n_shapes = pr.shapes.shapes.size();
+  std::vector<uint8_t> out;
+  out.reserve(32 + n * 29 + n_shapes * 8 + 64 * n_shapes +
+              16 * pr.statuses.size() + 24 * pr.kept_trace_ids.size());
+  put_u32(out, 1);  // ok
+  put_u32(out, static_cast<uint32_t>(n));
+  put_u32(out, static_cast<uint32_t>(n_shapes));
+  put_u32(out, static_cast<uint32_t>(pr.statuses.size()));
+  put_u32(out, static_cast<uint32_t>(pr.kept_trace_ids.size()));
+  put_u32(out, 0);
+  put_u32(out, 0);
+  put_u32(out, 0);
+
+  auto put_f64s = [&](auto&& get, size_t count) {
+    size_t at = out.size();
+    out.resize(at + count * 8);
+    for (size_t i = 0; i < count; ++i) {
+      double v = get(i);
+      std::memcpy(out.data() + at + i * 8, &v, 8);
+    }
+  };
+  auto put_i32s = [&](const int32_t* v, size_t count) {
+    size_t at = out.size();
+    out.resize(at + count * 4);
+    std::memcpy(out.data() + at, v, count * 4);
+  };
+
+  put_f64s([&](size_t i) { return pr.rows[i].latency_ms; }, n);
+  put_f64s([&](size_t i) { return pr.rows[i].timestamp_raw; }, n);
+  put_f64s([&](size_t i) { return pr.shapes.shapes[i].max_ts_ms; }, n_shapes);
+  put_i32s(parent_idx.data(), n);
+  put_i32s(pr.shape_id.data(), n);
+  put_i32s(pr.status_id.data(), n);
+  put_i32s(pr.trace_of.data(), n);
+  {
+    size_t at = out.size();
+    out.resize(at + n);
+    for (size_t i = 0; i < n; ++i)
+      out[at + i] = static_cast<uint8_t>(pr.rows[i].kind);
+  }
+  for (const Shape& sh : pr.shapes.shapes) {
+    out.push_back(sh.url_present);
+    out.push_back(sh.key_present);
+    for (int i = 0; i < kShapeFields; ++i) put_sv(out, sh.f[i]);
+  }
+  for (sv st : pr.statuses) put_sv(out, st);
+  for (size_t g = 0; g < pr.kept_trace_ids.size(); ++g) {
+    out.push_back(pr.kept_trace_present[g]);
+    put_sv(out, pr.kept_trace_ids[g]);
+  }
+
+  unsigned char* buf = static_cast<unsigned char*>(std::malloc(out.size()));
+  if (buf == nullptr) return nullptr;
+  std::memcpy(buf, out.data(), out.size());
+  *out_len = out.size();
+  return buf;
+}
+
+}  // extern "C"

@@ -1,20 +1,29 @@
 """Graph REST handler: dependency graphs, chords, charts, and scorers.
 
-Equivalent of /root/reference/src/handler/GraphService.ts. Every route is a
-cache read followed by a pure graph computation on the labeled dependency
-cache (the parity-exact host implementations). The device scorer kernels
-(kmamiz_tpu.ops.scorers over the DP process's resident EndpointGraph) serve
-the high-throughput path; this API process scores its cached view.
+Equivalent of /root/reference/src/handler/GraphService.ts. Graph views and
+charts are cache reads followed by pure host computations. The SCORER
+routes (cohesion / instability / coupling) are served from the device
+kernels (kmamiz_tpu.ops.scorers over the DP process's resident
+EndpointGraph) whenever the app embeds a DataProcessor — the device
+returns integer count arrays and the handler assembles the exact ratios in
+float64, so payloads match the host implementation bit-for-bit. The host
+path remains the parity oracle and the fallback (`?scorer=host`, no
+processor, empty graph, or any device error).
 """
 from __future__ import annotations
 
+import logging
 import math
-from typing import List, Optional
+from typing import Callable, List, Optional
+
+import numpy as np
 
 from kmamiz_tpu.api.router import IRequestHandler, Request, Response
 from kmamiz_tpu.domain.endpoint_data_type import EndpointDataType
 from kmamiz_tpu.domain.endpoint_dependencies import EndpointDependencies
 from kmamiz_tpu.server.initializer import AppContext
+
+logger = logging.getLogger("kmamiz_tpu.api.graph")
 
 
 class GraphHandler(IRequestHandler):
@@ -70,15 +79,28 @@ class GraphHandler(IRequestHandler):
         )
 
     def _cohesion(self, req: Request) -> Response:
-        return Response(payload=self.get_service_cohesion(req.params.get("namespace")))
+        return Response(
+            payload=self.get_service_cohesion(
+                req.params.get("namespace"),
+                force_host=req.query.get("scorer") == "host",
+            )
+        )
 
     def _instability(self, req: Request) -> Response:
         return Response(
-            payload=self.get_service_instability(req.params.get("namespace"))
+            payload=self.get_service_instability(
+                req.params.get("namespace"),
+                force_host=req.query.get("scorer") == "host",
+            )
         )
 
     def _coupling(self, req: Request) -> Response:
-        return Response(payload=self.get_service_coupling(req.params.get("namespace")))
+        return Response(
+            payload=self.get_service_coupling(
+                req.params.get("namespace"),
+                force_host=req.query.get("scorer") == "host",
+            )
+        )
 
     def _requests(self, req: Request) -> Response:
         return Response(
@@ -243,11 +265,101 @@ class GraphHandler(IRequestHandler):
         ]
 
     # -- scorers (GraphService.ts:294-379) -----------------------------------
+    # Served from the device graph when available (VERDICT r1 #2); the host
+    # implementations below each device method are the parity oracle and
+    # fallback.
 
-    def get_service_cohesion(self, namespace: Optional[str] = None) -> List[dict]:
-        dependencies = self._labeled_dependencies(namespace)
-        if not dependencies:
-            return []
+    def _device_graph(self):
+        proc = getattr(self._ctx, "processor", None)
+        graph = getattr(proc, "graph", None) if proc is not None else None
+        if graph is None or graph.n_edges == 0:
+            return None
+        # labels feed the device ml tables; drop them when the label map
+        # has refreshed since the last scorer call
+        label_map = self._ctx.cache.get("LabelMapping")
+        version = label_map.last_update if label_map is not None else None
+        if version != getattr(self, "_label_version", None):
+            graph.invalidate_labels()
+            self._label_version = version
+        return graph
+
+    def _label_of(self) -> Optional[Callable[[str], Optional[str]]]:
+        label_map = self._ctx.cache.get("LabelMapping")
+        if label_map is None:
+            return None
+        return label_map.get_label
+
+    @staticmethod
+    def _service_rows(graph, namespace):
+        """(sid, uniqueServiceName, display name) for active services in
+        the namespace, display-name sorted like every host scorer."""
+        active = graph.active_services()
+        rows = []
+        for sid in range(len(graph.interner.services)):
+            if sid >= len(active) or not active[sid]:
+                continue
+            usn = graph.interner.services.lookup(sid)
+            service, ns, version = (usn.split("\t") + ["", ""])[:3]
+            if namespace and ns != namespace:
+                continue
+            rows.append((sid, usn, f"{service}.{ns} ({version})"))
+        rows.sort(key=lambda r: r[2])
+        return rows
+
+    def _device_usage_cohesion(self, graph, namespace) -> List[dict]:
+        coh = graph.usage_cohesion(self._label_of())
+        total = np.asarray(coh.total_endpoints)
+        p_owner = np.asarray(coh.pair_owner)
+        p_consumer = np.asarray(coh.pair_consumer)
+        p_consumes = np.asarray(coh.pair_consumes)
+        p_valid = np.asarray(coh.pair_valid)
+        consumers_of: dict = {}
+        for i in np.nonzero(p_valid)[0]:
+            consumers_of.setdefault(int(p_owner[i]), []).append(
+                (int(p_consumer[i]), int(p_consumes[i]))
+            )
+        services = graph.interner.services
+        out = []
+        for sid, usn, _name in self._service_rows(graph, namespace):
+            consumers = [
+                {"uniqueServiceName": services.lookup(c), "consumes": n}
+                for c, n in consumers_of.get(sid, [])
+            ]
+            total_eps = int(total[sid]) if sid < len(total) else 0
+            # exact f64 ratio from integer counts (kernel floats are f32)
+            cohesion = 0.0
+            if total_eps and consumers:
+                cohesion = sum(
+                    c["consumes"] / total_eps for c in consumers
+                ) / len(consumers)
+            out.append(
+                {
+                    "uniqueServiceName": usn,
+                    "totalEndpoints": total_eps,
+                    "consumers": consumers,
+                    "endpointUsageCohesion": cohesion,
+                }
+            )
+        return out
+
+    def get_service_cohesion(
+        self, namespace: Optional[str] = None, force_host: bool = False
+    ) -> List[dict]:
+        graph = None if force_host else self._device_graph()
+        usage_cohesions: Optional[List[dict]] = None
+        if graph is not None:
+            try:
+                usage_cohesions = self._device_usage_cohesion(graph, namespace)
+            except Exception:  # noqa: BLE001 - host fallback
+                logger.exception("device cohesion failed; host fallback")
+
+        if usage_cohesions is None:
+            # host oracle path only: relabeling the whole record set is the
+            # exact cost the device offload avoids
+            dependencies = self._labeled_dependencies(namespace)
+            if not dependencies:
+                return []
+            usage_cohesions = dependencies.to_service_endpoint_cohesion()
 
         label_map = self._ctx.cache.get("LabelMapping")
         data_types = []
@@ -263,7 +375,6 @@ class GraphHandler(IRequestHandler):
             d["uniqueServiceName"]: d
             for d in EndpointDataType.get_service_cohesion(data_types)
         }
-        usage_cohesions = dependencies.to_service_endpoint_cohesion()
 
         results = []
         for u in usage_cohesions:
@@ -289,7 +400,32 @@ class GraphHandler(IRequestHandler):
             )
         return sorted(results, key=lambda r: r["name"])
 
-    def get_service_instability(self, namespace: Optional[str] = None) -> List[dict]:
+    def get_service_instability(
+        self, namespace: Optional[str] = None, force_host: bool = False
+    ) -> List[dict]:
+        graph = None if force_host else self._device_graph()
+        if graph is not None:
+            try:
+                scores = graph.service_scores(self._label_of())
+                on = np.asarray(scores.instability_on)
+                by = np.asarray(scores.instability_by)
+                out = []
+                for sid, usn, name in self._service_rows(graph, namespace):
+                    d_on, d_by = int(on[sid]), int(by[sid])
+                    total = d_on + d_by
+                    out.append(
+                        {
+                            "uniqueServiceName": usn,
+                            "name": name,
+                            "dependingBy": d_by,
+                            "dependingOn": d_on,
+                            # exact f64 ratio from the integer counts
+                            "instability": d_on / total if total else 0,
+                        }
+                    )
+                return out
+            except Exception:  # noqa: BLE001 - host fallback
+                logger.exception("device instability failed; host fallback")
         dependencies = self._labeled_dependencies(namespace)
         if not dependencies:
             return []
@@ -297,7 +433,30 @@ class GraphHandler(IRequestHandler):
             dependencies.to_service_instability(), key=lambda r: r["name"]
         )
 
-    def get_service_coupling(self, namespace: Optional[str] = None) -> List[dict]:
+    def get_service_coupling(
+        self, namespace: Optional[str] = None, force_host: bool = False
+    ) -> List[dict]:
+        graph = None if force_host else self._device_graph()
+        if graph is not None:
+            try:
+                scores = graph.service_scores(self._label_of())
+                ais = np.asarray(scores.ais)
+                ads = np.asarray(scores.ads)
+                out = []
+                for sid, usn, name in self._service_rows(graph, namespace):
+                    d_ais, d_ads = int(ais[sid]), int(ads[sid])
+                    out.append(
+                        {
+                            "uniqueServiceName": usn,
+                            "name": name,
+                            "ais": d_ais,
+                            "ads": d_ads,
+                            "acs": d_ais * d_ads,
+                        }
+                    )
+                return out
+            except Exception:  # noqa: BLE001 - host fallback
+                logger.exception("device coupling failed; host fallback")
         dependencies = self._labeled_dependencies(namespace)
         if not dependencies:
             return []

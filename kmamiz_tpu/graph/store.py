@@ -15,6 +15,7 @@ The reference's combineWith overwrites same-window duplicate records
 """
 from __future__ import annotations
 
+import threading
 from functools import partial
 from typing import Optional
 
@@ -112,6 +113,11 @@ class EndpointGraph:
         # per-endpoint host-side metadata, padded on demand
         self._ep_record = np.zeros(0, dtype=bool)
         self._ep_last_ts = np.zeros(0, dtype=np.float64)
+        # the DP tick mutates from a scheduler thread while API threads
+        # read scorers (handlers/graph.py); every state transition and
+        # snapshot happens under this reentrant lock. Device kernels run
+        # OUTSIDE the lock on immutable jnp snapshots.
+        self._lock = threading.RLock()
 
     # -- capacity management -------------------------------------------------
 
@@ -140,6 +146,10 @@ class EndpointGraph:
     def merge_window(self, batch: SpanBatch) -> None:
         """Union this window's dependency edges into the store and update
         per-endpoint record/last-usage metadata."""
+        with self._lock:
+            self._merge_window_locked(batch)
+
+    def _merge_window_locked(self, batch: SpanBatch) -> None:
         self._finalize_pending()
         packed = pack_trace_rows(
             batch.trace_of, batch.n_spans, batch.parent_idx
@@ -195,6 +205,10 @@ class EndpointGraph:
     def _finalize_pending(self) -> None:
         """Resolve the deferred merge: fetch the edge count and re-pad the
         merged arrays to the next power-of-2 capacity."""
+        with self._lock:
+            self._finalize_pending_locked()
+
+    def _finalize_pending_locked(self) -> None:
         pending = self._pending
         if pending is None:
             return
@@ -218,21 +232,28 @@ class EndpointGraph:
     # -- views ---------------------------------------------------------------
 
     def edge_arrays(self):
-        """(src_ep, dst_ep, dist, mask) views of the stored edges."""
-        self._finalize_pending()
-        mask = self._src != SENTINEL
-        return self._src, self._dst, self._dist, mask
+        """(src_ep, dst_ep, dist, mask) snapshot of the stored edges
+        (immutable jnp arrays: safe to use after the lock releases)."""
+        with self._lock:
+            self._finalize_pending_locked()
+            mask = self._src != SENTINEL
+            return self._src, self._dst, self._dist, mask
 
     def invalidate_labels(self) -> None:
         """Call when the label mapping changes; per-endpoint tables rebuild
         on the next scorer call."""
-        self._ep_tables_cache = None
+        with self._lock:
+            self._ep_tables_cache = None
 
     def _ep_tables(self, label_of=None):
         """Padded per-endpoint service/ml/record arrays (+ padded size).
 
         Cached between scorer calls — rebuilt only when the intern table or
         record set grows (or after invalidate_labels)."""
+        with self._lock:
+            return self._ep_tables_locked(label_of)
+
+    def _ep_tables_locked(self, label_of=None):
         n_ep = len(self.interner.endpoints)
         self._ensure_ep_arrays(n_ep)
         cache_key = (n_ep, int(self._ep_record[:n_ep].sum()), label_of is not None)
@@ -249,11 +270,27 @@ class EndpointGraph:
             name = self.interner.endpoints.lookup(eid)
             parts = name.split("\t")
             method = parts[3] if len(parts) > 3 else ""
-            label = label_of(name) if label_of else None
+            # without a label the endpoint is its own granularity (the
+            # reference's unlabeled view keys by the endpoint name); a
+            # label collapses same-(method, label) endpoints
+            label = (label_of(name) if label_of else None) or name
             ep_ml[eid] = self.ml_interner.intern(f"{method}\t{label}")
         result = (ep_service, ep_ml, ep_record, ep_cap)
         self._ep_tables_cache = (cache_key, result)
         return result
+
+    def _total_labeled_endpoints(self, ep_service, ep_ml, ep_record):
+        """Distinct (service, ml) record count per service, padded to the
+        service capacity (host numpy: O(#endpoints))."""
+        svc_cap = _pow2(max(len(self.interner.services), 1))
+        out = np.zeros(svc_cap, dtype=np.float32)
+        rec = ep_record.nonzero()[0]
+        if len(rec):
+            pairs = np.unique(
+                np.stack([ep_service[rec], ep_ml[rec]]), axis=1
+            )
+            np.add.at(out, pairs[0], 1.0)
+        return out
 
     # -- scorers -------------------------------------------------------------
 
@@ -272,25 +309,92 @@ class EndpointGraph:
             num_services=svc_cap,
         )
 
-    def usage_cohesion(self) -> scorer_ops.CohesionScores:
+    def usage_cohesion(self, label_of=None) -> scorer_ops.CohesionScores:
         src, dst, dist, mask = self.edge_arrays()
-        ep_service, _, ep_record, _ = self._ep_tables()
+        ep_service, ep_ml, ep_record, _ = self._ep_tables(label_of)
         svc_cap = _pow2(max(len(self.interner.services), 1))
+        total = self._total_labeled_endpoints(ep_service, ep_ml, ep_record)
         return scorer_ops.usage_cohesion(
             src,
             dst,
             dist,
             mask,
             jnp.asarray(ep_service),
-            jnp.asarray(ep_record),
+            jnp.asarray(ep_ml),
+            jnp.asarray(total),
             num_services=svc_cap,
         )
 
+    # -- warm start from the persisted dependency cache ----------------------
+
+    def load_dependencies(self, records) -> None:
+        """Rebuild the device edge store from cached dependency records
+        (the persisted EndpointDependencies JSON): after a restart the
+        process-lifetime graph is empty while the cache was restored from
+        storage, so the API's device scorer path warm-starts from it.
+        Records' dependingOn/dependingBy entries become (src, dst, dist)
+        edges; every record endpoint is marked as a record holder."""
+        with self._lock:
+            self._load_dependencies_locked(records)
+
+    def _load_dependencies_locked(self, records) -> None:
+        src_l, dst_l, dist_l = [], [], []
+        for r in records:
+            info = r.get("endpoint", {})
+            uen = info.get("uniqueEndpointName")
+            if uen is None:
+                continue
+            eid = self.interner.intern_endpoint(uen, info)
+            for d in r.get("dependingOn", []):
+                dep_info = d.get("endpoint", {})
+                dep_uen = dep_info.get("uniqueEndpointName")
+                if dep_uen is None:
+                    continue
+                dep_id = self.interner.intern_endpoint(dep_uen, dep_info)
+                src_l.append(eid)
+                dst_l.append(dep_id)
+                dist_l.append(d.get("distance", 1))
+            for d in r.get("dependingBy", []):
+                dep_info = d.get("endpoint", {})
+                dep_uen = dep_info.get("uniqueEndpointName")
+                if dep_uen is None:
+                    continue
+                dep_id = self.interner.intern_endpoint(dep_uen, dep_info)
+                src_l.append(dep_id)
+                dst_l.append(eid)
+                dist_l.append(d.get("distance", 1))
+            n_ep = len(self.interner.endpoints)
+            self._ensure_ep_arrays(n_ep)
+            self._ep_record[eid] = True
+        if not src_l:
+            return
+        self._finalize_pending()
+        cap = _pow2(len(src_l))
+        src = np.full(cap, SENTINEL, dtype=np.int32)
+        dst = np.full(cap, SENTINEL, dtype=np.int32)
+        dist = np.full(cap, SENTINEL, dtype=np.int32)
+        src[: len(src_l)] = src_l
+        dst[: len(dst_l)] = dst_l
+        dist[: len(dist_l)] = dist_l
+        s, d, ds, v = _merge_edges(
+            self._src,
+            self._dst,
+            self._dist,
+            self._src != SENTINEL,
+            jnp.asarray(src),
+            jnp.asarray(dst),
+            jnp.asarray(dist),
+            jnp.asarray(src != SENTINEL),
+        )
+        self._pending = (s, d, ds, v.sum())
+        self.invalidate_labels()
+
     def active_services(self) -> np.ndarray:
         """bool[num_services]: services owning at least one endpoint record."""
-        n_ep = len(self.interner.endpoints)
-        self._ensure_ep_arrays(n_ep)
-        out = np.zeros(len(self.interner.services), dtype=bool)
+        with self._lock:
+            n_ep = len(self.interner.endpoints)
+            self._ensure_ep_arrays(n_ep)
+            out = np.zeros(len(self.interner.services), dtype=bool)
         for eid in range(n_ep):
             if self._ep_record[eid]:
                 out[self.interner.service_of(eid)] = True

@@ -184,9 +184,16 @@ def service_scores(
 
 
 class CohesionScores(NamedTuple):
-    total_endpoints: jnp.ndarray  # endpoint records per service
+    total_endpoints: jnp.ndarray  # distinct (label-collapsed) records/service
     consumer_count: jnp.ndarray  # distinct consumer services
     usage_cohesion: jnp.ndarray  # SIUC
+    # (owner, consumer, consumes) pair table for the HTTP payload's
+    # `consumers` list; rows where pair_valid, order lexsorted by
+    # (owner, consumer) — the reference emits insertion order instead
+    pair_owner: jnp.ndarray
+    pair_consumer: jnp.ndarray
+    pair_consumes: jnp.ndarray
+    pair_valid: jnp.ndarray
 
 
 @partial(jax.jit, static_argnames=("num_services",))
@@ -196,28 +203,30 @@ def usage_cohesion(
     dist: jnp.ndarray,
     mask: jnp.ndarray,
     ep_service: jnp.ndarray,
-    ep_has_record: jnp.ndarray,
+    ep_ml: jnp.ndarray,
+    total_endpoints: jnp.ndarray,
     num_services: int,
 ) -> CohesionScores:
     """SIUC: for each service, average over consumer services of
-    (distinct endpoints consumed / total endpoint records)."""
-    park = num_services
-    total_endpoints = jax.ops.segment_sum(
-        ep_has_record.astype(jnp.float32),
-        jnp.where(ep_has_record, ep_service, park),
-        num_segments=park + 1,
-    )[:-1]
+    (distinct endpoints consumed / total endpoint records).
 
-    # distance-1 by-edges: consumer = svc[src], consumed endpoint = dst.
-    # ONE sort keyed (owner, consumer, consumed_ep): identical
-    # (consumer, ep) pairs share their owner (owner = svc[ep]), so pair
+    Endpoint distinctness is by ep_ml (method+label intern id), so
+    endpoints sharing a label collapse exactly like the reference's labeled
+    view; total_endpoints is the matching distinct-(service, ml) record
+    count per service, computed host-side from the intern tables."""
+    park = num_services
+
+    # distance-1 by-edges: consumer = svc[src], consumed = (owner, ml[dst]).
+    # ONE sort keyed (owner, consumer, consumed_ml): identical
+    # (consumer, ml) pairs share their owner (owner = svc[ep]), so pair
     # distincts are full-row boundaries and (owner, consumer) groups are
     # prefix boundaries of the same order — no second lexsort.
     d1 = mask & (dist == 1)
     consumer = ep_service[jnp.maximum(src_ep, 0)]
     owner = ep_service[jnp.maximum(dst_ep, 0)]
-    (g_owner, g_consumer, _g_ep), pair_first = lex_unique(
-        (owner, consumer, dst_ep), d1
+    dst_ml = ep_ml[jnp.maximum(dst_ep, 0)]
+    (g_owner, g_consumer, _g_ml), pair_first = lex_unique(
+        (owner, consumer, dst_ml), d1
     )
     row_valid = g_owner != SENTINEL
     group_first = (
@@ -239,9 +248,10 @@ def usage_cohesion(
         num_segments=cap,
     )
     owner_total = total_endpoints[jnp.minimum(g_owner, park - 1)]
+    consumes_at_first = pair_counts[jnp.maximum(group_gid, 0)]
     frac = jnp.where(
         group_first & (owner_total > 0),
-        pair_counts[jnp.maximum(group_gid, 0)] / jnp.maximum(owner_total, 1),
+        consumes_at_first / jnp.maximum(owner_total, 1),
         0.0,
     )
     pair_owner_seg = jnp.where(group_first, g_owner, park)
@@ -256,6 +266,10 @@ def usage_cohesion(
         total_endpoints=total_endpoints,
         consumer_count=consumer_count,
         usage_cohesion=cohesion,
+        pair_owner=jnp.where(group_first, g_owner, SENTINEL),
+        pair_consumer=jnp.where(group_first, g_consumer, SENTINEL),
+        pair_consumes=consumes_at_first,
+        pair_valid=group_first,
     )
 
 

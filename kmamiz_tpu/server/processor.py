@@ -17,6 +17,7 @@ graph scorers.
 """
 from __future__ import annotations
 
+import threading
 import time
 from typing import Callable, Dict, List, Optional, Sequence
 
@@ -66,26 +67,31 @@ class DataProcessor:
         self._use_device_stats = use_device_stats
         self._now_ms = now_ms
         self._processed: Dict[str, float] = {}
+        # collect() runs on the scheduler/DP thread while /ingest backfills
+        # arrive on other server threads; dedup-map transitions serialize
+        # here (the graph store carries its own lock)
+        self._dedup_lock = threading.Lock()
         self.graph = EndpointGraph()
 
     # -- trace dedup (data_processor.rs:30-73) -------------------------------
 
     def _filter_traces(self, traces: List[List[dict]], request_time: float):
-        kept = []
-        for group in traces:
-            if not group:
-                continue
-            trace_id = group[0].get("traceId")
-            if trace_id in self._processed:
-                continue
-            self._processed[trace_id] = request_time
-            kept.append(group)
-        # TTL cleanup
-        cutoff = request_time - PROCESSED_TRACE_TTL_MS
-        self._processed = {
-            k: v for k, v in self._processed.items() if v >= cutoff
-        }
-        return kept
+        with self._dedup_lock:
+            kept = []
+            for group in traces:
+                if not group:
+                    continue
+                trace_id = group[0].get("traceId")
+                if trace_id in self._processed:
+                    continue
+                self._processed[trace_id] = request_time
+                kept.append(group)
+            # TTL cleanup
+            cutoff = request_time - PROCESSED_TRACE_TTL_MS
+            self._processed = {
+                k: v for k, v in self._processed.items() if v >= cutoff
+            }
+            return kept
 
     # -- the tick ------------------------------------------------------------
 
@@ -190,23 +196,30 @@ class DataProcessor:
         from kmamiz_tpu.core.spans import raw_spans_to_batch
 
         t_start = self._now_ms()
+        with self._dedup_lock:
+            skip = list(self._processed)
         with step_timer.phase("raw_ingest_parse"):
             out = raw_spans_to_batch(
                 raw,
                 interner=self.graph.interner,
-                skip_trace_ids=list(self._processed),
+                skip_trace_ids=skip,
             )
         if out is None:
             raise ValueError(
                 "native span loader unavailable or malformed payload"
             )
         batch, kept = out
-        for tid in kept:
-            self._processed[tid] = t_start
-        cutoff = t_start - PROCESSED_TRACE_TTL_MS
-        self._processed = {
-            k: v for k, v in self._processed.items() if v >= cutoff
-        }
+        # the snapshot above is taken before the (long) parse: a trace that
+        # a concurrent collect() processes in between is merged twice —
+        # benign for the set-union edge store — but registrations are never
+        # lost to a concurrent dict rebuild
+        with self._dedup_lock:
+            for tid in kept:
+                self._processed[tid] = t_start
+            cutoff = t_start - PROCESSED_TRACE_TTL_MS
+            self._processed = {
+                k: v for k, v in self._processed.items() if v >= cutoff
+            }
         if batch.n_spans:
             with step_timer.phase("raw_ingest_graph"), profiling.trace(
                 "raw_ingest_graph"

@@ -127,6 +127,59 @@ class TestGraphRoutes:
         )
         assert {"ais", "ads", "acs"} <= set(coupling.payload[0])
 
+    def test_scorer_routes_device_equals_host(self, router, ctx):
+        """The scorer routes are served from the device graph (VERDICT r1
+        #2); `?scorer=host` forces the host oracle — payloads must match
+        exactly (consumers list order excepted: the device emits it
+        lexsorted, the host in insertion order)."""
+        assert ctx.processor.graph.n_edges > 0  # device path is live
+
+        # prove the device path serves the default route: a poisoned host
+        # cache would change the host answer but not the device one
+        calls = {"n": 0}
+        orig = ctx.processor.graph.service_scores
+
+        def spy(*a, **k):
+            calls["n"] += 1
+            return orig(*a, **k)
+
+        ctx.processor.graph.service_scores = spy
+        try:
+            for route in ("instability", "coupling"):
+                dev = get(router, f"/api/v1/graph/{route}")
+                host = get(router, f"/api/v1/graph/{route}?scorer=host")
+                assert dev.status == host.status == 200
+                assert dev.payload == host.payload, route
+            assert calls["n"] == 2
+        finally:
+            ctx.processor.graph.service_scores = orig
+
+        dev = get(router, "/api/v1/graph/cohesion")
+        host = get(router, "/api/v1/graph/cohesion?scorer=host")
+        assert dev.status == host.status == 200
+
+        def canon(payload):
+            return [
+                {
+                    **row,
+                    "consumers": sorted(
+                        row["consumers"], key=lambda c: c["uniqueServiceName"]
+                    ),
+                }
+                for row in payload
+            ]
+
+        assert canon(dev.payload) == canon(host.payload)
+
+    def test_scorer_routes_device_namespace_filter(self, router, ctx):
+        dev = get(router, "/api/v1/graph/instability/pdas")
+        host = get(router, "/api/v1/graph/instability/pdas?scorer=host")
+        assert dev.payload == host.payload
+        assert dev.payload  # pdas services present
+        assert all("\tpdas\t" in r["uniqueServiceName"] for r in dev.payload)
+        none = get(router, "/api/v1/graph/instability/nope")
+        assert none.payload == []
+
     def test_request_chart(self, router, ctx):
         svc = ctx.cache.get("CombinedRealtimeData").get_data().to_json()[0][
             "uniqueServiceName"

@@ -106,6 +106,47 @@ def test_incremental_merge_is_union(pdas_traces, bookinfo_traces):
     )
 
 
+def test_load_dependencies_warm_start(bookinfo_traces):
+    """Restart path: a graph rebuilt from the persisted dependency-cache
+    JSON must carry the same edges and scores as one built from spans."""
+    from kmamiz_tpu.domain.endpoint_dependencies import EndpointDependencies
+
+    batch = spans_to_batch(bookinfo_traces)
+    from_spans = EndpointGraph(interner=batch.interner)
+    from_spans.merge_window(batch)
+
+    raw = Traces(bookinfo_traces).to_endpoint_dependencies()
+    deps = EndpointDependencies([])
+    for record in raw.to_json():
+        deps = deps.combine_with(EndpointDependencies([record]))
+
+    warmed = EndpointGraph()
+    warmed.load_dependencies(deps.to_json())
+
+    def named_edges(g):
+        s, d, dist, m = (np.asarray(x) for x in g.edge_arrays())
+        look = g.interner.endpoints.lookup
+        return {
+            (look(int(a)), look(int(b)), int(c))
+            for a, b, c in zip(s[m], d[m], dist[m])
+        }
+
+    assert named_edges(warmed) == named_edges(from_spans)
+
+    def scores_by_name(g):
+        s = g.service_scores()
+        inst = np.asarray(s.instability)
+        acs = np.asarray(s.acs)
+        active = g.active_services()
+        return {
+            g.interner.services.lookup(sid): (float(inst[sid]), float(acs[sid]))
+            for sid in range(len(g.interner.services))
+            if sid < len(active) and active[sid]
+        }
+
+    assert scores_by_name(warmed) == scores_by_name(from_spans)
+
+
 def test_risk_scores_shape(pdas_traces):
     batch, graph = build_graph([pdas_traces])
     scores = graph.service_scores()

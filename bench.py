@@ -295,8 +295,7 @@ def main() -> None:
                 dist,
                 emask,
                 ep_service,
-                ep_ml,
-                req_count,  # stand-in per-service record totals
+                ep_record,
                 num_services=N_SERVICES,
             )
             risk = scorers.risk_scores(
@@ -372,7 +371,7 @@ def main() -> None:
 
         http_api_refresh_ms = _timed(http_get, reps=5) * 1000
     finally:
-        api._server.shutdown()
+        api.stop()
 
     # ---- end-to-end DP tick at the reference's own scale -------------------
     # the reference caps realtime ticks at 2,500 traces / 5 s; this times the

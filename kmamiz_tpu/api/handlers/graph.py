@@ -307,7 +307,9 @@ class GraphHandler(IRequestHandler):
         return rows
 
     def _device_usage_cohesion(self, graph, namespace) -> List[dict]:
-        coh = graph.usage_cohesion(self._label_of())
+        # raw endpoint granularity: the reference's labeled view never
+        # merges records for cohesion (EndpointDependencies.ts:565-612)
+        coh = graph.usage_cohesion()
         total = np.asarray(coh.total_endpoints)
         p_owner = np.asarray(coh.pair_owner)
         p_consumer = np.asarray(coh.pair_consumer)

@@ -279,25 +279,47 @@ class EndpointGraph:
         self._ep_tables_cache = (cache_key, result)
         return result
 
-    def _total_labeled_endpoints(self, ep_service, ep_ml, ep_record):
-        """Distinct (service, ml) record count per service, padded to the
-        service capacity (host numpy: O(#endpoints))."""
-        svc_cap = _pow2(max(len(self.interner.services), 1))
-        out = np.zeros(svc_cap, dtype=np.float32)
-        rec = ep_record.nonzero()[0]
-        if len(rec):
-            pairs = np.unique(
-                np.stack([ep_service[rec], ep_ml[rec]]), axis=1
-            )
-            np.add.at(out, pairs[0], 1.0)
-        return out
-
     # -- scorers -------------------------------------------------------------
 
-    def service_scores(self, label_of=None) -> scorer_ops.ServiceScores:
+    def _fresh_mask(self, ep_cap: int, now_ms=None) -> np.ndarray:
+        """bool[ep_cap]: endpoints whose last usage is within the
+        deprecated-endpoint threshold (EndpointDependencies.ts:44-74; the
+        host path prunes stale records AND links to them — the device twin
+        masks the same endpoints out of records and edges). All-True when
+        the threshold is unset."""
+        from kmamiz_tpu.config import parse_threshold_ms, settings
+
+        fresh = np.ones(ep_cap, dtype=bool)
+        deprecated_ms = parse_threshold_ms(settings.deprecated_endpoint_threshold)
+        if deprecated_ms:
+            import time as _time
+
+            cutoff = (now_ms if now_ms is not None else _time.time() * 1000) - deprecated_ms
+            n_ep = len(self.interner.endpoints)
+            with self._lock:
+                self._ensure_ep_arrays(n_ep)
+                fresh[:n_ep] = self._ep_last_ts[:n_ep] >= cutoff
+        return fresh
+
+    def _scorer_inputs(self, label_of=None, now_ms=None):
         src, dst, dist, mask = self.edge_arrays()
-        ep_service, ep_ml, ep_record, _ = self._ep_tables(label_of)
+        ep_service, ep_ml, ep_record, ep_cap = self._ep_tables(label_of)
+        fresh = self._fresh_mask(ep_cap, now_ms)
+        if not fresh.all():
+            fresh_j = jnp.asarray(fresh)
+            mask = (
+                mask
+                & fresh_j[jnp.clip(src, 0, ep_cap - 1)]
+                & fresh_j[jnp.clip(dst, 0, ep_cap - 1)]
+            )
+            ep_record = ep_record & fresh
         svc_cap = _pow2(max(len(self.interner.services), 1))
+        return src, dst, dist, mask, ep_service, ep_ml, ep_record, svc_cap
+
+    def service_scores(self, label_of=None, now_ms=None) -> scorer_ops.ServiceScores:
+        src, dst, dist, mask, ep_service, ep_ml, ep_record, svc_cap = (
+            self._scorer_inputs(label_of, now_ms)
+        )
         return scorer_ops.service_scores(
             src,
             dst,
@@ -309,19 +331,17 @@ class EndpointGraph:
             num_services=svc_cap,
         )
 
-    def usage_cohesion(self, label_of=None) -> scorer_ops.CohesionScores:
-        src, dst, dist, mask = self.edge_arrays()
-        ep_service, ep_ml, ep_record, _ = self._ep_tables(label_of)
-        svc_cap = _pow2(max(len(self.interner.services), 1))
-        total = self._total_labeled_endpoints(ep_service, ep_ml, ep_record)
+    def usage_cohesion(self, now_ms=None) -> scorer_ops.CohesionScores:
+        src, dst, dist, mask, ep_service, _ep_ml, ep_record, svc_cap = (
+            self._scorer_inputs(None, now_ms)
+        )
         return scorer_ops.usage_cohesion(
             src,
             dst,
             dist,
             mask,
             jnp.asarray(ep_service),
-            jnp.asarray(ep_ml),
-            jnp.asarray(total),
+            jnp.asarray(ep_record),
             num_services=svc_cap,
         )
 
@@ -366,6 +386,8 @@ class EndpointGraph:
             n_ep = len(self.interner.endpoints)
             self._ensure_ep_arrays(n_ep)
             self._ep_record[eid] = True
+            last_used = r.get("lastUsageTimestamp") or info.get("timestamp") or 0
+            self._ep_last_ts[eid] = max(self._ep_last_ts[eid], last_used)
         if not src_l:
             return
         self._finalize_pending()
@@ -389,13 +411,15 @@ class EndpointGraph:
         self._pending = (s, d, ds, v.sum())
         self.invalidate_labels()
 
-    def active_services(self) -> np.ndarray:
-        """bool[num_services]: services owning at least one endpoint record."""
+    def active_services(self, now_ms=None) -> np.ndarray:
+        """bool[num_services]: services owning at least one non-deprecated
+        endpoint record."""
         with self._lock:
             n_ep = len(self.interner.endpoints)
             self._ensure_ep_arrays(n_ep)
+            fresh = self._fresh_mask(_pow2(max(n_ep, 1)), now_ms)
             out = np.zeros(len(self.interner.services), dtype=bool)
-        for eid in range(n_ep):
-            if self._ep_record[eid]:
-                out[self.interner.service_of(eid)] = True
-        return out
+            for eid in range(n_ep):
+                if self._ep_record[eid] and fresh[eid]:
+                    out[self.interner.service_of(eid)] = True
+            return out

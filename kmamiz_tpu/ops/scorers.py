@@ -184,7 +184,7 @@ def service_scores(
 
 
 class CohesionScores(NamedTuple):
-    total_endpoints: jnp.ndarray  # distinct (label-collapsed) records/service
+    total_endpoints: jnp.ndarray  # endpoint records per service
     consumer_count: jnp.ndarray  # distinct consumer services
     usage_cohesion: jnp.ndarray  # SIUC
     # (owner, consumer, consumes) pair table for the HTTP payload's
@@ -203,30 +203,33 @@ def usage_cohesion(
     dist: jnp.ndarray,
     mask: jnp.ndarray,
     ep_service: jnp.ndarray,
-    ep_ml: jnp.ndarray,
-    total_endpoints: jnp.ndarray,
+    ep_has_record: jnp.ndarray,
     num_services: int,
 ) -> CohesionScores:
     """SIUC: for each service, average over consumer services of
     (distinct endpoints consumed / total endpoint records).
 
-    Endpoint distinctness is by ep_ml (method+label intern id), so
-    endpoints sharing a label collapse exactly like the reference's labeled
-    view; total_endpoints is the matching distinct-(service, ml) record
-    count per service, computed host-side from the intern tables."""
+    Distinctness is by RAW endpoint id: the reference's labeled view only
+    decorates records with labelName — toServiceEndpointCohesion counts
+    uniqueEndpointNames (EndpointDependencies.ts:565-612) — so label
+    collapsing must NOT apply here."""
     park = num_services
+    total_endpoints = jax.ops.segment_sum(
+        ep_has_record.astype(jnp.float32),
+        jnp.where(ep_has_record, ep_service, park),
+        num_segments=park + 1,
+    )[:-1]
 
-    # distance-1 by-edges: consumer = svc[src], consumed = (owner, ml[dst]).
-    # ONE sort keyed (owner, consumer, consumed_ml): identical
-    # (consumer, ml) pairs share their owner (owner = svc[ep]), so pair
+    # distance-1 by-edges: consumer = svc[src], consumed endpoint = dst.
+    # ONE sort keyed (owner, consumer, consumed_ep): identical
+    # (consumer, ep) pairs share their owner (owner = svc[ep]), so pair
     # distincts are full-row boundaries and (owner, consumer) groups are
     # prefix boundaries of the same order — no second lexsort.
     d1 = mask & (dist == 1)
     consumer = ep_service[jnp.maximum(src_ep, 0)]
     owner = ep_service[jnp.maximum(dst_ep, 0)]
-    dst_ml = ep_ml[jnp.maximum(dst_ep, 0)]
-    (g_owner, g_consumer, _g_ml), pair_first = lex_unique(
-        (owner, consumer, dst_ml), d1
+    (g_owner, g_consumer, _g_ep), pair_first = lex_unique(
+        (owner, consumer, dst_ep), d1
     )
     row_valid = g_owner != SENTINEL
     group_first = (

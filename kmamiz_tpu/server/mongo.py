@@ -1,0 +1,235 @@
+"""MongoDB wire-protocol client + Store backend (no driver dependency).
+
+Equivalent of the reference's MongoOperator
+(/root/reference/src/services/MongoOperator.ts:31-93): the nine mongoose
+collections become one database the framework reads/writes through a
+hand-rolled OP_MSG client (MongoDB 3.6+ wire protocol, opcode 2013) over a
+plain socket — the image ships no pymongo. Supported commands cover the
+Store contract: hello/ping, insert, find (+getMore cursor drain), update
+(upsert by _id), delete, drop.
+
+STORAGE_URI=mongodb://host:port/dbname selects this backend
+(kmamiz_tpu.server.storage.store_from_uri). Authenticated deployments
+(SCRAM) are not implemented — point the DP at an in-cluster mongo with
+trusted-network access like the reference's own sample deployment
+(/root/reference/deploy/kmamiz-sample.yaml), or use file:// storage.
+"""
+from __future__ import annotations
+
+import itertools
+import socket
+import struct
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+from urllib.parse import urlparse
+
+from kmamiz_tpu.server import bson
+from kmamiz_tpu.server.storage import COLLECTIONS, Store
+
+OP_MSG = 2013
+_HEADER = struct.Struct("<iiii")
+
+
+class MongoError(RuntimeError):
+    pass
+
+
+class MongoClient:
+    """One-socket OP_MSG client; thread-safe via a request lock."""
+
+    def __init__(
+        self, host: str, port: int = 27017, timeout: float = 10.0
+    ) -> None:
+        self._addr = (host, port)
+        self._timeout = timeout
+        self._sock: Optional[socket.socket] = None
+        self._req_ids = itertools.count(1)
+        self._lock = threading.Lock()
+
+    # -- transport -----------------------------------------------------------
+
+    def _connect(self) -> socket.socket:
+        if self._sock is None:
+            s = socket.create_connection(self._addr, timeout=self._timeout)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._sock = s
+        return self._sock
+
+    def close(self) -> None:
+        with self._lock:
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                finally:
+                    self._sock = None
+
+    def _recv_exact(self, sock: socket.socket, n: int) -> bytes:
+        chunks = []
+        while n:
+            chunk = sock.recv(n)
+            if not chunk:
+                raise MongoError("connection closed by server")
+            chunks.append(chunk)
+            n -= len(chunk)
+        return b"".join(chunks)
+
+    def command(self, doc: Dict[str, Any]) -> Dict[str, Any]:
+        """Run one command document; returns the reply body, raising on
+        ok: 0 or write errors."""
+        payload = b"\x00\x00\x00\x00" + b"\x00" + bson.encode(doc)
+        with self._lock:
+            try:
+                sock = self._connect()
+                req_id = next(self._req_ids)
+                header = _HEADER.pack(16 + len(payload), req_id, 0, OP_MSG)
+                sock.sendall(header + payload)
+                raw_len = self._recv_exact(sock, 4)
+                (total,) = struct.unpack("<i", raw_len)
+                rest = self._recv_exact(sock, total - 4)
+            except (OSError, struct.error) as err:
+                self._sock = None  # force reconnect on next call
+                raise MongoError(f"mongo transport error: {err}") from err
+        _req, _resp, opcode = struct.unpack_from("<iii", rest, 0)
+        if opcode != OP_MSG:
+            raise MongoError(f"unexpected reply opcode {opcode}")
+        body = rest[12:]
+        # flagBits u32, then sections; we only ever receive one kind-0
+        pos = 4
+        if body[pos] != 0:
+            raise MongoError(f"unexpected reply section kind {body[pos]}")
+        reply = bson.decode(body[pos + 1 :])
+        if reply.get("ok") != 1 and reply.get("ok") != 1.0:
+            raise MongoError(
+                f"command failed: {reply.get('codeName')} "
+                f"{reply.get('errmsg')}"
+            )
+        for err in reply.get("writeErrors") or []:
+            raise MongoError(f"write error: {err.get('errmsg')}")
+        return reply
+
+    # -- operations ----------------------------------------------------------
+
+    def ping(self, db: str = "admin") -> None:
+        self.command({"ping": 1, "$db": db})
+
+    def insert_many(self, db: str, collection: str, docs: List[dict]) -> None:
+        if docs:
+            self.command(
+                {"insert": collection, "documents": list(docs), "$db": db}
+            )
+
+    def find_all(self, db: str, collection: str) -> List[dict]:
+        reply = self.command({"find": collection, "$db": db})
+        cursor = reply["cursor"]
+        docs = list(cursor.get("firstBatch", []))
+        while cursor.get("id"):
+            reply = self.command(
+                {
+                    "getMore": cursor["id"],
+                    "collection": collection,
+                    "$db": db,
+                }
+            )
+            cursor = reply["cursor"]
+            docs.extend(cursor.get("nextBatch", []))
+        return docs
+
+    def upsert_by_id(self, db: str, collection: str, doc: dict) -> None:
+        self.command(
+            {
+                "update": collection,
+                "updates": [
+                    {
+                        "q": {"_id": doc["_id"]},
+                        "u": doc,
+                        "upsert": True,
+                    }
+                ],
+                "$db": db,
+            }
+        )
+
+    def delete_ids(self, db: str, collection: str, ids: List[str]) -> int:
+        if not ids:
+            return 0
+        reply = self.command(
+            {
+                "delete": collection,
+                "deletes": [
+                    {"q": {"_id": {"$in": list(ids)}}, "limit": 0}
+                ],
+                "$db": db,
+            }
+        )
+        return int(reply.get("n", 0))
+
+    def delete_all(self, db: str, collection: str) -> None:
+        self.command(
+            {
+                "delete": collection,
+                "deletes": [{"q": {}, "limit": 0}],
+                "$db": db,
+            }
+        )
+
+
+class MongoStore(Store):
+    """Store backend over MongoClient with the reference's nine
+    collections. Query semantics (namespace filters, the 30-day historical
+    window) live in the shared Store helpers over find_all, mirroring
+    MongoOperator's aggregation results."""
+
+    def __init__(
+        self,
+        host: str,
+        port: int = 27017,
+        database: str = "kmamiz",
+        timeout: float = 10.0,
+    ) -> None:
+        self._client = MongoClient(host, port, timeout=timeout)
+        self._db = database
+
+    @classmethod
+    def from_uri(cls, uri: str) -> "MongoStore":
+        parsed = urlparse(uri)
+        if parsed.username or parsed.password:
+            raise ValueError(
+                "mongodb:// credentials are not supported by the built-in "
+                "wire client; use a trusted-network mongo or file:// storage"
+            )
+        return cls(
+            parsed.hostname or "localhost",
+            parsed.port or 27017,
+            database=(parsed.path or "/kmamiz").lstrip("/") or "kmamiz",
+        )
+
+    def ping(self) -> None:
+        self._client.ping()
+
+    def find_all(self, collection: str) -> List[dict]:
+        return self._client.find_all(self._db, collection)
+
+    def insert_many(self, collection: str, docs: List[dict]) -> List[dict]:
+        import uuid
+
+        out = []
+        for doc in docs:
+            d = dict(doc)
+            d.setdefault("_id", uuid.uuid4().hex)
+            out.append(d)
+        self._client.insert_many(self._db, collection, out)
+        return out
+
+    def save(self, collection: str, doc: dict) -> dict:
+        import uuid
+
+        d = dict(doc)
+        d.setdefault("_id", uuid.uuid4().hex)
+        self._client.upsert_by_id(self._db, collection, d)
+        return d
+
+    def delete_many(self, collection: str, ids: List[str]) -> int:
+        return self._client.delete_ids(self._db, collection, ids)
+
+    def clear_collection(self, collection: str) -> None:
+        self._client.delete_all(self._db, collection)

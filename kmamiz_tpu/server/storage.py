@@ -297,6 +297,10 @@ class FileStore(MemoryStore):
 def store_from_uri(uri: str) -> Store:
     if uri.startswith("file://"):
         return FileStore(uri[len("file://"):])
+    if uri.startswith("mongodb://"):
+        from kmamiz_tpu.server.mongo import MongoStore
+
+        return MongoStore.from_uri(uri)
     if uri in ("memory://", "memory", ""):
         return MemoryStore()
     raise ValueError(f"unsupported STORAGE_URI: {uri}")

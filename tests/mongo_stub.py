@@ -1,0 +1,203 @@
+"""In-process MongoDB stand-in speaking the real OP_MSG wire protocol.
+
+Backs the MongoStore integration tests without a mongod binary: a real
+socket server with independent OP_MSG framing. It shares
+kmamiz_tpu.server.bson for the document codec, so the codec itself is
+separately validated against fixed byte vectors produced by real MongoDB
+tooling (tests/test_mongo_store.py::TestBsonCodec).
+
+Supported commands: hello/ismaster, ping, insert, find (+getMore with a
+deliberately small batch size to force cursor drains), update (upsert by
+_id), delete ({} / {_id: eq} / {_id: {$in}}), drop.
+"""
+from __future__ import annotations
+
+import itertools
+import socket
+import struct
+import threading
+from typing import Dict, List, Tuple
+
+from kmamiz_tpu.server import bson
+
+OP_MSG = 2013
+_HEADER = struct.Struct("<iiii")
+
+
+def _matches(doc: dict, query: dict) -> bool:
+    for key, cond in query.items():
+        value = doc.get(key)
+        if isinstance(cond, dict) and "$in" in cond:
+            if value not in cond["$in"]:
+                return False
+        elif value != cond:
+            return False
+    return True
+
+
+class MiniMongo:
+    def __init__(self, batch_size: int = 3) -> None:
+        self.batch_size = batch_size
+        self.data: Dict[Tuple[str, str], Dict[str, dict]] = {}
+        self.commands_seen: List[str] = []
+        self._cursors: Dict[int, List[dict]] = {}
+        self._cursor_ids = itertools.count(1000)
+        self._server = socket.create_server(("127.0.0.1", 0))
+        self._threads: List[threading.Thread] = []
+        self._running = True
+
+    @property
+    def port(self) -> int:
+        return self._server.getsockname()[1]
+
+    def start(self) -> "MiniMongo":
+        t = threading.Thread(target=self._accept_loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+        return self
+
+    def stop(self) -> None:
+        self._running = False
+        try:
+            self._server.close()
+        except OSError:
+            pass
+
+    # -- wire ----------------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while self._running:
+            try:
+                conn, _ = self._server.accept()
+            except OSError:
+                return
+            t = threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True
+            )
+            t.start()
+            self._threads.append(t)
+
+    def _recv_exact(self, conn: socket.socket, n: int) -> bytes:
+        chunks = []
+        while n:
+            chunk = conn.recv(n)
+            if not chunk:
+                raise ConnectionError("client closed")
+            chunks.append(chunk)
+            n -= len(chunk)
+        return b"".join(chunks)
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        with conn:
+            while self._running:
+                try:
+                    raw_len = self._recv_exact(conn, 4)
+                except (ConnectionError, OSError):
+                    return
+                (total,) = struct.unpack("<i", raw_len)
+                rest = self._recv_exact(conn, total - 4)
+                req_id, _resp, opcode = struct.unpack_from("<iii", rest, 0)
+                assert opcode == OP_MSG, opcode
+                body = rest[12:]
+                assert body[4] == 0, "only kind-0 sections supported"
+                command = bson.decode(body[5:])
+                reply = self._dispatch(command)
+                payload = b"\x00\x00\x00\x00" + b"\x00" + bson.encode(reply)
+                header = _HEADER.pack(16 + len(payload), 1, req_id, OP_MSG)
+                try:
+                    conn.sendall(header + payload)
+                except OSError:
+                    return
+
+    # -- commands ------------------------------------------------------------
+
+    def _coll(self, command: dict, name: str) -> Dict[str, dict]:
+        key = (command["$db"], command[name])
+        return self.data.setdefault(key, {})
+
+    def _dispatch(self, command: dict) -> dict:
+        op = next(iter(command))
+        self.commands_seen.append(op)
+        if op in ("hello", "ismaster", "ping"):
+            return {"ok": 1}
+        if op == "insert":
+            coll = self._coll(command, "insert")
+            for doc in command["documents"]:
+                if doc["_id"] in coll:
+                    return {
+                        "ok": 1,
+                        "n": 0,
+                        "writeErrors": [
+                            {"index": 0, "code": 11000, "errmsg": "duplicate key"}
+                        ],
+                    }
+                coll[doc["_id"]] = doc
+            return {"ok": 1, "n": len(command["documents"])}
+        if op == "find":
+            coll = self._coll(command, "find")
+            docs = [
+                d
+                for d in coll.values()
+                if _matches(d, command.get("filter", {}))
+            ]
+            first, rest = docs[: self.batch_size], docs[self.batch_size :]
+            cursor_id = 0
+            if rest:
+                cursor_id = next(self._cursor_ids)
+                self._cursors[cursor_id] = rest
+            return {
+                "ok": 1,
+                "cursor": {
+                    "id": cursor_id,
+                    "ns": f"{command['$db']}.{command['find']}",
+                    "firstBatch": first,
+                },
+            }
+        if op == "getMore":
+            cursor_id = command["getMore"]
+            rest = self._cursors.get(cursor_id, [])
+            batch, remaining = rest[: self.batch_size], rest[self.batch_size :]
+            if remaining:
+                self._cursors[cursor_id] = remaining
+                next_id = cursor_id
+            else:
+                self._cursors.pop(cursor_id, None)
+                next_id = 0
+            return {
+                "ok": 1,
+                "cursor": {
+                    "id": next_id,
+                    "ns": f"{command['$db']}.{command['collection']}",
+                    "nextBatch": batch,
+                },
+            }
+        if op == "update":
+            coll = self._coll(command, "update")
+            n = 0
+            for update in command["updates"]:
+                q = update["q"]
+                matched = [d for d in coll.values() if _matches(d, q)]
+                if matched:
+                    for d in matched:
+                        coll[d["_id"]] = update["u"]
+                        n += 1
+                elif update.get("upsert"):
+                    doc = update["u"]
+                    coll[doc["_id"]] = doc
+                    n += 1
+            return {"ok": 1, "n": n}
+        if op == "delete":
+            coll = self._coll(command, "delete")
+            n = 0
+            for delete in command["deletes"]:
+                hits = [
+                    k for k, d in coll.items() if _matches(d, delete["q"])
+                ]
+                for k in hits:
+                    del coll[k]
+                    n += 1
+            return {"ok": 1, "n": n}
+        if op == "drop":
+            self.data.pop((command["$db"], command["drop"]), None)
+            return {"ok": 1}
+        return {"ok": 0, "errmsg": f"unsupported command {op}", "code": 59}

@@ -147,6 +147,31 @@ def test_load_dependencies_warm_start(bookinfo_traces):
     assert scores_by_name(warmed) == scores_by_name(from_spans)
 
 
+def test_deprecated_endpoints_age_out(pdas_traces, monkeypatch):
+    """DEPRECATED_ENDPOINT_THRESHOLD prunes stale endpoints from the
+    device-served scorers like the host's _filter_out_deprecated
+    (EndpointDependencies.ts:44-74): records and edges to them vanish."""
+    from kmamiz_tpu.config import settings
+
+    batch, graph = build_graph([pdas_traces])
+    monkeypatch.setattr(settings, "deprecated_endpoint_threshold", "1d")
+
+    # the fixture's spans are from 2022: everything is stale vs real now
+    assert not graph.active_services().any()
+    scores = graph.service_scores()
+    assert float(np.asarray(scores.instability_on).sum()) == 0
+    assert float(np.asarray(graph.usage_cohesion().usage_cohesion).sum()) == 0
+
+    # pin "now" inside the window: everything is fresh again
+    now = float(batch.timestamp_us[: batch.n_spans].max()) / 1000 + 1
+    assert graph.active_services(now_ms=now).any()
+    assert float(np.asarray(graph.service_scores(now_ms=now).instability_on).sum()) > 0
+
+    # threshold unset (default): nothing ages out
+    monkeypatch.setattr(settings, "deprecated_endpoint_threshold", "")
+    assert graph.active_services().any()
+
+
 def test_risk_scores_shape(pdas_traces):
     batch, graph = build_graph([pdas_traces])
     scores = graph.service_scores()

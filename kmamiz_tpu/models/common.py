@@ -11,9 +11,12 @@ import jax.numpy as jnp
 import optax
 
 
-def make_loss_fn(forward):
+def make_loss_fn(forward, pos_weight: float = 1.0):
     """Masked MSE (latency) + masked sigmoid BCE (anomaly) over a head's
-    forward function."""
+    forward function. pos_weight scales the positive-class BCE term —
+    anomalies are rare (a few fault-window slots per day), and unweighted
+    BCE drives the head into predicting the base rate, never crossing any
+    useful threshold."""
 
     def loss_fn(
         params,
@@ -31,9 +34,11 @@ def make_loss_fn(forward):
         w = node_mask.astype(jnp.float32)
         denom = jnp.maximum(w.sum(), 1.0)
         latency_loss = jnp.sum(w * (pred_latency - target_latency) ** 2) / denom
+        class_w = 1.0 + (pos_weight - 1.0) * target_anomaly
         anomaly_loss = (
             jnp.sum(
                 w
+                * class_w
                 * optax.sigmoid_binary_cross_entropy(anomaly_logit, target_anomaly)
             )
             / denom

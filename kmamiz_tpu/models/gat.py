@@ -45,6 +45,8 @@ class GatParams(NamedTuple):
     b_latency: jnp.ndarray  # [1]
     w_anomaly: jnp.ndarray  # [H, 1]
     b_anomaly: jnp.ndarray  # [1]
+    w_latency_skip: jnp.ndarray  # [F, 1]
+    w_anomaly_skip: jnp.ndarray  # [F, 1]
 
 
 def init_params(
@@ -76,6 +78,9 @@ def init_params(
         b_latency=jnp.zeros(1, dtype=jnp.float32),
         w_anomaly=glorot(k[11], (hidden, 1)),
         b_anomaly=jnp.zeros(1, dtype=jnp.float32),
+        # wide-and-deep input skips (see graphsage.init_params)
+        w_latency_skip=jnp.zeros((num_features, 1), dtype=jnp.float32),
+        w_anomaly_skip=jnp.zeros((num_features, 1), dtype=jnp.float32),
     )
 
 
@@ -139,14 +144,20 @@ def forward(
         params.w_2, params.a_src_2, params.a_dst_2,
         params.a_src_2r, params.a_dst_2r, params.b_2,
     )
-    latency = (h2 @ params.w_latency + params.b_latency)[:, 0]
-    anomaly_logit = (h2 @ params.w_anomaly + params.b_anomaly)[:, 0]
+    latency = (
+        h2 @ params.w_latency + features @ params.w_latency_skip + params.b_latency
+    )[:, 0]
+    anomaly_logit = (
+        h2 @ params.w_anomaly + features @ params.w_anomaly_skip + params.b_anomaly
+    )[:, 0]
     return latency, anomaly_logit
 
 
-loss_fn = common.make_loss_fn(forward)
+loss_fn = common.make_loss_fn(forward)  # unweighted default
 make_optimizer = common.make_optimizer
 
 
-def make_train_step(optimizer):
-    return common.make_train_step(optimizer, loss_fn)
+def make_train_step(optimizer, pos_weight: float = 1.0):
+    if pos_weight == 1.0:
+        return common.make_train_step(optimizer, loss_fn)
+    return common.make_train_step(optimizer, common.make_loss_fn(forward, pos_weight))

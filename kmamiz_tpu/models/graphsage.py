@@ -20,7 +20,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-NUM_FEATURES = 8
+NUM_FEATURES = 10  # incl. sin/cos hour-of-day
 
 
 class SageParams(NamedTuple):
@@ -34,6 +34,8 @@ class SageParams(NamedTuple):
     b_latency: jnp.ndarray  # [1]
     w_anomaly: jnp.ndarray  # [H, 1]
     b_anomaly: jnp.ndarray  # [1]
+    w_latency_skip: jnp.ndarray  # [F, 1]
+    w_anomaly_skip: jnp.ndarray  # [F, 1]
 
 
 def init_params(
@@ -56,6 +58,11 @@ def init_params(
         b_latency=jnp.zeros(1, dtype=jnp.float32),
         w_anomaly=glorot(k[5], (hidden, 1)),
         b_anomaly=jnp.zeros(1, dtype=jnp.float32),
+        # wide-and-deep input skips: persistence (next ~ current) is the
+        # dominant mode of both targets, so the readout sees the raw
+        # features directly and the GNN trunk learns residuals
+        w_latency_skip=jnp.zeros((num_features, 1), dtype=jnp.float32),
+        w_anomaly_skip=jnp.zeros((num_features, 1), dtype=jnp.float32),
     )
 
 
@@ -96,21 +103,27 @@ def forward(
     )
     agg2 = neighbor_mean(h1, src_ep, dst_ep, edge_mask)
     h2 = jax.nn.relu(h1 @ params.w_self_2 + agg2 @ params.w_neigh_2 + params.b_2)
-    latency = (h2 @ params.w_latency + params.b_latency)[:, 0]
-    anomaly_logit = (h2 @ params.w_anomaly + params.b_anomaly)[:, 0]
+    latency = (
+        h2 @ params.w_latency + features @ params.w_latency_skip + params.b_latency
+    )[:, 0]
+    anomaly_logit = (
+        h2 @ params.w_anomaly + features @ params.w_anomaly_skip + params.b_anomaly
+    )[:, 0]
     return latency, anomaly_logit
 
 
 # loss / optimizer / train step are the family-shared scaffolding
 from kmamiz_tpu.models import common as _common  # noqa: E402
 
-loss_fn = _common.make_loss_fn(forward)
+loss_fn = _common.make_loss_fn(forward)  # unweighted default
 make_optimizer = _common.make_optimizer
 
 
-def make_train_step(optimizer):
+def make_train_step(optimizer, pos_weight: float = 1.0):
     """Jitted (params, opt_state, batch...) -> (params, opt_state, loss, aux)."""
-    return _common.make_train_step(optimizer, loss_fn)
+    if pos_weight == 1.0:
+        return _common.make_train_step(optimizer, loss_fn)
+    return _common.make_train_step(optimizer, _common.make_loss_fn(forward, pos_weight))
 
 
 def features_from_stats(
@@ -123,6 +136,9 @@ def features_from_stats(
     num_endpoints: int,
     num_statuses: int,
     window_seconds: float = 30.0,
+    *,
+    hour_of_day: float,  # required: silent 0.0 would skew the trained
+    # sin/cos features against real slot hours (train/serve skew)
 ) -> jnp.ndarray:
     """Fold per-(endpoint,status) window stats into [N, NUM_FEATURES]."""
     shape = (num_endpoints, num_statuses)
@@ -142,11 +158,13 @@ def features_from_stats(
             total / window_seconds,  # request rate
             e4.sum(axis=1) / safe,  # 4xx rate
             e5.sum(axis=1) / safe,  # 5xx rate
-            mean_latency,
+            jnp.log1p(mean_latency),  # same space as the regression target
             mean_cv,
             replicas[:num_endpoints].astype(jnp.float32),
             jnp.log1p(total),
             (total > 0).astype(jnp.float32),
+            jnp.full_like(total, jnp.sin(2.0 * jnp.pi * hour_of_day / 24.0)),
+            jnp.full_like(total, jnp.cos(2.0 * jnp.pi * hour_of_day / 24.0)),
         ],
         axis=1,
     )

@@ -138,16 +138,24 @@ def dataset_from_simulation(
     for t in range(len(order) - 1):
         count, err4, err5, lat, cv, active = per_slot[t]
         n_count, _n_err4, n_err5, n_lat, _n_cv, n_active = per_slot[t + 1]
+        # hour-of-day of the PREDICTED slot: recurring operational faults
+        # (nightly jobs, scheduled scale-downs) are periodic, and the
+        # persistence baseline is blind to them
+        _, next_hour, _ = parse_slot_key(order[t + 1])
+        angle = 2.0 * np.pi * next_hour / 24.0
+        n = len(count)
         features = np.stack(
             [
                 count / SLOT_SECONDS,  # request rate
                 err4 / np.maximum(count, 1.0),  # 4xx share
                 err5 / np.maximum(count, 1.0),  # 5xx share
-                lat,
+                np.log1p(lat),  # same space as the regression target
                 cv,
                 replicas,
                 np.log1p(count),
                 active.astype(np.float64),
+                np.full(n, np.sin(angle)),
+                np.full(n, np.cos(angle)),
             ],
             axis=1,
         ).astype(np.float32)
@@ -180,6 +188,7 @@ def train(
     seed: int = 0,
     checkpoint_dir: str = "",
     checkpoint_every: int = 10,
+    model=graphsage,
 ) -> TrainResult:
     """Full-graph training, one step per slot per epoch.
 
@@ -189,10 +198,9 @@ def train(
     saved hyperparameters against the requested ones."""
     from kmamiz_tpu.models import checkpoint as ckpt
 
-    params = graphsage.init_params(jax.random.PRNGKey(seed), hidden=hidden)
-    optimizer = graphsage.make_optimizer(lr)
+    params = model.init_params(jax.random.PRNGKey(seed), hidden=hidden)
+    optimizer = model.make_optimizer(lr)
     opt_state = optimizer.init(params)
-    step = graphsage.make_train_step(optimizer)
 
     start_epoch = 0
     if checkpoint_dir:
@@ -209,8 +217,16 @@ def train(
             # validate hyperparameters BEFORE restoring: orbax would
             # silently return the saved shapes against a mismatched template
             meta = ckpt.load_metadata(checkpoint_dir, resume_step) or {}
-            for name, want in (("hidden", hidden), ("lr", lr), ("seed", seed)):
+            model_name = model.__name__.rsplit(".", 1)[-1]
+            for name, want in (
+                ("hidden", hidden),
+                ("lr", lr),
+                ("seed", seed),
+                ("model", model_name),
+            ):
                 saved = meta.get(name)
+                if name == "model" and saved is None:
+                    saved = "graphsage"  # pre-'model'-field checkpoints
                 if saved is None:
                     raise ValueError(
                         f"checkpoint {checkpoint_dir} step {resume_step} "
@@ -228,6 +244,17 @@ def train(
             if restored is not None:
                 params, opt_state, meta = restored
                 start_epoch = int(meta.get("step", 0))
+
+    # balance the rare positive class: weight by the inverse base rate of
+    # the training slots (clipped; 1.0 when no positives exist)
+    pos = sum(
+        float((np.asarray(a) * np.asarray(m)).sum())
+        for a, m in zip(dataset.target_anomaly, dataset.node_mask)
+    )
+    tot = sum(float(np.asarray(m).sum()) for m in dataset.node_mask)
+    base_rate = pos / tot if tot else 0.0
+    pos_weight = float(np.clip(1.0 / base_rate, 1.0, 20.0)) if base_rate else 1.0
+    step = model.make_train_step(optimizer, pos_weight=pos_weight)
 
     losses, lat_losses, ano_losses = [], [], []
     for epoch in range(start_epoch, epochs):
@@ -265,6 +292,7 @@ def train(
                     "hidden": hidden,
                     "lr": lr,
                     "seed": seed,
+                    "model": model.__name__.rsplit(".", 1)[-1],
                 },
             )
     return TrainResult(params, losses, lat_losses, ano_losses)
@@ -279,30 +307,25 @@ class EvalResult:
     anomaly_base_rate: float
     per_slot_flagged: Dict[str, List[str]]  # slotKey -> flagged endpoints
     in_sample: bool = False  # True when evaluated on the training slots
+    anomaly_f1: float = 0.0
+    latency_mae_ms: float = 0.0  # mean |expm1(pred) - expm1(target)| in ms
+    threshold: float = 0.5  # decision threshold (train-set calibrated)
 
 
-def evaluate(
-    params: graphsage.SageParams,
-    dataset: GraphDataset,
-    threshold: float = 0.5,
-) -> EvalResult:
+def _score_predictions(dataset, predict) -> EvalResult:
+    """Shared metric accumulation: `predict(i) -> (latency_log1p [N],
+    anomaly_pos bool [N])` per slot."""
     tp = fp = fn = tn = 0
     sq_err_sum = 0.0
+    abs_ms_sum = 0.0
     weight_sum = 0.0
     positives = 0
     total = 0
     flagged: Dict[str, List[str]] = {}
     for i in range(len(dataset.features)):
-        pred_latency, logit = graphsage.forward(
-            params,
-            dataset.features[i],
-            dataset.src,
-            dataset.dst,
-            dataset.edge_mask,
-        )
+        pred_latency, pred_pos_raw = predict(i)
         mask = np.asarray(dataset.node_mask[i])
-        prob = np.asarray(jax.nn.sigmoid(logit))
-        pred_pos = (prob > threshold) & mask
+        pred_pos = np.asarray(pred_pos_raw) & mask
         truth = np.asarray(dataset.target_anomaly[i]).astype(bool) & mask
 
         tp += int((pred_pos & truth).sum())
@@ -312,8 +335,13 @@ def evaluate(
         positives += int(truth.sum())
         total += int(mask.sum())
 
-        err = np.asarray(pred_latency) - np.asarray(dataset.target_latency[i])
+        pred_log = np.asarray(pred_latency)
+        target_log = np.asarray(dataset.target_latency[i])
+        err = pred_log - target_log
         sq_err_sum += float((mask * err**2).sum())
+        abs_ms_sum += float(
+            (mask * np.abs(np.expm1(pred_log) - np.expm1(target_log))).sum()
+        )
         weight_sum += float(mask.sum())
 
         names = [
@@ -322,14 +350,79 @@ def evaluate(
         if names:
             flagged[dataset.slot_keys[i]] = names
 
+    precision = tp / max(tp + fp, 1)
+    recall = tp / max(tp + fn, 1)
     return EvalResult(
         latency_mse=sq_err_sum / max(weight_sum, 1.0),
         anomaly_accuracy=(tp + tn) / max(total, 1),
-        anomaly_precision=tp / max(tp + fp, 1),
-        anomaly_recall=tp / max(tp + fn, 1),
+        anomaly_precision=precision,
+        anomaly_recall=recall,
         anomaly_base_rate=positives / max(total, 1),
         per_slot_flagged=flagged,
+        anomaly_f1=(
+            2 * precision * recall / (precision + recall)
+            if precision + recall
+            else 0.0
+        ),
+        latency_mae_ms=abs_ms_sum / max(weight_sum, 1.0),
     )
+
+
+def evaluate(
+    params,
+    dataset: GraphDataset,
+    threshold: float = 0.5,
+    model=graphsage,
+) -> EvalResult:
+    def predict(i):
+        pred_latency, logit = model.forward(
+            params,
+            dataset.features[i],
+            dataset.src,
+            dataset.dst,
+            dataset.edge_mask,
+        )
+        prob = np.asarray(jax.nn.sigmoid(logit))
+        return pred_latency, prob > threshold
+
+    return _score_predictions(dataset, predict)
+
+
+def evaluate_baseline(dataset: GraphDataset) -> EvalResult:
+    """Persistence baseline the heads must beat: next-slot anomaly =
+    current-slot 5xx share above the labeling threshold (feature col 2);
+    next-slot latency = current-slot latency mean (feature col 3)."""
+
+    def predict(i):
+        feats = np.asarray(dataset.features[i])
+        return feats[:, 3], feats[:, 2] > ANOMALY_ERROR_SHARE
+
+    return _score_predictions(dataset, predict)
+
+
+def evaluate_naive(dataset: GraphDataset, rate: float = 0.0, seed: int = 0) -> EvalResult:
+    """Truly naive baselines: flag nothing (rate=0), everything (rate=1),
+    or random at `rate`; latency = the dataset's global mean target."""
+    rng = np.random.default_rng(seed)
+    all_targets = np.concatenate(
+        [
+            np.asarray(t)[np.asarray(m).astype(bool)]
+            for t, m in zip(dataset.target_latency, dataset.node_mask)
+        ]
+    ) if dataset.features else np.zeros(1)
+    mean_latency = float(all_targets.mean()) if all_targets.size else 0.0
+
+    def predict(i):
+        n = np.asarray(dataset.features[i]).shape[0]
+        if rate <= 0:
+            flags = np.zeros(n, dtype=bool)
+        elif rate >= 1:
+            flags = np.ones(n, dtype=bool)
+        else:
+            flags = rng.random(n) < rate
+        return np.full(n, mean_latency, dtype=np.float32), flags
+
+    return _score_predictions(dataset, predict)
 
 
 def train_on_simulation(
@@ -340,6 +433,7 @@ def train_on_simulation(
     epochs: int = 30,
     hidden: int = 32,
     seed: int = 0,
+    model=graphsage,
 ) -> Tuple[TrainResult, EvalResult, GraphDataset]:
     """Temporal split: train on the first slots, evaluate on the rest
     (fault windows land wherever the config put them)."""
@@ -369,10 +463,55 @@ def train_on_simulation(
         node_mask=dataset.node_mask[cut:],
         slot_keys=dataset.slot_keys[cut:],
     )
-    result = train(train_set, epochs=epochs, hidden=hidden, seed=seed)
+    result = train(train_set, epochs=epochs, hidden=hidden, seed=seed, model=model)
+    threshold = calibrate_threshold(result.params, train_set, model=model)
     if eval_set.features:
-        metrics = evaluate(result.params, eval_set)
+        metrics = evaluate(result.params, eval_set, threshold=threshold, model=model)
     else:  # nothing held out: report train-set metrics, explicitly marked
-        metrics = evaluate(result.params, train_set)
+        metrics = evaluate(result.params, train_set, threshold=threshold, model=model)
         metrics.in_sample = True
+    metrics.threshold = threshold
     return result, metrics, dataset
+
+
+def calibrate_threshold(
+    params, dataset: GraphDataset, model=graphsage, grid=None
+) -> float:
+    """Pick the decision threshold maximizing F1 on the TRAINING slots —
+    standard practice for imbalanced detection; the held-out evaluation
+    never sees its own labels. Falls back to 0.5 when no threshold
+    achieves positive F1 (e.g. a clean run with no anomalies), so a
+    degenerate grid point cannot flood inference with false positives.
+    Forward passes run once; only the thresholding sweeps."""
+    if grid is None:
+        grid = [i / 20 for i in range(1, 20)]
+    probs = []
+    for i in range(len(dataset.features)):
+        _lat, logit = model.forward(
+            params,
+            dataset.features[i],
+            dataset.src,
+            dataset.dst,
+            dataset.edge_mask,
+        )
+        probs.append(np.asarray(jax.nn.sigmoid(logit)))
+    best_t, best_f1 = 0.5, 0.0
+    for t in grid:
+        tp = fp = fn = 0
+        for i, prob in enumerate(probs):
+            mask = np.asarray(dataset.node_mask[i]).astype(bool)
+            pred = (prob > t) & mask
+            truth = np.asarray(dataset.target_anomaly[i]).astype(bool) & mask
+            tp += int((pred & truth).sum())
+            fp += int((pred & ~truth).sum())
+            fn += int((~pred & truth).sum())
+        precision = tp / max(tp + fp, 1)
+        recall = tp / max(tp + fn, 1)
+        f1 = (
+            2 * precision * recall / (precision + recall)
+            if precision + recall
+            else 0.0
+        )
+        if f1 > best_f1:
+            best_t, best_f1 = t, f1
+    return best_t

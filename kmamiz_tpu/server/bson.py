@@ -21,6 +21,11 @@ class BsonError(ValueError):
     pass
 
 
+class Int64(int):
+    """Marker forcing int64 encoding (tag 0x12) regardless of magnitude —
+    MongoDB requires some fields (getMore cursor ids) to be BSON longs."""
+
+
 # -- encoding ---------------------------------------------------------------
 
 
@@ -37,6 +42,10 @@ def _encode_value(key: str, value: Any, out: bytearray) -> None:
         out += b"\x0a" + name
     elif value is True or value is False:
         out += b"\x08" + name + (b"\x01" if value else b"\x00")
+    elif isinstance(value, Int64):
+        if not _INT64_MIN <= value <= _INT64_MAX:
+            raise BsonError(f"integer out of int64 range: {key}")
+        out += b"\x12" + name + struct.pack("<q", value)
     elif isinstance(value, int):  # bool handled above
         if _INT32_MIN <= value <= _INT32_MAX:
             out += b"\x10" + name + struct.pack("<i", value)

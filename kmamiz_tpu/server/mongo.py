@@ -125,7 +125,9 @@ class MongoClient:
         while cursor.get("id"):
             reply = self.command(
                 {
-                    "getMore": cursor["id"],
+                    # mongod requires the cursor id as a BSON long even
+                    # when it fits 32 bits
+                    "getMore": bson.Int64(cursor["id"]),
                     "collection": collection,
                     "$db": db,
                 }

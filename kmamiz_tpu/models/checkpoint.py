@@ -135,7 +135,9 @@ def restore_checkpoint(
         "opt_state": opt_state_template,
     }
     payload = _checkpointer().restore(path, item=template)
-    params = SageParams(
+    # rebuild with the TEMPLATE's NamedTuple type: GAT checkpoints restore
+    # into GatParams, SAGE into SageParams
+    params = type(params_template)(
         **{k: jax.numpy.asarray(v) for k, v in payload["params"].items()}
     )
     return params, payload["opt_state"], meta

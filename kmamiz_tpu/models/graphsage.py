@@ -23,6 +23,39 @@ import jax.numpy as jnp
 NUM_FEATURES = 10  # incl. sin/cos hour-of-day
 
 
+def assemble_features(
+    request_rate,
+    err4_share,
+    err5_share,
+    log_latency,
+    latency_cv,
+    replicas,
+    log_volume,
+    active,
+    hour_of_day: float,
+):
+    """THE feature-column layout, shared by the trainer's per-slot builder
+    and the device window-stats path — one definition so the two can never
+    skew (train/serve skew is silent and deadly for the hour features)."""
+    angle = 2.0 * jnp.pi * hour_of_day / 24.0
+    rate = jnp.asarray(request_rate, dtype=jnp.float32)
+    return jnp.stack(
+        [
+            rate,
+            jnp.asarray(err4_share, dtype=jnp.float32),
+            jnp.asarray(err5_share, dtype=jnp.float32),
+            jnp.asarray(log_latency, dtype=jnp.float32),
+            jnp.asarray(latency_cv, dtype=jnp.float32),
+            jnp.asarray(replicas, dtype=jnp.float32),
+            jnp.asarray(log_volume, dtype=jnp.float32),
+            jnp.asarray(active, dtype=jnp.float32),
+            jnp.full_like(rate, jnp.sin(angle)),
+            jnp.full_like(rate, jnp.cos(angle)),
+        ],
+        axis=1,
+    )
+
+
 class SageParams(NamedTuple):
     w_self_1: jnp.ndarray  # [F, H]
     w_neigh_1: jnp.ndarray  # [F, H]
@@ -153,18 +186,14 @@ def features_from_stats(
     # count-weighted means across status groups
     mean_latency = (lm * c).sum(axis=1) / safe
     mean_cv = (cv * c).sum(axis=1) / safe
-    return jnp.stack(
-        [
-            total / window_seconds,  # request rate
-            e4.sum(axis=1) / safe,  # 4xx rate
-            e5.sum(axis=1) / safe,  # 5xx rate
-            jnp.log1p(mean_latency),  # same space as the regression target
-            mean_cv,
-            replicas[:num_endpoints].astype(jnp.float32),
-            jnp.log1p(total),
-            (total > 0).astype(jnp.float32),
-            jnp.full_like(total, jnp.sin(2.0 * jnp.pi * hour_of_day / 24.0)),
-            jnp.full_like(total, jnp.cos(2.0 * jnp.pi * hour_of_day / 24.0)),
-        ],
-        axis=1,
+    return assemble_features(
+        total / window_seconds,
+        e4.sum(axis=1) / safe,
+        e5.sum(axis=1) / safe,
+        jnp.log1p(mean_latency),
+        mean_cv,
+        replicas[:num_endpoints],
+        jnp.log1p(total),
+        total > 0,
+        hour_of_day=hour_of_day,
     )

@@ -142,25 +142,19 @@ def dataset_from_simulation(
         # (nightly jobs, scheduled scale-downs) are periodic, and the
         # persistence baseline is blind to them
         _, next_hour, _ = parse_slot_key(order[t + 1])
-        angle = 2.0 * np.pi * next_hour / 24.0
-        n = len(count)
-        features = np.stack(
-            [
-                count / SLOT_SECONDS,  # request rate
-                err4 / np.maximum(count, 1.0),  # 4xx share
-                err5 / np.maximum(count, 1.0),  # 5xx share
-                np.log1p(lat),  # same space as the regression target
-                cv,
-                replicas,
-                np.log1p(count),
-                active.astype(np.float64),
-                np.full(n, np.sin(angle)),
-                np.full(n, np.cos(angle)),
-            ],
-            axis=1,
-        ).astype(np.float32)
+        features = graphsage.assemble_features(
+            count / SLOT_SECONDS,
+            err4 / np.maximum(count, 1.0),
+            err5 / np.maximum(count, 1.0),
+            np.log1p(lat),  # same space as the regression target
+            cv,
+            replicas,
+            np.log1p(count),
+            active,
+            hour_of_day=float(next_hour),
+        )
         err_share_next = n_err5 / np.maximum(n_count, 1.0)
-        dataset.features.append(jnp.asarray(features))
+        dataset.features.append(features)
         dataset.target_latency.append(
             jnp.asarray(np.log1p(n_lat).astype(np.float32))
         )
@@ -217,16 +211,22 @@ def train(
             # validate hyperparameters BEFORE restoring: orbax would
             # silently return the saved shapes against a mismatched template
             meta = ckpt.load_metadata(checkpoint_dir, resume_step) or {}
+            if meta.get("num_features") is None:
+                raise ValueError(
+                    f"checkpoint {checkpoint_dir} step {resume_step} was "
+                    "saved before the 10-feature layout (no num_features in "
+                    "metadata) and cannot restore into the current model; "
+                    "delete the directory or retrain"
+                )
             model_name = model.__name__.rsplit(".", 1)[-1]
             for name, want in (
                 ("hidden", hidden),
                 ("lr", lr),
                 ("seed", seed),
                 ("model", model_name),
+                ("num_features", model.NUM_FEATURES),
             ):
                 saved = meta.get(name)
-                if name == "model" and saved is None:
-                    saved = "graphsage"  # pre-'model'-field checkpoints
                 if saved is None:
                     raise ValueError(
                         f"checkpoint {checkpoint_dir} step {resume_step} "
@@ -293,6 +293,7 @@ def train(
                     "lr": lr,
                     "seed": seed,
                     "model": model.__name__.rsplit(".", 1)[-1],
+                    "num_features": model.NUM_FEATURES,
                 },
             )
     return TrainResult(params, losses, lat_losses, ano_losses)
@@ -385,7 +386,9 @@ def evaluate(
         prob = np.asarray(jax.nn.sigmoid(logit))
         return pred_latency, prob > threshold
 
-    return _score_predictions(dataset, predict)
+    result = _score_predictions(dataset, predict)
+    result.threshold = threshold
+    return result
 
 
 def evaluate_baseline(dataset: GraphDataset) -> EvalResult:
@@ -425,6 +428,29 @@ def evaluate_naive(dataset: GraphDataset, rate: float = 0.0, seed: int = 0) -> E
     return _score_predictions(dataset, predict)
 
 
+def temporal_split(
+    dataset: GraphDataset, train_fraction: float = 0.75
+) -> Tuple[GraphDataset, GraphDataset]:
+    """First-slots train set / remaining-slots eval set — the ONE split
+    definition shared by train_on_simulation and tools/eval_models.py."""
+    cut = max(1, int(len(dataset.features) * train_fraction))
+
+    def subset(lo, hi):
+        return GraphDataset(
+            endpoint_names=dataset.endpoint_names,
+            src=dataset.src,
+            dst=dataset.dst,
+            edge_mask=dataset.edge_mask,
+            features=dataset.features[lo:hi],
+            target_latency=dataset.target_latency[lo:hi],
+            target_anomaly=dataset.target_anomaly[lo:hi],
+            node_mask=dataset.node_mask[lo:hi],
+            slot_keys=dataset.slot_keys[lo:hi],
+        )
+
+    return subset(0, cut), subset(cut, None)
+
+
 def train_on_simulation(
     endpoint_dependencies: List[dict],
     realtime_data_per_slot: Dict[str, List[dict]],
@@ -440,29 +466,7 @@ def train_on_simulation(
     dataset = dataset_from_simulation(
         endpoint_dependencies, realtime_data_per_slot, replica_counts
     )
-    cut = max(1, int(len(dataset.features) * train_fraction))
-    train_set = GraphDataset(
-        endpoint_names=dataset.endpoint_names,
-        src=dataset.src,
-        dst=dataset.dst,
-        edge_mask=dataset.edge_mask,
-        features=dataset.features[:cut],
-        target_latency=dataset.target_latency[:cut],
-        target_anomaly=dataset.target_anomaly[:cut],
-        node_mask=dataset.node_mask[:cut],
-        slot_keys=dataset.slot_keys[:cut],
-    )
-    eval_set = GraphDataset(
-        endpoint_names=dataset.endpoint_names,
-        src=dataset.src,
-        dst=dataset.dst,
-        edge_mask=dataset.edge_mask,
-        features=dataset.features[cut:],
-        target_latency=dataset.target_latency[cut:],
-        target_anomaly=dataset.target_anomaly[cut:],
-        node_mask=dataset.node_mask[cut:],
-        slot_keys=dataset.slot_keys[cut:],
-    )
+    train_set, eval_set = temporal_split(dataset, train_fraction)
     result = train(train_set, epochs=epochs, hidden=hidden, seed=seed, model=model)
     threshold = calibrate_threshold(result.params, train_set, model=model)
     if eval_set.features:

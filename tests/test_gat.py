@@ -94,7 +94,10 @@ class TestGatModel:
         from kmamiz_tpu.simulator.simulator import Simulator
 
         sim = Simulator().generate_simulation_data(
-            FAULT_YAML, simulate_date_ms=946684800000
+            FAULT_YAML,
+            simulate_date_ms=946684800000,
+            rng=np.random.default_rng(11),  # deterministic: the loss-decrease
+            # assertion below is stochastic under a fresh RNG
         )
         ds = trainer.dataset_from_simulation(
             sim.endpoint_dependencies,

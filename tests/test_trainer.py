@@ -269,11 +269,57 @@ class TestCheckpointResume:
         optimizer = graphsage.make_optimizer()
         checkpoint.save_checkpoint(
             str(tmp_path), params, optimizer.init(params), step=2,
-            metadata={"hidden": 8, "lr": 1e-2, "seed": 0},
+            metadata={
+                "hidden": 8,
+                "lr": 1e-2,
+                "seed": 0,
+                "model": "graphsage",
+                "num_features": graphsage.NUM_FEATURES,
+            },
         )
         ds = None  # train validates metadata before touching the dataset
         with pytest.raises(ValueError, match="hidden=8"):
             trainer.train(ds, epochs=4, hidden=16, checkpoint_dir=str(tmp_path))
+
+    def test_resume_rejects_pre_upgrade_checkpoint(self, tmp_path):
+        """Checkpoints saved before the 10-feature layout (no num_features
+        in metadata) cannot restore into the current param tree; the
+        rejection must be explicit, not an orbax shape error."""
+        import jax
+        import pytest
+
+        from kmamiz_tpu.models import checkpoint, graphsage, trainer
+
+        params = graphsage.init_params(jax.random.PRNGKey(0), hidden=8)
+        optimizer = graphsage.make_optimizer()
+        checkpoint.save_checkpoint(
+            str(tmp_path), params, optimizer.init(params), step=2,
+            metadata={"hidden": 8, "lr": 1e-2, "seed": 0},
+        )
+        with pytest.raises(ValueError, match="10-feature layout"):
+            trainer.train(None, epochs=4, hidden=8, checkpoint_dir=str(tmp_path))
+
+    def test_gat_checkpoint_restores_gat_params(self, tmp_path):
+        """restore rebuilds the TEMPLATE's param type: a GAT checkpoint
+        round-trips through GatParams, not SageParams."""
+        import jax
+        import numpy as np
+
+        from kmamiz_tpu.models import checkpoint, gat
+
+        params = gat.init_params(jax.random.PRNGKey(3), hidden=8)
+        optimizer = gat.make_optimizer()
+        opt_state = optimizer.init(params)
+        checkpoint.save_checkpoint(
+            str(tmp_path), params, opt_state, step=1, metadata={"model": "gat"}
+        )
+        restored = checkpoint.restore_checkpoint(
+            str(tmp_path), params, opt_state, step=1
+        )
+        assert restored is not None
+        r_params, _state, _meta = restored
+        assert type(r_params) is gat.GatParams
+        assert np.allclose(np.asarray(r_params.w_1), np.asarray(params.w_1))
 
     def test_stray_file_does_not_mask_checkpoints(self, tmp_path):
         import jax

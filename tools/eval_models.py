@@ -200,19 +200,8 @@ def main() -> None:
         shared_dataset = dataset
         rows.append((name, metrics))
 
-    # persistence baseline on the SAME held-out slots
-    cut = max(1, int(len(shared_dataset.features) * 0.75))
-    eval_set = trainer.GraphDataset(
-        endpoint_names=shared_dataset.endpoint_names,
-        src=shared_dataset.src,
-        dst=shared_dataset.dst,
-        edge_mask=shared_dataset.edge_mask,
-        features=shared_dataset.features[cut:],
-        target_latency=shared_dataset.target_latency[cut:],
-        target_anomaly=shared_dataset.target_anomaly[cut:],
-        node_mask=shared_dataset.node_mask[cut:],
-        slot_keys=shared_dataset.slot_keys[cut:],
-    )
+    # baselines score the SAME held-out slots (shared split definition)
+    _train_set, eval_set = trainer.temporal_split(shared_dataset, 0.75)
     base_rate = rows[0][1].anomaly_base_rate
     rows.append(("persistence skyline", trainer.evaluate_baseline(eval_set)))
     rows.append(

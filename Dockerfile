@@ -1,0 +1,41 @@
+# KMamiz-TPU: API server + TPU Data Processor in one image.
+#
+#   docker build -t kmamiz-tpu .
+#   docker run -p 3000:3000 -e STORAGE_URI=file:///data kmamiz-tpu
+#
+# The CPU jax wheel is installed by default so the image runs anywhere;
+# on a TPU VM, build with --build-arg JAX_EXTRA="jax[tpu]" (libtpu wheel)
+# and the same image drives real chips.
+FROM python:3.11-slim AS build
+
+RUN apt-get update \
+    && apt-get install -y --no-install-recommends g++ \
+    && rm -rf /var/lib/apt/lists/*
+
+ARG JAX_EXTRA="jax[cpu]"
+RUN pip install --no-cache-dir "${JAX_EXTRA}" flax optax orbax-checkpoint chex einops numpy
+
+WORKDIR /app
+COPY kmamiz_tpu/ kmamiz_tpu/
+COPY native/kmamiz_native.cpp native/kmamiz_json.cpp native/kmamiz_spans.cpp native/
+# includes the filter CRs and, when built via envoy/filter/build.sh,
+# the kmamiz-filter.wasm binary served at GET /wasm
+COPY envoy/ envoy/
+
+# compile the native ingest/parse extension at build time so the first
+# request never pays the toolchain cost
+RUN g++ -O3 -shared -fPIC -std=c++17 \
+      -o /tmp/libkmamiz_native.so \
+      native/kmamiz_native.cpp native/kmamiz_json.cpp native/kmamiz_spans.cpp \
+    && mkdir -p native/build \
+    && mv /tmp/libkmamiz_native.so native/build/
+
+ENV PYTHONPATH=/app \
+    PORT=3000 \
+    STORAGE_URI=memory:// \
+    KMAMIZ_WASM_PATH=/app/envoy/kmamiz-filter.wasm
+
+EXPOSE 3000
+# modes mirror the reference entrypoint (index.ts:29-92): SERVE_ONLY,
+# READ_ONLY_MODE, SIMULATOR_MODE, ENABLE_TESTING_ENDPOINTS via env
+CMD ["python", "-m", "kmamiz_tpu.api.app"]

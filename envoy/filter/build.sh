@@ -1,0 +1,15 @@
+#!/bin/sh
+# Build the KMamiz-TPU telemetry filter to wasm32 (proxy-wasm ABI).
+# Requires tinygo >= 0.28 and go >= 1.21 (not shipped in the dev image;
+# any machine or the tinygo/tinygo container works):
+#
+#   docker run --rm -v "$PWD":/src -w /src tinygo/tinygo:0.31.2 ./build.sh
+#
+# The binary lands at envoy/kmamiz-filter.wasm, which the API server
+# serves at GET /wasm (KMAMIZ_WASM_PATH) for the EnvoyFilter CR's
+# remote-code fetch.
+set -eu
+cd "$(dirname "$0")"
+go mod tidy
+tinygo build -o ../kmamiz-filter.wasm -scheduler=none -target=wasi ./main.go
+echo "built ../kmamiz-filter.wasm"

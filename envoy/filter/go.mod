@@ -1,0 +1,5 @@
+module github.com/kmamiz-tpu/envoy-filter
+
+go 1.21
+
+require github.com/tetratelabs/proxy-wasm-go-sdk v0.24.0

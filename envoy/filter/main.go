@@ -1,0 +1,179 @@
+// KMamiz-TPU Envoy telemetry filter (proxy-wasm).
+//
+// Emits one `[Request id/trace/span/parent] [METHOD host/path]
+// [ContentType ...] [Body] {...}` log line per HTTP request and the
+// `[Response ...] [Status] ...` twin when the stream closes, with JSON
+// bodies desensitized to type-preserving zero values before anything
+// leaves the pod. The line grammar is specified (and parity-tested) by
+// kmamiz_tpu/core/envoy_filter.py and consumed by the ingestion parser
+// kmamiz_tpu/core/envoy.py; behavioral equivalent of the reference's
+// filter (/root/reference/envoy/wasm/main.go:52-240), implemented
+// independently against that spec.
+//
+// Build (requires tinygo >= 0.28, not shipped in the dev image):
+//   ./build.sh        # -> ../kmamiz-filter.wasm, served at GET /wasm
+package main
+
+import (
+	"encoding/json"
+
+	"github.com/tetratelabs/proxy-wasm-go-sdk/proxywasm"
+	"github.com/tetratelabs/proxy-wasm-go-sdk/proxywasm/types"
+)
+
+const noID = "NO_ID"
+
+func main() {
+	proxywasm.SetVMContext(&vmContext{})
+}
+
+type vmContext struct {
+	types.DefaultVMContext
+}
+
+func (*vmContext) NewPluginContext(uint32) types.PluginContext {
+	return &pluginContext{}
+}
+
+type pluginContext struct {
+	types.DefaultPluginContext
+}
+
+func (*pluginContext) NewHttpContext(uint32) types.HttpContext {
+	return &httpContext{
+		requestID:  noID,
+		traceID:    noID,
+		spanID:     noID,
+		parentSpan: noID,
+	}
+}
+
+type httpContext struct {
+	types.DefaultHttpContext
+
+	requestID, traceID, spanID, parentSpan string
+	method, host, path                     string
+	reqContentType, respContentType        string
+	status                                 string
+	reqBody, respBody                      []byte
+}
+
+func headerOr(name, fallback string) string {
+	value, err := proxywasm.GetHttpRequestHeader(name)
+	if err != nil || value == "" {
+		return fallback
+	}
+	return value
+}
+
+func (ctx *httpContext) OnHttpRequestHeaders(int, bool) types.Action {
+	ctx.requestID = headerOr("x-request-id", noID)
+	ctx.traceID = headerOr("x-b3-traceid", noID)
+	ctx.spanID = headerOr("x-b3-spanid", noID)
+	ctx.parentSpan = headerOr("x-b3-parentspanid", noID)
+	ctx.method = headerOr(":method", "")
+	ctx.host = headerOr(":authority", "")
+	ctx.path = headerOr(":path", "")
+	ctx.reqContentType = headerOr("content-type", "")
+	return types.ActionContinue
+}
+
+func (ctx *httpContext) OnHttpRequestBody(bodySize int, endOfStream bool) types.Action {
+	if bodySize > 0 && ctx.reqContentType == "application/json" {
+		body, err := proxywasm.GetHttpRequestBody(0, bodySize)
+		if err == nil {
+			ctx.reqBody = body
+		}
+	}
+	return types.ActionContinue
+}
+
+func (ctx *httpContext) OnHttpResponseHeaders(int, bool) types.Action {
+	status, err := proxywasm.GetHttpResponseHeader(":status")
+	if err == nil {
+		ctx.status = status
+	}
+	contentType, err := proxywasm.GetHttpResponseHeader("content-type")
+	if err == nil {
+		ctx.respContentType = contentType
+	}
+	return types.ActionContinue
+}
+
+func (ctx *httpContext) OnHttpResponseBody(bodySize int, endOfStream bool) types.Action {
+	if bodySize > 0 && ctx.respContentType == "application/json" {
+		body, err := proxywasm.GetHttpResponseBody(0, bodySize)
+		if err == nil {
+			ctx.respBody = body
+		}
+	}
+	return types.ActionContinue
+}
+
+// desensitize keeps container shapes, booleans, and null while zeroing
+// strings ("") and numbers (0) — the grammar the schema-inference side
+// expects (envoy_filter.py desensitize_value).
+func desensitize(value interface{}) interface{} {
+	switch v := value.(type) {
+	case map[string]interface{}:
+		for key, item := range v {
+			v[key] = desensitize(item)
+		}
+		return v
+	case []interface{}:
+		for i, item := range v {
+			v[i] = desensitize(item)
+		}
+		return v
+	case string:
+		return ""
+	case float64:
+		return 0
+	case json.Number:
+		return 0
+	default: // bool, nil
+		return v
+	}
+}
+
+func scrubbedBody(raw []byte) (string, bool) {
+	var parsed interface{}
+	if err := json.Unmarshal(raw, &parsed); err != nil {
+		return "", false // unparseable bodies are dropped, never leaked
+	}
+	scrubbed, err := json.Marshal(desensitize(parsed))
+	if err != nil {
+		return "", false
+	}
+	return string(scrubbed), true
+}
+
+func (ctx *httpContext) idBlock(kind string) string {
+	return "[" + kind + " " + ctx.requestID + "/" + ctx.traceID + "/" +
+		ctx.spanID + "/" + ctx.parentSpan + "]"
+}
+
+func (ctx *httpContext) OnHttpStreamDone() {
+	request := ctx.idBlock("Request") +
+		" [" + ctx.method + " " + ctx.host + ctx.path + "]"
+	if ctx.reqContentType != "" {
+		request += " [ContentType " + ctx.reqContentType + "]"
+	}
+	if len(ctx.reqBody) > 0 && ctx.reqContentType == "application/json" {
+		if body, ok := scrubbedBody(ctx.reqBody); ok {
+			request += " [Body] " + body
+		}
+	}
+	proxywasm.LogInfo(request)
+
+	response := ctx.idBlock("Response") + " [Status] " + ctx.status
+	if ctx.respContentType != "" {
+		response += " [ContentType " + ctx.respContentType + "]"
+	}
+	if len(ctx.respBody) > 0 && ctx.respContentType == "application/json" {
+		if body, ok := scrubbedBody(ctx.respBody); ok {
+			response += " [Body] " + body
+		}
+	}
+	proxywasm.LogInfo(response)
+}

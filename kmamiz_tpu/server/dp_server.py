@@ -121,3 +121,41 @@ class DataProcessorServer:
 
     def serve_forever(self) -> None:
         self._server.serve_forever()
+
+
+def main() -> None:
+    """Standalone external DP, env-configured like the Rust service
+    (kmamiz_data_processor/src/env.rs): BIND_IP, DP_PORT, ZIPKIN_URL,
+    KUBEAPI_HOST, IS_RUNNING_IN_K8S. Point a stock KMamiz install's
+    EXTERNAL_DATA_PROCESSOR here."""
+    import os
+
+    from kmamiz_tpu.ingestion.kubernetes import KubernetesClient
+    from kmamiz_tpu.ingestion.zipkin import ZipkinClient
+
+    logging.basicConfig(level=os.environ.get("LOG_LEVEL", "INFO").upper())
+    zipkin = ZipkinClient(os.environ.get("ZIPKIN_URL", ""))
+    k8s = None
+    kube_host = os.environ.get("KUBEAPI_HOST", "")
+    if kube_host:
+        if os.environ.get("IS_RUNNING_IN_K8S", "").lower() == "true":
+            k8s = KubernetesClient.from_service_account(kube_host)
+        else:
+            k8s = KubernetesClient(kube_host)
+    processor = DataProcessor(
+        trace_source=lambda look_back, end_ts, limit: zipkin.get_trace_list(
+            look_back, end_ts, limit
+        ),
+        k8s_source=k8s,
+    )
+    server = DataProcessorServer(
+        processor,
+        host=os.environ.get("BIND_IP", "0.0.0.0"),
+        port=int(os.environ.get("DP_PORT", "8600")),
+    )
+    logger.info("external DP listening on %d", server.port)
+    server.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

@@ -141,6 +141,53 @@ def main() -> None:
 
         return jax.lax.fori_loop(0, ITERS, body, 0.0)
 
+    # ---- custom-kernel story: MXU packed walk vs flat gather walk ----------
+    # the trace-row-packed ancestor walk (one-hot einsums on the MXU) is the
+    # production default; the flat gather walk is what a naive translation
+    # would do. Chained+rtt-adjusted like everything else, few reps (the
+    # gather walk is ~1.1 s/iter).
+    WALK_MXU_ITERS, WALK_FLAT_ITERS = 8, 2  # flat is ~2 orders slower
+
+    @jax.jit
+    def flat_walk_chain():
+        def body(_i, acc):
+            # like-for-like: SAME depth cap as the packed walk
+            edges = window.dependency_edges(
+                jnp.asarray(parent),
+                jnp.asarray(kind),
+                jnp.ones(N_SPANS, bool),
+                endpoint_id + (acc > 1e30).astype(jnp.int32),
+                max_depth=bench_depth,
+            )
+            return acc + digest(tuple(edges))
+
+        return jax.lax.fori_loop(0, WALK_FLAT_ITERS, body, 0.0)
+
+    @jax.jit
+    def mxu_walk_chain():
+        def body(_i, acc):
+            edges = window.dependency_edges_packed(
+                parent_slot2,
+                kind2,
+                valid2,
+                ep2 + (acc > 1e30).astype(jnp.int32),
+                max_depth=bench_depth,
+            )
+            return acc + digest(tuple(edges))
+
+        return jax.lax.fori_loop(0, WALK_MXU_ITERS, body, 0.0)
+
+    walk_mxu_ms = (
+        max(_timed(lambda: float(mxu_walk_chain()), reps=3) - rtt, 0)
+        / WALK_MXU_ITERS
+        * 1000
+    )
+    walk_flat_ms = (
+        max(_timed(lambda: float(flat_walk_chain()), reps=3) - rtt, 0)
+        / WALK_FLAT_ITERS
+        * 1000
+    )
+
     total = _timed(lambda: float(window_chain()))
     # sustained ingest charges the per-window host packing cost the
     # production merge path pays, not just the device chain
@@ -477,6 +524,9 @@ def main() -> None:
         "e2e_host_cores": os.cpu_count(),
         "p50_graph_refresh_ms_10k_endpoints": round(refresh_ms, 2),
         "http_instability_10k_endpoints_ms": round(http_api_refresh_ms, 1),
+        "walk_mxu_packed_ms": round(walk_mxu_ms, 1),
+        "walk_flat_gather_ms": round(walk_flat_ms, 1),
+        "walk_mxu_speedup": round(walk_flat_ms / max(walk_mxu_ms, 1e-9), 1),
         "graph_refresh_target_ms": 50.0,
         "n_spans": N_SPANS,
         "n_endpoints": N_ENDPOINTS,

@@ -17,13 +17,29 @@ masking — see PAPERS.md) applied to the reference's hottest loop
 
 Use KMAMIZ_SEGMENT_BACKEND=pallas to switch the DataProcessor stats path
 (server/processor.py consults segment_backend()); window_stats also takes
-`backend=` directly. Measured on a v5e-1 at the bench shape (1M spans,
-80k segments) the one-hot matmul loses to XLA's scatter (~620 ms vs
-~28 ms: the dense one-hot does N*S work), so XLA stays the default; the
-kernel is kept as the MXU formulation for small segment counts and as
-the pattern the packed dependency walk (window.dependency_edges_packed)
-builds on. Numerical note: matmul accumulation reassociates float adds,
-so sums can differ from the scatter path by float32 rounding
+`backend=` directly.
+
+Honest result of the backend shoot-out (v5e-1, tunnel-rtt-adjusted,
+fori-chained — the r2 sweep):
+
+    spans    segments   xla scatter   pallas one-hot
+    32k      512        15.0 ms*      14.6 ms*
+    32k      4,096      14.8 ms*      15.6 ms*
+    131k     4,096      16.7 ms       19.6 ms
+    2M       80,000     75.5 ms       1,270 ms
+    (* small shapes are dispatch-overhead-bound; the backends tie)
+
+The dense one-hot does N*S work, so it cannot win at the production
+shape and only ties where overhead dominates — XLA's scatter stays the
+default, and that is a measured conclusion, not a guess. The MXU idea
+DOES win where the operand structure fits the systolic array: the
+trace-row-packed ancestor walk (window.dependency_edges_packed), built
+on this kernel's one-hot-einsum pattern with row-LOCAL (64-slot)
+one-hots, beats the flat gather walk by >=50x at 1M spans at the SAME
+depth cap (flat ~0.7-1.1 s/window; packed under ~20 ms, inside the
+tunnel's measurement noise — reported per-run as walk_* in bench.py)
+and has been the production default since round 1. Numerical note: matmul accumulation reassociates float
+adds, so sums can differ from the scatter path by float32 rounding
 (tests/test_ops_window.py asserts tight rtol, counts and maxes exact).
 """
 from __future__ import annotations

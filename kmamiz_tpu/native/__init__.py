@@ -257,21 +257,24 @@ def parse_spans(raw: bytes, skip_trace_ids: Sequence = ()) -> Optional[dict]:
         if ok != 1:
             return None
         pos = 32
-        latency_ms = np.frombuffer(buf, np.float64, n, pos).copy()
+        # read-only VIEWS over `buf` (which the arrays keep alive via
+        # .base): raw_spans_to_batch copies once into its padded arrays,
+        # so eager copies here would be a second full pass
+        latency_ms = np.frombuffer(buf, np.float64, n, pos)
         pos += 8 * n
         timestamp_raw = np.frombuffer(buf, np.float64, n, pos)
         pos += 8 * n
-        shape_max_ts_ms = np.frombuffer(buf, np.float64, n_shapes, pos).copy()
+        shape_max_ts_ms = np.frombuffer(buf, np.float64, n_shapes, pos)
         pos += 8 * n_shapes
-        parent_idx = np.frombuffer(buf, np.int32, n, pos).copy()
+        parent_idx = np.frombuffer(buf, np.int32, n, pos)
         pos += 4 * n
-        shape_id = np.frombuffer(buf, np.int32, n, pos).copy()
+        shape_id = np.frombuffer(buf, np.int32, n, pos)
         pos += 4 * n
-        status_id = np.frombuffer(buf, np.int32, n, pos).copy()
+        status_id = np.frombuffer(buf, np.int32, n, pos)
         pos += 4 * n
-        trace_of = np.frombuffer(buf, np.int32, n, pos).copy()
+        trace_of = np.frombuffer(buf, np.int32, n, pos)
         pos += 4 * n
-        kind = np.frombuffer(buf, np.int8, n, pos).copy()
+        kind = np.frombuffer(buf, np.int8, n, pos)
         pos += n
 
         shapes = []

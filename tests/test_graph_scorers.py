@@ -16,17 +16,22 @@ def build_graph(trace_groups):
     return batch, graph
 
 
-def host_scores(trace_groups):
-    # Fold per-span records into per-endpoint records one at a time: each
-    # single-record combineWith unions (endpoint, distance) sets. (A bulk
-    # combineWith would drop same-window duplicate records' edges — the
-    # reference's Map.set overwrite quirk; the device store keeps the union.)
+def build_host_deps(trace_groups):
+    """Fold per-span records into per-endpoint records one at a time: each
+    single-record combineWith unions (endpoint, distance) sets. (A bulk
+    combineWith would drop same-window duplicate records' edges — the
+    reference's Map.set overwrite quirk; the device store keeps the union.)"""
     from kmamiz_tpu.domain.endpoint_dependencies import EndpointDependencies
 
     raw = Traces(trace_groups).to_endpoint_dependencies()
     deps = EndpointDependencies([])
     for record in raw.to_json():
         deps = deps.combine_with(EndpointDependencies([record]))
+    return deps
+
+
+def host_scores(trace_groups):
+    deps = build_host_deps(trace_groups)
     return {
         "instability": {
             s["uniqueServiceName"]: s for s in deps.to_service_instability()
@@ -109,16 +114,11 @@ def test_incremental_merge_is_union(pdas_traces, bookinfo_traces):
 def test_load_dependencies_warm_start(bookinfo_traces):
     """Restart path: a graph rebuilt from the persisted dependency-cache
     JSON must carry the same edges and scores as one built from spans."""
-    from kmamiz_tpu.domain.endpoint_dependencies import EndpointDependencies
-
     batch = spans_to_batch(bookinfo_traces)
     from_spans = EndpointGraph(interner=batch.interner)
     from_spans.merge_window(batch)
 
-    raw = Traces(bookinfo_traces).to_endpoint_dependencies()
-    deps = EndpointDependencies([])
-    for record in raw.to_json():
-        deps = deps.combine_with(EndpointDependencies([record]))
+    deps = build_host_deps(bookinfo_traces)
 
     warmed = EndpointGraph()
     warmed.load_dependencies(deps.to_json())
@@ -170,6 +170,63 @@ def test_deprecated_endpoints_age_out(pdas_traces, monkeypatch):
     # threshold unset (default): nothing ages out
     monkeypatch.setattr(settings, "deprecated_endpoint_threshold", "")
     assert graph.active_services().any()
+
+
+@pytest.mark.parametrize("corpus", ["pdas", "bookinfo"])
+def test_device_risk_matches_host(corpus, pdas_traces, bookinfo_traces):
+    """The device risk pipeline (ops/scorers.risk_scores over the graph
+    store's relying-factor/ACS) against the host RiskAnalyzer port on the
+    same window — full impact x probability chain, not just shapes."""
+    from kmamiz_tpu.analytics import risk as risk_analyzer
+
+    trace_groups = [pdas_traces] if corpus == "pdas" else bookinfo_traces
+    batch, graph = build_graph(trace_groups)
+
+    svc_deps = build_host_deps(trace_groups).to_service_dependencies()
+    data = (
+        Traces(trace_groups)
+        .combine_logs_to_realtime_data([])
+        .to_combined_realtime_data()
+        .to_json()
+    )
+    host = {
+        r["uniqueServiceName"]: r
+        for r in risk_analyzer.realtime_risk(data, svc_deps, [])
+    }
+
+    scores = graph.service_scores()
+    services = graph.interner.services
+    S = int(np.asarray(scores.acs).shape[0])
+    req = np.zeros(S, dtype=np.float32)
+    err = np.zeros(S, dtype=np.float32)
+    cvw = np.zeros(S, dtype=np.float32)
+    active = np.zeros(S, dtype=bool)
+    for r in data:
+        sid = services.get(r["uniqueServiceName"])
+        assert sid is not None  # rt-space services intern alongside graph's
+        req[sid] += r["combined"]
+        if str(r["status"]).startswith("5"):
+            err[sid] += r["combined"]
+        cvw[sid] += (r["latency"].get("cv") or 0.0) * r["combined"]
+        active[sid] = True
+
+    out = scorer_ops.risk_scores(
+        scores.relying_factor,
+        scores.acs,
+        jnp.ones(S, dtype=jnp.float32),
+        jnp.asarray(req),
+        jnp.asarray(err),
+        jnp.asarray(cvw),
+        jnp.asarray(active),
+    )
+    risk = np.asarray(out.risk)
+    norm = np.asarray(out.norm_risk)
+    assert host
+    for name, h in host.items():
+        sid = services.get(name)
+        assert risk[sid] == pytest.approx(h["risk"], rel=1e-5), name
+        if len(host) > 1:  # single-service norm is the host-preserved quirk
+            assert norm[sid] == pytest.approx(h["norm"], rel=1e-5), name
 
 
 def test_risk_scores_shape(pdas_traces):

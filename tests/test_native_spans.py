@@ -245,6 +245,47 @@ class TestJsonEdgeCases:
         s2 = {"traceId": "t", "id": "b", "timestamp": 1}
         roundtrip([[s1, s2]])
 
+    def test_structural_chars_inside_skipped_values(self):
+        # skipped strings/objects carrying JSON structural characters and
+        # escape sequences must not desync the scanner
+        span = {
+            "traceId": "t1",
+            "id": "a",
+            "kind": "SERVER",
+            "name": "n",
+            "timestamp": 5,
+            "duration": -1.5e-3,
+            "localEndpoint": {"ipv4": "10.0.0.1", "note": '}],[{"id":"fake"}'},
+            "annotations": [{"value": 'quote \\" and ]} inside'}],
+            "tags": {
+                "http.status_code": "200",
+                "weird": "[Request a/b/c/d] {not json}",
+                "depth": {"a": [{"b": [[]]}]},
+            },
+        }
+        span2 = {"traceId": "t1", "id": "b", "timestamp": 6}
+        raw = json.dumps([[span, span2]]).encode()
+        groups = json.loads(raw)
+        host = spans_to_batch(groups)
+        out = raw_spans_to_batch(raw)
+        assert out is not None
+        assert_batches_equal(host, out[0])
+
+    def test_unicode_separators_and_big_numbers(self):
+        span = {
+            "traceId": "t sep",
+            "id": "x",
+            "name": "svc line",
+            "timestamp": 9_007_199_254_740_991,  # 2^53-1, exact in double
+            "duration": 1e18,  # forces the strtod slow path
+            "tags": {"http.url": "http://h/p?q=", "http.status_code": "200"},
+        }
+        raw = json.dumps([[span]]).encode()
+        host = spans_to_batch(json.loads(raw))
+        out = raw_spans_to_batch(raw)
+        assert out is not None
+        assert_batches_equal(host, out[0])
+
     def test_malformed_returns_none(self):
         assert raw_spans_to_batch(b"[[{") is None
         assert raw_spans_to_batch(b"not json") is None
@@ -308,6 +349,71 @@ class TestRawIngestSurface:
                 assert e.code == 400
         finally:
             server.stop()
+
+
+class TestConcurrentIngest:
+    def test_parallel_ingest_and_collect_lose_nothing(self):
+        """/ingest backfills race the realtime tick on a ThreadingHTTPServer;
+        the dedup map and edge store are lock-protected — no window may
+        vanish and every distinct trace is counted exactly once."""
+        import threading
+
+        from kmamiz_tpu.server.processor import DataProcessor
+
+        def span(tag, t, j, kind_):
+            svc = f"svc{(t + j) % 3}"
+            return {
+                "traceId": f"{tag}-{t}",
+                "id": f"{tag}-{t}-{j}",
+                "parentId": f"{tag}-{t}-{j-1}" if j else None,
+                "kind": kind_,
+                "name": f"{svc}.ns.svc.cluster.local:80/*",
+                "timestamp": 1_700_000_000_000_000 + t,
+                "duration": 100,
+                "tags": {
+                    "http.method": "GET",
+                    "http.status_code": "200",
+                    "http.url": f"http://{svc}.ns.svc.cluster.local/api",
+                    "istio.canonical_service": svc,
+                    "istio.namespace": "ns",
+                    "istio.canonical_revision": "v1",
+                },
+            }
+
+        def window(tag, n_traces=20):
+            # SERVER -> CLIENT -> SERVER chains so every trace yields edges
+            return [
+                [span(tag, t, 0, "SERVER"), span(tag, t, 1, "CLIENT"),
+                 span(tag, t, 2, "SERVER")]
+                for t in range(n_traces)
+            ]
+
+        dp = DataProcessor(trace_source=lambda lb, t, lim: [])
+        totals = []
+        errors = []
+
+        def worker(k):
+            try:
+                for i in range(5):
+                    s = dp.ingest_raw_window(
+                        json.dumps(window(f"w{k}-{i}")).encode()
+                    )
+                    totals.append(s["traces"])
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker, args=(k,)) for k in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        assert sum(totals) == 4 * 5 * 20  # every distinct trace counted once
+        assert len(dp._processed) == 4 * 5 * 20
+        assert dp.graph.n_edges > 0
+        # re-ingesting any window is fully deduplicated
+        s = dp.ingest_raw_window(json.dumps(window("w0-0")).encode())
+        assert s["traces"] == 0
 
 
 class TestFuzzParity:

@@ -138,6 +138,9 @@ def restore_checkpoint(
     # rebuild with the TEMPLATE's NamedTuple type: GAT checkpoints restore
     # into GatParams, SAGE into SageParams
     params = type(params_template)(
-        **{k: jax.numpy.asarray(v) for k, v in payload["params"].items()}
+        **{
+            k: jax.numpy.asarray(v) if v is not None else None
+            for k, v in payload["params"].items()
+        }
     )
     return params, payload["opt_state"], meta

@@ -23,7 +23,7 @@ import jax
 import jax.numpy as jnp
 
 from kmamiz_tpu.models import common
-from kmamiz_tpu.models.graphsage import NUM_FEATURES
+from kmamiz_tpu.models.graphsage import EMB_DIM, NUM_FEATURES
 
 LEAK = 0.2
 
@@ -47,12 +47,17 @@ class GatParams(NamedTuple):
     b_anomaly: jnp.ndarray  # [1]
     w_latency_skip: jnp.ndarray  # [F, 1]
     w_anomaly_skip: jnp.ndarray  # [F, 1]
+    embedding: object  # [num_nodes, EMB_DIM] learned node identity, or None
 
 
 def init_params(
-    rng: jax.Array, hidden: int = 64, num_features: int = NUM_FEATURES
+    rng: jax.Array,
+    hidden: int = 64,
+    num_features: int = NUM_FEATURES,
+    num_nodes: int = 0,
 ) -> GatParams:
-    k = jax.random.split(rng, 12)
+    k = jax.random.split(rng, 13)
+    in_dim = num_features + (EMB_DIM if num_nodes else 0)
 
     def glorot(key, shape):
         scale = jnp.sqrt(2.0 / (shape[0] + shape[-1]))
@@ -62,7 +67,7 @@ def init_params(
         return jax.random.normal(key, (h,), dtype=jnp.float32) * 0.1
 
     return GatParams(
-        w_1=glorot(k[0], (num_features, hidden)),
+        w_1=glorot(k[0], (in_dim, hidden)),
         a_src_1=att(k[1], hidden),
         a_dst_1=att(k[2], hidden),
         a_src_1r=att(k[3], hidden),
@@ -81,6 +86,12 @@ def init_params(
         # wide-and-deep input skips (see graphsage.init_params)
         w_latency_skip=jnp.zeros((num_features, 1), dtype=jnp.float32),
         w_anomaly_skip=jnp.zeros((num_features, 1), dtype=jnp.float32),
+        embedding=(
+            jax.random.normal(k[12], (num_nodes, EMB_DIM), dtype=jnp.float32)
+            * 0.1
+            if num_nodes
+            else None  # None, not [0, D]: orbax cannot save zero-size arrays
+        ),
     )
 
 
@@ -134,8 +145,11 @@ def forward(
     edge_mask: jnp.ndarray,
 ):
     """Two attention layers -> (latency prediction [N], anomaly logits [N])."""
+    x = features
+    if params.embedding is not None:
+        x = jnp.concatenate([features, params.embedding], axis=1)
     h1 = _layer(
-        features, src_ep, dst_ep, edge_mask,
+        x, src_ep, dst_ep, edge_mask,
         params.w_1, params.a_src_1, params.a_dst_1,
         params.a_src_1r, params.a_dst_1r, params.b_1,
     )

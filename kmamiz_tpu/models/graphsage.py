@@ -69,20 +69,32 @@ class SageParams(NamedTuple):
     b_anomaly: jnp.ndarray  # [1]
     w_latency_skip: jnp.ndarray  # [F, 1]
     w_anomaly_skip: jnp.ndarray  # [F, 1]
+    embedding: object  # [num_nodes, EMB_DIM] learned node identity, or None
+    # ([0, EMB_DIM] disables: identity-free features cannot express
+    # per-node periodic behavior like "db-query errors nightly")
+
+
+EMB_DIM = 8  # learned node-identity embedding width
 
 
 def init_params(
-    rng: jax.Array, hidden: int = 64, num_features: int = NUM_FEATURES
+    rng: jax.Array,
+    hidden: int = 64,
+    num_features: int = NUM_FEATURES,
+    num_nodes: int = 0,
 ) -> SageParams:
-    k = jax.random.split(rng, 6)
+    """num_nodes > 0 adds a learned per-node embedding, concatenated to
+    the input features of layer 1 (the readout skips stay feature-only)."""
+    k = jax.random.split(rng, 7)
+    in_dim = num_features + (EMB_DIM if num_nodes else 0)
 
     def glorot(key, shape):
         scale = jnp.sqrt(2.0 / (shape[0] + shape[1]))
         return jax.random.normal(key, shape, dtype=jnp.float32) * scale
 
     return SageParams(
-        w_self_1=glorot(k[0], (num_features, hidden)),
-        w_neigh_1=glorot(k[1], (num_features, hidden)),
+        w_self_1=glorot(k[0], (in_dim, hidden)),
+        w_neigh_1=glorot(k[1], (in_dim, hidden)),
         b_1=jnp.zeros(hidden, dtype=jnp.float32),
         w_self_2=glorot(k[2], (hidden, hidden)),
         w_neigh_2=glorot(k[3], (hidden, hidden)),
@@ -96,6 +108,12 @@ def init_params(
         # features directly and the GNN trunk learns residuals
         w_latency_skip=jnp.zeros((num_features, 1), dtype=jnp.float32),
         w_anomaly_skip=jnp.zeros((num_features, 1), dtype=jnp.float32),
+        embedding=(
+            jax.random.normal(k[6], (num_nodes, EMB_DIM), dtype=jnp.float32)
+            * 0.1
+            if num_nodes
+            else None  # None, not [0, D]: orbax cannot save zero-size arrays
+        ),
     )
 
 
@@ -130,9 +148,12 @@ def forward(
     edge_mask: jnp.ndarray,
 ):
     """Two SAGE layers -> (latency prediction [N], anomaly logits [N])."""
-    agg1 = neighbor_mean(features, src_ep, dst_ep, edge_mask)
+    x = features
+    if params.embedding is not None:
+        x = jnp.concatenate([features, params.embedding], axis=1)
+    agg1 = neighbor_mean(x, src_ep, dst_ep, edge_mask)
     h1 = jax.nn.relu(
-        features @ params.w_self_1 + agg1 @ params.w_neigh_1 + params.b_1
+        x @ params.w_self_1 + agg1 @ params.w_neigh_1 + params.b_1
     )
     agg2 = neighbor_mean(h1, src_ep, dst_ep, edge_mask)
     h2 = jax.nn.relu(h1 @ params.w_self_2 + agg2 @ params.w_neigh_2 + params.b_2)

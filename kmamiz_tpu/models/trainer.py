@@ -183,6 +183,7 @@ def train(
     checkpoint_dir: str = "",
     checkpoint_every: int = 10,
     model=graphsage,
+    use_node_embeddings: bool = False,
 ) -> TrainResult:
     """Full-graph training, one step per slot per epoch.
 
@@ -192,7 +193,16 @@ def train(
     saved hyperparameters against the requested ones."""
     from kmamiz_tpu.models import checkpoint as ckpt
 
-    params = model.init_params(jax.random.PRNGKey(seed), hidden=hidden)
+    # node-identity embeddings are OPT-IN: on the small simulator meshes
+    # they overfit (held-out F1 drops ~0.02 and latency MAE inflates ~17x
+    # in the r2 experiment, MODELS.md); larger production graphs may want
+    # them for periodic per-node behavior
+    num_nodes = (
+        dataset.num_nodes if (use_node_embeddings and dataset is not None) else 0
+    )
+    params = model.init_params(
+        jax.random.PRNGKey(seed), hidden=hidden, num_nodes=num_nodes
+    )
     optimizer = model.make_optimizer(lr)
     opt_state = optimizer.init(params)
 
@@ -225,6 +235,7 @@ def train(
                 ("seed", seed),
                 ("model", model_name),
                 ("num_features", model.NUM_FEATURES),
+                ("num_nodes", num_nodes),
             ):
                 saved = meta.get(name)
                 if saved is None:
@@ -294,6 +305,7 @@ def train(
                     "seed": seed,
                     "model": model.__name__.rsplit(".", 1)[-1],
                     "num_features": model.NUM_FEATURES,
+                    "num_nodes": num_nodes,
                 },
             )
     return TrainResult(params, losses, lat_losses, ano_losses)

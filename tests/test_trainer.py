@@ -299,6 +299,42 @@ class TestCheckpointResume:
         with pytest.raises(ValueError, match="10-feature layout"):
             trainer.train(None, epochs=4, hidden=8, checkpoint_dir=str(tmp_path))
 
+    def test_node_embeddings_opt_in_trains_and_resumes(self, tmp_path, simulation):
+        """use_node_embeddings=True learns a per-node table and the
+        checkpoint round-trips it (num_nodes validated in metadata)."""
+        import numpy as np
+
+        from kmamiz_tpu.models import trainer
+
+        ds = trainer.dataset_from_simulation(
+            simulation.endpoint_dependencies,
+            simulation.realtime_data_per_slot,
+            simulation.replica_counts,
+        )
+        result = trainer.train(
+            ds,
+            epochs=2,
+            hidden=8,
+            use_node_embeddings=True,
+            checkpoint_dir=str(tmp_path),
+        )
+        emb = np.asarray(result.params.embedding)
+        assert emb.shape == (ds.num_nodes, 8)
+        # resuming with a different embedding setting is rejected
+        import pytest
+
+        with pytest.raises(ValueError, match="num_nodes"):
+            trainer.train(ds, epochs=3, hidden=8, checkpoint_dir=str(tmp_path))
+        # matching settings resume cleanly
+        result2 = trainer.train(
+            ds,
+            epochs=3,
+            hidden=8,
+            use_node_embeddings=True,
+            checkpoint_dir=str(tmp_path),
+        )
+        assert result2.params.embedding is not None
+
     def test_gat_checkpoint_restores_gat_params(self, tmp_path):
         """restore rebuilds the TEMPLATE's param type: a GAT checkpoint
         round-trips through GatParams, not SageParams."""

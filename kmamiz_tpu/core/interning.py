@@ -10,6 +10,7 @@ never leave the host.
 """
 from __future__ import annotations
 
+import threading
 from typing import Dict, Hashable, Iterable, List, Optional
 
 
@@ -70,27 +71,35 @@ class EndpointInterner:
         self.services = StringInterner()
         self._endpoint_service: List[int] = []
         self._endpoint_infos: List[Optional[dict]] = []
+        # shared across ingest threads (the /ingest backfill races the
+        # realtime tick, and the streaming pipeline overlaps the parse of
+        # chunk k+1 with the merge of chunk k): the GIL makes dict ops
+        # atomic but not the check-then-insert sequence, which could hand
+        # two ids to one endpoint. Interning is O(#shapes) per window on
+        # the raw path, so the lock is off the per-span hot loop.
+        self._intern_lock = threading.RLock()
 
     def intern_endpoint(
         self, unique_endpoint_name: str, info: Optional[dict] = None
     ) -> int:
         """Intern an endpoint name; optionally attach/refresh its metadata
         (the freshest-timestamp info wins)."""
-        eid = self.endpoints.get(unique_endpoint_name)
-        if eid is None:
-            eid = self.endpoints.intern(unique_endpoint_name)
-            parts = unique_endpoint_name.split("\t")
-            service_name = "\t".join(parts[:3])
-            sid = self.services.intern(service_name)
-            self._endpoint_service.append(sid)
-            self._endpoint_infos.append(None)
-        if info is not None:
-            existing = self._endpoint_infos[eid]
-            if existing is None or info.get("timestamp", 0) > existing.get(
-                "timestamp", 0
-            ):
-                self._endpoint_infos[eid] = info
-        return eid
+        with self._intern_lock:
+            eid = self.endpoints.get(unique_endpoint_name)
+            if eid is None:
+                eid = self.endpoints.intern(unique_endpoint_name)
+                parts = unique_endpoint_name.split("\t")
+                service_name = "\t".join(parts[:3])
+                sid = self.services.intern(service_name)
+                self._endpoint_service.append(sid)
+                self._endpoint_infos.append(None)
+            if info is not None:
+                existing = self._endpoint_infos[eid]
+                if existing is None or info.get("timestamp", 0) > existing.get(
+                    "timestamp", 0
+                ):
+                    self._endpoint_infos[eid] = info
+            return eid
 
     def service_of(self, endpoint_id: int) -> int:
         return self._endpoint_service[endpoint_id]

@@ -47,6 +47,7 @@ def _build() -> bool:
         "-O3",
         "-shared",
         "-fPIC",
+        "-pthread",
         "-std=c++17",
         "-o",
         str(_LIB_PATH),
@@ -109,6 +110,22 @@ def _open_and_bind() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_size_t),
         ]
         lib.km_parse_spans.restype = ctypes.c_void_p
+        lib.km_parse_spans_mt.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_size_t,
+            ctypes.c_char_p,
+            ctypes.c_size_t,
+            ctypes.c_int,
+            ctypes.POINTER(ctypes.c_size_t),
+        ]
+        lib.km_parse_spans_mt.restype = ctypes.c_void_p
+        lib.km_split_groups.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_size_t,
+            ctypes.c_int,
+            ctypes.POINTER(ctypes.c_size_t),
+        ]
+        lib.km_split_groups.restype = ctypes.c_void_p
         lib.km_free.argtypes = [ctypes.c_void_p]
         lib.km_free.restype = None
         return lib
@@ -209,19 +226,36 @@ SHAPE_HAS_REV = 1 << 5
 SHAPE_HAS_MESH = 1 << 6
 
 
-def parse_spans(raw: bytes, skip_trace_ids: Sequence = ()) -> Optional[dict]:
+def parse_threads() -> int:
+    """Worker count for the native span scan: KMAMIZ_PARSE_THREADS, else 0
+    (auto = hardware concurrency, capped at 16 in the extension)."""
+    try:
+        return int(os.environ.get("KMAMIZ_PARSE_THREADS", "0"))
+    except ValueError:
+        return 0
+
+
+def parse_spans(
+    raw: bytes, skip_trace_ids: Sequence = (), threads: Optional[int] = None
+) -> Optional[dict]:
     """Scan a raw Zipkin JSON response ([[span,...],...]) into SoA arrays.
 
     skip_trace_ids: already-processed trace ids (may contain None, matching
     DataProcessor._filter_traces semantics); groups whose first span carries
     one are dropped whole.
 
+    threads: native worker count (None -> KMAMIZ_PARSE_THREADS env, 0 ->
+    auto). The parallel scan preserves exact sequential semantics: group
+    dedup runs in document order during the prescan, and duplicate span
+    ids resolve first-position/last-wins via a document-order fixup.
+
     Returns None when the extension is unavailable or the input is
     malformed (callers fall back to json.loads + spans_to_batch), else a
     dict with numpy arrays (kind/parent_idx/shape_id/status_id/trace_of/
     latency_ms/timestamp_us), the distinct naming shapes
     [(fields7, url_present, presence_bits)], shape_max_ts_ms, distinct
-    status strings, and the kept trace ids (None markers preserved).
+    status strings, the kept trace ids (None markers preserved), and a
+    "timings" dict (native phase wall times, for honest bench accounting).
     """
     import numpy as np
 
@@ -237,11 +271,18 @@ def parse_spans(raw: bytes, skip_trace_ids: Sequence = ()) -> Optional[dict]:
             skip_blob += struct.pack("<BI", 1, len(b))
             skip_blob += b
 
+    if threads is None:
+        threads = parse_threads()
     out_len = ctypes.c_size_t(0)
     # the json buffer crosses ctypes without a copy (c_char_p on bytes)
     raw = bytes(raw) if not isinstance(raw, bytes) else raw
-    ptr = lib.km_parse_spans(
-        bytes(skip_blob), len(skip_blob), raw, len(raw), ctypes.byref(out_len)
+    ptr = lib.km_parse_spans_mt(
+        bytes(skip_blob),
+        len(skip_blob),
+        raw,
+        len(raw),
+        int(threads),
+        ctypes.byref(out_len),
     )
     if not ptr:
         return None
@@ -251,11 +292,25 @@ def parse_spans(raw: bytes, skip_trace_ids: Sequence = ()) -> Optional[dict]:
         lib.km_free(ptr)
 
     try:
-        ok, n, n_shapes, n_statuses, n_groups = struct.unpack_from(
-            "<5I", buf, 0
-        )
+        (
+            ok,
+            n,
+            n_shapes,
+            n_statuses,
+            n_groups,
+            prescan_us,
+            parse_us,
+            merge_packed,
+        ) = struct.unpack_from("<8I", buf, 0)
         if ok != 1:
             return None
+        # threads<<25 | merge_us (25-bit µs, ~33 s cap) — see kmamiz_spans.cpp
+        timings = {
+            "prescan_us": prescan_us,
+            "parse_us": parse_us,
+            "merge_us": merge_packed & 0x01FFFFFF,
+            "threads": merge_packed >> 25,
+        }
         pos = 32
         # read-only VIEWS over `buf` (which the arrays keep alive via
         # .base): raw_spans_to_batch copies once into its padded arrays,
@@ -325,7 +380,38 @@ def parse_spans(raw: bytes, skip_trace_ids: Sequence = ()) -> Optional[dict]:
         "shape_max_ts_ms": shape_max_ts_ms,
         "statuses": statuses,
         "trace_ids": trace_ids,
+        "timings": timings,
     }
+
+
+def split_groups(raw: bytes, n_chunks: int) -> Optional[List[bytes]]:
+    """Split a raw Zipkin response into <= n_chunks standalone responses,
+    each covering whole trace groups (for the streaming ingest pipeline).
+    Returns None when the extension is unavailable or the input is
+    malformed."""
+    lib = _load()
+    if lib is None:
+        return None
+    raw = bytes(raw) if not isinstance(raw, bytes) else raw
+    out_len = ctypes.c_size_t(0)
+    ptr = lib.km_split_groups(raw, len(raw), int(n_chunks), ctypes.byref(out_len))
+    if not ptr:
+        return None
+    try:
+        buf = ctypes.string_at(ptr, out_len.value)
+    finally:
+        lib.km_free(ptr)
+    try:
+        (n_ranges,) = struct.unpack_from("<I", buf, 0)
+        chunks = []
+        pos = 4
+        for _ in range(n_ranges):
+            begin, end = struct.unpack_from("<2Q", buf, pos)
+            pos += 16
+            chunks.append(b"[" + raw[begin:end] + b"]")
+        return chunks
+    except (struct.error, IndexError):
+        return None
 
 
 # ---------------------------------------------------------------------------

@@ -18,6 +18,7 @@ from __future__ import annotations
 import gzip
 import json
 import logging
+import os
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
@@ -69,9 +70,33 @@ def make_handler(processor: DataProcessor):
                 return
 
             if self.path.split("?", 1)[0].rstrip("/") == "/ingest":
-                # uncapped raw ingest: body IS the Zipkin response bytes
+                # uncapped raw ingest: body IS the Zipkin response bytes.
+                # Large bodies split on trace-group boundaries and flow
+                # through the pipelined path so the native parse of chunk
+                # k+1 overlaps the device merge of chunk k. Span-id maps
+                # are then scoped per chunk (the reference's own scope
+                # under paginated fetches; see ingest_raw_stream).
                 try:
-                    summary = processor.ingest_raw_window(raw)
+                    summary = None
+                    try:
+                        threshold = int(
+                            os.environ.get(
+                                "KMAMIZ_INGEST_STREAM_BYTES", 33554432
+                            )
+                        )
+                    except ValueError:  # malformed env is not a client error
+                        threshold = 33554432
+                    # gate on the DECOMPRESSED size (gzip bodies shrink
+                    # ~15x on the wire, exactly the payloads that want
+                    # the pipelined path)
+                    if len(raw) >= threshold:
+                        from kmamiz_tpu import native as native_mod
+
+                        chunks = native_mod.split_groups(raw, 8)
+                        if chunks is not None and len(chunks) > 1:
+                            summary = processor.ingest_raw_stream(chunks)
+                    if summary is None:
+                        summary = processor.ingest_raw_window(raw)
                 except ValueError as e:
                     self._send_json(400, {"error": str(e)})
                     return

@@ -10,13 +10,34 @@
 // string work (URL explode, interning) to Python -- which keeps naming
 // semantics byte-identical to the host implementation.
 //
+// Parallel structure (round 3): the single hot loop is split into phases so
+// the scan scales across cores the way the reference scaled by rewriting
+// its DP in Rust (/root/reference/deploy/README-DP.md):
+//   1. prescan (sequential): a string-aware bracket walk finds top-level
+//      trace-group boundaries and applies the processed-trace dedup in
+//      document order -- exact _filter_traces semantics.
+//   2. parse (parallel): kept groups are sliced into contiguous,
+//      byte-balanced ranges; each worker parses its range with a private
+//      arena + shape/status tables. With n_threads == 1 the prescan and
+//      parse fuse back into one pass (no second walk over the bytes).
+//   3. span-id table (parallel): span ids are interned AFTER the parse
+//      into a shared open-addressing table with atomic claims, in blocks
+//      with software prefetch -- the ~50 MB random-access table walks out
+//      of the scan loop and its cache misses overlap (MLP) instead of
+//      serializing behind string work. Duplicate ids (same id claimed by
+//      two rows) are recorded and resolved in document order afterwards:
+//      first position wins, last-written fields win, dead rows compact
+//      away, and the shape/status tables rebuild over surviving rows --
+//      byte-identical to the sequential last-wins semantics (the JS Map
+//      semantics of Traces.ts:119-126).
+//   4. parent resolution (parallel): read-only prefetched probes.
+//   5. serialize.
+//
 // Performance notes (single-core host next to the TPU tunnel): string
 // scanning rides glibc memchr (AVX2/512); keys dispatch on a
 // length-switch; integer JSON numbers take a no-strtod fast path; naming
-// shapes and statuses intern DURING the parse, with a rare fallback
-// recompute when duplicate span ids force last-wins overwrites (so tables
-// never contain values seen only in dead records, matching the JS Map
-// semantics of Traces.ts:119-126).
+// shapes and statuses intern DURING the parse (small, cache-resident
+// tables).
 //
 // Input payload (little-endian):
 //   u32 n_skip                     -- processed-trace dedup entries
@@ -25,7 +46,8 @@
 //
 // Output buffer (km_free to release), all little-endian:
 //   header: u32 ok, u32 n_spans, u32 n_shapes, u32 n_statuses,
-//           u32 n_groups, u32 reserved x3          (32 bytes)
+//           u32 n_groups, u32 prescan_us, u32 parse_us,
+//           u32 (threads<<25 | merge_us)                  (32 bytes)
 //   f64 latency_ms[n_spans]
 //   f64 timestamp_us[n_spans]     -- raw JSON number (int64-cast in numpy)
 //   f64 shape_max_ts_ms[n_shapes]
@@ -52,16 +74,33 @@
 //   the tag is reported via url_present so the realtime-space naming
 //   (js_str(None) == "undefined") reproduces first-seen behavior.
 
+#include <algorithm>
+#include <atomic>
+#include <chrono>
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
 #include <memory>
 #include <string>
+#include <thread>
 #include <vector>
 
 namespace {
 
 using sv = std::string_view;
+
+inline uint64_t now_us() {
+  return static_cast<uint64_t>(
+      std::chrono::duration_cast<std::chrono::microseconds>(
+          std::chrono::steady_clock::now().time_since_epoch())
+          .count());
+}
+
+inline void cpu_relax() {
+#if defined(__x86_64__) || defined(__i386__)
+  __builtin_ia32_pause();
+#endif
+}
 
 // -- arena for decoded (escaped) strings ------------------------------------
 
@@ -121,15 +160,102 @@ inline uint64_t swar_eq(uint64_t w, uint64_t pat) {
 constexpr uint64_t kQuotePat = 0x2222222222222222ull;   // '"'
 constexpr uint64_t kBslashPat = 0x5C5C5C5C5C5C5C5Cull;  // '\\'
 
+// -- wide scans with runtime dispatch ---------------------------------------
+// The string/value scans touch every input byte; on AVX-512 hosts a 64-byte
+// masked-compare iteration replaces 8 SWAR word steps. Dispatch is a
+// one-time cpuid check into function pointers; the SWAR forms are the
+// portable fallback (and the tail loop near the buffer end).
+
+// first '"' or '\\' at/after q; returns end when absent
+static const char* scan_special_swar(const char* q, const char* end) {
+  while (end - q >= 8) {
+    uint64_t w;
+    std::memcpy(&w, q, 8);
+    uint64_t m = swar_eq(w, kQuotePat) | swar_eq(w, kBslashPat);
+    if (m) return q + (__builtin_ctzll(m) >> 3);
+    q += 8;
+  }
+  while (q < end && *q != '"' && *q != '\\') ++q;
+  return q;
+}
+
+// first structural byte ('"', '{', '}', '[', ']') at/after q, else end
+static const char* scan_structural_swar(const char* q, const char* end) {
+  while (end - q >= 8) {
+    uint64_t w;
+    std::memcpy(&w, q, 8);
+    uint64_t wl = w | 0x2020202020202020ull;
+    uint64_t m = swar_eq(wl, 0x7B7B7B7B7B7B7B7Bull) |
+                 swar_eq(wl, 0x7D7D7D7D7D7D7D7Dull) | swar_eq(w, kQuotePat);
+    if (m) return q + (__builtin_ctzll(m) >> 3);
+    q += 8;
+  }
+  while (q < end && *q != '"' && *q != '{' && *q != '}' && *q != '[' &&
+         *q != ']')
+    ++q;
+  return q;
+}
+
+#if defined(__x86_64__)
+#include <immintrin.h>
+
+__attribute__((target("avx2"))) static const char* scan_special_avx2(
+    const char* q, const char* end) {
+  const __m256i vq = _mm256_set1_epi8('"');
+  const __m256i vb = _mm256_set1_epi8('\\');
+  while (end - q >= 32) {
+    __m256i v = _mm256_loadu_si256(reinterpret_cast<const __m256i*>(q));
+    uint32_t m = static_cast<uint32_t>(
+        _mm256_movemask_epi8(_mm256_or_si256(_mm256_cmpeq_epi8(v, vq),
+                                             _mm256_cmpeq_epi8(v, vb))));
+    if (m) return q + __builtin_ctz(m);
+    q += 32;
+  }
+  return scan_special_swar(q, end);
+}
+
+__attribute__((target("avx2"))) static const char* scan_structural_avx2(
+    const char* q, const char* end) {
+  const __m256i vq = _mm256_set1_epi8('"');
+  const __m256i vo = _mm256_set1_epi8('{');
+  const __m256i vc = _mm256_set1_epi8('}');
+  const __m256i lower = _mm256_set1_epi8(0x20);
+  while (end - q >= 32) {
+    __m256i v = _mm256_loadu_si256(reinterpret_cast<const __m256i*>(q));
+    __m256i vl = _mm256_or_si256(v, lower);
+    __m256i hit = _mm256_or_si256(
+        _mm256_or_si256(_mm256_cmpeq_epi8(vl, vo), _mm256_cmpeq_epi8(vl, vc)),
+        _mm256_cmpeq_epi8(v, vq));
+    uint32_t m = static_cast<uint32_t>(_mm256_movemask_epi8(hit));
+    if (m) return q + __builtin_ctz(m);
+    q += 32;
+  }
+  return scan_structural_swar(q, end);
+}
+#endif
+
+using scan_fn = const char* (*)(const char*, const char*);
+scan_fn g_scan_special = scan_special_swar;
+scan_fn g_scan_structural = scan_structural_swar;
+
+__attribute__((constructor)) static void init_scan_dispatch() {
+#if defined(__x86_64__)
+  if (__builtin_cpu_supports("avx2")) {
+    g_scan_special = scan_special_avx2;
+    g_scan_structural = scan_structural_avx2;
+  }
+#endif
+}
+
 // -- open-addressing string_view -> int32 map -------------------------------
 // One packed 24-byte slot per entry (cached hash + ptr/len + value): a probe
 // costs one cache line, and equality checks compare the 64-bit hash before
-// touching key bytes. With ~1M span ids the table is ~50 MB of random
-// access, so slot locality is the dominant cost.
+// touching key bytes. Used for the small sequential tables (trace-id dedup,
+// statuses); the big span-id table is the atomic SpanIdTable below.
 
 struct SvMap {
   struct Slot {
-    uint64_t hash;     // 0 = empty (hash_sv never returns 0; see intern)
+    uint64_t hash;  // 0 = empty (hash_sv never returns 0; see intern)
     const char* ptr;
     uint32_t len;
     int32_t val;
@@ -302,17 +428,9 @@ struct Scanner {
     return p < end && *p == c;
   }
 
-  // first '"' or '\\' at/after q (SWAR word scan; no call overhead)
+  // first '"' or '\\' at/after q (dispatched wide scan)
   const char* scan_special(const char* q) const {
-    while (end - q >= 8) {
-      uint64_t w;
-      std::memcpy(&w, q, 8);
-      uint64_t m = swar_eq(w, kQuotePat) | swar_eq(w, kBslashPat);
-      if (m) return q + (__builtin_ctzll(m) >> 3);
-      q += 8;
-    }
-    while (q < end && *q != '"' && *q != '\\') ++q;
-    return q;  // == end when not found
+    return g_scan_special(q, end);  // == end when not found
   }
 
   // decoded string; zero-copy when escape-free (the common case)
@@ -451,24 +569,8 @@ struct Scanner {
     int depth = 0;
     const char* q = p;
     while (q < end) {
-      uint64_t m = 0;
-      while (end - q >= 8) {
-        uint64_t w;
-        std::memcpy(&w, q, 8);
-        uint64_t wl = w | 0x2020202020202020ull;
-        m = swar_eq(wl, 0x7B7B7B7B7B7B7B7Bull) |
-            swar_eq(wl, 0x7D7D7D7D7D7D7D7Dull) | swar_eq(w, kQuotePat);
-        if (m) break;
-        q += 8;
-      }
-      if (m) {
-        q += __builtin_ctzll(m) >> 3;
-      } else {
-        while (q < end && *q != '"' && *q != '{' && *q != '}' && *q != '[' &&
-               *q != ']')
-          ++q;
-        if (q >= end) break;
-      }
+      q = g_scan_structural(q, end);
+      if (q >= end) break;
       char c = *q;
       switch (c) {
         case '"':
@@ -635,23 +737,6 @@ struct KeyPredictor {
       ++pos;
     }
   }
-};
-
-struct ParseResult {
-  std::vector<SpanRec> rows;
-  std::vector<int32_t> trace_of;
-  std::vector<int32_t> shape_id;   // valid when !had_duplicates
-  std::vector<int32_t> status_id;  // valid when !had_duplicates
-  ShapeTable shapes;
-  std::vector<sv> statuses;
-  std::vector<sv> kept_trace_ids;
-  std::vector<uint8_t> kept_trace_present;
-  SvMap span_index;  // final id -> first-position row
-  bool had_duplicates = false;
-  bool ok = false;
-
-  explicit ParseResult(size_t span_estimate)
-      : span_index(span_estimate + 64) {}
 };
 
 inline int8_t tag_handler(sv key) {
@@ -862,44 +947,152 @@ bool peek_trace_id(Scanner probe, sv* out, bool* present) {
 // sentinel for "traceId is Python None" in the seen-set
 const sv kNoneSentinel("\x01\x01\x01none", 7);
 
-ParseResult parse_all(const char* json, size_t json_len,
-                      const std::vector<std::pair<sv, bool>>& skip,
-                      Arena* arena) {
-  // presize the span-id index off the byte estimate: growing a ~50 MB
-  // table rehashes every id through random memory, costing more than the
-  // scan itself
-  ParseResult pr(json_len / 350);
-  Scanner s{json, json + json_len, arena};
+// -- phase 1: prescan -------------------------------------------------------
 
+struct GroupRange {
+  const char* begin;  // at the group's '['
+  const char* end;    // one past the group's ']'
+  sv tid;
+  bool tid_present;
+};
+
+// per-thread parse output: rows + small private tables
+struct ThreadOut {
+  std::vector<SpanRec> rows;
+  std::vector<int32_t> trace_of;   // GLOBAL kept-group index
+  std::vector<int32_t> shape_id;   // local shape ids
+  std::vector<int32_t> status_id;  // local status ids
+  ShapeTable shapes;
+  std::vector<sv> statuses;
+  Arena arena;
+  bool ok = true;
+  uint64_t busy_us = 0;
+};
+
+// direct-mapped shape-id cache: most windows carry a few hundred distinct
+// shapes but EVERY span pays the 7-string shape_hash without it. The cache
+// indexes on a 2-string hash (name+url distinguish almost all shapes) and
+// verifies with full shape_eq, so it is purely an optimization.
+struct ShapeCache {
+  static constexpr size_t kSize = 2048;
+  struct Entry {
+    uint64_t h2 = 0;
+    int32_t id = -1;
+  };
+  Entry entries[kSize];
+};
+
+// parse the spans of one kept group into `to` (local tables)
+bool parse_group_spans(Scanner& s, int32_t global_group, ThreadOut* to,
+                       KeyPredictor& span_pred, KeyPredictor& tag_pred,
+                       SvMap& status_map, sv& last_status,
+                       int32_t& last_status_id, ShapeCache& shape_cache) {
+  if (!s.eat('[')) return false;
+  bool first_span = true;
+  bool ins;
+  while (s.ok) {
+    s.ws();
+    if (s.peek(']')) {
+      ++s.p;
+      return true;
+    }
+    if (!first_span && !s.eat(',')) return false;
+    first_span = false;
+    SpanRec rec;
+    if (!parse_span(s, &rec, span_pred, tag_pred)) return false;
+
+    // shape + status intern on the thread-local tables; the (big) span-id
+    // table is deferred to the prefetched build phase
+    Shape sh;
+    sh.f[0] = rec.name;
+    sh.f[1] = rec.url;
+    sh.f[2] = rec.method;
+    sh.f[3] = rec.svc;
+    sh.f[4] = rec.ns;
+    sh.f[5] = rec.rev;
+    sh.f[6] = rec.mesh;
+    sh.key_present = rec.present & kKeyBits;
+    sh.url_present = rec.url_present ? 1 : 0;
+    int32_t sid = -1;
+    uint64_t h2 = hash_sv(rec.name) * 31 + hash_sv(rec.url) +
+                  (rec.present & kKeyBits);
+    ShapeCache::Entry& ce =
+        shape_cache.entries[h2 & (ShapeCache::kSize - 1)];
+    if (ce.h2 == h2 && ce.id >= 0 &&
+        shape_eq(to->shapes.shapes[ce.id], sh)) {
+      sid = ce.id;
+    } else {
+      sid = to->shapes.intern(sh);
+      ce.h2 = h2;
+      ce.id = sid;
+    }
+    Shape& stored = to->shapes.shapes[sid];
+    double ts_ms = rec.timestamp_raw / 1000.0;
+    if (!stored.has_ts || ts_ms > stored.max_ts_ms) {
+      stored.max_ts_ms = ts_ms;
+      stored.has_ts = true;
+    }
+    sv st = rec.status_present ? rec.status : sv("", 0);
+    int32_t stid;
+    if (last_status_id >= 0 && st == last_status) {
+      stid = last_status_id;
+    } else {
+      stid = status_map.intern(st, static_cast<int32_t>(to->statuses.size()),
+                               &ins);
+      if (ins) to->statuses.push_back(st);
+      last_status = st;
+      last_status_id = stid;
+    }
+    to->rows.push_back(rec);
+    to->trace_of.push_back(global_group);
+    to->shape_id.push_back(sid);
+    to->status_id.push_back(stid);
+  }
+  return s.ok;
+}
+
+// walk the top-level array: dedup groups in document order. When
+// `inline_out` is non-null (sequential mode) kept groups parse immediately
+// (single pass); otherwise their byte ranges are recorded for the workers.
+struct PrescanResult {
+  std::vector<GroupRange> kept;
+  bool ok = false;
+};
+
+PrescanResult prescan(const char* json, size_t json_len,
+                      const std::vector<std::pair<sv, bool>>& skip,
+                      Arena* arena, ThreadOut* inline_out) {
+  PrescanResult out;
+  Scanner s{json, json + json_len, arena};
   SvMap seen(skip.size() + 64);
   bool ins;
   for (auto& e : skip)
     seen.intern(e.second ? e.first : kNoneSentinel, 1, &ins);
 
-  SvMap status_map(64);
   KeyPredictor span_pred, tag_pred;
-  // one-entry status memo: windows carry a handful of distinct statuses and
-  // runs of identical ones, so most spans skip the map probe entirely
+  SvMap status_map(64);
   sv last_status;
   int32_t last_status_id = -1;
-  pr.rows.reserve(json_len / 400 + 16);
-  pr.trace_of.reserve(json_len / 400 + 16);
-  pr.shape_id.reserve(json_len / 400 + 16);
-  pr.status_id.reserve(json_len / 400 + 16);
+  auto shape_cache = std::make_unique<ShapeCache>();
+  if (inline_out) {
+    inline_out->rows.reserve(json_len / 400 + 16);
+    inline_out->trace_of.reserve(json_len / 400 + 16);
+    inline_out->shape_id.reserve(json_len / 400 + 16);
+    inline_out->status_id.reserve(json_len / 400 + 16);
+  }
 
-  if (!s.eat('[')) return pr;
+  if (!s.eat('[')) return out;
   bool first_group = true;
-  int32_t group_idx = 0;
   while (s.ok) {
     s.ws();
     if (s.peek(']')) {
       ++s.p;
       break;
     }
-    if (!first_group && !s.eat(',')) return pr;
+    if (!first_group && !s.eat(',')) return out;
     first_group = false;
     s.ws();
-    if (!s.peek('[')) return pr;
+    if (!s.peek('[')) return out;
     {
       Scanner probe = s;
       probe.eat('[');
@@ -910,119 +1103,501 @@ ParseResult parse_all(const char* json, size_t json_len,
         continue;
       }
     }
+    sv tid;
+    bool tid_present = false;
     {
       Scanner probe = s;
       probe.eat('[');
-      sv tid;
-      bool tid_present = false;
-      if (!peek_trace_id(probe, &tid, &tid_present)) return pr;
-      sv seen_key = tid_present ? tid : kNoneSentinel;
-      if (seen.find(seen_key) != nullptr) {
-        s.skip_value();  // whole group already processed
-        continue;
-      }
-      seen.intern(seen_key, 1, &ins);
-      pr.kept_trace_ids.push_back(tid);
-      pr.kept_trace_present.push_back(tid_present ? 1 : 0);
+      if (!peek_trace_id(probe, &tid, &tid_present)) return out;
     }
-    s.eat('[');
-    bool first_span = true;
-    while (s.ok) {
-      s.ws();
-      if (s.peek(']')) {
-        ++s.p;
-        break;
-      }
-      if (!first_span && !s.eat(',')) return pr;
-      first_span = false;
-      SpanRec rec;
-      if (!parse_span(s, &rec, span_pred, tag_pred)) return pr;
+    sv seen_key = tid_present ? tid : kNoneSentinel;
+    if (seen.find(seen_key) != nullptr) {
+      s.skip_value();  // whole group already processed
+      if (!s.ok) return out;
+      continue;
+    }
+    seen.intern(seen_key, 1, &ins);
+    int32_t gidx = static_cast<int32_t>(out.kept.size());
+    const char* gbegin = s.p;
+    if (inline_out) {
+      if (!parse_group_spans(s, gidx, inline_out, span_pred, tag_pred,
+                             status_map, last_status, last_status_id,
+                             *shape_cache))
+        return out;
+      out.kept.push_back(GroupRange{gbegin, s.p, tid, tid_present});
+    } else {
+      s.skip_value();
+      if (!s.ok) return out;
+      out.kept.push_back(GroupRange{gbegin, s.p, tid, tid_present});
+    }
+  }
+  out.ok = s.ok;
+  return out;
+}
 
-      int32_t next_row = static_cast<int32_t>(pr.rows.size());
-      int32_t row = pr.span_index.intern(rec.id, next_row, &ins);
-      if (!ins) {
-        pr.rows[row] = rec;  // last wins; first position kept
-        pr.had_duplicates = true;
+// -- phase 2: parallel group parsing ----------------------------------------
+
+void parse_range(const std::vector<GroupRange>& kept, size_t g0, size_t g1,
+                 ThreadOut* to) {
+  uint64_t t0 = now_us();
+  KeyPredictor span_pred, tag_pred;
+  SvMap status_map(64);
+  sv last_status;
+  int32_t last_status_id = -1;
+  auto shape_cache = std::make_unique<ShapeCache>();
+  size_t bytes = 0;
+  for (size_t g = g0; g < g1; ++g)
+    bytes += static_cast<size_t>(kept[g].end - kept[g].begin);
+  to->rows.reserve(bytes / 400 + 16);
+  to->trace_of.reserve(bytes / 400 + 16);
+  to->shape_id.reserve(bytes / 400 + 16);
+  to->status_id.reserve(bytes / 400 + 16);
+  for (size_t g = g0; g < g1; ++g) {
+    Scanner s{kept[g].begin, kept[g].end, &to->arena};
+    if (!parse_group_spans(s, static_cast<int32_t>(g), to, span_pred,
+                           tag_pred, status_map, last_status,
+                           last_status_id, *shape_cache)) {
+      to->ok = false;
+      break;
+    }
+  }
+  to->busy_us = now_us() - t0;
+}
+
+// -- phase 3: shared span-id table with atomic claims -----------------------
+// Claim protocol: CAS the hash word 0 -> h; the winner then publishes its
+// row with release. A prober that sees a matching hash spins for the row
+// (claims publish within a few instructions), compares key bytes through
+// the flat id array, and either records a duplicate or walks on (distinct
+// key, same 64-bit hash). Single-threaded this degenerates to uncontended
+// atomics -- one code path for both modes.
+
+struct SpanIdTable {
+  struct Slot {
+    std::atomic<uint64_t> hash;
+    std::atomic<int32_t> row;
+  };
+  std::unique_ptr<Slot[]> slots;
+  size_t mask;
+
+  explicit SpanIdTable(size_t n_rows) {
+    size_t n = 64;
+    while (n < n_rows * 2) n <<= 1;
+    slots.reset(new Slot[n]);
+    for (size_t i = 0; i < n; ++i) {
+      slots[i].hash.store(0, std::memory_order_relaxed);
+      slots[i].row.store(-1, std::memory_order_relaxed);
+    }
+    mask = n - 1;
+  }
+
+  // returns -1 when `row` claimed the slot, else the slot index of the
+  // existing claim (a duplicate id)
+  int64_t claim(sv key, uint64_t h, int32_t row, const sv* ids) {
+    size_t j = h & mask;
+    for (;;) {
+      uint64_t cur = slots[j].hash.load(std::memory_order_acquire);
+      if (cur == 0) {
+        if (slots[j].hash.compare_exchange_strong(
+                cur, h, std::memory_order_acq_rel)) {
+          slots[j].row.store(row, std::memory_order_release);
+          return -1;
+        }
+        // lost the race; cur now holds the winner's hash -- fall through
+      }
+      if (cur == h) {
+        int32_t r;
+        while ((r = slots[j].row.load(std::memory_order_acquire)) < 0)
+          cpu_relax();
+        const sv& k = ids[r];
+        if (k.size() == key.size() &&
+            std::memcmp(k.data(), key.data(), key.size()) == 0)
+          return static_cast<int64_t>(j);
+        // same hash, different key: keep probing
+      }
+      j = (j + 1) & mask;
+    }
+  }
+
+  // read-only lookup (post-build); -1 when absent
+  int32_t find(sv key, uint64_t h, const sv* ids) const {
+    size_t j = h & mask;
+    for (;;) {
+      uint64_t cur = slots[j].hash.load(std::memory_order_acquire);
+      if (cur == 0) return -1;
+      if (cur == h) {
+        int32_t r = slots[j].row.load(std::memory_order_acquire);
+        if (r >= 0) {
+          const sv& k = ids[r];
+          if (k.size() == key.size() &&
+              std::memcmp(k.data(), key.data(), key.size()) == 0)
+            return r;
+        }
+      }
+      j = (j + 1) & mask;
+    }
+  }
+};
+
+constexpr size_t kPrefetchBlock = 32;
+
+// insert rows [r0, r1) into the table in prefetched blocks; duplicate
+// claims append (slot, row) to `dups`
+void build_table_range(SpanIdTable& tab, const sv* ids, size_t r0, size_t r1,
+                       std::vector<std::pair<int64_t, int32_t>>* dups) {
+  uint64_t hashes[kPrefetchBlock];
+  for (size_t b = r0; b < r1; b += kPrefetchBlock) {
+    size_t e = b + kPrefetchBlock < r1 ? b + kPrefetchBlock : r1;
+    for (size_t i = b; i < e; ++i) {
+      uint64_t h = SvMap::key_hash(ids[i]);
+      hashes[i - b] = h;
+      __builtin_prefetch(&tab.slots[h & tab.mask], 1, 1);
+    }
+    for (size_t i = b; i < e; ++i) {
+      int64_t slot = tab.claim(ids[i], hashes[i - b],
+                               static_cast<int32_t>(i), ids);
+      if (slot >= 0) dups->emplace_back(slot, static_cast<int32_t>(i));
+    }
+  }
+}
+
+// resolve parent ids for rows [r0, r1) in prefetched blocks
+void resolve_parents_range(const SpanIdTable& tab, const sv* ids,
+                           const sv* parents, const uint8_t* has_parent,
+                           size_t r0, size_t r1, int32_t* parent_idx) {
+  uint64_t hashes[kPrefetchBlock];
+  for (size_t b = r0; b < r1; b += kPrefetchBlock) {
+    size_t e = b + kPrefetchBlock < r1 ? b + kPrefetchBlock : r1;
+    for (size_t i = b; i < e; ++i) {
+      if (!has_parent[i]) {
+        hashes[i - b] = 0;
         continue;
       }
-      pr.rows.push_back(rec);
-      pr.trace_of.push_back(group_idx);
-      pr.shape_id.push_back(0);
-      pr.status_id.push_back(0);
-      size_t r = static_cast<size_t>(next_row);
-      // intern shape + status inline (recomputed later if duplicates)
-      {
-        const SpanRec& rr = pr.rows[r];
-        Shape sh;
-        sh.f[0] = rr.name;
-        sh.f[1] = rr.url;
-        sh.f[2] = rr.method;
-        sh.f[3] = rr.svc;
-        sh.f[4] = rr.ns;
-        sh.f[5] = rr.rev;
-        sh.f[6] = rr.mesh;
-        sh.key_present = rr.present & kKeyBits;
-        sh.url_present = rr.url_present ? 1 : 0;
-        int32_t sid = pr.shapes.intern(sh);
-        pr.shape_id[r] = sid;
-        Shape& stored = pr.shapes.shapes[sid];
-        double ts_ms = rr.timestamp_raw / 1000.0;
-        if (!stored.has_ts || ts_ms > stored.max_ts_ms) {
-          stored.max_ts_ms = ts_ms;
+      uint64_t h = SvMap::key_hash(parents[i]);
+      hashes[i - b] = h;
+      __builtin_prefetch(&tab.slots[h & tab.mask], 0, 1);
+    }
+    for (size_t i = b; i < e; ++i) {
+      parent_idx[i] =
+          has_parent[i] ? tab.find(parents[i], hashes[i - b], ids) : -1;
+    }
+  }
+}
+
+// -- assembled result (pre-serialization) -----------------------------------
+
+struct Assembled {
+  size_t n = 0;
+  std::vector<SpanRec> rows;  // flat, document order (moved/copied)
+  std::vector<int32_t> trace_of;
+  std::vector<int32_t> shape_id;   // global ids
+  std::vector<int32_t> status_id;  // global ids
+  std::vector<int32_t> parent_idx;
+  ShapeTable shapes;        // global
+  std::vector<sv> statuses;  // global
+  std::vector<GroupRange> kept;
+  bool ok = false;
+  uint32_t prescan_us = 0, parse_us = 0, merge_us = 0;
+  uint32_t threads = 1;
+};
+
+// merge thread outputs + build span table + dedup fixup + parents.
+// `outs` rows are consumed (moved into the flat arrays).
+void assemble(std::vector<ThreadOut>& outs, PrescanResult&& ps,
+              Assembled* as, unsigned n_threads) {
+  uint64_t m0 = now_us();
+  as->kept = std::move(ps.kept);
+
+  size_t n = 0;
+  for (auto& t : outs) n += t.rows.size();
+  as->n = n;
+
+  if (outs.size() == 1) {
+    // single worker: its tables ARE the global tables (ids assigned in
+    // document order already) -- move, don't copy ~150 MB of rows
+    ThreadOut& t = outs[0];
+    as->rows = std::move(t.rows);
+    as->trace_of = std::move(t.trace_of);
+    as->shape_id = std::move(t.shape_id);
+    as->status_id = std::move(t.status_id);
+    as->shapes = std::move(t.shapes);
+    as->statuses = std::move(t.statuses);
+  } else {
+    as->rows.reserve(n);
+    as->trace_of.reserve(n);
+    as->shape_id.reserve(n);
+    as->status_id.reserve(n);
+
+    // global shape/status tables in document order (threads own
+    // contiguous document ranges, merged ascending -> first-appearance
+    // order matches the sequential scan)
+    for (auto& t : outs) {
+      std::vector<int32_t> shape_remap(t.shapes.shapes.size());
+      for (size_t i = 0; i < t.shapes.shapes.size(); ++i) {
+        const Shape& sh = t.shapes.shapes[i];
+        int32_t gid = as->shapes.intern(sh);
+        Shape& stored = as->shapes.shapes[gid];
+        if (sh.has_ts &&
+            (!stored.has_ts || sh.max_ts_ms > stored.max_ts_ms)) {
+          stored.max_ts_ms = sh.max_ts_ms;
           stored.has_ts = true;
         }
-        sv st = rr.status_present ? rr.status : sv("", 0);
-        int32_t stid;
-        if (last_status_id >= 0 && st == last_status) {
-          stid = last_status_id;
-        } else {
-          stid = status_map.intern(
-              st, static_cast<int32_t>(pr.statuses.size()), &ins);
-          if (ins) pr.statuses.push_back(st);
-          last_status = st;
-          last_status_id = stid;
-        }
-        pr.status_id[r] = stid;
+        shape_remap[i] = gid;
+      }
+      for (size_t i = 0; i < t.rows.size(); ++i) {
+        as->trace_of.push_back(t.trace_of[i]);
+        as->shape_id.push_back(shape_remap[t.shape_id[i]]);
+        as->status_id.push_back(t.status_id[i]);  // local; remapped below
+      }
+      for (auto& r : t.rows) as->rows.push_back(r);
+    }
+
+    // global status interning (document order across threads)
+    SvMap status_map(64);
+    bool ins;
+    std::vector<std::vector<int32_t>> remaps(outs.size());
+    for (size_t ti = 0; ti < outs.size(); ++ti) {
+      auto& t = outs[ti];
+      remaps[ti].resize(t.statuses.size());
+      for (size_t i = 0; i < t.statuses.size(); ++i) {
+        int32_t gid = status_map.intern(
+            t.statuses[i], static_cast<int32_t>(as->statuses.size()), &ins);
+        if (ins) as->statuses.push_back(t.statuses[i]);
+        remaps[ti][i] = gid;
       }
     }
-    ++group_idx;
+    size_t at = 0;
+    for (size_t ti = 0; ti < outs.size(); ++ti) {
+      size_t cnt = outs[ti].rows.size();
+      for (size_t i = 0; i < cnt; ++i)
+        as->status_id[at + i] = remaps[ti][as->status_id[at + i]];
+      at += cnt;
+    }
   }
-  pr.ok = s.ok;
 
-  if (pr.ok && pr.had_duplicates) {
+  // flat id/parent views for the table phases
+  std::vector<sv> ids(n), parents(n);
+  std::vector<uint8_t> hasp(n);
+  for (size_t i = 0; i < n; ++i) {
+    ids[i] = as->rows[i].id;
+    parents[i] = as->rows[i].parent_id;
+    hasp[i] = as->rows[i].has_parent ? 1 : 0;
+  }
+
+  SpanIdTable table(n);
+  std::vector<std::vector<std::pair<int64_t, int32_t>>> dup_lists(n_threads);
+  if (n_threads <= 1 || n < 4096) {
+    build_table_range(table, ids.data(), 0, n, &dup_lists[0]);
+  } else {
+    std::vector<std::thread> ths;
+    size_t per = (n + n_threads - 1) / n_threads;
+    for (unsigned t = 0; t < n_threads; ++t) {
+      size_t r0 = t * per, r1 = std::min(n, r0 + per);
+      if (r0 >= r1) break;
+      ths.emplace_back(build_table_range, std::ref(table), ids.data(), r0,
+                       r1, &dup_lists[t]);
+    }
+    for (auto& th : ths) th.join();
+  }
+
+  // duplicate fixup in document order: first position survives, last
+  // written fields win, later rows die (the sequential path never appends
+  // a row for a duplicate id)
+  std::vector<std::pair<int64_t, int32_t>> dups;
+  for (auto& dl : dup_lists) dups.insert(dups.end(), dl.begin(), dl.end());
+  std::vector<uint8_t> dead;
+  bool had_duplicates = !dups.empty();
+  if (had_duplicates) {
+    dead.assign(n, 0);
+    // gather claimants per slot
+    std::vector<std::pair<int64_t, int32_t>> all = dups;
+    for (auto& d : dups) {
+      int32_t w = table.slots[d.first].row.load(std::memory_order_relaxed);
+      all.emplace_back(d.first, w);
+    }
+    std::sort(all.begin(), all.end());
+    all.erase(std::unique(all.begin(), all.end()), all.end());
+    size_t i = 0;
+    while (i < all.size()) {
+      size_t j = i;
+      int32_t first = all[i].second, last = all[i].second;
+      while (j < all.size() && all[j].first == all[i].first) {
+        first = std::min(first, all[j].second);
+        last = std::max(last, all[j].second);
+        ++j;
+      }
+      // survivor keeps its position/trace_of; fields come from the last
+      for (size_t k = i; k < j; ++k)
+        if (all[k].second != first) dead[all[k].second] = 1;
+      if (last != first) {
+        SpanRec moved = as->rows[last];
+        int32_t keep_group = as->trace_of[first];
+        as->rows[first] = moved;
+        as->trace_of[first] = keep_group;
+        ids[first] = moved.id;
+        parents[first] = moved.parent_id;
+        hasp[first] = moved.has_parent ? 1 : 0;
+      }
+      table.slots[all[i].first].row.store(first, std::memory_order_relaxed);
+      i = j;
+    }
+    // compaction: drop dead rows (renumbers everything after them)
+    std::vector<int32_t> remap(n, -1);
+    size_t w = 0;
+    for (size_t r = 0; r < n; ++r) {
+      if (dead[r]) continue;
+      remap[r] = static_cast<int32_t>(w);
+      if (w != r) {
+        as->rows[w] = as->rows[r];
+        as->trace_of[w] = as->trace_of[r];
+        ids[w] = ids[r];
+        parents[w] = parents[r];
+        hasp[w] = hasp[r];
+      }
+      ++w;
+    }
+    as->rows.resize(w);
+    as->trace_of.resize(w);
+    ids.resize(w);
+    parents.resize(w);
+    hasp.resize(w);
+    as->n = w;
+    n = w;
+    // rebuild table rows through the remap
+    for (size_t s2 = 0; s2 <= table.mask; ++s2) {
+      int32_t r = table.slots[s2].row.load(std::memory_order_relaxed);
+      if (r >= 0) {
+        table.slots[s2].row.store(remap[r], std::memory_order_relaxed);
+      }
+    }
     // last-wins overwrites may have left shape/status tables holding
-    // values seen only in dead records; rebuild over the FINAL rows
-    pr.shapes.clear();
-    pr.statuses.clear();
+    // values seen only in dead records; rebuild over the FINAL rows (same
+    // rare path as the sequential scan)
+    as->shapes.clear();
+    as->statuses.clear();
+    as->shape_id.assign(n, 0);
+    as->status_id.assign(n, 0);
     SvMap rebuilt_status(64);
-    for (size_t i = 0; i < pr.rows.size(); ++i) {
-      const SpanRec& r = pr.rows[i];
+    bool ins;
+    for (size_t r = 0; r < n; ++r) {
+      const SpanRec& rec = as->rows[r];
       Shape sh;
-      sh.f[0] = r.name;
-      sh.f[1] = r.url;
-      sh.f[2] = r.method;
-      sh.f[3] = r.svc;
-      sh.f[4] = r.ns;
-      sh.f[5] = r.rev;
-      sh.f[6] = r.mesh;
-      sh.key_present = r.present & kKeyBits;
-      sh.url_present = r.url_present ? 1 : 0;
-      int32_t sid = pr.shapes.intern(sh);
-      pr.shape_id[i] = sid;
-      Shape& stored = pr.shapes.shapes[sid];
-      double ts_ms = r.timestamp_raw / 1000.0;
+      sh.f[0] = rec.name;
+      sh.f[1] = rec.url;
+      sh.f[2] = rec.method;
+      sh.f[3] = rec.svc;
+      sh.f[4] = rec.ns;
+      sh.f[5] = rec.rev;
+      sh.f[6] = rec.mesh;
+      sh.key_present = rec.present & kKeyBits;
+      sh.url_present = rec.url_present ? 1 : 0;
+      int32_t sid = as->shapes.intern(sh);
+      as->shape_id[r] = sid;
+      Shape& stored = as->shapes.shapes[sid];
+      double ts_ms = rec.timestamp_raw / 1000.0;
       if (!stored.has_ts || ts_ms > stored.max_ts_ms) {
         stored.max_ts_ms = ts_ms;
         stored.has_ts = true;
       }
-      sv st = r.status_present ? r.status : sv("", 0);
+      sv st = rec.status_present ? rec.status : sv("", 0);
       int32_t stid = rebuilt_status.intern(
-          st, static_cast<int32_t>(pr.statuses.size()), &ins);
-      if (ins) pr.statuses.push_back(st);
-      pr.status_id[i] = stid;
+          st, static_cast<int32_t>(as->statuses.size()), &ins);
+      if (ins) as->statuses.push_back(st);
+      as->status_id[r] = stid;
     }
   }
-  return pr;
+
+  // parent resolution (prefetched, parallel)
+  as->parent_idx.assign(n, -1);
+  if (n_threads <= 1 || n < 4096) {
+    resolve_parents_range(table, ids.data(), parents.data(), hasp.data(), 0,
+                          n, as->parent_idx.data());
+  } else {
+    std::vector<std::thread> ths;
+    size_t per = (n + n_threads - 1) / n_threads;
+    for (unsigned t = 0; t < n_threads; ++t) {
+      size_t r0 = t * per, r1 = std::min(n, r0 + per);
+      if (r0 >= r1) break;
+      ths.emplace_back(resolve_parents_range, std::cref(table), ids.data(),
+                       parents.data(), hasp.data(), r0, r1,
+                       as->parent_idx.data());
+    }
+    for (auto& th : ths) th.join();
+  }
+
+  as->ok = true;
+  as->merge_us = static_cast<uint32_t>(now_us() - m0);
+}
+
+unsigned pick_threads(int requested) {
+  if (requested > 0) return static_cast<unsigned>(std::min(requested, 64));
+  unsigned hw = std::thread::hardware_concurrency();
+  return hw ? std::min(hw, 16u) : 1u;
+}
+
+// header packing for the threads+merge_us field: 7 bits of thread count
+// (pick_threads caps at 64) + 25 bits of microseconds (~33 s cap)
+constexpr uint32_t kMergeUsBits = 25;
+constexpr uint32_t kMergeUsMask = (1u << kMergeUsBits) - 1;
+
+bool parse_pipeline(const char* json, size_t json_len,
+                    const std::vector<std::pair<sv, bool>>& skip,
+                    Arena* arena, std::vector<ThreadOut>& outs,
+                    Assembled* as, int n_threads_req) {
+  unsigned n_threads = pick_threads(n_threads_req);
+  as->threads = n_threads;
+
+  uint64_t p0 = now_us();
+  if (n_threads <= 1) {
+    // sequential mode: single fused pass (no separate prescan walk)
+    outs.resize(1);
+    PrescanResult ps = prescan(json, json_len, skip, arena, &outs[0]);
+    if (!ps.ok || !outs[0].ok) return false;
+    as->prescan_us = 0;
+    as->parse_us = static_cast<uint32_t>(now_us() - p0);
+    assemble(outs, std::move(ps), as, 1);
+    return as->ok;
+  }
+
+  PrescanResult ps = prescan(json, json_len, skip, arena, nullptr);
+  if (!ps.ok) return false;
+  uint64_t p1 = now_us();
+  as->prescan_us = static_cast<uint32_t>(p1 - p0);
+
+  // contiguous, byte-balanced group ranges preserve document order
+  size_t total_bytes = 0;
+  for (auto& g : ps.kept)
+    total_bytes += static_cast<size_t>(g.end - g.begin);
+  size_t n_groups = ps.kept.size();
+  unsigned workers =
+      static_cast<unsigned>(std::min<size_t>(n_threads, n_groups ? n_groups : 1));
+  outs.resize(workers);
+  std::vector<size_t> cuts(workers + 1, n_groups);
+  cuts[0] = 0;
+  size_t acc = 0, w = 1;
+  size_t per = total_bytes / workers + 1;
+  for (size_t g = 0; g < n_groups && w < workers; ++g) {
+    acc += static_cast<size_t>(ps.kept[g].end - ps.kept[g].begin);
+    if (acc >= per * w) cuts[w++] = g + 1;
+  }
+  std::vector<std::thread> ths;
+  for (unsigned t = 0; t < workers; ++t) {
+    if (cuts[t] >= cuts[t + 1]) {
+      outs[t].ok = true;
+      continue;
+    }
+    ths.emplace_back(parse_range, std::cref(ps.kept), cuts[t], cuts[t + 1],
+                     &outs[t]);
+  }
+  for (auto& th : ths) th.join();
+  for (auto& t : outs)
+    if (!t.ok) return false;
+  uint64_t busy_max = 0;
+  for (auto& t : outs) busy_max = std::max(busy_max, t.busy_us);
+  as->parse_us = static_cast<uint32_t>(busy_max);
+
+  assemble(outs, std::move(ps), as, workers);
+  return as->ok;
 }
 
 inline void put_u32(std::vector<uint8_t>& b, uint32_t v) {
@@ -1037,16 +1612,89 @@ inline void put_sv(std::vector<uint8_t>& b, sv s) {
   b.insert(b.end(), s.begin(), s.end());
 }
 
+unsigned char* serialize(const Assembled& as, size_t* out_len) {
+  size_t n = as.n;
+  size_t n_shapes = as.shapes.shapes.size();
+
+  // exact size up front: one malloc, one pass, no vector regrow + final
+  // copy (the output is ~35 MB at 1M spans)
+  size_t sz = 32 + n * (8 + 8 + 4 + 4 + 4 + 4 + 1) + n_shapes * 8;
+  for (const Shape& sh : as.shapes.shapes) {
+    sz += 2 + kShapeFields * 4;
+    for (int i = 0; i < kShapeFields; ++i) sz += sh.f[i].size();
+  }
+  for (sv st : as.statuses) sz += 4 + st.size();
+  for (auto& g : as.kept) sz += 5 + g.tid.size();
+
+  unsigned char* buf = static_cast<unsigned char*>(std::malloc(sz));
+  if (buf == nullptr) return nullptr;
+  unsigned char* w = buf;
+  auto w_u32 = [&](uint32_t v) {
+    std::memcpy(w, &v, 4);
+    w += 4;
+  };
+  auto w_sv = [&](sv s) {
+    w_u32(static_cast<uint32_t>(s.size()));
+    std::memcpy(w, s.data(), s.size());
+    w += s.size();
+  };
+
+  w_u32(1);  // ok
+  w_u32(static_cast<uint32_t>(n));
+  w_u32(static_cast<uint32_t>(n_shapes));
+  w_u32(static_cast<uint32_t>(as.statuses.size()));
+  w_u32(static_cast<uint32_t>(as.kept.size()));
+  w_u32(as.prescan_us);
+  w_u32(as.parse_us);
+  w_u32((as.threads << kMergeUsBits) |
+        std::min(as.merge_us, kMergeUsMask));
+
+  for (size_t i = 0; i < n; ++i) {
+    std::memcpy(w + i * 8, &as.rows[i].latency_ms, 8);
+    std::memcpy(w + (n + i) * 8, &as.rows[i].timestamp_raw, 8);
+  }
+  w += n * 16;
+  for (size_t i = 0; i < n_shapes; ++i) {
+    std::memcpy(w, &as.shapes.shapes[i].max_ts_ms, 8);
+    w += 8;
+  }
+  std::memcpy(w, as.parent_idx.data(), n * 4);
+  w += n * 4;
+  std::memcpy(w, as.shape_id.data(), n * 4);
+  w += n * 4;
+  std::memcpy(w, as.status_id.data(), n * 4);
+  w += n * 4;
+  std::memcpy(w, as.trace_of.data(), n * 4);
+  w += n * 4;
+  for (size_t i = 0; i < n; ++i)
+    w[i] = static_cast<uint8_t>(as.rows[i].kind);
+  w += n;
+  for (const Shape& sh : as.shapes.shapes) {
+    *w++ = sh.url_present;
+    *w++ = sh.key_present;
+    for (int i = 0; i < kShapeFields; ++i) w_sv(sh.f[i]);
+  }
+  for (sv st : as.statuses) w_sv(st);
+  for (size_t g = 0; g < as.kept.size(); ++g) {
+    *w++ = as.kept[g].tid_present ? 1 : 0;
+    w_sv(as.kept[g].tid);
+  }
+
+  *out_len = static_cast<size_t>(w - buf);
+  return buf;
+}
+
 }  // namespace
 
 extern "C" {
 
 // skip_blob: u32 n_skip then per entry u8 present + u32 len + bytes.
 // json: the raw Zipkin response, passed separately so the (large) buffer
-// crosses the ctypes boundary without a copy.
-unsigned char* km_parse_spans(const char* skip_blob, size_t skip_len,
-                              const char* json, size_t json_len,
-                              size_t* out_len) {
+// crosses the ctypes boundary without a copy. n_threads: 0 = auto
+// (hardware concurrency, capped at 16), else the exact worker count.
+unsigned char* km_parse_spans_mt(const char* skip_blob, size_t skip_len,
+                                 const char* json, size_t json_len,
+                                 int n_threads, size_t* out_len) {
   *out_len = 0;
   if (skip_len < 4) return nullptr;
   const uint8_t* q = reinterpret_cast<const uint8_t*>(skip_blob);
@@ -1067,69 +1715,66 @@ unsigned char* km_parse_spans(const char* skip_blob, size_t skip_len,
   }
 
   Arena arena;
-  ParseResult pr = parse_all(json, json_len, skip, &arena);
-  if (!pr.ok) return nullptr;
+  std::vector<ThreadOut> outs;
+  Assembled as;
+  if (!parse_pipeline(json, json_len, skip, &arena, outs, &as, n_threads))
+    return nullptr;
+  return serialize(as, out_len);
+}
 
-  size_t n = pr.rows.size();
-  // parent resolution against the final id->row index
-  std::vector<int32_t> parent_idx(n, -1);
-  for (size_t i = 0; i < n; ++i) {
-    if (!pr.rows[i].has_parent) continue;
-    int32_t* pi = pr.span_index.find(pr.rows[i].parent_id);
-    if (pi != nullptr) parent_idx[i] = *pi;
-  }
+unsigned char* km_parse_spans(const char* skip_blob, size_t skip_len,
+                              const char* json, size_t json_len,
+                              size_t* out_len) {
+  return km_parse_spans_mt(skip_blob, skip_len, json, json_len, 0, out_len);
+}
 
-  size_t n_shapes = pr.shapes.shapes.size();
-  std::vector<uint8_t> out;
-  out.reserve(32 + n * 29 + n_shapes * 8 + 64 * n_shapes +
-              16 * pr.statuses.size() + 24 * pr.kept_trace_ids.size());
-  put_u32(out, 1);  // ok
-  put_u32(out, static_cast<uint32_t>(n));
-  put_u32(out, static_cast<uint32_t>(n_shapes));
-  put_u32(out, static_cast<uint32_t>(pr.statuses.size()));
-  put_u32(out, static_cast<uint32_t>(pr.kept_trace_ids.size()));
-  put_u32(out, 0);
-  put_u32(out, 0);
-  put_u32(out, 0);
-
-  auto put_f64s = [&](auto&& get, size_t count) {
-    size_t at = out.size();
-    out.resize(at + count * 8);
-    for (size_t i = 0; i < count; ++i) {
-      double v = get(i);
-      std::memcpy(out.data() + at + i * 8, &v, 8);
+// group-aligned split points for streaming ingest: walks the top-level
+// array (string-aware) and emits <= n_chunks byte ranges, each covering
+// whole trace groups. Output: u32 n_ranges, then per range u64 begin,
+// u64 end (offsets into json — u64 because the uncapped ingest path can
+// legitimately carry >4 GiB bodies; each json[begin:end] re-wraps as
+// "[" + groups + "]" on the Python side). Returns nullptr on malformed
+// input.
+unsigned char* km_split_groups(const char* json, size_t json_len,
+                               int n_chunks, size_t* out_len) {
+  *out_len = 0;
+  if (n_chunks < 1) n_chunks = 1;
+  Arena arena;
+  Scanner s{json, json + json_len, &arena};
+  std::vector<std::pair<uint64_t, uint64_t>> groups;
+  if (!s.eat('[')) return nullptr;
+  bool first = true;
+  while (s.ok) {
+    s.ws();
+    if (s.peek(']')) {
+      ++s.p;
+      break;
     }
-  };
-  auto put_i32s = [&](const int32_t* v, size_t count) {
-    size_t at = out.size();
-    out.resize(at + count * 4);
-    std::memcpy(out.data() + at, v, count * 4);
-  };
+    if (!first && !s.eat(',')) return nullptr;
+    first = false;
+    s.ws();
+    const char* gbegin = s.p;
+    s.skip_value();
+    if (!s.ok) return nullptr;
+    groups.emplace_back(static_cast<uint64_t>(gbegin - json),
+                        static_cast<uint64_t>(s.p - json));
+  }
+  if (!s.ok) return nullptr;
 
-  put_f64s([&](size_t i) { return pr.rows[i].latency_ms; }, n);
-  put_f64s([&](size_t i) { return pr.rows[i].timestamp_raw; }, n);
-  put_f64s([&](size_t i) { return pr.shapes.shapes[i].max_ts_ms; }, n_shapes);
-  put_i32s(parent_idx.data(), n);
-  put_i32s(pr.shape_id.data(), n);
-  put_i32s(pr.status_id.data(), n);
-  put_i32s(pr.trace_of.data(), n);
-  {
-    size_t at = out.size();
-    out.resize(at + n);
-    for (size_t i = 0; i < n; ++i)
-      out[at + i] = static_cast<uint8_t>(pr.rows[i].kind);
+  size_t per = (groups.size() + n_chunks - 1) /
+               static_cast<size_t>(n_chunks);
+  if (per == 0) per = 1;
+  std::vector<uint8_t> out;
+  size_t n_ranges = groups.empty() ? 0 : (groups.size() + per - 1) / per;
+  put_u32(out, static_cast<uint32_t>(n_ranges));
+  auto put_u64 = [&](uint64_t v) {
+    for (int b = 0; b < 8; ++b) out.push_back((v >> (8 * b)) & 0xFF);
+  };
+  for (size_t i = 0; i < groups.size(); i += per) {
+    size_t j = std::min(groups.size(), i + per);
+    put_u64(groups[i].first);
+    put_u64(groups[j - 1].second);
   }
-  for (const Shape& sh : pr.shapes.shapes) {
-    out.push_back(sh.url_present);
-    out.push_back(sh.key_present);
-    for (int i = 0; i < kShapeFields; ++i) put_sv(out, sh.f[i]);
-  }
-  for (sv st : pr.statuses) put_sv(out, st);
-  for (size_t g = 0; g < pr.kept_trace_ids.size(); ++g) {
-    out.push_back(pr.kept_trace_present[g]);
-    put_sv(out, pr.kept_trace_ids[g]);
-  }
-
   unsigned char* buf = static_cast<unsigned char*>(std::malloc(out.size()));
   if (buf == nullptr) return nullptr;
   std::memcpy(buf, out.data(), out.size());

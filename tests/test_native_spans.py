@@ -482,3 +482,182 @@ class TestFuzzParity:
             nat, kept = out
             assert kept == [g[0].get("traceId") for g in kept_groups]
             assert_batches_equal(host, nat)
+
+class TestParallelParse:
+    """The multi-threaded scan (prescan + worker ranges + atomic span-id
+    table + document-order dup fixup) must be byte-identical to the
+    sequential single-pass mode."""
+
+    def _compare_outputs(self, raw, skip=()):
+        seq = native.parse_spans(raw, list(skip), threads=1)
+        mt = native.parse_spans(raw, list(skip), threads=4)
+        assert (seq is None) == (mt is None)
+        if seq is None:
+            return
+        for key in (
+            "n_spans",
+            "shapes",
+            "statuses",
+            "trace_ids",
+        ):
+            assert seq[key] == mt[key], key
+        for key in (
+            "kind",
+            "parent_idx",
+            "shape_id",
+            "status_id",
+            "trace_of",
+            "latency_ms",
+            "timestamp_us",
+            "shape_max_ts_ms",
+        ):
+            assert np.array_equal(seq[key], mt[key]), key
+        assert mt["timings"]["threads"] >= 1
+
+    def test_fixtures_mt(self):
+        for fixture in ["pdas_traces", "pdas2_traces", "bookinfo_traces"]:
+            data = load_fixture(fixture)
+            groups = data if isinstance(data[0], list) else [data]
+            self._compare_outputs(json.dumps(groups).encode())
+
+    def test_many_groups_with_cross_group_duplicate_ids(self):
+        # span id "shared" recurs in far-apart groups: the atomic-table
+        # fixup must collapse them first-position/last-wins exactly like
+        # the sequential scan, then compact and rebuild tables
+        mk = TestDedupSemantics().mk_span
+        groups = []
+        for t in range(40):
+            sid = "shared" if t % 7 == 0 else f"s{t}"
+            child = mk(f"t{t}", f"c{t}", parent=sid)
+            child["duration"] = 1000 + t
+            groups.append([mk(f"t{t}", sid, duration=500 + t), child])
+        self._compare_outputs(json.dumps(groups).encode())
+
+    def test_skip_set_and_empty_groups_mt(self):
+        mk = TestDedupSemantics().mk_span
+        groups = []
+        for t in range(30):
+            groups.append([] if t % 5 == 0 else [mk(f"t{t}", f"s{t}")])
+            if t % 6 == 0:
+                groups.append([mk(f"t{t}", f"dup{t}")])  # dup trace id
+        skip = [f"t{t}" for t in range(0, 30, 3)] + [None]
+        self._compare_outputs(json.dumps(groups).encode(), skip=skip)
+
+    def test_fuzz_mt(self):
+        rng = random.Random(21)
+        mk = TestDedupSemantics().mk_span
+        for trial in range(8):
+            groups = []
+            for t in range(rng.randint(0, 25)):
+                group = []
+                for j in range(rng.randint(0, 6)):
+                    sid = (
+                        rng.choice(["dupA", "dupB"])
+                        if rng.random() < 0.15
+                        else f"{trial}-{t}-{j}"
+                    )
+                    over = {"duration": rng.randint(0, 10**6)}
+                    if rng.random() < 0.4:
+                        over["parentId"] = rng.choice(
+                            [f"{trial}-{t}-0", "dupA", "missing"]
+                        )
+                    group.append(mk(f"{trial}-t{t}", sid, **over))
+                groups.append(group)
+            self._compare_outputs(json.dumps(groups).encode())
+
+    def test_parity_with_host_under_threads_env(self, monkeypatch):
+        # the full raw_spans_to_batch path (naming, interning) with the MT
+        # scanner underneath must still match the pure-Python host path
+        monkeypatch.setenv("KMAMIZ_PARSE_THREADS", "4")
+        data = load_fixture("bookinfo_traces")
+        groups = data if isinstance(data[0], list) else [data]
+        roundtrip(groups)
+
+
+class TestStreamingIngest:
+    def test_split_groups_covers_whole_groups(self):
+        mk = TestDedupSemantics().mk_span
+        groups = [[mk(f"t{t}", f"s{t}")] for t in range(17)]
+        raw = json.dumps(groups).encode()
+        chunks = native.split_groups(raw, 4)
+        assert chunks is not None
+        assert 1 <= len(chunks) <= 4
+        total = 0
+        for chunk in chunks:
+            parsed = json.loads(chunk)  # each chunk is a standalone response
+            total += len(parsed)
+        assert total == 17
+
+    def test_split_groups_malformed(self):
+        assert native.split_groups(b'[[{"truncated', 4) is None
+
+    def test_stream_matches_window_ingest(self):
+        from kmamiz_tpu.server.processor import DataProcessor
+
+        mk = TestDedupSemantics().mk_span
+        groups = []
+        for t in range(50):
+            parent = mk(f"t{t}", f"p{t}")
+            child = mk(
+                f"t{t}",
+                f"c{t}",
+                parent=f"p{t}",
+                kind="CLIENT",
+                name=f"down{t % 5}.ns.svc.cluster.local:80/*",
+            )
+            child["tags"]["istio.canonical_service"] = f"down{t % 5}"
+            groups.append([parent, child])
+        raw = json.dumps(groups).encode()
+
+        one = DataProcessor(trace_source=lambda *a: [], use_device_stats=False)
+        whole = one.ingest_raw_window(raw)
+
+        two = DataProcessor(trace_source=lambda *a: [], use_device_stats=False)
+        chunks = native.split_groups(raw, 6)
+        assert chunks is not None and len(chunks) > 1
+        streamed = two.ingest_raw_stream(chunks)
+
+        assert streamed["spans"] == whole["spans"] == 100
+        assert streamed["traces"] == whole["traces"] == 50
+        assert streamed["edges"] == whole["edges"]
+        assert streamed["endpoints"] == whole["endpoints"]
+        assert streamed["chunks"] == len(chunks)
+        # dedup maps converge: a second pass ingests nothing
+        again = two.ingest_raw_stream([raw])
+        assert again["spans"] == 0 and again["traces"] == 0
+
+    def test_stream_dedup_across_chunks(self):
+        from kmamiz_tpu.server.processor import DataProcessor
+
+        mk = TestDedupSemantics().mk_span
+        # the same trace id appears in chunk 1 and chunk 2: the second
+        # occurrence must drop (kept ids register before the next parse)
+        c1 = json.dumps([[mk("tX", "a")], [mk("tY", "b")]]).encode()
+        c2 = json.dumps([[mk("tX", "c")], [mk("tZ", "d")]]).encode()
+        dp = DataProcessor(trace_source=lambda *a: [], use_device_stats=False)
+        out = dp.ingest_raw_stream([c1, c2])
+        assert out["traces"] == 3
+        assert out["spans"] == 3
+
+    def test_stream_span_id_scope_is_per_chunk(self):
+        # adversarial: the SAME span ids recur in different trace groups.
+        # One-shot ingest collapses them window-wide; the streamed path
+        # scopes the span map per chunk (the reference's per-response
+        # scope under paginated fetches). Graph results must still agree.
+        from kmamiz_tpu.server.processor import DataProcessor
+
+        mk = TestDedupSemantics().mk_span
+        groups = [[mk(f"t{t}", "sameid")] for t in range(24)]
+        raw = json.dumps(groups).encode()
+
+        one = DataProcessor(trace_source=lambda *a: [], use_device_stats=False)
+        whole = one.ingest_raw_window(raw)
+        two = DataProcessor(trace_source=lambda *a: [], use_device_stats=False)
+        chunks = native.split_groups(raw, 4)
+        streamed = two.ingest_raw_stream(chunks)
+
+        assert whole["spans"] == 1      # window-wide collapse
+        assert streamed["spans"] == 4   # one survivor per chunk
+        assert streamed["traces"] == whole["traces"] == 24
+        assert streamed["edges"] == whole["edges"]
+        assert streamed["endpoints"] == whole["endpoints"]

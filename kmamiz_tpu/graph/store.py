@@ -110,6 +110,9 @@ class EndpointGraph:
         self._dist = jnp.full(capacity, SENTINEL, dtype=jnp.int32)
         self._n_edges = 0
         self._pending = None  # deferred (src, dst, dist, count) of last merge
+        # monotonic state-change counter: API layers key scorer-payload
+        # caches on it (bumped by merges and warm-start loads)
+        self._version = 0
         # per-endpoint host-side metadata, padded on demand
         self._ep_record = np.zeros(0, dtype=bool)
         self._ep_last_ts = np.zeros(0, dtype=np.float64)
@@ -131,6 +134,12 @@ class EndpointGraph:
         self._finalize_pending()
         return self._n_edges
 
+    @property
+    def version(self) -> int:
+        """Monotonic counter of graph state changes (merges/loads)."""
+        with self._lock:
+            return self._version
+
     def _ensure_ep_arrays(self, n: int) -> None:
         if len(self._ep_record) < n:
             grow = n - len(self._ep_record)
@@ -150,6 +159,7 @@ class EndpointGraph:
             self._merge_window_locked(batch)
 
     def _merge_window_locked(self, batch: SpanBatch) -> None:
+        self._version += 1
         self._finalize_pending()
         packed = pack_trace_rows(
             batch.trace_of, batch.n_spans, batch.parent_idx
@@ -282,6 +292,10 @@ class EndpointGraph:
     # -- scorers -------------------------------------------------------------
 
     def _fresh_mask(self, ep_cap: int, now_ms=None) -> np.ndarray:
+        with self._lock:
+            return self._fresh_mask_locked(ep_cap, now_ms)
+
+    def _fresh_mask_locked(self, ep_cap: int, now_ms=None) -> np.ndarray:
         """bool[ep_cap]: endpoints whose last usage is within the
         deprecated-endpoint threshold (EndpointDependencies.ts:44-74; the
         host path prunes stale records AND links to them — the device twin
@@ -295,16 +309,24 @@ class EndpointGraph:
             import time as _time
 
             cutoff = (now_ms if now_ms is not None else _time.time() * 1000) - deprecated_ms
-            n_ep = len(self.interner.endpoints)
-            with self._lock:
-                self._ensure_ep_arrays(n_ep)
-                fresh[:n_ep] = self._ep_last_ts[:n_ep] >= cutoff
+            # under the caller's lock: n_ep cannot outgrow ep_cap here
+            n_ep = min(len(self.interner.endpoints), ep_cap)
+            self._ensure_ep_arrays(n_ep)
+            fresh[:n_ep] = self._ep_last_ts[:n_ep] >= cutoff
         return fresh
 
     def _scorer_inputs(self, label_of=None, now_ms=None):
-        src, dst, dist, mask = self.edge_arrays()
-        ep_service, ep_ml, ep_record, ep_cap = self._ep_tables(label_of)
-        fresh = self._fresh_mask(ep_cap, now_ms)
+        # ONE lock hold across the whole snapshot: a concurrent ingest can
+        # intern endpoints between piecewise acquisitions, leaving n_ep >
+        # ep_cap when the fresh mask sizes from a stale table (ADVICE r2)
+        with self._lock:
+            self._finalize_pending_locked()
+            mask = self._src != SENTINEL
+            src, dst, dist = self._src, self._dst, self._dist
+            ep_service, ep_ml, ep_record, ep_cap = self._ep_tables_locked(
+                label_of
+            )
+            fresh = self._fresh_mask_locked(ep_cap, now_ms)
         if not fresh.all():
             fresh_j = jnp.asarray(fresh)
             mask = (
@@ -358,6 +380,7 @@ class EndpointGraph:
             self._load_dependencies_locked(records)
 
     def _load_dependencies_locked(self, records) -> None:
+        self._version += 1
         src_l, dst_l, dist_l = [], [], []
         for r in records:
             info = r.get("endpoint", {})

@@ -232,11 +232,14 @@ class CronExpr:
 
     def _after_gap(self, local: _dt.datetime) -> _dt.datetime:
         """First valid wall-clock instant after the DST gap containing
-        `local`."""
+        `local`, carrying the expression's smallest allowed second so a
+        6-field expression whose seconds set excludes 0 still fires at a
+        matching second (ADVICE r2)."""
         probe = local.replace(second=0)
+        fire_second = min(self.seconds)
         for _ in range(6 * 60):  # gaps are at most a few hours; scan by minute
             probe = probe + _dt.timedelta(minutes=1)
-            resolved = self._resolve_dst(probe)
+            resolved = self._resolve_dst(probe.replace(second=fire_second))
             if resolved is not None:
                 return resolved
         return local + _dt.timedelta(hours=6)  # pragma: no cover - defensive

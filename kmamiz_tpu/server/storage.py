@@ -220,8 +220,10 @@ class FileStore(MemoryStore):
             elif op == "delete":
                 for i in entry["ids"]:
                     self._data[collection].pop(i, None)
-            # (no "clear" op: clear_collection truncates the journal and
-            # swaps in an empty snapshot instead of journaling)
+            elif op == "clear":
+                # journaled clear marker: makes clear_collection atomic
+                # under any crash point (see clear_collection)
+                self._data[collection].clear()
         if torn:
             # truncate NOW so later appends don't land after a bad line
             # and vanish on the following reload
@@ -283,15 +285,18 @@ class FileStore(MemoryStore):
     def clear_collection(self, collection: str) -> None:
         with self._lock:
             super().clear_collection(collection)
-            # truncate the journal FIRST: crashing between the two steps
-            # must not leave an old journal whose replayed puts resurrect
-            # cleared docs over the emptied snapshot
-            open(self._journal_path(collection), "w").close()
-            self._journal_sizes[collection] = 0
+            # atomic under any crash point (ADVICE r2): journal a "clear"
+            # marker FIRST — a crash before the snapshot swap replays
+            # old-journal + clear = {}; then swap in the empty snapshot
+            # (crash before truncate replays clear over [] = {}); then
+            # truncate. Every intermediate state reloads as post-clear.
+            self._append(collection, [{"op": "clear"}])
             path = self._snapshot_path(collection)
             tmp = path.with_suffix(".json.tmp")
             tmp.write_text("[]")
             tmp.replace(path)
+            open(self._journal_path(collection), "w").close()
+            self._journal_sizes[collection] = 0
 
 
 def store_from_uri(uri: str) -> Store:

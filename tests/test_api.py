@@ -571,3 +571,44 @@ class TestStaticServing:
 
         router = Router(api_version="1")
         assert router.dispatch("GET", "/anything").status == 404
+
+
+class TestScorerPayloadCache:
+    """VERDICT r2 #2: scorer payloads cache keyed by graph version +
+    label freshness; merges invalidate automatically."""
+
+    def test_repeat_requests_serve_cached_payload(self, router):
+        for route in ("instability", "coupling", "cohesion"):
+            r1 = get(router, f"/api/v1/graph/{route}")
+            r2 = get(router, f"/api/v1/graph/{route}")
+            assert r2.payload is r1.payload, route
+
+    def test_graph_merge_invalidates(self, ctx, router):
+        r1 = get(router, "/api/v1/graph/instability")
+        ctx.processor._processed.clear()
+        ctx.operator.retrieve_realtime_data()  # merges a window
+        r2 = get(router, "/api/v1/graph/instability")
+        assert r2.payload is not r1.payload
+        assert r2.payload == r1.payload  # same window content, fresh build
+
+    def test_label_update_invalidates(self, ctx, router):
+        r1 = get(router, "/api/v1/graph/cohesion")
+        label_map = ctx.cache.get("LabelMapping")
+        label_map.set_data(None)  # recompute labels -> last_update bumps
+        r2 = get(router, "/api/v1/graph/cohesion")
+        assert r2.payload is not r1.payload
+
+    def test_host_oracle_never_cached(self, router):
+        r1 = get(router, "/api/v1/graph/instability?scorer=host")
+        r2 = get(router, "/api/v1/graph/instability?scorer=host")
+        assert r2.payload is not r1.payload
+
+    def test_deprecated_threshold_disables_cache(self, router, monkeypatch):
+        from kmamiz_tpu.config import settings
+
+        monkeypatch.setattr(
+            settings, "deprecated_endpoint_threshold", "1d"
+        )
+        r1 = get(router, "/api/v1/graph/instability")
+        r2 = get(router, "/api/v1/graph/instability")
+        assert r2.payload is not r1.payload

@@ -333,6 +333,33 @@ class TestStorageAndDispatch:
         docs = reloaded.find_all("EndpointDataType")
         assert [(d["_id"], d["k"]) for d in docs] == [(a["_id"], 10)]
 
+    def test_clear_collection_atomic_at_every_crash_point(self, tmp_path):
+        """clear_collection journals a "clear" marker before swapping the
+        empty snapshot, so a crash at ANY point reloads as post-clear
+        (ADVICE r2: the old ordering resurrected docs)."""
+        import shutil
+
+        # crash point 1: clear marker appended, snapshot NOT yet swapped
+        store = FileStore(str(tmp_path / "a"))
+        store.insert_many("EndpointDataType", [{"k": 1}, {"k": 2}])
+        with open(tmp_path / "a" / "EndpointDataType.journal", "a") as f:
+            f.write('{"op": "clear"}\n')
+        assert FileStore(str(tmp_path / "a")).find_all("EndpointDataType") == []
+
+        # crash point 2: snapshot swapped, journal NOT yet truncated
+        store = FileStore(str(tmp_path / "b"))
+        store.insert_many("EndpointDataType", [{"k": 1}])
+        with open(tmp_path / "b" / "EndpointDataType.journal", "a") as f:
+            f.write('{"op": "clear"}\n')
+        (tmp_path / "b" / "EndpointDataType.json").write_text("[]")
+        assert FileStore(str(tmp_path / "b")).find_all("EndpointDataType") == []
+
+        # the real call end-to-end
+        store = FileStore(str(tmp_path / "c"))
+        store.insert_many("EndpointDataType", [{"k": 1}])
+        store.clear_collection("EndpointDataType")
+        assert FileStore(str(tmp_path / "c")).find_all("EndpointDataType") == []
+
     def test_file_store_torn_journal_tail_is_ignored(self, tmp_path):
         store = FileStore(str(tmp_path / "d"))
         store.save("TaggedInterface", {"ok": True})

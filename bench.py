@@ -188,6 +188,51 @@ def main() -> None:
         * 1000
     )
 
+    # the SHARDED packed walk (parallel/mesh.py) on a 1-device TPU mesh:
+    # same kernel the multi-chip dryrun runs on 8 virtual devices — this
+    # records the real-TPU per-chip cost of the sharded code path
+    from kmamiz_tpu.parallel import mesh as pmesh
+
+    mesh1 = pmesh.make_mesh(1)
+
+    @jax.jit
+    def sharded_walk_chain():
+        # single iteration: the flat sharded walk is ~600 ms/iter, one is
+        # plenty and needs no anti-hoisting ceremony
+        _a, _d, _ds, m = pmesh.sharded_dependency_edges(
+            mesh1,
+            jnp.asarray(parent),
+            jnp.asarray(kind),
+            jnp.ones(N_SPANS, bool),
+            endpoint_id,
+            max_depth=bench_depth,
+        )
+        return jnp.sum(m.astype(jnp.float32))
+
+    @jax.jit
+    def sharded_packed_walk_chain():
+        def body(_i, acc):
+            _a, _d, _ds, m = pmesh.sharded_dependency_edges_packed(
+                mesh1,
+                parent_slot2,
+                kind2,
+                valid2,
+                ep2 + (acc > 1e30).astype(jnp.int32),
+                max_depth=bench_depth,
+            )
+            return acc + jnp.sum(m.astype(jnp.float32))
+
+        return jax.lax.fori_loop(0, WALK_MXU_ITERS, body, 0.0)
+
+    walk_sharded_packed_ms = (
+        max(_timed(lambda: float(sharded_packed_walk_chain()), reps=3) - rtt, 0)
+        / WALK_MXU_ITERS
+        * 1000
+    )
+    walk_sharded_flat_ms = (
+        max(_timed(lambda: float(sharded_walk_chain()), reps=3) - rtt, 0) * 1000
+    )
+
     total = _timed(lambda: float(window_chain()))
     # sustained ingest charges the per-window host packing cost the
     # production merge path pays, not just the device chain
@@ -304,7 +349,9 @@ def main() -> None:
 
     e2e_phases = None
     if raw_e2e_once() is not None:  # warms the compile
-        reps = [raw_e2e_once() for _ in range(3)]
+        # 5 reps: the single-core host's timing noise is +/-40%, and the
+        # headline is parse-bound — a wider median damps one bad rep
+        reps = [raw_e2e_once() for _ in range(5)]
         e2e_phases = tuple(float(np.median(c)) for c in zip(*reps))
 
     # ---- native parse thread scaling (honest: this host has 1 core) --------
@@ -653,6 +700,8 @@ def main() -> None:
         "walk_mxu_packed_ms": round(walk_mxu_ms, 1),
         "walk_flat_gather_ms": round(walk_flat_ms, 1),
         "walk_mxu_speedup": round(walk_flat_ms / max(walk_mxu_ms, 1e-9), 1),
+        "walk_sharded_packed_1dev_ms": round(walk_sharded_packed_ms, 1),
+        "walk_sharded_flat_1dev_ms": round(walk_sharded_flat_ms, 1),
         "graph_refresh_target_ms": 50.0,
         "n_spans": N_SPANS,
         "n_endpoints": N_ENDPOINTS,

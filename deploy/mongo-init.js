@@ -1,0 +1,14 @@
+// Demo Mongo bootstrap: creates the application user the KMamiz-TPU
+// store authenticates as (SCRAM; see kmamiz_tpu/server/mongo.py).
+// Runs once from /docker-entrypoint-initdb.d on first container start.
+// Reference deployment shape: /root/reference/deploy/mongo-init.js.
+db.createUser({
+  user: "kmamiz",
+  pwd: "kmamiz-demo-password", // change for anything beyond the demo
+  roles: [
+    {
+      role: "readWrite",
+      db: "kmamiz",
+    },
+  ],
+});

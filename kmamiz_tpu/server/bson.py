@@ -55,6 +55,10 @@ def _encode_value(key: str, value: Any, out: bytearray) -> None:
             raise BsonError(f"integer out of int64 range: {key}")
     elif isinstance(value, float):
         out += b"\x01" + name + struct.pack("<d", value)
+    elif isinstance(value, (bytes, bytearray)):  # binary, generic subtype
+        out += (
+            b"\x05" + name + struct.pack("<i", len(value)) + b"\x00" + bytes(value)
+        )
     elif isinstance(value, str):
         raw = value.encode("utf-8")
         out += b"\x02" + name + struct.pack("<i", len(raw) + 1) + raw + b"\x00"

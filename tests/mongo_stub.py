@@ -8,15 +8,23 @@ tooling (tests/test_mongo_store.py::TestBsonCodec).
 
 Supported commands: hello/ismaster, ping, insert, find (+getMore with a
 deliberately small batch size to force cursor drains), update (upsert by
-_id), delete ({} / {_id: eq} / {_id: {$in}}), drop.
+_id), delete ({} / {_id: eq} / {_id: {$in}}), drop. With `users`
+configured it also speaks the server side of SCRAM-SHA-1/-SHA-256
+(saslStart/saslContinue, per-connection auth state, Unauthorized for
+data commands before authentication) so the client's auth path is
+exercised over the real wire protocol.
 """
 from __future__ import annotations
 
+import base64
+import hashlib
+import hmac
 import itertools
+import os
 import socket
 import struct
 import threading
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from kmamiz_tpu.server import bson
 
@@ -36,12 +44,24 @@ def _matches(doc: dict, query: dict) -> bool:
 
 
 class MiniMongo:
-    def __init__(self, batch_size: int = 3) -> None:
+    def __init__(
+        self,
+        batch_size: int = 3,
+        users: Optional[Dict[str, str]] = None,
+        mechanisms: Tuple[str, ...] = ("SCRAM-SHA-256", "SCRAM-SHA-1"),
+        force_empty_exchange: bool = False,
+    ) -> None:
         self.batch_size = batch_size
+        self.users = users or {}  # username -> password; empty = no auth
+        self.mechanisms = mechanisms
+        # ignore the client's skipEmptyExchange to exercise its final
+        # empty saslContinue round (old-server behavior)
+        self.force_empty_exchange = force_empty_exchange
         self.data: Dict[Tuple[str, str], Dict[str, dict]] = {}
         self.commands_seen: List[str] = []
         self._cursors: Dict[int, List[dict]] = {}
         self._cursor_ids = itertools.count(1000)
+        self._conversations = itertools.count(1)
         self._server = socket.create_server(("127.0.0.1", 0))
         self._threads: List[threading.Thread] = []
         self._running = True
@@ -88,6 +108,7 @@ class MiniMongo:
         return b"".join(chunks)
 
     def _serve_conn(self, conn: socket.socket) -> None:
+        conn_state: Dict[str, object] = {"authed": not self.users, "sasl": None}
         with conn:
             while self._running:
                 try:
@@ -101,7 +122,7 @@ class MiniMongo:
                 body = rest[12:]
                 assert body[4] == 0, "only kind-0 sections supported"
                 command = bson.decode(body[5:])
-                reply = self._dispatch(command)
+                reply = self._dispatch(command, conn_state)
                 payload = b"\x00\x00\x00\x00" + b"\x00" + bson.encode(reply)
                 header = _HEADER.pack(16 + len(payload), 1, req_id, OP_MSG)
                 try:
@@ -115,10 +136,151 @@ class MiniMongo:
         key = (command["$db"], command[name])
         return self.data.setdefault(key, {})
 
-    def _dispatch(self, command: dict) -> dict:
+    # -- server-side SCRAM ---------------------------------------------------
+
+    @staticmethod
+    def _scram_password(mechanism: str, username: str, password: str) -> str:
+        from kmamiz_tpu.server.mongo import _saslprep
+
+        if mechanism == "SCRAM-SHA-1":
+            return hashlib.md5(
+                f"{username}:mongo:{password}".encode("utf-8")
+            ).hexdigest()
+        return _saslprep(password)  # what a real mongod stores
+
+    def _sasl_start(self, command: dict, conn_state: dict) -> dict:
+        mechanism = command.get("mechanism")
+        if mechanism not in self.mechanisms:
+            return {
+                "ok": 0,
+                "code": 2,
+                "codeName": "BadValue",
+                "errmsg": f"unsupported mechanism {mechanism}",
+            }
+        payload = bytes(command["payload"]).decode("utf-8")
+        # "n,,n=<user>,r=<nonce>"
+        bare = payload.split(",,", 1)[1]
+        fields = dict(
+            p.split("=", 1) for p in bare.split(",") if "=" in p
+        )
+        username = fields["n"].replace("=2C", ",").replace("=3D", "=")
+        cnonce = fields["r"]
+        if username not in self.users:
+            return {
+                "ok": 0,
+                "code": 18,
+                "codeName": "AuthenticationFailed",
+                "errmsg": "Authentication failed.",
+            }
+        snonce = cnonce + base64.b64encode(os.urandom(18)).decode("ascii")
+        salt = os.urandom(16)
+        iterations = 4096
+        server_first = (
+            f"r={snonce},s={base64.b64encode(salt).decode('ascii')},"
+            f"i={iterations}"
+        )
+        skip_empty = bool(
+            (command.get("options") or {}).get("skipEmptyExchange")
+        ) and not self.force_empty_exchange
+        conn_state["sasl"] = {
+            "mechanism": mechanism,
+            "username": username,
+            "client_first_bare": bare,
+            "server_first": server_first,
+            "salt": salt,
+            "iterations": iterations,
+            "nonce": snonce,
+            "skip_empty": skip_empty,
+            "verified": False,
+        }
+        return {
+            "ok": 1,
+            "conversationId": next(self._conversations),
+            "done": False,
+            "payload": server_first.encode("utf-8"),
+        }
+
+    def _sasl_continue(self, command: dict, conn_state: dict) -> dict:
+        sasl = conn_state.get("sasl")
+        if not sasl:
+            return {
+                "ok": 0,
+                "code": 17,
+                "codeName": "ProtocolError",
+                "errmsg": "no SASL session",
+            }
+        payload = bytes(command["payload"]).decode("utf-8")
+        if sasl["verified"]:  # the final empty exchange
+            conn_state["authed"] = True
+            conn_state["sasl"] = None
+            return {"ok": 1, "done": True, "payload": b""}
+        fields = dict(
+            p.split("=", 1) for p in payload.split(",") if "=" in p
+        )
+        digest = {"SCRAM-SHA-1": "sha1", "SCRAM-SHA-256": "sha256"}[
+            sasl["mechanism"]
+        ]
+        pw = self._scram_password(
+            sasl["mechanism"], sasl["username"], self.users[sasl["username"]]
+        )
+        salted = hashlib.pbkdf2_hmac(
+            digest, pw.encode("utf-8"), sasl["salt"], sasl["iterations"]
+        )
+        client_key = hmac.new(salted, b"Client Key", digest).digest()
+        stored_key = hashlib.new(digest, client_key).digest()
+        without_proof = f"c=biws,r={fields['r']}"
+        auth_message = ",".join(
+            [sasl["client_first_bare"], sasl["server_first"], without_proof]
+        ).encode("utf-8")
+        client_sig = hmac.new(stored_key, auth_message, digest).digest()
+        derived_key = bytes(
+            a ^ b
+            for a, b in zip(base64.b64decode(fields["p"]), client_sig)
+        )
+        if (
+            fields["r"] != sasl["nonce"]
+            or hashlib.new(digest, derived_key).digest() != stored_key
+        ):
+            conn_state["sasl"] = None
+            return {
+                "ok": 0,
+                "code": 18,
+                "codeName": "AuthenticationFailed",
+                "errmsg": "Authentication failed.",
+            }
+        server_key = hmac.new(salted, b"Server Key", digest).digest()
+        v = base64.b64encode(
+            hmac.new(server_key, auth_message, digest).digest()
+        ).decode("ascii")
+        if sasl["skip_empty"]:
+            conn_state["authed"] = True
+            conn_state["sasl"] = None
+            return {"ok": 1, "done": True, "payload": f"v={v}".encode()}
+        sasl["verified"] = True
+        return {"ok": 1, "done": False, "payload": f"v={v}".encode()}
+
+    def _dispatch(self, command: dict, conn_state: dict) -> dict:
         op = next(iter(command))
         self.commands_seen.append(op)
-        if op in ("hello", "ismaster", "ping"):
+        if op in ("hello", "ismaster"):
+            reply = {"ok": 1}
+            if self.users and command.get("saslSupportedMechs"):
+                user = str(command["saslSupportedMechs"]).split(".", 1)[-1]
+                if user in self.users:
+                    reply["saslSupportedMechs"] = list(self.mechanisms)
+            return reply
+        if op == "saslStart":
+            return self._sasl_start(command, conn_state)
+        if op == "saslContinue":
+            return self._sasl_continue(command, conn_state)
+        if self.users and not conn_state.get("authed"):
+            return {
+                "ok": 0,
+                "code": 13,
+                "codeName": "Unauthorized",
+                "errmsg": f"command {op} requires authentication",
+            }
+        if op == "ping":
             return {"ok": 1}
         if op == "insert":
             coll = self._coll(command, "insert")

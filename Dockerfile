@@ -18,22 +18,28 @@ RUN pip install --no-cache-dir "${JAX_EXTRA}" flax optax orbax-checkpoint chex e
 WORKDIR /app
 COPY kmamiz_tpu/ kmamiz_tpu/
 COPY native/kmamiz_native.cpp native/kmamiz_json.cpp native/kmamiz_spans.cpp native/
-# includes the filter CRs and, when built via envoy/filter/build.sh,
-# the kmamiz-filter.wasm binary served at GET /wasm
+# filter CRs + wasm filter source; the header-telemetry binary is
+# (re)assembled below, the richer Go build (JSON body capture) comes from
+# envoy/filter/build.sh on a tinygo-equipped machine
 COPY envoy/ envoy/
+COPY tools/wasm_asm.py tools/build_wasm_filter.py tools/
 
 # compile the native ingest/parse extension at build time so the first
 # request never pays the toolchain cost
-RUN g++ -O3 -shared -fPIC -std=c++17 \
+RUN g++ -O3 -shared -fPIC -pthread -std=c++17 \
       -o /tmp/libkmamiz_native.so \
       native/kmamiz_native.cpp native/kmamiz_json.cpp native/kmamiz_spans.cpp \
     && mkdir -p native/build \
     && mv /tmp/libkmamiz_native.so native/build/
 
+# assemble the proxy-wasm telemetry filter from the tree (pure Python —
+# no wasm toolchain needed); served at GET /wasm
+RUN python tools/build_wasm_filter.py
+
 ENV PYTHONPATH=/app \
     PORT=3000 \
     STORAGE_URI=memory:// \
-    KMAMIZ_WASM_PATH=/app/envoy/kmamiz-filter.wasm
+    KMAMIZ_WASM_PATH=/app/envoy/filter/kmamiz_filter.wasm
 
 EXPOSE 3000
 # modes mirror the reference entrypoint (index.ts:29-92): SERVE_ONLY,

@@ -75,9 +75,10 @@ class Settings:
     static_dir: str = field(
         default_factory=lambda: os.environ.get("KMAMIZ_STATIC_DIR", "./dist")
     )
+    # default: the in-tree artifact tools/build_wasm_filter.py assembles
     wasm_path: str = field(
         default_factory=lambda: os.environ.get(
-            "KMAMIZ_WASM_PATH", "./envoy/kmamiz-filter.wasm"
+            "KMAMIZ_WASM_PATH", "./envoy/filter/kmamiz_filter.wasm"
         )
     )
 

@@ -1,0 +1,426 @@
+"""Assemble the Envoy proxy-wasm telemetry filter binary from the tree.
+
+The image ships no wasm toolchain (no tinygo for envoy/filter/main.go, no
+clang wasm32 target), so this builder emits the filter directly through
+tools/wasm_asm.py — pure Python, reproducible, no network. Output:
+envoy/filter/kmamiz_filter.wasm, served by the API at GET /wasm
+(KMAMIZ_WASM_PATH) and deployed by envoy/EnvoyFilter-WASM.yaml.
+
+Behavior (proxy-wasm ABI 0.2.x, the contract of the reference's Go filter
+/root/reference/envoy/wasm/main.go and of the richer Go source kept at
+envoy/filter/main.go for tinygo-equipped builds):
+
+- on request headers: log
+    [Request reqId/traceId/spanId/parentSpanId] [METHOD hostpath]
+    (+ " [ContentType ..]" when the request carries one)
+  and remember the id block per stream context.
+- on response headers: log
+    [Response <same ids>] [Status] <code> (+ ContentType block)
+- ids default to NO_ID individually, method/host/path to "" — exactly
+  kmamiz_tpu.core.envoy_filter.format_request_log/format_response_log,
+  which tests/test_wasm_filter.py executes this BINARY against (via the
+  tools/wasm_interp.py interpreter) to prove.
+
+Body capture/desensitization is the one main.go feature not assembled
+here (it needs a JSON tokenizer in raw wasm); the ingestion parser
+accepts body-less lines, so schemas come from the Go build when a tinygo
+toolchain exists. Everything else — the lines every scorer, dependency
+graph, and insight consumes — is produced by this in-tree artifact.
+
+Host interface used:
+  env.proxy_log(level, ptr, size) -> status
+  env.proxy_get_header_map_value(map_type, kptr, klen, out_ptr, out_size)
+      -> status            (map_type 0 = request headers, 2 = response)
+
+Memory map (4 pages):
+  0x0080.. : static strings (data segment)
+  0x0800   : header-value out-ptr scratch, 0x0804: out-size scratch
+  0x1000.. : log-line build buffer
+  0x8000.. : per-stream context table, 128 slots x 256 B
+             [0]=ctx_id [4]=ids_len [8..]=ids bytes
+  0x10000..0x40000 : bump arena for proxy_on_memory_allocate (wraps;
+             host-written values are consumed within the same callback)
+"""
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+from wasm_asm import I32, Asm, Module  # noqa: E402
+
+LINE_BUF = 0x1000
+OUT_PTR = 0x800
+OUT_SIZE = 0x804
+CTX_TABLE = 0x8000
+CTX_SLOTS = 128
+CTX_SLOT_SIZE = 256
+IDS_CAP = CTX_SLOT_SIZE - 8
+ARENA_LO = 0x10000
+ARENA_HI = 0x40000
+LOG_INFO = 2
+MAP_REQUEST = 0
+MAP_RESPONSE = 2
+
+
+def build() -> bytes:
+    m = Module()
+    m.set_memory_pages(4)
+
+    # -- static strings ------------------------------------------------------
+    strings = {}
+    cursor = 0x80
+
+    def S(text: str):
+        nonlocal cursor
+        if text not in strings:
+            raw = text.encode()
+            strings[text] = (cursor, len(raw))
+            cursor += len(raw)
+        return strings[text]
+
+    for s in (
+        "x-request-id",
+        "x-b3-traceid",
+        "x-b3-spanid",
+        "x-b3-parentspanid",
+        ":method",
+        ":authority",
+        ":path",
+        "content-type",
+        ":status",
+        "NO_ID",
+        "NO_ID/NO_ID/NO_ID/NO_ID",
+        "[Request ",
+        "[Response ",
+        "] [",
+        "] [Status] ",
+        " [ContentType ",
+        "]",
+        "/",
+        " ",
+        "",
+    ):
+        S(s)
+
+    # -- imports (function index space starts with these) --------------------
+    LOG = m.add_import("env", "proxy_log", [I32, I32, I32], [I32])
+    GET = m.add_import(
+        "env", "proxy_get_header_map_value", [I32] * 5, [I32]
+    )
+
+    # -- globals -------------------------------------------------------------
+    G_BUMP = m.add_global(ARENA_LO)
+    G_LINE = m.add_global(0)
+
+    # -- function declarations (bodies reference forward indices) ------------
+    ALLOC = m.declare_func("alloc", [I32], [I32])
+    APPEND = m.declare_func("append", [I32, I32], [])
+    MEMCPY = m.declare_func("memcpy", [I32, I32, I32], [])
+    GETHDR = m.declare_func("get_header", [I32, I32, I32], [I32])
+    APPVAL = m.declare_func("append_value", [], [])
+    APPHDR = m.declare_func("append_header_or", [I32] * 5, [])
+    SLOT = m.declare_func("slot", [I32, I32], [I32])
+    ONREQ = m.declare_func("on_req", [I32], [])
+    ONRESP = m.declare_func("on_resp", [I32], [])
+    m.declare_func("proxy_on_memory_allocate", [I32], [I32])
+    m.declare_func("proxy_on_request_headers", [I32, I32, I32], [I32])
+    m.declare_func("proxy_on_response_headers", [I32, I32, I32], [I32])
+    m.declare_func("proxy_on_context_create", [I32, I32], [])
+    m.declare_func("proxy_on_vm_start", [I32, I32], [I32])
+    m.declare_func("proxy_on_configure", [I32, I32], [I32])
+    m.declare_func("proxy_on_done", [I32], [I32])
+    m.declare_func("proxy_on_delete", [I32], [])
+    m.declare_func("proxy_on_log", [I32], [])
+    m.declare_func("proxy_abi_version_0_2_0", [], [])
+
+    def append_lit(a: Asm, text: str) -> None:
+        ptr, length = S(text)
+        a.i32_const(ptr).i32_const(length).call(APPEND)
+
+    # -- alloc(size) -> ptr: bump, 8-aligned, wraps the arena ---------------
+    a = Asm()
+    a.global_get(G_BUMP).local_set(1)  # ptr = bump
+    a.global_get(G_BUMP).local_get(0).i32_add().i32_const(7).i32_add()
+    a.i32_const(-8).i32_and().global_set(G_BUMP)
+    a.global_get(G_BUMP).i32_const(ARENA_HI).i32_gt_u().if_()
+    a.i32_const(ARENA_LO).local_set(1)
+    a.i32_const(ARENA_LO).local_get(0).i32_add().i32_const(7).i32_add()
+    a.i32_const(-8).i32_and().global_set(G_BUMP)
+    a.end()
+    a.local_get(1)
+    m.define_func("alloc", 1, a)
+
+    # -- append(src, len): copy into the line buffer, clamped so oversized
+    # headers can never run past the buffer into the context table ----------
+    line_cap = CTX_TABLE - LINE_BUF
+    a = Asm()
+    # len = min(len, cap - line_len)
+    a.local_get(1).i32_const(line_cap).global_get(G_LINE).i32_sub()
+    a.i32_gt_u().if_()
+    a.i32_const(line_cap).global_get(G_LINE).i32_sub().local_set(1)
+    a.end()
+    a.i32_const(0).local_set(2)
+    a.block()
+    a.loop()
+    a.local_get(2).local_get(1).i32_ge_u().br_if(1)
+    a.i32_const(LINE_BUF).global_get(G_LINE).i32_add().local_get(2).i32_add()
+    a.local_get(0).local_get(2).i32_add().i32_load8_u()
+    a.i32_store8()
+    a.local_get(2).i32_const(1).i32_add().local_set(2)
+    a.br(0)
+    a.end()
+    a.end()
+    a.global_get(G_LINE).local_get(1).i32_add().global_set(G_LINE)
+    m.define_func("append", 1, a)
+
+    # -- memcpy(dst, src, len) ------------------------------------------------
+    a = Asm()
+    a.i32_const(0).local_set(3)
+    a.block()
+    a.loop()
+    a.local_get(3).local_get(2).i32_ge_u().br_if(1)
+    a.local_get(0).local_get(3).i32_add()
+    a.local_get(1).local_get(3).i32_add().i32_load8_u()
+    a.i32_store8()
+    a.local_get(3).i32_const(1).i32_add().local_set(3)
+    a.br(0)
+    a.end()
+    a.end()
+    m.define_func("memcpy", 1, a)
+
+    # -- get_header(map, kptr, klen) -> found; value at OUT_PTR/OUT_SIZE -----
+    a = Asm()
+    a.i32_const(OUT_PTR).i32_const(0).i32_store()
+    a.i32_const(OUT_SIZE).i32_const(0).i32_store()
+    a.local_get(0).local_get(1).local_get(2)
+    a.i32_const(OUT_PTR).i32_const(OUT_SIZE).call(GET)
+    a.if_(I32)  # nonzero status: not found / error
+    a.i32_const(0)
+    a.else_()
+    a.i32_const(OUT_PTR).i32_load().i32_eqz().if_(I32)
+    a.i32_const(0)
+    a.else_()
+    a.i32_const(OUT_SIZE).i32_load().i32_const(0).i32_gt_u()
+    a.end()
+    a.end()
+    m.define_func("get_header", 0, a)
+
+    # -- append_value(): append the header value the host wrote --------------
+    a = Asm()
+    a.i32_const(OUT_PTR).i32_load().i32_const(OUT_SIZE).i32_load().call(APPEND)
+    m.define_func("append_value", 0, a)
+
+    # -- append_header_or(map, kptr, klen, fbptr, fblen) ----------------------
+    a = Asm()
+    a.local_get(0).local_get(1).local_get(2).call(GETHDR)
+    a.if_()
+    a.call(APPVAL)
+    a.else_()
+    a.local_get(3).local_get(4).call(APPEND)
+    a.end()
+    m.define_func("append_header_or", 0, a)
+
+    # -- slot(ctx, create) -> addr | 0 ---------------------------------------
+    # Open addressing with TOMBSTONES (id -1): proxy_on_delete must not
+    # zero slots in place or it would break the probe chains of colliding
+    # live streams. Lookups probe past tombstones; creation reuses the
+    # first tombstone seen once the key is proven absent.
+    TOMB = -1
+    a = Asm()
+    # locals: 2=h, 3=tries, 4=addr, 5=id, 6=first_tombstone
+    a.local_get(0).i32_const(-1640531527).i32_mul()
+    a.i32_const(16).i32_shr_u().i32_const(CTX_SLOTS - 1).i32_and()
+    a.local_set(2)
+    a.i32_const(0).local_set(3)
+    a.i32_const(0).local_set(6)
+    a.block()
+    a.loop()
+    a.local_get(3).i32_const(CTX_SLOTS).i32_ge_u().br_if(1)  # probed all
+    a.i32_const(CTX_TABLE).local_get(2).i32_const(CTX_SLOT_SIZE).i32_mul()
+    a.i32_add().local_set(4)
+    a.local_get(4).i32_load().local_set(5)
+    a.local_get(5).local_get(0).i32_eq().if_()
+    a.local_get(4).return_()
+    a.end()
+    a.local_get(5).i32_const(TOMB).i32_eq().if_()
+    a.local_get(6).i32_eqz().if_()
+    a.local_get(4).local_set(6)  # remember the first reusable slot
+    a.end()
+    a.else_()
+    a.local_get(5).i32_eqz().if_()
+    a.local_get(1).i32_eqz().if_()
+    a.i32_const(0).return_()  # lookup miss
+    a.end()
+    a.local_get(6).if_()  # claim the earlier tombstone if any
+    a.local_get(6).local_set(4)
+    a.end()
+    a.local_get(4).local_get(0).i32_store()
+    a.local_get(4).i32_const(0).i32_store(4)
+    a.local_get(4).return_()
+    a.end()
+    a.end()
+    a.local_get(2).i32_const(1).i32_add().i32_const(CTX_SLOTS - 1).i32_and()
+    a.local_set(2)
+    a.local_get(3).i32_const(1).i32_add().local_set(3)
+    a.br(0)
+    a.end()
+    a.end()
+    # probed the whole table: claim a tombstone when creating
+    a.local_get(1).if_()
+    a.local_get(6).if_()
+    a.local_get(6).local_get(0).i32_store()
+    a.local_get(6).i32_const(0).i32_store(4)
+    a.local_get(6).return_()
+    a.end()
+    a.end()
+    a.i32_const(0)
+    m.define_func("slot", 5, a)
+
+    # -- on_req(ctx): build + log the [Request ...] line ----------------------
+    no_id = S("NO_ID")
+    a = Asm()
+    # locals: 1=ids_start, 2=ids_len, 3=slot_addr
+    a.i32_const(0).global_set(G_LINE)
+    append_lit(a, "[Request ")
+    a.global_get(G_LINE).local_set(1)
+    for i, key in enumerate(
+        ("x-request-id", "x-b3-traceid", "x-b3-spanid", "x-b3-parentspanid")
+    ):
+        kp, kl = S(key)
+        a.i32_const(MAP_REQUEST).i32_const(kp).i32_const(kl)
+        a.i32_const(no_id[0]).i32_const(no_id[1]).call(APPHDR)
+        if i < 3:
+            append_lit(a, "/")
+    a.global_get(G_LINE).local_get(1).i32_sub().local_set(2)
+    # remember the id block for the response/log phases
+    a.local_get(0).i32_const(1).call(SLOT).local_set(3)
+    a.local_get(3).if_()
+    a.local_get(2).i32_const(IDS_CAP).i32_gt_u().if_()
+    a.i32_const(IDS_CAP).local_set(2)
+    a.end()
+    a.local_get(3).local_get(2).i32_store(4)
+    a.local_get(3).i32_const(8).i32_add()
+    a.i32_const(LINE_BUF).local_get(1).i32_add()
+    a.local_get(2).call(MEMCPY)
+    a.end()
+    append_lit(a, "] [")
+    empty = S("")
+    for key in (":method", None, ":authority", ":path"):
+        if key is None:
+            append_lit(a, " ")
+            continue
+        kp, kl = S(key)
+        a.i32_const(MAP_REQUEST).i32_const(kp).i32_const(kl)
+        a.i32_const(empty[0]).i32_const(empty[1]).call(APPHDR)
+    append_lit(a, "]")
+    ct = S("content-type")
+    a.i32_const(MAP_REQUEST).i32_const(ct[0]).i32_const(ct[1]).call(GETHDR)
+    a.if_()
+    append_lit(a, " [ContentType ")
+    a.call(APPVAL)
+    append_lit(a, "]")
+    a.end()
+    a.i32_const(LOG_INFO).i32_const(LINE_BUF).global_get(G_LINE).call(LOG)
+    a.drop()
+    m.define_func("on_req", 3, a)
+
+    # -- on_resp(ctx): the [Response ...] twin --------------------------------
+    a = Asm()
+    # locals: 1=slot_addr
+    a.i32_const(0).global_set(G_LINE)
+    append_lit(a, "[Response ")
+    a.local_get(0).i32_const(0).call(SLOT).local_set(1)
+    a.local_get(1).if_()
+    a.local_get(1).i32_const(8).i32_add().local_get(1).i32_load(4).call(APPEND)
+    a.else_()
+    append_lit(a, "NO_ID/NO_ID/NO_ID/NO_ID")
+    a.end()
+    append_lit(a, "] [Status] ")
+    st = S(":status")
+    a.i32_const(MAP_RESPONSE).i32_const(st[0]).i32_const(st[1])
+    a.i32_const(empty[0]).i32_const(empty[1]).call(APPHDR)
+    ct = S("content-type")
+    a.i32_const(MAP_RESPONSE).i32_const(ct[0]).i32_const(ct[1]).call(GETHDR)
+    a.if_()
+    append_lit(a, " [ContentType ")
+    a.call(APPVAL)
+    append_lit(a, "]")
+    a.end()
+    a.i32_const(LOG_INFO).i32_const(LINE_BUF).global_get(G_LINE).call(LOG)
+    a.drop()
+    m.define_func("on_resp", 1, a)
+
+    # -- ABI surface ----------------------------------------------------------
+    a = Asm()
+    a.local_get(0).call(ALLOC)
+    m.define_func("proxy_on_memory_allocate", 0, a)
+
+    a = Asm()
+    a.local_get(0).call(ONREQ)
+    a.i32_const(0)  # Action::Continue
+    m.define_func("proxy_on_request_headers", 0, a)
+
+    a = Asm()
+    a.local_get(0).call(ONRESP)
+    a.i32_const(0)
+    m.define_func("proxy_on_response_headers", 0, a)
+
+    m.define_func("proxy_on_context_create", 0, Asm())
+
+    a = Asm()
+    a.i32_const(1)
+    m.define_func("proxy_on_vm_start", 0, a)
+
+    a = Asm()
+    a.i32_const(1)
+    m.define_func("proxy_on_configure", 0, a)
+
+    a = Asm()
+    a.i32_const(1)
+    m.define_func("proxy_on_done", 0, a)
+
+    a = Asm()
+    a.local_get(0).i32_const(0).call(SLOT).local_tee(1).if_()
+    a.local_get(1).i32_const(-1).i32_store()  # tombstone, not empty
+    a.end()
+    m.define_func("proxy_on_delete", 1, a)
+
+    m.define_func("proxy_on_log", 0, Asm())
+    m.define_func("proxy_abi_version_0_2_0", 0, Asm())
+
+    for name in (
+        "proxy_on_memory_allocate",
+        "proxy_on_request_headers",
+        "proxy_on_response_headers",
+        "proxy_on_context_create",
+        "proxy_on_vm_start",
+        "proxy_on_configure",
+        "proxy_on_done",
+        "proxy_on_delete",
+        "proxy_on_log",
+        "proxy_abi_version_0_2_0",
+    ):
+        m.export_func(name)
+    m.export_func("malloc", "alloc")  # legacy hosts allocate via malloc
+    m.export_memory()
+
+    base = min(off for off, _ in strings.values())
+    end = max(off + ln for off, ln in strings.values())
+    blob = bytearray(end - base)
+    for text, (off, ln) in strings.items():
+        blob[off - base : off - base + ln] = text.encode()
+    m.add_data(base, bytes(blob))
+
+    return m.build()
+
+
+def main() -> None:
+    out = Path(__file__).resolve().parent.parent / "envoy" / "filter" / "kmamiz_filter.wasm"
+    binary = build()
+    out.write_bytes(binary)
+    print(f"wrote {out} ({len(binary)} bytes)")
+
+
+if __name__ == "__main__":
+    main()

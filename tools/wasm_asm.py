@@ -1,0 +1,350 @@
+"""Minimal WebAssembly (MVP) binary writer.
+
+The build image ships no wasm toolchain (no tinygo/clang/wat2wasm), so the
+Envoy telemetry filter binary (envoy/filter/kmamiz_filter.wasm) is
+assembled directly from this pure-Python encoder — zero external
+dependencies, reproducible from the tree. The subset emitted is what the
+filter needs: i32 arithmetic, linear memory, globals, calls, structured
+control flow, and active data segments.
+
+Binary layout per the WebAssembly 1.0 spec (sections 1,2,3,5,6,7,10,11).
+"""
+from __future__ import annotations
+
+import struct
+from typing import Dict, List, Optional, Sequence, Tuple
+
+I32 = 0x7F
+
+# -- LEB128 -----------------------------------------------------------------
+
+
+def uleb(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def sleb(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        sign = b & 0x40
+        if (n == 0 and not sign) or (n == -1 and sign):
+            out.append(b)
+            return bytes(out)
+        out.append(b | 0x80)
+
+
+def _section(sid: int, payload: bytes) -> bytes:
+    return bytes([sid]) + uleb(len(payload)) + payload
+
+
+def _vec(items: Sequence[bytes]) -> bytes:
+    return uleb(len(items)) + b"".join(items)
+
+
+# -- instruction assembler ---------------------------------------------------
+
+
+class Asm:
+    """Appends instruction bytes; one instance per function body."""
+
+    def __init__(self) -> None:
+        self.code = bytearray()
+
+    # control
+    def block(self) -> "Asm":  # void block type
+        self.code += b"\x02\x40"
+        return self
+
+    def loop(self) -> "Asm":
+        self.code += b"\x03\x40"
+        return self
+
+    def if_(self, result: Optional[int] = None) -> "Asm":
+        self.code += b"\x04" + (bytes([result]) if result else b"\x40")
+        return self
+
+    def else_(self) -> "Asm":
+        self.code += b"\x05"
+        return self
+
+    def end(self) -> "Asm":
+        self.code += b"\x0B"
+        return self
+
+    def br(self, depth: int) -> "Asm":
+        self.code += b"\x0C" + uleb(depth)
+        return self
+
+    def br_if(self, depth: int) -> "Asm":
+        self.code += b"\x0D" + uleb(depth)
+        return self
+
+    def return_(self) -> "Asm":
+        self.code += b"\x0F"
+        return self
+
+    def call(self, func_index: int) -> "Asm":
+        self.code += b"\x10" + uleb(func_index)
+        return self
+
+    def unreachable(self) -> "Asm":
+        self.code += b"\x00"
+        return self
+
+    def drop(self) -> "Asm":
+        self.code += b"\x1A"
+        return self
+
+    def select(self) -> "Asm":
+        self.code += b"\x1B"
+        return self
+
+    # variables
+    def local_get(self, i: int) -> "Asm":
+        self.code += b"\x20" + uleb(i)
+        return self
+
+    def local_set(self, i: int) -> "Asm":
+        self.code += b"\x21" + uleb(i)
+        return self
+
+    def local_tee(self, i: int) -> "Asm":
+        self.code += b"\x22" + uleb(i)
+        return self
+
+    def global_get(self, i: int) -> "Asm":
+        self.code += b"\x23" + uleb(i)
+        return self
+
+    def global_set(self, i: int) -> "Asm":
+        self.code += b"\x24" + uleb(i)
+        return self
+
+    # memory (alignment hint 0 / 2 is valid for any access)
+    def i32_load(self, offset: int = 0) -> "Asm":
+        self.code += b"\x28\x02" + uleb(offset)
+        return self
+
+    def i32_load8_u(self, offset: int = 0) -> "Asm":
+        self.code += b"\x2D\x00" + uleb(offset)
+        return self
+
+    def i32_store(self, offset: int = 0) -> "Asm":
+        self.code += b"\x36\x02" + uleb(offset)
+        return self
+
+    def i32_store8(self, offset: int = 0) -> "Asm":
+        self.code += b"\x3A\x00" + uleb(offset)
+        return self
+
+    # const + numeric
+    def i32_const(self, v: int) -> "Asm":
+        self.code += b"\x41" + sleb(v)
+        return self
+
+    def i32_eqz(self) -> "Asm":
+        self.code += b"\x45"
+        return self
+
+    def i32_eq(self) -> "Asm":
+        self.code += b"\x46"
+        return self
+
+    def i32_ne(self) -> "Asm":
+        self.code += b"\x47"
+        return self
+
+    def i32_lt_u(self) -> "Asm":
+        self.code += b"\x49"
+        return self
+
+    def i32_gt_u(self) -> "Asm":
+        self.code += b"\x4B"
+        return self
+
+    def i32_le_u(self) -> "Asm":
+        self.code += b"\x4D"
+        return self
+
+    def i32_ge_u(self) -> "Asm":
+        self.code += b"\x4F"
+        return self
+
+    def i32_add(self) -> "Asm":
+        self.code += b"\x6A"
+        return self
+
+    def i32_sub(self) -> "Asm":
+        self.code += b"\x6B"
+        return self
+
+    def i32_mul(self) -> "Asm":
+        self.code += b"\x6C"
+        return self
+
+    def i32_rem_u(self) -> "Asm":
+        self.code += b"\x70"
+        return self
+
+    def i32_and(self) -> "Asm":
+        self.code += b"\x71"
+        return self
+
+    def i32_or(self) -> "Asm":
+        self.code += b"\x72"
+        return self
+
+    def i32_shl(self) -> "Asm":
+        self.code += b"\x74"
+        return self
+
+    def i32_shr_u(self) -> "Asm":
+        self.code += b"\x76"
+        return self
+
+
+# -- module builder ----------------------------------------------------------
+
+
+class Module:
+    def __init__(self) -> None:
+        self._types: List[Tuple[Tuple[int, ...], Tuple[int, ...]]] = []
+        self._imports: List[Tuple[str, str, int]] = []  # module, name, type idx
+        self._funcs: List[Tuple[int, List[int], Asm]] = []  # type, locals, body
+        self._func_names: Dict[str, int] = {}
+        self._exports: List[Tuple[str, int, int]] = []  # name, kind, index
+        self._globals: List[Tuple[int, bool, int]] = []  # type, mut, init
+        self._data: List[Tuple[int, bytes]] = []
+        self._mem_pages = 1
+
+    def type_index(self, params: Sequence[int], results: Sequence[int]) -> int:
+        key = (tuple(params), tuple(results))
+        for i, t in enumerate(self._types):
+            if t == key:
+                return i
+        self._types.append(key)
+        return len(self._types) - 1
+
+    def add_import(
+        self, module: str, name: str, params: Sequence[int], results: Sequence[int]
+    ) -> int:
+        """Returns the function index (imports come first in index space)."""
+        if self._funcs:
+            raise ValueError("declare all imports before functions")
+        self._imports.append((module, name, self.type_index(params, results)))
+        idx = len(self._imports) - 1
+        self._func_names[name] = idx
+        return idx
+
+    def declare_func(
+        self, name: str, params: Sequence[int], results: Sequence[int]
+    ) -> int:
+        """Reserve an index (so bodies can call forward references)."""
+        idx = len(self._imports) + len(self._funcs)
+        self._funcs.append((self.type_index(params, results), [], Asm()))
+        self._func_names[name] = idx
+        return idx
+
+    def define_func(self, name: str, locals_i32: int, body: Asm) -> None:
+        idx = self._func_names[name] - len(self._imports)
+        type_idx = self._funcs[idx][0]
+        self._funcs[idx] = (type_idx, [I32] * locals_i32, body)
+
+    def func(self, name: str) -> int:
+        return self._func_names[name]
+
+    def add_global(self, init: int, mutable: bool = True) -> int:
+        self._globals.append((I32, mutable, init))
+        return len(self._globals) - 1
+
+    def export_func(self, name: str, func_name: Optional[str] = None) -> None:
+        self._exports.append((name, 0, self._func_names[func_name or name]))
+
+    def export_memory(self, name: str = "memory") -> None:
+        self._exports.append((name, 2, 0))
+
+    def set_memory_pages(self, pages: int) -> None:
+        self._mem_pages = pages
+
+    def add_data(self, offset: int, payload: bytes) -> None:
+        self._data.append((offset, payload))
+
+    def build(self) -> bytes:
+        out = bytearray(b"\x00asm\x01\x00\x00\x00")
+
+        types = []
+        for params, results in self._types:
+            types.append(
+                b"\x60"
+                + _vec([bytes([p]) for p in params])
+                + _vec([bytes([r]) for r in results])
+            )
+        out += _section(1, _vec(types))
+
+        if self._imports:
+            imps = []
+            for module, name, tidx in self._imports:
+                imps.append(
+                    uleb(len(module.encode()))
+                    + module.encode()
+                    + uleb(len(name.encode()))
+                    + name.encode()
+                    + b"\x00"
+                    + uleb(tidx)
+                )
+            out += _section(2, _vec(imps))
+
+        out += _section(3, _vec([uleb(t) for t, _l, _b in self._funcs]))
+        out += _section(5, _vec([b"\x00" + uleb(self._mem_pages)]))
+
+        if self._globals:
+            gl = []
+            for vtype, mut, init in self._globals:
+                gl.append(
+                    bytes([vtype, 1 if mut else 0])
+                    + b"\x41"
+                    + sleb(init)
+                    + b"\x0B"
+                )
+            out += _section(6, _vec(gl))
+
+        exps = []
+        for name, kind, index in self._exports:
+            exps.append(
+                uleb(len(name.encode()))
+                + name.encode()
+                + bytes([kind])
+                + uleb(index)
+            )
+        out += _section(7, _vec(exps))
+
+        codes = []
+        for _tidx, locals_, body in self._funcs:
+            decl = _vec([uleb(len(locals_)) + bytes([I32])] if locals_ else [])
+            code = decl + bytes(body.code) + b"\x0B"
+            codes.append(uleb(len(code)) + code)
+        out += _section(10, _vec(codes))
+
+        if self._data:
+            segs = []
+            for offset, payload in self._data:
+                segs.append(
+                    b"\x00\x41"
+                    + sleb(offset)
+                    + b"\x0B"
+                    + uleb(len(payload))
+                    + payload
+                )
+            out += _section(11, _vec(segs))
+
+        return bytes(out)

@@ -1,0 +1,425 @@
+"""Subset WebAssembly interpreter: executes the in-tree filter binary.
+
+No wasm runtime ships in the image, so the tests run the ACTUAL
+envoy/filter/kmamiz_filter.wasm artifact through this interpreter against
+mocked proxy-wasm host functions and compare the logged lines with the
+Python spec twin (kmamiz_tpu.core.envoy_filter). Covers the MVP subset
+tools/wasm_asm.py emits — i32 ops, linear memory, globals, structured
+control flow, calls — and raises on anything outside it.
+"""
+from __future__ import annotations
+
+import struct
+from typing import Callable, Dict, List, Optional, Tuple
+
+PAGE = 65536
+
+
+def _read_uleb(buf: bytes, pos: int) -> Tuple[int, int]:
+    result = 0
+    shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def _read_sleb(buf: bytes, pos: int) -> Tuple[int, int]:
+    result = 0
+    shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        shift += 7
+        if not b & 0x80:
+            if b & 0x40:
+                result |= -(1 << shift)
+            return result, pos
+
+
+def _u32(v: int) -> int:
+    return v & 0xFFFFFFFF
+
+
+def _s32(v: int) -> int:
+    v &= 0xFFFFFFFF
+    return v - (1 << 32) if v >= (1 << 31) else v
+
+
+class WasmError(RuntimeError):
+    pass
+
+
+class Function:
+    def __init__(self, type_idx: int, locals_n: int, body: bytes) -> None:
+        self.type_idx = type_idx
+        self.locals_n = locals_n
+        self.body = body
+        self.jumps: Dict[int, Tuple[int, Optional[int]]] = {}
+        self._scan()
+
+    def _scan(self) -> None:
+        """Precompute (end_pc, else_pc) for every block/loop/if start."""
+        stack: List[int] = []
+        elses: Dict[int, int] = {}
+        pos = 0
+        buf = self.body
+        while pos < len(buf):
+            op = buf[pos]
+            start = pos
+            pos += 1
+            if op in (0x02, 0x03, 0x04):  # block/loop/if
+                pos += 1  # blocktype byte
+                stack.append(start)
+            elif op == 0x05:  # else
+                elses[stack[-1]] = pos
+            elif op == 0x0B:  # end
+                if stack:
+                    opener = stack.pop()
+                    self.jumps[opener] = (pos, elses.get(opener))
+            elif op in (0x0C, 0x0D, 0x10, 0x20, 0x21, 0x22, 0x23, 0x24):
+                _, pos = _read_uleb(buf, pos)
+            elif op in (0x28, 0x2D, 0x36, 0x3A):
+                _, pos = _read_uleb(buf, pos)
+                _, pos = _read_uleb(buf, pos)
+            elif op == 0x41:
+                _, pos = _read_sleb(buf, pos)
+            # all other supported opcodes have no immediates
+
+
+class Module:
+    def __init__(self, binary: bytes) -> None:
+        if binary[:8] != b"\x00asm\x01\x00\x00\x00":
+            raise WasmError("bad magic/version")
+        self.types: List[Tuple[List[int], List[int]]] = []
+        self.imports: List[Tuple[str, str, int]] = []
+        self.functions: List[Function] = []
+        self.globals_init: List[int] = []
+        self.exports: Dict[str, Tuple[int, int]] = {}  # name -> (kind, idx)
+        self.mem_pages = 1
+        self.data: List[Tuple[int, bytes]] = []
+        self._parse(binary)
+
+    def _parse(self, binary: bytes) -> None:
+        pos = 8
+        func_types: List[int] = []
+        while pos < len(binary):
+            sid = binary[pos]
+            pos += 1
+            size, pos = _read_uleb(binary, pos)
+            body = binary[pos : pos + size]
+            pos += size
+            if sid == 1:
+                self._parse_types(body)
+            elif sid == 2:
+                self._parse_imports(body)
+            elif sid == 3:
+                n, p = _read_uleb(body, 0)
+                for _ in range(n):
+                    t, p = _read_uleb(body, p)
+                    func_types.append(t)
+            elif sid == 5:
+                n, p = _read_uleb(body, 0)
+                flags, p = _read_uleb(body, p)
+                self.mem_pages, p = _read_uleb(body, p)
+            elif sid == 6:
+                self._parse_globals(body)
+            elif sid == 7:
+                self._parse_exports(body)
+            elif sid == 10:
+                self._parse_code(body, func_types)
+            elif sid == 11:
+                self._parse_data(body)
+            # other sections ignored
+
+    def _parse_types(self, body: bytes) -> None:
+        n, p = _read_uleb(body, 0)
+        for _ in range(n):
+            if body[p] != 0x60:
+                raise WasmError("expected functype")
+            p += 1
+            np_, p = _read_uleb(body, p)
+            params = list(body[p : p + np_])
+            p += np_
+            nr, p = _read_uleb(body, p)
+            results = list(body[p : p + nr])
+            p += nr
+            self.types.append((params, results))
+
+    def _parse_imports(self, body: bytes) -> None:
+        n, p = _read_uleb(body, 0)
+        for _ in range(n):
+            ml, p = _read_uleb(body, p)
+            mod = body[p : p + ml].decode()
+            p += ml
+            nl, p = _read_uleb(body, p)
+            name = body[p : p + nl].decode()
+            p += nl
+            kind = body[p]
+            p += 1
+            if kind != 0:
+                raise WasmError("only function imports supported")
+            tidx, p = _read_uleb(body, p)
+            self.imports.append((mod, name, tidx))
+
+    def _parse_globals(self, body: bytes) -> None:
+        n, p = _read_uleb(body, 0)
+        for _ in range(n):
+            p += 2  # valtype + mutability
+            if body[p] != 0x41:
+                raise WasmError("only i32.const global initializers")
+            v, p = _read_sleb(body, p + 1)
+            if body[p] != 0x0B:
+                raise WasmError("bad global init")
+            p += 1
+            self.globals_init.append(v)
+
+    def _parse_exports(self, body: bytes) -> None:
+        n, p = _read_uleb(body, 0)
+        for _ in range(n):
+            nl, p = _read_uleb(body, p)
+            name = body[p : p + nl].decode()
+            p += nl
+            kind = body[p]
+            p += 1
+            idx, p = _read_uleb(body, p)
+            self.exports[name] = (kind, idx)
+
+    def _parse_code(self, body: bytes, func_types: List[int]) -> None:
+        n, p = _read_uleb(body, 0)
+        for i in range(n):
+            size, p = _read_uleb(body, p)
+            code = body[p : p + size]
+            p += size
+            q = 0
+            ndecl, q = _read_uleb(code, q)
+            locals_n = 0
+            for _ in range(ndecl):
+                cnt, q = _read_uleb(code, q)
+                q += 1  # valtype
+                locals_n += cnt
+            self.functions.append(Function(func_types[i], locals_n, code[q:]))
+
+    def _parse_data(self, body: bytes) -> None:
+        n, p = _read_uleb(body, 0)
+        for _ in range(n):
+            mode, p = _read_uleb(body, p)
+            if mode != 0 or body[p] != 0x41:
+                raise WasmError("only active i32.const data segments")
+            offset, p = _read_sleb(body, p + 1)
+            if body[p] != 0x0B:
+                raise WasmError("bad data offset expr")
+            p += 1
+            ln, p = _read_uleb(body, p)
+            self.data.append((offset, body[p : p + ln]))
+            p += ln
+
+
+HostFn = Callable[..., int]
+
+
+class Instance:
+    """module + host functions keyed 'module.name'; host fns receive
+    (instance, *args)."""
+
+    def __init__(self, module: Module, host: Dict[str, HostFn]) -> None:
+        self.module = module
+        self.host = host
+        self.memory = bytearray(module.mem_pages * PAGE)
+        self.globals = list(module.globals_init)
+        for offset, payload in module.data:
+            self.memory[offset : offset + len(payload)] = payload
+        self.n_imports = len(module.imports)
+
+    # -- memory helpers for host functions ----------------------------------
+
+    def read(self, ptr: int, size: int) -> bytes:
+        return bytes(self.memory[ptr : ptr + size])
+
+    def write(self, ptr: int, data: bytes) -> None:
+        self.memory[ptr : ptr + len(data)] = data
+
+    def write_u32(self, ptr: int, v: int) -> None:
+        struct.pack_into("<I", self.memory, ptr, _u32(v))
+
+    def read_u32(self, ptr: int) -> int:
+        return struct.unpack_from("<I", self.memory, ptr)[0]
+
+    def invoke(self, name: str, *args: int) -> List[int]:
+        kind, idx = self.module.exports[name]
+        if kind != 0:
+            raise WasmError(f"{name} is not a function export")
+        return self._call(idx, list(args))
+
+    def _call(self, func_idx: int, args: List[int]) -> List[int]:
+        if func_idx < self.n_imports:
+            mod, name, tidx = self.module.imports[func_idx]
+            fn = self.host.get(f"{mod}.{name}")
+            if fn is None:
+                raise WasmError(f"missing host function {mod}.{name}")
+            result = fn(self, *args)
+            _params, results = self.module.types[tidx]
+            return [] if not results else [_u32(int(result or 0))]
+        f = self.module.functions[func_idx - self.n_imports]
+        locals_ = list(args) + [0] * f.locals_n
+        return self._exec(f, locals_)
+
+    def _exec(self, f: Function, locals_: List[int]) -> List[int]:
+        buf = f.body
+        stack: List[int] = []
+        # control stack entries: (kind, start_pc, end_pc, else_pc)
+        ctrl: List[Tuple[int, int, int, Optional[int]]] = []
+        pos = 0
+        _params, results = self.module.types[f.type_idx]
+
+        def branch(depth: int) -> int:
+            nonlocal ctrl
+            target = len(ctrl) - 1 - depth
+            kind, start, end, _els = ctrl[target]
+            del ctrl[target + 1 :]
+            if kind == 0x03:  # loop: jump back to the loop body start
+                return start
+            ctrl.pop()
+            return end
+
+        while True:
+            if pos >= len(buf):
+                break
+            op = buf[pos]
+            ipos = pos
+            pos += 1
+            if op == 0x02 or op == 0x03:  # block / loop
+                end, _els = f.jumps[ipos]
+                pos += 1
+                ctrl.append((op, pos, end, None))
+            elif op == 0x04:  # if
+                end, els = f.jumps[ipos]
+                pos += 1
+                cond = stack.pop()
+                ctrl.append((op, pos, end, els))
+                if not cond:
+                    pos = els if els is not None else end
+                    if els is None:
+                        ctrl.pop()
+            elif op == 0x05:  # else: taken branch falls here -> skip to end
+                kind, start, end, _els = ctrl.pop()
+                pos = end
+            elif op == 0x0B:  # end
+                if ctrl:
+                    ctrl.pop()
+                else:
+                    break
+            elif op == 0x0C:  # br
+                depth, pos = _read_uleb(buf, pos)
+                pos = branch(depth)
+            elif op == 0x0D:  # br_if
+                depth, pos = _read_uleb(buf, pos)
+                if stack.pop():
+                    pos = branch(depth)
+            elif op == 0x0F:  # return
+                break
+            elif op == 0x10:  # call
+                fidx, pos = _read_uleb(buf, pos)
+                if fidx < self.n_imports:
+                    nparams = len(self.module.types[self.module.imports[fidx][2]][0])
+                else:
+                    nparams = len(
+                        self.module.types[
+                            self.module.functions[fidx - self.n_imports].type_idx
+                        ][0]
+                    )
+                callee_args = stack[len(stack) - nparams :]
+                del stack[len(stack) - nparams :]
+                stack.extend(self._call(fidx, callee_args))
+            elif op == 0x1A:  # drop
+                stack.pop()
+            elif op == 0x1B:  # select
+                c = stack.pop()
+                b = stack.pop()
+                a = stack.pop()
+                stack.append(a if c else b)
+            elif op == 0x20:
+                i, pos = _read_uleb(buf, pos)
+                stack.append(locals_[i])
+            elif op == 0x21:
+                i, pos = _read_uleb(buf, pos)
+                locals_[i] = stack.pop()
+            elif op == 0x22:
+                i, pos = _read_uleb(buf, pos)
+                locals_[i] = stack[-1]
+            elif op == 0x23:
+                i, pos = _read_uleb(buf, pos)
+                stack.append(self.globals[i])
+            elif op == 0x24:
+                i, pos = _read_uleb(buf, pos)
+                self.globals[i] = stack.pop()
+            elif op == 0x28:  # i32.load
+                _a, pos = _read_uleb(buf, pos)
+                off, pos = _read_uleb(buf, pos)
+                addr = _u32(stack.pop()) + off
+                stack.append(struct.unpack_from("<I", self.memory, addr)[0])
+            elif op == 0x2D:  # i32.load8_u
+                _a, pos = _read_uleb(buf, pos)
+                off, pos = _read_uleb(buf, pos)
+                addr = _u32(stack.pop()) + off
+                stack.append(self.memory[addr])
+            elif op == 0x36:  # i32.store
+                _a, pos = _read_uleb(buf, pos)
+                off, pos = _read_uleb(buf, pos)
+                v = stack.pop()
+                addr = _u32(stack.pop()) + off
+                struct.pack_into("<I", self.memory, addr, _u32(v))
+            elif op == 0x3A:  # i32.store8
+                _a, pos = _read_uleb(buf, pos)
+                off, pos = _read_uleb(buf, pos)
+                v = stack.pop()
+                addr = _u32(stack.pop()) + off
+                self.memory[addr] = v & 0xFF
+            elif op == 0x41:
+                v, pos = _read_sleb(buf, pos)
+                stack.append(_u32(v))
+            elif op == 0x45:
+                stack.append(1 if stack.pop() == 0 else 0)
+            elif op in (0x46, 0x47, 0x49, 0x4B, 0x4D, 0x4F):
+                b = _u32(stack.pop())
+                a = _u32(stack.pop())
+                stack.append(
+                    {
+                        0x46: a == b,
+                        0x47: a != b,
+                        0x49: a < b,
+                        0x4B: a > b,
+                        0x4D: a <= b,
+                        0x4F: a >= b,
+                    }[op]
+                    and 1
+                    or 0
+                )
+            elif op in (0x6A, 0x6B, 0x6C, 0x70, 0x71, 0x72, 0x74, 0x76):
+                b = stack.pop()
+                a = stack.pop()
+                if op == 0x6A:
+                    r = a + b
+                elif op == 0x6B:
+                    r = a - b
+                elif op == 0x6C:
+                    r = a * b
+                elif op == 0x70:
+                    r = _u32(a) % _u32(b) if b else 0
+                elif op == 0x71:
+                    r = a & b
+                elif op == 0x72:
+                    r = a | b
+                elif op == 0x74:
+                    r = a << (b & 31)
+                else:  # 0x76 shr_u
+                    r = _u32(a) >> (b & 31)
+                stack.append(_u32(r))
+            else:
+                raise WasmError(f"unsupported opcode 0x{op:02x} at {ipos}")
+
+        return [_u32(v) for v in stack[len(stack) - len(results) :]] if results else []

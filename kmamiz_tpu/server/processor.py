@@ -261,6 +261,11 @@ class DataProcessor:
         one-shot path; only adversarial cross-trace id collisions can
         change the processed-row count.
 
+        Failure semantics: per-chunk at-least-once. A malformed LATER
+        chunk raises after earlier chunks already merged and registered
+        their trace ids (the set-union edge store makes re-merges benign;
+        the one-shot ingest_raw_window path stays all-or-nothing).
+
         Returns the ingest_raw_window totals plus overlap accounting
         (parse_ms / merge_ms / saved_ms)."""
         from concurrent.futures import ThreadPoolExecutor

@@ -238,13 +238,192 @@ using scan_fn = const char* (*)(const char*, const char*);
 scan_fn g_scan_special = scan_special_swar;
 scan_fn g_scan_structural = scan_structural_swar;
 
+// -- block classification for the group prescan -----------------------------
+// simdjson-style stage 1, reduced to what trace-group splitting needs:
+// per 64-byte block, bitmasks of '"', '\\', '[', ']' -> resolve escapes,
+// derive the in-string mask by prefix-XOR of unescaped quotes (with
+// carries across blocks), and emit the positions of brackets OUTSIDE
+// strings. One branchless linear pass instead of re-scanning every byte
+// through the Scanner's per-group skip walk — this is the serial
+// fraction of the multi-threaded parse.
+
+struct BlockMasks {
+  uint64_t quote, bslash, open, close;
+};
+
+static inline uint64_t movemask8(uint64_t m_high) {
+  // SWAR compare result (high bit per byte) -> 8-bit mask
+  return (m_high >> 7) * 0x0102040810204080ull >> 56;
+}
+
+static void classify_swar(const char* p, BlockMasks* out) {
+  uint64_t q = 0, b = 0, o = 0, c = 0;
+  for (int w = 0; w < 8; ++w) {
+    uint64_t word;
+    std::memcpy(&word, p + w * 8, 8);
+    q |= movemask8(swar_eq(word, kQuotePat)) << (w * 8);
+    b |= movemask8(swar_eq(word, kBslashPat)) << (w * 8);
+    o |= movemask8(swar_eq(word, 0x5B5B5B5B5B5B5B5Bull)) << (w * 8);
+    c |= movemask8(swar_eq(word, 0x5D5D5D5D5D5D5D5Dull)) << (w * 8);
+  }
+  out->quote = q;
+  out->bslash = b;
+  out->open = o;
+  out->close = c;
+}
+
+#if defined(__x86_64__)
+// NOTE: no lambdas here — closures do not inherit the target attribute
+__attribute__((target("avx2"))) static uint64_t mask64_avx2(
+    __m256i lo, __m256i hi, __m256i needle) {
+  uint64_t mlo = static_cast<uint32_t>(
+      _mm256_movemask_epi8(_mm256_cmpeq_epi8(lo, needle)));
+  uint64_t mhi = static_cast<uint32_t>(
+      _mm256_movemask_epi8(_mm256_cmpeq_epi8(hi, needle)));
+  return mlo | (mhi << 32);
+}
+
+__attribute__((target("avx2"))) static void classify_avx2(const char* p,
+                                                          BlockMasks* out) {
+  __m256i lo = _mm256_loadu_si256(reinterpret_cast<const __m256i*>(p));
+  __m256i hi = _mm256_loadu_si256(reinterpret_cast<const __m256i*>(p + 32));
+  out->quote = mask64_avx2(lo, hi, _mm256_set1_epi8('"'));
+  out->bslash = mask64_avx2(lo, hi, _mm256_set1_epi8('\\'));
+  out->open = mask64_avx2(lo, hi, _mm256_set1_epi8('['));
+  out->close = mask64_avx2(lo, hi, _mm256_set1_epi8(']'));
+}
+#endif
+
+using classify_fn = void (*)(const char*, BlockMasks*);
+classify_fn g_classify = classify_swar;
+
 __attribute__((constructor)) static void init_scan_dispatch() {
 #if defined(__x86_64__)
   if (__builtin_cpu_supports("avx2")) {
     g_scan_special = scan_special_avx2;
     g_scan_structural = scan_structural_avx2;
+    g_classify = classify_avx2;
   }
 #endif
+}
+
+inline uint64_t prefix_xor64(uint64_t x) {
+  x ^= x << 1;
+  x ^= x << 2;
+  x ^= x << 4;
+  x ^= x << 8;
+  x ^= x << 16;
+  x ^= x << 32;
+  return x;
+}
+
+// emit [begin, end) byte ranges of the top-level array's elements that are
+// themselves arrays (trace groups). Returns false on malformed bracket
+// structure; out_end gets the offset just past the top-level ']'.
+// Elements that are NOT arrays leave gaps the caller validates.
+static bool scan_group_ranges(const char* json, size_t len,
+                              std::vector<std::pair<size_t, size_t>>* groups,
+                              size_t* top_open, size_t* top_close) {
+  uint64_t prev_in_string = 0;   // all-ones when carrying inside a string
+  uint64_t prev_escaped = 0;     // bit 0: first char of block is escaped
+  int depth = 0;
+  bool seen_top = false;
+  size_t group_start = 0;
+  *top_open = len;
+  *top_close = len;
+
+  alignas(64) char tail[64];
+  for (size_t base = 0; base < len; base += 64) {
+    BlockMasks m;
+    if (len - base >= 64) {
+      g_classify(json + base, &m);
+    } else {
+      size_t n = len - base;
+      std::memset(tail, 0, sizeof(tail));
+      std::memcpy(tail, json + base, n);
+      g_classify(tail, &m);
+    }
+    // resolve escaped characters: the canonical simdjson odd-length
+    // backslash-run scan (json_string_scanner::find_escaped), with
+    // prev_escaped carrying a run's escape across the block edge
+    uint64_t bs = m.bslash & ~prev_escaped;
+    uint64_t follows_escape = (bs << 1) | prev_escaped;
+    constexpr uint64_t kEvenBits = 0x5555555555555555ull;
+    uint64_t odd_starts = bs & ~kEvenBits & ~follows_escape;
+    uint64_t seq_on_even;
+    prev_escaped =
+        __builtin_add_overflow(odd_starts, bs, &seq_on_even) ? 1 : 0;
+    uint64_t escaped = ((kEvenBits ^ (seq_on_even << 1)) & follows_escape);
+    uint64_t quotes = m.quote & ~escaped;
+    uint64_t in_string = prefix_xor64(quotes) ^ prev_in_string;
+    prev_in_string = static_cast<uint64_t>(static_cast<int64_t>(in_string) >> 63);
+    uint64_t structural = (m.open | m.close) & ~in_string & ~escaped;
+    // quoted regions: a bracket AT a quote position is impossible; the
+    // in_string mask includes the opening quote and excludes the closing
+    // one, which is fine because brackets are never quote bytes
+    while (structural) {
+      int bit = __builtin_ctzll(structural);
+      structural &= structural - 1;
+      size_t pos = base + static_cast<size_t>(bit);
+      if (pos >= len) break;
+      bool is_open = (m.open >> bit) & 1;
+      if (is_open) {
+        ++depth;
+        if (depth == 1) {
+          if (seen_top) return false;  // second top-level array
+          seen_top = true;
+          *top_open = pos;
+        } else if (depth == 2) {
+          group_start = pos;
+        }
+      } else {
+        if (depth <= 0) return false;
+        --depth;
+        if (depth == 1) {
+          groups->emplace_back(group_start, pos + 1);
+        } else if (depth == 0) {
+          *top_close = pos + 1;
+          return seen_top;
+        }
+      }
+    }
+  }
+  return false;  // top-level array never closed
+}
+
+inline bool only_ws_and_commas(const char* p, const char* end,
+                               int expected_commas) {
+  int commas = 0;
+  for (; p < end; ++p) {
+    char ch = *p;
+    if (ch == ',') {
+      ++commas;
+    } else if (ch != ' ' && ch != '\t' && ch != '\n' && ch != '\r') {
+      return false;
+    }
+  }
+  return commas == expected_commas;
+}
+
+// the ranges from scan_group_ranges cover only ARRAY elements; everything
+// between them must be exactly the separating commas (+ws), or the input
+// carried non-array elements / garbage the sequential walk would reject.
+// Shared by prescan_fast and km_split_groups so the two stay in lockstep.
+static bool validate_group_gaps(
+    const char* json, const std::vector<std::pair<size_t, size_t>>& ranges,
+    size_t top_open, size_t top_close) {
+  if (!only_ws_and_commas(json, json + top_open, 0)) return false;
+  if (ranges.empty())
+    return only_ws_and_commas(json + top_open + 1, json + top_close - 1, 0);
+  if (!only_ws_and_commas(json + top_open + 1, json + ranges[0].first, 0))
+    return false;
+  for (size_t g = 1; g < ranges.size(); ++g) {
+    if (!only_ws_and_commas(json + ranges[g - 1].second,
+                            json + ranges[g].first, 1))
+      return false;
+  }
+  return only_ws_and_commas(json + ranges.back().second,
+                            json + top_close - 1, 0);
 }
 
 // -- open-addressing string_view -> int32 map -------------------------------
@@ -1059,6 +1238,46 @@ struct PrescanResult {
   bool ok = false;
 };
 
+// fast path for the worker mode: ONE branchless structural pass finds all
+// group ranges (scan_group_ranges), gaps are validated to be exactly the
+// separating commas (so malformed non-array elements still fail like the
+// sequential walk), then only each group's head is probed for its traceId
+PrescanResult prescan_fast(const char* json, size_t json_len,
+                           const std::vector<std::pair<sv, bool>>& skip,
+                           Arena* arena) {
+  PrescanResult out;
+  std::vector<std::pair<size_t, size_t>> ranges;
+  size_t top_open, top_close;
+  if (!scan_group_ranges(json, json_len, &ranges, &top_open, &top_close))
+    return out;
+  if (!validate_group_gaps(json, ranges, top_open, top_close)) return out;
+  if (ranges.empty()) {
+    out.ok = true;
+    return out;
+  }
+
+  SvMap seen(skip.size() + 64);
+  bool ins;
+  for (auto& e : skip)
+    seen.intern(e.second ? e.first : kNoneSentinel, 1, &ins);
+  for (auto& r : ranges) {
+    Scanner probe{json + r.first, json + r.second, arena};
+    probe.eat('[');
+    probe.ws();
+    if (probe.peek(']')) continue;  // empty group: skipped, not registered
+    sv tid;
+    bool tid_present = false;
+    if (!peek_trace_id(probe, &tid, &tid_present)) return out;
+    sv seen_key = tid_present ? tid : kNoneSentinel;
+    if (seen.find(seen_key) != nullptr) continue;
+    seen.intern(seen_key, 1, &ins);
+    out.kept.push_back(
+        GroupRange{json + r.first, json + r.second, tid, tid_present});
+  }
+  out.ok = true;
+  return out;
+}
+
 PrescanResult prescan(const char* json, size_t json_len,
                       const std::vector<std::pair<sv, bool>>& skip,
                       Arena* arena, ThreadOut* inline_out) {
@@ -1559,7 +1778,7 @@ bool parse_pipeline(const char* json, size_t json_len,
     return as->ok;
   }
 
-  PrescanResult ps = prescan(json, json_len, skip, arena, nullptr);
+  PrescanResult ps = prescan_fast(json, json_len, skip, arena);
   if (!ps.ok) return false;
   uint64_t p1 = now_us();
   as->prescan_us = static_cast<uint32_t>(p1 - p0);
@@ -1739,27 +1958,16 @@ unsigned char* km_split_groups(const char* json, size_t json_len,
                                int n_chunks, size_t* out_len) {
   *out_len = 0;
   if (n_chunks < 1) n_chunks = 1;
-  Arena arena;
-  Scanner s{json, json + json_len, &arena};
+  std::vector<std::pair<size_t, size_t>> ranges;
+  size_t top_open, top_close;
+  if (!scan_group_ranges(json, json_len, &ranges, &top_open, &top_close))
+    return nullptr;
+  if (!validate_group_gaps(json, ranges, top_open, top_close)) return nullptr;
   std::vector<std::pair<uint64_t, uint64_t>> groups;
-  if (!s.eat('[')) return nullptr;
-  bool first = true;
-  while (s.ok) {
-    s.ws();
-    if (s.peek(']')) {
-      ++s.p;
-      break;
-    }
-    if (!first && !s.eat(',')) return nullptr;
-    first = false;
-    s.ws();
-    const char* gbegin = s.p;
-    s.skip_value();
-    if (!s.ok) return nullptr;
-    groups.emplace_back(static_cast<uint64_t>(gbegin - json),
-                        static_cast<uint64_t>(s.p - json));
-  }
-  if (!s.ok) return nullptr;
+  groups.reserve(ranges.size());
+  for (auto& r : ranges)
+    groups.emplace_back(static_cast<uint64_t>(r.first),
+                        static_cast<uint64_t>(r.second));
 
   size_t per = (groups.size() + n_chunks - 1) /
                static_cast<size_t>(n_chunks);

@@ -565,6 +565,39 @@ class TestParallelParse:
                 groups.append(group)
             self._compare_outputs(json.dumps(groups).encode())
 
+
+    def test_mt_structural_scan_vs_adversarial_strings(self):
+        # strings stuffed with brackets, escaped quotes, and backslash runs:
+        # the block-classified prescan must mask them exactly like the
+        # sequential scanner
+        mk = TestDedupSemantics().mk_span
+        groups = []
+        evil_names = [
+            'a]b[c',
+            'quote\\"inside',
+            'double\\\\backslash"then]bracket'.replace('"', ''),
+            'run\\\\\\"x][',
+            '[[[]]]',
+            'comma,]"like'.replace('"', ''),
+        ]
+        for t, name in enumerate(evil_names * 5):
+            s = mk(f"evil{t}", f"id{t}")
+            s["name"] = name
+            s["tags"]["http.url"] = f"http://h/[{name}]?q=\\]"
+            groups.append([s])
+        raw = json.dumps(groups).encode()
+        self._compare_outputs(raw)
+        # and split_groups agrees with the group count
+        chunks = native.split_groups(raw, 5)
+        assert chunks is not None
+        assert sum(len(json.loads(c)) for c in chunks) == len(groups)
+
+    def test_mt_whitespace_heavy_layout(self):
+        mk = TestDedupSemantics().mk_span
+        groups = [[mk(f"w{t}", f"s{t}")] for t in range(9)]
+        pretty = json.dumps(groups, indent=3).encode()
+        self._compare_outputs(pretty)
+
     def test_parity_with_host_under_threads_env(self, monkeypatch):
         # the full raw_spans_to_batch path (naming, interning) with the MT
         # scanner underneath must still match the pure-Python host path
@@ -661,3 +694,23 @@ class TestStreamingIngest:
         assert streamed["traces"] == whole["traces"] == 24
         assert streamed["edges"] == whole["edges"]
         assert streamed["endpoints"] == whole["endpoints"]
+
+
+def test_bracket_balanced_invalid_groups_agree_across_modes():
+    """Dropped (dedup-hit) groups are validated to bracket/string balance
+    only — in BOTH modes (the sequential walk's skip_value never parsed
+    grammar either); kept groups parse fully and reject bad JSON in both."""
+    from kmamiz_tpu import native
+
+    # duplicate group is bracket-balanced but grammatically invalid: it is
+    # DROPPED by dedup, so both modes accept the payload identically
+    dropped_bad = b'[[{"traceId":"t","id":"a"}],[{"traceId":"t"} {"x":1}]]'
+    seq = native.parse_spans(dropped_bad, [], threads=1)
+    mt = native.parse_spans(dropped_bad, [], threads=4)
+    assert seq is not None and mt is not None
+    assert seq["n_spans"] == mt["n_spans"] == 1
+
+    # the same malformation in a KEPT group fails in both modes
+    kept_bad = b'[[{"traceId":"x"} {"y":1}]]'
+    assert native.parse_spans(kept_bad, [], threads=1) is None
+    assert native.parse_spans(kept_bad, [], threads=4) is None

@@ -49,11 +49,14 @@ class Harness:
         self.logs = []
         self.request_headers = {}
         self.response_headers = {}
+        self.request_body = b""
+        self.response_body = b""
         self.instance = Instance(
             self.module,
             {
                 "env.proxy_log": self._log,
                 "env.proxy_get_header_map_value": self._get_header,
+                "env.proxy_get_buffer_bytes": self._get_buffer,
             },
         )
 
@@ -73,12 +76,43 @@ class Harness:
         inst.write_u32(out_size, len(val))
         return 0
 
-    def stream(self, ctx, request_headers, response_headers):
+    def _get_buffer(self, inst, buf_type, start, length, out_ptr, out_size):
+        data = self.request_body if buf_type == 0 else self.response_body
+        data = data[start : start + length]
+        if not data:
+            return 1
+        addr = inst.invoke("proxy_on_memory_allocate", len(data))[0]
+        if addr == 0:
+            return 1  # module refused the allocation (too large)
+        inst.write(addr, data)
+        inst.write_u32(out_ptr, addr)
+        inst.write_u32(out_size, len(data))
+        return 0
+
+    def stream(
+        self,
+        ctx,
+        request_headers,
+        response_headers,
+        request_body=None,
+        response_body=None,
+    ):
         self.request_headers = request_headers
         self.response_headers = response_headers
+        self.request_body = (request_body or "").encode()
+        self.response_body = (response_body or "").encode()
         self.instance.invoke("proxy_on_context_create", ctx, 1)
         assert self.instance.invoke("proxy_on_request_headers", ctx, 0, 0) == [0]
+        if request_body is not None:
+            assert self.instance.invoke(
+                "proxy_on_request_body", ctx, len(self.request_body), 1
+            ) == [0]
         assert self.instance.invoke("proxy_on_response_headers", ctx, 0, 0) == [0]
+        if response_body is not None:
+            assert self.instance.invoke(
+                "proxy_on_response_body", ctx, len(self.response_body), 1
+            ) == [0]
+        self.instance.invoke("proxy_on_log", ctx)
         self.instance.invoke("proxy_on_delete", ctx)
 
 
@@ -120,7 +154,7 @@ class TestBinaryStructure:
             "memory",
         ):
             assert export in m.exports, export
-        assert [mod for mod, _n, _t in m.imports] == ["env", "env"]
+        assert [mod for mod, _n, _t in m.imports] == ["env"] * 3
 
     def test_lifecycle_booleans(self, binary):
         h = Harness(binary)
@@ -168,6 +202,7 @@ class TestLineParity:
         h = Harness(binary)
         req_a = dict(FULL_REQ, **{"x-b3-traceid": "trace-A"})
         req_b = dict(FULL_REQ, **{"x-b3-traceid": "trace-B"})
+        del req_a["content-type"], req_b["content-type"]  # log at headers
         # A request, B request, then responses out of order
         h.request_headers = req_a
         h.instance.invoke("proxy_on_request_headers", 10, 0, 0)
@@ -263,4 +298,177 @@ class TestIngestionRoundTrip:
             ctx_id = int.from_bytes(table[off : off + 4], "little")
             assert ctx_id in (0, 6, 0xFFFFFFFF), hex(ctx_id)
         # and the stream still correlated (ids survived, truncated or not)
-        assert h.logs[1][1].startswith("[Response rid-1/abc123")
+        resp_line = next(l for _lvl, l in h.logs if l.startswith("[Response"))
+        assert resp_line.startswith("[Response rid-1/abc123")
+
+
+class TestBodyDesensitization:
+    """JSON bodies round the wasm transform: string values -> "",
+    numbers -> 0, keys/booleans/null/structure kept — byte-identical to
+    the Python twin's json.loads/dumps pipeline for ASCII keys."""
+
+    def _req_with_body(self, binary, body):
+        h = Harness(binary)
+        h.stream(21, FULL_REQ, {":status": "200"}, request_body=body)
+        return h.logs[0][1]
+
+    @pytest.mark.parametrize(
+        "body",
+        [
+            '{"user": "alice", "age": 31, "tags": ["a", "b"], "ok": true}',
+            '{"nested": {"deep": {"x": [1, 2.5, -3e2], "y": null}}}',
+            "[]",
+            "{}",
+            '[{"a": 1}, {"a": 2}, []]',
+            '"top-level string"',
+            "12345",
+            "-0.5e-2",
+            "true",
+            "null",
+            '{"esc": "line\\nbreak \\u0041 and \\"quoted\\""}',
+            '{"spaced"  :   [ 1 ,  2 ]  }',
+            '{"zero": 0, "neg": -7}',
+        ],
+    )
+    def test_body_matches_spec_twin(self, binary, body):
+        from kmamiz_tpu.core.envoy_filter import format_request_log
+
+        line = self._req_with_body(binary, body)
+        want = format_request_log(
+            "POST",
+            "svc.ns.svc.cluster.local:8080",
+            "/api/v1/data?x=1",
+            "rid-1",
+            "abc123",
+            "s1",
+            "p1",
+            "application/json",
+            body,
+        )
+        assert line == want
+        assert " [Body] " in line  # the twin accepted it too
+
+    @pytest.mark.parametrize(
+        "bad",
+        [
+            '{"a" 1}',            # missing colon
+            '{"a": 1,}',          # trailing comma
+            '[1, 2] garbage',     # trailing bytes
+            "{'a': 1}",           # single quotes
+            '{"a": 01}',          # leading zero
+            '{"a": .5}',          # bare fraction
+            '{"a": 1.}',          # dangling dot
+            '{"bad\x01ctl": 1}',  # raw control char in string
+            '{"esc": "\\q"}',     # invalid escape
+            '{"u": "\\u12g4"}',   # bad hex
+            "[1, 2",              # unterminated
+            "",                   # empty
+            "NaN",                # json.loads accepts, the filter rejects
+        ],
+    )
+    def test_invalid_bodies_never_leak(self, binary, bad):
+        line = self._req_with_body(binary, bad)
+        assert " [Body] " not in line
+        assert bad[:8] not in line or not bad  # raw bytes never appear
+
+    def test_response_body(self, binary):
+        from kmamiz_tpu.core.envoy_filter import format_response_log
+
+        h = Harness(binary)
+        body = '{"result": "secret-value", "count": 99}'
+        h.stream(22, FULL_REQ, FULL_RESP, response_body=body)
+        want = format_response_log(
+            "201", "rid-1", "abc123", "s1", "p1", "application/json", body
+        )
+        resp_line = next(l for _lvl, l in h.logs if l.startswith("[Response"))
+        assert resp_line == want
+        assert "secret-value" not in resp_line  # desensitized
+
+    def test_oversized_body_drops_block(self, binary):
+        big = '{"k": [' + ", ".join(["1"] * 20_000) + "]}"
+        line = self._req_with_body(binary, big)
+        assert " [Body] " not in line
+
+    def test_non_json_content_type_ignores_body(self, binary):
+        h = Harness(binary)
+        req = dict(FULL_REQ, **{"content-type": "text/plain"})
+        h.stream(23, req, {":status": "200"}, request_body='{"a": 1}')
+        assert " [Body] " not in h.logs[0][1]
+        assert "[ContentType text/plain]" in h.logs[0][1]
+
+    def test_missing_body_falls_back_to_bare_line(self, binary):
+        from kmamiz_tpu.core.envoy_filter import format_request_log
+
+        h = Harness(binary)
+        h.stream(24, FULL_REQ, {":status": "200"})  # json ct, body never came
+        req_line = next(l for _lvl, l in h.logs if l.startswith("[Request"))
+        assert req_line == format_request_log(
+            "POST",
+            "svc.ns.svc.cluster.local:8080",
+            "/api/v1/data?x=1",
+            "rid-1",
+            "abc123",
+            "s1",
+            "p1",
+            "application/json",
+        )
+
+    def test_fuzz_random_json_matches_twin(self, binary):
+        import json as _json
+        import random
+
+        from kmamiz_tpu.core.envoy_filter import desensitize_body
+
+        rng = random.Random(11)
+
+        def gen(depth=0):
+            r = rng.random()
+            if depth > 3 or r < 0.25:
+                return rng.choice(
+                    [True, False, None, 0, -17, 3.25, 1e6, "txt", "", "q\\"]
+                )
+            if r < 0.55:
+                return [gen(depth + 1) for _ in range(rng.randint(0, 4))]
+            return {
+                f"k{i}": gen(depth + 1) for i in range(rng.randint(0, 4))
+            }
+
+        h = Harness(binary)
+        for trial in range(30):
+            body = _json.dumps(gen())
+            h.logs.clear()
+            h.stream(100 + trial, FULL_REQ, {":status": "200"},
+                     request_body=body)
+            line = h.logs[0][1]
+            want_scrubbed = desensitize_body(body)
+            assert want_scrubbed is not None
+            assert line.endswith(f" [Body] {want_scrubbed}"), (
+                body,
+                line,
+                want_scrubbed,
+            )
+
+    def test_body_larger_than_arena_drops_block(self, binary):
+        # bigger than the whole allocation arena: the module must refuse
+        # the allocation (ptr 0) rather than hand out an overrunning
+        # pointer; the line still logs, bodyless
+        huge = '{"k": "' + "x" * 300_000 + '"}'
+        line = self._req_with_body(binary, huge)
+        assert " [Body] " not in line
+        assert line.startswith("[Request rid-1/abc123")
+
+    def test_full_context_table_still_logs_json_streams(self, binary):
+        h = Harness(binary)
+        # fill every slot with live JSON streams (no delete)
+        h.response_headers = {":status": "200"}
+        for i in range(1, 129):
+            h.request_headers = dict(FULL_REQ, **{"x-b3-traceid": f"t{i}"})
+            h.instance.invoke("proxy_on_request_headers", i, 0, 0)
+        # the 129th stream finds no slot: it must fall back to logging at
+        # headers instead of silently dropping its line pair
+        h.request_headers = dict(FULL_REQ, **{"x-b3-traceid": "overflow"})
+        before = len(h.logs)
+        h.instance.invoke("proxy_on_request_headers", 999, 0, 0)
+        assert len(h.logs) == before + 1
+        assert "overflow" in h.logs[-1][1]
+        assert h.logs[-1][1].startswith("[Request")

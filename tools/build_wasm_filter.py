@@ -7,38 +7,51 @@ envoy/filter/kmamiz_filter.wasm, served by the API at GET /wasm
 (KMAMIZ_WASM_PATH) and deployed by envoy/EnvoyFilter-WASM.yaml.
 
 Behavior (proxy-wasm ABI 0.2.x, the contract of the reference's Go filter
-/root/reference/envoy/wasm/main.go and of the richer Go source kept at
+/root/reference/envoy/wasm/main.go, mirrored by the Go source kept at
 envoy/filter/main.go for tinygo-equipped builds):
 
-- on request headers: log
-    [Request reqId/traceId/spanId/parentSpanId] [METHOD hostpath]
-    (+ " [ContentType ..]" when the request carries one)
-  and remember the id block per stream context.
-- on response headers: log
-    [Response <same ids>] [Status] <code> (+ ContentType block)
+- on request headers: build and remember the
+  `reqId/traceId/spanId/parentSpanId` block per stream context; when the
+  request does NOT carry `content-type: application/json`, immediately log
+    [Request ids] [METHOD hostpath] (+ " [ContentType ..]")
+- JSON requests wait for the body: at the body callback the buffered
+  bytes are DESENSITIZED — string values -> "", numbers -> 0, booleans/
+  null/containers preserved, object keys kept, ", "/": " separators —
+  by a validating single-pass JSON transform, and the line logs with
+  " [Body] {..}". Invalid JSON drops the body block (never leaks).
+- response headers/body mirror this with [Response ids] [Status] <code>.
+- proxy_on_log backstops streams whose expected body never arrived, so
+  every stream still emits its line pair.
 - ids default to NO_ID individually, method/host/path to "" — exactly
   kmamiz_tpu.core.envoy_filter.format_request_log/format_response_log,
   which tests/test_wasm_filter.py executes this BINARY against (via the
   tools/wasm_interp.py interpreter) to prove.
 
-Body capture/desensitization is the one main.go feature not assembled
-here (it needs a JSON tokenizer in raw wasm); the ingestion parser
-accepts body-less lines, so schemas come from the Go build when a tinygo
-toolchain exists. Everything else — the lines every scorer, dependency
-graph, and insight consumes — is produced by this in-tree artifact.
+Known, documented divergences from the Python twin's json.loads/dumps
+round trip (tests pin the common cases byte-identically):
+- object KEYS are copied verbatim: `\\/`, `\\uXXXX`, and non-ASCII keys
+  keep their original spelling instead of json.dumps' normalized form;
+- duplicate object keys are kept (the twin's dict round trip dedups to
+  the last occurrence);
+- NaN/Infinity literals are rejected (json.loads accepts them);
+- bodies larger than the transform buffer (24 KB output) drop the block.
 
 Host interface used:
   env.proxy_log(level, ptr, size) -> status
   env.proxy_get_header_map_value(map_type, kptr, klen, out_ptr, out_size)
       -> status            (map_type 0 = request headers, 2 = response)
+  env.proxy_get_buffer_bytes(buffer_type, start, length, out_ptr,
+      out_size) -> status  (buffer 0 = request body, 1 = response body)
 
 Memory map (4 pages):
   0x0080.. : static strings (data segment)
   0x0800   : header-value out-ptr scratch, 0x0804: out-size scratch
-  0x1000.. : log-line build buffer
+  0x1000.. : log-line build buffer (to 0x8000, clamped)
   0x8000.. : per-stream context table, 128 slots x 256 B
-             [0]=ctx_id [4]=ids_len [8..]=ids bytes
-  0x10000..0x40000 : bump arena for proxy_on_memory_allocate (wraps;
+             [0]=ctx_id [4]=flags [8]=ids_len [12..]=ids bytes
+  0x10000  : desensitized-body output buffer (24 KB)
+  0x16000  : JSON container stack (64 B)
+  0x16100..0x40000 : bump arena for proxy_on_memory_allocate (wraps;
              host-written values are consumed within the same callback)
 """
 from __future__ import annotations
@@ -55,12 +68,32 @@ OUT_SIZE = 0x804
 CTX_TABLE = 0x8000
 CTX_SLOTS = 128
 CTX_SLOT_SIZE = 256
-IDS_CAP = CTX_SLOT_SIZE - 8
-ARENA_LO = 0x10000
+IDS_CAP = CTX_SLOT_SIZE - 12
+BODY_BUF = 0x10000
+BODY_CAP = 0x6000  # 24 KB transformed-body budget
+STACK_BASE = 0x16000
+MAX_DEPTH = 64
+ARENA_LO = 0x16100
 ARENA_HI = 0x40000
 LOG_INFO = 2
 MAP_REQUEST = 0
 MAP_RESPONSE = 2
+BUF_REQUEST_BODY = 0
+BUF_RESPONSE_BODY = 1
+
+# slot flag bits
+F_REQ_LOGGED = 1
+F_RESP_LOGGED = 2
+F_REQ_PENDING = 4
+F_RESP_PENDING = 8
+
+# desens states
+ST_VALUE = 0
+ST_VALUE_OR_END = 1
+ST_KEY_OR_END = 2
+ST_KEY = 3
+ST_COLON = 4
+ST_AFTER = 5
 
 
 def build() -> bytes:
@@ -89,6 +122,7 @@ def build() -> bytes:
         ":path",
         "content-type",
         ":status",
+        "application/json",
         "NO_ID",
         "NO_ID/NO_ID/NO_ID/NO_ID",
         "[Request ",
@@ -96,36 +130,50 @@ def build() -> bytes:
         "] [",
         "] [Status] ",
         " [ContentType ",
+        " [Body] ",
         "]",
         "/",
         " ",
         "",
+        "true",
+        "false",
+        "null",
     ):
         S(s)
 
-    # -- imports (function index space starts with these) --------------------
+    # -- imports -------------------------------------------------------------
     LOG = m.add_import("env", "proxy_log", [I32, I32, I32], [I32])
-    GET = m.add_import(
-        "env", "proxy_get_header_map_value", [I32] * 5, [I32]
-    )
+    GET = m.add_import("env", "proxy_get_header_map_value", [I32] * 5, [I32])
+    GETBUF = m.add_import("env", "proxy_get_buffer_bytes", [I32] * 5, [I32])
 
     # -- globals -------------------------------------------------------------
     G_BUMP = m.add_global(ARENA_LO)
     G_LINE = m.add_global(0)
+    G_BODY = m.add_global(0)  # desens output length (may exceed cap = fail)
 
-    # -- function declarations (bodies reference forward indices) ------------
+    # -- declarations --------------------------------------------------------
     ALLOC = m.declare_func("alloc", [I32], [I32])
     APPEND = m.declare_func("append", [I32, I32], [])
     MEMCPY = m.declare_func("memcpy", [I32, I32, I32], [])
+    MEMEQ = m.declare_func("memeq", [I32, I32, I32], [I32])
     GETHDR = m.declare_func("get_header", [I32, I32, I32], [I32])
     APPVAL = m.declare_func("append_value", [], [])
     APPHDR = m.declare_func("append_header_or", [I32] * 5, [])
     SLOT = m.declare_func("slot", [I32, I32], [I32])
-    ONREQ = m.declare_func("on_req", [I32], [])
-    ONRESP = m.declare_func("on_resp", [I32], [])
+    BODYB = m.declare_func("body_putb", [I32], [])
+    BODYPUT = m.declare_func("body_put", [I32, I32], [])
+    STRSCAN = m.declare_func("strscan", [I32, I32, I32, I32], [I32])
+    HEXOK = m.declare_func("hex_ok", [I32], [I32])
+    DESENS = m.declare_func("desens", [I32, I32], [I32])
+    BUILDIDS = m.declare_func("build_ids", [I32], [])
+    EMITREQ = m.declare_func("emit_req", [I32, I32, I32], [])
+    EMITRESP = m.declare_func("emit_resp", [I32, I32, I32], [])
+    ONBODY = m.declare_func("on_body", [I32, I32, I32, I32], [])
     m.declare_func("proxy_on_memory_allocate", [I32], [I32])
     m.declare_func("proxy_on_request_headers", [I32, I32, I32], [I32])
     m.declare_func("proxy_on_response_headers", [I32, I32, I32], [I32])
+    m.declare_func("proxy_on_request_body", [I32, I32, I32], [I32])
+    m.declare_func("proxy_on_response_body", [I32, I32, I32], [I32])
     m.declare_func("proxy_on_context_create", [I32, I32], [])
     m.declare_func("proxy_on_vm_start", [I32, I32], [I32])
     m.declare_func("proxy_on_configure", [I32, I32], [I32])
@@ -138,9 +186,15 @@ def build() -> bytes:
         ptr, length = S(text)
         a.i32_const(ptr).i32_const(length).call(APPEND)
 
-    # -- alloc(size) -> ptr: bump, 8-aligned, wraps the arena ---------------
+    # -- alloc(size) -> ptr | 0 ----------------------------------------------
     a = Asm()
-    a.global_get(G_BUMP).local_set(1)  # ptr = bump
+    # a request larger than the whole arena can never be satisfied: return
+    # 0 (hosts treat it as allocation failure) instead of handing out a
+    # pointer the host's copy would run past linear memory
+    a.local_get(0).i32_const(ARENA_HI - ARENA_LO - 16).i32_gt_u().if_()
+    a.i32_const(0).return_()
+    a.end()
+    a.global_get(G_BUMP).local_set(1)
     a.global_get(G_BUMP).local_get(0).i32_add().i32_const(7).i32_add()
     a.i32_const(-8).i32_and().global_set(G_BUMP)
     a.global_get(G_BUMP).i32_const(ARENA_HI).i32_gt_u().if_()
@@ -151,11 +205,9 @@ def build() -> bytes:
     a.local_get(1)
     m.define_func("alloc", 1, a)
 
-    # -- append(src, len): copy into the line buffer, clamped so oversized
-    # headers can never run past the buffer into the context table ----------
+    # -- append(src, len): into the line buffer, clamped ---------------------
     line_cap = CTX_TABLE - LINE_BUF
     a = Asm()
-    # len = min(len, cap - line_len)
     a.local_get(1).i32_const(line_cap).global_get(G_LINE).i32_sub()
     a.i32_gt_u().if_()
     a.i32_const(line_cap).global_get(G_LINE).i32_sub().local_set(1)
@@ -189,13 +241,31 @@ def build() -> bytes:
     a.end()
     m.define_func("memcpy", 1, a)
 
-    # -- get_header(map, kptr, klen) -> found; value at OUT_PTR/OUT_SIZE -----
+    # -- memeq(p1, p2, len) -> i32 -------------------------------------------
+    a = Asm()
+    a.i32_const(0).local_set(3)
+    a.block()
+    a.loop()
+    a.local_get(3).local_get(2).i32_ge_u().br_if(1)
+    a.local_get(0).local_get(3).i32_add().i32_load8_u()
+    a.local_get(1).local_get(3).i32_add().i32_load8_u()
+    a.i32_ne().if_()
+    a.i32_const(0).return_()
+    a.end()
+    a.local_get(3).i32_const(1).i32_add().local_set(3)
+    a.br(0)
+    a.end()
+    a.end()
+    a.i32_const(1)
+    m.define_func("memeq", 1, a)
+
+    # -- get_header(map, kptr, klen) -> found ---------------------------------
     a = Asm()
     a.i32_const(OUT_PTR).i32_const(0).i32_store()
     a.i32_const(OUT_SIZE).i32_const(0).i32_store()
     a.local_get(0).local_get(1).local_get(2)
     a.i32_const(OUT_PTR).i32_const(OUT_SIZE).call(GET)
-    a.if_(I32)  # nonzero status: not found / error
+    a.if_(I32)
     a.i32_const(0)
     a.else_()
     a.i32_const(OUT_PTR).i32_load().i32_eqz().if_(I32)
@@ -206,7 +276,7 @@ def build() -> bytes:
     a.end()
     m.define_func("get_header", 0, a)
 
-    # -- append_value(): append the header value the host wrote --------------
+    # -- append_value() -------------------------------------------------------
     a = Asm()
     a.i32_const(OUT_PTR).i32_load().i32_const(OUT_SIZE).i32_load().call(APPEND)
     m.define_func("append_value", 0, a)
@@ -221,11 +291,7 @@ def build() -> bytes:
     a.end()
     m.define_func("append_header_or", 0, a)
 
-    # -- slot(ctx, create) -> addr | 0 ---------------------------------------
-    # Open addressing with TOMBSTONES (id -1): proxy_on_delete must not
-    # zero slots in place or it would break the probe chains of colliding
-    # live streams. Lookups probe past tombstones; creation reuses the
-    # first tombstone seen once the key is proven absent.
+    # -- slot(ctx, create) -> addr | 0  (tombstone deletes) -------------------
     TOMB = -1
     a = Asm()
     # locals: 2=h, 3=tries, 4=addr, 5=id, 6=first_tombstone
@@ -236,7 +302,7 @@ def build() -> bytes:
     a.i32_const(0).local_set(6)
     a.block()
     a.loop()
-    a.local_get(3).i32_const(CTX_SLOTS).i32_ge_u().br_if(1)  # probed all
+    a.local_get(3).i32_const(CTX_SLOTS).i32_ge_u().br_if(1)
     a.i32_const(CTX_TABLE).local_get(2).i32_const(CTX_SLOT_SIZE).i32_mul()
     a.i32_add().local_set(4)
     a.local_get(4).i32_load().local_set(5)
@@ -245,18 +311,19 @@ def build() -> bytes:
     a.end()
     a.local_get(5).i32_const(TOMB).i32_eq().if_()
     a.local_get(6).i32_eqz().if_()
-    a.local_get(4).local_set(6)  # remember the first reusable slot
+    a.local_get(4).local_set(6)
     a.end()
     a.else_()
     a.local_get(5).i32_eqz().if_()
     a.local_get(1).i32_eqz().if_()
-    a.i32_const(0).return_()  # lookup miss
+    a.i32_const(0).return_()
     a.end()
-    a.local_get(6).if_()  # claim the earlier tombstone if any
+    a.local_get(6).if_()
     a.local_get(6).local_set(4)
     a.end()
     a.local_get(4).local_get(0).i32_store()
     a.local_get(4).i32_const(0).i32_store(4)
+    a.local_get(4).i32_const(0).i32_store(8)
     a.local_get(4).return_()
     a.end()
     a.end()
@@ -266,46 +333,398 @@ def build() -> bytes:
     a.br(0)
     a.end()
     a.end()
-    # probed the whole table: claim a tombstone when creating
     a.local_get(1).if_()
     a.local_get(6).if_()
     a.local_get(6).local_get(0).i32_store()
     a.local_get(6).i32_const(0).i32_store(4)
+    a.local_get(6).i32_const(0).i32_store(8)
     a.local_get(6).return_()
     a.end()
     a.end()
     a.i32_const(0)
     m.define_func("slot", 5, a)
 
-    # -- on_req(ctx): build + log the [Request ...] line ----------------------
-    no_id = S("NO_ID")
+    # -- body_putb(byte): into BODY_BUF; length may exceed cap (=> fail) -----
     a = Asm()
-    # locals: 1=ids_start, 2=ids_len, 3=slot_addr
+    a.global_get(G_BODY).i32_const(BODY_CAP).i32_lt_u().if_()
+    a.i32_const(BODY_BUF).global_get(G_BODY).i32_add()
+    a.local_get(0).i32_store8()
+    a.end()
+    a.global_get(G_BODY).i32_const(1).i32_add().global_set(G_BODY)
+    m.define_func("body_putb", 0, a)
+
+    # -- body_put(src, len) ---------------------------------------------------
+    a = Asm()
+    a.i32_const(0).local_set(2)
+    a.block()
+    a.loop()
+    a.local_get(2).local_get(1).i32_ge_u().br_if(1)
+    a.local_get(0).local_get(2).i32_add().i32_load8_u().call(BODYB)
+    a.local_get(2).i32_const(1).i32_add().local_set(2)
+    a.br(0)
+    a.end()
+    a.end()
+    m.define_func("body_put", 1, a)
+
+    # -- hex_ok(c) -> i32 -----------------------------------------------------
+    a = Asm()
+    a.local_get(0).i32_const(ord("0")).i32_ge_u()
+    a.local_get(0).i32_const(ord("9")).i32_le_u().i32_and().if_()
+    a.i32_const(1).return_()
+    a.end()
+    a.local_get(0).i32_const(0x20).i32_or().local_set(0)  # tolower
+    a.local_get(0).i32_const(ord("a")).i32_ge_u()
+    a.local_get(0).i32_const(ord("f")).i32_le_u().i32_and()
+    m.define_func("hex_ok", 0, a)
+
+    # -- strscan(src, len, p, emit) -> new p past closing quote | -1 ---------
+    # p sits just after the opening quote. emit=1 copies the raw bytes
+    # (incl. the closing quote) via body_put; emit=0 skips. Validates
+    # escapes and rejects raw control characters, like json.loads.
+    a = Asm()
+    # locals: 4=c, 5=n
+    a.block()
+    a.loop()
+    a.local_get(2).local_get(1).i32_ge_u().br_if(1)  # EOF inside string
+    a.local_get(0).local_get(2).i32_add().i32_load8_u().local_set(4)
+    a.local_get(4).i32_const(ord('"')).i32_eq().if_()
+    a.local_get(3).if_()
+    a.i32_const(ord('"')).call(BODYB)
+    a.end()
+    a.local_get(2).i32_const(1).i32_add().return_()
+    a.end()
+    a.local_get(4).i32_const(ord("\\")).i32_eq().if_()
+    a.local_get(2).i32_const(1).i32_add().local_get(1).i32_ge_u().if_()
+    a.i32_const(-1).return_()
+    a.end()
+    a.local_get(0).local_get(2).i32_add().i32_load8_u(1).local_set(5)
+    a.local_get(5).i32_const(ord("u")).i32_eq().if_()
+    # need p+2..p+5 in bounds: p+6 <= len
+    a.local_get(2).i32_const(6).i32_add().local_get(1).i32_gt_u().if_()
+    a.i32_const(-1).return_()
+    a.end()
+    for off in (2, 3, 4, 5):
+        a.local_get(0).local_get(2).i32_add().i32_load8_u(off).call(HEXOK)
+        a.i32_eqz().if_()
+        a.i32_const(-1).return_()
+        a.end()
+    a.local_get(3).if_()
+    a.local_get(0).local_get(2).i32_add().i32_const(6).call(BODYPUT)
+    a.end()
+    a.local_get(2).i32_const(6).i32_add().local_set(2)
+    a.else_()
+    # one-char escapes: " \ / b f n r t
+    valid = [ord(ch) for ch in '"\\/bfnrt']
+    a.i32_const(0).local_set(4)
+    for ch in valid:
+        a.local_get(5).i32_const(ch).i32_eq().if_()
+        a.i32_const(1).local_set(4)
+        a.end()
+    a.local_get(4).i32_eqz().if_()
+    a.i32_const(-1).return_()
+    a.end()
+    a.local_get(3).if_()
+    a.local_get(0).local_get(2).i32_add().i32_const(2).call(BODYPUT)
+    a.end()
+    a.local_get(2).i32_const(2).i32_add().local_set(2)
+    a.end()
+    a.else_()
+    a.local_get(4).i32_const(0x20).i32_lt_u().if_()  # raw control char
+    a.i32_const(-1).return_()
+    a.end()
+    a.local_get(3).if_()
+    a.local_get(4).call(BODYB)
+    a.end()
+    a.local_get(2).i32_const(1).i32_add().local_set(2)
+    a.end()
+    a.br(0)
+    a.end()
+    a.end()
+    a.i32_const(-1)
+    m.define_func("strscan", 2, a)
+
+    # -- desens(src, len) -> ok ----------------------------------------------
+    # single-pass validate + transform: string values -> "", numbers -> 0,
+    # keys/booleans/null/structure copied, ", " and ": " separators.
+    a = Asm()
+    # locals: 2=p, 3=state, 4=depth, 5=c, 6=q
+    a.i32_const(0).global_set(G_BODY)
+    a.i32_const(0).local_set(2)
+    a.i32_const(ST_VALUE).local_set(3)
+    a.i32_const(0).local_set(4)
+    a.block()
+    a.loop()
+    a.local_get(2).local_get(1).i32_ge_u().br_if(1)
+    a.local_get(0).local_get(2).i32_add().i32_load8_u().local_set(5)
+    # whitespace
+    a.i32_const(0).local_set(6)
+    for ws in (0x20, 0x09, 0x0A, 0x0D):
+        a.local_get(5).i32_const(ws).i32_eq().if_()
+        a.i32_const(1).local_set(6)
+        a.end()
+    a.local_get(6).if_()
+    a.local_get(2).i32_const(1).i32_add().local_set(2)
+    a.br(1)  # continue main loop
+    a.end()
+
+    # '"'
+    a.local_get(5).i32_const(ord('"')).i32_eq().if_()
+    a.local_get(3).i32_const(-2).i32_and().i32_const(2).i32_eq().if_()
+    # key states (2,3): copy verbatim
+    a.i32_const(ord('"')).call(BODYB)
+    a.local_get(0).local_get(1).local_get(2).i32_const(1).i32_add()
+    a.i32_const(1).call(STRSCAN).local_set(2)
+    a.local_get(2).i32_const(-1).i32_eq().if_()
+    a.i32_const(0).return_()
+    a.end()
+    a.i32_const(ST_COLON).local_set(3)
+    a.br(2)  # continue
+    a.end()
+    a.local_get(3).i32_const(ST_VALUE_OR_END).i32_le_u().if_()
+    # string value -> ""
+    a.local_get(0).local_get(1).local_get(2).i32_const(1).i32_add()
+    a.i32_const(0).call(STRSCAN).local_set(2)
+    a.local_get(2).i32_const(-1).i32_eq().if_()
+    a.i32_const(0).return_()
+    a.end()
+    a.i32_const(ord('"')).call(BODYB)
+    a.i32_const(ord('"')).call(BODYB)
+    a.i32_const(ST_AFTER).local_set(3)
+    a.br(2)
+    a.end()
+    a.i32_const(0).return_()
+    a.end()
+
+    # '{' / '['
+    for ch, kind, nstate in ((ord("{"), 1, ST_KEY_OR_END), (ord("["), 2, ST_VALUE_OR_END)):
+        a.local_get(5).i32_const(ch).i32_eq().if_()
+        a.local_get(3).i32_const(ST_VALUE_OR_END).i32_gt_u().if_()
+        a.i32_const(0).return_()
+        a.end()
+        a.local_get(4).i32_const(MAX_DEPTH).i32_ge_u().if_()
+        a.i32_const(0).return_()
+        a.end()
+        a.i32_const(STACK_BASE).local_get(4).i32_add()
+        a.i32_const(kind).i32_store8()
+        a.local_get(4).i32_const(1).i32_add().local_set(4)
+        a.i32_const(ch).call(BODYB)
+        a.i32_const(nstate).local_set(3)
+        a.local_get(2).i32_const(1).i32_add().local_set(2)
+        a.br(1)
+        a.end()
+
+    # '}' / ']'
+    for ch, kind, open_state in ((ord("}"), 1, ST_KEY_OR_END), (ord("]"), 2, ST_VALUE_OR_END)):
+        a.local_get(5).i32_const(ch).i32_eq().if_()
+        # allowed: state==open_state (empty container), or state==AFTER
+        # with a matching container on the stack
+        a.i32_const(0).local_set(6)
+        a.local_get(3).i32_const(open_state).i32_eq().if_()
+        a.i32_const(1).local_set(6)
+        a.end()
+        a.local_get(3).i32_const(ST_AFTER).i32_eq().if_()
+        a.i32_const(1).local_set(6)
+        a.end()
+        a.local_get(6).i32_eqz().if_()
+        a.i32_const(0).return_()
+        a.end()
+        a.local_get(4).i32_eqz().if_()
+        a.i32_const(0).return_()
+        a.end()
+        a.i32_const(STACK_BASE).local_get(4).i32_const(1).i32_sub().i32_add()
+        a.i32_load8_u().i32_const(kind).i32_ne().if_()
+        a.i32_const(0).return_()
+        a.end()
+        a.local_get(4).i32_const(1).i32_sub().local_set(4)
+        a.i32_const(ch).call(BODYB)
+        a.i32_const(ST_AFTER).local_set(3)
+        a.local_get(2).i32_const(1).i32_add().local_set(2)
+        a.br(1)
+        a.end()
+
+    # ','
+    a.local_get(5).i32_const(ord(",")).i32_eq().if_()
+    a.local_get(3).i32_const(ST_AFTER).i32_ne().if_()
+    a.i32_const(0).return_()
+    a.end()
+    a.local_get(4).i32_eqz().if_()
+    a.i32_const(0).return_()
+    a.end()
+    a.i32_const(ord(",")).call(BODYB)
+    a.i32_const(ord(" ")).call(BODYB)
+    a.i32_const(STACK_BASE).local_get(4).i32_const(1).i32_sub().i32_add()
+    a.i32_load8_u().i32_const(1).i32_eq().if_()
+    a.i32_const(ST_KEY).local_set(3)
+    a.else_()
+    a.i32_const(ST_VALUE).local_set(3)
+    a.end()
+    a.local_get(2).i32_const(1).i32_add().local_set(2)
+    a.br(1)
+    a.end()
+
+    # ':'
+    a.local_get(5).i32_const(ord(":")).i32_eq().if_()
+    a.local_get(3).i32_const(ST_COLON).i32_ne().if_()
+    a.i32_const(0).return_()
+    a.end()
+    a.i32_const(ord(":")).call(BODYB)
+    a.i32_const(ord(" ")).call(BODYB)
+    a.i32_const(ST_VALUE).local_set(3)
+    a.local_get(2).i32_const(1).i32_add().local_set(2)
+    a.br(1)
+    a.end()
+
+    # literals / numbers: value states only
+    a.local_get(3).i32_const(ST_VALUE_OR_END).i32_gt_u().if_()
+    a.i32_const(0).return_()
+    a.end()
+    for lit in ("true", "false", "null"):
+        lp, ll = S(lit)
+        a.local_get(5).i32_const(ord(lit[0])).i32_eq().if_()
+        a.local_get(2).i32_const(ll).i32_add().local_get(1).i32_gt_u().if_()
+        a.i32_const(0).return_()
+        a.end()
+        a.local_get(0).local_get(2).i32_add().i32_const(lp).i32_const(ll)
+        a.call(MEMEQ).i32_eqz().if_()
+        a.i32_const(0).return_()
+        a.end()
+        a.i32_const(lp).i32_const(ll).call(BODYPUT)
+        a.local_get(2).i32_const(ll).i32_add().local_set(2)
+        a.i32_const(ST_AFTER).local_set(3)
+        a.br(1)
+        a.end()
+    # number
+    a.i32_const(0).local_set(6)  # digit seen
+    a.local_get(5).i32_const(ord("-")).i32_eq().if_()
+    a.local_get(2).i32_const(1).i32_add().local_set(2)
+    a.end()
+    # integer part: first digit, leading-zero rule
+    a.local_get(2).local_get(1).i32_ge_u().if_()
+    a.i32_const(0).return_()
+    a.end()
+    a.local_get(0).local_get(2).i32_add().i32_load8_u().local_set(5)
+    a.local_get(5).i32_const(ord("0")).i32_lt_u()
+    a.local_get(5).i32_const(ord("9")).i32_gt_u().i32_or().if_()
+    a.i32_const(0).return_()
+    a.end()
+    a.local_get(5).i32_const(ord("0")).i32_eq().if_()
+    # "0" must not be followed by another digit
+    a.local_get(2).i32_const(1).i32_add().local_get(1).i32_lt_u().if_()
+    a.local_get(0).local_get(2).i32_add().i32_load8_u(1).local_set(6)
+    a.local_get(6).i32_const(ord("0")).i32_ge_u()
+    a.local_get(6).i32_const(ord("9")).i32_le_u().i32_and().if_()
+    a.i32_const(0).return_()
+    a.end()
+    a.end()
+    a.end()
+    # consume digits
+
+    def consume_digits(require: bool) -> None:
+        if require:
+            a.local_get(2).local_get(1).i32_ge_u().if_()
+            a.i32_const(0).return_()
+            a.end()
+            a.local_get(0).local_get(2).i32_add().i32_load8_u().local_set(5)
+            a.local_get(5).i32_const(ord("0")).i32_lt_u()
+            a.local_get(5).i32_const(ord("9")).i32_gt_u().i32_or().if_()
+            a.i32_const(0).return_()
+            a.end()
+        a.block()
+        a.loop()
+        a.local_get(2).local_get(1).i32_ge_u().br_if(1)
+        a.local_get(0).local_get(2).i32_add().i32_load8_u().local_set(5)
+        a.local_get(5).i32_const(ord("0")).i32_lt_u()
+        a.local_get(5).i32_const(ord("9")).i32_gt_u().i32_or().br_if(1)
+        a.local_get(2).i32_const(1).i32_add().local_set(2)
+        a.br(0)
+        a.end()
+        a.end()
+
+    consume_digits(require=False)
+    # fraction
+    a.local_get(2).local_get(1).i32_lt_u().if_()
+    a.local_get(0).local_get(2).i32_add().i32_load8_u().i32_const(ord(".")).i32_eq().if_()
+    a.local_get(2).i32_const(1).i32_add().local_set(2)
+    consume_digits(require=True)
+    a.end()
+    a.end()
+    # exponent
+    a.local_get(2).local_get(1).i32_lt_u().if_()
+    a.local_get(0).local_get(2).i32_add().i32_load8_u().i32_const(0x20).i32_or()
+    a.i32_const(ord("e")).i32_eq().if_()
+    a.local_get(2).i32_const(1).i32_add().local_set(2)
+    a.local_get(2).local_get(1).i32_lt_u().if_()
+    a.local_get(0).local_get(2).i32_add().i32_load8_u().local_set(5)
+    a.local_get(5).i32_const(ord("+")).i32_eq()
+    a.local_get(5).i32_const(ord("-")).i32_eq().i32_or().if_()
+    a.local_get(2).i32_const(1).i32_add().local_set(2)
+    a.end()
+    a.end()
+    consume_digits(require=True)
+    a.end()
+    a.end()
+    a.i32_const(ord("0")).call(BODYB)
+    a.i32_const(ST_AFTER).local_set(3)
+    a.br(0)  # continue main loop
+    a.end()  # loop
+    a.end()  # block
+    # accept iff one complete value and the output fit the buffer
+    a.local_get(3).i32_const(ST_AFTER).i32_eq()
+    a.local_get(4).i32_eqz().i32_and()
+    a.global_get(G_BODY).i32_const(BODY_CAP).i32_le_u().i32_and()
+    m.define_func("desens", 5, a)
+
+    # -- build_ids(ctx): snapshot the id block into the slot at request-
+    # header time (the only moment the values are guaranteed current);
+    # uses the line buffer as scratch
+    no_id = S("NO_ID")
+    empty = S("")
+
+    def append_ids_from_headers(a: Asm) -> None:
+        # the one id-block definition (req/trace/span/parent + slashes)
+        # shared by build_ids and both emit fallbacks
+        for i, key in enumerate(
+            ("x-request-id", "x-b3-traceid", "x-b3-spanid", "x-b3-parentspanid")
+        ):
+            kp, kl = S(key)
+            a.i32_const(MAP_REQUEST).i32_const(kp).i32_const(kl)
+            a.i32_const(no_id[0]).i32_const(no_id[1]).call(APPHDR)
+            if i < 3:
+                append_lit(a, "/")
+
+    a = Asm()
+    # locals: 1=ids_len, 2=slot_addr
+    a.i32_const(0).global_set(G_LINE)
+    append_ids_from_headers(a)
+    a.global_get(G_LINE).local_set(1)
+    a.local_get(0).i32_const(1).call(SLOT).local_set(2)
+    a.local_get(2).if_()
+    a.local_get(1).i32_const(IDS_CAP).i32_gt_u().if_()
+    a.i32_const(IDS_CAP).local_set(1)
+    a.end()
+    a.local_get(2).local_get(1).i32_store(8)
+    a.local_get(2).i32_const(12).i32_add()
+    a.i32_const(LINE_BUF).local_get(1).call(MEMCPY)
+    a.end()
+    m.define_func("build_ids", 2, a)
+
+    # -- emit_req(ctx, body_ptr, body_len) ------------------------------------
+    a = Asm()
+    # locals: 3=slot_addr
     a.i32_const(0).global_set(G_LINE)
     append_lit(a, "[Request ")
-    a.global_get(G_LINE).local_set(1)
-    for i, key in enumerate(
-        ("x-request-id", "x-b3-traceid", "x-b3-spanid", "x-b3-parentspanid")
-    ):
-        kp, kl = S(key)
-        a.i32_const(MAP_REQUEST).i32_const(kp).i32_const(kl)
-        a.i32_const(no_id[0]).i32_const(no_id[1]).call(APPHDR)
-        if i < 3:
-            append_lit(a, "/")
-    a.global_get(G_LINE).local_get(1).i32_sub().local_set(2)
-    # remember the id block for the response/log phases
-    a.local_get(0).i32_const(1).call(SLOT).local_set(3)
-    a.local_get(3).if_()
-    a.local_get(2).i32_const(IDS_CAP).i32_gt_u().if_()
-    a.i32_const(IDS_CAP).local_set(2)
+    a.local_get(0).i32_const(0).call(SLOT).local_set(3)
+    a.local_get(3).if_(I32)
+    a.local_get(3).i32_load(8).i32_const(0).i32_gt_u()
+    a.else_()
+    a.i32_const(0)
     a.end()
-    a.local_get(3).local_get(2).i32_store(4)
-    a.local_get(3).i32_const(8).i32_add()
-    a.i32_const(LINE_BUF).local_get(1).i32_add()
-    a.local_get(2).call(MEMCPY)
+    a.if_()
+    a.local_get(3).i32_const(12).i32_add().local_get(3).i32_load(8).call(APPEND)
+    a.else_()
+    append_ids_from_headers(a)
     a.end()
     append_lit(a, "] [")
-    empty = S("")
     for key in (":method", None, ":authority", ":path"):
         if key is None:
             append_lit(a, " ")
@@ -321,20 +740,37 @@ def build() -> bytes:
     a.call(APPVAL)
     append_lit(a, "]")
     a.end()
+    a.local_get(2).if_()
+    append_lit(a, " [Body] ")
+    a.local_get(1).local_get(2).call(APPEND)
+    a.end()
     a.i32_const(LOG_INFO).i32_const(LINE_BUF).global_get(G_LINE).call(LOG)
     a.drop()
-    m.define_func("on_req", 3, a)
+    # mark logged
+    a.local_get(3).if_()
+    a.local_get(3).local_get(3).i32_load(4).i32_const(F_REQ_LOGGED).i32_or()
+    a.i32_store(4)
+    a.end()
+    m.define_func("emit_req", 1, a)
 
-    # -- on_resp(ctx): the [Response ...] twin --------------------------------
+    # -- emit_resp(ctx, body_ptr, body_len) -----------------------------------
     a = Asm()
-    # locals: 1=slot_addr
+    # locals: 3=slot_addr
     a.i32_const(0).global_set(G_LINE)
     append_lit(a, "[Response ")
-    a.local_get(0).i32_const(0).call(SLOT).local_set(1)
-    a.local_get(1).if_()
-    a.local_get(1).i32_const(8).i32_add().local_get(1).i32_load(4).call(APPEND)
+    a.local_get(0).i32_const(0).call(SLOT).local_set(3)
+    a.local_get(3).if_(I32)
+    a.local_get(3).i32_load(8).i32_const(0).i32_gt_u()
     a.else_()
-    append_lit(a, "NO_ID/NO_ID/NO_ID/NO_ID")
+    a.i32_const(0)
+    a.end()
+    a.if_()
+    a.local_get(3).i32_const(12).i32_add().local_get(3).i32_load(8).call(APPEND)
+    a.else_()
+    # no stored ids (no slot, or a JSON request whose line is still
+    # pending): rebuild from the request header map, which proxy-wasm
+    # keeps accessible through the response phase
+    append_ids_from_headers(a)
     a.end()
     append_lit(a, "] [Status] ")
     st = S(":status")
@@ -347,24 +783,160 @@ def build() -> bytes:
     a.call(APPVAL)
     append_lit(a, "]")
     a.end()
+    a.local_get(2).if_()
+    append_lit(a, " [Body] ")
+    a.local_get(1).local_get(2).call(APPEND)
+    a.end()
     a.i32_const(LOG_INFO).i32_const(LINE_BUF).global_get(G_LINE).call(LOG)
     a.drop()
-    m.define_func("on_resp", 1, a)
+    a.local_get(3).if_()
+    a.local_get(3).local_get(3).i32_load(4).i32_const(F_RESP_LOGGED).i32_or()
+    a.i32_store(4)
+    a.end()
+    m.define_func("emit_resp", 1, a)
+
+    # -- on_body(ctx, size, eos, is_response) ---------------------------------
+    # shared body-callback logic: on stream end, read the buffered body,
+    # desensitize, and emit the pending line (with the body block when the
+    # transform succeeded, without it otherwise)
+    a = Asm()
+    # locals: 4=slot_addr, 5=flags, 6=src, 7=ok
+    a.local_get(2).i32_eqz().if_()
+    a.return_()  # wait for end_of_stream
+    a.end()
+    a.local_get(0).i32_const(0).call(SLOT).local_set(4)
+    a.local_get(4).i32_eqz().if_()
+    a.return_()
+    a.end()
+    a.local_get(4).i32_load(4).local_set(5)
+    # pending/logged bit pair for this direction
+    a.local_get(3).if_(I32)
+    a.i32_const(F_RESP_PENDING)
+    a.else_()
+    a.i32_const(F_REQ_PENDING)
+    a.end()
+    a.local_get(5).i32_and().i32_eqz().if_()
+    a.return_()  # no JSON body expected
+    a.end()
+    a.local_get(3).if_(I32)
+    a.i32_const(F_RESP_LOGGED)
+    a.else_()
+    a.i32_const(F_REQ_LOGGED)
+    a.end()
+    a.local_get(5).i32_and().if_()
+    a.return_()  # already logged
+    a.end()
+    # fetch the buffered body
+    a.i32_const(OUT_PTR).i32_const(0).i32_store()
+    a.i32_const(OUT_SIZE).i32_const(0).i32_store()
+    a.local_get(3).if_(I32)
+    a.i32_const(BUF_RESPONSE_BODY)
+    a.else_()
+    a.i32_const(BUF_REQUEST_BODY)
+    a.end()
+    a.i32_const(0).local_get(1)
+    a.i32_const(OUT_PTR).i32_const(OUT_SIZE).call(GETBUF)
+    a.if_(I32)
+    a.i32_const(0)
+    a.else_()
+    a.i32_const(OUT_PTR).i32_load().i32_const(0).i32_ne()
+    a.end()
+    a.local_set(7)
+    a.i32_const(0).local_set(6)
+    a.local_get(7).if_()
+    a.i32_const(OUT_PTR).i32_load().local_set(6)
+    a.local_get(6).i32_const(OUT_SIZE).i32_load().call(DESENS).local_set(7)
+    a.end()
+    # emit with/without body
+    a.local_get(7).if_()
+    a.local_get(3).if_()
+    a.local_get(0).i32_const(BODY_BUF).global_get(G_BODY).call(EMITRESP)
+    a.else_()
+    a.local_get(0).i32_const(BODY_BUF).global_get(G_BODY).call(EMITREQ)
+    a.end()
+    a.else_()
+    a.local_get(3).if_()
+    a.local_get(0).i32_const(0).i32_const(0).call(EMITRESP)
+    a.else_()
+    a.local_get(0).i32_const(0).i32_const(0).call(EMITREQ)
+    a.end()
+    a.end()
+    m.define_func("on_body", 4, a)
 
     # -- ABI surface ----------------------------------------------------------
     a = Asm()
     a.local_get(0).call(ALLOC)
     m.define_func("proxy_on_memory_allocate", 0, a)
 
-    a = Asm()
-    a.local_get(0).call(ONREQ)
-    a.i32_const(0)  # Action::Continue
-    m.define_func("proxy_on_request_headers", 0, a)
+    appjson = S("application/json")
 
     a = Asm()
-    a.local_get(0).call(ONRESP)
+    # locals: 3=slot_addr
+    ct = S("content-type")
+    a.i32_const(MAP_REQUEST).i32_const(ct[0]).i32_const(ct[1]).call(GETHDR)
+    a.if_(I32)
+    a.i32_const(OUT_SIZE).i32_load().i32_const(appjson[1]).i32_eq().if_(I32)
+    a.i32_const(OUT_PTR).i32_load().i32_const(appjson[0])
+    a.i32_const(appjson[1]).call(MEMEQ)
+    a.else_()
     a.i32_const(0)
-    m.define_func("proxy_on_response_headers", 0, a)
+    a.end()
+    a.else_()
+    a.i32_const(0)
+    a.end()
+    a.if_()
+    # JSON request: snapshot ids now, log later (at body end or on_log).
+    # A full context table means no pending flag can be tracked: log at
+    # headers immediately (body block lost, line pair kept)
+    a.local_get(0).call(BUILDIDS)
+    a.local_get(0).i32_const(0).call(SLOT).local_tee(3).if_()
+    a.local_get(3).local_get(3).i32_load(4).i32_const(F_REQ_PENDING).i32_or()
+    a.i32_store(4)
+    a.else_()
+    a.local_get(0).i32_const(0).i32_const(0).call(EMITREQ)
+    a.end()
+    a.else_()
+    a.local_get(0).call(BUILDIDS)
+    a.local_get(0).i32_const(0).i32_const(0).call(EMITREQ)
+    a.end()
+    a.i32_const(0)
+    m.define_func("proxy_on_request_headers", 1, a)
+
+    a = Asm()
+    # locals: 3=slot_addr
+    a.i32_const(MAP_RESPONSE).i32_const(ct[0]).i32_const(ct[1]).call(GETHDR)
+    a.if_(I32)
+    a.i32_const(OUT_SIZE).i32_load().i32_const(appjson[1]).i32_eq().if_(I32)
+    a.i32_const(OUT_PTR).i32_load().i32_const(appjson[0])
+    a.i32_const(appjson[1]).call(MEMEQ)
+    a.else_()
+    a.i32_const(0)
+    a.end()
+    a.else_()
+    a.i32_const(0)
+    a.end()
+    a.if_()
+    a.local_get(0).i32_const(1).call(SLOT).local_tee(3).if_()
+    a.local_get(3).local_get(3).i32_load(4).i32_const(F_RESP_PENDING).i32_or()
+    a.i32_store(4)
+    a.else_()
+    a.local_get(0).i32_const(0).i32_const(0).call(EMITRESP)  # table full
+    a.end()
+    a.else_()
+    a.local_get(0).i32_const(0).i32_const(0).call(EMITRESP)
+    a.end()
+    a.i32_const(0)
+    m.define_func("proxy_on_response_headers", 1, a)
+
+    a = Asm()
+    a.local_get(0).local_get(1).local_get(2).i32_const(0).call(ONBODY)
+    a.i32_const(0)
+    m.define_func("proxy_on_request_body", 0, a)
+
+    a = Asm()
+    a.local_get(0).local_get(1).local_get(2).i32_const(1).call(ONBODY)
+    a.i32_const(0)
+    m.define_func("proxy_on_response_body", 0, a)
 
     m.define_func("proxy_on_context_create", 0, Asm())
 
@@ -386,13 +958,36 @@ def build() -> bytes:
     a.end()
     m.define_func("proxy_on_delete", 1, a)
 
-    m.define_func("proxy_on_log", 0, Asm())
+    # proxy_on_log: backstop for streams whose expected JSON body never
+    # arrived — emit the pending line(s) without a body block so every
+    # stream still produces its pair
+    a = Asm()
+    # locals: 1=slot_addr, 2=flags
+    a.local_get(0).i32_const(0).call(SLOT).local_tee(1).i32_eqz().if_()
+    a.return_()
+    a.end()
+    a.local_get(1).i32_load(4).local_set(2)
+    a.local_get(2).i32_const(F_REQ_PENDING).i32_and().if_()
+    a.local_get(2).i32_const(F_REQ_LOGGED).i32_and().i32_eqz().if_()
+    a.local_get(0).i32_const(0).i32_const(0).call(EMITREQ)
+    a.end()
+    a.end()
+    a.local_get(1).i32_load(4).local_set(2)
+    a.local_get(2).i32_const(F_RESP_PENDING).i32_and().if_()
+    a.local_get(2).i32_const(F_RESP_LOGGED).i32_and().i32_eqz().if_()
+    a.local_get(0).i32_const(0).i32_const(0).call(EMITRESP)
+    a.end()
+    a.end()
+    m.define_func("proxy_on_log", 2, a)
+
     m.define_func("proxy_abi_version_0_2_0", 0, Asm())
 
     for name in (
         "proxy_on_memory_allocate",
         "proxy_on_request_headers",
         "proxy_on_response_headers",
+        "proxy_on_request_body",
+        "proxy_on_response_body",
         "proxy_on_context_create",
         "proxy_on_vm_start",
         "proxy_on_configure",

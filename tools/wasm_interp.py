@@ -236,11 +236,22 @@ class Instance:
         self.n_imports = len(module.imports)
 
     # -- memory helpers for host functions ----------------------------------
+    # bounds-checked like a real wasm host: silent bytearray growth would
+    # hide module bugs (e.g. allocations past the arena) from the tests
+
+    def _check(self, ptr: int, size: int) -> None:
+        if ptr < 0 or size < 0 or ptr + size > len(self.memory):
+            raise WasmError(
+                f"out-of-bounds memory access: [{ptr}, {ptr + size}) "
+                f"of {len(self.memory)}"
+            )
 
     def read(self, ptr: int, size: int) -> bytes:
+        self._check(ptr, size)
         return bytes(self.memory[ptr : ptr + size])
 
     def write(self, ptr: int, data: bytes) -> None:
+        self._check(ptr, len(data))
         self.memory[ptr : ptr + len(data)] = data
 
     def write_u32(self, ptr: int, v: int) -> None:

@@ -714,3 +714,82 @@ def test_bracket_balanced_invalid_groups_agree_across_modes():
     kept_bad = b'[[{"traceId":"x"} {"y":1}]]'
     assert native.parse_spans(kept_bad, [], threads=1) is None
     assert native.parse_spans(kept_bad, [], threads=4) is None
+
+
+def test_mass_duplicate_span_ids_compaction():
+    """Stress the document-order dup fixup + compaction: thousands of
+    colliding span ids across groups, in both scan modes."""
+    from kmamiz_tpu import native
+
+    mk = TestDedupSemantics().mk_span
+    groups = []
+    for t in range(600):
+        # every third group reuses one of 50 shared ids -> heavy overflow
+        sid = f"shared{t % 50}" if t % 3 == 0 else f"uniq{t}"
+        dur = 100 + t
+        groups.append([mk(f"t{t}", sid, duration=dur)])
+    raw = json.dumps(groups).encode()
+    seq = native.parse_spans(raw, [], threads=1)
+    mt = native.parse_spans(raw, [], threads=4)
+    assert seq is not None and mt is not None
+    # 200 shared-id groups collapse to 50 surviving rows + 400 unique
+    assert seq["n_spans"] == mt["n_spans"] == 450
+    for key in ("latency_ms", "trace_of", "shape_id", "status_id"):
+        assert np.array_equal(seq[key], mt[key]), key
+    # last-wins: each shared id carries the LAST occurrence's duration
+    host = spans_to_batch(_collapse_host(groups))
+    assert np.array_equal(seq["latency_ms"], host.latency_ms[: len(seq["latency_ms"])])
+
+
+def _collapse_host(groups):
+    """Host-side model of whole-window span-map semantics: first position,
+    last-wins fields."""
+    order = []
+    by_id = {}
+    for g in groups:
+        for s in g:
+            if s["id"] in by_id:
+                by_id[s["id"]] = s
+            else:
+                by_id[s["id"]] = s
+                order.append(s["id"])
+    # rebuild one span per surviving id, each in its own group to keep
+    # trace_of monotone like the window (one span per group here)
+    return [[by_id[i]] for i in order]
+
+
+def test_mt_large_fuzz_window():
+    """A bigger randomized window (10k spans) through both scan modes."""
+    from kmamiz_tpu import native
+
+    rng = random.Random(99)
+    mk = TestDedupSemantics().mk_span
+    groups = []
+    for t in range(1500):
+        n = rng.randint(1, 12)
+        group = []
+        for j in range(n):
+            over = {
+                "duration": rng.randint(1, 10**6),
+                "kind": rng.choice(["SERVER", "CLIENT", "PRODUCER"]),
+            }
+            if j and rng.random() < 0.7:
+                over["parentId"] = f"{t}-{rng.randrange(j)}"
+            s = mk(f"t{t}", f"{t}-{j}", **over)
+            s["name"] = f"svc{rng.randrange(40)}.ns{rng.randrange(4)}.svc.cluster.local:80/*"
+            s["tags"]["http.url"] = f"http://svc{rng.randrange(40)}/api/{rng.randrange(30)}"
+            if rng.random() < 0.1:
+                del s["tags"]["http.status_code"]
+            group.append(s)
+        groups.append(group)
+    raw = json.dumps(groups).encode()
+    seq = native.parse_spans(raw, [], threads=1)
+    mt = native.parse_spans(raw, [], threads=4)
+    assert seq is not None and mt is not None
+    assert seq["n_spans"] == mt["n_spans"]
+    for key in ("kind", "parent_idx", "shape_id", "status_id", "trace_of",
+                "latency_ms", "timestamp_us", "shape_max_ts_ms"):
+        assert np.array_equal(seq[key], mt[key]), key
+    assert seq["shapes"] == mt["shapes"]
+    assert seq["statuses"] == mt["statuses"]
+    assert seq["trace_ids"] == mt["trace_ids"]

@@ -472,6 +472,7 @@ def train_on_simulation(
     hidden: int = 32,
     seed: int = 0,
     model=graphsage,
+    use_node_embeddings: bool = False,
 ) -> Tuple[TrainResult, EvalResult, GraphDataset]:
     """Temporal split: train on the first slots, evaluate on the rest
     (fault windows land wherever the config put them)."""
@@ -479,7 +480,14 @@ def train_on_simulation(
         endpoint_dependencies, realtime_data_per_slot, replica_counts
     )
     train_set, eval_set = temporal_split(dataset, train_fraction)
-    result = train(train_set, epochs=epochs, hidden=hidden, seed=seed, model=model)
+    result = train(
+        train_set,
+        epochs=epochs,
+        hidden=hidden,
+        seed=seed,
+        model=model,
+        use_node_embeddings=use_node_embeddings,
+    )
     threshold = calibrate_threshold(result.params, train_set, model=model)
     if eval_set.features:
         metrics = evaluate(result.params, eval_set, threshold=threshold, model=model)

@@ -90,28 +90,33 @@ class TestFixtureParity:
             assert_batches_equal(host, nat)
 
 
+def mk_span(tid, sid, parent=None, **over):
+    """Module-level span factory shared by the dedup/MT/stream tests."""
+    s = {
+        "traceId": tid,
+        "id": sid,
+        "parentId": parent,
+        "kind": "SERVER",
+        "name": "svc.ns.svc.cluster.local:80/*",
+        "timestamp": 1_700_000_000_000_000,
+        "duration": 1000,
+        "tags": {
+            "http.method": "GET",
+            "http.status_code": "200",
+            "http.url": "http://svc.ns.svc.cluster.local/api",
+            "istio.canonical_revision": "v1",
+            "istio.canonical_service": "svc",
+            "istio.mesh_id": "cluster.local",
+            "istio.namespace": "ns",
+        },
+    }
+    s.update(over)
+    return s
+
+
 class TestDedupSemantics:
     def mk_span(self, tid, sid, parent=None, **over):
-        s = {
-            "traceId": tid,
-            "id": sid,
-            "parentId": parent,
-            "kind": "SERVER",
-            "name": "svc.ns.svc.cluster.local:80/*",
-            "timestamp": 1_700_000_000_000_000,
-            "duration": 1000,
-            "tags": {
-                "http.method": "GET",
-                "http.status_code": "200",
-                "http.url": "http://svc.ns.svc.cluster.local/api",
-                "istio.canonical_revision": "v1",
-                "istio.canonical_service": "svc",
-                "istio.mesh_id": "cluster.local",
-                "istio.namespace": "ns",
-            },
-        }
-        s.update(over)
-        return s
+        return mk_span(tid, sid, parent, **over)
 
     def test_skip_set_drops_groups(self):
         g1 = [self.mk_span("t1", "a")]
@@ -524,7 +529,7 @@ class TestParallelParse:
         # span id "shared" recurs in far-apart groups: the atomic-table
         # fixup must collapse them first-position/last-wins exactly like
         # the sequential scan, then compact and rebuild tables
-        mk = TestDedupSemantics().mk_span
+        mk = mk_span
         groups = []
         for t in range(40):
             sid = "shared" if t % 7 == 0 else f"s{t}"
@@ -534,7 +539,7 @@ class TestParallelParse:
         self._compare_outputs(json.dumps(groups).encode())
 
     def test_skip_set_and_empty_groups_mt(self):
-        mk = TestDedupSemantics().mk_span
+        mk = mk_span
         groups = []
         for t in range(30):
             groups.append([] if t % 5 == 0 else [mk(f"t{t}", f"s{t}")])
@@ -545,7 +550,7 @@ class TestParallelParse:
 
     def test_fuzz_mt(self):
         rng = random.Random(21)
-        mk = TestDedupSemantics().mk_span
+        mk = mk_span
         for trial in range(8):
             groups = []
             for t in range(rng.randint(0, 25)):
@@ -570,7 +575,7 @@ class TestParallelParse:
         # strings stuffed with brackets, escaped quotes, and backslash runs:
         # the block-classified prescan must mask them exactly like the
         # sequential scanner
-        mk = TestDedupSemantics().mk_span
+        mk = mk_span
         groups = []
         evil_names = [
             'a]b[c',
@@ -593,7 +598,7 @@ class TestParallelParse:
         assert sum(len(json.loads(c)) for c in chunks) == len(groups)
 
     def test_mt_whitespace_heavy_layout(self):
-        mk = TestDedupSemantics().mk_span
+        mk = mk_span
         groups = [[mk(f"w{t}", f"s{t}")] for t in range(9)]
         pretty = json.dumps(groups, indent=3).encode()
         self._compare_outputs(pretty)
@@ -609,7 +614,7 @@ class TestParallelParse:
 
 class TestStreamingIngest:
     def test_split_groups_covers_whole_groups(self):
-        mk = TestDedupSemantics().mk_span
+        mk = mk_span
         groups = [[mk(f"t{t}", f"s{t}")] for t in range(17)]
         raw = json.dumps(groups).encode()
         chunks = native.split_groups(raw, 4)
@@ -627,7 +632,7 @@ class TestStreamingIngest:
     def test_stream_matches_window_ingest(self):
         from kmamiz_tpu.server.processor import DataProcessor
 
-        mk = TestDedupSemantics().mk_span
+        mk = mk_span
         groups = []
         for t in range(50):
             parent = mk(f"t{t}", f"p{t}")
@@ -662,7 +667,7 @@ class TestStreamingIngest:
     def test_stream_dedup_across_chunks(self):
         from kmamiz_tpu.server.processor import DataProcessor
 
-        mk = TestDedupSemantics().mk_span
+        mk = mk_span
         # the same trace id appears in chunk 1 and chunk 2: the second
         # occurrence must drop (kept ids register before the next parse)
         c1 = json.dumps([[mk("tX", "a")], [mk("tY", "b")]]).encode()
@@ -679,7 +684,7 @@ class TestStreamingIngest:
         # scope under paginated fetches). Graph results must still agree.
         from kmamiz_tpu.server.processor import DataProcessor
 
-        mk = TestDedupSemantics().mk_span
+        mk = mk_span
         groups = [[mk(f"t{t}", "sameid")] for t in range(24)]
         raw = json.dumps(groups).encode()
 
@@ -721,7 +726,7 @@ def test_mass_duplicate_span_ids_compaction():
     colliding span ids across groups, in both scan modes."""
     from kmamiz_tpu import native
 
-    mk = TestDedupSemantics().mk_span
+    mk = mk_span
     groups = []
     for t in range(600):
         # every third group reuses one of 50 shared ids -> heavy overflow
@@ -763,7 +768,7 @@ def test_mt_large_fuzz_window():
     from kmamiz_tpu import native
 
     rng = random.Random(99)
-    mk = TestDedupSemantics().mk_span
+    mk = mk_span
     groups = []
     for t in range(1500):
         n = rng.randint(1, 12)
@@ -793,3 +798,28 @@ def test_mt_large_fuzz_window():
     assert seq["shapes"] == mt["shapes"]
     assert seq["statuses"] == mt["statuses"]
     assert seq["trace_ids"] == mt["trace_ids"]
+
+
+def test_stream_malformed_later_chunk_at_least_once():
+    """ingest_raw_stream's documented failure semantics: a malformed later
+    chunk raises AFTER earlier chunks merged and registered (per-chunk
+    at-least-once); the one-shot path stays all-or-nothing."""
+    from kmamiz_tpu.server.processor import DataProcessor
+
+    mk = mk_span
+    good = json.dumps([[mk("tA", "a")], [mk("tB", "b")]]).encode()
+    bad = b'[[{"traceId": "tC", "id": '  # truncated
+    dp = DataProcessor(trace_source=lambda *a: [], use_device_stats=False)
+    with pytest.raises(ValueError):
+        dp.ingest_raw_stream([good, bad])
+    # chunk 1 landed and registered before the failure
+    assert dp.graph.interner and len(dp.graph.interner.endpoints) > 0
+    with dp._dedup_lock:
+        assert "tA" in dp._processed and "tB" in dp._processed
+
+    # one-shot on the same malformed payload: nothing mutates
+    dp2 = DataProcessor(trace_source=lambda *a: [], use_device_stats=False)
+    with pytest.raises(ValueError):
+        dp2.ingest_raw_window(bad)
+    with dp2._dedup_lock:
+        assert not dp2._processed

@@ -280,6 +280,11 @@ def main() -> None:
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--hidden", type=int, default=32)
     parser.add_argument(
+        "--embeddings",
+        action="store_true",
+        help="learned per-node identity embeddings (MODELS.md future work)",
+    )
+    parser.add_argument(
         "--tenk",
         action="store_true",
         help="also time (not score) the 1k-svc/10k-endpoint config",
@@ -308,7 +313,11 @@ def main() -> None:
 
     rows = []
     shared_dataset = None
-    for name, model in (("GraphSAGE", graphsage), ("GAT", gat)):
+    suffix = " (+node embeddings)" if args.embeddings else ""
+    for name, model in (
+        (f"GraphSAGE{suffix}", graphsage),
+        (f"GAT{suffix}", gat),
+    ):
         t1 = time.perf_counter()
         res, metrics, dataset = trainer.train_on_simulation(
             result.endpoint_dependencies,
@@ -319,6 +328,7 @@ def main() -> None:
             hidden=args.hidden,
             seed=args.seed,
             model=model,
+            use_node_embeddings=args.embeddings,
         )
         train_s = time.perf_counter() - t1
         shared_dataset = dataset
@@ -445,6 +455,7 @@ def main() -> None:
             hidden=args.hidden,
             seed=args.seed,
             model=graphsage,
+            use_node_embeddings=args.embeddings,
         )
         step_s = time.perf_counter() - t3
         print(

@@ -237,3 +237,108 @@ class TestExternalDataProcessorTopology:
         assert "pdas" in namespaces  # fallback path contributed
         services = {d["endpoint"]["service"] for d in deps}
         assert "productpage" in services  # external results were kept
+
+
+class TestScaleIngestSurfaces:
+    """Round-3 surfaces, live over sockets: uncapped streamed POST /ingest
+    into the DP server, the version-keyed scorer payload cache on the API,
+    and the in-tree wasm binary at GET /wasm — one flow."""
+
+    def test_streamed_ingest_feeds_cached_scorers_and_wasm(
+        self, bookinfo_traces, monkeypatch
+    ):
+        import os
+
+        from kmamiz_tpu import native
+        from kmamiz_tpu.api.app import build_router
+        from kmamiz_tpu.api.handlers.graph import GraphHandler
+        from kmamiz_tpu.api.router import ApiServer
+        from kmamiz_tpu.server.dp_server import DataProcessorServer
+        from kmamiz_tpu.server.initializer import AppContext, Initializer
+        from kmamiz_tpu.server.processor import DataProcessor
+
+        if not native.available():
+            pytest.skip("native extension unavailable")
+
+        dp = DataProcessor(trace_source=lambda lb, t, lim: [])
+        dp_server = DataProcessorServer(dp, host="127.0.0.1", port=0)
+        dp_server.start()
+        try:
+            # a multi-group window, every id namespaced per rep so the
+            # span-id dedup keeps all replicas; above a forced stream
+            # threshold so the pipelined path engages
+            groups = []
+            for rep in range(40):
+                for g in bookinfo_traces:
+                    ng = []
+                    for s in g:
+                        c = dict(s)
+                        c["traceId"] = f"{rep}-{s.get('traceId')}"
+                        c["id"] = f"{rep}-{s.get('id')}"
+                        if c.get("parentId"):
+                            c["parentId"] = f"{rep}-{c['parentId']}"
+                        ng.append(c)
+                    groups.append(ng)
+            n_spans = sum(len(g) for g in groups)
+            body = json.dumps(groups).encode()
+            monkeypatch.setenv("KMAMIZ_INGEST_STREAM_BYTES", "10000")
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{dp_server.port}/ingest",
+                data=body,
+                method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=120) as r:
+                summary = json.loads(r.read())
+            assert summary["chunks"] > 1  # streamed path engaged
+            assert summary["traces"] == len(groups)
+            assert summary["spans"] == n_spans  # nothing collapsed away
+            assert summary["edges"] > 0
+
+            # the API serves device scorers from the SAME graph store,
+            # with the payload cache warm on repeat requests
+            settings = Settings()
+            settings.external_data_processor = ""
+            settings.wasm_path = os.path.join(
+                os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                "envoy",
+                "filter",
+                "kmamiz_filter.wasm",
+            )
+            ctx = AppContext.build(
+                app_settings=settings, store=MemoryStore(), processor=dp
+            )
+            Initializer(ctx).register_data_caches()
+            router = build_router(ctx)
+            api = ApiServer(router, host="127.0.0.1", port=0)
+            api.start()
+            try:
+                url = f"http://127.0.0.1:{api.port}/api/v1/graph/instability"
+                with urllib.request.urlopen(url, timeout=120) as r:
+                    first = json.loads(r.read())
+                with urllib.request.urlopen(url, timeout=120) as r:
+                    second = json.loads(r.read())
+                assert first == second
+                assert any(row["dependingOn"] > 0 for row in first)
+                # cache-specific: the handler holds a payload entry keyed
+                # by the CURRENT graph version after the first request
+                handler = next(
+                    fn.__self__
+                    for r in router._routes
+                    for fn in [r.handler]
+                    if isinstance(getattr(fn, "__self__", None), GraphHandler)
+                )
+                cached = handler._scorer_payload_cache[
+                    ("instability", None)
+                ]
+                assert cached[0][0] == dp.graph.version
+                assert cached[1] == first
+
+                # the committed wasm artifact serves at GET /wasm
+                wasm_url = f"http://127.0.0.1:{api.port}/wasm"
+                with urllib.request.urlopen(wasm_url, timeout=30) as r:
+                    blob = r.read()
+                assert blob[:4] == b"\x00asm"
+            finally:
+                api.stop()
+        finally:
+            dp_server.stop()

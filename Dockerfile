@@ -22,6 +22,7 @@ COPY native/kmamiz_native.cpp native/kmamiz_json.cpp native/kmamiz_spans.cpp nat
 # (re)assembled below, the richer Go build (JSON body capture) comes from
 # envoy/filter/build.sh on a tinygo-equipped machine
 COPY envoy/ envoy/
+COPY dist/ dist/
 COPY tools/wasm_asm.py tools/build_wasm_filter.py tools/
 
 # compile the native ingest/parse extension at build time so the first

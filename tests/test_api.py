@@ -418,6 +418,9 @@ class TestAlertRoutes:
         v = res.payload[0]
         assert v["uniqueServiceName"] == svc
         assert v["timeoutAt"] > v["occursAt"]
+        # the dashboard (dist/index.html renderAlerts) reads these two
+        assert v["displayName"] == "user-service.pdas (latest)"
+        assert "highlightNodeName" in v
 
 
 class TestComparatorRoutes:
@@ -612,3 +615,81 @@ class TestScorerPayloadCache:
         r1 = get(router, "/api/v1/graph/instability")
         r2 = get(router, "/api/v1/graph/instability")
         assert r2.payload is not r1.payload
+
+
+class TestDashboardContract:
+    """dist/index.html is the in-tree SPA; these pin (a) that the router
+    serves it and (b) that every endpoint the dashboard fetches returns
+    the exact fields its JS reads (no JS runtime ships in CI, so the
+    data contract is the testable surface)."""
+
+    def test_static_serving(self, ctx):
+        import os
+
+        from kmamiz_tpu.api.app import build_router as _build
+
+        ctx.settings.static_dir = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "dist",
+        )
+        router = _build(ctx)
+        r = router.dispatch("GET", "/")
+        assert r.status == 200
+        body = r.raw_body.decode()
+        for el_id in (
+            "tiles", "depgraph", "alerts", "linechart", "instability",
+            "cohesion", "coupling", "stats", "ns-select", "health-text",
+        ):
+            assert f'id="{el_id}"' in body, el_id
+        # SPA fallback for client routes
+        assert router.dispatch("GET", "/insights").status == 200
+
+    def test_fetched_shapes(self, router):
+        svc = get(router, "/api/v1/data/serviceDisplayInfo").payload
+        assert svc and {"service", "namespace", "endpointCount"} <= set(svc[0])
+
+        dep = get(router, "/api/v1/graph/dependency/service").payload
+        assert {"nodes", "links"} <= set(dep)
+        assert {"id", "name"} <= set(dep["nodes"][0])
+        assert {"source", "target"} <= set(dep["links"][0])
+
+        line = get(router, "/api/v1/graph/line").payload
+        assert {"dates", "services", "metrics"} <= set(line)
+        assert len(line["metrics"][0][0]) == 6
+        # the dashboard indexes the vector POSITIONALLY:
+        # [requests, requestErrors, serverErrors, cv, mean, risk] — pin the
+        # order by cross-checking position 0/4 against the historical docs
+        rows = line["metrics"][0]
+        svc_names = line["services"]
+        assert all(r[0] == int(r[0]) and r[0] >= 0 for r in rows)  # counts
+        # latencyMean (pos 4) must match the statistics endpoint's means
+        stats_by_name = {
+            s["name"]: s
+            for s in get(router, "/api/v1/graph/statistics").payload
+        }
+        import math
+
+        for name, r in zip(svc_names, rows):
+            if name in stats_by_name and r[0] > 0:
+                assert math.isclose(
+                    r[4], stats_by_name[name]["latencyMean"], rel_tol=1e-6
+                ), (name, r)
+
+        instab = get(router, "/api/v1/graph/instability").payload
+        assert {"name", "instability", "dependingOn", "dependingBy"} <= set(
+            instab[0]
+        )
+        coh = get(router, "/api/v1/graph/cohesion").payload
+        assert {
+            "name", "totalInterfaceCohesion", "usageCohesion", "dataCohesion"
+        } <= set(coh[0])
+        coup = get(router, "/api/v1/graph/coupling").payload
+        assert {"name", "ais", "ads", "acs"} <= set(coup[0])
+
+        stats = get(router, "/api/v1/graph/statistics").payload
+        assert {
+            "name", "latencyMean", "serverErrorRate", "requestErrorsRate"
+        } <= set(stats[0])
+
+        alerts = get(router, "/api/v1/alert/violation").payload
+        assert isinstance(alerts, list)  # row fields pinned in TestAlertRoutes.test_violation_detection

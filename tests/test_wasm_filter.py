@@ -472,3 +472,13 @@ class TestBodyDesensitization:
         assert len(h.logs) == before + 1
         assert "overflow" in h.logs[-1][1]
         assert h.logs[-1][1].startswith("[Request")
+
+
+def test_envoyfilter_cr_sha_matches_artifact(binary):
+    """The deploy CR pins the remote-fetch sha256; it must always match
+    the committed binary (regenerating one without the other breaks the
+    sidecar fetch in a way only a live cluster would reveal)."""
+    import hashlib
+
+    cr = (REPO / "envoy" / "EnvoyFilter-WASM.yaml").read_text()
+    assert hashlib.sha256(binary).hexdigest() in cr

@@ -1,0 +1,105 @@
+"""Smoke coverage for the model-evaluation tooling (tools/eval_models*.py):
+the MODELS.md results must stay reproducible, so the mesh generator, the
+metric helpers, and the end-to-end pipeline get exercised at tiny scale.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+import pytest
+import yaml
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+import eval_models_large as eml  # noqa: E402
+
+
+class TestMeshGenerator:
+    def test_config_validates_and_simulates(self):
+        from kmamiz_tpu.simulator.simulator import Simulator
+
+        rng = np.random.default_rng(3)
+        cfg = eml.make_mesh_config(8, 3, 1, rng)
+        parsed = yaml.safe_load(cfg)
+        services = parsed["servicesInfo"][0]["services"]
+        assert len(services) == 8
+        assert sum(len(v["endpoints"]) for s in services
+                   for v in s["versions"]) == 24
+        assert parsed["loadSimulation"]["faultInjection"]
+
+        result = Simulator().generate_simulation_data(
+            cfg, 0.0, rng=np.random.default_rng(3)
+        )
+        assert result.validation_error_message == ""
+        assert result.converting_error_message == ""
+        assert result.realtime_data_per_slot
+
+    def test_fault_targets_exist(self):
+        rng = np.random.default_rng(4)
+        parsed = yaml.safe_load(eml.make_mesh_config(10, 4, 2, rng))
+        eps = {
+            e["endpointId"]
+            for s in parsed["servicesInfo"][0]["services"]
+            for v in s["versions"]
+            for e in v["endpoints"]
+        }
+        for fault in parsed["loadSimulation"]["faultInjection"]:
+            for t in fault["targets"]["endpoints"]:
+                assert t["endpointId"] in eps
+
+
+class TestMetricHelpers:
+    def test_roc_auc_orders_perfect_and_random(self):
+        labels = np.array([True] * 5 + [False] * 5)
+        perfect = np.array([0.9] * 5 + [0.1] * 5)
+        inverted = np.array([0.1] * 5 + [0.9] * 5)
+        assert eml.roc_auc(perfect, labels) == 1.0
+        assert eml.roc_auc(inverted, labels) == 0.0
+        # ties get midranks: all-equal scores -> 0.5
+        assert eml.roc_auc(np.full(10, 0.5), labels) == pytest.approx(0.5)
+
+    def test_pr_auc_average_precision(self):
+        labels = np.array([True, False, True, False])
+        scores = np.array([0.9, 0.8, 0.7, 0.1])
+        # AP = mean of precision at each positive: (1/1 + 2/3) / 2
+        assert eml.pr_auc(scores, labels) == pytest.approx((1 + 2 / 3) / 2)
+
+    def test_onset_recall(self):
+        scores = np.array([0.9, 0.2, 0.8])
+        truths = np.array([True, True, False])
+        onsets = np.array([True, True, False])
+        assert eml.onset_recall(scores, truths, onsets, 0.5) == pytest.approx(0.5)
+
+
+class TestEndToEndTiny:
+    def test_pipeline_runs_and_beats_random(self):
+        from kmamiz_tpu.models import graphsage, trainer
+        from kmamiz_tpu.simulator.simulator import Simulator
+
+        rng = np.random.default_rng(0)
+        cfg = eml.make_mesh_config(6, 3, 2, rng)
+        result = Simulator().generate_simulation_data(
+            cfg, 0.0, rng=np.random.default_rng(0)
+        )
+        assert result.validation_error_message == ""
+        res, metrics, dataset = trainer.train_on_simulation(
+            result.endpoint_dependencies,
+            result.realtime_data_per_slot,
+            result.replica_counts,
+            train_fraction=eml.TRAIN_FRACTION,
+            epochs=3,
+            hidden=8,
+            seed=0,
+            model=graphsage,
+            use_node_embeddings=True,
+        )
+        _train, eval_set = trainer.temporal_split(dataset, eml.TRAIN_FRACTION)
+        scores, truths, onsets, currents = eml.collect_scores(
+            res.params, eval_set, graphsage
+        )
+        assert len(scores) == len(truths) == len(onsets) == len(currents)
+        if truths.any() and not truths.all():
+            auc = eml.roc_auc(scores, truths)
+            assert 0.0 <= auc <= 1.0

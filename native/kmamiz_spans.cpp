@@ -1542,55 +1542,68 @@ void assemble(std::vector<ThreadOut>& outs, PrescanResult&& ps,
     as->shapes = std::move(t.shapes);
     as->statuses = std::move(t.statuses);
   } else {
-    as->rows.reserve(n);
-    as->trace_of.reserve(n);
-    as->shape_id.reserve(n);
-    as->status_id.reserve(n);
-
     // global shape/status tables in document order (threads own
     // contiguous document ranges, merged ascending -> first-appearance
-    // order matches the sequential scan)
-    for (auto& t : outs) {
-      std::vector<int32_t> shape_remap(t.shapes.shapes.size());
-      for (size_t i = 0; i < t.shapes.shapes.size(); ++i) {
-        const Shape& sh = t.shapes.shapes[i];
-        int32_t gid = as->shapes.intern(sh);
-        Shape& stored = as->shapes.shapes[gid];
-        if (sh.has_ts &&
-            (!stored.has_ts || sh.max_ts_ms > stored.max_ts_ms)) {
-          stored.max_ts_ms = sh.max_ts_ms;
-          stored.has_ts = true;
+    // order matches the sequential scan); the tables are small, so this
+    // stays sequential
+    std::vector<std::vector<int32_t>> shape_remaps(outs.size());
+    std::vector<std::vector<int32_t>> status_remaps(outs.size());
+    {
+      SvMap status_map(64);
+      bool ins;
+      for (size_t ti = 0; ti < outs.size(); ++ti) {
+        auto& t = outs[ti];
+        shape_remaps[ti].resize(t.shapes.shapes.size());
+        for (size_t i = 0; i < t.shapes.shapes.size(); ++i) {
+          const Shape& sh = t.shapes.shapes[i];
+          int32_t gid = as->shapes.intern(sh);
+          Shape& stored = as->shapes.shapes[gid];
+          if (sh.has_ts &&
+              (!stored.has_ts || sh.max_ts_ms > stored.max_ts_ms)) {
+            stored.max_ts_ms = sh.max_ts_ms;
+            stored.has_ts = true;
+          }
+          shape_remaps[ti][i] = gid;
         }
-        shape_remap[i] = gid;
+        status_remaps[ti].resize(t.statuses.size());
+        for (size_t i = 0; i < t.statuses.size(); ++i) {
+          int32_t gid = status_map.intern(
+              t.statuses[i], static_cast<int32_t>(as->statuses.size()),
+              &ins);
+          if (ins) as->statuses.push_back(t.statuses[i]);
+          status_remaps[ti][i] = gid;
+        }
       }
-      for (size_t i = 0; i < t.rows.size(); ++i) {
-        as->trace_of.push_back(t.trace_of[i]);
-        as->shape_id.push_back(shape_remap[t.shape_id[i]]);
-        as->status_id.push_back(t.status_id[i]);  // local; remapped below
-      }
-      for (auto& r : t.rows) as->rows.push_back(r);
     }
 
-    // global status interning (document order across threads)
-    SvMap status_map(64);
-    bool ins;
-    std::vector<std::vector<int32_t>> remaps(outs.size());
-    for (size_t ti = 0; ti < outs.size(); ++ti) {
+    // the ~150 MB document-order row copy parallelizes: each worker owns
+    // a disjoint slice (bases from the prefix sum), remapping shape /
+    // status ids as it copies
+    as->rows.resize(n);
+    as->trace_of.resize(n);
+    as->shape_id.resize(n);
+    as->status_id.resize(n);
+    std::vector<size_t> bases(outs.size() + 1, 0);
+    for (size_t ti = 0; ti < outs.size(); ++ti)
+      bases[ti + 1] = bases[ti] + outs[ti].rows.size();
+    auto copy_slice = [&](size_t ti) {
       auto& t = outs[ti];
-      remaps[ti].resize(t.statuses.size());
-      for (size_t i = 0; i < t.statuses.size(); ++i) {
-        int32_t gid = status_map.intern(
-            t.statuses[i], static_cast<int32_t>(as->statuses.size()), &ins);
-        if (ins) as->statuses.push_back(t.statuses[i]);
-        remaps[ti][i] = gid;
+      size_t base = bases[ti];
+      const auto& shape_remap = shape_remaps[ti];
+      const auto& status_remap = status_remaps[ti];
+      for (size_t i = 0; i < t.rows.size(); ++i) {
+        as->rows[base + i] = t.rows[i];
+        as->trace_of[base + i] = t.trace_of[i];
+        as->shape_id[base + i] = shape_remap[t.shape_id[i]];
+        as->status_id[base + i] = status_remap[t.status_id[i]];
       }
-    }
-    size_t at = 0;
-    for (size_t ti = 0; ti < outs.size(); ++ti) {
-      size_t cnt = outs[ti].rows.size();
-      for (size_t i = 0; i < cnt; ++i)
-        as->status_id[at + i] = remaps[ti][as->status_id[at + i]];
-      at += cnt;
+    };
+    {
+      std::vector<std::thread> ths;
+      for (size_t ti = 1; ti < outs.size(); ++ti)
+        ths.emplace_back(copy_slice, ti);
+      copy_slice(0);
+      for (auto& th : ths) th.join();
     }
   }
 

@@ -1598,10 +1598,12 @@ void assemble(std::vector<ThreadOut>& outs, PrescanResult&& ps,
         as->status_id[base + i] = status_remap[t.status_id[i]];
       }
     };
-    {
+    if (n < 4096) {  // small windows: spawn cost dwarfs the copy
+      for (size_t ti = 0; ti < outs.size(); ++ti) copy_slice(ti);
+    } else {
       std::vector<std::thread> ths;
       for (size_t ti = 1; ti < outs.size(); ++ti)
-        ths.emplace_back(copy_slice, ti);
+        if (outs[ti].rows.size()) ths.emplace_back(copy_slice, ti);
       copy_slice(0);
       for (auto& th : ths) th.join();
     }

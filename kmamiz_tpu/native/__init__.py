@@ -362,7 +362,12 @@ def parse_spans(
             tid = buf[pos : pos + tlen].decode("utf-8", "surrogatepass")
             pos += tlen
             trace_ids.append(tid if present else None)
-    except (struct.error, IndexError, UnicodeDecodeError, ValueError):
+    except UnicodeDecodeError:
+        # string fields carried invalid UTF-8: JSON must be UTF-8, so the
+        # payload is malformed — reject, exactly like the json.loads path
+        logger.warning("span payload contains invalid UTF-8; rejected")
+        return None
+    except (struct.error, IndexError, ValueError):
         # ValueError: np.frombuffer on a truncated buffer (stale .so ABI)
         logger.warning("native span decode failed, using Python path")
         return None

@@ -823,3 +823,40 @@ def test_stream_malformed_later_chunk_at_least_once():
         dp2.ingest_raw_window(bad)
     with dp2._dedup_lock:
         assert not dp2._processed
+
+
+def test_fuzz_mutated_bytes_never_crash():
+    """Malformed, truncated, byte-flipped, and structural-char-injected
+    payloads: both scan modes must return None or a well-formed result —
+    never crash — and invalid UTF-8 rejects like the json.loads path."""
+    from kmamiz_tpu import native
+
+    rng = random.Random(77)
+    base = json.dumps([[mk_span("t1", "a", duration=5)],
+                       [mk_span("t2", "b", parent="a")]]).encode()
+    for _ in range(300):
+        mode = rng.randrange(4)
+        if mode == 0:
+            buf = bytes(rng.randrange(256) for _ in range(rng.randrange(0, 160)))
+        elif mode == 1:
+            buf = base[: rng.randrange(len(base) + 1)]
+        elif mode == 2:
+            b = bytearray(base)
+            for _ in range(rng.randrange(1, 6)):
+                b[rng.randrange(len(b))] = rng.randrange(256)
+            buf = bytes(b)
+        else:
+            b = bytearray(base)
+            for _ in range(rng.randrange(1, 8)):
+                b.insert(rng.randrange(len(b)), rng.choice(b'[]{}",\\\x00\x01'))
+            buf = bytes(b)
+        for threads in (1, 4):
+            out = native.parse_spans(buf, ["skip", None], threads=threads)
+            if out is not None:
+                assert out["n_spans"] == len(out["kind"])
+
+    # the invalid-UTF-8 rejection matches json.loads behavior
+    bad_utf8 = base.replace(b'"200"', b'"2\xb20"')
+    assert native.parse_spans(bad_utf8, []) is None
+    with pytest.raises(UnicodeDecodeError):
+        json.loads(bad_utf8)

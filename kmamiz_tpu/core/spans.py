@@ -317,6 +317,7 @@ def raw_spans_to_batch(
     pad: bool = True,
     ts_base_us: Optional[int] = None,
     skip_trace_ids: Sequence = (),
+    skip_blob: Optional[bytes] = None,
 ):
     """Native ingest: raw Zipkin response bytes -> (SpanBatch, kept trace
     ids), bypassing json.loads and the per-span dict walk (VERDICT r1 #1).
@@ -333,7 +334,9 @@ def raw_spans_to_batch(
     """
     from kmamiz_tpu import native as native_mod
 
-    parsed = native_mod.parse_spans(raw, list(skip_trace_ids))
+    parsed = native_mod.parse_spans(
+        raw, list(skip_trace_ids), skip_blob=skip_blob
+    )
     if parsed is None:
         return None
 

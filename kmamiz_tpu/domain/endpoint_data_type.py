@@ -120,6 +120,14 @@ class EndpointDataType:
     def merge_schema_with(
         self, other: "EndpointDataType", now_ms: Optional[float] = None
     ) -> "EndpointDataType":
+        if now_ms is None:
+            # the reference stamps merged per-status schemas with the
+            # merge time (EndpointDataType.ts:160 `time: new Date()`);
+            # callers pass now_ms for determinism in tests
+            import time as _time
+
+            now_ms = _time.time() * 1000
+
         def to_map(schemas: List[dict]) -> Dict[str, dict]:
             ordered = sorted(schemas, key=lambda s: -(s.get("time") or 0))
             out: Dict[str, dict] = {}

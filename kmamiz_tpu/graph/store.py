@@ -15,7 +15,9 @@ The reference's combineWith overwrites same-window duplicate records
 """
 from __future__ import annotations
 
+import os
 import threading
+import time
 from functools import partial
 from typing import Optional
 
@@ -32,7 +34,13 @@ from kmamiz_tpu.core.spans import (
 )
 from kmamiz_tpu.ops import scorers as scorer_ops
 from kmamiz_tpu.ops import window as window_ops
-from kmamiz_tpu.ops.sortutil import SENTINEL, compact_unique
+from kmamiz_tpu.ops.sortutil import (
+    EDGE_KEY_MAX_DIST,
+    EDGE_KEY_MAX_EP,
+    SENTINEL,
+    compact_unique,
+    compact_unique_edges_packed,
+)
 
 
 @jax.jit
@@ -64,6 +72,55 @@ def _window_merge(parent_idx, kind, valid, endpoint_id, src, dst, dist, mask):
         edges.mask.reshape(-1),
     )
     return s, d, ds, v, v.sum()
+
+
+@partial(jax.jit, static_argnames=("max_depth",))
+def _window_edges_packed(parent_slot, kind, valid, endpoint_id, max_depth):
+    """Walk-only kernel: this window's flat (ancestor, descendant,
+    distance, mask) candidate columns, store untouched. The staged-merge
+    overflow fallback re-walks a window through this when its compacted
+    prefix truncated (see _drain_staged_locked)."""
+    edges = window_ops.dependency_edges_packed(
+        parent_slot, kind, valid, endpoint_id, max_depth=max_depth
+    )
+    return (
+        edges.ancestor_ep.reshape(-1),
+        edges.descendant_ep.reshape(-1),
+        edges.distance.reshape(-1),
+        edges.mask.reshape(-1),
+    )
+
+
+@partial(jax.jit, static_argnames=("max_depth", "stage_cap", "packed_key"))
+def _window_edges_compact(
+    parent_slot, kind, valid, endpoint_id, max_depth, stage_cap, packed_key
+):
+    """Staged-merge kernel for the streaming path: walk this window's
+    candidates and self-compact them to a sorted unique prefix, sliced to
+    stage_cap rows. Dispatched async per chunk, the sort runs on device
+    WHILE the host parses the next chunk; the drain then unions the tiny
+    compacted prefixes instead of the full padded candidate arrays
+    (~16x fewer rows at bench scale). Returns (src, dst, dist, count);
+    count is the TRUE unique total — count > stage_cap means the prefix
+    truncated and the drain must re-walk this window (rare: it takes a
+    window carrying >stage_cap distinct edges).
+
+    packed_key selects the single-int32-key sort (2x cheaper); the caller
+    guarantees the id/dist bounds (sortutil.EDGE_KEY_*)."""
+    edges = window_ops.dependency_edges_packed(
+        parent_slot, kind, valid, endpoint_id, max_depth=max_depth
+    )
+    cols = (
+        edges.ancestor_ep.reshape(-1),
+        edges.descendant_ep.reshape(-1),
+        edges.distance.reshape(-1),
+    )
+    mask = edges.mask.reshape(-1)
+    if packed_key:
+        (s, d, ds), v = compact_unique_edges_packed(*cols, mask)
+    else:
+        (s, d, ds), v = compact_unique(cols, mask)
+    return s[:stage_cap], d[:stage_cap], ds[:stage_cap], v.sum()
 
 
 @partial(jax.jit, static_argnames=("max_depth",))
@@ -109,7 +166,22 @@ class EndpointGraph:
         self._dst = jnp.full(capacity, SENTINEL, dtype=jnp.int32)
         self._dist = jnp.full(capacity, SENTINEL, dtype=jnp.int32)
         self._n_edges = 0
+        # host->device copy time of the LAST merge_window call (ms),
+        # for casual introspection only — concurrent mergers use
+        # merge_window's per-call return value for accounting.
+        self.last_transfer_ms = 0.0
         self._pending = None  # deferred (src, dst, dist, count) of last merge
+        # staged windows (compacted src/dst/dist prefixes + pinned walk
+        # inputs) awaiting the batched drain union; bounded by
+        # _stage_max_rows
+        self._staged = []
+        self._staged_rows = 0
+        # distance bounds ever merged (host-tracked): gate the
+        # packed-single-key sort fast path at the drain. Walk kernels
+        # only emit dist >= 1; warm-start records can carry anything
+        # (dist < 1 would wrap the packed key), so loads widen the range.
+        self._max_dist = 0
+        self._min_dist = 1
         # monotonic state-change counter: API layers key scorer-payload
         # caches on it (bumped by merges and warm-start loads)
         self._version = 0
@@ -152,18 +224,71 @@ class EndpointGraph:
 
     # -- ingestion -----------------------------------------------------------
 
-    def merge_window(self, batch: SpanBatch) -> None:
-        """Union this window's dependency edges into the store and update
-        per-endpoint record/last-usage metadata."""
-        with self._lock:
-            self._merge_window_locked(batch)
+    def _to_device(self, *host_arrays):
+        """Copy host arrays to the device; returns (arrays, copy_ms). The
+        inputs must land before the merge kernel can start, so blocking
+        here costs nothing — and it makes the copy separable from
+        framework work in the ingest accounting (on this dev harness the
+        copy rides a ~10 MB/s tunnel; on a TPU VM it is PCIe)."""
+        t0 = time.perf_counter()
+        out = jax.block_until_ready([jnp.asarray(a) for a in host_arrays])
+        ms = (time.perf_counter() - t0) * 1000.0
+        self.last_transfer_ms = ms
+        return out, ms
 
-    def _merge_window_locked(self, batch: SpanBatch) -> None:
+    def merge_window(self, batch: SpanBatch, stage: bool = False) -> float:
+        """Union this window's dependency edges into the store and update
+        per-endpoint record/last-usage metadata. Returns THIS call's
+        host->device copy time in ms (per-call, so concurrent mergers
+        can't clobber each other's accounting; `last_transfer_ms` keeps
+        the most recent value for casual introspection).
+
+        stage=True (the streaming-ingest path) dispatches only the cheap
+        ancestor-walk kernel and STAGES its candidate edges; the union
+        sort runs once over all staged windows at the next read
+        (_finalize_pending), so k chunks cost one big sort instead of k
+        serialized ones. stage=False (ticks, one-shot ingest) keeps the
+        fused walk+union kernel: one device program per window."""
+        with self._lock:
+            return self._merge_window_locked(batch, stage)
+
+    def _merge_window_locked(self, batch: SpanBatch, stage: bool = False) -> float:
         self._version += 1
-        self._finalize_pending()
         packed = pack_trace_rows(
             batch.trace_of, batch.n_spans, batch.parent_idx
         )
+        if stage and packed is not None:
+            depth = min(
+                window_ops.MAX_DEPTH,
+                _pow2(max(1, packed.max_trace_len - 1), minimum=4),
+            )
+            dev_in, transfer_ms = self._to_device(
+                packed.pack(packed.parent_slots(batch.parent_idx), -1),
+                packed.pack(batch.kind, 0),
+                packed.pack(batch.valid, False),
+                packed.pack(batch.endpoint_id, 0),
+            )
+            self._max_dist = max(self._max_dist, depth)
+            packed_key = (
+                len(self.interner.endpoints) <= EDGE_KEY_MAX_EP
+                and depth <= EDGE_KEY_MAX_DIST
+            )
+            s, d, ds, count = _window_edges_compact(
+                *dev_in,
+                max_depth=depth,
+                stage_cap=self._stage_cap(),
+                packed_key=packed_key,
+            )
+            if hasattr(count, "copy_to_host_async"):
+                count.copy_to_host_async()
+            self._staged.append((s, d, ds, count, dev_in, depth))
+            self._staged_rows += int(s.shape[0])
+            self._update_ep_metadata(batch)
+            # backstop: an unread stream must not grow HBM unboundedly
+            if self._staged_rows > self._stage_max_rows():
+                self._finalize_pending_locked()
+            return transfer_ms
+        self._finalize_pending_locked()
         if packed is not None:
             # ancestor chains cannot outrun the longest trace; cap the walk
             # depth (pow2 buckets keep recompilation bounded)
@@ -171,11 +296,15 @@ class EndpointGraph:
                 window_ops.MAX_DEPTH,
                 _pow2(max(1, packed.max_trace_len - 1), minimum=4),
             )
+            dev_in, transfer_ms = self._to_device(
+                packed.pack(packed.parent_slots(batch.parent_idx), -1),
+                packed.pack(batch.kind, 0),
+                packed.pack(batch.valid, False),
+                packed.pack(batch.endpoint_id, 0),
+            )
+            self._max_dist = max(self._max_dist, depth)
             src, dst, dist, _valid, valid_count = _window_merge_packed(
-                jnp.asarray(packed.pack(packed.parent_slots(batch.parent_idx), -1)),
-                jnp.asarray(packed.pack(batch.kind, 0)),
-                jnp.asarray(packed.pack(batch.valid, False)),
-                jnp.asarray(packed.pack(batch.endpoint_id, 0)),
+                *dev_in,
                 self._src,
                 self._dst,
                 self._dist,
@@ -183,11 +312,12 @@ class EndpointGraph:
                 max_depth=depth,
             )
         else:  # overlong trace / cross-trace parent: flat gather fallback
+            self._max_dist = max(self._max_dist, window_ops.MAX_DEPTH)
+            dev_in, transfer_ms = self._to_device(
+                batch.parent_idx, batch.kind, batch.valid, batch.endpoint_id
+            )
             src, dst, dist, _valid, valid_count = _window_merge(
-                jnp.asarray(batch.parent_idx),
-                jnp.asarray(batch.kind),
-                jnp.asarray(batch.valid),
-                jnp.asarray(batch.endpoint_id),
+                *dev_in,
                 self._src,
                 self._dst,
                 self._dist,
@@ -199,8 +329,12 @@ class EndpointGraph:
         if hasattr(valid_count, "copy_to_host_async"):
             valid_count.copy_to_host_async()
         self._pending = (src, dst, dist, valid_count)
+        self._update_ep_metadata(batch)
+        return transfer_ms
 
-        # endpoint metadata (host-side, no device sync)
+    def _update_ep_metadata(self, batch: SpanBatch) -> None:
+        """Per-endpoint record/last-usage metadata (host-side, no device
+        sync); shared by the fused and staged merge paths."""
         n_ep = len(self.interner.endpoints)
         self._ensure_ep_arrays(n_ep)
         server_eps = batch.endpoint_id[batch.valid & (batch.kind == KIND_SERVER)]
@@ -212,6 +346,27 @@ class EndpointGraph:
                     self._ep_last_ts[eid], info["timestamp"]
                 )
 
+    @staticmethod
+    def _stage_max_rows() -> int:
+        """Staged-prefix row cap before an inline drain (bounds HBM for
+        an unread stream; each staged window also pins its walk inputs
+        for the overflow fallback)."""
+        try:
+            return int(os.environ.get("KMAMIZ_STAGE_MAX_ROWS", 1 << 24))
+        except ValueError:
+            return 1 << 24
+
+    @staticmethod
+    def _stage_cap() -> int:
+        """Per-window compacted-prefix width (static kernel shape). A
+        window carrying more distinct edges than this still merges
+        correctly via the drain's re-walk fallback — this cap only sets
+        the fast path's width."""
+        try:
+            return int(os.environ.get("KMAMIZ_STAGE_CAP", 1 << 17))
+        except ValueError:
+            return 1 << 17
+
     def _finalize_pending(self) -> None:
         """Resolve the deferred merge: fetch the edge count and re-pad the
         merged arrays to the next power-of-2 capacity."""
@@ -219,13 +374,19 @@ class EndpointGraph:
             self._finalize_pending_locked()
 
     def _finalize_pending_locked(self) -> None:
-        pending = self._pending
-        if pending is None:
+        if self._staged:
+            self._drain_staged_locked()  # resolves _pending too
             return
-        self._pending = None
-        src, dst, dist, valid_count = pending
+        if self._pending is None:
+            return
+        pending, self._pending = self._pending, None
+        self._apply_merged(*pending)
+
+    def _apply_merged(self, src, dst, dist, valid_count) -> None:
+        """Adopt a merged edge set: fetch the count and re-pad to the next
+        power-of-2 capacity."""
         valid_count = int(valid_count)
-        new_cap = _pow2(valid_count, minimum=self.capacity)
+        new_cap = _pow2(valid_count, minimum=int(self._src.shape[0]))
         merged_len = int(src.shape[0])
         if new_cap <= merged_len:
             # compact_unique packs valid edges first, so the prefix is exact
@@ -239,7 +400,52 @@ class EndpointGraph:
             self._dist = jnp.concatenate([dist, pad])
         self._n_edges = valid_count
 
-    # -- views ---------------------------------------------------------------
+    def _drain_staged_locked(self) -> None:
+        """ONE set-union over the store + every staged window's compacted
+        prefix: the batched equivalent of k fused merges, with the big
+        per-window sorts already done asynchronously at stage time. Runs
+        whenever staged windows exist and anything reads the store (or
+        the staging cap trips). A window whose prefix truncated
+        (count > stage_cap) re-walks here from its pinned inputs —
+        correctness never depends on the cap."""
+        staged, self._staged = self._staged, []
+        self._staged_rows = 0
+        # resolve any fused-path pending merge FIRST so the union below
+        # sees the freshest store arrays
+        if self._pending is not None:
+            pending, self._pending = self._pending, None
+            self._apply_merged(*pending)
+        srcs, dsts, dists, masks = (
+            [self._src],
+            [self._dst],
+            [self._dist],
+            [self._src != SENTINEL],
+        )
+        for s, d, ds, count, dev_in, depth in staged:
+            if int(count) > int(s.shape[0]):  # truncated prefix: re-walk
+                s, d, ds, m = _window_edges_packed(*dev_in, max_depth=depth)
+                srcs.append(s)
+                dsts.append(d)
+                dists.append(ds)
+                masks.append(m)
+            else:
+                srcs.append(s)
+                dsts.append(d)
+                dists.append(ds)
+                masks.append(s != SENTINEL)
+        src = jnp.concatenate(srcs)
+        dst = jnp.concatenate(dsts)
+        dist = jnp.concatenate(dists)
+        mask = jnp.concatenate(masks)
+        if (
+            len(self.interner.endpoints) <= EDGE_KEY_MAX_EP
+            and self._min_dist >= 1
+            and self._max_dist <= EDGE_KEY_MAX_DIST
+        ):
+            (s, d, ds), v = compact_unique_edges_packed(src, dst, dist, mask)
+        else:
+            (s, d, ds), v = compact_unique((src, dst, dist), mask)
+        self._apply_merged(s, d, ds, v.sum())
 
     def edge_arrays(self):
         """(src_ep, dst_ep, dist, mask) snapshot of the stored edges
@@ -414,6 +620,10 @@ class EndpointGraph:
         if not src_l:
             return
         self._finalize_pending()
+        # loaded records carry arbitrary distances; keep the packed-key
+        # gate honest on BOTH bounds (dist < 1 would wrap the key)
+        self._max_dist = max(self._max_dist, max(dist_l))
+        self._min_dist = min(self._min_dist, min(dist_l))
         cap = _pow2(len(src_l))
         src = np.full(cap, SENTINEL, dtype=np.int32)
         dst = np.full(cap, SENTINEL, dtype=np.int32)

@@ -96,6 +96,37 @@ class ZipkinClient:
             logger.error("zipkin raw trace fetch failed: %s", err)
             return None
 
+    def iter_trace_pages_raw(
+        self,
+        look_back: float = DEFAULT_LOOKBACK_MS,
+        end_ts: Optional[float] = None,
+        pages: int = 4,
+        limit: int = 100_000,
+        service_name: str = DEFAULT_ROOT_SERVICE,
+    ):
+        """Paginated raw fetch: split the look-back window into `pages`
+        contiguous endTs/lookback sub-windows (oldest first, so spans merge
+        in roughly chronological order) and yield each page's raw response
+        bytes. This is the feeder for DataProcessor.ingest_raw_stream —
+        page k+1's fetch+parse overlaps page k's device merge, and the
+        processed-trace dedup absorbs traces that straddle a page boundary
+        (Zipkin returns such a trace in both pages).
+
+        Pages are fetched lazily (one HTTP request per generator step);
+        empty or failed pages are skipped, matching get_trace_list_raw's
+        log-and-continue error posture."""
+        if end_ts is None:
+            end_ts = time.time() * 1000
+        pages = max(1, int(pages))
+        page_lb = look_back / pages
+        for k in range(pages):
+            page_end = end_ts - (pages - 1 - k) * page_lb
+            raw = self.get_trace_list_raw(
+                page_lb, page_end, limit, service_name
+            )
+            if raw:
+                yield raw
+
     def get_services(self) -> List[str]:
         try:
             data = _http_get_json(f"{self._base}/services", self._timeout)

@@ -235,8 +235,23 @@ def parse_threads() -> int:
         return 0
 
 
+def encode_skip_entry(tid) -> bytes:
+    """One skip-set entry in the km_parse_spans_mt blob layout
+    (u8 present + u32 len + utf8 bytes; None markers encode as absent).
+    Callers that parse repeatedly against a growing processed set cache
+    these encodings instead of re-walking the whole set every call
+    (DataProcessor keeps an incremental blob)."""
+    if tid is None:
+        return struct.pack("<BI", 0, 0)
+    b = str(tid).encode("utf-8", "surrogatepass")
+    return struct.pack("<BI", 1, len(b)) + b
+
+
 def parse_spans(
-    raw: bytes, skip_trace_ids: Sequence = (), threads: Optional[int] = None
+    raw: bytes,
+    skip_trace_ids: Sequence = (),
+    threads: Optional[int] = None,
+    skip_blob: Optional[bytes] = None,
 ) -> Optional[dict]:
     """Scan a raw Zipkin JSON response ([[span,...],...]) into SoA arrays.
 
@@ -248,6 +263,11 @@ def parse_spans(
     auto). The parallel scan preserves exact sequential semantics: group
     dedup runs in document order during the prescan, and duplicate span
     ids resolve first-position/last-wins via a document-order fixup.
+
+    skip_blob: pre-encoded full skip blob (u32 count + encode_skip_entry
+    per id) that REPLACES skip_trace_ids when given — callers with a
+    large, slowly-growing processed set pass a cached blob so each parse
+    doesn't re-encode the whole set.
 
     Returns None when the extension is unavailable or the input is
     malformed (callers fall back to json.loads + spans_to_batch), else a
@@ -262,14 +282,10 @@ def parse_spans(
     lib = _load()
     if lib is None:
         return None
-    skip_blob = bytearray(struct.pack("<I", len(skip_trace_ids)))
-    for t in skip_trace_ids:
-        if t is None:
-            skip_blob += struct.pack("<BI", 0, 0)
-        else:
-            b = str(t).encode("utf-8", "surrogatepass")
-            skip_blob += struct.pack("<BI", 1, len(b))
-            skip_blob += b
+    if skip_blob is None:
+        skip_blob = bytearray(struct.pack("<I", len(skip_trace_ids)))
+        for t in skip_trace_ids:
+            skip_blob += encode_skip_entry(t)
 
     if threads is None:
         threads = parse_threads()

@@ -30,7 +30,10 @@ def _replace_all_sync(store: Store, collection: str, docs_fn: Callable[[], list]
         docs = docs_fn()
         if docs is None:
             return
-        old_ids = [d["_id"] for d in store.find_all(collection) if "_id" in d]
+        # ids-only read: the rotation needs no document bodies, so it
+        # skips the boundary validation walk entirely — and it still
+        # purges documents the read path has quarantined
+        old_ids = store.find_ids(collection)
         # strip _id so re-synced docs get fresh ids — otherwise docs loaded
         # from this store would be upserted under their old ids and then
         # deleted as "old", wiping the collection

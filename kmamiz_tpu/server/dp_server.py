@@ -91,8 +91,20 @@ def make_handler(processor: DataProcessor):
                     # the pipelined path)
                     if len(raw) >= threshold:
                         from kmamiz_tpu import native as native_mod
+                        from kmamiz_tpu.server.processor import (
+                            DEFAULT_STREAM_CHUNKS,
+                        )
 
-                        chunks = native_mod.split_groups(raw, 8)
+                        try:
+                            n_chunks = int(
+                                os.environ.get(
+                                    "KMAMIZ_INGEST_STREAM_CHUNKS",
+                                    DEFAULT_STREAM_CHUNKS,
+                                )
+                            )
+                        except ValueError:
+                            n_chunks = DEFAULT_STREAM_CHUNKS
+                        chunks = native_mod.split_groups(raw, n_chunks)
                         if chunks is not None and len(chunks) > 1:
                             summary = processor.ingest_raw_stream(chunks)
                     if summary is None:

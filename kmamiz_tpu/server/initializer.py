@@ -206,6 +206,29 @@ class Initializer:
         now = time.time() * 1000
         today = int(now - (now % 86_400_000))
 
+        # device-graph backfill rides the uncapped streaming route: page
+        # fetch + native parse of page k+1 overlap page k's device merge
+        # (processor.ingest_from_zipkin). The host-domain caches below
+        # still follow the reference's capped path byte for byte.
+        if ctx.processor is not None and hasattr(
+            ctx.zipkin_client, "iter_trace_pages_raw"
+        ):
+            try:
+                summary = ctx.processor.ingest_from_zipkin(
+                    ctx.zipkin_client, 86_400_000 * 30, now
+                )
+                logger.info(
+                    "device-graph backfill: %d spans / %d traces in %.0f ms",
+                    summary["spans"],
+                    summary["traces"],
+                    summary["ms"],
+                )
+            except ValueError:
+                logger.info(
+                    "native loader unavailable; device graph will fill "
+                    "from realtime ticks instead"
+                )
+
         traces = Traces(
             ctx.zipkin_client.get_trace_list(86_400_000 * 30, today)
         )

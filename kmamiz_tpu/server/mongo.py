@@ -29,7 +29,7 @@ import threading
 from typing import Any, Dict, List, Optional, Tuple
 from urllib.parse import parse_qs, unquote, urlparse
 
-from kmamiz_tpu.server import bson
+from kmamiz_tpu.server import bson, schemas
 from kmamiz_tpu.server.storage import COLLECTIONS, Store
 
 OP_MSG = 2013
@@ -334,8 +334,16 @@ class MongoClient:
                 {"insert": collection, "documents": list(docs), "$db": db}
             )
 
-    def find_all(self, db: str, collection: str) -> List[dict]:
-        reply = self.command({"find": collection, "$db": db})
+    def find_all(
+        self,
+        db: str,
+        collection: str,
+        projection: Optional[dict] = None,
+    ) -> List[dict]:
+        cmd = {"find": collection, "$db": db}
+        if projection is not None:
+            cmd["projection"] = projection
+        reply = self.command(cmd)
         cursor = reply["cursor"]
         docs = list(cursor.get("firstBatch", []))
         while cursor.get("id"):
@@ -443,14 +451,31 @@ class MongoStore(Store):
         self._client.ping()
 
     def find_all(self, collection: str) -> List[dict]:
-        return self._client.find_all(self._db, collection)
+        docs = self._client.find_all(self._db, collection)
+        # the Mongo database is writable by other clients: the boundary
+        # check migrates old documents and quarantines foreign/corrupt
+        # ones with a logged error (reference: Mongoose model casting,
+        # MongoOperator.ts:6-14)
+        from kmamiz_tpu.server.storage import _boundary_check_reads
+
+        return _boundary_check_reads(collection, docs)
+
+    def find_ids(self, collection: str) -> List[str]:
+        # _id projection: the rotation transfers no document bodies
+        docs = self._client.find_all(
+            self._db, collection, projection={"_id": 1}
+        )
+        return [d["_id"] for d in docs if "_id" in d]
 
     def insert_many(self, collection: str, docs: List[dict]) -> List[dict]:
         import uuid
 
+        if schemas.enabled():
+            for doc in docs:
+                schemas.validate_doc(collection, doc)
         out = []
         for doc in docs:
-            d = dict(doc)
+            d = schemas.stamp(dict(doc))
             d.setdefault("_id", uuid.uuid4().hex)
             out.append(d)
         self._client.insert_many(self._db, collection, out)
@@ -459,7 +484,9 @@ class MongoStore(Store):
     def save(self, collection: str, doc: dict) -> dict:
         import uuid
 
-        d = dict(doc)
+        if schemas.enabled():
+            schemas.validate_doc(collection, doc)
+        d = schemas.stamp(dict(doc))
         d.setdefault("_id", uuid.uuid4().hex)
         self._client.upsert_by_id(self._db, collection, d)
         return d

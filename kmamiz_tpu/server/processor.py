@@ -33,6 +33,13 @@ from kmamiz_tpu.domain.realtime import RealtimeDataList
 from kmamiz_tpu.core import profiling
 from kmamiz_tpu.core.profiling import step_timer
 from kmamiz_tpu.domain.traces import Traces
+
+# default pipeline width for chunked big-window ingest (DP-server body
+# splits, paginated Zipkin backfills): enough chunks that the native
+# parse of chunk k+1 fully hides the device merge of chunk k, few enough
+# that per-chunk padding/assembly overhead stays small (measured sweet
+# spot on the bench's 1.05M-span window; 2-8 all land within ~8%)
+DEFAULT_STREAM_CHUNKS = 4
 from kmamiz_tpu.graph.store import EndpointGraph
 from kmamiz_tpu.ops import window as window_ops
 
@@ -67,6 +74,11 @@ class DataProcessor:
         self._use_device_stats = use_device_stats
         self._now_ms = now_ms
         self._processed: Dict[str, float] = {}
+        # incremental pre-encoded skip blob mirroring _processed's keys
+        # (native/__init__.encode_skip_entry layout): the raw-ingest parse
+        # passes it straight to the native scanner instead of re-encoding
+        # a six-figure processed set on every chunk
+        self._skip_entries = bytearray()
         # collect() runs on the scheduler/DP thread while /ingest backfills
         # arrive on other server threads; dedup-map transitions serialize
         # here (the graph store carries its own lock)
@@ -76,6 +88,8 @@ class DataProcessor:
     # -- trace dedup (data_processor.rs:30-73) -------------------------------
 
     def _filter_traces(self, traces: List[List[dict]], request_time: float):
+        from kmamiz_tpu.native import encode_skip_entry
+
         with self._dedup_lock:
             kept = []
             for group in traces:
@@ -85,13 +99,31 @@ class DataProcessor:
                 if trace_id in self._processed:
                     continue
                 self._processed[trace_id] = request_time
+                self._skip_entries += encode_skip_entry(trace_id)
                 kept.append(group)
-            # TTL cleanup
-            cutoff = request_time - PROCESSED_TRACE_TTL_MS
-            self._processed = {
-                k: v for k, v in self._processed.items() if v >= cutoff
-            }
+            self._prune_processed_locked(request_time)
             return kept
+
+    def _prune_processed_locked(self, now_ms: float) -> None:
+        """TTL-prune the processed map; the cached skip blob rebuilds only
+        when the prune actually removed entries."""
+        from kmamiz_tpu.native import encode_skip_entry
+
+        cutoff = now_ms - PROCESSED_TRACE_TTL_MS
+        pruned = {k: v for k, v in self._processed.items() if v >= cutoff}
+        if len(pruned) != len(self._processed):
+            self._processed = pruned
+            self._skip_entries = bytearray()
+            for tid in pruned:
+                self._skip_entries += encode_skip_entry(tid)
+
+    def _skip_blob_locked(self) -> bytes:
+        """Snapshot of the full native skip blob (header + entries)."""
+        import struct
+
+        return struct.pack("<I", len(self._processed)) + bytes(
+            self._skip_entries
+        )
 
     # -- the tick ------------------------------------------------------------
 
@@ -197,12 +229,12 @@ class DataProcessor:
 
         t_start = self._now_ms()
         with self._dedup_lock:
-            skip = list(self._processed)
+            skip_blob = self._skip_blob_locked()
         with step_timer.phase("raw_ingest_parse"):
             out = raw_spans_to_batch(
                 raw,
                 interner=self.graph.interner,
-                skip_trace_ids=skip,
+                skip_blob=skip_blob,
             )
         if out is None:
             raise ValueError(
@@ -230,13 +262,14 @@ class DataProcessor:
     def _register_processed(self, kept, when_ms: float) -> None:
         """Register kept trace ids in the processed map + TTL prune (the
         one definition both raw-ingest paths share)."""
+        from kmamiz_tpu.native import encode_skip_entry
+
         with self._dedup_lock:
             for tid in kept:
+                if tid not in self._processed:
+                    self._skip_entries += encode_skip_entry(tid)
                 self._processed[tid] = when_ms
-            cutoff = when_ms - PROCESSED_TRACE_TTL_MS
-            self._processed = {
-                k: v for k, v in self._processed.items() if v >= cutoff
-            }
+            self._prune_processed_locked(when_ms)
 
     # -- streaming raw ingest: parse(k+1) overlaps merge(k) ------------------
 
@@ -267,7 +300,11 @@ class DataProcessor:
         the one-shot ingest_raw_window path stays all-or-nothing).
 
         Returns the ingest_raw_window totals plus overlap accounting
-        (parse_ms / merge_ms / saved_ms)."""
+        (parse_ms / merge_ms / saved_ms) and a per-chunk phase breakdown
+        (`chunk_detail`: spans / parse_ms / merge_ms / transfer_ms per
+        chunk, plus `drain_ms` for the final device sync) — enough to
+        reconstruct the pipeline's critical path with the host->device
+        copy priced at any bandwidth (bench.py does exactly that)."""
         from concurrent.futures import ThreadPoolExecutor
 
         from kmamiz_tpu.core.spans import raw_spans_to_batch
@@ -276,23 +313,31 @@ class DataProcessor:
         parse_ms = 0.0
         merge_ms = 0.0
         totals = {"spans": 0, "traces": 0, "chunks": 0}
+        chunk_detail = []
 
-        def _parse(raw: bytes):
+        it = iter(chunks)
+
+        def _fetch_and_parse():
+            """Pull the NEXT chunk from the iterator and parse it — both
+            on the worker thread, so a paginated source's HTTP fetch
+            overlaps the device merge along with the parse (the iterator
+            has exactly one consumer at a time: the single in-flight
+            task). parse_ms therefore includes the source fetch.
+            Returns None when the source is exhausted."""
+            try:
+                raw = next(it)
+            except StopIteration:
+                return None
             with self._dedup_lock:
-                skip = list(self._processed)
+                skip_blob = self._skip_blob_locked()
             t0 = time.perf_counter()
             out = raw_spans_to_batch(
-                raw, interner=self.graph.interner, skip_trace_ids=skip
+                raw, interner=self.graph.interner, skip_blob=skip_blob
             )
             return out, (time.perf_counter() - t0) * 1000.0
 
-        it = iter(chunks)
         with ThreadPoolExecutor(max_workers=1) as pool:
-            try:
-                first = next(it)
-            except StopIteration:
-                first = None
-            current = _parse(first) if first is not None else None
+            current = _fetch_and_parse()
             while current is not None:
                 out, dt = current
                 parse_ms += dt
@@ -301,35 +346,76 @@ class DataProcessor:
                         "native span loader unavailable or malformed payload"
                     )
                 batch, kept = out
-                # before the next chunk's parse snapshots the processed set
+                # registration precedes the next fetch+parse submission,
+                # so chunk k+1's parse snapshots a processed set that
+                # already includes chunk k
                 self._register_processed(kept, self._now_ms())
-                try:
-                    nxt = next(it)
-                except StopIteration:
-                    nxt = None
-                fut = pool.submit(_parse, nxt) if nxt is not None else None
+                fut = pool.submit(_fetch_and_parse)
                 t0 = time.perf_counter()
+                chunk_transfer_ms = 0.0
                 if batch.n_spans:
                     with step_timer.phase("raw_ingest_graph"), profiling.trace(
                         "raw_ingest_graph"
                     ):
-                        self.graph.merge_window(batch)
-                merge_ms += (time.perf_counter() - t0) * 1000.0
+                        # stage: walk-only dispatch per chunk, ONE union
+                        # sort over all chunks at the drain below
+                        chunk_transfer_ms = self.graph.merge_window(
+                            batch, stage=True
+                        )
+                chunk_merge_ms = (time.perf_counter() - t0) * 1000.0
+                merge_ms += chunk_merge_ms
+                chunk_detail.append(
+                    {
+                        "spans": batch.n_spans,
+                        "parse_ms": round(dt, 1),
+                        "merge_ms": round(chunk_merge_ms, 1),
+                        "transfer_ms": round(chunk_transfer_ms, 1),
+                    }
+                )
                 totals["spans"] += batch.n_spans
                 totals["traces"] += len(kept)
                 totals["chunks"] += 1
-                current = fut.result() if fut is not None else None
+                current = fut.result()
 
+        # the deferred merge chain resolves here: n_edges blocks on the
+        # device queue, so charge it explicitly as the pipeline's drain
+        t0 = time.perf_counter()
+        n_edges = int(self.graph.n_edges)
+        drain_ms = (time.perf_counter() - t0) * 1000.0
         wall_ms = self._now_ms() - t_start
         return {
             **totals,
             "endpoints": len(self.graph.interner.endpoints),
-            "edges": int(self.graph.n_edges),
+            "edges": n_edges,
+            "chunk_detail": chunk_detail,
+            "drain_ms": round(drain_ms, 1),
             "ms": round(wall_ms, 1),
             "parse_ms": round(parse_ms, 1),
             "merge_ms": round(merge_ms, 1),
             "saved_ms": round(max(0.0, parse_ms + merge_ms - wall_ms), 1),
         }
+
+    def ingest_from_zipkin(
+        self,
+        zipkin,
+        look_back_ms: float,
+        end_ts: "Optional[float]" = None,
+        pages: int = DEFAULT_STREAM_CHUNKS,
+    ) -> dict:
+        """THE big-window route: paginated raw Zipkin fetch -> chunked
+        native parse -> overlapped device merge, end to end. Each page's
+        HTTP fetch + native parse runs on the pipeline's worker thread
+        while the previous page packs/transfers/merges into the device
+        graph (ingest_raw_stream). This composition replaces the
+        reference's capped realtime tick for backfills and large windows
+        (data_processor.rs:75-126 processes at most 2,500 traces per
+        tick; this path is uncapped).
+
+        Raises ValueError when the native loader is unavailable (callers
+        fall back to the capped get_trace_list path)."""
+        return self.ingest_raw_stream(
+            zipkin.iter_trace_pages_raw(look_back_ms, end_ts, pages=pages)
+        )
 
     # -- hybrid combine: device numeric stats + host body merge --------------
 

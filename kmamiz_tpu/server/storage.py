@@ -10,10 +10,42 @@ from __future__ import annotations
 
 import copy
 import json
+import logging
 import threading
 import uuid
 from pathlib import Path
 from typing import Any, Callable, Dict, List, Optional
+
+from kmamiz_tpu.server import schemas
+
+logger = logging.getLogger("kmamiz_tpu.storage")
+
+
+def _boundary_check_reads(collection: str, docs: List[dict]) -> List[dict]:
+    """Read-side boundary: migrate old documents forward, QUARANTINE
+    invalid ones (skip + log the boundary error). Reads stay fail-open so
+    one corrupt/foreign document cannot wedge its collection — the
+    periodic replace-all sync (which reads ids only) rewrites the
+    collection and purges the quarantined doc on its next rotation;
+    writes remain fail-closed (insert_many/save raise)."""
+    if not schemas.enabled():
+        return docs
+    out = []
+    for d in docs:
+        try:
+            d = schemas.migrate(collection, d)
+            schemas.validate_doc(collection, d)
+        except schemas.SchemaValidationError as err:
+            logger.error(
+                "quarantined invalid document %s in %s: %s",
+                d.get("_id", "<no id>"),
+                collection,
+                err,
+            )
+            continue
+        out.append(d)
+    return out
+
 
 COLLECTIONS = (
     "AggregatedData",
@@ -33,6 +65,12 @@ class Store:
     delete_many / clear)."""
 
     def find_all(self, collection: str) -> List[dict]:
+        raise NotImplementedError
+
+    def find_ids(self, collection: str) -> List[str]:
+        """All _ids in a collection WITHOUT materializing/validating the
+        documents — the cheap read the periodic replace-all sync uses to
+        rotate a collection (and the purge path for quarantined docs)."""
         raise NotImplementedError
 
     def insert_many(self, collection: str, docs: List[dict]) -> List[dict]:
@@ -115,21 +153,31 @@ class MemoryStore(Store):
 
     def find_all(self, collection: str) -> List[dict]:
         with self._lock:
-            return copy.deepcopy(list(self._data[collection].values()))
+            docs = copy.deepcopy(list(self._data[collection].values()))
+        return _boundary_check_reads(collection, docs)
+
+    def find_ids(self, collection: str) -> List[str]:
+        with self._lock:
+            return list(self._data[collection].keys())
 
     def insert_many(self, collection: str, docs: List[dict]) -> List[dict]:
+        if schemas.enabled():
+            for doc in docs:
+                schemas.validate_doc(collection, doc)
         out = []
         with self._lock:
             for doc in docs:
-                d = copy.deepcopy(doc)
+                d = schemas.stamp(copy.deepcopy(doc))
                 d.setdefault("_id", uuid.uuid4().hex)
                 self._data[collection][d["_id"]] = d
                 out.append(copy.deepcopy(d))
         return out
 
     def save(self, collection: str, doc: dict) -> dict:
+        if schemas.enabled():
+            schemas.validate_doc(collection, doc)
         with self._lock:
-            d = copy.deepcopy(doc)
+            d = schemas.stamp(copy.deepcopy(doc))
             d.setdefault("_id", uuid.uuid4().hex)
             self._data[collection][d["_id"]] = d
             return copy.deepcopy(d)

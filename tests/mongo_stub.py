@@ -302,6 +302,12 @@ class MiniMongo:
                 for d in coll.values()
                 if _matches(d, command.get("filter", {}))
             ]
+            projection = command.get("projection")
+            if projection:  # inclusion-style projection (_id always kept)
+                keep = {k for k, v in projection.items() if v} | {"_id"}
+                docs = [
+                    {k: v for k, v in d.items() if k in keep} for d in docs
+                ]
             first, rest = docs[: self.batch_size], docs[self.batch_size :]
             cursor_id = 0
             if rest:

@@ -111,6 +111,80 @@ def test_incremental_merge_is_union(pdas_traces, bookinfo_traces):
     )
 
 
+def test_staged_merge_equals_fused(pdas_traces, bookinfo_traces):
+    # the streaming path's staged merges (walk-only per window, one union
+    # at the drain) must produce the identical edge set to fused merges
+    fused = EndpointGraph()
+    for group in bookinfo_traces:
+        fused.merge_window(spans_to_batch([group], interner=fused.interner))
+
+    staged = EndpointGraph()
+    v0 = staged.version
+    for group in bookinfo_traces:
+        staged.merge_window(
+            spans_to_batch([group], interner=staged.interner), stage=True
+        )
+    assert staged.version > v0  # staging still bumps the version counter
+    assert staged._staged  # nothing drained before the first read
+    assert staged.n_edges == fused.n_edges  # the read drains
+    assert not staged._staged
+
+    s1, d1, dist1, m1 = (np.asarray(x) for x in fused.edge_arrays())
+    s2, d2, dist2, m2 = (np.asarray(x) for x in staged.edge_arrays())
+    e1 = {(int(a), int(b), int(c)) for a, b, c in zip(s1[m1], d1[m1], dist1[m1])}
+    e2 = {(int(a), int(b), int(c)) for a, b, c in zip(s2[m2], d2[m2], dist2[m2])}
+    assert e1 == e2
+
+
+def test_staged_and_fused_interleave(pdas_traces):
+    # a realtime tick (fused) landing between staged stream chunks must
+    # not lose either side's edges
+    groups = pdas_traces if isinstance(pdas_traces[0], list) else [pdas_traces]
+    ref = EndpointGraph()
+    ref.merge_window(spans_to_batch(groups, interner=ref.interner))
+
+    mixed = EndpointGraph()
+    for i, group in enumerate(groups):
+        mixed.merge_window(
+            spans_to_batch([group], interner=mixed.interner),
+            stage=(i % 2 == 0),
+        )
+    assert mixed.n_edges == ref.n_edges
+
+
+def test_out_of_range_loaded_distance_stays_exact(pdas_traces):
+    # regression (review finding): a warm-start record with distance 0
+    # must NOT take the packed-single-key drain path (dist-1 would wrap
+    # the int32 key into a garbage edge); the generic 3-column union
+    # keeps it exact
+    g = EndpointGraph()
+    info = {
+        "uniqueServiceName": "a\tns\tv", "uniqueEndpointName": "a\tns\tv\tGET\tu",
+        "service": "a", "namespace": "ns", "version": "v", "url": "u",
+        "host": "h", "path": "p", "port": "80", "method": "GET",
+        "clusterName": "c", "timestamp": 1,
+    }
+    dep_info = {**info, "uniqueEndpointName": "b\tns\tv\tGET\tu",
+                "uniqueServiceName": "b\tns\tv", "service": "b"}
+    g.load_dependencies([
+        {
+            "endpoint": info,
+            "lastUsageTimestamp": 1,
+            "dependingOn": [{"endpoint": dep_info, "distance": 0, "type": "t"}],
+            "dependingBy": [],
+        }
+    ])
+    # stage a window so the drain union runs with the loaded edge present
+    groups = pdas_traces if isinstance(pdas_traces[0], list) else [pdas_traces]
+    g.merge_window(spans_to_batch(groups, interner=g.interner), stage=True)
+    s, d, dist, m = (np.asarray(x) for x in g.edge_arrays())
+    edges = {(int(a), int(b), int(c)) for a, b, c in zip(s[m], d[m], dist[m])}
+    eid_a = g.interner.endpoints.get("a\tns\tv\tGET\tu")
+    eid_b = g.interner.endpoints.get("b\tns\tv\tGET\tu")
+    assert (eid_a, eid_b, 0) in edges  # survives exactly, not as garbage
+    assert all(c < 1_000_000 and a >= 0 for a, _b, c in edges)
+
+
 def test_load_dependencies_warm_start(bookinfo_traces):
     """Restart path: a graph rebuilt from the persisted dependency-cache
     JSON must carry the same edges and scores as one built from spans."""

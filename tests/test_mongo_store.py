@@ -16,6 +16,14 @@ from kmamiz_tpu.server.storage import store_from_uri
 from mongo_stub import MiniMongo
 
 
+@pytest.fixture(autouse=True)
+def _no_schema_validation(monkeypatch):
+    # this module tests the WIRE/store mechanics (OP_MSG framing, SCRAM,
+    # upsert/delete contracts) with shorthand docs; boundary shape checks
+    # are covered by test_server.py::TestSchemaBoundary
+    monkeypatch.setenv("KMAMIZ_SCHEMA_VALIDATION", "0")
+
+
 @pytest.fixture()
 def mongo():
     server = MiniMongo(batch_size=3).start()

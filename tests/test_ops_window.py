@@ -418,3 +418,79 @@ class TestNonPow2ClientSkip:
                 got.ancestor_ep, got.descendant_ep, got.distance, got.mask
             )
             assert have == want, f"cap={cap}"
+
+
+class TestSortUtil:
+    """Direct properties of the dedup kernels the graph store unions
+    with (ops/sortutil.py)."""
+
+    def _ref_unique(self, src, dst, dist, valid):
+        rows = sorted(
+            {(int(a), int(b), int(c))
+             for a, b, c in zip(src[valid], dst[valid], dist[valid])}
+        )
+        return rows
+
+    def test_compact_unique_matches_set_semantics(self):
+        import numpy as np
+
+        from kmamiz_tpu.ops.sortutil import SENTINEL, compact_unique
+
+        rng = np.random.default_rng(7)
+        for n in (1, 5, 257, 4096):
+            src = rng.integers(0, 50, n).astype(np.int32)
+            dst = rng.integers(0, 50, n).astype(np.int32)
+            dist = rng.integers(1, 9, n).astype(np.int32)
+            valid = rng.random(n) < 0.7
+            (s, d, ds), v = compact_unique(
+                (jnp.asarray(src), jnp.asarray(dst), jnp.asarray(dist)),
+                jnp.asarray(valid),
+            )
+            s, d, ds, v = (np.asarray(x) for x in (s, d, ds, v))
+            want = self._ref_unique(src, dst, dist, valid)
+            got = list(zip(s[v].tolist(), d[v].tolist(), ds[v].tolist()))
+            assert got == want  # sorted unique prefix, in order
+            # tail fully parked
+            assert (s[~v] == SENTINEL).all()
+            assert v[: len(want)].all() and not v[len(want):].any()
+
+    def test_packed_key_path_equals_generic(self):
+        import numpy as np
+
+        from kmamiz_tpu.ops.sortutil import (
+            EDGE_KEY_MAX_DIST,
+            EDGE_KEY_MAX_EP,
+            compact_unique,
+            compact_unique_edges_packed,
+        )
+
+        rng = np.random.default_rng(11)
+        n = 8192
+        # ids right up to the documented bounds
+        src = rng.integers(0, EDGE_KEY_MAX_EP, n).astype(np.int32)
+        dst = rng.integers(0, EDGE_KEY_MAX_EP, n).astype(np.int32)
+        dist = rng.integers(1, EDGE_KEY_MAX_DIST + 1, n).astype(np.int32)
+        valid = rng.random(n) < 0.6
+        generic = compact_unique(
+            (jnp.asarray(src), jnp.asarray(dst), jnp.asarray(dist)),
+            jnp.asarray(valid),
+        )
+        packed = compact_unique_edges_packed(
+            jnp.asarray(src), jnp.asarray(dst), jnp.asarray(dist),
+            jnp.asarray(valid),
+        )
+        for a, b in zip(generic[0], packed[0]):
+            assert (np.asarray(a) == np.asarray(b)).all()
+        assert (np.asarray(generic[1]) == np.asarray(packed[1])).all()
+
+    def test_scatter_compact_preserves_order(self):
+        import numpy as np
+
+        from kmamiz_tpu.ops.sortutil import SENTINEL, scatter_compact
+
+        vals = jnp.asarray(np.array([5, 3, 9, 1, 7], dtype=np.int32))
+        keep = jnp.asarray(np.array([True, False, True, True, False]))
+        (out,), v = scatter_compact([vals], keep)
+        assert np.asarray(out).tolist()[:3] == [5, 9, 1]
+        assert (np.asarray(out)[3:] == SENTINEL).all()
+        assert np.asarray(v).tolist() == [True, True, True, False, False]

@@ -296,6 +296,13 @@ class TestCaches:
 
 
 class TestStorageAndDispatch:
+    @pytest.fixture(autouse=True)
+    def _no_schema_validation(self, monkeypatch):
+        # these tests exercise store MECHANICS (journals, upserts,
+        # crash-atomicity) with shorthand docs; the boundary shape checks
+        # have their own suite (test_server.py::TestSchemaBoundary)
+        monkeypatch.setenv("KMAMIZ_SCHEMA_VALIDATION", "0")
+
     def test_file_store_round_trip(self, tmp_path):
         store = FileStore(str(tmp_path / "data"))
         docs = store.insert_many("AggregatedData", [{"services": [], "fromDate": 1, "toDate": 2}])
@@ -486,3 +493,152 @@ class TestStorageAndDispatch:
 
         cache.import_data(exported, factory)
         assert cache.get("LabelMapping").get_label("a\tb\tc\tGET\thttp://x/y") == "/y"
+
+
+class TestSchemaBoundary:
+    """Store-boundary document validation (server/schemas.py): the nine
+    collection shapes of /root/reference/src/entities/schema/*.ts enforced
+    on writes AND reads, with a version stamp + migration hook."""
+
+    def _tagged_swagger(self, **over):
+        doc = {
+            "tag": "v1",
+            "time": 1000,
+            "uniqueServiceName": "svc\tns\tv1",
+            "openApiDocument": "{}",
+        }
+        doc.update(over)
+        return doc
+
+    def test_valid_docs_accepted_and_stamped(self):
+        from kmamiz_tpu.server.schemas import CURRENT_VERSION
+
+        store = MemoryStore()
+        out = store.insert_many("TaggedSwagger", [self._tagged_swagger()])
+        assert out[0]["_schemaVersion"] == CURRENT_VERSION
+        assert store.find_all("TaggedSwagger")[0]["tag"] == "v1"
+
+    def test_garbage_rejected_at_write_with_boundary_error(self):
+        from kmamiz_tpu.server.schemas import SchemaValidationError
+
+        store = MemoryStore()
+        with pytest.raises(SchemaValidationError) as err:
+            store.insert_many("TaggedSwagger", [{"tag": "x", "time": "NOT A NUMBER"}])
+        assert "TaggedSwagger" in str(err.value)
+        assert "time" in str(err.value)
+        with pytest.raises(SchemaValidationError):
+            store.save("AggregatedData", {"fromDate": 1})  # toDate+services missing
+        # nothing partially persisted
+        assert store.find_all("TaggedSwagger") == []
+
+    def test_foreign_garbage_quarantined_at_read(self, caplog):
+        # a corrupt document written by a FOREIGN writer (bypassing the
+        # boundary) is QUARANTINED on read — skipped with a logged
+        # boundary error instead of a KeyError deep in domain code, and
+        # without wedging the collection (reads stay fail-open; the sync
+        # rotation purges it via the ids-only read)
+        import logging
+
+        store = MemoryStore()
+        with store._lock:  # simulate a foreign writer
+            store._data["TaggedSwagger"]["x"] = {"_id": "x", "bogus": True,
+                                                 "_schemaVersion": 1}
+        good = self._tagged_swagger()
+        store.save("TaggedSwagger", good)
+        with caplog.at_level(logging.ERROR, "kmamiz_tpu.storage"):
+            docs = store.find_all("TaggedSwagger")
+        assert [d["tag"] for d in docs] == ["v1"]  # bad doc skipped
+        assert any("quarantined" in r.message for r in caplog.records)
+        # the rotation sees BOTH ids, so the quarantined doc is purgeable
+        assert set(store.find_ids("TaggedSwagger")) == {"x", docs[0]["_id"]}
+
+    def test_quarantined_doc_cannot_wedge_replace_all_sync(self):
+        # regression (review finding): the periodic replace-all sync must
+        # keep persisting and purge the corrupt doc, not raise forever
+        from kmamiz_tpu.server.cacheables import _replace_all_sync
+
+        store = MemoryStore()
+        with store._lock:
+            store._data["TaggedSwagger"]["bad"] = {"_id": "bad", "nope": 1}
+        sync = _replace_all_sync(
+            store, "TaggedSwagger", lambda: [self._tagged_swagger()]
+        )
+        sync()
+        docs = store.find_all("TaggedSwagger")
+        assert [d["tag"] for d in docs] == ["v1"]
+        assert store.find_ids("TaggedSwagger") == [docs[0]["_id"]]  # purged
+
+    def test_legacy_null_schema_time_migrates(self):
+        # regression (review finding): pre-versioning EndpointDataType
+        # docs could carry schemas[].time == null (the old merge path);
+        # the 0->1 migration repairs them instead of crashing every read
+        store = MemoryStore()
+        legacy = {
+            "_id": "L",
+            "uniqueServiceName": "s\tns\tv",
+            "uniqueEndpointName": "s\tns\tv\tGET\turl",
+            "service": "s", "namespace": "ns", "version": "v",
+            "method": "GET",
+            "schemas": [{"status": "200", "time": None}],
+        }
+        with store._lock:
+            store._data["EndpointDataType"]["L"] = legacy
+        docs = store.find_all("EndpointDataType")
+        assert docs and docs[0]["schemas"][0]["time"] == 0
+
+    def test_unversioned_docs_migrate_forward_on_read(self):
+        from kmamiz_tpu.server.schemas import CURRENT_VERSION
+
+        store = MemoryStore()
+        with store._lock:  # pre-versioning document (no _schemaVersion)
+            store._data["TaggedSwagger"]["old"] = {
+                "_id": "old", **self._tagged_swagger()
+            }
+        docs = store.find_all("TaggedSwagger")
+        assert docs[0]["_schemaVersion"] == CURRENT_VERSION
+
+    def test_migration_hook_is_applied(self, monkeypatch):
+        from kmamiz_tpu.server import schemas as S
+
+        calls = []
+
+        def fix_tag(doc):
+            calls.append(doc["_id"])
+            return {**doc, "tag": doc["tag"].lower()}
+
+        monkeypatch.setitem(S.MIGRATIONS["TaggedSwagger"], 0, fix_tag)
+        store = MemoryStore()
+        with store._lock:
+            store._data["TaggedSwagger"]["y"] = {
+                "_id": "y", **self._tagged_swagger(tag="V9")
+            }
+        docs = store.find_all("TaggedSwagger")
+        assert docs[0]["tag"] == "v9" and calls == ["y"]
+
+    def test_optional_fields_and_unknown_collections_pass(self):
+        store = MemoryStore()
+        # boundToSwagger optional (TaggedInterface.ts default)
+        store.save(
+            "TaggedInterface",
+            {
+                "uniqueLabelName": "a",
+                "userLabel": "b",
+                "requestSchema": "",
+                "responseSchema": "",
+                "timestamp": 5,
+            },
+        )
+        assert store.find_all("TaggedInterface")
+
+    def test_validation_can_be_disabled(self, monkeypatch):
+        monkeypatch.setenv("KMAMIZ_SCHEMA_VALIDATION", "0")
+        store = MemoryStore()
+        store.insert_many("TaggedSwagger", [{"bogus": 1}])
+        assert store.find_all("TaggedSwagger")
+
+    def test_nine_collections_have_schemas(self):
+        from kmamiz_tpu.server.schemas import SCHEMAS
+        from kmamiz_tpu.server.storage import COLLECTIONS
+
+        assert set(SCHEMAS) == set(COLLECTIONS)
+        assert len(SCHEMAS) == 9

@@ -40,14 +40,99 @@ def desensitize_value(value: Any) -> Any:
     return value
 
 
+def _reject_constant(_name: str):
+    # the wasm filter's strict grammar has no NaN/Infinity; json.loads
+    # would accept them
+    raise ValueError("non-JSON constant")
+
+
+def _scan_string_end(s: str, i: int) -> int:
+    """i points AFTER the opening quote of a known-valid JSON string;
+    returns the index after the closing quote."""
+    while True:
+        c = s[i]
+        if c == "\\":
+            i += 2
+            continue
+        if c == '"':
+            return i + 1
+        i += 1
+
+
+def _desens_tokens(s: str) -> str:
+    """Rebuild a KNOWN-VALID JSON text with values scrubbed, keeping the
+    RAW source tokens for keys and literals — exactly what the wasm
+    filter's streaming transform (and the reference's gjson walk,
+    main.go:210-240) emit. json.dumps-style re-encoding of keys would
+    diverge on non-ASCII or non-canonically-escaped keys (e.g. the
+    source token "uni\\u00E9" must survive byte-for-byte). Separators
+    normalize to ", " / ": ", matching the filter's output."""
+    out: list = []
+    i, n = 0, len(s)
+    # per-open-container marker: True/False = object (key expected /
+    # not), None = array (never expects keys)
+    expect_key: list = []
+    while i < n:
+        c = s[i]
+        if c in " \t\n\r":
+            i += 1
+            continue
+        if c == "{":
+            out.append("{")
+            expect_key.append(True)
+            i += 1
+        elif c == "[":
+            out.append("[")
+            expect_key.append(None)
+            i += 1
+        elif c in "}]":
+            out.append(c)
+            expect_key.pop()
+            i += 1
+        elif c == ",":
+            out.append(", ")
+            if expect_key and expect_key[-1] is not None:
+                expect_key[-1] = True
+            i += 1
+        elif c == ":":
+            out.append(": ")
+            expect_key[-1] = False
+            i += 1
+        elif c == '"':
+            end = _scan_string_end(s, i + 1)
+            if expect_key and expect_key[-1]:
+                out.append(s[i:end])  # raw key token, byte-for-byte
+            else:
+                out.append('""')
+            i = end
+        elif s.startswith("true", i):
+            out.append("true")
+            i += 4
+        elif s.startswith("false", i):
+            out.append("false")
+            i += 5
+        elif s.startswith("null", i):
+            out.append("null")
+            i += 4
+        else:  # number token
+            out.append("0")
+            while i < n and s[i] not in ",}] \t\n\r":
+                i += 1
+    return "".join(out)
+
+
 def desensitize_body(body: str) -> Optional[str]:
     """JSON body -> desensitized JSON string; None when it doesn't parse
-    (the filter drops unparseable bodies, main.go:213-218)."""
+    (the filter drops unparseable bodies, main.go:213-218). Validation
+    rides json.loads' strict grammar (with NaN/Infinity rejected, like
+    the filter); the output is rebuilt from the RAW source tokens so
+    keys, duplicate keys, and literal spellings match the wasm
+    filter's streaming transform exactly."""
     try:
-        parsed = json.loads(body)
-    except (json.JSONDecodeError, TypeError):
+        json.loads(body, parse_constant=_reject_constant)
+    except (json.JSONDecodeError, TypeError, ValueError, RecursionError):
         return None
-    return json.dumps(desensitize_value(parsed), separators=(", ", ": "))
+    return _desens_tokens(body)
 
 
 def _id_block(kind: str, request_id: str, trace_id: str, span_id: str, parent_span_id: str) -> str:

@@ -482,3 +482,348 @@ def test_envoyfilter_cr_sha_matches_artifact(binary):
 
     cr = (REPO / "envoy" / "EnvoyFilter-WASM.yaml").read_text()
     assert hashlib.sha256(binary).hexdigest() in cr
+
+
+# -- strict proxy-wasm host: ABI contracts a real Envoy enforces -------------
+
+from proxy_wasm_host import (  # noqa: E402
+    ACTION_CONTINUE,
+    ACTION_PAUSE,
+    AbiViolation,
+    StrictHost,
+)
+
+
+def build_violating_binary(kind: str) -> bytes:
+    """Minimal proxy-wasm modules that each break ONE host contract —
+    the strict host must reject every one of them."""
+    from wasm_asm import I32, Asm, Module as AsmModule
+
+    m = AsmModule()
+    m.set_memory_pages(1)
+    GETBUF = m.add_import(
+        "env", "proxy_get_buffer_bytes", [I32] * 5, [I32]
+    )
+    GETHDR = m.add_import(
+        "env", "proxy_get_header_map_value", [I32] * 5, [I32]
+    )
+    m.declare_func("proxy_on_memory_allocate", [I32], [I32])
+    m.declare_func("proxy_on_context_create", [I32, I32], [])
+    m.declare_func("proxy_on_request_headers", [I32, I32, I32], [I32])
+    m.declare_func("proxy_on_request_body", [I32, I32, I32], [I32])
+    m.declare_func("proxy_on_done", [I32], [I32])
+    m.declare_func("proxy_on_log", [I32], [])
+    m.declare_func("proxy_on_delete", [I32], [])
+
+    a = Asm()
+    a.i32_const(0x200)  # fixed scratch allocation
+    m.define_func("proxy_on_memory_allocate", 0, a)
+    m.define_func("proxy_on_context_create", 0, Asm())
+
+    a = Asm()
+    if kind == "buffer_in_headers":
+        # reads the request-body buffer during on_request_headers
+        a.i32_const(0).i32_const(0).i32_const(64)
+        a.i32_const(0x100).i32_const(0x104).call(GETBUF).drop()
+    elif kind == "response_map_in_request_phase":
+        # reads the response header map before it exists
+        a.i32_const(2).i32_const(0x80).i32_const(1)
+        a.i32_const(0x100).i32_const(0x104).call(GETHDR).drop()
+    a.i32_const(0)
+    m.define_func("proxy_on_request_headers", 0, a)
+
+    a = Asm()
+    if kind == "bad_action":
+        a.i32_const(7)  # not a proxy-wasm Action
+    else:
+        a.i32_const(0)
+    m.define_func("proxy_on_request_body", 0, a)
+
+    a = Asm()
+    a.i32_const(1)
+    m.define_func("proxy_on_done", 0, a)
+    m.define_func("proxy_on_log", 0, Asm())
+    m.define_func("proxy_on_delete", 0, Asm())
+    for name in (
+        "proxy_on_memory_allocate",
+        "proxy_on_context_create",
+        "proxy_on_request_headers",
+        "proxy_on_request_body",
+        "proxy_on_done",
+        "proxy_on_log",
+        "proxy_on_delete",
+    ):
+        m.export_func(name)
+    m.export_memory()
+    return m.build()
+
+
+class TestStrictHostAbi:
+    """The filter under a host that enforces real proxy-wasm contracts:
+    chunked deliveries with Envoy buffering semantics, teardown order
+    done->log->delete, callback-context legality (VERDICT r3 #3a)."""
+
+    def test_chunked_request_body_pauses_then_captures_whole_body(self, binary):
+        body = '{"user": "alice", "age": 31, "nested": {"a": [1, 2, 3]}}'
+        host = StrictHost(binary)
+        host.context_create(31)
+        host.request_headers(31, FULL_REQ)
+        actions = host.request_body(31, body.encode(), chunks=5)
+        # the reference pauses until end_of_stream (main.go:101-104); a
+        # filter that continues early loses the buffer in this host
+        assert actions[:-1] == [ACTION_PAUSE] * (len(actions) - 1)
+        assert actions[-1] == ACTION_CONTINUE
+        host.response_headers(31, {":status": "200"})
+        host.done(31)
+        host.log(31)
+        host.delete(31)
+        want = format_request_log(
+            "POST",
+            "svc.ns.svc.cluster.local:8080",
+            "/api/v1/data?x=1",
+            "rid-1",
+            "abc123",
+            "s1",
+            "p1",
+            "application/json",
+            body,
+        )
+        assert host.logs[0][1] == want  # FULL body, not the last chunk
+
+    def test_chunked_response_body_matches_twin(self, binary):
+        body = '{"result": "secret", "items": [10, 20, 30], "ok": true}'
+        host = StrictHost(binary)
+        host.stream(
+            32, FULL_REQ, FULL_RESP, response_body=body.encode(), body_chunks=4
+        )
+        want = format_response_log(
+            "201", "rid-1", "abc123", "s1", "p1", "application/json", body
+        )
+        resp = next(l for _lvl, l in host.logs if l.startswith("[Response"))
+        assert resp == want
+        assert "secret" not in resp
+
+    def test_single_byte_chunks(self, binary):
+        body = '{"k": [1, 2], "s": "v"}'
+        host = StrictHost(binary)
+        host.stream(
+            33,
+            FULL_REQ,
+            {":status": "200"},
+            request_body=body.encode(),
+            body_chunks=len(body),
+        )
+        assert host.logs[0][1].endswith(
+            ' [Body] {"k": [0, 0], "s": ""}'
+        )
+
+    def test_stream_close_without_response(self, binary):
+        # reset/timeout: no response phase at all; Envoy still fires
+        # done -> log -> delete and the pending request line must emerge
+        host = StrictHost(binary)
+        host.stream(34, FULL_REQ)  # JSON content-type: line was pending
+        lines = [l for _lvl, l in host.logs]
+        assert lines == [
+            format_request_log(
+                "POST",
+                "svc.ns.svc.cluster.local:8080",
+                "/api/v1/data?x=1",
+                "rid-1",
+                "abc123",
+                "s1",
+                "p1",
+                "application/json",
+            )
+        ]
+
+    def test_close_mid_body_without_end_of_stream(self, binary):
+        # body started, stream reset before end_of_stream: the log
+        # backstop emits the bodyless line, and no partial body leaks
+        host = StrictHost(binary)
+        host.context_create(35)
+        host.request_headers(35, FULL_REQ)
+        actions = host.request_body(
+            35, b'{"half": "of a bo', chunks=2, end_stream=False
+        )
+        assert actions == [ACTION_PAUSE, ACTION_PAUSE]
+        host.done(35)
+        host.log(35)
+        host.delete(35)
+        line = host.logs[0][1]
+        assert " [Body] " not in line
+        assert line.startswith("[Request rid-1/abc123")
+
+    def test_header_reads_across_pauses(self, binary):
+        # two interleaved streams, one paused mid-body: header-map reads
+        # for the OTHER stream keep working and land on the right stream
+        host = StrictHost(binary)
+        host.context_create(36)
+        host.request_headers(
+            36, dict(FULL_REQ, **{"x-b3-traceid": "paused-stream"})
+        )
+        host.request_body(36, b'{"a": 1', chunks=1, end_stream=False)  # paused
+        host.context_create(37)
+        req_b = dict(FULL_REQ, **{"x-b3-traceid": "other-stream"})
+        del req_b["content-type"]  # logs at headers
+        host.request_headers(37, req_b)
+        assert "other-stream" in host.logs[-1][1]
+        # the paused stream finishes afterwards, body intact
+        host.request_body(36, b'}', chunks=1, end_stream=True)
+        host.response_headers(36, {":status": "200"})
+        host.done(36)
+        host.log(36)
+        host.delete(36)
+        paused = next(l for _lvl, l in host.logs if "paused-stream" in l)
+        assert paused.endswith(' [Body] {"a": 0}')
+
+    def test_shipped_binary_passes_strict_full_streams(self, binary):
+        host = StrictHost(binary)
+        for i in range(1, 40):
+            host.stream(
+                i,
+                dict(FULL_REQ, **{"x-b3-traceid": f"strict-{i}"}),
+                FULL_RESP,
+                request_body=b'{"n": 1}',
+                response_body=b'{"ok": true}',
+                body_chunks=3,
+            )
+        assert len(host.logs) == 39 * 2
+
+    # -- the host must reject intentionally ABI-violating binaries ------------
+
+    def test_rejects_buffer_read_during_headers(self):
+        bad = build_violating_binary("buffer_in_headers")
+        host = StrictHost(bad)
+        host.context_create(1)
+        with pytest.raises(AbiViolation, match="buffer 0 read during"):
+            host.request_headers(1, FULL_REQ)
+
+    def test_rejects_response_map_read_in_request_phase(self):
+        bad = build_violating_binary("response_map_in_request_phase")
+        host = StrictHost(bad)
+        host.context_create(1)
+        with pytest.raises(AbiViolation, match="precedes its existence"):
+            host.request_headers(1, FULL_REQ)
+
+    def test_rejects_bad_action_value(self):
+        bad = build_violating_binary("bad_action")
+        host = StrictHost(bad)
+        host.context_create(1)
+        host.request_headers(1, FULL_REQ)
+        with pytest.raises(AbiViolation, match="non-Action"):
+            host.request_body(1, b"{}", chunks=1)
+
+    def test_rejects_host_calls_after_delete(self, binary):
+        host = StrictHost(binary)
+        host.stream(40, FULL_REQ, FULL_RESP)
+        with pytest.raises(AbiViolation, match="deleted context"):
+            host._enter(40, "on_log")
+            try:
+                host._get_header(
+                    host.instance, 0, 0x80, 1, 0x100, 0x104
+                )
+            finally:
+                host._exit()
+
+
+class TestDifferentialFuzz:
+    """>=10k adversarial bodies through the BINARY under the strict host,
+    differentially checked against the Python spec twin
+    (core/envoy_filter.py) AND the reference log grammar via the L1
+    parser (core/envoy.py parse_envoy_logs; grammar from
+    /root/reference/envoy/wasm/main.go:156-207) — VERDICT r3 #3b."""
+
+    def test_fuzz_10k_bodies_match_twin_and_grammar(self, binary):
+        import json as _json
+        import os
+        import random
+
+        from kmamiz_tpu.core.envoy import parse_envoy_logs
+        from kmamiz_tpu.core.envoy_filter import desensitize_body
+
+        trials = int(os.environ.get("KMAMIZ_WASM_FUZZ_TRIALS", 10_000))
+        rng = random.Random(20260730)
+
+        key_pool = [
+            "k0", "k1", "k2", "k3", "unié", "a b", "q\\", "line\nbreak",
+            "", "\t", "käy-💡",
+        ]
+
+        def gen_value(depth=0):
+            r = rng.random()
+            if depth > 3 or r < 0.3:
+                return rng.choice(
+                    [True, False, None, 0, -17, 3.25, 1e6, -0.0,
+                     "txt", "", "q\\", "unié", "nul\\u0000",
+                     '{"nested": "as-string"}', "line\nbreak", "\t"]
+                )
+            if r < 0.6:
+                return [gen_value(depth + 1) for _ in range(rng.randint(0, 4))]
+            return {
+                rng.choice(key_pool): gen_value(depth + 1)
+                for _ in range(rng.randint(0, 4))
+            }
+
+        # raw key-token cases no dumps() round can synthesize: raw
+        # non-ASCII keys, UPPERCASE hex escapes, solidus escapes,
+        # duplicate keys — the wasm transform must keep every raw token
+        # byte-for-byte and the twin must agree
+        template_bodies = [
+            '{"uni\\u00E9": 1}',
+            '{"k\\/s": "v", "k\\/s": 2}',
+            '{"dup": 1, "dup": {"dup": "x"}}',
+            '{"unié": "raw-utf8", "\\u0041": 0}',
+            '{"mixed\\u00e9é": [1, {"\\u2603": "snow"}]}',
+        ]
+
+        def mutate(s: str) -> str:
+            # structural damage: truncation, byte flips, junk injection
+            r = rng.random()
+            if not s or r < 0.33:
+                return s[: rng.randint(0, max(len(s) - 1, 0))]
+            if r < 0.66:
+                i = rng.randrange(len(s))
+                return s[:i] + rng.choice("{}[],:\"'x0\x01\\") + s[i + 1:]
+            i = rng.randrange(len(s) + 1)
+            return s[:i] + rng.choice(["garbage", '{"', "]", "\\u12"]) + s[i:]
+
+        host = StrictHost(binary)
+        checked = 0
+        for trial in range(trials):
+            if trial % 23 == 21:
+                body = rng.choice(template_bodies)
+            else:
+                body = _json.dumps(
+                    gen_value(), ensure_ascii=bool(trial % 2)
+                )
+            if trial % 3 == 2:  # every third body is damaged
+                body = mutate(body)
+            host.logs.clear()
+            ctx = 100 + (trial % 100)
+            host.stream(
+                ctx,
+                FULL_REQ,
+                {":status": "200"},
+                request_body=body.encode("utf-8", "replace"),
+                body_chunks=1 + trial % 4,
+            )
+            line = host.logs[0][1]
+            want = desensitize_body(body)
+            if want is None:
+                assert " [Body] " not in line, (body, line)
+            else:
+                assert line.endswith(f" [Body] {want}"), (body, line, want)
+            # reference-grammar check: the emitted pair must parse as one
+            # envoy log stream with the ids/method/path intact
+            stamped = [
+                f"2026-07-30T00:00:0{i}.000Z\t{l}"
+                for i, (_lvl, l) in enumerate(host.logs)
+            ]
+            records = parse_envoy_logs(stamped, "ns", "pod-1").to_json()
+            assert records[0]["type"] == "Request"
+            assert records[0]["traceId"] == "abc123"
+            assert records[0]["method"] == "POST"
+            assert records[1]["type"] == "Response"
+            assert records[1]["status"] == "200"
+            checked += 1
+        assert checked == trials

@@ -48,7 +48,8 @@ Memory map (4 pages):
   0x0800   : header-value out-ptr scratch, 0x0804: out-size scratch
   0x1000.. : log-line build buffer (to 0x8000, clamped)
   0x8000.. : per-stream context table, 128 slots x 256 B
-             [0]=ctx_id [4]=flags [8]=ids_len [12..]=ids bytes
+             [0]=ctx_id [4]=flags [8]=req_body_total [12]=resp_body_total
+             [16]=ids_len [20..]=ids bytes
   0x10000  : desensitized-body output buffer (24 KB)
   0x16000  : JSON container stack (64 B)
   0x16100..0x40000 : bump arena for proxy_on_memory_allocate (wraps;
@@ -68,7 +69,19 @@ OUT_SIZE = 0x804
 CTX_TABLE = 0x8000
 CTX_SLOTS = 128
 CTX_SLOT_SIZE = 256
-IDS_CAP = CTX_SLOT_SIZE - 12
+# slot field offsets: body totals accumulate across chunked deliveries
+# (proxy-wasm delivers bodies in multiple on_*_body calls; the reference
+# filter sums bodySize and reads [0, total] at end_of_stream,
+# envoy/wasm/main.go:94-117)
+SLOT_FLAGS = 4
+SLOT_REQ_TOTAL = 8
+SLOT_RESP_TOTAL = 12
+SLOT_IDS_LEN = 16
+SLOT_IDS = 20
+IDS_CAP = CTX_SLOT_SIZE - SLOT_IDS
+# proxy-wasm action codes returned by body callbacks
+ACTION_CONTINUE = 0
+ACTION_PAUSE = 1
 BODY_BUF = 0x10000
 BODY_CAP = 0x6000  # 24 KB transformed-body budget
 STACK_BASE = 0x16000
@@ -168,7 +181,7 @@ def build() -> bytes:
     BUILDIDS = m.declare_func("build_ids", [I32], [])
     EMITREQ = m.declare_func("emit_req", [I32, I32, I32], [])
     EMITRESP = m.declare_func("emit_resp", [I32, I32, I32], [])
-    ONBODY = m.declare_func("on_body", [I32, I32, I32, I32], [])
+    ONBODY = m.declare_func("on_body", [I32, I32, I32, I32], [I32])
     m.declare_func("proxy_on_memory_allocate", [I32], [I32])
     m.declare_func("proxy_on_request_headers", [I32, I32, I32], [I32])
     m.declare_func("proxy_on_response_headers", [I32, I32, I32], [I32])
@@ -322,8 +335,10 @@ def build() -> bytes:
     a.local_get(6).local_set(4)
     a.end()
     a.local_get(4).local_get(0).i32_store()
-    a.local_get(4).i32_const(0).i32_store(4)
-    a.local_get(4).i32_const(0).i32_store(8)
+    a.local_get(4).i32_const(0).i32_store(SLOT_FLAGS)
+    a.local_get(4).i32_const(0).i32_store(SLOT_REQ_TOTAL)
+    a.local_get(4).i32_const(0).i32_store(SLOT_RESP_TOTAL)
+    a.local_get(4).i32_const(0).i32_store(SLOT_IDS_LEN)
     a.local_get(4).return_()
     a.end()
     a.end()
@@ -336,8 +351,10 @@ def build() -> bytes:
     a.local_get(1).if_()
     a.local_get(6).if_()
     a.local_get(6).local_get(0).i32_store()
-    a.local_get(6).i32_const(0).i32_store(4)
-    a.local_get(6).i32_const(0).i32_store(8)
+    a.local_get(6).i32_const(0).i32_store(SLOT_FLAGS)
+    a.local_get(6).i32_const(0).i32_store(SLOT_REQ_TOTAL)
+    a.local_get(6).i32_const(0).i32_store(SLOT_RESP_TOTAL)
+    a.local_get(6).i32_const(0).i32_store(SLOT_IDS_LEN)
     a.local_get(6).return_()
     a.end()
     a.end()
@@ -702,8 +719,8 @@ def build() -> bytes:
     a.local_get(1).i32_const(IDS_CAP).i32_gt_u().if_()
     a.i32_const(IDS_CAP).local_set(1)
     a.end()
-    a.local_get(2).local_get(1).i32_store(8)
-    a.local_get(2).i32_const(12).i32_add()
+    a.local_get(2).local_get(1).i32_store(SLOT_IDS_LEN)
+    a.local_get(2).i32_const(SLOT_IDS).i32_add()
     a.i32_const(LINE_BUF).local_get(1).call(MEMCPY)
     a.end()
     m.define_func("build_ids", 2, a)
@@ -715,12 +732,12 @@ def build() -> bytes:
     append_lit(a, "[Request ")
     a.local_get(0).i32_const(0).call(SLOT).local_set(3)
     a.local_get(3).if_(I32)
-    a.local_get(3).i32_load(8).i32_const(0).i32_gt_u()
+    a.local_get(3).i32_load(SLOT_IDS_LEN).i32_const(0).i32_gt_u()
     a.else_()
     a.i32_const(0)
     a.end()
     a.if_()
-    a.local_get(3).i32_const(12).i32_add().local_get(3).i32_load(8).call(APPEND)
+    a.local_get(3).i32_const(SLOT_IDS).i32_add().local_get(3).i32_load(SLOT_IDS_LEN).call(APPEND)
     a.else_()
     append_ids_from_headers(a)
     a.end()
@@ -760,12 +777,12 @@ def build() -> bytes:
     append_lit(a, "[Response ")
     a.local_get(0).i32_const(0).call(SLOT).local_set(3)
     a.local_get(3).if_(I32)
-    a.local_get(3).i32_load(8).i32_const(0).i32_gt_u()
+    a.local_get(3).i32_load(SLOT_IDS_LEN).i32_const(0).i32_gt_u()
     a.else_()
     a.i32_const(0)
     a.end()
     a.if_()
-    a.local_get(3).i32_const(12).i32_add().local_get(3).i32_load(8).call(APPEND)
+    a.local_get(3).i32_const(SLOT_IDS).i32_add().local_get(3).i32_load(SLOT_IDS_LEN).call(APPEND)
     a.else_()
     # no stored ids (no slot, or a JSON request whose line is still
     # pending): rebuild from the request header map, which proxy-wasm
@@ -795,18 +812,32 @@ def build() -> bytes:
     a.end()
     m.define_func("emit_resp", 1, a)
 
-    # -- on_body(ctx, size, eos, is_response) ---------------------------------
-    # shared body-callback logic: on stream end, read the buffered body,
-    # desensitize, and emit the pending line (with the body block when the
-    # transform succeeded, without it otherwise)
+    # -- on_body(ctx, size, eos, is_response) -> action -----------------------
+    # shared body-callback logic, mirroring the reference filter
+    # (envoy/wasm/main.go:94-143): accumulate this delivery's size into
+    # the slot, return Pause until end_of_stream so the host buffers the
+    # whole body, then read the full buffered range [0, total],
+    # desensitize, and emit the pending line (with the body block when
+    # the transform succeeded, without it otherwise)
     a = Asm()
-    # locals: 4=slot_addr, 5=flags, 6=src, 7=ok
-    a.local_get(2).i32_eqz().if_()
-    a.return_()  # wait for end_of_stream
-    a.end()
+    # locals: 4=slot_addr, 5=flags, 6=src, 7=ok, 8=total_addr
     a.local_get(0).i32_const(0).call(SLOT).local_set(4)
     a.local_get(4).i32_eqz().if_()
-    a.return_()
+    # no context (no request headers seen): never pause such a stream
+    a.i32_const(ACTION_CONTINUE).return_()
+    a.end()
+    # total += this delivery's size (field picked by direction)
+    a.local_get(4)
+    a.local_get(3).if_(I32)
+    a.i32_const(SLOT_RESP_TOTAL)
+    a.else_()
+    a.i32_const(SLOT_REQ_TOTAL)
+    a.end()
+    a.i32_add().local_set(8)
+    a.local_get(8).local_get(8).i32_load().local_get(1).i32_add().i32_store()
+    a.local_get(2).i32_eqz().if_()
+    # wait until the entire body is buffered (main.go:101-104)
+    a.i32_const(ACTION_PAUSE).return_()
     a.end()
     a.local_get(4).i32_load(4).local_set(5)
     # pending/logged bit pair for this direction
@@ -816,7 +847,7 @@ def build() -> bytes:
     a.i32_const(F_REQ_PENDING)
     a.end()
     a.local_get(5).i32_and().i32_eqz().if_()
-    a.return_()  # no JSON body expected
+    a.i32_const(ACTION_CONTINUE).return_()  # no JSON body expected
     a.end()
     a.local_get(3).if_(I32)
     a.i32_const(F_RESP_LOGGED)
@@ -824,9 +855,9 @@ def build() -> bytes:
     a.i32_const(F_REQ_LOGGED)
     a.end()
     a.local_get(5).i32_and().if_()
-    a.return_()  # already logged
+    a.i32_const(ACTION_CONTINUE).return_()  # already logged
     a.end()
-    # fetch the buffered body
+    # fetch the WHOLE buffered body [0, total]
     a.i32_const(OUT_PTR).i32_const(0).i32_store()
     a.i32_const(OUT_SIZE).i32_const(0).i32_store()
     a.local_get(3).if_(I32)
@@ -834,7 +865,7 @@ def build() -> bytes:
     a.else_()
     a.i32_const(BUF_REQUEST_BODY)
     a.end()
-    a.i32_const(0).local_get(1)
+    a.i32_const(0).local_get(8).i32_load()
     a.i32_const(OUT_PTR).i32_const(OUT_SIZE).call(GETBUF)
     a.if_(I32)
     a.i32_const(0)
@@ -861,7 +892,8 @@ def build() -> bytes:
     a.local_get(0).i32_const(0).i32_const(0).call(EMITREQ)
     a.end()
     a.end()
-    m.define_func("on_body", 4, a)
+    a.i32_const(ACTION_CONTINUE)
+    m.define_func("on_body", 5, a)
 
     # -- ABI surface ----------------------------------------------------------
     a = Asm()
@@ -930,12 +962,10 @@ def build() -> bytes:
 
     a = Asm()
     a.local_get(0).local_get(1).local_get(2).i32_const(0).call(ONBODY)
-    a.i32_const(0)
     m.define_func("proxy_on_request_body", 0, a)
 
     a = Asm()
     a.local_get(0).local_get(1).local_get(2).i32_const(1).call(ONBODY)
-    a.i32_const(0)
     m.define_func("proxy_on_response_body", 0, a)
 
     m.define_func("proxy_on_context_create", 0, Asm())

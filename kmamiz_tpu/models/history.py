@@ -1,0 +1,165 @@
+"""Identity-free history features: the inductive replacement for learned
+node embeddings (VERDICT r3 #4).
+
+Node-identity embeddings lift GraphSAGE past the persistence skyline on
+the 1k-endpoint benchmark (MODELS.md), but they are transductive: an
+embedding memorizes "THIS endpoint errors nightly at 05:00", which
+cannot transfer to an endpoint unseen in training. The same signal is
+available inductively — from each node's OWN observable past rather than
+its identity:
+
+- **same-hour history**: mean past anomaly label and mean past 5xx share
+  at the predicted slot's hour-of-day over prior days, plus a log count
+  of observations (so the model can discount thin profiles). A fresh
+  endpoint starts at zero and grows its own profile as it runs — no
+  retraining needed.
+- **temporal deltas**: slot-over-slot change of 5xx share and latency —
+  trend signal persistence cannot represent.
+- **short rolling mean**: 3-slot mean 5xx share, smoothing single-slot
+  noise.
+- **degree features**: log in/out degree from the dependency graph —
+  structural position, available for brand-new endpoints immediately.
+
+Everything is CAUSAL: the features for slot t use only data observable
+by the end of slot t (a past slot's anomaly label concerns slot t'+1 and
+is therefore usable from slot t'+1 onward). `augment_with_history` runs
+BEFORE any split so evaluation slots carry their production-realistic
+history, and before `mask_endpoints` so held-out endpoints' features
+exist (a live mesh computes these from traffic, not labels' train/test
+status).
+
+Feature-column contract: input column 2 = current 5xx share, column 3 =
+current log-latency (graphsage.assemble_features); the augmented layout
+appends NUM_HISTORY_FEATURES columns after the base ones.
+"""
+from __future__ import annotations
+
+from typing import List
+
+import jax.numpy as jnp
+import numpy as np
+
+from kmamiz_tpu.models.trainer import GraphDataset, parse_slot_key
+
+NUM_HISTORY_FEATURES = 8
+
+#: base-feature columns the history builder reads
+_COL_ERR5 = 2
+_COL_LOG_LATENCY = 3
+
+
+def augment_with_history(dataset: GraphDataset) -> GraphDataset:
+    """New GraphDataset whose per-slot features carry
+    NUM_HISTORY_FEATURES extra columns (same graph/targets/masks)."""
+    n = dataset.num_nodes
+    slots = len(dataset.features)
+
+    src = np.asarray(dataset.src)
+    dst = np.asarray(dataset.dst)
+    emask = np.asarray(dataset.edge_mask).astype(bool)
+    deg_out = np.zeros(n, dtype=np.float32)
+    deg_in = np.zeros(n, dtype=np.float32)
+    np.add.at(deg_out, src[emask], 1.0)
+    np.add.at(deg_in, dst[emask], 1.0)
+    deg_out = np.log1p(deg_out)
+    deg_in = np.log1p(deg_in)
+
+    # predicted-slot hour per example (the slot key stored is the CURRENT
+    # slot; the target is the next one)
+    hours = [
+        (parse_slot_key(key)[1] + 1) % 24 for key in dataset.slot_keys
+    ]
+
+    # per-hour causal accumulators over nodes
+    label_sum = np.zeros((24, n), dtype=np.float64)
+    err_sum = np.zeros((24, n), dtype=np.float64)
+    obs = np.zeros((24, n), dtype=np.float64)
+
+    feats_np = [np.asarray(f) for f in dataset.features]
+    out_features: List[jnp.ndarray] = []
+    prev_err5 = np.zeros(n, dtype=np.float32)
+    prev_lat = np.zeros(n, dtype=np.float32)
+    err5_window: List[np.ndarray] = []
+
+    for t in range(slots):
+        base = feats_np[t]
+        err5 = base[:, _COL_ERR5].astype(np.float32)
+        lat = base[:, _COL_LOG_LATENCY].astype(np.float32)
+        h = hours[t]
+
+        err5_window.append(err5)
+        if len(err5_window) > 3:
+            err5_window.pop(0)
+
+        hist_n = obs[h]
+        safe = np.maximum(hist_n, 1.0)
+        cols = np.stack(
+            [
+                (label_sum[h] / safe).astype(np.float32),  # past label rate
+                (err_sum[h] / safe).astype(np.float32),  # past 5xx share
+                np.log1p(hist_n).astype(np.float32),  # profile depth
+                err5 - prev_err5,  # delta 5xx
+                lat - prev_lat,  # delta latency
+                np.mean(err5_window, axis=0).astype(np.float32),  # roll-3
+                deg_in,
+                deg_out,
+            ],
+            axis=1,
+        )
+        out_features.append(
+            jnp.asarray(np.concatenate([base, cols], axis=1), jnp.float32)
+        )
+
+        # fold THIS example's outcome into the accumulators for later
+        # slots only (the label for slot t is observable at slot t+1)
+        label = np.asarray(dataset.target_anomaly[t], dtype=np.float64)
+        active = np.asarray(dataset.node_mask[t], dtype=np.float64)
+        label_sum[h] += label * active
+        err_sum[h] += err5.astype(np.float64) * active
+        obs[h] += active
+        prev_err5, prev_lat = err5, lat
+
+    return GraphDataset(
+        endpoint_names=dataset.endpoint_names,
+        src=dataset.src,
+        dst=dataset.dst,
+        edge_mask=dataset.edge_mask,
+        features=out_features,
+        target_latency=list(dataset.target_latency),
+        target_anomaly=list(dataset.target_anomaly),
+        node_mask=list(dataset.node_mask),
+        slot_keys=list(dataset.slot_keys),
+    )
+
+
+def mask_endpoints(dataset: GraphDataset, keep: np.ndarray) -> GraphDataset:
+    """View whose per-slot node_mask is restricted to `keep` (bool [N]).
+
+    The graph and features are untouched — masked-out endpoints still
+    pass messages as neighbors — but losses, threshold calibration, and
+    every metric only see kept endpoints. Holding out 20% of ENDPOINTS
+    at train time is `mask_endpoints(train_set, ~held)`; evaluating on
+    them is `mask_endpoints(eval_set, held)`."""
+    keep_j = jnp.asarray(np.asarray(keep).astype(bool))
+    return GraphDataset(
+        endpoint_names=dataset.endpoint_names,
+        src=dataset.src,
+        dst=dataset.dst,
+        edge_mask=dataset.edge_mask,
+        features=list(dataset.features),
+        target_latency=list(dataset.target_latency),
+        target_anomaly=list(dataset.target_anomaly),
+        node_mask=[m & keep_j for m in dataset.node_mask],
+        slot_keys=list(dataset.slot_keys),
+    )
+
+
+def split_endpoints(
+    n: int, held_fraction: float = 0.2, seed: int = 0
+) -> np.ndarray:
+    """bool [n]: True = HELD-OUT endpoint (labels unseen in training)."""
+    rng = np.random.default_rng(seed)
+    held = np.zeros(n, dtype=bool)
+    k = max(1, int(round(n * held_fraction)))
+    held[rng.choice(n, size=k, replace=False)] = True
+    return held

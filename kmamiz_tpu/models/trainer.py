@@ -200,8 +200,19 @@ def train(
     num_nodes = (
         dataset.num_nodes if (use_node_embeddings and dataset is not None) else 0
     )
+    # feature width comes from the data: history-augmented datasets
+    # (models/history.py) carry extra identity-free columns beyond the
+    # base assemble_features layout
+    num_features = (
+        int(dataset.features[0].shape[1])
+        if dataset is not None and dataset.features
+        else model.NUM_FEATURES
+    )
     params = model.init_params(
-        jax.random.PRNGKey(seed), hidden=hidden, num_nodes=num_nodes
+        jax.random.PRNGKey(seed),
+        hidden=hidden,
+        num_features=num_features,
+        num_nodes=num_nodes,
     )
     optimizer = model.make_optimizer(lr)
     opt_state = optimizer.init(params)
@@ -234,7 +245,7 @@ def train(
                 ("lr", lr),
                 ("seed", seed),
                 ("model", model_name),
-                ("num_features", model.NUM_FEATURES),
+                ("num_features", num_features),
                 ("num_nodes", num_nodes),
             ):
                 saved = meta.get(name)
@@ -304,7 +315,7 @@ def train(
                     "lr": lr,
                     "seed": seed,
                     "model": model.__name__.rsplit(".", 1)[-1],
-                    "num_features": model.NUM_FEATURES,
+                    "num_features": num_features,
                     "num_nodes": num_nodes,
                 },
             )

@@ -103,3 +103,25 @@ class TestEndToEndTiny:
         if truths.any() and not truths.all():
             auc = eml.roc_auc(scores, truths)
             assert 0.0 <= auc <= 1.0
+
+    def test_inductive_pipeline_smoke(self, capsys):
+        """The --inductive protocol end to end at tiny scale: held-out
+        endpoints never train, history features augment, the table
+        prints with the skyline row computed on the same holdout."""
+        import argparse
+
+        from kmamiz_tpu.simulator.simulator import Simulator
+
+        rng = np.random.default_rng(0)
+        cfg = eml.make_mesh_config(6, 3, 2, rng)
+        result = Simulator().generate_simulation_data(
+            cfg, 0.0, rng=np.random.default_rng(0)
+        )
+        assert result.validation_error_message == ""
+        args = argparse.Namespace(epochs=3, hidden=8, seed=0)
+        eml.inductive_eval(args, result)
+        out = capsys.readouterr().out
+        assert "INDUCTIVE protocol" in out
+        assert "with history features" in out
+        assert "ablation: base features" in out
+        assert "persistence skyline (held-out endpoints)" in out

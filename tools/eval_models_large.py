@@ -271,6 +271,177 @@ def onset_recall(scores, truths, onsets, threshold) -> float:
     return float(((scores > threshold) & onsets).sum() / n_onset)
 
 
+def _persistence_rows(eval_set):
+    """(scores, truths, onsets) for the threshold-free persistence
+    skyline over an eval set (node_mask-respecting)."""
+    from kmamiz_tpu.models.trainer import ANOMALY_ERROR_SHARE
+
+    p_scores, p_truths, p_onsets = [], [], []
+    for i in range(len(eval_set.features)):
+        mask = np.asarray(eval_set.node_mask[i]).astype(bool)
+        feats = np.asarray(eval_set.features[i])
+        truth = np.asarray(eval_set.target_anomaly[i]).astype(bool)
+        current_bad = feats[:, 2] > ANOMALY_ERROR_SHARE
+        p_scores.append(feats[:, 2][mask])
+        p_truths.append(truth[mask])
+        p_onsets.append((truth & ~current_bad)[mask])
+    return (
+        np.concatenate(p_scores),
+        np.concatenate(p_truths),
+        np.concatenate(p_onsets),
+    )
+
+
+def _hybrid_row(name, metrics, scores, truths, onsets, currents, threshold,
+                train_s):
+    """persistence ("already broken") UNION the head's forecast ("about
+    to break") — the operational pager policy; it can only add the
+    model's true onsets (plus its false alarms) on top of the skyline."""
+    from kmamiz_tpu.models import trainer
+
+    hybrid = (scores > threshold) | currents
+    tp = int((hybrid & truths).sum())
+    fp = int((hybrid & ~truths).sum())
+    fn = int((~hybrid & truths).sum())
+    hp = tp / max(tp + fp, 1)
+    hr = tp / max(tp + fn, 1)
+    hybrid_metrics = trainer.EvalResult(
+        latency_mse=metrics.latency_mse,
+        anomaly_accuracy=0.0,
+        anomaly_precision=hp,
+        anomaly_recall=hr,
+        anomaly_base_rate=metrics.anomaly_base_rate,
+        per_slot_flagged={},
+        anomaly_f1=2 * hp * hr / (hp + hr) if hp + hr else 0.0,
+        latency_mae_ms=metrics.latency_mae_ms,
+    )
+    return (
+        f"{name} + persistence (hybrid)",
+        hybrid_metrics,
+        float("nan"),
+        float("nan"),
+        onset_recall(scores, truths, onsets, threshold),
+        train_s,
+    )
+
+
+def _print_rows(rows) -> None:
+    print(
+        "| model | precision | recall | F1 | ROC-AUC | PR-AUC | "
+        "onset recall | latency MAE (ms) | train wall (s) |"
+    )
+    print("|---|---|---|---|---|---|---|---|---|")
+    for name, m, auc, ap, onset, wall in rows:
+        print(
+            f"| {name} | {m.anomaly_precision:.3f} | {m.anomaly_recall:.3f} "
+            f"| {m.anomaly_f1:.3f} | {auc:.3f} | {ap:.3f} | {onset:.3f} "
+            f"| {m.latency_mae_ms:.2f} | {wall:.0f} |"
+        )
+
+
+def inductive_eval(args, result) -> None:
+    """Held-out-ENDPOINT evaluation (VERDICT r3 #4): 20% of endpoints
+    never contribute a training loss or calibration label; anomaly
+    metrics are computed on exactly those endpoints over the held-out
+    slots. No node-identity embeddings — the identity signal is replaced
+    by causal history features (models/history.py), which transfer to
+    endpoints the model never saw."""
+    from kmamiz_tpu.models import graphsage, history, trainer
+    from kmamiz_tpu.models.trainer import ANOMALY_ERROR_SHARE
+
+    dataset = trainer.dataset_from_simulation(
+        result.endpoint_dependencies,
+        result.realtime_data_per_slot,
+        result.replica_counts,
+    )
+    held = history.split_endpoints(dataset.num_nodes, 0.2, seed=args.seed)
+    print(
+        f"\n== INDUCTIVE protocol: {int(held.sum())}/{dataset.num_nodes} "
+        "endpoints held out of training losses AND threshold calibration; "
+        "metrics below are on those endpoints only, held-out slots only =="
+    )
+
+    rows = []
+    eval_held = None  # the history iteration's holdout, reused below
+    for label, use_history in (("with history features", True),
+                               ("ablation: base features", False)):
+        ds = history.augment_with_history(dataset) if use_history else dataset
+        train_set, eval_set = trainer.temporal_split(ds, TRAIN_FRACTION)
+        train_seen = history.mask_endpoints(train_set, ~held)
+        it_eval_held = history.mask_endpoints(eval_set, held)
+
+        t1 = time.perf_counter()
+        res = trainer.train(
+            train_seen,
+            epochs=args.epochs,
+            hidden=args.hidden,
+            seed=args.seed,
+            model=graphsage,
+            use_node_embeddings=False,
+        )
+        train_s = time.perf_counter() - t1
+        threshold = trainer.calibrate_threshold(
+            res.params, train_seen, model=graphsage
+        )
+        metrics = trainer.evaluate(
+            res.params, it_eval_held, threshold=threshold, model=graphsage
+        )
+        scores, truths, onsets, currents = collect_scores(
+            res.params, it_eval_held, graphsage
+        )
+        rows.append(
+            (
+                f"GraphSAGE ({label})",
+                metrics,
+                roc_auc(scores, truths),
+                pr_auc(scores, truths),
+                onset_recall(scores, truths, onsets, threshold),
+                train_s,
+            )
+        )
+        if use_history:
+            eval_held = it_eval_held
+            rows.append(
+                _hybrid_row(
+                    "GraphSAGE", metrics, scores, truths, onsets,
+                    currents, threshold, train_s,
+                )
+            )
+
+    # the skyline on the SAME held-out endpoints/slots (the skyline only
+    # reads base feature columns, which augmentation leaves in place)
+    p_scores, p_truths, p_onsets = _persistence_rows(eval_held)
+    persist = trainer.evaluate_baseline(eval_held)
+    rows.append(
+        (
+            "persistence skyline (held-out endpoints)",
+            persist,
+            roc_auc(p_scores, p_truths),
+            pr_auc(p_scores, p_truths),
+            onset_recall(p_scores, p_truths, p_onsets, ANOMALY_ERROR_SHARE),
+            0.0,
+        )
+    )
+    base_rate = rows[0][1].anomaly_base_rate
+    rows.append(
+        (
+            "naive: random @ base rate",
+            trainer.evaluate_naive(eval_held, rate=base_rate, seed=args.seed),
+            0.5,
+            float(p_truths.mean()),
+            float(base_rate),
+            0.0,
+        )
+    )
+    print(
+        f"\nheld-out slots: {len(eval_set.features)}, held-out endpoints: "
+        f"{int(held.sum())}, anomaly base rate {base_rate:.3f}, onset "
+        f"samples {int(p_onsets.sum())}, epochs {args.epochs}, "
+        f"seed {args.seed}\n"
+    )
+    _print_rows(rows)
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--services", type=int, default=100)
@@ -283,6 +454,12 @@ def main() -> None:
         "--embeddings",
         action="store_true",
         help="learned per-node identity embeddings (MODELS.md future work)",
+    )
+    parser.add_argument(
+        "--inductive",
+        action="store_true",
+        help="hold out 20%% of ENDPOINTS from training + calibration and "
+        "score only them (history features, no identity embeddings)",
     )
     parser.add_argument(
         "--tenk",
@@ -310,6 +487,10 @@ def main() -> None:
         f"mesh: {args.services} services / {n_eps} endpoints / "
         f"{args.days} days -> simulated in {sim_s:.1f}s"
     )
+
+    if args.inductive:
+        inductive_eval(args, result)
+        return
 
     rows = []
     shared_dataset = None
@@ -346,34 +527,10 @@ def main() -> None:
                 train_s,
             )
         )
-        # hybrid detector: persistence ("already broken") UNION the head's
-        # forecast ("about to break") — the operational pager policy; it
-        # can only add the model's true onsets (plus its false alarms) on
-        # top of the skyline
-        hybrid = (scores > metrics.threshold) | currents
-        tp = int((hybrid & truths).sum())
-        fp = int((hybrid & ~truths).sum())
-        fn = int((~hybrid & truths).sum())
-        hp = tp / max(tp + fp, 1)
-        hr = tp / max(tp + fn, 1)
-        hybrid_metrics = trainer.EvalResult(
-            latency_mse=metrics.latency_mse,
-            anomaly_accuracy=0.0,
-            anomaly_precision=hp,
-            anomaly_recall=hr,
-            anomaly_base_rate=metrics.anomaly_base_rate,
-            per_slot_flagged={},
-            anomaly_f1=2 * hp * hr / (hp + hr) if hp + hr else 0.0,
-            latency_mae_ms=metrics.latency_mae_ms,
-        )
         rows.append(
-            (
-                f"{name} + persistence (hybrid)",
-                hybrid_metrics,
-                float("nan"),
-                float("nan"),
-                onset_recall(scores, truths, onsets, metrics.threshold),
-                train_s,
+            _hybrid_row(
+                name, metrics, scores, truths, onsets, currents,
+                metrics.threshold, train_s,
             )
         )
 
@@ -381,20 +538,9 @@ def main() -> None:
     base_rate = rows[0][1].anomaly_base_rate
     # persistence scores: current 5xx share as the ranking score — the
     # fair threshold-free form of the skyline
-    p_scores, p_truths, p_onsets = [], [], []
     from kmamiz_tpu.models.trainer import ANOMALY_ERROR_SHARE
 
-    for i in range(len(eval_set.features)):
-        mask = np.asarray(eval_set.node_mask[i]).astype(bool)
-        feats = np.asarray(eval_set.features[i])
-        truth = np.asarray(eval_set.target_anomaly[i]).astype(bool)
-        current_bad = feats[:, 2] > ANOMALY_ERROR_SHARE
-        p_scores.append(feats[:, 2][mask])
-        p_truths.append(truth[mask])
-        p_onsets.append((truth & ~current_bad)[mask])
-    p_scores = np.concatenate(p_scores)
-    p_truths = np.concatenate(p_truths)
-    p_onsets = np.concatenate(p_onsets)
+    p_scores, p_truths, p_onsets = _persistence_rows(eval_set)
 
     persist = trainer.evaluate_baseline(eval_set)
     rows.append(
@@ -425,17 +571,7 @@ def main() -> None:
         f"{base_rate:.3f}, onset samples {n_onsets}, epochs {args.epochs}, "
         f"seed {args.seed}\n"
     )
-    print(
-        "| model | precision | recall | F1 | ROC-AUC | PR-AUC | "
-        "onset recall | latency MAE (ms) | train wall (s) |"
-    )
-    print("|---|---|---|---|---|---|---|---|---|")
-    for name, m, auc, ap, onset, wall in rows:
-        print(
-            f"| {name} | {m.anomaly_precision:.3f} | {m.anomaly_recall:.3f} "
-            f"| {m.anomaly_f1:.3f} | {auc:.3f} | {ap:.3f} | {onset:.3f} "
-            f"| {m.latency_mae_ms:.2f} | {wall:.0f} |"
-        )
+    _print_rows(rows)
 
     if args.tenk:
         t2 = time.perf_counter()

@@ -152,6 +152,26 @@ class EndpointGraph:
     """Capacity-padded edge set keyed (src_ep -> dst_ep, distance).
 
     Edge semantics: src depends-ON dst (src is the CLIENT-side ancestor).
+
+    Capacity policy (bench.py's graph_scale_* extras characterize it to
+    100k endpoints / ~5.2M edges): edge arrays are padded to
+    power-of-2 capacities and grow by doubling when a union's valid count
+    exceeds the current capacity (_apply_merged). Consequences:
+    - XLA program count is O(log(max_edges) * distinct window shapes):
+      each (window-bucket, store-capacity) pair compiles once, and
+      capacities only double, so a store that grows to E edges passes
+      through ~log2(E) capacities total — compiles amortize to zero on a
+      long-running server.
+    - Merge cost is O((cap + window) log(cap + window)) per union — the
+      sort dominates; per-doubling wall times are reported by the bench.
+    - Capacity never shrinks (the padded arrays are the high-water mark):
+      HBM for 2^23 edges is 3 int32 columns = ~100 MB, well inside a
+      single chip; shrink-on-idle is deliberately omitted to keep the
+      program-shape set stable.
+    - Measured on the dev TPU (2026-07-30), growth 1M -> 5.2M edges at
+      100k endpoints: warm unions 0.6-2.4 s per 1M-candidate window,
+      3 union programs total (each ~50-70 s to compile over the dev
+      tunnel, once); full scorer refresh at that scale ~2.3-2.5 s.
     """
 
     def __init__(
@@ -572,6 +592,57 @@ class EndpointGraph:
             jnp.asarray(ep_record),
             num_services=svc_cap,
         )
+
+    def merge_edges(self, src, dst, dist, valid=None) -> None:
+        """Bulk set-union of raw (src, dst, dist) edge arrays into the
+        store — the import/warm-start/bench path. Device-resident inputs
+        are welcome (no host round trip); the same fused union kernel and
+        deferred-count capacity policy as window merges apply."""
+        with self._lock:
+            self._version += 1
+            self._finalize_pending_locked()
+            src = jnp.asarray(src, dtype=jnp.int32)
+            dst = jnp.asarray(dst, dtype=jnp.int32)
+            dist = jnp.asarray(dist, dtype=jnp.int32)
+            mask = (
+                jnp.asarray(valid, dtype=bool)
+                if valid is not None
+                else src != SENTINEL
+            )
+            # pow2-pad the inputs so variable-length batches share union
+            # programs (same rationale as load_dependencies: each
+            # distinct shape is a ~minute-long compile on the tunnel)
+            cap = _pow2(max(int(src.shape[0]), 1))
+            if cap != int(src.shape[0]):
+                pad = jnp.full(cap - int(src.shape[0]), SENTINEL, jnp.int32)
+                src = jnp.concatenate([src, pad])
+                dst = jnp.concatenate([dst, pad])
+                dist = jnp.concatenate([dist, pad])
+                mask = jnp.concatenate(
+                    [mask, jnp.zeros(cap - int(mask.shape[0]), bool)]
+                )
+            # keep the packed-key drain gate honest: bulk edges carry
+            # caller-provided distances (ONE device fetch for both bounds)
+            masked_dist = jnp.where(mask, dist, 1)
+            lo, hi = np.asarray(
+                jnp.stack([jnp.min(masked_dist), jnp.max(masked_dist)])
+            )
+            self._max_dist = max(self._max_dist, int(hi))
+            self._min_dist = min(self._min_dist, int(lo))
+            s, d, ds, v = _merge_edges(
+                self._src,
+                self._dst,
+                self._dist,
+                self._src != SENTINEL,
+                src,
+                dst,
+                dist,
+                mask,
+            )
+            count = v.sum()
+            if hasattr(count, "copy_to_host_async"):
+                count.copy_to_host_async()
+            self._pending = (s, d, ds, count)
 
     # -- warm start from the persisted dependency cache ----------------------
 

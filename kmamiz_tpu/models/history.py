@@ -64,16 +64,21 @@ def augment_with_history(dataset: GraphDataset) -> GraphDataset:
     deg_out = np.log1p(deg_out)
     deg_in = np.log1p(deg_in)
 
-    # predicted-slot hour per example (the slot key stored is the CURRENT
-    # slot; the target is the next one)
-    hours = [
-        (parse_slot_key(key)[1] + 1) % 24 for key in dataset.slot_keys
-    ]
+    # hours per example: the slot key stored is the CURRENT slot; the
+    # target (and the label) concern the NEXT one. Label history is keyed
+    # by the predicted hour; observed 5xx shares are keyed by the hour
+    # they were OBSERVED in, so a slot predicting hour h reads 5xx
+    # traffic actually seen at hour h on prior days.
+    hours_cur = [parse_slot_key(key)[1] % 24 for key in dataset.slot_keys]
+    hours_pred = [(h + 1) % 24 for h in hours_cur]
 
-    # per-hour causal accumulators over nodes
+    # per-hour causal accumulators over nodes (separate observation
+    # counts: labels key by predicted hour, observed 5xx shares by the
+    # hour they occurred in)
     label_sum = np.zeros((24, n), dtype=np.float64)
+    label_obs = np.zeros((24, n), dtype=np.float64)
     err_sum = np.zeros((24, n), dtype=np.float64)
-    obs = np.zeros((24, n), dtype=np.float64)
+    err_obs = np.zeros((24, n), dtype=np.float64)
 
     feats_np = [np.asarray(f) for f in dataset.features]
     out_features: List[jnp.ndarray] = []
@@ -85,18 +90,21 @@ def augment_with_history(dataset: GraphDataset) -> GraphDataset:
         base = feats_np[t]
         err5 = base[:, _COL_ERR5].astype(np.float32)
         lat = base[:, _COL_LOG_LATENCY].astype(np.float32)
-        h = hours[t]
+        h = hours_pred[t]
 
         err5_window.append(err5)
         if len(err5_window) > 3:
             err5_window.pop(0)
 
-        hist_n = obs[h]
-        safe = np.maximum(hist_n, 1.0)
+        hist_n = label_obs[h]
         cols = np.stack(
             [
-                (label_sum[h] / safe).astype(np.float32),  # past label rate
-                (err_sum[h] / safe).astype(np.float32),  # past 5xx share
+                (label_sum[h] / np.maximum(hist_n, 1.0)).astype(
+                    np.float32
+                ),  # past label rate @ predicted hour
+                (err_sum[h] / np.maximum(err_obs[h], 1.0)).astype(
+                    np.float32
+                ),  # past 5xx share OBSERVED at hour h
                 np.log1p(hist_n).astype(np.float32),  # profile depth
                 err5 - prev_err5,  # delta 5xx
                 lat - prev_lat,  # delta latency
@@ -111,12 +119,19 @@ def augment_with_history(dataset: GraphDataset) -> GraphDataset:
         )
 
         # fold THIS example's outcome into the accumulators for later
-        # slots only (the label for slot t is observable at slot t+1)
+        # slots only (the label for slot t is observable at slot t+1):
+        # the label under its PREDICTED hour, the observed 5xx share
+        # under the hour it was OBSERVED in
         label = np.asarray(dataset.target_anomaly[t], dtype=np.float64)
-        active = np.asarray(dataset.node_mask[t], dtype=np.float64)
-        label_sum[h] += label * active
-        err_sum[h] += err5.astype(np.float64) * active
-        obs[h] += active
+        # label validity follows the dataset's node_mask (active in the
+        # predicted slot); the 5xx observation follows CURRENT-slot
+        # activity (base feature column 7)
+        active_next = np.asarray(dataset.node_mask[t], dtype=np.float64)
+        active_cur = base[:, 7].astype(np.float64)
+        label_sum[h] += label * active_next
+        label_obs[h] += active_next
+        err_sum[hours_cur[t]] += err5.astype(np.float64) * active_cur
+        err_obs[hours_cur[t]] += active_cur
         prev_err5, prev_lat = err5, lat
 
     return GraphDataset(

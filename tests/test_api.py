@@ -693,3 +693,80 @@ class TestDashboardContract:
 
         alerts = get(router, "/api/v1/alert/violation").payload
         assert isinstance(alerts, list)  # row fields pinned in TestAlertRoutes.test_violation_detection
+
+    def test_round4_sections_served(self, ctx):
+        import os
+
+        from kmamiz_tpu.api.app import build_router as _build
+
+        ctx.settings.static_dir = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "dist",
+        )
+        router = _build(ctx)
+        body = router.dispatch("GET", "/").raw_body.decode()
+        for el_id in (
+            "chord", "swagger-select", "swagger", "compare-select",
+            "compare", "compare-snap",
+        ):
+            assert f'id="{el_id}"' in body, el_id
+
+    def test_chord_shapes(self, router):
+        # renderChord reads nodes[].id and links[].{from,to,value}
+        for kind in ("direct", "indirect"):
+            chord = get(router, f"/api/v1/graph/chord/{kind}").payload
+            assert {"nodes", "links"} <= set(chord)
+            assert chord["nodes"], kind
+            assert {"id", "name"} <= set(chord["nodes"][0])
+            assert {"from", "to", "value"} <= set(chord["links"][0])
+        # indirect includes at least every direct link
+        direct = get(router, "/api/v1/graph/chord/direct").payload
+        indirect = get(router, "/api/v1/graph/chord/indirect").payload
+        d_pairs = {(l["from"], l["to"]) for l in direct["links"]}
+        i_pairs = {(l["from"], l["to"]) for l in indirect["links"]}
+        assert d_pairs <= i_pairs
+
+    def test_swagger_viewer_shapes(self, router):
+        # the viewer picks services from serviceDisplayInfo and fetches
+        # /swagger/:usn expecting an OpenAPI doc with paths/info
+        svc = get(router, "/api/v1/data/serviceDisplayInfo").payload
+        assert svc and svc[0]["uniqueServiceName"]
+        usn = svc[0]["uniqueServiceName"]
+        from urllib.parse import quote
+
+        doc = get(router, f"/api/v1/swagger/{quote(usn, safe='')}").payload
+        assert doc["openapi"].startswith("3.")
+        assert {"title", "version"} <= set(doc["info"])
+        assert doc["paths"]
+        path, methods = next(iter(doc["paths"].items()))
+        assert path.startswith("/")
+        method, op = next(iter(methods.items()))
+        assert "responses" in op
+        # the yaml link the viewer renders must also serve
+        y = get(router, f"/api/v1/swagger/yaml/{quote(usn, safe='')}")
+        assert y.status == 200
+
+    def test_comparator_diff_shapes(self, router):
+        # snapshot via POST, list via /tags, diff both tagged and live
+        assert router.dispatch(
+            "POST", "/api/v1/comparator/diffData",
+            body=json.dumps({"tag": "dash-test"}).encode(),
+        ).status == 200
+        tags = get(router, "/api/v1/comparator/tags").payload
+        assert any(t["tag"] == "dash-test" and "time" in t for t in tags)
+        for q in ("?tag=dash-test", ""):
+            diff = get(router, "/api/v1/comparator/diffData" + q).payload
+            assert {
+                "graphData", "cohesionData", "couplingData",
+                "instabilityData",
+            } <= set(diff)
+            assert {"nodes", "links"} <= set(diff["graphData"])
+            if diff["instabilityData"]:
+                row = diff["instabilityData"][0]
+                assert {"uniqueServiceName", "name", "instability"} <= set(row)
+            if diff["couplingData"]:
+                assert {"uniqueServiceName", "acs"} <= set(diff["couplingData"][0])
+            if diff["cohesionData"]:
+                assert {
+                    "uniqueServiceName", "totalInterfaceCohesion"
+                } <= set(diff["cohesionData"][0])

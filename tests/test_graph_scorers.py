@@ -321,3 +321,49 @@ def test_risk_scores_shape(pdas_traces):
     norm = np.asarray(risk.norm_risk)
     assert ((norm[active] >= 0.1 - 1e-6) & (norm[active] <= 1.0 + 1e-6)).all()
     assert (norm[~active] == 0).all()
+
+
+def test_merge_edges_bulk_union(pdas_traces):
+    # the bulk import/bench path: device arrays union through the same
+    # kernel + capacity policy as window merges, coexisting with staged
+    # stream merges
+    import jax
+
+    g = EndpointGraph(capacity=8)
+    src = jnp.asarray([1, 2, 3, 1], jnp.int32)
+    dst = jnp.asarray([4, 5, 6, 4], jnp.int32)
+    dist = jnp.asarray([1, 2, 1, 1], jnp.int32)
+    v0 = g.version
+    g.merge_edges(src, dst, dist)
+    assert g.n_edges == 3  # duplicate (1,4,1) collapsed
+    assert g.version > v0
+    # second union with overlap only adds the new edge
+    g.merge_edges(
+        jnp.asarray([1, 9], jnp.int32),
+        jnp.asarray([4, 9], jnp.int32),
+        jnp.asarray([1, 3], jnp.int32),
+    )
+    assert g.n_edges == 4
+    # interleave with a staged window merge: both survive
+    groups = pdas_traces if isinstance(pdas_traces[0], list) else [pdas_traces]
+    batch = spans_to_batch(groups, interner=g.interner)
+    g.merge_window(batch, stage=True)
+    only_window = EndpointGraph()
+    only_window.merge_window(
+        spans_to_batch(groups, interner=only_window.interner)
+    )
+    assert g.n_edges == 4 + only_window.n_edges
+    # capacity policy: pow2, never below the live edge count
+    assert g.capacity >= g.n_edges
+    assert g.capacity & (g.capacity - 1) == 0
+
+
+def test_merge_edges_respects_valid_mask():
+    g = EndpointGraph(capacity=8)
+    g.merge_edges(
+        jnp.asarray([1, 2], jnp.int32),
+        jnp.asarray([3, 4], jnp.int32),
+        jnp.asarray([1, 1], jnp.int32),
+        valid=jnp.asarray([True, False]),
+    )
+    assert g.n_edges == 1

@@ -464,6 +464,36 @@ class TestHistoryFeatures:
         seen = [float(np.asarray(aug.features[t])[back, col]) for t in day2]
         assert max(seen) > 0.5, seen  # day-1 history predicts day 2
 
+    def test_err_profile_keyed_by_observed_hour(self, dataset):
+        # regression (review finding): the 5xx-share profile column must
+        # carry traffic OBSERVED at the predicted hour on prior days —
+        # not the hour before it. back-get's 5xx spikes during hours 6-10
+        # (the fault window shifted by the next-slot labeling); a day-2
+        # slot predicting an in-window hour must see a positive profile.
+        from kmamiz_tpu.models import history
+
+        aug = history.augment_with_history(dataset)
+        base_w = np.asarray(dataset.features[0]).shape[1]
+        back = next(
+            i for i, n in enumerate(dataset.endpoint_names) if "back" in n
+        )
+        err_col = base_w + 1
+        # find a day-2 example whose PREDICTED hour saw high 5xx on day 1
+        bad_hours = {
+            (trainer.parse_slot_key(k)[1])
+            for t, k in enumerate(dataset.slot_keys)
+            if trainer.parse_slot_key(k)[0] == 0
+            and np.asarray(dataset.features[t])[back, 2] > 0.3
+        }
+        assert bad_hours, "day-1 must have observed 5xx slots"
+        hits = [
+            float(np.asarray(aug.features[t])[back, err_col])
+            for t, k in enumerate(dataset.slot_keys)
+            if trainer.parse_slot_key(k)[0] == 1
+            and (trainer.parse_slot_key(k)[1] + 1) % 24 in bad_hours
+        ]
+        assert hits and max(hits) > 0.3, hits
+
     def test_degree_columns_are_static_log_degrees(self, dataset):
         from kmamiz_tpu.models import history
 

@@ -363,6 +363,7 @@ def inductive_eval(args, result) -> None:
 
     rows = []
     eval_held = None  # the history iteration's holdout, reused below
+    held_slot_count = 0
     for label, use_history in (("with history features", True),
                                ("ablation: base features", False)):
         ds = history.augment_with_history(dataset) if use_history else dataset
@@ -401,6 +402,7 @@ def inductive_eval(args, result) -> None:
         )
         if use_history:
             eval_held = it_eval_held
+            held_slot_count = len(eval_set.features)
             rows.append(
                 _hybrid_row(
                     "GraphSAGE", metrics, scores, truths, onsets,
@@ -434,7 +436,7 @@ def inductive_eval(args, result) -> None:
         )
     )
     print(
-        f"\nheld-out slots: {len(eval_set.features)}, held-out endpoints: "
+        f"\nheld-out slots: {held_slot_count}, held-out endpoints: "
         f"{int(held.sum())}, anomaly base rate {base_rate:.3f}, onset "
         f"samples {int(p_onsets.sum())}, epochs {args.epochs}, "
         f"seed {args.seed}\n"

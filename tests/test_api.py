@@ -770,3 +770,60 @@ class TestDashboardContract:
                 assert {
                     "uniqueServiceName", "totalInterfaceCohesion"
                 } <= set(diff["cohesionData"][0])
+
+    def test_js_dom_ids_and_routes_are_consistent(self, router):
+        """Static cross-check of the dashboard's inline JS (no JS runtime
+        ships in this image): every DOM id the script references must
+        exist in the markup, and every API path it fetches must resolve
+        to a registered route of the right METHOD — a typo in either
+        renders a silently blank section in production."""
+        import re
+        from pathlib import Path
+
+        html = (
+            Path(__file__).resolve().parent.parent / "dist" / "index.html"
+        ).read_text(encoding="utf-8")
+        dom_ids = set(re.findall(r'id="([^"]+)"', html))
+        # $("x") and getElementById("x") references in the script
+        for ref in re.findall(r'\$\("([^"]+)"\)', html) + re.findall(
+            r'getElementById\("([^"]+)"\)', html
+        ):
+            assert ref in dom_ids, f"JS references missing DOM id {ref!r}"
+
+        def route_exists(path: str, method: str, dynamic_tail: bool) -> bool:
+            """A registered route of `method` serves `path`. A literal
+            path may only extend into OPTIONAL param segments (":x?");
+            a path built with a dynamic JS suffix ("+ usn") may extend
+            into required ones too."""
+            path = path.split("?", 1)[0].rstrip("/")
+            for r in router._routes:
+                if r.method != method.upper():
+                    continue
+                raw = r.raw_path.rstrip("/")
+                if raw == path:
+                    return True
+                if raw.startswith(path + "/"):
+                    tail = raw[len(path) + 1 :]
+                    segs = tail.split("/")
+                    if dynamic_tail and segs[0].startswith(":"):
+                        return True
+                    if all(
+                        s.startswith(":") and s.endswith("?") for s in segs
+                    ):
+                        return True
+            return False
+
+        # jget("...") GETs; a trailing '/' or a '+'-concatenation marks a
+        # dynamic suffix (ns / usn / tag appended at runtime)
+        for path, cont in re.findall(r'jget\("(/[^"]+)"( *\+)?', html):
+            dyn = bool(cont) or path.endswith("/")
+            assert route_exists("/api/v1" + path, "GET", dyn), path
+        # fetch(API + "...", {method: "POST"}) — method-aware
+        for path, opts in re.findall(
+            r'fetch\(API \+ "(/[^"]+)",\s*(\{[^}]*\})?', html
+        ):
+            method = "POST" if "POST" in (opts or "") else "GET"
+            assert route_exists("/api/v1" + path, method, False), (
+                method,
+                path,
+            )

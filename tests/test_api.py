@@ -784,10 +784,14 @@ class TestDashboardContract:
             Path(__file__).resolve().parent.parent / "dist" / "index.html"
         ).read_text(encoding="utf-8")
         dom_ids = set(re.findall(r'id="([^"]+)"', html))
-        # $("x") and getElementById("x") references in the script
-        for ref in re.findall(r'\$\("([^"]+)"\)', html) + re.findall(
-            r'getElementById\("([^"]+)"\)', html
-        ):
+        # $("x"), getElementById("x"), and querySelector[All]("#x ...")
+        # references in the script (the selector's leading #id must exist)
+        refs = (
+            re.findall(r'\$\("([^"]+)"\)', html)
+            + re.findall(r'getElementById\("([^"]+)"\)', html)
+            + re.findall(r'querySelector(?:All)?\("#([\w-]+)', html)
+        )
+        for ref in refs:
             assert ref in dom_ids, f"JS references missing DOM id {ref!r}"
 
         def route_exists(path: str, method: str, dynamic_tail: bool) -> bool:
@@ -818,12 +822,14 @@ class TestDashboardContract:
         for path, cont in re.findall(r'jget\("(/[^"]+)"( *\+)?', html):
             dyn = bool(cont) or path.endswith("/")
             assert route_exists("/api/v1" + path, "GET", dyn), path
-        # fetch(API + "...", {method: "POST"}) — method-aware
-        for path, opts in re.findall(
-            r'fetch\(API \+ "(/[^"]+)",\s*(\{[^}]*\})?', html
-        ):
-            method = "POST" if "POST" in (opts or "") else "GET"
-            assert route_exists("/api/v1" + path, method, False), (
+        # fetch(API + "...", {...}) — method-aware: scan a bounded window
+        # after each call site for a method: "X" literal (brace-nesting
+        # in the options object must not hide it)
+        for m in re.finditer(r'fetch\(API \+ "(/[^"]+)"', html):
+            window = html[m.end() : m.end() + 400]
+            method_m = re.search(r'method:\s*"([A-Z]+)"', window)
+            method = method_m.group(1) if method_m else "GET"
+            assert route_exists("/api/v1" + m.group(1), method, False), (
                 method,
-                path,
+                m.group(1),
             )

@@ -39,100 +39,51 @@ from typing import List
 import jax.numpy as jnp
 import numpy as np
 
-from kmamiz_tpu.models.trainer import GraphDataset, parse_slot_key
+from kmamiz_tpu.models.trainer import (
+    ANOMALY_ERROR_SHARE,
+    GraphDataset,
+    parse_slot_key,
+)
 
 NUM_HISTORY_FEATURES = 8
 
 #: base-feature columns the history builder reads
 _COL_ERR5 = 2
 _COL_LOG_LATENCY = 3
+_COL_ACTIVE = 7
 
 
 def augment_with_history(dataset: GraphDataset) -> GraphDataset:
     """New GraphDataset whose per-slot features carry
-    NUM_HISTORY_FEATURES extra columns (same graph/targets/masks)."""
-    n = dataset.num_nodes
-    slots = len(dataset.features)
+    NUM_HISTORY_FEATURES extra columns (same graph/targets/masks).
 
-    src = np.asarray(dataset.src)
-    dst = np.asarray(dataset.dst)
-    emask = np.asarray(dataset.edge_mask).astype(bool)
-    deg_out = np.zeros(n, dtype=np.float32)
-    deg_in = np.zeros(n, dtype=np.float32)
-    np.add.at(deg_out, src[emask], 1.0)
-    np.add.at(deg_in, dst[emask], 1.0)
-    deg_out = np.log1p(deg_out)
-    deg_in = np.log1p(deg_in)
-
-    # hours per example: the slot key stored is the CURRENT slot; the
-    # target (and the label) concern the NEXT one. Label history is keyed
-    # by the predicted hour; observed 5xx shares are keyed by the hour
-    # they were OBSERVED in, so a slot predicting hour h reads 5xx
-    # traffic actually seen at hour h on prior days.
-    hours_cur = [parse_slot_key(key)[1] % 24 for key in dataset.slot_keys]
-    hours_pred = [(h + 1) % 24 for h in hours_cur]
-
-    # per-hour causal accumulators over nodes (separate observation
-    # counts: labels key by predicted hour, observed 5xx shares by the
-    # hour they occurred in)
-    label_sum = np.zeros((24, n), dtype=np.float64)
-    label_obs = np.zeros((24, n), dtype=np.float64)
-    err_sum = np.zeros((24, n), dtype=np.float64)
-    err_obs = np.zeros((24, n), dtype=np.float64)
-
-    feats_np = [np.asarray(f) for f in dataset.features]
+    Implemented as a replay of the dataset's slots through the ONLINE
+    state (`HistoryState.step`) — one feature formula, used identically
+    at train and serve time, so skew is impossible by construction. The
+    column semantics: label history keys by the PREDICTED hour (the hour
+    an anomaly occurred in), observed 5xx shares key by the hour they
+    were OBSERVED in; both read causally (a slot's features never see
+    its own fold). The label a bucket carries is the retiring previous
+    example's target — in dataset terms, target_anomaly[t-1] equals
+    (bucket t's 5xx share > ANOMALY_ERROR_SHARE) weighted by
+    node_mask[t-1] == bucket t's activity column."""
+    state = HistoryState(dataset.num_nodes)
+    state.set_degrees(
+        dataset.src, dataset.dst, dataset.edge_mask, dataset.num_nodes
+    )
     out_features: List[jnp.ndarray] = []
-    prev_err5 = np.zeros(n, dtype=np.float32)
-    prev_lat = np.zeros(n, dtype=np.float32)
-    err5_window: List[np.ndarray] = []
-
-    for t in range(slots):
-        base = feats_np[t]
-        err5 = base[:, _COL_ERR5].astype(np.float32)
-        lat = base[:, _COL_LOG_LATENCY].astype(np.float32)
-        h = hours_pred[t]
-
-        err5_window.append(err5)
-        if len(err5_window) > 3:
-            err5_window.pop(0)
-
-        hist_n = label_obs[h]
-        cols = np.stack(
-            [
-                (label_sum[h] / np.maximum(hist_n, 1.0)).astype(
-                    np.float32
-                ),  # past label rate @ predicted hour
-                (err_sum[h] / np.maximum(err_obs[h], 1.0)).astype(
-                    np.float32
-                ),  # past 5xx share OBSERVED at hour h
-                np.log1p(hist_n).astype(np.float32),  # profile depth
-                err5 - prev_err5,  # delta 5xx
-                lat - prev_lat,  # delta latency
-                np.mean(err5_window, axis=0).astype(np.float32),  # roll-3
-                deg_in,
-                deg_out,
-            ],
-            axis=1,
+    for t in range(len(dataset.features)):
+        base = np.asarray(dataset.features[t])
+        hour = parse_slot_key(dataset.slot_keys[t])[1]
+        cols = state.step(
+            hour,
+            base[:, _COL_ERR5],
+            base[:, _COL_LOG_LATENCY],
+            base[:, _COL_ACTIVE],
         )
         out_features.append(
             jnp.asarray(np.concatenate([base, cols], axis=1), jnp.float32)
         )
-
-        # fold THIS example's outcome into the accumulators for later
-        # slots only (the label for slot t is observable at slot t+1):
-        # the label under its PREDICTED hour, the observed 5xx share
-        # under the hour it was OBSERVED in
-        label = np.asarray(dataset.target_anomaly[t], dtype=np.float64)
-        # label validity follows the dataset's node_mask (active in the
-        # predicted slot); the 5xx observation follows CURRENT-slot
-        # activity (base feature column 7)
-        active_next = np.asarray(dataset.node_mask[t], dtype=np.float64)
-        active_cur = base[:, 7].astype(np.float64)
-        label_sum[h] += label * active_next
-        label_obs[h] += active_next
-        err_sum[hours_cur[t]] += err5.astype(np.float64) * active_cur
-        err_obs[hours_cur[t]] += active_cur
-        prev_err5, prev_lat = err5, lat
 
     return GraphDataset(
         endpoint_names=dataset.endpoint_names,
@@ -178,3 +129,154 @@ def split_endpoints(
     k = max(1, int(round(n * held_fraction)))
     held[rng.choice(n, size=k, replace=False)] = True
     return held
+
+
+class HistoryState:
+    """SERVING-side rolling state for the history features: the online
+    twin of `augment_with_history`, fed one completed hourly bucket at a
+    time instead of a whole dataset. `step(hour, err5_share,
+    latency_log, active)` returns the NUM_HISTORY_FEATURES columns for
+    predicting hour+1 and folds the bucket into the accumulators —
+    replaying a training dataset's slots through step() reproduces the
+    trainer's feature columns exactly
+    (tests/test_trainer.py::TestHistoryState), so a model trained on
+    augmented datasets serves against this state with zero skew.
+
+    Endpoint capacity grows on demand (new endpoints join with empty
+    profiles, exactly the cold-start case the inductive evaluation
+    grades). Degree columns come from the live dependency graph via
+    `set_degrees`.
+    """
+
+    def __init__(self, num_endpoints: int = 0) -> None:
+        self._n = 0
+        self._label_sum = np.zeros((24, 0))
+        self._label_obs = np.zeros((24, 0))
+        self._err_sum = np.zeros((24, 0))
+        self._err_obs = np.zeros((24, 0))
+        self._prev_err5 = np.zeros(0, dtype=np.float32)
+        self._prev_lat = np.zeros(0, dtype=np.float32)
+        self._window: List[np.ndarray] = []
+        self._deg_in = np.zeros(0, dtype=np.float32)
+        self._deg_out = np.zeros(0, dtype=np.float32)
+        # no label fold on the very first bucket: its anomaly state is
+        # the label of an example that predates the stream (the trainer
+        # never folds it either — exact-replay equivalence depends on
+        # skipping it)
+        self._started = False
+        if num_endpoints:
+            self._grow(num_endpoints)
+
+    @property
+    def num_endpoints(self) -> int:
+        return self._n
+
+    def _grow(self, n: int) -> None:
+        if n <= self._n:
+            return
+        extra = n - self._n
+
+        def widen(a, fill=0.0):
+            pad_shape = a.shape[:-1] + (extra,)
+            return np.concatenate(
+                [a, np.full(pad_shape, fill, dtype=a.dtype)], axis=-1
+            )
+
+        self._label_sum = widen(self._label_sum)
+        self._label_obs = widen(self._label_obs)
+        self._err_sum = widen(self._err_sum)
+        self._err_obs = widen(self._err_obs)
+        self._prev_err5 = widen(self._prev_err5)
+        self._prev_lat = widen(self._prev_lat)
+        self._deg_in = widen(self._deg_in)
+        self._deg_out = widen(self._deg_out)
+        self._window = [widen(w) for w in self._window]
+        self._n = n
+
+    def set_degrees(self, src, dst, edge_mask, num_endpoints: int) -> None:
+        """Refresh the structural-position columns from the dependency
+        graph's edge arrays (EndpointGraph.edge_arrays)."""
+        self._grow(num_endpoints)
+        src = np.asarray(src)
+        dst = np.asarray(dst)
+        emask = np.asarray(edge_mask).astype(bool)
+        deg_out = np.zeros(self._n, dtype=np.float32)
+        deg_in = np.zeros(self._n, dtype=np.float32)
+        s, d = src[emask], dst[emask]
+        keep = (s >= 0) & (s < self._n) & (d >= 0) & (d < self._n)
+        np.add.at(deg_out, s[keep], 1.0)
+        np.add.at(deg_in, d[keep], 1.0)
+        self._deg_in = np.log1p(deg_in)
+        self._deg_out = np.log1p(deg_out)
+
+    def step(
+        self,
+        hour: int,
+        err5_share,
+        latency_log,
+        active,
+        anomaly_threshold: float = ANOMALY_ERROR_SHARE,
+    ) -> np.ndarray:
+        """One completed hourly bucket -> feature columns [N, 8] for
+        predicting hour+1, THEN fold the bucket (matching the trainer's
+        emit-before-fold order so profiles never include their own slot).
+
+        The anomaly label for the bucket (err5_share > threshold) keys
+        under `hour` — the hour the anomaly OCCURRED in, which is the
+        predicted hour of the example one slot earlier — mirroring
+        augment_with_history's keying exactly."""
+        err5 = np.asarray(err5_share, dtype=np.float32)
+        lat = np.asarray(latency_log, dtype=np.float32)
+        self._grow(len(err5))
+        n = self._n
+
+        def fit(a, fill=0.0):
+            out = np.full(n, fill, dtype=np.float32)
+            out[: len(a)] = a
+            return out
+
+        err5 = fit(err5)
+        lat = fit(lat)
+        act = fit(np.asarray(active, dtype=np.float32)).astype(np.float64)
+
+        hour = int(hour) % 24
+        h_pred = (hour + 1) % 24
+
+        # label fold FIRST: this bucket's anomaly state is the label of
+        # the example emitted one hour ago (keyed by occurrence hour) —
+        # in the trainer this fold happens when example t-1 retires
+        if self._started:
+            label = (err5 > anomaly_threshold).astype(np.float64)
+            self._label_sum[hour] += label * act
+            self._label_obs[hour] += act
+        self._started = True
+
+        self._window.append(err5)
+        if len(self._window) > 3:
+            self._window.pop(0)
+
+        hist_n = self._label_obs[h_pred]
+        cols = np.stack(
+            [
+                (self._label_sum[h_pred] / np.maximum(hist_n, 1.0)).astype(
+                    np.float32
+                ),
+                (
+                    self._err_sum[h_pred]
+                    / np.maximum(self._err_obs[h_pred], 1.0)
+                ).astype(np.float32),
+                np.log1p(hist_n).astype(np.float32),
+                err5 - self._prev_err5,
+                lat - self._prev_lat,
+                np.mean(self._window, axis=0).astype(np.float32),
+                self._deg_in,
+                self._deg_out,
+            ],
+            axis=1,
+        )
+
+        # observation fold AFTER the emit, keyed by the observed hour
+        self._err_sum[hour] += err5.astype(np.float64) * act
+        self._err_obs[hour] += act
+        self._prev_err5, self._prev_lat = err5, lat
+        return cols

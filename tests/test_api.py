@@ -822,11 +822,14 @@ class TestDashboardContract:
         for path, cont in re.findall(r'jget\("(/[^"]+)"( *\+)?', html):
             dyn = bool(cont) or path.endswith("/")
             assert route_exists("/api/v1" + path, "GET", dyn), path
-        # fetch(API + "...", {...}) — method-aware: scan a bounded window
-        # after each call site for a method: "X" literal (brace-nesting
-        # in the options object must not hide it)
+        # fetch(API + "...", {...}) — method-aware: scan a window after
+        # each call site for a method: "X" literal, bounded by the NEXT
+        # fetch call so adjacent calls cannot cross-contaminate
         for m in re.finditer(r'fetch\(API \+ "(/[^"]+)"', html):
             window = html[m.end() : m.end() + 400]
+            nxt = window.find("fetch(")
+            if nxt != -1:
+                window = window[:nxt]
             method_m = re.search(r'method:\s*"([A-Z]+)"', window)
             method = method_m.group(1) if method_m else "GET"
             assert route_exists("/api/v1" + m.group(1), method, False), (

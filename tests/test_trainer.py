@@ -533,3 +533,64 @@ class TestHistoryFeatures:
             aug.features[0]
         ).shape[1]
         assert np.isfinite(res.losses[-1])
+
+
+class TestHistoryState:
+    """Serving-side rolling state (models/history.HistoryState): replay
+    equivalence with the trainer's augmentation, cold-start growth, and
+    degree refresh — zero train/serve skew by construction."""
+
+    def test_replay_reproduces_trainer_features_exactly(self, dataset):
+        from kmamiz_tpu.models import history
+
+        aug = history.augment_with_history(dataset)
+        base_w = np.asarray(dataset.features[0]).shape[1]
+
+        state = history.HistoryState(dataset.num_nodes)
+        state.set_degrees(
+            dataset.src, dataset.dst, dataset.edge_mask, dataset.num_nodes
+        )
+        for t in range(len(dataset.features)):
+            base = np.asarray(dataset.features[t])
+            hour = trainer.parse_slot_key(dataset.slot_keys[t])[1]
+            cols = state.step(hour, base[:, 2], base[:, 3], base[:, 7])
+            want = np.asarray(aug.features[t])[:, base_w:]
+            # bit-for-bit: train-time augmentation IS a replay of this
+            # state, so any inequality is real train/serve skew
+            assert (cols == want).all(), f"slot {t} skew"
+
+    def test_cold_start_endpoint_grows_in(self, dataset):
+        from kmamiz_tpu.models import history
+
+        state = history.HistoryState(2)
+        c1 = state.step(5, [0.5, 0.0], [1.0, 1.0], [1, 1])
+        assert c1.shape == (2, history.NUM_HISTORY_FEATURES)
+        # a third endpoint appears mid-stream: state widens, empty profile
+        c2 = state.step(6, [0.5, 0.0, 0.2], [1.0, 1.0, 1.0], [1, 1, 1])
+        assert c2.shape == (3, history.NUM_HISTORY_FEATURES)
+        assert c2[2, 0] == 0.0 and c2[2, 2] == 0.0  # no history yet
+        # after a full day incl. a FOLDED hour-5 5xx bucket, the
+        # recurring fault shows in the profile when predicting hour 5
+        # again (read at the hour-4 step)
+        for h in range(7, 24 + 7):
+            state.step(h % 24, [0.5 if h % 24 == 5 else 0.0, 0.0, 0.0],
+                       [1.0] * 3, [1] * 3)
+        # stream is now at hour 6; wind forward to an hour-4 bucket
+        for h in range(7, 24 + 5):
+            state.step(h % 24, [0.0, 0.0, 0.0], [1.0] * 3, [1] * 3)
+        cols = state.step(4, [0.0, 0.0, 0.0], [1.0] * 3, [1] * 3)
+        assert cols[0, 0] > 0.3  # past label rate at predicted hour 5
+        assert cols[0, 1] > 0.15  # past observed 5xx share at hour 5
+        assert cols[1, 0] == 0.0  # the clean endpoint's profile stays clean
+
+    def test_degrees_from_live_graph(self):
+        from kmamiz_tpu.models import history
+
+        state = history.HistoryState(3)
+        state.set_degrees(
+            np.array([0, 0, 1]), np.array([1, 2, 2]),
+            np.array([True, True, True]), 3,
+        )
+        cols = state.step(0, [0.0] * 3, [0.0] * 3, [1] * 3)
+        assert np.isclose(cols[0, 7], np.log1p(2))  # out-degree of node 0
+        assert np.isclose(cols[2, 6], np.log1p(2))  # in-degree of node 2

@@ -642,3 +642,115 @@ class TestSchemaBoundary:
 
         assert set(SCHEMAS) == set(COLLECTIONS)
         assert len(SCHEMAS) == 9
+
+
+class TestHistoryObservation:
+    """The tick feeds the online history-feature state: hourly buckets
+    accumulate per-endpoint SERVER-span stats and fold on rollover
+    (serving side of models/history; MODELS.md)."""
+
+    def _tick(self, processor, t_ms, uid):
+        return processor.collect(
+            {"uniqueId": uid, "lookBack": 30_000, "time": t_ms}
+        )
+
+    def test_hour_rollover_folds_features(self, pdas_traces):
+        import numpy as np
+
+        seen = {"n": 0}
+
+        def source(_lb, _t, _lim):
+            # fresh trace ids per tick so dedup keeps them
+            seen["n"] += 1
+            out = []
+            for g in [pdas_traces]:
+                ng = []
+                for s in g:
+                    c = dict(s)
+                    c["traceId"] = f"h{seen['n']}-{s.get('traceId')}"
+                    c["id"] = f"h{seen['n']}-{s.get('id')}"
+                    if c.get("parentId"):
+                        c["parentId"] = f"h{seen['n']}-{c['parentId']}"
+                    ng.append(c)
+                out.append(ng)
+            return out
+
+        dp = DataProcessor(trace_source=source, use_device_stats=False)
+        H = 3_600_000
+        t0 = 400 * H  # hour 400 -> 16:00
+        self._tick(dp, t0, "a")
+        self._tick(dp, t0 + 60_000, "b")
+        assert dp.history is not None
+        assert dp.history_features is None  # hour not complete yet
+        # rollover: the completed hour folds, features predict the new hour
+        self._tick(dp, t0 + H, "c")
+        assert dp.history_features is not None
+        n_ep = len(dp.graph.interner.endpoints)
+        assert dp.history_features.shape == (n_ep, 8)
+        assert dp.history_predicted_hour == (400 % 24 + 1) % 24
+        # degree columns reflect the live dependency graph
+        assert dp.history_features[:, 6].max() > 0 or \
+            dp.history_features[:, 7].max() > 0
+        # the state accumulated the completed hour's observations
+        assert dp.history.num_endpoints == n_ep
+        assert float(np.asarray(dp.history._err_obs).sum()) > 0
+
+    def test_quiet_hours_fold_as_zero_activity(self, pdas_traces):
+        import numpy as np
+
+        seen = {"n": 0}
+
+        def source(_lb, _t, _lim):
+            seen["n"] += 1
+            ng = []
+            for s in pdas_traces:
+                c = dict(s)
+                c["traceId"] = f"q{seen['n']}-{s.get('traceId')}"
+                c["id"] = f"q{seen['n']}-{s.get('id')}"
+                if c.get("parentId"):
+                    c["parentId"] = f"q{seen['n']}-{c['parentId']}"
+                ng.append(c)
+            return [ng]
+
+        dp = DataProcessor(trace_source=source, use_device_stats=False)
+        H = 3_600_000
+        t0 = 500 * H
+        self._tick(dp, t0, "a")
+        # traffic resumes THREE hours later: the completed hour folds,
+        # the two quiet hours fold as zero-activity (every hour stepped
+        # exactly once, in order — the trainer's replay discipline)
+        self._tick(dp, t0 + 3 * H, "b")
+        assert dp.history_predicted_hour == (500 % 24 + 3) % 24
+        # zero-activity folds add no observations
+        obs = np.asarray(dp.history._err_obs)
+        assert float(obs[(500 + 1) % 24].sum()) == 0.0
+        assert float(obs[(500 + 2) % 24].sum()) == 0.0
+        assert float(obs[500 % 24].sum()) > 0.0
+
+    def test_stale_clock_cannot_fold_early(self, pdas_traces):
+        seen = {"n": 0}
+
+        def source(_lb, _t, _lim):
+            seen["n"] += 1
+            ng = []
+            for s in pdas_traces:
+                c = dict(s)
+                c["traceId"] = f"s{seen['n']}-{s.get('traceId')}"
+                c["id"] = f"s{seen['n']}-{s.get('id')}"
+                if c.get("parentId"):
+                    c["parentId"] = f"s{seen['n']}-{c['parentId']}"
+                ng.append(c)
+            return [ng]
+
+        dp = DataProcessor(trace_source=source, use_device_stats=False)
+        H = 3_600_000
+        t0 = 600 * H
+        self._tick(dp, t0, "a")
+        # a client with yesterday's clock: accumulates into the CURRENT
+        # bucket, folds nothing
+        self._tick(dp, t0 - 30 * H, "b")
+        assert dp.history_features is None
+        assert dp._hour_bucket[0] == 600
+        # normal progression still folds exactly once
+        self._tick(dp, t0 + H, "c")
+        assert dp.history_features is not None

@@ -20,6 +20,7 @@ from kmamiz_tpu.api.handlers import (
     DataHandler,
     GraphHandler,
     HealthHandler,
+    ModelHandler,
     SwaggerHandler,
 )
 from kmamiz_tpu.api.router import ApiServer, Router
@@ -84,6 +85,7 @@ def build_router(
         ComparatorHandler(ctx, graph_handler=graph, data_handler=data),
         ConfigurationHandler(ctx),
         HealthHandler(),
+        ModelHandler(ctx),
     ]
     try:  # simulator routes only exist when the simulator package is in use
         from kmamiz_tpu.simulator.handler import SimulationHandler

@@ -4,6 +4,7 @@ from kmamiz_tpu.api.handlers.configuration import ConfigurationHandler
 from kmamiz_tpu.api.handlers.data import DataHandler
 from kmamiz_tpu.api.handlers.graph import GraphHandler
 from kmamiz_tpu.api.handlers.health import HealthHandler
+from kmamiz_tpu.api.handlers.model import ModelHandler
 from kmamiz_tpu.api.handlers.swagger import SwaggerHandler
 
 __all__ = [
@@ -13,5 +14,6 @@ __all__ = [
     "DataHandler",
     "GraphHandler",
     "HealthHandler",
+    "ModelHandler",
     "SwaggerHandler",
 ]

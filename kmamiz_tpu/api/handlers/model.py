@@ -1,0 +1,182 @@
+"""Forecast routes: serve the trained graph head against live features.
+
+The model families (models/graphsage.py, models/gat.py) train offline on
+simulator or replayed data (tools/eval_models_large.py, MODELS.md); this
+handler closes the loop by running a checkpointed head against the
+features the realtime tick produces online (DataProcessor._observe_history
+-> history_model_features) over the live dependency graph:
+
+- `GET /model/status` — checkpoint metadata + feature freshness.
+- `GET /model/forecast` — per-endpoint anomaly probability and predicted
+  latency for the upcoming hour.
+
+Configuration: KMAMIZ_MODEL_DIR points at a trainer checkpoint directory
+(models/checkpoint.py). Only identity-free heads serve here (num_nodes=0
+in the checkpoint): node-identity embeddings are transductive and cannot
+be aligned with a live, growing endpoint set — the inductive history
+features exist precisely so the deployable model does not need them
+(MODELS.md round 4).
+"""
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Optional
+
+import numpy as np
+
+from kmamiz_tpu.api.router import IRequestHandler, Request, Response
+from kmamiz_tpu.server.initializer import AppContext
+
+logger = logging.getLogger("kmamiz_tpu.api.model")
+
+
+class ModelHandler(IRequestHandler):
+    def __init__(self, ctx: AppContext) -> None:
+        super().__init__("model")
+        self._ctx = ctx
+        self._lock = threading.Lock()
+        self._loaded = None  # (params, meta, model_module) | None
+        self._load_error: Optional[str] = None
+
+        self.add_route("get", "/status", self._status)
+        self.add_route("get", "/forecast", self._forecast)
+
+    # -- checkpoint loading (lazy, once) -------------------------------------
+
+    def _load(self):
+        with self._lock:
+            if self._loaded is not None or self._load_error is not None:
+                return self._loaded
+            directory = self._ctx.settings.model_dir
+            if not directory:
+                self._load_error = "KMAMIZ_MODEL_DIR not configured"
+                return None
+            try:
+                import jax
+
+                from kmamiz_tpu.models import checkpoint as ckpt
+                from kmamiz_tpu.models import gat, graphsage
+
+                step = ckpt.latest_complete_step(directory)
+                if step is None:
+                    self._load_error = f"no complete checkpoint in {directory}"
+                    return None
+                meta = ckpt.load_metadata(directory, step) or {}
+                if int(meta.get("num_nodes", 0)):
+                    self._load_error = (
+                        "checkpoint uses node-identity embeddings; only "
+                        "identity-free heads serve against a live endpoint "
+                        "set (retrain without --embeddings)"
+                    )
+                    return None
+                model = gat if meta.get("model") == "gat" else graphsage
+                template = model.init_params(
+                    jax.random.PRNGKey(0),
+                    hidden=int(meta["hidden"]),
+                    num_features=int(meta["num_features"]),
+                    num_nodes=0,
+                )
+                optimizer = model.make_optimizer(float(meta.get("lr", 1e-3)))
+                restored = ckpt.restore_checkpoint(
+                    directory, template, optimizer.init(template), step=step
+                )
+                if restored is None:
+                    self._load_error = f"restore failed for {directory}"
+                    return None
+                params, _opt, meta = restored
+                self._loaded = (params, dict(meta), model)
+                logger.info(
+                    "forecast model loaded from %s step %s", directory, step
+                )
+            except Exception as err:  # noqa: BLE001 - surfaced via /status
+                self._load_error = f"model load failed: {err}"
+                logger.exception("forecast model load failed")
+            return self._loaded
+
+    # -- routes --------------------------------------------------------------
+
+    def _status(self, req: Request) -> Response:
+        loaded = self._load()
+        dp = self._ctx.processor
+        snap = getattr(dp, "forecast_snapshot", None) if dp else None
+        payload = {
+            "modelLoaded": loaded is not None,
+            "modelDir": self._ctx.settings.model_dir,
+            "error": self._load_error,
+            "featureHourReady": snap is not None,
+            "predictedHour": snap["predicted_hour"] if snap else None,
+            "numEndpoints": int(snap["features"].shape[0]) if snap else 0,
+        }
+        if loaded is not None:
+            _params, meta, model = loaded
+            payload["checkpoint"] = {
+                "model": meta.get("model"),
+                "step": meta.get("step"),
+                "hidden": meta.get("hidden"),
+                "numFeatures": meta.get("num_features"),
+                "loss": meta.get("loss"),
+            }
+        return Response(payload=payload)
+
+    def _forecast(self, req: Request) -> Response:
+        loaded = self._load()
+        if loaded is None:
+            return Response(
+                status=503, payload={"error": self._load_error}
+            )
+        dp = self._ctx.processor
+        # ONE attribute read: the fold publishes features + matching
+        # edges + names + hour together, so no torn mixtures and no
+        # clamped edge ids from endpoints interned after the fold
+        snap = getattr(dp, "forecast_snapshot", None) if dp else None
+        if snap is None:
+            return Response(
+                status=503,
+                payload={
+                    "error": "no completed feature hour yet (the first "
+                    "forecast is available after one full hour of ticks)"
+                },
+            )
+        feats = snap["features"]
+        params, meta, model = loaded
+        if feats.shape[1] != int(meta["num_features"]):
+            return Response(
+                status=409,
+                payload={
+                    "error": (
+                        f"feature width {feats.shape[1]} != checkpoint's "
+                        f"{meta['num_features']} (train with the matching "
+                        "feature layout)"
+                    )
+                },
+            )
+        import jax
+        import jax.numpy as jnp
+
+        names = snap["names"]
+        pred_lat, logit = model.forward(
+            params,
+            jnp.asarray(feats, jnp.float32),
+            snap["src"],
+            snap["dst"],
+            snap["mask"],
+        )
+        prob = np.asarray(jax.nn.sigmoid(logit))
+        lat_ms = np.expm1(np.asarray(pred_lat))
+        order = np.argsort(-prob)
+        endpoints = [
+            {
+                "uniqueEndpointName": names[i],
+                "anomalyProbability": round(float(prob[i]), 4),
+                "predictedLatencyMs": round(float(max(lat_ms[i], 0.0)), 2),
+            }
+            for i in order
+        ]
+        return Response(
+            payload={
+                "predictedHour": snap["predicted_hour"],
+                "model": meta.get("model"),
+                "endpoints": endpoints,
+            }
+        )

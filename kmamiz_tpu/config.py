@@ -38,6 +38,11 @@ class Settings:
     external_data_processor: str = field(
         default_factory=lambda: os.environ.get("EXTERNAL_DATA_PROCESSOR", "")
     )
+    # checkpoint directory of a trained forecast head (models/trainer.py);
+    # empty disables the GET /model routes' inference
+    model_dir: str = field(
+        default_factory=lambda: os.environ.get("KMAMIZ_MODEL_DIR", "")
+    )
     aggregate_interval: str = field(
         default_factory=lambda: os.environ.get("AGGREGATE_INTERVAL", "*/5 * * * *")
     )

@@ -91,9 +91,16 @@ class DataProcessor:
         # so every transition serializes on _history_lock.
         self.history = None
         self.history_features = None  # last fold's [N, 8] columns
+        self.history_model_features = None  # full [N, 18] model input
         self.history_predicted_hour = None
-        self._hour_bucket = None  # (abs_hour, count, err5, lat_sum)
+        # atomic fold-time snapshot for /model/forecast: features + the
+        # matching graph edges + names, published as ONE dict so readers
+        # never mix folds (replaced wholesale, read via one attribute)
+        self.forecast_snapshot = None
+        self._hour_bucket = None  # [abs_hour, count, e4, e5, lat, lat^2,
+        #                            cls_count, cls_lat, cls_lat^2]
         self._history_lock = threading.Lock()
+        self._last_replicas: Dict[str, float] = {}
 
     # -- trace dedup (data_processor.rs:30-73) -------------------------------
 
@@ -166,6 +173,13 @@ class DataProcessor:
                 # ~max(pod) not Σ(pod) (data_processor.rs:58-73)
                 replicas, pod_logs = self._k8s.get_replicas_and_envoy_logs(
                     namespaces
+                )
+                self._last_replicas.update(
+                    {
+                        r["uniqueServiceName"]: float(r.get("replicas", 1))
+                        for r in replicas
+                        if r.get("uniqueServiceName")
+                    }
                 )
                 structured_logs = EnvoyLogs.combine_to_structured_envoy_logs(
                     pod_logs
@@ -250,60 +264,150 @@ class DataProcessor:
         abs_hour = int(req_time_ms // 3_600_000)
         sel = batch.valid & (batch.kind == KIND_SERVER)
         eids = batch.endpoint_id[sel]
+        err4 = (batch.status_class[sel] == 4).astype(np.float64)
         err5 = (batch.status_class[sel] == 5).astype(np.float64)
         lat = np.asarray(batch.latency_ms, dtype=np.float64)[sel]
+
+        scls = np.clip(
+            np.asarray(batch.status_class, dtype=np.int64)[sel], 0, 5
+        )
 
         with self._history_lock:
             if self.history is None:
                 self.history = HistoryState(n_ep)
             if self._hour_bucket is not None and abs_hour > self._hour_bucket[0]:
                 completed_hour = self._hour_bucket[0]
-                self._fold_history_bucket_locked()
+                self._fold_hour_locked(*self._hour_bucket)
                 # zero-activity folds for fully quiet hours in between
+                # (each builds its own model-feature matrix too, so the
+                # forecast snapshot always matches its labeled hour)
                 gap_first = completed_hour + 1
                 gap_last = abs_hour - 1
                 if gap_last - gap_first + 1 > self.HISTORY_MAX_CATCHUP_HOURS:
                     gap_first = gap_last - self.HISTORY_MAX_CATCHUP_HOURS + 1
-                zeros = np.zeros(self.history.num_endpoints)
+                m = self.history.num_endpoints
                 for h in range(gap_first, gap_last + 1):
-                    self.history_features = self.history.step(
-                        h % 24, zeros, zeros, zeros
+                    self._fold_hour_locked(
+                        h,
+                        np.zeros(m),
+                        np.zeros(m),
+                        np.zeros(m),
+                        np.zeros(m),
+                        np.zeros(m),
+                        np.zeros((m, 6)),
+                        np.zeros((m, 6)),
+                        np.zeros((m, 6)),
                     )
-                    self.history_predicted_hour = (h + 1) % 24
                 self._hour_bucket = None
             if self._hour_bucket is None:
-                self._hour_bucket = (
+                self._hour_bucket = [
                     abs_hour,
-                    np.zeros(n_ep),
-                    np.zeros(n_ep),
-                    np.zeros(n_ep),
-                )
-            hour, count, err5_sum, lat_sum = self._hour_bucket
-            if len(count) < n_ep:  # new endpoints interned this tick
-                grow = n_ep - len(count)
-                count = np.concatenate([count, np.zeros(grow)])
-                err5_sum = np.concatenate([err5_sum, np.zeros(grow)])
-                lat_sum = np.concatenate([lat_sum, np.zeros(grow)])
-                self._hour_bucket = (hour, count, err5_sum, lat_sum)
-            np.add.at(count, eids, 1.0)
-            np.add.at(err5_sum, eids, err5)
-            np.add.at(lat_sum, eids, lat)
+                    np.zeros(n_ep),  # count
+                    np.zeros(n_ep),  # err4
+                    np.zeros(n_ep),  # err5
+                    np.zeros(n_ep),  # lat sum
+                    np.zeros(n_ep),  # lat sum of squares
+                    np.zeros((n_ep, 6)),  # per-status-class count
+                    np.zeros((n_ep, 6)),  # per-status-class lat sum
+                    np.zeros((n_ep, 6)),  # per-status-class lat sq sum
+                ]
+            bucket = self._hour_bucket
+            if len(bucket[1]) < n_ep:  # new endpoints interned this tick
+                grow = n_ep - len(bucket[1])
+                for i in range(1, 6):
+                    bucket[i] = np.concatenate([bucket[i], np.zeros(grow)])
+                for i in range(6, 9):
+                    bucket[i] = np.concatenate(
+                        [bucket[i], np.zeros((grow, 6))]
+                    )
+            np.add.at(bucket[1], eids, 1.0)
+            np.add.at(bucket[2], eids, err4)
+            np.add.at(bucket[3], eids, err5)
+            np.add.at(bucket[4], eids, lat)
+            np.add.at(bucket[5], eids, lat * lat)
+            np.add.at(bucket[6], (eids, scls), 1.0)
+            np.add.at(bucket[7], (eids, scls), lat)
+            np.add.at(bucket[8], (eids, scls), lat * lat)
 
-    def _fold_history_bucket_locked(self) -> None:
-        """Fold the completed hour into the state (trainer-equivalent
-        shares: 5xx/count, log1p mean latency, active = saw traffic).
-        Caller holds _history_lock."""
-        hour, count, err5_sum, lat_sum = self._hour_bucket
+    def _fold_hour_locked(
+        self,
+        hour,
+        count,
+        err4_sum,
+        err5_sum,
+        lat_sum,
+        lat_sq_sum,
+        cls_count,
+        cls_lat,
+        cls_lat_sq,
+    ) -> None:
+        """Fold one completed hour into the state (trainer-equivalent
+        shares: 5xx/count, log1p mean latency, active = saw traffic),
+        assemble the FULL model-feature matrix for the predicted hour,
+        and publish an atomic forecast snapshot (features + the graph
+        edges + names as of THIS fold — the serving input of the
+        forecast route, immune to endpoints interned later). Caller
+        holds _history_lock.
+
+        Feature-fidelity notes: latency CV mirrors the trainer's
+        count-weighted mean of per-(endpoint,status) within-window CVs,
+        approximated at status-CLASS granularity (distinct statuses in
+        one class pool together). request_rate/log_volume reflect the
+        tick pipeline's deduped, ZIPKIN_LIMIT-capped trace stream — for
+        production forecasting, train on data collected through this
+        same pipeline so those columns share a distribution."""
+        from kmamiz_tpu.models import graphsage
+        from kmamiz_tpu.models.trainer import SLOT_SECONDS
+
         safe = np.maximum(count, 1.0)
+        lat_mean = lat_sum / safe
         src, dst, _dist, mask = self.graph.edge_arrays()
         self.history.set_degrees(src, dst, mask, len(count))
-        self.history_features = self.history.step(
+        hist_cols = self.history.step(
             hour % 24,
             err5_sum / safe,
-            np.log1p(lat_sum / safe),
+            np.log1p(lat_mean),
             count > 0,
         )
+        self.history_features = hist_cols
         self.history_predicted_hour = (hour % 24 + 1) % 24
+        # trainer-faithful CV: per-(endpoint,status-class) CV from the
+        # sum-of-squares identity, count-weighted like _per_slot_stats
+        cls_safe = np.maximum(cls_count, 1.0)
+        cls_mean = cls_lat / cls_safe
+        cls_var = np.maximum(cls_lat_sq / cls_safe - cls_mean * cls_mean, 0.0)
+        cls_cv = np.sqrt(cls_var) / np.maximum(cls_mean, 1e-9)
+        cv = (cls_count * cls_cv).sum(axis=1) / safe
+        n = len(count)
+        replicas = np.ones(n, dtype=np.float32)
+        if self._last_replicas:
+            interner = self.graph.interner
+            for eid in range(n):
+                svc_name = interner.services.lookup(interner.service_of(eid))
+                replicas[eid] = self._last_replicas.get(svc_name, 1.0)
+        base = graphsage.assemble_features(
+            count / SLOT_SECONDS,
+            err4_sum / safe,
+            err5_sum / safe,
+            np.log1p(lat_mean),
+            cv,
+            replicas,
+            np.log1p(count),
+            count > 0,
+            hour_of_day=float(self.history_predicted_hour),
+        )
+        self.history_model_features = np.concatenate(
+            [np.asarray(base), hist_cols], axis=1
+        )
+        interner = self.graph.interner
+        self.forecast_snapshot = {
+            "features": self.history_model_features,
+            "src": src,
+            "dst": dst,
+            "mask": mask,
+            "names": [interner.endpoints.lookup(i) for i in range(n)],
+            "predicted_hour": self.history_predicted_hour,
+        }
 
     def ingest_raw_window(self, raw: bytes) -> dict:
         """Raw Zipkin response bytes -> persistent device graph, uncapped.

@@ -836,3 +836,132 @@ class TestDashboardContract:
                 method,
                 m.group(1),
             )
+
+
+class TestModelRoutes:
+    """Forecast routes: a checkpointed head served against the features
+    the realtime tick produces online (handlers/model.py)."""
+
+    def test_status_unconfigured(self, router):
+        res = get(router, "/api/v1/model/status")
+        assert res.status == 200
+        assert res.payload["modelLoaded"] is False
+        assert "KMAMIZ_MODEL_DIR" in res.payload["error"]
+        res = get(router, "/api/v1/model/forecast")
+        assert res.status == 503
+
+    def test_forecast_end_to_end(self, pdas_traces, tmp_path):
+        """Train a tiny augmented-feature head on simulated faults, save
+        a checkpoint, tick a processor across an hour boundary, and read
+        the forecast through the HTTP surface."""
+        import numpy as np
+
+        from kmamiz_tpu.api.app import build_router as _build
+        from kmamiz_tpu.models import history, trainer
+        from kmamiz_tpu.server.initializer import AppContext, Initializer
+        from kmamiz_tpu.server.processor import DataProcessor
+        from kmamiz_tpu.server.storage import MemoryStore
+        from test_trainer import FAULT_YAML
+        from kmamiz_tpu.simulator.simulator import Simulator
+
+        sim = Simulator().generate_simulation_data(
+            FAULT_YAML, 0.0, rng=np.random.default_rng(7)
+        )
+        ds = trainer.dataset_from_simulation(
+            sim.endpoint_dependencies,
+            sim.realtime_data_per_slot,
+            sim.replica_counts,
+        )
+        aug = history.augment_with_history(ds)
+        trainer.train(
+            aug, epochs=4, hidden=8, seed=0,
+            checkpoint_dir=str(tmp_path), checkpoint_every=0,
+        )
+
+        seen = {"n": 0}
+
+        def source(_lb, _t, _lim):
+            seen["n"] += 1
+            out = []
+            for g in [pdas_traces]:
+                ng = []
+                for s in g:
+                    c = dict(s)
+                    c["traceId"] = f"f{seen['n']}-{s.get('traceId')}"
+                    c["id"] = f"f{seen['n']}-{s.get('id')}"
+                    if c.get("parentId"):
+                        c["parentId"] = f"f{seen['n']}-{c['parentId']}"
+                    ng.append(c)
+                out.append(ng)
+            return out
+
+        dp = DataProcessor(trace_source=source, use_device_stats=False)
+        settings = Settings()
+        settings.external_data_processor = ""
+        settings.model_dir = str(tmp_path)
+        ctx = AppContext.build(
+            app_settings=settings, store=MemoryStore(), processor=dp
+        )
+        Initializer(ctx).register_data_caches()
+        model_router = _build(ctx)
+
+        H = 3_600_000
+        t0 = 900 * H
+        dp.collect({"uniqueId": "m1", "lookBack": 30_000, "time": t0})
+        # before the first completed hour: model loads, features pending
+        res = model_router.dispatch("GET", "/api/v1/model/forecast")
+        assert res.status == 503
+        status = model_router.dispatch("GET", "/api/v1/model/status").payload
+        assert status["modelLoaded"] is True
+        assert status["checkpoint"]["numFeatures"] == 18
+
+        dp.collect({"uniqueId": "m2", "lookBack": 30_000, "time": t0 + H})
+        res = model_router.dispatch("GET", "/api/v1/model/forecast")
+        assert res.status == 200, res.payload
+        body = res.payload
+        assert body["predictedHour"] == (900 % 24 + 1) % 24
+        eps = body["endpoints"]
+        assert eps and len(eps) == len(dp.graph.interner.endpoints)
+        for row in eps:
+            assert 0.0 <= row["anomalyProbability"] <= 1.0
+            assert row["predictedLatencyMs"] >= 0.0
+            assert "\t" in row["uniqueEndpointName"]
+        # sorted most-suspicious first
+        probs = [r["anomalyProbability"] for r in eps]
+        assert probs == sorted(probs, reverse=True)
+
+    def test_embedding_checkpoint_rejected(self, pdas_traces, tmp_path):
+        import numpy as np
+
+        from kmamiz_tpu.api.app import build_router as _build
+        from kmamiz_tpu.models import trainer
+        from kmamiz_tpu.server.initializer import AppContext, Initializer
+        from kmamiz_tpu.server.processor import DataProcessor
+        from kmamiz_tpu.server.storage import MemoryStore
+        from test_trainer import FAULT_YAML
+        from kmamiz_tpu.simulator.simulator import Simulator
+
+        sim = Simulator().generate_simulation_data(
+            FAULT_YAML, 0.0, rng=np.random.default_rng(7)
+        )
+        ds = trainer.dataset_from_simulation(
+            sim.endpoint_dependencies,
+            sim.realtime_data_per_slot,
+            sim.replica_counts,
+        )
+        trainer.train(
+            ds, epochs=1, hidden=8, seed=0, use_node_embeddings=True,
+            checkpoint_dir=str(tmp_path), checkpoint_every=0,
+        )
+        settings = Settings()
+        settings.external_data_processor = ""
+        settings.model_dir = str(tmp_path)
+        dp = DataProcessor(trace_source=lambda *a: [], use_device_stats=False)
+        ctx = AppContext.build(
+            app_settings=settings, store=MemoryStore(), processor=dp
+        )
+        Initializer(ctx).register_data_caches()
+        model_router = _build(ctx)
+        status = model_router.dispatch("GET", "/api/v1/model/status").payload
+        assert status["modelLoaded"] is False
+        assert "identity" in status["error"]

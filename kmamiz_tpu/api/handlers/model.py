@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from typing import Optional
 
 import numpy as np
@@ -38,20 +39,40 @@ class ModelHandler(IRequestHandler):
         self._lock = threading.Lock()
         self._loaded = None  # (params, meta, model_module) | None
         self._load_error: Optional[str] = None
+        # a missing/empty checkpoint directory is TRANSIENT (the trainer
+        # may simply not have written its first step yet): such failures
+        # re-attempt on later requests, rate-limited, instead of pinning
+        # a 503 until restart. Terminal errors (no model dir configured,
+        # embedding checkpoints, restore failures) cache permanently.
+        self._error_transient = False
+        self._next_retry = 0.0
 
         self.add_route("get", "/status", self._status)
         self.add_route("get", "/forecast", self._forecast)
+
+    RETRY_SECONDS = 5.0
 
     # -- checkpoint loading (lazy, once) -------------------------------------
 
     def _load(self):
         with self._lock:
-            if self._loaded is not None or self._load_error is not None:
+            if self._loaded is not None:
                 return self._loaded
+            if self._load_error is not None and (
+                not self._error_transient
+                or time.monotonic() < self._next_retry
+            ):
+                return None
             directory = self._ctx.settings.model_dir
             if not directory:
                 self._load_error = "KMAMIZ_MODEL_DIR not configured"
                 return None
+            # every path below is terminal unless it explicitly marks
+            # itself transient; without this reset, a raising load after
+            # a prior transient failure would inherit transient=True with
+            # an expired retry deadline — re-attempting the full load on
+            # EVERY request with no rate limit
+            self._error_transient = False
             try:
                 import jax
 
@@ -61,8 +82,21 @@ class ModelHandler(IRequestHandler):
                 step = ckpt.latest_complete_step(directory)
                 if step is None:
                     self._load_error = f"no complete checkpoint in {directory}"
+                    self._error_transient = True
+                    self._next_retry = time.monotonic() + self.RETRY_SECONDS
                     return None
                 meta = ckpt.load_metadata(directory, step) or {}
+                if not meta:
+                    # sidecar vanished between listing and read: the
+                    # trainer is mid-rewrite of this step — same
+                    # transient class as "not written yet"
+                    self._load_error = (
+                        f"checkpoint step {step} metadata unreadable "
+                        f"(trainer mid-write?)"
+                    )
+                    self._error_transient = True
+                    self._next_retry = time.monotonic() + self.RETRY_SECONDS
+                    return None
                 if int(meta.get("num_nodes", 0)):
                     self._load_error = (
                         "checkpoint uses node-identity embeddings; only "
@@ -82,10 +116,16 @@ class ModelHandler(IRequestHandler):
                     directory, template, optimizer.init(template), step=step
                 )
                 if restored is None:
+                    # the step directory disappeared between listing and
+                    # restore (trainer re-saving the same step): transient
+                    # — a complete checkpoint reappears moments later
                     self._load_error = f"restore failed for {directory}"
+                    self._error_transient = True
+                    self._next_retry = time.monotonic() + self.RETRY_SECONDS
                     return None
                 params, _opt, meta = restored
                 self._loaded = (params, dict(meta), model)
+                self._load_error = None  # clear a prior transient failure
                 logger.info(
                     "forecast model loaded from %s step %s", directory, step
                 )

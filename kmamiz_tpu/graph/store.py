@@ -302,7 +302,14 @@ class EndpointGraph:
             if hasattr(count, "copy_to_host_async"):
                 count.copy_to_host_async()
             self._staged.append((s, d, ds, count, dev_in, depth))
-            self._staged_rows += int(s.shape[0])
+            # the pinned walk inputs (kept for the truncated-prefix
+            # re-walk fallback) dominate a large window's staged HBM, so
+            # they count toward the drain backstop too: one packed slot
+            # (~10 B across the four arrays) ≈ one compacted edge row
+            # (3 int32). Counting only the stage_cap prefix would let a
+            # long stream of big windows pin windows x padded-input
+            # bytes before tripping (ADVICE r4).
+            self._staged_rows += int(s.shape[0]) + int(dev_in[0].size)
             self._update_ep_metadata(batch)
             # backstop: an unread stream must not grow HBM unboundedly
             if self._staged_rows > self._stage_max_rows():

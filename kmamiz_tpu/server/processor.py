@@ -256,12 +256,16 @@ class DataProcessor:
         in order (the trainer's replay steps consecutive slots — skipped
         hours would skew deltas/rolling windows); a request whose clock
         runs BEHIND the current bucket accumulates into it instead of
-        folding a partial hour early (one skewed client cannot corrupt
-        the hour-keyed profiles)."""
+        folding a partial hour early, and one whose clock runs AHEAD of
+        the server clamps to the server clock — otherwise a single
+        far-future timestamp (e.g. microseconds where milliseconds
+        belong) would advance the bucket past wall time and freeze folds
+        until the clock caught up (one skewed client cannot corrupt the
+        hour-keyed profiles in either direction)."""
         from kmamiz_tpu.models.history import HistoryState
 
         n_ep = len(self.graph.interner.endpoints)
-        abs_hour = int(req_time_ms // 3_600_000)
+        abs_hour = int(min(req_time_ms, self._now_ms()) // 3_600_000)
         sel = batch.valid & (batch.kind == KIND_SERVER)
         eids = batch.endpoint_id[sel]
         err4 = (batch.status_class[sel] == 4).astype(np.float64)

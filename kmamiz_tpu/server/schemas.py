@@ -386,7 +386,12 @@ def stamp(doc: dict) -> dict:
 
 def migrate(collection: str, doc: dict) -> dict:
     """Walk a read document forward to CURRENT_VERSION via MIGRATIONS.
-    Raises SchemaValidationError when a needed migration is missing."""
+    Raises SchemaValidationError when a needed migration is missing.
+    Unknown collections pass through unchanged, matching validate_doc's
+    policy (the simulator adds private collections this module never
+    versions)."""
+    if collection not in SCHEMAS:
+        return doc
     version = doc.get("_schemaVersion", 0)
     while version < CURRENT_VERSION:
         hook = MIGRATIONS.get(collection, {}).get(version)

@@ -838,6 +838,33 @@ class TestDashboardContract:
             )
 
 
+def _train_tiny_checkpoint(
+    checkpoint_dir, epochs=1, augmented=True, **train_kw
+):
+    """Train the smallest viable head on the simulator fault mesh and
+    write a checkpoint — the shared setup of every TestModelRoutes case."""
+    import numpy as np
+
+    from kmamiz_tpu.models import history, trainer
+    from test_trainer import FAULT_YAML
+    from kmamiz_tpu.simulator.simulator import Simulator
+
+    sim = Simulator().generate_simulation_data(
+        FAULT_YAML, 0.0, rng=np.random.default_rng(7)
+    )
+    ds = trainer.dataset_from_simulation(
+        sim.endpoint_dependencies,
+        sim.realtime_data_per_slot,
+        sim.replica_counts,
+    )
+    if augmented:
+        ds = history.augment_with_history(ds)
+    trainer.train(
+        ds, epochs=epochs, hidden=8, seed=0,
+        checkpoint_dir=str(checkpoint_dir), checkpoint_every=0, **train_kw,
+    )
+
+
 class TestModelRoutes:
     """Forecast routes: a checkpointed head served against the features
     the realtime tick produces online (handlers/model.py)."""
@@ -854,29 +881,12 @@ class TestModelRoutes:
         """Train a tiny augmented-feature head on simulated faults, save
         a checkpoint, tick a processor across an hour boundary, and read
         the forecast through the HTTP surface."""
-        import numpy as np
-
         from kmamiz_tpu.api.app import build_router as _build
-        from kmamiz_tpu.models import history, trainer
         from kmamiz_tpu.server.initializer import AppContext, Initializer
         from kmamiz_tpu.server.processor import DataProcessor
         from kmamiz_tpu.server.storage import MemoryStore
-        from test_trainer import FAULT_YAML
-        from kmamiz_tpu.simulator.simulator import Simulator
 
-        sim = Simulator().generate_simulation_data(
-            FAULT_YAML, 0.0, rng=np.random.default_rng(7)
-        )
-        ds = trainer.dataset_from_simulation(
-            sim.endpoint_dependencies,
-            sim.realtime_data_per_slot,
-            sim.replica_counts,
-        )
-        aug = history.augment_with_history(ds)
-        trainer.train(
-            aug, epochs=4, hidden=8, seed=0,
-            checkpoint_dir=str(tmp_path), checkpoint_every=0,
-        )
+        _train_tiny_checkpoint(tmp_path, epochs=4)
 
         seen = {"n": 0}
 
@@ -930,28 +940,44 @@ class TestModelRoutes:
         probs = [r["anomalyProbability"] for r in eps]
         assert probs == sorted(probs, reverse=True)
 
-    def test_embedding_checkpoint_rejected(self, pdas_traces, tmp_path):
-        import numpy as np
-
+    def test_empty_checkpoint_dir_retries(self, tmp_path, monkeypatch):
+        """A missing first checkpoint is TRANSIENT: the handler must
+        re-attempt the load once the trainer writes one, instead of
+        pinning a 503 until process restart (ADVICE r4)."""
         from kmamiz_tpu.api.app import build_router as _build
-        from kmamiz_tpu.models import trainer
+        from kmamiz_tpu.api.handlers.model import ModelHandler
         from kmamiz_tpu.server.initializer import AppContext, Initializer
         from kmamiz_tpu.server.processor import DataProcessor
         from kmamiz_tpu.server.storage import MemoryStore
-        from test_trainer import FAULT_YAML
-        from kmamiz_tpu.simulator.simulator import Simulator
 
-        sim = Simulator().generate_simulation_data(
-            FAULT_YAML, 0.0, rng=np.random.default_rng(7)
+        monkeypatch.setattr(ModelHandler, "RETRY_SECONDS", 0.0)
+        settings = Settings()
+        settings.external_data_processor = ""
+        settings.model_dir = str(tmp_path)  # exists but empty
+        dp = DataProcessor(trace_source=lambda *a: [], use_device_stats=False)
+        ctx = AppContext.build(
+            app_settings=settings, store=MemoryStore(), processor=dp
         )
-        ds = trainer.dataset_from_simulation(
-            sim.endpoint_dependencies,
-            sim.realtime_data_per_slot,
-            sim.replica_counts,
-        )
-        trainer.train(
-            ds, epochs=1, hidden=8, seed=0, use_node_embeddings=True,
-            checkpoint_dir=str(tmp_path), checkpoint_every=0,
+        Initializer(ctx).register_data_caches()
+        model_router = _build(ctx)
+        status = model_router.dispatch("GET", "/api/v1/model/status").payload
+        assert status["modelLoaded"] is False
+        assert "no complete checkpoint" in status["error"]
+
+        # the trainer writes its first checkpoint AFTER the server booted
+        _train_tiny_checkpoint(tmp_path)
+        status = model_router.dispatch("GET", "/api/v1/model/status").payload
+        assert status["modelLoaded"] is True, status
+        assert status["error"] is None
+
+    def test_embedding_checkpoint_rejected(self, pdas_traces, tmp_path):
+        from kmamiz_tpu.api.app import build_router as _build
+        from kmamiz_tpu.server.initializer import AppContext, Initializer
+        from kmamiz_tpu.server.processor import DataProcessor
+        from kmamiz_tpu.server.storage import MemoryStore
+
+        _train_tiny_checkpoint(
+            tmp_path, augmented=False, use_node_embeddings=True
         )
         settings = Settings()
         settings.external_data_processor = ""

@@ -136,6 +136,22 @@ def test_staged_merge_equals_fused(pdas_traces, bookinfo_traces):
     assert e1 == e2
 
 
+def test_stage_backstop_counts_pinned_inputs(pdas_traces, monkeypatch):
+    """The staged-HBM drain backstop must account the pinned padded walk
+    inputs, not just the compacted prefixes — a stream of large windows
+    would otherwise pin windows x padded-input bytes before tripping
+    (ADVICE r4). The cap sits BETWEEN the compacted-prefix contribution
+    (stage_cap=8 rows) and prefix + pinned input (8 + 64 slots for the
+    one-trace pdas window), so the drain below fires only under the new
+    accounting — prefix-only accounting would stage without draining."""
+    monkeypatch.setenv("KMAMIZ_STAGE_CAP", "8")
+    monkeypatch.setenv("KMAMIZ_STAGE_MAX_ROWS", "32")
+    g = EndpointGraph()
+    g.merge_window(spans_to_batch([pdas_traces], interner=g.interner), stage=True)
+    assert not g._staged  # the backstop drained inline
+    assert g.n_edges > 0
+
+
 def test_staged_and_fused_interleave(pdas_traces):
     # a realtime tick (fused) landing between staged stream chunks must
     # not lose either side's edges

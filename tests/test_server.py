@@ -643,6 +643,16 @@ class TestSchemaBoundary:
         assert set(SCHEMAS) == set(COLLECTIONS)
         assert len(SCHEMAS) == 9
 
+    def test_migrate_unknown_collection_passes_through(self):
+        """migrate() must mirror validate_doc's unknown-collections-pass
+        policy: a simulator-private collection's documents (version 0,
+        no migration registered) read back unchanged instead of being
+        quarantined (ADVICE r4)."""
+        from kmamiz_tpu.server.schemas import migrate
+
+        doc = {"anything": 1}
+        assert migrate("SimulatorPrivate", doc) is doc
+
 
 class TestHistoryObservation:
     """The tick feeds the online history-feature state: hourly buckets
@@ -754,3 +764,43 @@ class TestHistoryObservation:
         # normal progression still folds exactly once
         self._tick(dp, t0 + H, "c")
         assert dp.history_features is not None
+
+    def test_future_clock_cannot_advance_bucket(self, pdas_traces):
+        """A client timestamp AHEAD of the server clock clamps to it:
+        one far-future `time` (e.g. microseconds where milliseconds
+        belong) must not advance the hour bucket past wall time, which
+        would freeze folds until the wall clock caught up (ADVICE r4)."""
+        seen = {"n": 0}
+
+        def source(_lb, _t, _lim):
+            seen["n"] += 1
+            ng = []
+            for s in pdas_traces:
+                c = dict(s)
+                c["traceId"] = f"f{seen['n']}-{s.get('traceId')}"
+                c["id"] = f"f{seen['n']}-{s.get('id')}"
+                if c.get("parentId"):
+                    c["parentId"] = f"f{seen['n']}-{c['parentId']}"
+                ng.append(c)
+            return [ng]
+
+        H = 3_600_000
+        clock = {"now": 700 * H + 1000}
+        dp = DataProcessor(
+            trace_source=source,
+            use_device_stats=False,
+            now_ms=lambda: clock["now"],
+        )
+        self._tick(dp, clock["now"], "a")
+        assert dp._hour_bucket[0] == 700
+        # a request whose clock reads microseconds-as-milliseconds:
+        # clamps to the server hour, same bucket, no fold
+        self._tick(dp, 700 * H * 1000, "b")
+        assert dp._hour_bucket[0] == 700
+        assert dp.history_features is None
+        # real time advances one hour: exactly one fold, and the stream
+        # resumes at the true current hour — not frozen at the future one
+        clock["now"] = 701 * H + 1000
+        self._tick(dp, clock["now"], "c")
+        assert dp.history_features is not None
+        assert dp._hour_bucket[0] == 701

@@ -85,44 +85,12 @@ def _timed_median(run, reps: int = 5):
     return float(np.median(_reps(run, reps)))
 
 
-def make_raw_window(n_traces: int, spans_per: int, t_start: int = 0) -> bytes:
-    """The bench's synthetic raw-Zipkin window: Istio-sidecar-shaped spans
-    in ~7-span traces. Module-level so tools/profile_parse.py profiles the
-    exact workload the headline measures."""
-    groups = []
-    for t in range(t_start, t_start + n_traces):
-        group = []
-        for j in range(spans_per):
-            group.append(
-                {
-                    "traceId": f"w{t}",
-                    "id": f"{t}-{j}",
-                    "parentId": f"{t}-{j-1}" if j else None,
-                    "kind": "SERVER" if j % 2 == 0 else "CLIENT",
-                    "name": f"svc{(t + j) % 200}.ns{j % 8}.svc.cluster.local:80/*",
-                    "timestamp": 1_700_000_000_000_000 + t * 900 + j,
-                    "duration": 1000 + (t + j) % 5000,
-                    "localEndpoint": {"serviceName": f"svc{(t + j) % 200}"},
-                    "tags": {
-                        "component": "proxy",
-                        "http.method": "GET",
-                        "http.protocol": "HTTP/1.1",
-                        "http.status_code": "503" if t % 50 == 0 else "200",
-                        "http.url": (
-                            f"http://svc{(t + j) % 200}.ns{j % 8}"
-                            f".svc.cluster.local/api/v1/ep{(t * 7 + j) % 50}"
-                        ),
-                        "istio.canonical_revision": "latest",
-                        "istio.canonical_service": f"svc{(t + j) % 200}",
-                        "istio.mesh_id": "cluster.local",
-                        "istio.namespace": f"ns{j % 8}",
-                        "response_flags": "-",
-                        "upstream_cluster": "inbound|9080||",
-                    },
-                }
-            )
-        groups.append(group)
-    return json.dumps(groups).encode()
+# the bench's synthetic raw-Zipkin windows come from the shared generator
+# (legacy 200-svc/50-url defaults reproduce the historical bench shape
+# byte for byte; urls_per_service>0 selects the BASELINE 10k-endpoint
+# shape). Re-exported so tools/profile_parse.py keeps profiling the exact
+# workload the headline measures.
+from kmamiz_tpu.synth import make_raw_window  # noqa: E402
 
 
 def critical_path_ms(chunk_detail, drain_ms: float) -> float:

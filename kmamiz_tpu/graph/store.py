@@ -256,6 +256,50 @@ class EndpointGraph:
         self.last_transfer_ms = ms
         return out, ms
 
+    def _to_device_sharded(self, mesh, *host_arrays):
+        """_to_device onto the deployed mesh: each [rows, ROW_SLOTS]
+        array lands row-sharded over the spans axis, so the walk kernel
+        runs on every device's local rows with no resharding."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        sh = NamedSharding(mesh, P("spans", None))
+        t0 = time.perf_counter()
+        out = jax.block_until_ready(
+            [jax.device_put(a, sh) for a in host_arrays]
+        )
+        ms = (time.perf_counter() - t0) * 1000.0
+        self.last_transfer_ms = ms
+        return out, ms
+
+    @staticmethod
+    def _deploy_mesh(n_rows: int):
+        """The active deployed mesh when this window is worth sharding
+        (at least one packed trace row per device), else None. Window
+        merges consult this per call, so a v5e-8 serving process shards
+        every big window across all chips automatically while the
+        single-chip dev box keeps the single-device kernels
+        (VERDICT r4 #1)."""
+        from kmamiz_tpu.parallel.mesh import active_mesh
+
+        mesh = active_mesh()
+        if mesh is None or n_rows < mesh.shape["spans"]:
+            return None
+        return mesh
+
+    @staticmethod
+    def _pad_rows_for(mesh, arr, fill):
+        """Pad a [rows, ROW_SLOTS] host array's leading dim to a multiple
+        of the mesh's device count (no-op for pow2 device counts, since
+        pack_trace_rows already pow2-pads rows)."""
+        n_dev = mesh.shape["spans"]
+        rows = arr.shape[0]
+        target = -(-rows // n_dev) * n_dev
+        if target == rows:
+            return arr
+        out = np.full((target, arr.shape[1]), fill, dtype=arr.dtype)
+        out[:rows] = arr
+        return out
+
     def merge_window(self, batch: SpanBatch, stage: bool = False) -> float:
         """Union this window's dependency edges into the store and update
         per-endpoint record/last-usage metadata. Returns THIS call's
@@ -282,7 +326,7 @@ class EndpointGraph:
                 window_ops.MAX_DEPTH,
                 _pow2(max(1, packed.max_trace_len - 1), minimum=4),
             )
-            dev_in, transfer_ms = self._to_device(
+            host_in = (
                 packed.pack(packed.parent_slots(batch.parent_idx), -1),
                 packed.pack(batch.kind, 0),
                 packed.pack(batch.valid, False),
@@ -293,15 +337,38 @@ class EndpointGraph:
                 len(self.interner.endpoints) <= EDGE_KEY_MAX_EP
                 and depth <= EDGE_KEY_MAX_DIST
             )
-            s, d, ds, count = _window_edges_compact(
-                *dev_in,
-                max_depth=depth,
-                stage_cap=self._stage_cap(),
-                packed_key=packed_key,
-            )
+            mesh = self._deploy_mesh(host_in[0].shape[0])
+            if mesh is not None:
+                from kmamiz_tpu.parallel.mesh import (
+                    sharded_window_edges_compact,
+                )
+
+                fills = (-1, 0, False, 0)
+                dev_in, transfer_ms = self._to_device_sharded(
+                    mesh,
+                    *(
+                        self._pad_rows_for(mesh, a, f)
+                        for a, f in zip(host_in, fills)
+                    ),
+                )
+                s, d, ds, count = sharded_window_edges_compact(
+                    mesh,
+                    *dev_in,
+                    max_depth=depth,
+                    stage_cap=self._stage_cap(),
+                    packed_key=packed_key,
+                )
+            else:
+                dev_in, transfer_ms = self._to_device(*host_in)
+                s, d, ds, count = _window_edges_compact(
+                    *dev_in,
+                    max_depth=depth,
+                    stage_cap=self._stage_cap(),
+                    packed_key=packed_key,
+                )
             if hasattr(count, "copy_to_host_async"):
                 count.copy_to_host_async()
-            self._staged.append((s, d, ds, count, dev_in, depth))
+            self._staged.append((s, d, ds, count, dev_in, depth, mesh))
             # the pinned walk inputs (kept for the truncated-prefix
             # re-walk fallback) dominate a large window's staged HBM, so
             # they count toward the drain backstop too: one packed slot
@@ -448,9 +515,31 @@ class EndpointGraph:
             [self._dist],
             [self._src != SENTINEL],
         )
-        for s, d, ds, count, dev_in, depth in staged:
-            if int(count) > int(s.shape[0]):  # truncated prefix: re-walk
-                s, d, ds, m = _window_edges_packed(*dev_in, max_depth=depth)
+        for s, d, ds, count, dev_in, depth, mesh in staged:
+            # per-shard prefix width: sharded entries carry one stage_cap
+            # prefix per device and an [n_dev] count vector
+            cap = int(s.shape[0])
+            if mesh is not None:
+                cap //= mesh.shape["spans"]
+            if (np.asarray(count) > cap).any():  # truncated: re-walk
+                if mesh is None:
+                    s, d, ds, m = _window_edges_packed(
+                        *dev_in, max_depth=depth
+                    )
+                else:
+                    from kmamiz_tpu.parallel.mesh import (
+                        sharded_dependency_edges_packed,
+                    )
+
+                    a_, d_, ds_, m_ = sharded_dependency_edges_packed(
+                        mesh, *dev_in, max_depth=depth
+                    )
+                    s, d, ds, m = (
+                        a_.reshape(-1),
+                        d_.reshape(-1),
+                        ds_.reshape(-1),
+                        m_.reshape(-1),
+                    )
                 srcs.append(s)
                 dsts.append(d)
                 dists.append(ds)

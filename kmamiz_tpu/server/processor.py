@@ -731,17 +731,45 @@ class DeviceStatsJob:
         self._statuses = statuses
         self._num_statuses = max(len(statuses), 1)
 
-        stats = window_ops.window_stats(
-            jnp.asarray(eid),
-            jnp.asarray(sid),
-            jnp.asarray(scl),
-            jnp.asarray(lat.astype(np.float64)),
-            jnp.asarray(ts_rel),
-            jnp.asarray(valid),
-            num_endpoints=max(len(endpoints), 1),
-            num_statuses=self._num_statuses,
-            backend=segment_backend(),
-        )
+        from kmamiz_tpu.parallel.mesh import active_mesh
+
+        mesh = active_mesh()
+        if mesh is not None and cap % mesh.shape["spans"] == 0:
+            # deployed multi-device path (VERDICT r4 #1): span rows
+            # shard over the mesh, each chip computes its local segment
+            # sums, one psum over ICI merges them — the collective
+            # replacement for the reference's single-threaded
+            # combine-merge (CombinedRealtimeDataList.ts:278-315)
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from kmamiz_tpu.parallel.mesh import sharded_window_stats
+
+            sh = NamedSharding(mesh, P("spans"))
+            put = lambda a: jax.device_put(jnp.asarray(a), sh)
+            stats = sharded_window_stats(
+                mesh,
+                put(eid),
+                put(sid),
+                put(scl),
+                put(lat.astype(np.float64)),
+                put(ts_rel),
+                put(valid),
+                num_endpoints=max(len(endpoints), 1),
+                num_statuses=self._num_statuses,
+                backend=segment_backend(),
+            )
+        else:
+            stats = window_ops.window_stats(
+                jnp.asarray(eid),
+                jnp.asarray(sid),
+                jnp.asarray(scl),
+                jnp.asarray(lat.astype(np.float64)),
+                jnp.asarray(ts_rel),
+                jnp.asarray(valid),
+                num_endpoints=max(len(endpoints), 1),
+                num_statuses=self._num_statuses,
+                backend=segment_backend(),
+            )
         # ONE packed buffer: individual np.asarray calls each pay a full
         # device-sync round trip (expensive on a tunneled TPU)
         self._packed = _pack_stats(

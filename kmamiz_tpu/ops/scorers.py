@@ -80,10 +80,14 @@ def service_scores(
 
     # direction rows: "on" = owner src sees linked dst; "by" = owner dst sees
     # linked src. Distinct (owner, linked_svc, linked_ml, dist, dir) tuples.
-    # Key order puts (owner, linked, dir) FIRST so one sort serves all three
-    # granularities: full-tuple distincts for the detail counts, and
-    # prefix-boundary distincts for instability/ACS — replacing two further
-    # lexsorts (TPU sorts cost one pass per key) with segment ops.
+    # Key order exploits TWO properties downstream (each worth ~100 ms at
+    # the 100k-endpoint scale, where scatter-based segment ops dominate):
+    # (owner, linked, dir) FIRST makes every per-owner reduction a
+    # contiguous run of the sorted order — cumsum + searchsorted boundary
+    # differences instead of 8M-row TPU scatters; dist BEFORE ml makes the
+    # first row of each (owner, linked, dir) triple carry the triple's
+    # MINIMUM distance, so "triple contains a distance-1 row" is read off
+    # that row directly instead of an 8M-segment segment_max + gather.
     owner = jnp.concatenate([src_svc, dst_svc])
     linked = jnp.concatenate([dst_svc, src_svc])
     linked_ml = jnp.concatenate([dst_ml, src_ml])
@@ -93,13 +97,28 @@ def service_scores(
     )  # 0 = on/SERVER, 1 = by/CLIENT
     both_mask = jnp.concatenate([mask, mask])
 
-    (s_owner, s_linked, s_dir, _s_ml, s_dist), uniq = lex_unique(
-        (owner, linked, ddir, linked_ml, ddist), both_mask
+    (s_owner, s_linked, s_dir, s_dist, _s_ml), uniq = lex_unique(
+        (owner, linked, ddir, ddist, linked_ml), both_mask
     )
 
     park = num_services
     owner_seg = jnp.where(uniq, s_owner, park)
     row_valid = s_owner != SENTINEL
+
+    # per-owner reductions over the sorted rows: rows of service k occupy
+    # [lo[k], hi[k]), parked rows (SENTINEL owner) sort past every id.
+    # Counts cumsum in int32, which is exact (values are 0/1 and the
+    # total fits easily), so the boundary difference equals the scatter
+    # segment_sum bit for bit.
+    svc_ids = jnp.arange(num_services, dtype=jnp.int32)
+    lo = jnp.searchsorted(s_owner, svc_ids, side="left")
+    hi = jnp.searchsorted(s_owner, svc_ids, side="right")
+
+    def owner_count(flags) -> jnp.ndarray:
+        c = jnp.concatenate(
+            [jnp.zeros(1, jnp.int32), jnp.cumsum(flags.astype(jnp.int32))]
+        )
+        return (c[hi] - c[lo]).astype(jnp.float32)
 
     # -- distinct (owner, linked, direction): prefix boundaries --------------
     prefix_neq = (
@@ -109,39 +128,16 @@ def service_scores(
     )
     triple_first = jnp.concatenate([jnp.array([True]), prefix_neq]) & row_valid
     fdir = s_dir == 0
-    triple_seg = jnp.where(triple_first, s_owner, park)
-    inst_on = jax.ops.segment_sum(
-        (triple_first & fdir).astype(jnp.float32),
-        triple_seg,
-        num_segments=park + 1,
-    )[:-1]
-    inst_by = jax.ops.segment_sum(
-        (triple_first & ~fdir).astype(jnp.float32),
-        triple_seg,
-        num_segments=park + 1,
-    )[:-1]
+    inst_on = owner_count(triple_first & fdir)
+    inst_by = owner_count(triple_first & ~fdir)
     total = inst_on + inst_by
     instability = jnp.where(total > 0, inst_on / jnp.maximum(total, 1), 0.0)
 
     # -- ACS at distance 1: triples containing any distance-1 row ------------
-    cap = s_owner.shape[0]
-    triple_gid = jnp.cumsum(triple_first.astype(jnp.int32)) - 1
-    has_d1 = jax.ops.segment_max(
-        ((s_dist == 1) & row_valid).astype(jnp.int32),
-        jnp.maximum(triple_gid, 0),
-        num_segments=cap,
-    )
-    d1_at_row = has_d1[jnp.maximum(triple_gid, 0)] > 0
-    ads = jax.ops.segment_sum(
-        (triple_first & fdir & d1_at_row).astype(jnp.float32),
-        triple_seg,
-        num_segments=park + 1,
-    )[:-1]
-    ais_links = jax.ops.segment_sum(
-        (triple_first & ~fdir & d1_at_row).astype(jnp.float32),
-        triple_seg,
-        num_segments=park + 1,
-    )[:-1]
+    # dist sorts before ml, so a triple's first row carries its min dist
+    d1_at_first = s_dist == 1
+    ads = owner_count(triple_first & fdir & d1_at_first)
+    ais_links = owner_count(triple_first & ~fdir & d1_at_first)
 
     # gateway: a service owning an endpoint record with zero depended-by
     # edges (reference: dependency.find(d => d.dependingBy.length === 0))

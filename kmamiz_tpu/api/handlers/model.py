@@ -39,11 +39,12 @@ class ModelHandler(IRequestHandler):
         self._lock = threading.Lock()
         self._loaded = None  # (params, meta, model_module) | None
         self._load_error: Optional[str] = None
-        # a missing/empty checkpoint directory is TRANSIENT (the trainer
-        # may simply not have written its first step yet): such failures
+        # a missing/empty checkpoint directory, a mid-rewrite sidecar, or
+        # a vanished step directory are TRANSIENT (the trainer may not
+        # have written — or be rewriting — its step): such failures
         # re-attempt on later requests, rate-limited, instead of pinning
         # a 503 until restart. Terminal errors (no model dir configured,
-        # embedding checkpoints, restore failures) cache permanently.
+        # embedding checkpoints, unexpected exceptions) cache permanently.
         self._error_transient = False
         self._next_retry = 0.0
 
@@ -51,6 +52,15 @@ class ModelHandler(IRequestHandler):
         self.add_route("get", "/forecast", self._forecast)
 
     RETRY_SECONDS = 5.0
+
+    def _mark_transient(self, msg: str) -> None:
+        """Record a transient load failure (rate-limited retry). Caller
+        holds self._lock; returns None so `return self._mark_transient(...)`
+        reads as the failure exit."""
+        self._load_error = msg
+        self._error_transient = True
+        self._next_retry = time.monotonic() + self.RETRY_SECONDS
+        return None
 
     # -- checkpoint loading (lazy, once) -------------------------------------
 
@@ -81,22 +91,18 @@ class ModelHandler(IRequestHandler):
 
                 step = ckpt.latest_complete_step(directory)
                 if step is None:
-                    self._load_error = f"no complete checkpoint in {directory}"
-                    self._error_transient = True
-                    self._next_retry = time.monotonic() + self.RETRY_SECONDS
-                    return None
+                    return self._mark_transient(
+                        f"no complete checkpoint in {directory}"
+                    )
                 meta = ckpt.load_metadata(directory, step) or {}
                 if not meta:
                     # sidecar vanished between listing and read: the
                     # trainer is mid-rewrite of this step — same
                     # transient class as "not written yet"
-                    self._load_error = (
+                    return self._mark_transient(
                         f"checkpoint step {step} metadata unreadable "
                         f"(trainer mid-write?)"
                     )
-                    self._error_transient = True
-                    self._next_retry = time.monotonic() + self.RETRY_SECONDS
-                    return None
                 if int(meta.get("num_nodes", 0)):
                     self._load_error = (
                         "checkpoint uses node-identity embeddings; only "
@@ -119,16 +125,21 @@ class ModelHandler(IRequestHandler):
                     # the step directory disappeared between listing and
                     # restore (trainer re-saving the same step): transient
                     # — a complete checkpoint reappears moments later
-                    self._load_error = f"restore failed for {directory}"
-                    self._error_transient = True
-                    self._next_retry = time.monotonic() + self.RETRY_SECONDS
-                    return None
+                    return self._mark_transient(
+                        f"restore failed for {directory}"
+                    )
                 params, _opt, meta = restored
                 self._loaded = (params, dict(meta), model)
                 self._load_error = None  # clear a prior transient failure
                 logger.info(
                     "forecast model loaded from %s step %s", directory, step
                 )
+            except OSError as err:
+                # filesystem races with a concurrently-writing trainer
+                # (step dir pruned mid-restore, etc) are the same
+                # transient class as "not written yet"
+                logger.warning("forecast model load raced a writer: %s", err)
+                return self._mark_transient(f"model load raced a writer: {err}")
             except Exception as err:  # noqa: BLE001 - surfaced via /status
                 self._load_error = f"model load failed: {err}"
                 logger.exception("forecast model load failed")

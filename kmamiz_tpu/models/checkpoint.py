@@ -44,8 +44,13 @@ def save_checkpoint(
         os.remove(f"{path}.meta.json")
     payload = {"params": params._asdict(), "opt_state": opt_state}
     _checkpointer().save(path, payload)
-    with open(f"{path}.meta.json", "w") as f:
+    # atomic sidecar: a concurrent reader (the serving ModelHandler polls
+    # this directory) must never observe a half-written meta file — it
+    # either sees no sidecar (incomplete save) or the full JSON
+    tmp = f"{path}.meta.json.tmp"
+    with open(tmp, "w") as f:
         json.dump({"step": step, **(metadata or {})}, f)
+    os.replace(tmp, f"{path}.meta.json")
     return path
 
 

@@ -34,10 +34,31 @@ appends NUM_HISTORY_FEATURES columns after the base ones.
 """
 from __future__ import annotations
 
+import base64
 from typing import List
 
 import jax.numpy as jnp
 import numpy as np
+
+
+def encode_array(a: np.ndarray) -> dict:
+    """JSON-safe array encoding (dtype + shape + base64 raw bytes) for
+    the store-persisted history snapshot: exact round trip, ~25% size
+    overhead vs raw — far smaller than digit strings at profile scale."""
+    a = np.ascontiguousarray(a)
+    return {
+        "dtype": str(a.dtype),
+        "shape": list(a.shape),
+        "data": base64.b64encode(a.tobytes()).decode("ascii"),
+    }
+
+
+def decode_array(d: dict) -> np.ndarray:
+    return (
+        np.frombuffer(base64.b64decode(d["data"]), dtype=d["dtype"])
+        .reshape(d["shape"])
+        .copy()
+    )
 
 from kmamiz_tpu.models.trainer import (
     ANOMALY_ERROR_SHARE,
@@ -280,3 +301,65 @@ class HistoryState:
         self._err_obs[hour] += act
         self._prev_err5, self._prev_lat = err5, lat
         return cols
+
+    # -- persistence (VERDICT r4 #4) -----------------------------------------
+    #
+    # The profiles in this state take days of traffic to build (24-hour
+    # per-endpoint anomaly/error histories); every other piece of live
+    # state rides the cacheable init/sync contract
+    # (/root/reference/src/classes/Cacheable/Cacheable.ts:42-55), so this
+    # one does too. Documents carry raw array bytes (encode_array);
+    # re-keying across restarts happens OUTSIDE this class, by endpoint
+    # name (remap), because intern ids shift between processes.
+
+    _ARRAY_FIELDS = (
+        "_label_sum",
+        "_label_obs",
+        "_err_sum",
+        "_err_obs",
+        "_prev_err5",
+        "_prev_lat",
+        "_deg_in",
+        "_deg_out",
+    )
+
+    def to_doc(self) -> dict:
+        """Exact serializable snapshot of the accumulators."""
+        doc = {
+            "n": self._n,
+            "started": self._started,
+            "window": [encode_array(w) for w in self._window],
+        }
+        for f in self._ARRAY_FIELDS:
+            doc[f.lstrip("_")] = encode_array(getattr(self, f))
+        return doc
+
+    @classmethod
+    def from_doc(cls, doc: dict) -> "HistoryState":
+        state = cls(0)
+        state._n = int(doc["n"])
+        state._started = bool(doc["started"])
+        state._window = [
+            decode_array(w).astype(np.float32) for w in doc["window"]
+        ]
+        for f in cls._ARRAY_FIELDS:
+            setattr(state, f, decode_array(doc[f.lstrip("_")]))
+        return state
+
+    def remap(self, new_ids: np.ndarray, n_new: int) -> None:
+        """Re-key every per-endpoint column: saved index i becomes
+        new_ids[i] in a fresh n_new-wide layout (restart re-interning —
+        the saved snapshot's names resolve to different ids in the new
+        process; endpoints absent from the snapshot start empty)."""
+        ids = np.asarray(new_ids, dtype=np.int64)
+
+        def scatter(a):
+            out = np.zeros(a.shape[:-1] + (n_new,), dtype=a.dtype)
+            k = min(a.shape[-1], len(ids))
+            out[..., ids[:k]] = a[..., :k]
+            return out
+
+        for f in self._ARRAY_FIELDS:
+            setattr(self, f, scatter(getattr(self, f)))
+        self._window = [scatter(w) for w in self._window]
+        self._n = n_new

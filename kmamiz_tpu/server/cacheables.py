@@ -645,3 +645,44 @@ class CSimulatedHistoricalData(Cacheable):
 
     def insert_one(self, one: HistoricalData) -> None:
         self.set_data(self.get_data() + [one])
+
+
+class CModelHistoryState(Cacheable):
+    """Persistence vehicle for the online forecast-model state (VERDICT
+    r4 #4): hour-keyed per-endpoint profiles take days of traffic to
+    build, so they honor the same init/sync contract as every other live
+    cache (Cacheable.ts:42-55) — restored at boot keyed by endpoint
+    NAME, flushed on the dispatch rotation and at shutdown. The data
+    itself lives on the DataProcessor (models/history.HistoryState); this
+    cache holds no copy, it snapshots on sync and restores on init."""
+
+    unique_name = "ModelHistoryState"
+    can_export = False  # live serving state, like LookBackRealtimeData
+
+    def __init__(
+        self,
+        store: Optional[Store] = None,
+        processor: Optional[Any] = None,
+        simulator_mode: bool = False,
+    ) -> None:
+        super().__init__(self.unique_name, None)
+        if store is not None and processor is not None:
+
+            def init() -> None:
+                docs = store.find_all(self.unique_name)
+                if docs:
+                    # restore_history picks the newest COMPLETE part set
+                    processor.restore_history(docs)
+
+            def docs_fn() -> Optional[list]:
+                # a list of chunked part documents (each a few MB at
+                # most, under any backend's document-size cap); None
+                # before the first observed tick leaves the stored
+                # snapshot alone rather than wiping it
+                return processor.snapshot_history()
+
+            self._set_init(init, simulator_mode)
+            self._set_sync(
+                _replace_all_sync(store, self.unique_name, docs_fn),
+                simulator_mode,
+            )

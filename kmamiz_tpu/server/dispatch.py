@@ -7,10 +7,13 @@ boolean-lock + spin-wait of the reference becomes a real threading.Lock.
 """
 from __future__ import annotations
 
+import logging
 import threading
 from typing import List
 
 from kmamiz_tpu.server.cache import DataCache
+
+logger = logging.getLogger("kmamiz_tpu.dispatch")
 
 
 class DispatchStorage:
@@ -30,17 +33,28 @@ class DispatchStorage:
         return entries
 
     def sync(self) -> None:
-        """Flush the next cache in rotation (one per dispatch tick)."""
+        """Flush the next cache in rotation (one per dispatch tick). A
+        failing flush logs and leaves the rotation intact — the cache
+        retries on its next turn."""
         strategies = self.sync_strategies
         if not strategies:
             return
         with self._lock:
             self._sync_type = (self._sync_type + 1) % len(strategies)
             name, sync_fn = strategies[self._sync_type]
-            sync_fn()
+            try:
+                sync_fn()
+            except Exception:  # noqa: BLE001 - one cache must not wedge the cron
+                logger.exception("dispatch sync of %s failed", name)
 
     def sync_all(self) -> None:
-        """Flush every cache (graceful-shutdown path)."""
+        """Flush every cache (graceful-shutdown path). Per-cache error
+        isolation: one failing flush (e.g. a store rejecting an
+        oversized document) must not abort the loop and silently drop
+        every cache sorted after it."""
         with self._lock:
-            for _, sync_fn in self.sync_strategies:
-                sync_fn()
+            for name, sync_fn in self.sync_strategies:
+                try:
+                    sync_fn()
+                except Exception:  # noqa: BLE001 - flush the rest regardless
+                    logger.exception("shutdown sync of %s failed", name)

@@ -26,6 +26,7 @@ from kmamiz_tpu.server.cacheables import (
     CLabelMapping,
     CLabeledEndpointDependencies,
     CLookBackRealtimeData,
+    CModelHistoryState,
     CReplicas,
     CSimulatedHistoricalData,
     CTaggedDiffData,
@@ -146,9 +147,25 @@ class ImportExportHandler:
             if name not in ("AggregatedData", "HistoricalData")
         ]
         ctx.cache.import_data(cache_pairs, self._cacheable_factory)
-        ctx.cache.register(
-            [CLookBackRealtimeData(store=ctx.store, simulator_mode=ctx.settings.simulator_mode)]
-        )
+        # non-exportable caches are absent from the pairs; re-register
+        # them or the rebuilt registry silently drops their sync hooks
+        # (the dispatch rotation would never flush them again)
+        extra: List[Any] = [
+            CLookBackRealtimeData(
+                store=ctx.store, simulator_mode=ctx.settings.simulator_mode
+            )
+        ]
+        if ctx.processor is not None and hasattr(
+            ctx.processor, "snapshot_history"
+        ):
+            extra.append(
+                CModelHistoryState(
+                    store=ctx.store,
+                    processor=ctx.processor,
+                    simulator_mode=ctx.settings.simulator_mode,
+                )
+            )
+        ctx.cache.register(extra)
 
         if not skip_collections:
             aggregated = next(

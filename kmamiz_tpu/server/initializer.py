@@ -29,6 +29,7 @@ from kmamiz_tpu.server.cacheables import (
     CLabelMapping,
     CLabeledEndpointDependencies,
     CLookBackRealtimeData,
+    CModelHistoryState,
     CReplicas,
     CSimulatedHistoricalData,
     CTaggedDiffData,
@@ -133,6 +134,17 @@ class Initializer:
             CUserDefinedLabel(store=store, simulator_mode=sim),
             CLookBackRealtimeData(store=store, simulator_mode=sim),
         ]
+        # online forecast-model state persists only when a processor owns
+        # it (production / DP-serving modes); serve-only and simulator
+        # modes have no online history to checkpoint
+        if ctx.processor is not None and hasattr(
+            ctx.processor, "snapshot_history"
+        ):
+            caches.append(
+                CModelHistoryState(
+                    store=store, processor=ctx.processor, simulator_mode=sim
+                )
+            )
         if sim:
             caches.append(CTaggedSimulationYAML())
             caches.append(CSimulatedHistoricalData())

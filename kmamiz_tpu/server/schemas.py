@@ -321,6 +321,50 @@ TAGGED_DIFF_DATA = {
     "endpointDataTypesMap": "any",
 }
 
+# encoded ndarray (models/history.encode_array): dtype + shape + base64
+_ENCODED_ARRAY = {"dtype": "str", "shape": ["num"], "data": "str"}
+
+# the online-model snapshot (DataProcessor.snapshot_history) — the 10th
+# collection, an extension past the reference's nine Mongoose models: the
+# reference has no online forecasting state to persist. Hour-keyed
+# per-endpoint profiles take days of traffic to build, so they ride the
+# same dispatch-cron/shutdown sync contract as every reference cache.
+MODEL_HISTORY_STATE = {
+    "savedAt": "date",
+    # chunked part documents (endpoint ranges): no single doc outgrows a
+    # backend's size cap; a restore stitches the newest complete set
+    "part": Opt("num"),
+    "parts": Opt("num"),
+    "names": ["str"],
+    "state": {
+        "n": "num",
+        "started": "bool",
+        "window": [_ENCODED_ARRAY],
+        "label_sum": _ENCODED_ARRAY,
+        "label_obs": _ENCODED_ARRAY,
+        "err_sum": _ENCODED_ARRAY,
+        "err_obs": _ENCODED_ARRAY,
+        "prev_err5": _ENCODED_ARRAY,
+        "prev_lat": _ENCODED_ARRAY,
+        "deg_in": _ENCODED_ARRAY,
+        "deg_out": _ENCODED_ARRAY,
+    },
+    "hourBucket": Opt({"hour": "num", "arrays": [_ENCODED_ARRAY]}),
+    "forecast": Opt(
+        {
+            "features": _ENCODED_ARRAY,
+            "src": _ENCODED_ARRAY,
+            "dst": _ENCODED_ARRAY,
+            "mask": _ENCODED_ARRAY,
+            "names": ["str"],
+            "predictedHour": "num",
+        }
+    ),
+    "historyFeatures": Opt(_ENCODED_ARRAY),
+    "modelFeatures": Opt(_ENCODED_ARRAY),
+    "predictedHour": Opt("num"),
+}
+
 SCHEMAS: Dict[str, dict] = {
     "AggregatedData": AGGREGATED_DATA,
     "HistoricalData": HISTORICAL_DATA,
@@ -331,6 +375,7 @@ SCHEMAS: Dict[str, dict] = {
     "TaggedInterface": TAGGED_INTERFACE,
     "TaggedSwagger": TAGGED_SWAGGER,
     "TaggedDiffData": TAGGED_DIFF_DATA,
+    "ModelHistoryState": MODEL_HISTORY_STATE,
 }
 
 # -- migrations --------------------------------------------------------------

@@ -57,6 +57,9 @@ COLLECTIONS = (
     "TaggedInterface",
     "TaggedSwagger",
     "TaggedDiffData",
+    # extension past the reference's nine models: the online forecast
+    # model's history profiles (DataProcessor.snapshot_history)
+    "ModelHistoryState",
 )
 
 

@@ -42,11 +42,18 @@ def build_production_context(app_settings: Optional[Settings] = None) -> AppCont
     # forceKMamizSync startup handshake there (index.ts:57-60); schedules
     # that would use them are simply never registered
     if not (s.simulator_mode or s.serve_only):
-        from kmamiz_tpu import native
         from kmamiz_tpu.ingestion import KubernetesClient, ZipkinClient
         from kmamiz_tpu.server.processor import DataProcessor
 
-        native.available()  # one-time extension build, off the request path
+        if not s.read_only_mode:
+            # one-time native-extension build, off the request path.
+            # Read-only mode skips it (VERDICT r4 #7): it never ingests
+            # raw spans, and a cold probe compiles the C++ loader —
+            # tens of seconds a mode that only reads the store must not
+            # pay at boot
+            from kmamiz_tpu import native
+
+            native.available()
         zipkin = ZipkinClient(s.zipkin_url)
         if s.is_running_in_kubernetes:
             k8s = KubernetesClient.from_service_account(s.kube_api_host)

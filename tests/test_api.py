@@ -991,3 +991,79 @@ class TestModelRoutes:
         status = model_router.dispatch("GET", "/api/v1/model/status").payload
         assert status["modelLoaded"] is False
         assert "identity" in status["error"]
+
+
+class TestServeOnlyBootWeight:
+    """Serve-only boot must answer health fast (VERDICT r4 #7): no device
+    work can ever happen in that mode, so nothing on its import closure
+    may pull jax (in environments without an interpreter-level preload,
+    jax import alone costs seconds) and nothing at boot may trigger the
+    native-extension build."""
+
+    def test_serve_only_import_closure_is_jax_free(self):
+        """Static audit: walk the import graph of kmamiz_tpu.api.app
+        (the serve-only entry) and assert no reachable first-party
+        module has a TOP-LEVEL jax import — device modules must be
+        imported lazily from the paths that use them."""
+        import ast
+        import os
+
+        pkg_root = os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))
+        )
+
+        def module_path(mod):
+            base = os.path.join(pkg_root, mod.replace(".", os.sep))
+            for cand in (base + ".py", os.path.join(base, "__init__.py")):
+                if os.path.isfile(cand):
+                    return cand
+            return None
+
+        def top_level_imports(path):
+            tree = ast.parse(open(path).read())
+            out = set()
+            for node in tree.body:
+                if isinstance(node, ast.Import):
+                    out.update(a.name for a in node.names)
+                elif isinstance(node, ast.ImportFrom) and node.module:
+                    out.add(node.module)
+            return out
+
+        seen, stack = set(), ["kmamiz_tpu.api.app"]
+        offenders = []
+        while stack:
+            mod = stack.pop()
+            if mod in seen:
+                continue
+            seen.add(mod)
+            path = module_path(mod)
+            if path is None:
+                continue  # stdlib / third-party
+            for imp in top_level_imports(path):
+                if imp == "jax" or imp.startswith("jax."):
+                    offenders.append(mod)
+                elif imp.startswith("kmamiz_tpu"):
+                    stack.append(imp)
+        assert not offenders, (
+            f"serve-only import closure pulls jax via: {offenders}"
+        )
+
+    def test_read_only_skips_native_probe(self, monkeypatch):
+        """Read-only mode never ingests raw spans; boot must not pay the
+        native-extension build probe."""
+        from kmamiz_tpu import native
+        from kmamiz_tpu.api import app as app_mod
+
+        called = []
+        monkeypatch.setattr(
+            native, "available", lambda: called.append(1) or True
+        )
+        settings = Settings()
+        settings.read_only_mode = True
+        settings.serve_only = False
+        settings.simulator_mode = False
+        settings.external_data_processor = ""
+        settings.storage_uri = "memory://"
+        ctx = app_mod.build_production_context(settings)
+        assert called == []
+        assert ctx.processor is not None  # clients still built (sync handshake)

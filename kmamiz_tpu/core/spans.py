@@ -345,28 +345,56 @@ def raw_spans_to_batch(
     n = parsed["n_spans"]
 
     # resolve each distinct naming shape once (same order the dict path
-    # would first-encounter them in)
+    # would first-encounter them in). Resolutions cache on the interner
+    # across calls: a chunked stream re-encounters the same shapes every
+    # page, and re-resolving ~10k shapes (URL explode + naming joins) per
+    # chunk costs more than the native parse saves at production
+    # endpoint diversity. _NamingEntry is immutable ids, and a cache hit
+    # skips only work whose outputs are already interned.
+    shape_cache = getattr(interner, "_raw_shape_cache", None)
+    if shape_cache is None:
+        shape_cache = interner._raw_shape_cache = {}
+    # fields arrive as raw bytes (native marshalling defers the decode
+    # to the miss path — the warm path never needs it). ALL misses
+    # decode BEFORE any interning: a malformed shape must reject the
+    # payload with the documented None return, not raise mid-loop after
+    # earlier shapes already mutated the shared interner.
+    try:
+        decoded = {
+            key: tuple(
+                f.decode("utf-8", "surrogatepass") for f in key[0]
+            )
+            for shape in parsed["shapes"]
+            if (key := (shape[0], shape[1], shape[2])) not in shape_cache
+        }
+    except UnicodeDecodeError:
+        return None
     entries: List[_NamingEntry] = []
     for fields, url_present, bits in parsed["shapes"]:
-        name, url, method, svc, ns, rev, mesh = fields
-        tags: Dict[str, str] = {}
-        if url_present:
-            tags["http.url"] = url
-        if bits & native_mod.SHAPE_HAS_METHOD:
-            tags["http.method"] = method
-        if bits & native_mod.SHAPE_HAS_SVC:
-            tags["istio.canonical_service"] = svc
-        if bits & native_mod.SHAPE_HAS_NS:
-            tags["istio.namespace"] = ns
-        if bits & native_mod.SHAPE_HAS_REV:
-            tags["istio.canonical_revision"] = rev
-        if bits & native_mod.SHAPE_HAS_MESH:
-            tags["istio.mesh_id"] = mesh
-        # timestamp 0: the freshest-timestamp info is applied below from
-        # the per-shape max, which dominates any intermediate value
-        entries.append(
-            _make_naming_entry({"name": name, "timestamp": 0, "tags": tags}, tags, interner)
-        )
+        cache_key = (fields, url_present, bits)
+        entry = shape_cache.get(cache_key)
+        if entry is None:
+            name, url, method, svc, ns, rev, mesh = decoded[cache_key]
+            tags: Dict[str, str] = {}
+            if url_present:
+                tags["http.url"] = url
+            if bits & native_mod.SHAPE_HAS_METHOD:
+                tags["http.method"] = method
+            if bits & native_mod.SHAPE_HAS_SVC:
+                tags["istio.canonical_service"] = svc
+            if bits & native_mod.SHAPE_HAS_NS:
+                tags["istio.namespace"] = ns
+            if bits & native_mod.SHAPE_HAS_REV:
+                tags["istio.canonical_revision"] = rev
+            if bits & native_mod.SHAPE_HAS_MESH:
+                tags["istio.mesh_id"] = mesh
+            # timestamp 0: the freshest-timestamp info is applied below
+            # from the per-shape max, which dominates any intermediate
+            entry = _make_naming_entry(
+                {"name": name, "timestamp": 0, "tags": tags}, tags, interner
+            )
+            shape_cache[cache_key] = entry
+        entries.append(entry)
 
     # distinct statuses -> interner ids + status classes
     st_ids = np.empty(max(len(parsed["statuses"]), 1), dtype=np.int32)

@@ -455,11 +455,14 @@ class EndpointGraph:
         """Per-window compacted-prefix width (static kernel shape). A
         window carrying more distinct edges than this still merges
         correctly via the drain's re-walk fallback — this cap only sets
-        the fast path's width."""
+        the fast path's width. Default 2^18: a production-diversity
+        window (10k endpoints, >100k distinct edges per page) fits the
+        fast path with room; the HBM cost is 3 int32 columns per staged
+        window (~3 MB)."""
         try:
-            return int(os.environ.get("KMAMIZ_STAGE_CAP", 1 << 17))
+            return int(os.environ.get("KMAMIZ_STAGE_CAP", 1 << 18))
         except ValueError:
-            return 1 << 17
+            return 1 << 18
 
     def _finalize_pending(self) -> None:
         """Resolve the deferred merge: fetch the edge count and re-pad the
@@ -515,53 +518,108 @@ class EndpointGraph:
             [self._dist],
             [self._src != SENTINEL],
         )
+        deferred = []  # truncation checks postponed past the union dispatch
         for s, d, ds, count, dev_in, depth, mesh in staged:
             # per-shard prefix width: sharded entries carry one stage_cap
             # prefix per device and an [n_dev] count vector
             cap = int(s.shape[0])
             if mesh is not None:
                 cap //= mesh.shape["spans"]
-            if (np.asarray(count) > cap).any():  # truncated: re-walk
-                if mesh is None:
-                    s, d, ds, m = _window_edges_packed(
-                        *dev_in, max_depth=depth
-                    )
-                else:
-                    from kmamiz_tpu.parallel.mesh import (
-                        sharded_dependency_edges_packed,
-                    )
-
-                    a_, d_, ds_, m_ = sharded_dependency_edges_packed(
-                        mesh, *dev_in, max_depth=depth
-                    )
-                    s, d, ds, m = (
-                        a_.reshape(-1),
-                        d_.reshape(-1),
-                        ds_.reshape(-1),
-                        m_.reshape(-1),
-                    )
-                srcs.append(s)
-                dsts.append(d)
-                dists.append(ds)
-                masks.append(m)
+            if not (
+                hasattr(count, "is_ready") and not count.is_ready()
+            ):
+                counts = np.asarray(count)
+                if (counts > cap).any():  # truncated: re-walk now
+                    s, d, ds, m = self._rewalk_staged(dev_in, depth, mesh)
+                    srcs.append(s)
+                    dsts.append(d)
+                    dists.append(ds)
+                    masks.append(m)
+                    continue
+                # slice the prefix down to its TRUE unique count: a
+                # window with 1k distinct edges contributes ~1k rows to
+                # the union sort instead of stage_cap of SENTINEL
+                # padding. Pow2-bucketed widths keep the union program
+                # count bounded.
+                k = min(cap, _pow2(max(int(counts.max()), 1), minimum=256))
+                if k < cap:
+                    if mesh is None:
+                        s, d, ds = s[:k], d[:k], ds[:k]
+                    else:
+                        n_dev = mesh.shape["spans"]
+                        s, d, ds = (
+                            a.reshape(n_dev, -1)[:, :k].reshape(-1)
+                            for a in (s, d, ds)
+                        )
             else:
-                srcs.append(s)
-                dsts.append(d)
-                dists.append(ds)
-                masks.append(s != SENTINEL)
-        src = jnp.concatenate(srcs)
-        dst = jnp.concatenate(dsts)
-        dist = jnp.concatenate(dists)
-        mask = jnp.concatenate(masks)
-        if (
-            len(self.interner.endpoints) <= EDGE_KEY_MAX_EP
-            and self._min_dist >= 1
-            and self._max_dist <= EDGE_KEY_MAX_DIST
-        ):
-            (s, d, ds), v = compact_unique_edges_packed(src, dst, dist, mask)
-        else:
-            (s, d, ds), v = compact_unique((src, dst, dist), mask)
-        self._apply_merged(s, d, ds, v.sum())
+                # the count copy has not landed yet (the final chunk of
+                # a stream: its walk kernel is still in the device
+                # queue). Blocking here would serialize one extra tunnel
+                # round trip before the union could even dispatch —
+                # instead the FULL prefix joins the union now and the
+                # truncation check resolves afterwards, overlapped with
+                # the union's own execution; a truncated prefix (rare:
+                # >stage_cap distinct edges in one window) re-walks and
+                # re-unions below.
+                deferred.append((count, cap, dev_in, depth, mesh))
+            srcs.append(s)
+            dsts.append(d)
+            dists.append(ds)
+            masks.append(s != SENTINEL)
+
+        def union(cols_src, cols_dst, cols_dist, cols_mask):
+            src = jnp.concatenate(cols_src)
+            dst = jnp.concatenate(cols_dst)
+            dist = jnp.concatenate(cols_dist)
+            mask = jnp.concatenate(cols_mask)
+            if (
+                len(self.interner.endpoints) <= EDGE_KEY_MAX_EP
+                and self._min_dist >= 1
+                and self._max_dist <= EDGE_KEY_MAX_DIST
+            ):
+                return compact_unique_edges_packed(src, dst, dist, mask)
+            return compact_unique((src, dst, dist), mask)
+
+        (s, d, ds), v = union(srcs, dsts, dists, masks)
+        count_sum = v.sum()
+        if hasattr(count_sum, "copy_to_host_async"):
+            count_sum.copy_to_host_async()
+        # resolve the deferred truncation checks (their copies now
+        # overlap the union's execution instead of preceding it)
+        rewalk = [
+            (dev_in, depth, mesh)
+            for count, cap, dev_in, depth, mesh in deferred
+            if (np.asarray(count) > cap).any()
+        ]
+        if rewalk:
+            extra = [self._rewalk_staged(*r) for r in rewalk]
+            (s, d, ds), v = union(
+                [s] + [e[0] for e in extra],
+                [d] + [e[1] for e in extra],
+                [ds] + [e[2] for e in extra],
+                [v] + [e[3] for e in extra],
+            )
+            count_sum = v.sum()
+        self._apply_merged(s, d, ds, count_sum)
+
+    @staticmethod
+    def _rewalk_staged(dev_in, depth, mesh):
+        """Full (uncompacted) candidate walk of a staged window whose
+        compacted prefix truncated — correctness never depends on the
+        stage cap."""
+        if mesh is None:
+            return _window_edges_packed(*dev_in, max_depth=depth)
+        from kmamiz_tpu.parallel.mesh import sharded_dependency_edges_packed
+
+        a_, d_, ds_, m_ = sharded_dependency_edges_packed(
+            mesh, *dev_in, max_depth=depth
+        )
+        return (
+            a_.reshape(-1),
+            d_.reshape(-1),
+            ds_.reshape(-1),
+            m_.reshape(-1),
+        )
 
     def edge_arrays(self):
         """(src_ep, dst_ep, dist, mask) snapshot of the stored edges

@@ -348,6 +348,12 @@ def parse_spans(
         kind = np.frombuffer(buf, np.int8, n, pos)
         pos += n
 
+        # shape fields stay as raw BYTES tuples: the consumer
+        # (core.spans.raw_spans_to_batch) caches shape resolutions keyed
+        # on these tuples and decodes only on a cache miss — at 10k
+        # distinct shapes per production window, eagerly decoding 70k
+        # strings per chunk costs more than the decode the warm path
+        # ever uses
         shapes = []
         for _ in range(n_shapes):
             url_present = buf[pos] != 0
@@ -357,11 +363,9 @@ def parse_spans(
             for _f in range(7):
                 (flen,) = struct.unpack_from("<I", buf, pos)
                 pos += 4
-                fields.append(
-                    buf[pos : pos + flen].decode("utf-8", "surrogatepass")
-                )
+                fields.append(bytes(buf[pos : pos + flen]))
                 pos += flen
-            shapes.append((fields, url_present, bits))
+            shapes.append((tuple(fields), url_present, bits))
 
         statuses = []
         for _ in range(n_statuses):

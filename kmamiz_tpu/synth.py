@@ -27,34 +27,49 @@ def make_raw_window(
     n_namespaces: int = 8,
     urls_per_service: int = 0,
     n_url_templates: int = 50,
+    trace_prefix: str = "w",
 ) -> bytes:
     """Serialized trace groups: `n_traces` chains of `spans_per` spans.
 
     With urls_per_service == 0 (legacy shape), every service shares the
     same `n_url_templates` url pool — endpoint diversity collapses to
     the template count. With urls_per_service > 0 (BASELINE shape),
-    each service owns its own url set, so distinct endpoints =
-    n_services * urls_per_service and the adjacency mixing drives edge
-    cardinality into production range (>=100k at 10k endpoints).
+    each service owns its own url set (distinct endpoints =
+    n_services * urls_per_service) and traces walk a STRUCTURED call
+    mesh: the entry service comes from the trace id and each hop calls
+    one of ~32 fixed callees of the current service — per-service
+    fan-out like a real mesh, not random adjacency. At the bench's
+    1k-svc/10-url config and ~150k traces this yields the full 10k
+    endpoints and >=100k distinct (ancestor, descendant, distance)
+    edges (production cardinality for the interner, shape tables, and
+    union sort).
+
+    `trace_prefix` varies the trace ids without changing the naming
+    shapes: steady-state benchmarking feeds a persistent processor
+    fresh windows that dedup as new traces while every naming shape
+    hits the warm interner — exactly like production windows after
+    boot.
     """
     groups = []
     for t in range(t_start, t_start + n_traces):
         group = []
+        svc_chain = t % n_services
         for j in range(spans_per):
             if urls_per_service:
-                # BASELINE shape: mix both hops and traces into the
-                # service choice so consecutive spans cross services
-                # and the (ancestor, descendant) pairs cover a dense
-                # edge set, the way a 1k-service mesh's call graph does
-                svc = (t * 13 + j * 7) % n_services
-                ep = (t + j * 3) % urls_per_service
+                svc = svc_chain
+                ep = (t // 7 + 3 * j) % urls_per_service
+                svc_chain = (svc_chain * 31 + (t + j) % 32 + 1) % n_services
+                # a service lives in ONE namespace (real meshes pin a
+                # workload to its namespace); a per-hop namespace would
+                # silently multiply the distinct service count
+                ns = svc % n_namespaces
             else:
                 svc = (t + j) % n_services
                 ep = (t * 7 + j) % n_url_templates
-            ns = j % n_namespaces
+                ns = j % n_namespaces
             group.append(
                 {
-                    "traceId": f"w{t}",
+                    "traceId": f"{trace_prefix}{t}",
                     "id": f"{t}-{j}",
                     "parentId": f"{t}-{j-1}" if j else None,
                     "kind": "SERVER" if j % 2 == 0 else "CLIENT",

@@ -908,3 +908,19 @@ def test_fuzz_mutated_bytes_never_crash():
     assert native.parse_spans(bad_utf8, []) is None
     with pytest.raises(UnicodeDecodeError):
         json.loads(bad_utf8)
+
+
+def test_malformed_utf8_rejects_without_interner_mutation():
+    """A payload whose span naming bytes are invalid UTF-8 must reject
+    with the documented None return BEFORE any shape interns — a raised
+    decode error mid-loop would leave phantom endpoints in the shared
+    interner from a rejected payload (review r5)."""
+    from kmamiz_tpu.core.interning import EndpointInterner
+    from kmamiz_tpu.core.spans import raw_spans_to_batch
+    from kmamiz_tpu.synth import make_raw_window
+
+    raw = make_raw_window(50, 7)
+    bad = raw.replace(b"svc1.ns1", b"svc\xb2.ns1", 1)
+    interner = EndpointInterner()
+    assert raw_spans_to_batch(bad, interner=interner) is None
+    assert len(interner.endpoints) == 0

@@ -22,7 +22,9 @@ def main() -> None:
     reps = int(sys.argv[2]) if len(sys.argv) > 2 else 5
     threads = [int(t) for t in sys.argv[3].split(",")] if len(sys.argv) > 3 else [1, 2, 4]
     t0 = time.perf_counter()
-    raw = make_raw_window(n_traces, 7)
+    # the bench headline's BASELINE workload shape (1k svc / 10 urls
+    # each) so the profiled parse IS the headline parse
+    raw = make_raw_window(n_traces, 7, n_services=1000, urls_per_service=10)
     print(f"window: {n_traces * 7} spans, {len(raw)/1e6:.1f} MB "
           f"(gen {time.perf_counter()-t0:.1f}s)")
     for T in threads:

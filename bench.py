@@ -860,6 +860,52 @@ def main() -> None:
     # latency metric vs the reference's 5 s tick budget: median
     dp_tick_ms = _timed_median(one_tick, reps=5) * 1000  # first call warms
 
+    # ---- restart warmth (VERDICT r4 #5b) -----------------------------------
+    # two fresh subprocesses share one persistent compilation cache dir:
+    # run 1 pays the pre-warm compile walls into the cache, run 2 is the
+    # production restart — pre-warm reloads from disk and the first tick
+    # runs with zero compile exposure. Budget-guarded (each run re-pays
+    # jax import + device handshake).
+    warm_boot_extras = {}
+    try:
+        # headroom covers the worst case: two subprocess runs at their
+        # full 600 s timeouts, plus margin for the result assembly
+        warm_budget_ok = (
+            time.perf_counter() - BENCH_T0
+            < int(os.environ.get("KMAMIZ_BENCH_BUDGET_S", 3000)) - 1300
+        )
+    except ValueError:
+        warm_budget_ok = True
+    if warm_budget_ok:
+        import subprocess
+        import tempfile
+
+        with tempfile.TemporaryDirectory(prefix="kmamiz-xla-cache-") as d:
+            env = {**os.environ, "KMAMIZ_COMPILE_CACHE_DIR": d}
+            runs = []
+            for tag in ("cold", "restart"):
+                try:
+                    out = subprocess.run(
+                        [sys.executable, "tools/warm_boot_probe.py"],
+                        env=env,
+                        capture_output=True,
+                        text=True,
+                        timeout=600,
+                    )
+                    runs.append((tag, json.loads(out.stdout.strip().splitlines()[-1])))
+                except Exception as err:  # noqa: BLE001 - extra, not headline
+                    warm_boot_extras["warm_boot_error"] = f"{tag}: {err}"
+                    break
+            for tag, probe in runs:
+                warm_boot_extras[f"warm_boot_{tag}_prewarm_s"] = probe["prewarm_s"]
+                warm_boot_extras[f"warm_boot_{tag}_first_tick_ms"] = probe[
+                    "first_tick_ms"
+                ]
+            if len(runs) == 2:
+                warm_boot_extras["warm_first_tick_ms"] = runs[1][1][
+                    "first_tick_ms"
+                ]
+
     e2e_extras = {}
     headline = None
     if e2e_phases is not None:
@@ -962,6 +1008,7 @@ def main() -> None:
         "n_services": N_SERVICES,
         "dp_tick_ms_2500_traces": round(dp_tick_ms, 1),
         "dp_tick_budget_ms": 5000.0,  # the reference's realtime cadence
+        **warm_boot_extras,
         "chained_iters": ITERS,
         "tunnel_rtt_ms": round(rtt * 1000, 1),
         "packing_host_ms": round(packing_host_ms, 1),

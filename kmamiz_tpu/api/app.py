@@ -183,6 +183,9 @@ def main() -> None:
 
     logging.basicConfig(level=logging.INFO)
     klog.configure()  # apply LOG_LEVEL (Logger.ts:22-30)
+    from kmamiz_tpu.core import compile_cache
+
+    compile_cache.enable_from_env()  # before the first jit dispatch
     app = Application(ctx=build_production_context())
     app.start_up()
     app.listen()

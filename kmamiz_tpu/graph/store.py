@@ -28,6 +28,7 @@ import numpy as np
 from kmamiz_tpu.core.interning import EndpointInterner, StringInterner
 from kmamiz_tpu.core.spans import (
     KIND_SERVER,
+    ROW_SLOTS,
     SpanBatch,
     _pad_size as _pow2,
     pack_trace_rows,
@@ -621,6 +622,75 @@ class EndpointGraph:
             m_.reshape(-1),
         )
 
+    #: default pre-warm program hints: (packed_rows, walk_depth) buckets.
+    #: 512 rows covers the reference-cadence 2,500-trace tick (17.5k
+    #: spans at ~8 traces per 64-slot row); 8192 rows covers a 262k-span
+    #: streaming chunk at the deployed 4-chunk default. Depth 8 is the
+    #: pow2 bucket of typical trace depth.
+    PREWARM_HINTS = ((512, 8), (8192, 8))
+
+    def prewarm_compile(self, hints=None) -> int:
+        """AOT-compile the merge programs for the CURRENT store capacity
+        and the given (rows, depth) buckets, so a production boot pays
+        its compile walls BEFORE the first tick instead of mid-request
+        (VERDICT r4 #5b; BENCH_r04 recorded 50-70 s union compiles).
+        Combined with the persistent compilation cache
+        (core.compile_cache), a restart reloads these from disk in
+        seconds. Uses jit lowering only — nothing executes, the store
+        never mutates. Returns the number of programs compiled."""
+        import jax
+
+        with self._lock:
+            self._finalize_pending_locked()
+            cap = int(self._src.shape[0])
+            packed_key = (
+                len(self.interner.endpoints) <= EDGE_KEY_MAX_EP
+                and self._min_dist >= 1
+                and self._max_dist <= EDGE_KEY_MAX_DIST
+            )
+        mesh = None
+        count = 0
+        for rows, depth in hints or self.PREWARM_HINTS:
+            mesh = self._deploy_mesh(rows)
+            win = [
+                jax.ShapeDtypeStruct((rows, ROW_SLOTS), dt)
+                for dt in (jnp.int32, jnp.int8, jnp.bool_, jnp.int32)
+            ]
+            store_cols = [
+                jax.ShapeDtypeStruct((cap,), jnp.int32) for _ in range(3)
+            ] + [jax.ShapeDtypeStruct((cap,), jnp.bool_)]
+            _window_merge_packed.lower(
+                *win, *store_cols, max_depth=depth
+            ).compile()
+            count += 1
+            if mesh is None:
+                _window_edges_compact.lower(
+                    *win,
+                    max_depth=depth,
+                    stage_cap=self._stage_cap(),
+                    packed_key=packed_key,
+                ).compile()
+            else:
+                from kmamiz_tpu.parallel.mesh import (
+                    sharded_window_edges_compact,
+                )
+
+                n_dev = mesh.shape["spans"]
+                srows = -(-rows // n_dev) * n_dev
+                swin = [
+                    jax.ShapeDtypeStruct((srows, ROW_SLOTS), dt)
+                    for dt in (jnp.int32, jnp.int8, jnp.bool_, jnp.int32)
+                ]
+                sharded_window_edges_compact.lower(
+                    mesh,
+                    *swin,
+                    max_depth=depth,
+                    stage_cap=self._stage_cap(),
+                    packed_key=packed_key,
+                ).compile()
+            count += 1
+        return count
+
     def edge_arrays(self):
         """(src_ep, dst_ep, dist, mask) snapshot of the stored edges
         (immutable jnp arrays: safe to use after the lock releases)."""
@@ -722,6 +792,25 @@ class EndpointGraph:
         src, dst, dist, mask, ep_service, ep_ml, ep_record, svc_cap = (
             self._scorer_inputs(label_of, now_ms)
         )
+        # deployed multi-device path (VERDICT r4 #5a): the edge->tuple
+        # expansion and local dedup sort shard across the mesh, degree
+        # partials psum over ICI; exact parity with the single-device
+        # scorer (parallel.mesh.sharded_service_scores)
+        mesh = self._deploy_mesh(int(src.shape[0]))
+        if mesh is not None and int(src.shape[0]) % mesh.shape["spans"] == 0:
+            from kmamiz_tpu.parallel.mesh import sharded_service_scores
+
+            return sharded_service_scores(
+                mesh,
+                src,
+                dst,
+                dist,
+                mask,
+                jnp.asarray(ep_service),
+                jnp.asarray(ep_ml),
+                jnp.asarray(ep_record),
+                num_services=svc_cap,
+            )
         return scorer_ops.service_scores(
             src,
             dst,

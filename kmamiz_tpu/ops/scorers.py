@@ -70,6 +70,20 @@ def service_scores(
     ep_has_record: bool[num_endpoints] — endpoints with a dependency record
     (seen as SERVER spans); gateway detection only considers these.
     """
+    rows = edge_direction_tuples(src_ep, dst_ep, dist, mask, ep_service, ep_ml)
+    is_gateway = gateway_mask(
+        dst_ep, mask, ep_service, ep_has_record, num_services
+    )
+    return score_tuple_rows(*rows, is_gateway, num_services=num_services)
+
+
+def edge_direction_tuples(src_ep, dst_ep, dist, mask, ep_service, ep_ml):
+    """Expand flat edges into BOTH direction-tuple rows:
+    "on" = owner src sees linked dst; "by" = owner dst sees linked src —
+    distinct (owner, linked_svc, dir, dist, linked_ml) tuples feed
+    score_tuple_rows. Shared by the single-device scorer and the
+    per-shard stage of the mesh-sharded scorer. Returns (owner, linked,
+    ddir, ddist, linked_ml, both_mask)."""
     src_safe = jnp.maximum(src_ep, 0)
     dst_safe = jnp.maximum(dst_ep, 0)
     src_svc = ep_service[src_safe]
@@ -77,17 +91,6 @@ def service_scores(
     src_ml = ep_ml[src_safe]
     dst_ml = ep_ml[dst_safe]
     dist32 = dist.astype(jnp.int32)
-
-    # direction rows: "on" = owner src sees linked dst; "by" = owner dst sees
-    # linked src. Distinct (owner, linked_svc, linked_ml, dist, dir) tuples.
-    # Key order exploits TWO properties downstream (each worth ~100 ms at
-    # the 100k-endpoint scale, where scatter-based segment ops dominate):
-    # (owner, linked, dir) FIRST makes every per-owner reduction a
-    # contiguous run of the sorted order — cumsum + searchsorted boundary
-    # differences instead of 8M-row TPU scatters; dist BEFORE ml makes the
-    # first row of each (owner, linked, dir) triple carry the triple's
-    # MINIMUM distance, so "triple contains a distance-1 row" is read off
-    # that row directly instead of an 8M-segment segment_max + gather.
     owner = jnp.concatenate([src_svc, dst_svc])
     linked = jnp.concatenate([dst_svc, src_svc])
     linked_ml = jnp.concatenate([dst_ml, src_ml])
@@ -96,7 +99,59 @@ def service_scores(
         [jnp.zeros_like(dist32), jnp.ones_like(dist32)]
     )  # 0 = on/SERVER, 1 = by/CLIENT
     both_mask = jnp.concatenate([mask, mask])
+    return owner, linked, ddir, ddist, linked_ml, both_mask
 
+
+def gateway_mask(
+    dst_ep, mask, ep_service, ep_has_record, num_services, by_deg=None
+):
+    """bool[num_services]: a service owning an endpoint record with zero
+    depended-by edges (reference: dependency.find(d =>
+    d.dependingBy.length === 0)). The mesh-sharded scorer passes its
+    psum-merged partial degrees as `by_deg`; single-device computes them
+    here."""
+    num_endpoints = ep_service.shape[0]
+    if by_deg is None:
+        by_deg = jax.ops.segment_sum(
+            mask.astype(jnp.float32),
+            jnp.where(mask, dst_ep, num_endpoints),
+            num_segments=num_endpoints + 1,
+        )[:-1]
+    gateway_ep = ep_has_record & (by_deg == 0)
+    return (
+        jax.ops.segment_max(
+            gateway_ep.astype(jnp.int32), ep_service, num_segments=num_services
+        )
+        > 0
+    )
+
+
+def score_tuple_rows(
+    owner: jnp.ndarray,
+    linked: jnp.ndarray,
+    ddir: jnp.ndarray,
+    ddist: jnp.ndarray,
+    linked_ml: jnp.ndarray,
+    both_mask: jnp.ndarray,
+    is_gateway: jnp.ndarray,
+    num_services: int,
+) -> ServiceScores:
+    """The counting core of service_scores over flat direction-tuple rows
+    (owner, linked, dir, dist, ml): global dedup, prefix-boundary
+    distincts, searchsorted per-owner reductions. Shared by the
+    single-device scorer (rows built straight from edges) and the
+    mesh-sharded scorer (rows locally deduped per shard first —
+    parallel.mesh.sharded_service_scores); duplicate rows across shards
+    collapse in the global lex_unique here, so both paths are exact.
+
+    Key order exploits two properties (each worth ~100 ms at the
+    100k-endpoint scale, where scatter-based segment ops dominate):
+    (owner, linked, dir) FIRST makes every per-owner reduction a
+    contiguous run of the sorted order — cumsum + searchsorted boundary
+    differences instead of 8M-row TPU scatters; dist BEFORE ml makes
+    the first row of each (owner, linked, dir) triple carry the
+    triple's MINIMUM distance, so "triple contains a distance-1 row"
+    reads off that row directly."""
     (s_owner, s_linked, s_dir, s_dist, _s_ml), uniq = lex_unique(
         (owner, linked, ddir, ddist, linked_ml), both_mask
     )
@@ -138,22 +193,6 @@ def service_scores(
     d1_at_first = s_dist == 1
     ads = owner_count(triple_first & fdir & d1_at_first)
     ais_links = owner_count(triple_first & ~fdir & d1_at_first)
-
-    # gateway: a service owning an endpoint record with zero depended-by
-    # edges (reference: dependency.find(d => d.dependingBy.length === 0))
-    num_endpoints = ep_service.shape[0]
-    by_deg = jax.ops.segment_sum(
-        mask.astype(jnp.float32),
-        jnp.where(mask, dst_ep, num_endpoints),
-        num_segments=num_endpoints + 1,
-    )[:-1]
-    gateway_ep = ep_has_record & (by_deg == 0)
-    is_gateway = (
-        jax.ops.segment_max(
-            gateway_ep.astype(jnp.int32), ep_service, num_segments=num_services
-        )
-        > 0
-    )
 
     ais = ais_links + is_gateway.astype(jnp.float32)
     acs = ais * ads

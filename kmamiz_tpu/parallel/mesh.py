@@ -606,3 +606,66 @@ def sharded_window_edges_compact(
         in_specs=(spec, spec, spec, spec),
         out_specs=(spec, spec, spec, spec),
     )(parent_slot, kind, valid, endpoint_id)
+
+
+@partial(jax.jit, static_argnames=("mesh", "num_services", "axis"))
+def sharded_service_scores(
+    mesh: Mesh,
+    src_ep: jnp.ndarray,
+    dst_ep: jnp.ndarray,
+    dist: jnp.ndarray,
+    mask: jnp.ndarray,
+    ep_service: jnp.ndarray,
+    ep_ml: jnp.ndarray,
+    ep_has_record: jnp.ndarray,
+    num_services: int,
+    axis: str = "spans",
+):
+    """service_scores with the edge->tuple expansion, local dedup, and
+    degree partials sharded over the mesh (VERDICT r4 #5a: the scorer
+    segment reductions split across devices).
+
+    Stage 1 (shard_map): each device expands ITS edge rows into both
+    direction tuples, lex-sorts and locally dedups them (the n parallel
+    local sorts replace one global-size sort), and contributes its
+    partial depended-by degrees via one psum over ICI. Stage 2: the
+    locally-deduped tuple prefixes feed the same counting core the
+    single-device scorer uses (ops.scorers.score_tuple_rows) — its
+    global lex_unique collapses cross-shard duplicates, so results are
+    exactly the single-device scorer's. Inputs reshard automatically
+    under jit; ep tables are replicated (they are per-endpoint lookups,
+    small next to the edge set)."""
+    from kmamiz_tpu.ops import scorers as scorer_ops
+    from kmamiz_tpu.ops.sortutil import lex_unique, scatter_compact
+
+    spec = P(axis)
+    num_endpoints = ep_service.shape[0]
+
+    def local(srcs, dsts, dists, masks, ep_svc, ep_ml_t):
+        rows = scorer_ops.edge_direction_tuples(
+            srcs, dsts, dists, masks, ep_svc, ep_ml_t
+        )
+        cols, uniq = lex_unique(rows[:-1], rows[-1])
+        comp, valid = scatter_compact(cols, uniq)
+        # partial depended-by degrees; ONE psum merges shards over ICI
+        bd = jax.ops.segment_sum(
+            masks.astype(jnp.float32),
+            jnp.where(masks, dsts, num_endpoints),
+            num_segments=num_endpoints + 1,
+        )[:-1]
+        bd = jax.lax.psum(bd, axis)
+        return (*comp, valid, bd)
+
+    o, l, dr, dd, ml, valid, by_deg = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(spec, spec, spec, spec, P(), P()),
+        out_specs=(spec, spec, spec, spec, spec, spec, P()),
+    )(src_ep, dst_ep, dist, mask, ep_service, ep_ml)
+
+    is_gateway = scorer_ops.gateway_mask(
+        dst_ep, mask, ep_service, ep_has_record, num_services, by_deg=by_deg
+    )
+    return scorer_ops.score_tuple_rows(
+        o, l, dr, dd, ml, valid, is_gateway, num_services=num_services
+    )

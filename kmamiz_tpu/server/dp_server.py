@@ -171,6 +171,9 @@ def main() -> None:
     from kmamiz_tpu.ingestion.zipkin import ZipkinClient
 
     logging.basicConfig(level=os.environ.get("LOG_LEVEL", "INFO").upper())
+    from kmamiz_tpu.core import compile_cache
+
+    compile_cache.enable_from_env()
     zipkin = ZipkinClient(os.environ.get("ZIPKIN_URL", ""))
     k8s = None
     kube_host = os.environ.get("KUBEAPI_HOST", "")
@@ -185,6 +188,14 @@ def main() -> None:
         ),
         k8s_source=k8s,
     )
+    if os.environ.get("KMAMIZ_PREWARM", "1") != "0":
+        import time as _time
+
+        t0 = _time.time()
+        n = processor.graph.prewarm_compile()
+        logger.info(
+            "pre-warmed %d merge programs in %.1fs", n, _time.time() - t0
+        )
     server = DataProcessorServer(
         processor,
         host=os.environ.get("BIND_IP", "0.0.0.0"),

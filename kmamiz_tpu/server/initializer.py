@@ -178,6 +178,18 @@ class Initializer:
                     "Warm-started device graph from %d dependency records.",
                     len(records),
                 )
+            # pre-warm the merge programs at the restored capacity so the
+            # first tick never eats a mid-request compile wall (pair with
+            # KMAMIZ_COMPILE_CACHE_DIR to make restarts load these from
+            # disk; KMAMIZ_PREWARM=0 opts out)
+            import os as _os
+
+            if _os.environ.get("KMAMIZ_PREWARM", "1") != "0":
+                t0 = time.time()
+                n = ctx.processor.graph.prewarm_compile()
+                logger.info(
+                    "Pre-warmed %d merge programs in %.1fs.", n, time.time() - t0
+                )
 
         if ctx.settings.read_only_mode:
             logger.info("Readonly mode enabled, skipping schedule registration.")

@@ -656,3 +656,59 @@ def test_sharded_stats_pallas_backend_matches(bookinfo_traces, mesh8):
     np.testing.assert_allclose(
         np.asarray(xla.latency_cv), np.asarray(pal.latency_cv), atol=2e-3
     )
+
+
+def test_sharded_service_scores_parity(mesh8):
+    """The mesh-sharded scorer (edge->tuple expansion + local dedup sort
+    per shard, degree psum over ICI, shared counting core) must equal
+    the single-device scorer exactly on every field."""
+    from kmamiz_tpu.ops import scorers
+
+    rng = np.random.default_rng(3)
+    CAP, EDGES, N_EP, N_SVC = 1 << 12, 3000, 512, 64
+    SEN = np.iinfo(np.int32).max
+    src = np.full(CAP, SEN, np.int32)
+    src[:EDGES] = rng.integers(0, N_EP, EDGES)
+    dst = np.full(CAP, SEN, np.int32)
+    dst[:EDGES] = rng.integers(0, N_EP, EDGES)
+    dist = np.ones(CAP, np.int32)
+    dist[:EDGES] = rng.integers(1, 6, EDGES)
+    mask = np.zeros(CAP, bool)
+    mask[:EDGES] = True
+    eps = rng.integers(0, N_SVC, N_EP).astype(np.int32)
+    epm = rng.integers(0, 300, N_EP).astype(np.int32)
+    epr = rng.random(N_EP) < 0.8
+    args = tuple(
+        jnp.asarray(a) for a in (src, dst, dist, mask, eps, epm, epr)
+    )
+    single = scorers.service_scores(*args, num_services=N_SVC)
+    shard = pmesh.sharded_service_scores(mesh8, *args, num_services=N_SVC)
+    for name in single._fields:
+        np.testing.assert_allclose(
+            np.asarray(getattr(single, name)),
+            np.asarray(getattr(shard, name)),
+            rtol=1e-6,
+            err_msg=name,
+        )
+
+
+def test_store_serves_sharded_scorer_on_mesh(pdas_traces, monkeypatch):
+    """EndpointGraph.service_scores takes the sharded path when the mesh
+    is active and must agree with the forced single-device path on the
+    same graph."""
+    from kmamiz_tpu.core.spans import spans_to_batch
+    from kmamiz_tpu.graph.store import EndpointGraph
+
+    g = EndpointGraph(capacity=64)  # small cap: 64 rows shard over 8
+    g.merge_window(spans_to_batch([pdas_traces], interner=g.interner))
+    monkeypatch.setenv("KMAMIZ_MESH", "0")
+    single = g.service_scores()
+    monkeypatch.setenv("KMAMIZ_MESH", "1")
+    shard = g.service_scores()
+    for name in single._fields:
+        np.testing.assert_allclose(
+            np.asarray(getattr(single, name)),
+            np.asarray(getattr(shard, name)),
+            rtol=1e-6,
+            err_msg=name,
+        )

@@ -1,0 +1,68 @@
+"""Restart-warmth probe: boot a fresh process, pre-warm, time the first tick.
+
+Run twice against the same KMAMIZ_COMPILE_CACHE_DIR to measure the
+production restart story (VERDICT r4 #5b):
+
+  run 1 (cold cache): the pre-warm pays the real compile walls, once;
+  run 2 (warm cache): the pre-warm reloads programs from disk and the
+  first tick runs with zero compile exposure.
+
+Prints ONE JSON line: {"prewarm_s": ..., "first_tick_ms": ...,
+"second_tick_ms": ...}. bench.py invokes this as a subprocess for the
+warm_first_tick_ms extra; it is also a deployable smoke check
+(KMAMIZ_COMPILE_CACHE_DIR=/var/cache/kmamiz python tools/warm_boot_probe.py).
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+
+def main() -> None:
+    from kmamiz_tpu.core import compile_cache
+
+    compile_cache.enable_from_env()
+
+    from kmamiz_tpu.server.processor import DataProcessor
+    from kmamiz_tpu.synth import make_raw_window
+
+    # the reference-cadence tick: 2,500 traces x 7 spans
+    window = json.loads(make_raw_window(2_500, 7))
+    dp = DataProcessor(trace_source=lambda lb, t, lim: window)
+
+    t0 = time.perf_counter()
+    n_programs = dp.graph.prewarm_compile(hints=((512, 8),))
+    prewarm_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    dp.collect({"uniqueId": "warm-1", "lookBack": 30_000, "time": 1_000_000})
+    # drain the deferred merge INSIDE the timer: the staged union is the
+    # device work the pre-warm exists to keep compile-free, and the
+    # second tick below charges it identically (comparable numbers)
+    dp.graph.n_edges
+    first_tick_ms = (time.perf_counter() - t0) * 1000
+
+    window2 = json.loads(make_raw_window(2_500, 7, t_start=10_000))
+    dp2 = DataProcessor(trace_source=lambda lb, t, lim: window2)
+    t0 = time.perf_counter()
+    dp2.collect({"uniqueId": "warm-2", "lookBack": 30_000, "time": 2_000_000})
+    dp2.graph.n_edges
+    second_tick_ms = (time.perf_counter() - t0) * 1000
+
+    print(
+        json.dumps(
+            {
+                "prewarm_s": round(prewarm_s, 1),
+                "prewarm_programs": n_programs,
+                "first_tick_ms": round(first_tick_ms, 1),
+                "second_tick_ms": round(second_tick_ms, 1),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

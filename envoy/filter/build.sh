@@ -1,15 +1,61 @@
 #!/bin/sh
-# Build the KMamiz-TPU telemetry filter to wasm32 (proxy-wasm ABI).
-# Requires tinygo >= 0.28 and go >= 1.21 (not shipped in the dev image;
-# any machine or the tinygo/tinygo container works):
+# Build the KMamiz-TPU telemetry filter to wasm32 (proxy-wasm ABI) and
+# pin the result by hash, so any tooling-equipped CI reproduces the
+# deployable artifact deterministically (the dev image ships no tinygo;
+# the Dockerfile stage carries the pinned toolchain). The binary lands
+# at envoy/kmamiz-filter.wasm, which the API server serves at GET /wasm
+# (KMAMIZ_WASM_PATH) for the EnvoyFilter CR's remote-code fetch.
 #
-#   docker run --rm -v "$PWD":/src -w /src tinygo/tinygo:0.31.2 ./build.sh
+#   ./build.sh                 # docker build -> ../kmamiz-filter.wasm
+#   ./build.sh --record        # build, then write BUILD.sha256
+#   ./build.sh --verify        # build, then compare against BUILD.sha256
+#   ./build.sh --check-inputs  # no tooling needed: verify the SOURCE
+#                              #   manifest hash (executable-as-written
+#                              #   dry check for this image)
 #
-# The binary lands at envoy/kmamiz-filter.wasm, which the API server
-# serves at GET /wasm (KMAMIZ_WASM_PATH) for the EnvoyFilter CR's
-# remote-code fetch.
+# BUILD.sha256 holds two lines:
+#   inputs  <sha256 of main.go + go.mod + Dockerfile, in that order>
+#   output  <sha256 of kmamiz-filter.wasm>  (recorded by the first
+#           tooling-equipped --record run; "pending" until then)
 set -eu
 cd "$(dirname "$0")"
-go mod tidy
-tinygo build -o ../kmamiz-filter.wasm -scheduler=none -target=wasi ./main.go
-echo "built ../kmamiz-filter.wasm"
+
+input_hash() {
+    cat main.go go.mod Dockerfile | sha256sum | cut -d' ' -f1
+}
+
+if [ "${1:-}" = "--check-inputs" ]; then
+    want=$(grep '^inputs' BUILD.sha256 | awk '{print $2}')
+    got=$(input_hash)
+    if [ "$want" != "$got" ]; then
+        echo "input manifest drift: recorded $want, tree has $got" >&2
+        echo "(re-run ./build.sh --record on a tooling-equipped host)" >&2
+        exit 1
+    fi
+    echo "inputs match BUILD.sha256 ($got)"
+    exit 0
+fi
+
+docker build -o .build-out .
+mv .build-out/kmamiz-filter.wasm ../kmamiz-filter.wasm
+rmdir .build-out
+out_hash=$(sha256sum ../kmamiz-filter.wasm | cut -d' ' -f1)
+echo "built ../kmamiz-filter.wasm ($out_hash)"
+
+case "${1:-}" in
+--record)
+    {
+        echo "inputs $(input_hash)"
+        echo "output $out_hash"
+    } > BUILD.sha256
+    echo "recorded BUILD.sha256"
+    ;;
+--verify)
+    want=$(grep '^output' BUILD.sha256 | awk '{print $2}')
+    if [ "$want" != "$out_hash" ]; then
+        echo "artifact drift: recorded $want, built $out_hash" >&2
+        exit 1
+    fi
+    echo "artifact matches BUILD.sha256"
+    ;;
+esac

@@ -827,3 +827,150 @@ class TestDifferentialFuzz:
             assert records[1]["status"] == "200"
             checked += 1
         assert checked == trials
+
+
+class TestReferenceCorpusDifferential:
+    """Third differential leg (VERDICT r4 #8): the strict host drives the
+    shipped binary over the REFERENCE filter's own observable output
+    corpus — real captured lines from the reference deployment
+    (tests/fixtures/pdas_envoy_log_lines.json, the same capture the
+    ingestion parity fixtures come from). Each captured line is parsed
+    back into the stream inputs that produced it and replayed through
+    OUR filter under full ABI enforcement; the emitted line must
+    reproduce the reference's id/method/status/content-type structure
+    verbatim, with the body passed through the independent
+    desensitization twin (the capture predates the desensitizing filter
+    build, so raw values scrub)."""
+
+    LINE_RE = __import__("re").compile(
+        r"^\[(Request|Response) ([^/]+)/([^/]+)/([^/]+)/([^\]]+)\] "
+        r"(?:\[(\w+) ([^\]]+)\]|\[Status\] (\d+))"
+        r"(?: \[ContentType ([^\]]+)\])?"
+        r"(?: \[Body\] (.*))?$"
+    )
+
+    def _parse(self, line):
+        payload = line.split("\t", 1)[1]
+        m = self.LINE_RE.match(payload)
+        assert m, payload
+        kind, rid, tid, sid, pid, method, hostpath, status, ct, body = (
+            m.groups()
+        )
+        return {
+            "kind": kind,
+            "ids": (rid, tid, sid, pid),
+            "method": method,
+            "hostpath": hostpath,
+            "status": status,
+            "content_type": ct,
+            "body": body,
+        }
+
+    def test_reference_captured_lines_replay(self, binary):
+        import json as _json
+
+        from conftest import load_fixture
+        from kmamiz_tpu.core.envoy_filter import (
+            desensitize_body,
+            format_request_log,
+            format_response_log,
+        )
+
+        lines = load_fixture("pdas_envoy_log_lines")
+        host = StrictHost(binary)
+        checked = 0
+        for i, line in enumerate(lines):
+            p = self._parse(line)
+            rid, tid, sid, pid = p["ids"]
+            id_headers = {
+                "x-request-id": rid,
+                "x-b3-traceid": tid,
+                "x-b3-spanid": sid,
+                "x-b3-parentspanid": pid,
+            }
+            if p["kind"] == "Request":
+                host_part, _, path = p["hostpath"].partition("/")
+                req = {
+                    **id_headers,
+                    ":method": p["method"],
+                    ":authority": host_part,
+                    ":path": f"/{path}",
+                }
+                if p["content_type"]:
+                    req["content-type"] = p["content_type"]
+                host.stream(
+                    200 + i,
+                    req,
+                    {":status": "200"},
+                    request_body=(p["body"] or "").encode() or None,
+                    body_chunks=2,
+                )
+                ours = host.logs[-2][1]  # request line of this stream
+                want = format_request_log(
+                    p["method"],
+                    host_part,
+                    f"/{path}",
+                    rid,
+                    tid,
+                    sid,
+                    pid,
+                    p["content_type"] or "",
+                    p["body"] or "",
+                )
+            else:
+                resp = {":status": p["status"]}
+                if p["content_type"]:
+                    resp["content-type"] = p["content_type"]
+                host.stream(
+                    200 + i,
+                    {**id_headers, ":method": "GET", ":authority": "h",
+                     ":path": "/"},
+                    resp,
+                    response_body=(p["body"] or "").encode() or None,
+                    body_chunks=2,
+                )
+                ours = host.logs[-1][1]  # response line of this stream
+                want = format_response_log(
+                    p["status"],
+                    rid,
+                    tid,
+                    sid,
+                    pid,
+                    p["content_type"] or "",
+                    p["body"] or "",
+                )
+            assert ours == want, (line, ours, want)
+            # structure must reproduce the reference capture verbatim
+            # (everything except the twin-desensitized body)
+            ref_payload = line.split("\t", 1)[1]
+            ref_structure = ref_payload.split(" [Body] ")[0]
+            our_structure = ours.split(" [Body] ")[0]
+            assert our_structure == ref_structure, (ref_structure, our_structure)
+            if p["body"]:
+                scrubbed = desensitize_body(p["body"])
+                assert ours.endswith(f" [Body] {scrubbed}")
+                checked += 1
+        assert checked >= 3  # corpus carries real JSON bodies
+
+
+def test_build_recipe_input_manifest_pinned():
+    """The deterministic build recipe is executable-as-written on any
+    tooling-equipped host, and THIS tree's sources match the recorded
+    input manifest (the dry half of the hash pinning; the output hash is
+    recorded by the first CI run of build.sh --record)."""
+    import hashlib
+    import pathlib
+
+    d = pathlib.Path(__file__).resolve().parent.parent / "envoy" / "filter"
+    recorded = {
+        line.split()[0]: line.split()[1]
+        for line in (d / "BUILD.sha256").read_text().splitlines()
+    }
+    h = hashlib.sha256()
+    for name in ("main.go", "go.mod", "Dockerfile"):
+        h.update((d / name).read_bytes())
+    assert recorded["inputs"] == h.hexdigest()
+    # the Dockerfile stage pins the exact toolchain + determinism flags
+    df = (d / "Dockerfile").read_text()
+    assert "tinygo/tinygo:0.31.2" in df
+    assert "SOURCE_DATE_EPOCH" in df and "-no-debug" in df

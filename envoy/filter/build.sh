@@ -21,7 +21,13 @@ set -eu
 cd "$(dirname "$0")"
 
 input_hash() {
-    cat main.go go.mod Dockerfile | sha256sum | cut -d' ' -f1
+    # go.sum joins the pin once the first tooling-equipped build
+    # materializes it (dependency bytes covered, not just versions)
+    if [ -f go.sum ]; then
+        cat main.go go.mod go.sum Dockerfile | sha256sum | cut -d' ' -f1
+    else
+        cat main.go go.mod Dockerfile | sha256sum | cut -d' ' -f1
+    fi
 }
 
 if [ "${1:-}" = "--check-inputs" ]; then
@@ -38,7 +44,10 @@ fi
 
 docker build -o .build-out .
 mv .build-out/kmamiz-filter.wasm ../kmamiz-filter.wasm
-rmdir .build-out
+if [ ! -f go.sum ] && [ -f .build-out/go.sum ]; then
+    mv .build-out/go.sum go.sum   # materialized by the first build
+fi
+rm -rf .build-out
 out_hash=$(sha256sum ../kmamiz-filter.wasm | cut -d' ' -f1)
 echo "built ../kmamiz-filter.wasm ($out_hash)"
 
@@ -52,6 +61,11 @@ case "${1:-}" in
     ;;
 --verify)
     want=$(grep '^output' BUILD.sha256 | awk '{print $2}')
+    if [ "$want" = "pending" ]; then
+        echo "no output hash recorded yet: run ./build.sh --record on a" >&2
+        echo "tooling-equipped host to pin the artifact (built $out_hash)" >&2
+        exit 1
+    fi
     if [ "$want" != "$out_hash" ]; then
         echo "artifact drift: recorded $want, built $out_hash" >&2
         exit 1

@@ -10,6 +10,7 @@ parser.
 """
 from __future__ import annotations
 
+import re
 import sys
 from pathlib import Path
 
@@ -842,7 +843,7 @@ class TestReferenceCorpusDifferential:
     desensitization twin (the capture predates the desensitizing filter
     build, so raw values scrub)."""
 
-    LINE_RE = __import__("re").compile(
+    LINE_RE = re.compile(
         r"^\[(Request|Response) ([^/]+)/([^/]+)/([^/]+)/([^\]]+)\] "
         r"(?:\[(\w+) ([^\]]+)\]|\[Status\] (\d+))"
         r"(?: \[ContentType ([^\]]+)\])?"

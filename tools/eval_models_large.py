@@ -381,6 +381,34 @@ def inductive_eval(args, result) -> None:
             use_node_embeddings=False,
         )
         train_s = time.perf_counter() - t1
+        if use_history and getattr(args, "checkpoint_dir", None):
+            # save AFTER training (never pass checkpoint_dir into
+            # trainer.train here: its resume path validates only hypers,
+            # so a stale checkpoint from a different mesh would silently
+            # skip training and report bogus "fresh" metrics)
+            from kmamiz_tpu.models import checkpoint as ckpt
+
+            ckpt.save_checkpoint(
+                args.checkpoint_dir,
+                res.params,
+                # serving restores against optimizer.init(template); the
+                # optimizer state itself is not reused, so a fresh init
+                # keeps the document shape without threading it out of
+                # TrainResult
+                graphsage.make_optimizer(0.01).init(res.params),
+                step=args.epochs,
+                metadata={
+                    "loss": float(res.losses[-1]) if res.losses else None,
+                    "hidden": args.hidden,
+                    "lr": 0.01,
+                    "seed": args.seed,
+                    "model": "graphsage",
+                    "num_features": int(
+                        np.asarray(train_seen.features[0]).shape[1]
+                    ),
+                    "num_nodes": 0,
+                },
+            )
         threshold = trainer.calibrate_threshold(
             res.params, train_seen, model=graphsage
         )
@@ -442,6 +470,12 @@ def inductive_eval(args, result) -> None:
         f"seed {args.seed}\n"
     )
     _print_rows(rows)
+    import resource
+
+    peak_gb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1e6
+    print(f"peak host memory: {peak_gb:.1f} GB (ru_maxrss)")
+    if getattr(args, "checkpoint_dir", None):
+        print(f"checkpoint (with-history model): {args.checkpoint_dir}")
 
 
 def main() -> None:
@@ -467,6 +501,11 @@ def main() -> None:
         "--tenk",
         action="store_true",
         help="also time (not score) the 1k-svc/10k-endpoint config",
+    )
+    parser.add_argument(
+        "--checkpoint-dir",
+        default=None,
+        help="save the (with-history) inductive model's checkpoint here",
     )
     args = parser.parse_args()
 

@@ -44,8 +44,12 @@ fi
 
 docker build -o .build-out .
 mv .build-out/kmamiz-filter.wasm ../kmamiz-filter.wasm
-if [ ! -f go.sum ] && [ -f .build-out/go.sum ]; then
-    mv .build-out/go.sum go.sum   # materialized by the first build
+# ONLY --record mutates the tree: materialize go.sum (dependency bytes
+# join the inputs pin) and re-pin both hashes together — a plain build
+# or --verify must never silently invalidate the committed pin
+if [ "${1:-}" = "--record" ] && [ ! -f go.sum ] \
+    && [ -f .build-out/go.sum ]; then
+    mv .build-out/go.sum go.sum
 fi
 rm -rf .build-out
 out_hash=$(sha256sum ../kmamiz-filter.wasm | cut -d' ' -f1)

@@ -165,27 +165,27 @@ def test_full_app_concurrency_soak(monkeypatch):
         versions.append(dp.graph.version)
         time.sleep(0.02)
 
-    # warm pass OUTSIDE the soak window: a standalone run pays multi-
-    # second XLA compiles on the first tick/read (inside the full suite
-    # earlier tests already compiled them); the soak measures sustained
-    # concurrency, not cold-compile latency
-    realtime_tick()
-    ingest_backfill()
-    scorer_reads()
-    read_counts["ok"] = 0
-    ingest_summaries.clear()
-
-    errors, wall = run_soak_workers(
-        (
-            realtime_tick,
-            ingest_backfill,
-            dispatch_sync,
-            scorer_reads,
-            version_watch,
-        )
-    )
-
     try:
+        # warm pass OUTSIDE the soak window (but inside the server
+        # shutdown scope): a standalone run pays multi-second XLA
+        # compiles on the first tick/read (inside the full suite earlier
+        # tests already compiled them); the soak measures sustained
+        # concurrency, not cold-compile latency
+        realtime_tick()
+        ingest_backfill()
+        scorer_reads()
+        read_counts["ok"] = 0
+        ingest_summaries.clear()
+
+        errors, wall = run_soak_workers(
+            (
+                realtime_tick,
+                ingest_backfill,
+                dispatch_sync,
+                scorer_reads,
+                version_watch,
+            )
+        )
         assert not errors, errors
 
         # progress on every axis
@@ -297,8 +297,8 @@ def test_soak_serves_forecasts_from_10k_checkpoint():
             assert e.code == 503, e.code
         time.sleep(0.05)
 
-    errors, _wall = run_soak_workers((realtime_tick, forecast_reads))
     try:
+        errors, _wall = run_soak_workers((realtime_tick, forecast_reads))
         assert not errors, errors
         assert tick_counter["n"] >= 3, "ticks starved"
         # the 10k-trained head served real forecasts for THIS mesh

@@ -804,8 +804,14 @@ def main() -> None:
             scale_extras["graph_scale_error"] = f"{type(err).__name__}: {err}"[:300]
             # the success path dels the multi-million-row arrays; a
             # mid-section failure must not leave them pinned for the
-            # remaining sections on this 1-core box
+            # remaining sections on this 1-core box (refresh_snapshot
+            # aliases the same edge_arrays tuple; the per-service inputs
+            # and the jitted closure keep device buffers alive too)
             big = src_f = dst_f = dist_f = mask_f = None  # noqa: F841
+            refresh_snapshot = None  # noqa: F841
+            ep_service_b = ep_ml_b = ep_record_b = None  # noqa: F841
+            replicas_b = req_b = None  # noqa: F841
+            refresh_chain_big = None  # noqa: F841 - closure pins the arrays
 
     # ---- end-to-end DP tick at the reference's own scale -------------------
     # the reference caps realtime ticks at 2,500 traces / 5 s; this times the

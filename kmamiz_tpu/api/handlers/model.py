@@ -47,6 +47,9 @@ class ModelHandler(IRequestHandler):
         # embedding checkpoints, unexpected exceptions) cache permanently.
         self._error_transient = False
         self._next_retry = 0.0
+        # (snapshot-identity, payload): forecasts change once per hour
+        # fold; polls in between serve the memoized payload
+        self._forecast_cache = None
 
         self.add_route("get", "/status", self._status)
         self.add_route("get", "/forecast", self._forecast)
@@ -189,6 +192,14 @@ class ModelHandler(IRequestHandler):
                     "forecast is available after one full hour of ticks)"
                 },
             )
+        # memoize per published snapshot: the fold replaces the snapshot
+        # dict wholesale once per hour, while dashboards poll every few
+        # seconds — re-running the model forward + full-endpoint JSON
+        # assembly per poll would be thousands of redundant forwards per
+        # hour at 10k endpoints
+        cached = self._forecast_cache
+        if cached is not None and cached[0] is snap:
+            return Response(payload=cached[1])
         feats = snap["features"]
         params, meta, model = loaded
         if feats.shape[1] != int(meta["num_features"]):
@@ -224,10 +235,10 @@ class ModelHandler(IRequestHandler):
             }
             for i in order
         ]
-        return Response(
-            payload={
-                "predictedHour": snap["predicted_hour"],
-                "model": meta.get("model"),
-                "endpoints": endpoints,
-            }
-        )
+        payload = {
+            "predictedHour": snap["predicted_hour"],
+            "model": meta.get("model"),
+            "endpoints": endpoints,
+        }
+        self._forecast_cache = (snap, payload)
+        return Response(payload=payload)

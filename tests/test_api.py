@@ -771,6 +771,74 @@ class TestDashboardContract:
                     "uniqueServiceName", "totalInterfaceCohesion"
                 } <= set(diff["cohesionData"][0])
 
+    def test_forecast_section_served(self, ctx):
+        import os
+
+        from kmamiz_tpu.api.app import build_router as _build
+
+        ctx.settings.static_dir = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "dist",
+        )
+        router = _build(ctx)
+        body = router.dispatch("GET", "/").raw_body.decode()
+        assert 'id="sec-forecast"' in body
+        assert 'id="forecast"' in body
+
+    def test_forecast_shapes(self, pdas_traces, tmp_path):
+        """renderForecast reads modelLoaded/error from /model/status and
+        endpoints[].{uniqueEndpointName, anomalyProbability,
+        predictedLatencyMs} + predictedHour from /model/forecast — pin
+        those fields against the committed 10k-endpoint checkpoint."""
+        import os
+
+        from kmamiz_tpu.api.app import build_router as _build
+        from kmamiz_tpu.server.initializer import AppContext, Initializer
+        from kmamiz_tpu.server.processor import DataProcessor
+        from kmamiz_tpu.server.storage import MemoryStore
+
+        dp = DataProcessor(
+            trace_source=_prefixed_trace_source(pdas_traces, "d"),
+            use_device_stats=False,
+        )
+        settings = Settings()
+        settings.external_data_processor = ""
+        settings.model_dir = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "fixtures",
+            "model10k",
+        )
+        ctx = AppContext.build(
+            app_settings=settings, store=MemoryStore(), processor=dp
+        )
+        Initializer(ctx).register_data_caches()
+        model_router = _build(ctx)
+
+        status = model_router.dispatch("GET", "/api/v1/model/status").payload
+        assert {"modelLoaded", "error", "featureHourReady"} <= set(status)
+        assert status["modelLoaded"] is True
+
+        H = 3_600_000
+        dp.collect({"uniqueId": "a", "lookBack": 30_000, "time": 910 * H})
+        dp.collect({"uniqueId": "b", "lookBack": 30_000, "time": 911 * H})
+        fc = model_router.dispatch("GET", "/api/v1/model/forecast").payload
+        assert {"endpoints", "predictedHour"} <= set(fc)
+        assert fc["endpoints"]
+        assert {
+            "uniqueEndpointName", "anomalyProbability", "predictedLatencyMs"
+        } <= set(fc["endpoints"][0])
+
+        # polls between folds serve the memoized payload (dashboards
+        # refresh every few seconds; the forecast changes hourly), and a
+        # new fold invalidates it
+        fc2 = model_router.dispatch("GET", "/api/v1/model/forecast").payload
+        assert fc2 is fc
+        dp.collect({"uniqueId": "c", "lookBack": 30_000, "time": 912 * H})
+        fc3 = model_router.dispatch("GET", "/api/v1/model/forecast").payload
+        assert fc3 is not fc
+        # the tick at hour 912 folds the COMPLETED hour 911
+        assert fc3["predictedHour"] == (911 % 24 + 1) % 24
+
     def test_js_dom_ids_and_routes_are_consistent(self, router):
         """Static cross-check of the dashboard's inline JS (no JS runtime
         ships in this image): every DOM id the script references must
@@ -838,6 +906,27 @@ class TestDashboardContract:
             )
 
 
+def _prefixed_trace_source(pdas_traces, prefix):
+    """Trace source emitting the pdas fixture with fresh ids per tick
+    (dedup keeps every tick's spans) — the shared scaffold of the
+    forecast tests."""
+    seen = {"n": 0}
+
+    def source(_lb, _t, _lim):
+        seen["n"] += 1
+        ng = []
+        for s in pdas_traces:
+            c = dict(s)
+            c["traceId"] = f"{prefix}{seen['n']}-{s.get('traceId')}"
+            c["id"] = f"{prefix}{seen['n']}-{s.get('id')}"
+            if c.get("parentId"):
+                c["parentId"] = f"{prefix}{seen['n']}-{c['parentId']}"
+            ng.append(c)
+        return [ng]
+
+    return source
+
+
 def _train_tiny_checkpoint(
     checkpoint_dir, epochs=1, augmented=True, **train_kw
 ):
@@ -888,24 +977,10 @@ class TestModelRoutes:
 
         _train_tiny_checkpoint(tmp_path, epochs=4)
 
-        seen = {"n": 0}
-
-        def source(_lb, _t, _lim):
-            seen["n"] += 1
-            out = []
-            for g in [pdas_traces]:
-                ng = []
-                for s in g:
-                    c = dict(s)
-                    c["traceId"] = f"f{seen['n']}-{s.get('traceId')}"
-                    c["id"] = f"f{seen['n']}-{s.get('id')}"
-                    if c.get("parentId"):
-                        c["parentId"] = f"f{seen['n']}-{c['parentId']}"
-                    ng.append(c)
-                out.append(ng)
-            return out
-
-        dp = DataProcessor(trace_source=source, use_device_stats=False)
+        dp = DataProcessor(
+            trace_source=_prefixed_trace_source(pdas_traces, "f"),
+            use_device_stats=False,
+        )
         settings = Settings()
         settings.external_data_processor = ""
         settings.model_dir = str(tmp_path)

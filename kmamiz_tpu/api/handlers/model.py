@@ -19,6 +19,7 @@ features exist precisely so the deployable model does not need them
 """
 from __future__ import annotations
 
+import json
 import logging
 import threading
 import time
@@ -199,7 +200,10 @@ class ModelHandler(IRequestHandler):
         # hour at 10k endpoints
         cached = self._forecast_cache
         if cached is not None and cached[0] is snap:
-            return Response(payload=cached[1])
+            # pre-encoded bytes ride raw_body (the HTTP layer prefers it)
+            # so polls skip the ~1 MB json.dumps too; .payload stays for
+            # in-process dispatch consumers
+            return Response(payload=cached[1], raw_body=cached[2])
         feats = snap["features"]
         params, meta, model = loaded
         if feats.shape[1] != int(meta["num_features"]):
@@ -240,5 +244,6 @@ class ModelHandler(IRequestHandler):
             "model": meta.get("model"),
             "endpoints": endpoints,
         }
-        self._forecast_cache = (snap, payload)
-        return Response(payload=payload)
+        encoded = json.dumps(payload).encode()
+        self._forecast_cache = (snap, payload, encoded)
+        return Response(payload=payload, raw_body=encoded)

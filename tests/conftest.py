@@ -76,3 +76,24 @@ def bookinfo_endpoint_dependencies():
 @pytest.fixture(scope="session")
 def pdas_envoy_log_lines():
     return load_fixture("pdas_envoy_log_lines")
+
+
+def prefixed_trace_source(pdas_traces, prefix):
+    """Trace source emitting the pdas fixture with fresh ids per tick
+    (dedup keeps every tick's spans) — shared scaffold of the forecast /
+    history tests across files."""
+    seen = {"n": 0}
+
+    def source(_lb, _t, _lim):
+        seen["n"] += 1
+        ng = []
+        for s in pdas_traces:
+            c = dict(s)
+            c["traceId"] = f"{prefix}{seen['n']}-{s.get('traceId')}"
+            c["id"] = f"{prefix}{seen['n']}-{s.get('id')}"
+            if c.get("parentId"):
+                c["parentId"] = f"{prefix}{seen['n']}-{c['parentId']}"
+            ng.append(c)
+        return [ng]
+
+    return source

@@ -785,7 +785,7 @@ class TestDashboardContract:
         assert 'id="sec-forecast"' in body
         assert 'id="forecast"' in body
 
-    def test_forecast_shapes(self, pdas_traces, tmp_path):
+    def test_forecast_shapes(self, pdas_traces):
         """renderForecast reads modelLoaded/error from /model/status and
         endpoints[].{uniqueEndpointName, anomalyProbability,
         predictedLatencyMs} + predictedHour from /model/forecast — pin
@@ -906,25 +906,7 @@ class TestDashboardContract:
             )
 
 
-def _prefixed_trace_source(pdas_traces, prefix):
-    """Trace source emitting the pdas fixture with fresh ids per tick
-    (dedup keeps every tick's spans) — the shared scaffold of the
-    forecast tests."""
-    seen = {"n": 0}
-
-    def source(_lb, _t, _lim):
-        seen["n"] += 1
-        ng = []
-        for s in pdas_traces:
-            c = dict(s)
-            c["traceId"] = f"{prefix}{seen['n']}-{s.get('traceId')}"
-            c["id"] = f"{prefix}{seen['n']}-{s.get('id')}"
-            if c.get("parentId"):
-                c["parentId"] = f"{prefix}{seen['n']}-{c['parentId']}"
-            ng.append(c)
-        return [ng]
-
-    return source
+from conftest import prefixed_trace_source as _prefixed_trace_source
 
 
 def _train_tiny_checkpoint(

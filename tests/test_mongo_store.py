@@ -334,3 +334,39 @@ class TestOrchestrationRoundTrip:
             t.join()
         assert not errors
         assert len(store.find_all("TaggedDiffData")) == 80
+
+
+class TestModelHistoryOverMongo:
+    def test_snapshot_roundtrip_through_wire_protocol(self, store, pdas_traces):
+        """The chunked online-model snapshot (base64 array documents)
+        must survive the real OP_MSG wire store: BSON-encode, persist,
+        read back through the boundary validation, and restore
+        bit-equal features into a fresh processor."""
+        import numpy as np
+
+        from kmamiz_tpu.server.processor import DataProcessor
+
+        from conftest import prefixed_trace_source
+
+        source = prefixed_trace_source(pdas_traces, "m")
+
+        H = 3_600_000
+        dp1 = DataProcessor(trace_source=source, use_device_stats=False)
+        dp1.collect({"uniqueId": "a", "lookBack": 30_000, "time": 700 * H})
+        dp1.collect({"uniqueId": "b", "lookBack": 30_000, "time": 701 * H})
+        docs = dp1.snapshot_history()
+        assert docs
+        store.insert_many("ModelHistoryState", docs)
+
+        found = store.find_all("ModelHistoryState")
+        assert len(found) == len(docs)
+        dp2 = DataProcessor(trace_source=source, use_device_stats=False)
+        dp2.restore_history(found)
+        assert dp2.history is not None
+        np.testing.assert_array_equal(
+            dp2.history_features, dp1.history_features
+        )
+        np.testing.assert_array_equal(
+            dp2.forecast_snapshot["features"],
+            dp1.forecast_snapshot["features"],
+        )

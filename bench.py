@@ -491,7 +491,15 @@ def main() -> None:
     stream_cold_extras = {}
     stream_legacy_extras = {}
     if e2e_phases is not None:
-        dp_stream = DataProcessor(trace_source=lambda lb, t, lim: [])
+        # virtual clock: advancing past the 5-min dedup TTL between reps
+        # keeps the processed-trace map at its production steady size
+        # (~one window of ids) instead of accumulating every rep's ids —
+        # the skip-set cost each parse pays stays the steady-state one
+        bench_clock = {"ms": 1_700_000_000_000.0}
+        dp_stream = DataProcessor(
+            trace_source=lambda lb, t, lim: [],
+            now_ms=lambda: bench_clock["ms"],
+        )
         cold = stream_once(dp_stream, make_stream_chunks("c"))
         if cold is not None:
             cold_wall_s, cold_summary = cold
@@ -510,8 +518,10 @@ def main() -> None:
             # store capacities, steady windows at the grown one — a
             # different program that would otherwise bill its compile
             # wall to the first counted rep
+            bench_clock["ms"] += 301_000  # TTL-prune the cold window's ids
             stream_once(dp_stream, make_stream_chunks("s"))
             for k in range(4):
+                bench_clock["ms"] += 301_000
                 chunks = make_stream_chunks(f"r{k}x")
                 out = stream_once(dp_stream, chunks)
                 del chunks
@@ -1038,7 +1048,10 @@ def main() -> None:
             "trace ids and identical naming shapes — production after "
             "boot; cold first window in e2e_stream_cold_*, r4-style "
             "legacy shape (fresh processor per rep) in "
-            "e2e_stream_legacy_*. Best-of-4 critical path from measured "
+            "e2e_stream_legacy_*; a virtual clock advances past the "
+            "5-min dedup TTL between reps so the processed-trace map "
+            "holds its production steady size. Best-of-4 critical path "
+            "from measured "
             "per-chunk phases with ONLY the measured host->device copy "
             "excluded (dev-harness tunnel ~10 MB/s; PCIe on a TPU VM); "
             "measured tunnel-inclusive walls reported in "

@@ -200,10 +200,12 @@ class ModelHandler(IRequestHandler):
         # hour at 10k endpoints
         cached = self._forecast_cache
         if cached is not None and cached[0] is snap:
-            # pre-encoded bytes ride raw_body (the HTTP layer prefers it)
-            # so polls skip the ~1 MB json.dumps too; .payload stays for
-            # in-process dispatch consumers
-            return Response(payload=cached[1], raw_body=cached[2])
+            # pre-encoded (and pre-gzipped) bytes ride the response so
+            # polls skip both the ~1 MB json.dumps and the per-request
+            # gzip; .payload stays for in-process dispatch consumers
+            return Response(
+                payload=cached[1], raw_body=cached[2], raw_gzip=cached[3]
+            )
         feats = snap["features"]
         params, meta, model = loaded
         if feats.shape[1] != int(meta["num_features"]):
@@ -244,6 +246,9 @@ class ModelHandler(IRequestHandler):
             "model": meta.get("model"),
             "endpoints": endpoints,
         }
+        import gzip
+
         encoded = json.dumps(payload).encode()
-        self._forecast_cache = (snap, payload, encoded)
-        return Response(payload=payload, raw_body=encoded)
+        zipped = gzip.compress(encoded)
+        self._forecast_cache = (snap, payload, encoded, zipped)
+        return Response(payload=payload, raw_body=encoded, raw_gzip=zipped)

@@ -52,6 +52,11 @@ class Response:
     status: int = 200
     payload: Any = None  # JSON-encoded unless raw_body is set
     raw_body: Optional[bytes] = None
+    # optional pre-compressed twin of raw_body: handlers serving a
+    # memoized large body (e.g. the hourly forecast) cache the gzip once
+    # instead of re-compressing ~1 MB per poll; MUST be
+    # gzip.compress(raw_body) or absent
+    raw_gzip: Optional[bytes] = None
     content_type: str = "application/json"
     headers: Dict[str, str] = field(default_factory=dict)
 
@@ -251,7 +256,13 @@ def make_http_handler(router: Router, cache_max_age: int = 5):
             accept = self.headers.get("Accept-Encoding", "")
             use_gzip = "gzip" in accept and len(body) > 512
             if use_gzip:
-                body = gzip.compress(body)
+                if (
+                    response.raw_gzip is not None
+                    and body is response.raw_body
+                ):
+                    body = response.raw_gzip
+                else:
+                    body = gzip.compress(body)
             self.send_response(response.status)
             bodyless = response.status in (204, 304)
             if not bodyless:  # RFC 7230 §3.3.2: no body framing on 204/304

@@ -150,7 +150,8 @@ class DataProcessor:
         Each phase is step-timed (GET /timings on the DP server) and the
         device work can be captured with jax.profiler by setting
         KMAMIZ_PROFILE_DIR (SURVEY.md §5 tracing/profiling parity)."""
-        t_start = self._now_ms()
+        t_start = self._now_ms()  # domain time: dedup stamps, req default
+        wall_t0 = time.perf_counter()
         look_back = request.get("lookBack", 30_000)
         req_time = request.get("time", int(t_start))
         existing_dep = request.get("existingDep")
@@ -222,7 +223,7 @@ class DataProcessor:
                 for d in combined_list_datatypes(combined)
             ]
 
-        elapsed = self._now_ms() - t_start
+        elapsed = (time.perf_counter() - wall_t0) * 1000
         return {
             "uniqueId": request.get("uniqueId", ""),
             "combined": combined.to_json(),
@@ -660,7 +661,8 @@ class DataProcessor:
         payload is malformed (callers may fall back to collect)."""
         from kmamiz_tpu.core.spans import raw_spans_to_batch
 
-        t_start = self._now_ms()
+        t_start = self._now_ms()  # domain time for the dedup registration
+        wall_t0 = time.perf_counter()
         with self._dedup_lock:
             skip_blob = self._skip_blob_locked()
         with step_timer.phase("raw_ingest_parse"):
@@ -689,7 +691,7 @@ class DataProcessor:
             "traces": len(kept),
             "endpoints": batch.num_endpoints,
             "edges": int(self.graph.n_edges),
-            "ms": round(self._now_ms() - t_start, 1),
+            "ms": round((time.perf_counter() - wall_t0) * 1000, 1),
         }
 
     def _register_processed(self, kept, when_ms: float) -> None:
@@ -742,7 +744,9 @@ class DataProcessor:
 
         from kmamiz_tpu.core.spans import raw_spans_to_batch
 
-        t_start = self._now_ms()
+        wall_t0 = time.perf_counter()  # wall accounting: monotonic, not
+        # the injectable domain clock (a virtual clock frozen mid-call
+        # would zero ms/saved_ms)
         parse_ms = 0.0
         merge_ms = 0.0
         totals = {"spans": 0, "traces": 0, "chunks": 0}
@@ -815,7 +819,7 @@ class DataProcessor:
         t0 = time.perf_counter()
         n_edges = int(self.graph.n_edges)
         drain_ms = (time.perf_counter() - t0) * 1000.0
-        wall_ms = self._now_ms() - t_start
+        wall_ms = (time.perf_counter() - wall_t0) * 1000
         return {
             **totals,
             "endpoints": len(self.graph.interner.endpoints),

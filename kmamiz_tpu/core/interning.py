@@ -71,6 +71,12 @@ class EndpointInterner:
         self.services = StringInterner()
         self._endpoint_service: List[int] = []
         self._endpoint_infos: List[Optional[dict]] = []
+        # per-endpoint info timestamp MIRROR in lockstep with
+        # _endpoint_infos (0.0 while info is None): lets bulk consumers
+        # (graph-store recency metadata, the raw-ingest session's
+        # freshest-timestamp refresh) read all timestamps as one numpy
+        # array instead of walking 10k+ info dicts per window
+        self._info_ts: List[float] = []
         # shared across ingest threads (the /ingest backfill races the
         # realtime tick, and the streaming pipeline overlaps the parse of
         # chunk k+1 with the merge of chunk k): the GIL makes dict ops
@@ -93,13 +99,43 @@ class EndpointInterner:
                 sid = self.services.intern(service_name)
                 self._endpoint_service.append(sid)
                 self._endpoint_infos.append(None)
+                self._info_ts.append(0.0)
             if info is not None:
                 existing = self._endpoint_infos[eid]
                 if existing is None or info.get("timestamp", 0) > existing.get(
                     "timestamp", 0
                 ):
                     self._endpoint_infos[eid] = info
+                    self._info_ts[eid] = float(info.get("timestamp", 0) or 0)
             return eid
+
+    def info_timestamps(self):
+        """Snapshot of the per-endpoint info-timestamp mirror as a
+        float64 numpy array (index = endpoint id; 0.0 = no info)."""
+        import numpy as np
+
+        with self._intern_lock:
+            return np.asarray(self._info_ts, dtype=np.float64)
+
+    def refresh_info_timestamps(self, eids, ts_ms) -> None:
+        """Bulk freshest-timestamp refresh: for each (eid, ts) pair,
+        advance the existing info's timestamp in place when strictly
+        newer — the session ingest path's vectorized equivalent of
+        re-interning `{**info, "timestamp": ts}` per endpoint. Info
+        CONTENT is unchanged by design: callers use this only when the
+        winning naming shape for the endpoint is the one already
+        applied (otherwise they fall back to intern_endpoint)."""
+        with self._intern_lock:
+            infos = self._endpoint_infos
+            mirror = self._info_ts
+            for eid, ts in zip(
+                eids.tolist() if hasattr(eids, "tolist") else eids,
+                ts_ms.tolist() if hasattr(ts_ms, "tolist") else ts_ms,
+            ):
+                info = infos[eid]
+                if info is not None and ts > info.get("timestamp", 0):
+                    info["timestamp"] = ts
+                    mirror[eid] = ts
 
     def service_of(self, endpoint_id: int) -> int:
         return self._endpoint_service[endpoint_id]

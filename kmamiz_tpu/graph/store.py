@@ -197,6 +197,24 @@ class EndpointGraph:
         # _stage_max_rows
         self._staged = []
         self._staged_rows = 0
+        # mid-stream pre-union (streaming drain overlap): earlier staged
+        # windows collapse into ONE dispatched-but-unfetched union while
+        # later chunks still parse on the host, so the stream's final
+        # drain unions a small tail instead of every window at once.
+        # _preunion holds (src, dst, dist) valid-first/SENTINEL-padded
+        # device arrays that already INCLUDE the store's edges;
+        # _preunion_count is its async valid-count scalar (sliced into
+        # the next union once landed); _preunion_checks carries the
+        # deferred truncation checks (count, cap, dev_in, depth, mesh)
+        # whose pinned inputs must re-walk at the drain if truncated.
+        self._preunion = None
+        self._preunion_count = None
+        self._preunion_checks = []
+        # rows pinned by _preunion_checks' walk inputs: counts toward the
+        # _stage_max_rows backstop (the pre-union zeroes _staged_rows, so
+        # without this an unread stream's deferred checks would pin
+        # windows x padded-input HBM unbounded — the ADVICE r4 invariant)
+        self._preunion_rows = 0
         # distance bounds ever merged (host-tracked): gate the
         # packed-single-key sort fast path at the drain. Walk kernels
         # only emit dist >= 1; warm-start records can carry anything
@@ -380,8 +398,15 @@ class EndpointGraph:
             self._staged_rows += int(s.shape[0]) + int(dev_in[0].size)
             self._update_ep_metadata(batch)
             # backstop: an unread stream must not grow HBM unboundedly
-            if self._staged_rows > self._stage_max_rows():
+            # (pre-union-deferred checks pin their walk inputs too)
+            if self._staged_rows + self._preunion_rows > self._stage_max_rows():
                 self._finalize_pending_locked()
+            elif self._preunion is not None or len(self._staged) >= 2:
+                # drain overlap: collapse what's staged into one async
+                # union now, while the stream's next chunk parses on the
+                # host — the final drain then adopts the last pre-union
+                # instead of sorting every window at once
+                self._preunion_staged_locked()
             return transfer_ms
         self._finalize_pending_locked()
         if packed is not None:
@@ -434,6 +459,18 @@ class EndpointGraph:
         self._ensure_ep_arrays(n_ep)
         server_eps = batch.endpoint_id[batch.valid & (batch.kind == KIND_SERVER)]
         self._ep_record[server_eps] = True
+        if batch.interner is self.interner:
+            # same interner: endpoint ids line up, so the recency update
+            # is one vectorized max over the interner's timestamp mirror
+            # (monotone — reading a few concurrent refreshes early is
+            # harmless) instead of a 10k+ info-dict walk per window
+            ts = batch.interner.info_timestamps()
+            k = min(ts.size, n_ep)
+            if k:
+                np.maximum(
+                    self._ep_last_ts[:k], ts[:k], out=self._ep_last_ts[:k]
+                )
+            return
         for info in batch.endpoint_infos:
             eid = self.interner.endpoints.get(info["uniqueEndpointName"])
             if eid is not None and eid < n_ep:
@@ -472,7 +509,7 @@ class EndpointGraph:
             self._finalize_pending_locked()
 
     def _finalize_pending_locked(self) -> None:
-        if self._staged:
+        if self._staged or self._preunion is not None:
             self._drain_staged_locked()  # resolves _pending too
             return
         if self._pending is None:
@@ -498,6 +535,73 @@ class EndpointGraph:
             self._dist = jnp.concatenate([dist, pad])
         self._n_edges = valid_count
 
+    def _base_edge_cols(self):
+        """Starting columns for a union: the pre-union result when one
+        exists (it already contains the store's edges; its async count
+        slices it to a pow2 bucket once landed), else the store arrays."""
+        if self._preunion is not None:
+            s0, d0, ds0 = self._preunion
+            c = self._preunion_count
+            if c is not None:
+                # the count copy was dispatched a full chunk ago, so this
+                # wait is ~a scalar round trip; slicing UNCONDITIONALLY
+                # keeps the chained-union widths deterministic (one small
+                # program set, no mid-bench recompiles on count-arrival
+                # races)
+                k = min(
+                    int(s0.shape[0]),
+                    _pow2(max(int(np.asarray(c)), 1), minimum=256),
+                )
+                if k < int(s0.shape[0]):
+                    s0, d0, ds0 = s0[:k], d0[:k], ds0[:k]
+            return [s0], [d0], [ds0], [s0 != SENTINEL]
+        return (
+            [self._src],
+            [self._dst],
+            [self._dist],
+            [self._src != SENTINEL],
+        )
+
+    def _preunion_staged_locked(self) -> None:
+        """Collapse the staged windows so far into one dispatched-but-
+        unfetched union (drain overlap): the device sorts while the host
+        parses the next chunk, and the stream's final drain unions only
+        the tail. No device sync happens here — ready counts slice,
+        not-ready ones defer their truncation checks to the drain."""
+        if not self._staged or self._pending is not None:
+            return
+        staged, self._staged = self._staged, []
+        self._staged_rows = 0
+        srcs, dsts, dists, masks = self._base_edge_cols()
+        # resolve carried-over truncation checks whose counts have landed
+        # since the last pre-union: non-truncated ones RELEASE their
+        # pinned walk inputs now (bounding pinned HBM to the in-flight
+        # tail), truncated ones re-walk into this union
+        still_deferred = []
+        for chk in self._preunion_checks:
+            count_c, cap_c, dev_in_c, depth_c, mesh_c = chk
+            if hasattr(count_c, "is_ready") and not count_c.is_ready():
+                still_deferred.append(chk)
+                continue
+            self._preunion_rows -= int(dev_in_c[0].size)
+            if (np.asarray(count_c) > cap_c).any():
+                s_, d_, ds_, m_ = self._rewalk_staged(dev_in_c, depth_c, mesh_c)
+                srcs.append(s_)
+                dsts.append(d_)
+                dists.append(ds_)
+                masks.append(m_)
+        self._preunion_checks = still_deferred
+        deferred = []
+        self._collect_staged_cols(staged, srcs, dsts, dists, masks, deferred)
+        (s, d, ds), v = self._union_edge_cols(srcs, dsts, dists, masks)
+        count = v.sum()
+        if hasattr(count, "copy_to_host_async"):
+            count.copy_to_host_async()
+        self._preunion = (s, d, ds)
+        self._preunion_count = count
+        self._preunion_checks.extend(deferred)
+        self._preunion_rows += sum(int(c[2][0].size) for c in deferred)
+
     def _drain_staged_locked(self) -> None:
         """ONE set-union over the store + every staged window's compacted
         prefix: the batched equivalent of k fused merges, with the big
@@ -513,13 +617,69 @@ class EndpointGraph:
         if self._pending is not None:
             pending, self._pending = self._pending, None
             self._apply_merged(*pending)
-        srcs, dsts, dists, masks = (
-            [self._src],
-            [self._dst],
-            [self._dist],
-            [self._src != SENTINEL],
-        )
-        deferred = []  # truncation checks postponed past the union dispatch
+        if not staged and self._preunion is not None:
+            # nothing new since the last pre-union: ADOPT it as the
+            # merged result instead of re-sorting it (the streaming
+            # drain's common case — only its count fetch remains)
+            s, d, ds = self._preunion
+            count = self._preunion_count
+            checks = self._preunion_checks
+            self._preunion = None
+            self._preunion_count = None
+            self._preunion_checks = []
+            self._preunion_rows = 0
+            rewalk = [
+                (dev_in, depth, mesh)
+                for c, cap, dev_in, depth, mesh in checks
+                if (np.asarray(c) > cap).any()
+            ]
+            if rewalk:
+                extra = [self._rewalk_staged(*r) for r in rewalk]
+                (s, d, ds), v = self._union_edge_cols(
+                    [s] + [e[0] for e in extra],
+                    [d] + [e[1] for e in extra],
+                    [ds] + [e[2] for e in extra],
+                    [s != SENTINEL] + [e[3] for e in extra],
+                )
+                count = v.sum()
+            self._apply_merged(s, d, ds, count)
+            return
+        srcs, dsts, dists, masks = self._base_edge_cols()
+        deferred = list(self._preunion_checks)
+        self._preunion = None
+        self._preunion_count = None
+        self._preunion_checks = []
+        self._preunion_rows = 0
+        self._collect_staged_cols(staged, srcs, dsts, dists, masks, deferred)
+        (s, d, ds), v = self._union_edge_cols(srcs, dsts, dists, masks)
+        count_sum = v.sum()
+        if hasattr(count_sum, "copy_to_host_async"):
+            count_sum.copy_to_host_async()
+        # resolve the deferred truncation checks (their copies now
+        # overlap the union's execution instead of preceding it)
+        rewalk = [
+            (dev_in, depth, mesh)
+            for count, cap, dev_in, depth, mesh in deferred
+            if (np.asarray(count) > cap).any()
+        ]
+        if rewalk:
+            extra = [self._rewalk_staged(*r) for r in rewalk]
+            (s, d, ds), v = self._union_edge_cols(
+                [s] + [e[0] for e in extra],
+                [d] + [e[1] for e in extra],
+                [ds] + [e[2] for e in extra],
+                [v] + [e[3] for e in extra],
+            )
+            count_sum = v.sum()
+        self._apply_merged(s, d, ds, count_sum)
+
+    def _collect_staged_cols(
+        self, staged, srcs, dsts, dists, masks, deferred
+    ) -> None:
+        """Append each staged window's compacted prefix to the union
+        columns: landed counts slice the prefix to its true pow2 width
+        (or re-walk immediately when truncated); in-flight counts join
+        at full width and push their truncation check into `deferred`."""
         for s, d, ds, count, dev_in, depth, mesh in staged:
             # per-shard prefix width: sharded entries carry one stage_cap
             # prefix per device and an [n_dev] count vector
@@ -568,40 +728,18 @@ class EndpointGraph:
             dists.append(ds)
             masks.append(s != SENTINEL)
 
-        def union(cols_src, cols_dst, cols_dist, cols_mask):
-            src = jnp.concatenate(cols_src)
-            dst = jnp.concatenate(cols_dst)
-            dist = jnp.concatenate(cols_dist)
-            mask = jnp.concatenate(cols_mask)
-            if (
-                len(self.interner.endpoints) <= EDGE_KEY_MAX_EP
-                and self._min_dist >= 1
-                and self._max_dist <= EDGE_KEY_MAX_DIST
-            ):
-                return compact_unique_edges_packed(src, dst, dist, mask)
-            return compact_unique((src, dst, dist), mask)
-
-        (s, d, ds), v = union(srcs, dsts, dists, masks)
-        count_sum = v.sum()
-        if hasattr(count_sum, "copy_to_host_async"):
-            count_sum.copy_to_host_async()
-        # resolve the deferred truncation checks (their copies now
-        # overlap the union's execution instead of preceding it)
-        rewalk = [
-            (dev_in, depth, mesh)
-            for count, cap, dev_in, depth, mesh in deferred
-            if (np.asarray(count) > cap).any()
-        ]
-        if rewalk:
-            extra = [self._rewalk_staged(*r) for r in rewalk]
-            (s, d, ds), v = union(
-                [s] + [e[0] for e in extra],
-                [d] + [e[1] for e in extra],
-                [ds] + [e[2] for e in extra],
-                [v] + [e[3] for e in extra],
-            )
-            count_sum = v.sum()
-        self._apply_merged(s, d, ds, count_sum)
+    def _union_edge_cols(self, cols_src, cols_dst, cols_dist, cols_mask):
+        src = jnp.concatenate(cols_src)
+        dst = jnp.concatenate(cols_dst)
+        dist = jnp.concatenate(cols_dist)
+        mask = jnp.concatenate(cols_mask)
+        if (
+            len(self.interner.endpoints) <= EDGE_KEY_MAX_EP
+            and self._min_dist >= 1
+            and self._max_dist <= EDGE_KEY_MAX_DIST
+        ):
+            return compact_unique_edges_packed(src, dst, dist, mask)
+        return compact_unique((src, dst, dist), mask)
 
     @staticmethod
     def _rewalk_staged(dev_in, depth, mesh):

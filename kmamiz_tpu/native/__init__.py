@@ -126,6 +126,47 @@ def _open_and_bind() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_size_t),
         ]
         lib.km_split_groups.restype = ctypes.c_void_p
+        lib.km_skipset_new.argtypes = []
+        lib.km_skipset_new.restype = ctypes.c_void_p
+        lib.km_skipset_free.argtypes = [ctypes.c_void_p]
+        lib.km_skipset_free.restype = None
+        lib.km_skipset_extend.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            ctypes.c_size_t,
+        ]
+        lib.km_skipset_extend.restype = ctypes.c_longlong
+        lib.km_skipset_clear.argtypes = [ctypes.c_void_p]
+        lib.km_skipset_clear.restype = None
+        lib.km_skipset_size.argtypes = [ctypes.c_void_p]
+        lib.km_skipset_size.restype = ctypes.c_ulonglong
+        lib.km_parse_spans_hs.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            ctypes.c_size_t,
+            ctypes.c_int,
+            ctypes.POINTER(ctypes.c_size_t),
+        ]
+        lib.km_parse_spans_hs.restype = ctypes.c_void_p
+        lib.km_session_new.argtypes = []
+        lib.km_session_new.restype = ctypes.c_void_p
+        lib.km_session_free.argtypes = [ctypes.c_void_p]
+        lib.km_session_free.restype = None
+        lib.km_session_ack.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_uint32,
+            ctypes.c_uint32,
+        ]
+        lib.km_session_ack.restype = None
+        lib.km_parse_spans_sess.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            ctypes.c_size_t,
+            ctypes.c_int,
+            ctypes.POINTER(ctypes.c_size_t),
+        ]
+        lib.km_parse_spans_sess.restype = ctypes.c_void_p
         lib.km_free.argtypes = [ctypes.c_void_p]
         lib.km_free.restype = None
         return lib
@@ -247,11 +288,218 @@ def encode_skip_entry(tid) -> bytes:
     return struct.pack("<BI", 1, len(b)) + b
 
 
+class SkipSet:
+    """Persistent native processed-trace set (km_skipset_* C API).
+
+    Replaces the per-parse skip blob on the streaming path: the
+    DataProcessor extends it incrementally as traces register
+    (`extend` takes the same skip-entry bytes `encode_skip_entry`
+    produces, sans count header) and passes the handle to every parse —
+    so the parse stops re-encoding and re-hashing the whole processed
+    set per chunk. Falls back transparently: when the extension is
+    unavailable, `handle` is None and callers use the blob path.
+    Thread-safe on the native side (per-probe mutex)."""
+
+    __slots__ = ("_lib", "_handle")
+
+    def __init__(self) -> None:
+        self._lib = _load()
+        self._handle = self._lib.km_skipset_new() if self._lib else None
+
+    @property
+    def handle(self):
+        return self._handle
+
+    def extend(self, entries: bytes) -> int:
+        """Add skip-entry records; returns records walked (-1 = malformed)."""
+        if self._handle is None or not entries:
+            return 0
+        return int(
+            self._lib.km_skipset_extend(
+                self._handle, bytes(entries), len(entries)
+            )
+        )
+
+    def clear(self) -> None:
+        if self._handle is not None:
+            self._lib.km_skipset_clear(self._handle)
+
+    def __len__(self) -> int:
+        if self._handle is None:
+            return 0
+        return int(self._lib.km_skipset_size(self._handle))
+
+    def __del__(self) -> None:
+        handle, self._handle = self._handle, None
+        if handle is not None and self._lib is not None:
+            try:
+                self._lib.km_skipset_free(handle)
+            except (OSError, AttributeError):  # interpreter teardown
+                pass
+
+
+def _decode_session_payload(buf) -> Optional[dict]:
+    """Decode the session wire format (header ok=2): span columns carry
+    session-GLOBAL shape/status ids; shape/status strings appear only
+    for the unacked tail [base..total). Raises like the v1 decode on
+    malformed buffers (the caller's except clauses handle both)."""
+    import numpy as np
+
+    (
+        _fmt,
+        n,
+        shapes_total,
+        statuses_total,
+        shape_base,
+        status_base,
+        n_groups,
+        prescan_us,
+        parse_us,
+        merge_packed,
+    ) = struct.unpack_from("<10I", buf, 0)
+    timings = {
+        "prescan_us": prescan_us,
+        "parse_us": parse_us,
+        "merge_us": merge_packed & 0x01FFFFFF,
+        "threads": merge_packed >> 25,
+    }
+    pos = 40
+    latency_ms = np.frombuffer(buf, np.float64, n, pos)
+    pos += 8 * n
+    timestamp_raw = np.frombuffer(buf, np.float64, n, pos)
+    pos += 8 * n
+    shape_max_ts_ms = np.frombuffer(buf, np.float64, shapes_total, pos)
+    pos += 8 * shapes_total
+    parent_idx = np.frombuffer(buf, np.int32, n, pos)
+    pos += 4 * n
+    shape_id = np.frombuffer(buf, np.int32, n, pos)
+    pos += 4 * n
+    status_id = np.frombuffer(buf, np.int32, n, pos)
+    pos += 4 * n
+    trace_of = np.frombuffer(buf, np.int32, n, pos)
+    pos += 4 * n
+    kind = np.frombuffer(buf, np.int8, n, pos)
+    pos += n
+
+    new_shapes = []
+    for _ in range(shapes_total - shape_base):
+        url_present = buf[pos] != 0
+        bits = buf[pos + 1]
+        pos += 2
+        fields = []
+        for _f in range(7):
+            (flen,) = struct.unpack_from("<I", buf, pos)
+            pos += 4
+            fields.append(bytes(buf[pos : pos + flen]))
+            pos += flen
+        new_shapes.append((tuple(fields), url_present, bits))
+
+    new_statuses = []
+    for _ in range(statuses_total - status_base):
+        (slen,) = struct.unpack_from("<I", buf, pos)
+        pos += 4
+        new_statuses.append(
+            buf[pos : pos + slen].decode("utf-8", "surrogatepass")
+        )
+        pos += slen
+
+    # kept trace ids, vectorized: presence + length arrays give every
+    # record's offset in one cumsum; the ASCII fast path decodes the
+    # whole interleaved section once and slices strings out of it (tids
+    # are hex in real Zipkin data). The interleaved records are
+    # byte-identical to encode_skip_entry layout, so the raw slice also
+    # serves as the caller's incremental dedup-blob append.
+    present = np.frombuffer(buf, np.uint8, n_groups, pos)
+    pos += n_groups
+    tlens = np.frombuffer(buf, np.uint32, n_groups, pos).astype(np.int64)
+    pos += 4 * n_groups
+    blob_len = 5 * n_groups + int(tlens.sum())
+    kept_blob = buf[pos : pos + blob_len]
+    if len(kept_blob) != blob_len:
+        raise ValueError("truncated kept-trace-id section")
+    pos += blob_len
+    starts = 5 * (np.arange(n_groups, dtype=np.int64) + 1)
+    starts[1:] += np.cumsum(tlens[:-1])
+    ends = starts + tlens
+    present_l = (present != 0).tolist()
+    if kept_blob.isascii():
+        s = kept_blob.decode("ascii")
+        trace_ids = [
+            s[a:b] if p else None
+            for a, b, p in zip(starts.tolist(), ends.tolist(), present_l)
+        ]
+    else:
+        trace_ids = [
+            kept_blob[a:b].decode("utf-8", "surrogatepass") if p else None
+            for a, b, p in zip(starts.tolist(), ends.tolist(), present_l)
+        ]
+
+    return {
+        "n_spans": int(n),
+        "kind": kind,
+        "parent_idx": parent_idx,
+        "shape_id": shape_id,
+        "status_id": status_id,
+        "trace_of": trace_of,
+        "latency_ms": latency_ms,
+        "timestamp_us": timestamp_raw.astype(np.int64),
+        "shape_max_ts_ms": shape_max_ts_ms,
+        "trace_ids": trace_ids,
+        "trace_ids_blob": kept_blob,
+        "timings": timings,
+        "session_format": True,
+        "shape_base": int(shape_base),
+        "shapes_total": int(shapes_total),
+        "status_base": int(status_base),
+        "statuses_total": int(statuses_total),
+        "new_shapes": new_shapes,
+        "new_statuses": new_statuses,
+    }
+
+
+class ParseSession:
+    """Persistent native parse session (km_session_* C API).
+
+    Keeps the shape/status intern tables alive across parse calls so a
+    chunked stream stops re-serializing and re-decoding ~10k identical
+    naming shapes per page: spans arrive with session-global ids and
+    only NEW (unacknowledged) shapes/statuses carry strings. The caller
+    acks after successfully consuming a payload; a rejected payload
+    (e.g. invalid UTF-8 in a field) is simply never acked and its
+    additions re-emit next call."""
+
+    __slots__ = ("_lib", "_handle")
+
+    def __init__(self) -> None:
+        self._lib = _load()
+        self._handle = self._lib.km_session_new() if self._lib else None
+
+    @property
+    def handle(self):
+        return self._handle
+
+    def ack(self, shapes_known: int, statuses_known: int) -> None:
+        if self._handle is not None:
+            self._lib.km_session_ack(
+                self._handle, int(shapes_known), int(statuses_known)
+            )
+
+    def __del__(self) -> None:
+        handle, self._handle = self._handle, None
+        if handle is not None and self._lib is not None:
+            try:
+                self._lib.km_session_free(handle)
+            except (OSError, AttributeError):  # interpreter teardown
+                pass
+
+
 def parse_spans(
     raw: bytes,
     skip_trace_ids: Sequence = (),
     threads: Optional[int] = None,
     skip_blob: Optional[bytes] = None,
+    skipset: "Optional[SkipSet]" = None,
+    session: "Optional[ParseSession]" = None,
 ) -> Optional[dict]:
     """Scan a raw Zipkin JSON response ([[span,...],...]) into SoA arrays.
 
@@ -282,24 +530,43 @@ def parse_spans(
     lib = _load()
     if lib is None:
         return None
-    if skip_blob is None:
-        skip_blob = bytearray(struct.pack("<I", len(skip_trace_ids)))
-        for t in skip_trace_ids:
-            skip_blob += encode_skip_entry(t)
-
     if threads is None:
         threads = parse_threads()
     out_len = ctypes.c_size_t(0)
     # the json buffer crosses ctypes without a copy (c_char_p on bytes)
     raw = bytes(raw) if not isinstance(raw, bytes) else raw
-    ptr = lib.km_parse_spans_mt(
-        bytes(skip_blob),
-        len(skip_blob),
-        raw,
-        len(raw),
-        int(threads),
-        ctypes.byref(out_len),
-    )
+    if session is not None and session.handle is not None:
+        # persistent-session path: global ids + delta shape emission
+        ptr = lib.km_parse_spans_sess(
+            session.handle,
+            skipset.handle if skipset is not None else None,
+            raw,
+            len(raw),
+            int(threads),
+            ctypes.byref(out_len),
+        )
+    elif skipset is not None and skipset.handle is not None:
+        # persistent-set path: no per-call blob at all
+        ptr = lib.km_parse_spans_hs(
+            skipset.handle,
+            raw,
+            len(raw),
+            int(threads),
+            ctypes.byref(out_len),
+        )
+    else:
+        if skip_blob is None:
+            skip_blob = bytearray(struct.pack("<I", len(skip_trace_ids)))
+            for t in skip_trace_ids:
+                skip_blob += encode_skip_entry(t)
+        ptr = lib.km_parse_spans_mt(
+            bytes(skip_blob),
+            len(skip_blob),
+            raw,
+            len(raw),
+            int(threads),
+            ctypes.byref(out_len),
+        )
     if not ptr:
         return None
     try:
@@ -308,6 +575,9 @@ def parse_spans(
         lib.km_free(ptr)
 
     try:
+        (fmt,) = struct.unpack_from("<I", buf, 0)
+        if fmt == 2:
+            return _decode_session_payload(buf)
         (
             ok,
             n,

@@ -79,6 +79,19 @@ class DataProcessor:
         # passes it straight to the native scanner instead of re-encoding
         # a six-figure processed set on every chunk
         self._skip_entries = bytearray()
+        # persistent native mirror of the skip entries (native.SkipSet):
+        # the streaming parse passes the HANDLE, so the native side stops
+        # rebuilding a hash set from the blob on every chunk. Lazily
+        # created; _skip_gen bumps whenever the blob is REBUILT (prune)
+        # so the sync logic knows appends-so-far are stale.
+        self._native_skipset = None
+        self._skipset_synced = 0  # bytes of _skip_entries already pushed
+        self._skip_gen = 0
+        self._skipset_gen = -1  # generation the native set reflects
+        # persistent raw-ingest session (core.spans.RawIngestSession):
+        # shape/status tables survive across chunks so warm pages carry
+        # zero naming strings; lazily created, None when native is out
+        self._raw_session = None
         # collect() runs on the scheduler/DP thread while /ingest backfills
         # arrive on other server threads; dedup-map transitions serialize
         # here (the graph store carries its own lock)
@@ -133,6 +146,7 @@ class DataProcessor:
             self._skip_entries = bytearray()
             for tid in pruned:
                 self._skip_entries += encode_skip_entry(tid)
+            self._skip_gen += 1  # native skip set must clear + resync
 
     def _skip_blob_locked(self) -> bytes:
         """Snapshot of the full native skip blob (header + entries)."""
@@ -141,6 +155,39 @@ class DataProcessor:
         return struct.pack("<I", len(self._processed)) + bytes(
             self._skip_entries
         )
+
+    def _skipset_locked(self):
+        """The persistent native skip set, synced to _skip_entries (caller
+        holds _dedup_lock). Returns None when the extension is missing —
+        callers then fall back to the per-parse blob snapshot. A prune
+        rebuild (generation bump) clears and re-pushes the whole blob;
+        otherwise only the appended delta crosses the boundary."""
+        from kmamiz_tpu.native import SkipSet
+
+        if self._native_skipset is None:
+            ss = SkipSet()
+            if ss.handle is None:
+                return None
+            self._native_skipset = ss
+        ss = self._native_skipset
+        if self._skipset_gen != self._skip_gen:
+            ss.clear()
+            self._skipset_synced = 0
+            self._skipset_gen = self._skip_gen
+        if self._skipset_synced < len(self._skip_entries):
+            ss.extend(bytes(self._skip_entries[self._skipset_synced :]))
+            self._skipset_synced = len(self._skip_entries)
+        return ss
+
+    def _raw_session_locked(self):
+        """The persistent raw-ingest session (caller holds _dedup_lock
+        for the lazy create; the session carries its own consumer
+        lock). None when the native extension is unavailable."""
+        if self._raw_session is None:
+            from kmamiz_tpu.core.spans import RawIngestSession
+
+            self._raw_session = RawIngestSession(self.graph.interner)
+        return self._raw_session if self._raw_session.available else None
 
     # -- the tick ------------------------------------------------------------
 
@@ -664,22 +711,28 @@ class DataProcessor:
         t_start = self._now_ms()  # domain time for the dedup registration
         wall_t0 = time.perf_counter()
         with self._dedup_lock:
-            skip_blob = self._skip_blob_locked()
+            skipset = self._skipset_locked()
+            skip_blob = None if skipset is not None else self._skip_blob_locked()
+            session = self._raw_session_locked()
         with step_timer.phase("raw_ingest_parse"):
             out = raw_spans_to_batch(
                 raw,
                 interner=self.graph.interner,
                 skip_blob=skip_blob,
+                skipset=skipset,
+                session=session,
             )
         if out is None:
             raise ValueError(
                 "native span loader unavailable or malformed payload"
             )
         batch, kept = out
-        # the snapshot above is taken before the (long) parse: a trace that
-        # a concurrent collect() processes in between is merged twice —
-        # benign for the set-union edge store — but registrations are never
-        # lost to a concurrent dict rebuild
+        # dedup state during the (long) parse: the blob path snapshots
+        # before parsing (a trace a concurrent collect() processes in
+        # between merges twice — benign for the set-union edge store);
+        # the persistent-skipset path sees mid-parse registrations live,
+        # which only ever skips MORE duplicates. Registrations are never
+        # lost to a concurrent dict rebuild either way.
         self._register_processed(kept, t_start)
         if batch.n_spans:
             with step_timer.phase("raw_ingest_graph"), profiling.trace(
@@ -696,14 +749,30 @@ class DataProcessor:
 
     def _register_processed(self, kept, when_ms: float) -> None:
         """Register kept trace ids in the processed map + TTL prune (the
-        one definition both raw-ingest paths share)."""
+        one definition both raw-ingest paths share). When the parse
+        supplied the raw skip-entry bytes of the kept records
+        (KeptTraceIds.blob) and every id is new — the steady streaming
+        case — the blob appends as ONE slice instead of re-encoding
+        each id."""
         from kmamiz_tpu.native import encode_skip_entry
 
+        blob = getattr(kept, "blob", None)
         with self._dedup_lock:
-            for tid in kept:
-                if tid not in self._processed:
-                    self._skip_entries += encode_skip_entry(tid)
-                self._processed[tid] = when_ms
+            if (
+                blob is not None
+                and kept
+                and all(t not in self._processed for t in kept)
+            ):
+                # prescan-deduped ids, all new: dict additions and blob
+                # entries stay 1:1 (the blob layout is byte-identical to
+                # encode_skip_entry, absent markers included)
+                self._skip_entries += blob
+                self._processed.update(zip(kept, [when_ms] * len(kept)))
+            else:
+                for tid in kept:
+                    if tid not in self._processed:
+                        self._skip_entries += encode_skip_entry(tid)
+                    self._processed[tid] = when_ms
             self._prune_processed_locked(when_ms)
 
     # -- streaming raw ingest: parse(k+1) overlaps merge(k) ------------------
@@ -766,10 +835,18 @@ class DataProcessor:
             except StopIteration:
                 return None
             with self._dedup_lock:
-                skip_blob = self._skip_blob_locked()
+                skipset = self._skipset_locked()
+                skip_blob = (
+                    None if skipset is not None else self._skip_blob_locked()
+                )
+                session = self._raw_session_locked()
             t0 = time.perf_counter()
             out = raw_spans_to_batch(
-                raw, interner=self.graph.interner, skip_blob=skip_blob
+                raw,
+                interner=self.graph.interner,
+                skip_blob=skip_blob,
+                skipset=skipset,
+                session=session,
             )
             return out, (time.perf_counter() - t0) * 1000.0
 

@@ -81,6 +81,7 @@
 #include <cstdlib>
 #include <cstring>
 #include <memory>
+#include <mutex>
 #include <string>
 #include <thread>
 #include <vector>
@@ -482,6 +483,10 @@ struct SvMap {
     return nullptr;
   }
 
+  const int32_t* find(sv key) const {
+    return const_cast<SvMap*>(this)->find(key);
+  }
+
   int32_t intern(sv key, int32_t next_val, bool* inserted) {
     if (count * 2 >= mask) grow();
     uint64_t h = key_hash(key);
@@ -526,11 +531,15 @@ inline bool shape_eq(const Shape& a, const Shape& b) {
   return true;
 }
 
+// shape identity hash over (name, url, presence bits) ONLY: those two
+// fields distinguish almost all real shapes, the per-span hot loop
+// already has their hashes at hand (ShapeCache), and equal-hash
+// collisions between shapes differing only in svc/ns/rev/mesh stay
+// correct — the tables verify with full shape_eq and probe past
+// mismatches. Hashing 2 fields instead of 7 is the point: every span
+// used to pay the 7-string walk on a ShapeCache miss.
 inline uint64_t shape_hash(const Shape& s) {
-  uint64_t h = 0x9e3779b97f4a7c15ull ^ s.key_present;
-  for (int i = 0; i < kShapeFields; ++i)
-    h ^= hash_sv(s.f[i]) + 0x9e3779b97f4a7c15ull + (h << 6) + (h >> 2);
-  return h;
+  return hash_sv(s.f[0]) * 31 + hash_sv(s.f[1]) + s.key_present;
 }
 
 struct ShapeTable {
@@ -562,9 +571,12 @@ struct ShapeTable {
     mask = n - 1;
   }
 
-  int32_t intern(const Shape& s) {
+  int32_t intern(const Shape& s) { return intern(s, shape_hash(s)); }
+
+  // hot-path form: the caller (parse_group_spans) already computed the
+  // (name, url, bits) hash for its direct-mapped cache; reuse it
+  int32_t intern(const Shape& s, uint64_t h) {
     if (shapes.size() * 2 >= mask) grow();
-    uint64_t h = shape_hash(s);
     size_t j = h & mask;
     while (slot_id[j] >= 0) {
       if (slot_hash[j] == h && shape_eq(shapes[slot_id[j]], s))
@@ -620,6 +632,35 @@ struct Scanner {
       return {};
     }
     ++p;
+    // inline one-word fast path: short fields (kinds, methods, statuses,
+    // most names) terminate within 8 bytes — resolving them here skips
+    // the dispatched wide-scan's indirect call, which at ~18 string
+    // scans per span is measurable
+    if (end - p >= 8) {
+      uint64_t w;
+      std::memcpy(&w, p, 8);
+      uint64_t m = swar_eq(w, kQuotePat) | swar_eq(w, kBslashPat);
+      if (m) {
+        const char* q = p + (__builtin_ctzll(m) >> 3);
+        if (*q == '"') {
+          sv out(p, static_cast<size_t>(q - p));
+          p = q + 1;
+          return out;
+        }
+        return str_slow();
+      }
+      const char* q = scan_special(p + 8);  // no specials in [p, p+8)
+      if (q >= end) {
+        ok = false;
+        return {};
+      }
+      if (*q == '"') {
+        sv out(p, static_cast<size_t>(q - p));
+        p = q + 1;
+        return out;
+      }
+      return str_slow();
+    }
     const char* q = scan_special(p);
     if (q >= end) {
       ok = false;
@@ -1126,6 +1167,66 @@ bool peek_trace_id(Scanner probe, sv* out, bool* present) {
 // sentinel for "traceId is Python None" in the seen-set
 const sv kNoneSentinel("\x01\x01\x01none", 7);
 
+// -- persistent skip set (km_skipset_* C API) -------------------------------
+// The processed-trace dedup set as a long-lived native object: the caller
+// (DataProcessor) extends it incrementally as traces register and passes
+// the HANDLE to each parse, instead of re-encoding and re-hashing the
+// whole (100k+-entry) set into a fresh blob+SvMap on every chunk — that
+// rebuild was ~20 ms of every streamed chunk's critical path at the
+// production dedup size. Id bytes copy into the set's own arena (the
+// caller's buffers may move); absent ids collapse onto kNoneSentinel,
+// exactly like the blob path's (sv, present=false) entries. Lookups
+// lock per probe (uncontended ~ns) so a concurrent registration from
+// the realtime tick never waits on a multi-hundred-ms parse.
+struct SkipSet {
+  mutable std::mutex mu;
+  Arena arena;
+  SvMap map{4096};
+  uint64_t count = 0;  // distinct ids (diagnostics)
+
+  bool contains(sv key) const {
+    std::lock_guard<std::mutex> g(mu);
+    return map.find(key) != nullptr;
+  }
+
+  // entries: consecutive skip-entry records (u8 present + u32 len +
+  // bytes). Returns the number of records walked, or -1 on malformed.
+  int64_t extend(const char* entries, size_t len) {
+    std::lock_guard<std::mutex> g(mu);
+    const uint8_t* q = reinterpret_cast<const uint8_t*>(entries);
+    size_t pos = 0;
+    int64_t walked = 0;
+    while (pos < len) {
+      if (pos + 5 > len) return -1;
+      bool present = q[pos] != 0;
+      uint32_t n;
+      std::memcpy(&n, q + pos + 1, 4);
+      pos += 5;
+      if (pos + n > len) return -1;
+      sv key = present ? sv(entries + pos, n) : kNoneSentinel;
+      pos += n;
+      ++walked;
+      if (map.find(key) != nullptr) continue;
+      if (present && n > 0) {
+        char* mem = arena.alloc(n);
+        std::memcpy(mem, key.data(), n);
+        key = sv(mem, n);
+      }
+      bool ins;
+      map.intern(key, 1, &ins);
+      if (ins) ++count;
+    }
+    return walked;
+  }
+
+  void clear() {
+    std::lock_guard<std::mutex> g(mu);
+    map = SvMap(4096);
+    arena = Arena();
+    count = 0;
+  }
+};
+
 // -- phase 1: prescan -------------------------------------------------------
 
 struct GroupRange {
@@ -1153,12 +1254,17 @@ struct ThreadOut {
 // indexes on a 2-string hash (name+url distinguish almost all shapes) and
 // verifies with full shape_eq, so it is purely an optimization.
 struct ShapeCache {
-  static constexpr size_t kSize = 2048;
+  // 32k slots: the BASELINE production shape carries ~10k distinct
+  // endpoints per window — a 2k cache thrashed (~80% miss measured via
+  // gprof), sending every miss through the table probe. 32k direct-
+  // mapped (384 KiB, L2-resident) keeps the hit rate high at 10k+
+  // distinct shapes while staying cheap to reset.
+  static constexpr size_t kSize = 32768;
   struct Entry {
     uint64_t h2 = 0;
     int32_t id = -1;
   };
-  Entry entries[kSize];
+  std::vector<Entry> entries{kSize};
 };
 
 // parse the spans of one kept group into `to` (local tables)
@@ -1193,6 +1299,8 @@ bool parse_group_spans(Scanner& s, int32_t global_group, ThreadOut* to,
     sh.key_present = rec.present & kKeyBits;
     sh.url_present = rec.url_present ? 1 : 0;
     int32_t sid = -1;
+    // identical to shape_hash(sh): the cache key IS the table hash, so
+    // a miss reuses it and never re-hashes the long fields
     uint64_t h2 = hash_sv(rec.name) * 31 + hash_sv(rec.url) +
                   (rec.present & kKeyBits);
     ShapeCache::Entry& ce =
@@ -1201,7 +1309,7 @@ bool parse_group_spans(Scanner& s, int32_t global_group, ThreadOut* to,
         shape_eq(to->shapes.shapes[ce.id], sh)) {
       sid = ce.id;
     } else {
-      sid = to->shapes.intern(sh);
+      sid = to->shapes.intern(sh, h2);
       ce.h2 = h2;
       ce.id = sid;
     }
@@ -1244,7 +1352,7 @@ struct PrescanResult {
 // sequential walk), then only each group's head is probed for its traceId
 PrescanResult prescan_fast(const char* json, size_t json_len,
                            const std::vector<std::pair<sv, bool>>& skip,
-                           Arena* arena) {
+                           Arena* arena, const SkipSet* ss = nullptr) {
   PrescanResult out;
   std::vector<std::pair<size_t, size_t>> ranges;
   size_t top_open, top_close;
@@ -1269,7 +1377,9 @@ PrescanResult prescan_fast(const char* json, size_t json_len,
     bool tid_present = false;
     if (!peek_trace_id(probe, &tid, &tid_present)) return out;
     sv seen_key = tid_present ? tid : kNoneSentinel;
-    if (seen.find(seen_key) != nullptr) continue;
+    if (seen.find(seen_key) != nullptr ||
+        (ss != nullptr && ss->contains(seen_key)))
+      continue;
     seen.intern(seen_key, 1, &ins);
     out.kept.push_back(
         GroupRange{json + r.first, json + r.second, tid, tid_present});
@@ -1280,7 +1390,8 @@ PrescanResult prescan_fast(const char* json, size_t json_len,
 
 PrescanResult prescan(const char* json, size_t json_len,
                       const std::vector<std::pair<sv, bool>>& skip,
-                      Arena* arena, ThreadOut* inline_out) {
+                      Arena* arena, ThreadOut* inline_out,
+                      const SkipSet* ss = nullptr) {
   PrescanResult out;
   Scanner s{json, json + json_len, arena};
   SvMap seen(skip.size() + 64);
@@ -1330,7 +1441,8 @@ PrescanResult prescan(const char* json, size_t json_len,
       if (!peek_trace_id(probe, &tid, &tid_present)) return out;
     }
     sv seen_key = tid_present ? tid : kNoneSentinel;
-    if (seen.find(seen_key) != nullptr) {
+    if (seen.find(seen_key) != nullptr ||
+        (ss != nullptr && ss->contains(seen_key))) {
       s.skip_value();  // whole group already processed
       if (!s.ok) return out;
       continue;
@@ -1353,6 +1465,71 @@ PrescanResult prescan(const char* json, size_t json_len,
   out.ok = s.ok;
   return out;
 }
+
+// -- persistent parse session (km_session_* C API) --------------------------
+// Cross-call shape/status tables: a chunked stream re-encounters the same
+// ~10k naming shapes on every page, and re-serializing + re-decoding +
+// re-resolving them per chunk cost more host time than the parse's own
+// scanning at production endpoint diversity. A session interns shapes and
+// statuses into PERSISTENT tables (field bytes deep-copied into the
+// session arena — the input json buffer dies with the call), emits spans
+// with session-global ids, and serializes only the shapes/statuses the
+// consumer has not yet acknowledged (km_session_ack): the warm-path
+// payload carries zero shape strings. The ack is explicit so a consumer
+// that rejects a payload (e.g. invalid UTF-8 in a field) simply never
+// acks — the next parse re-emits the unacknowledged tail.
+struct ParseSession {
+  std::mutex mu;  // one parse at a time per session
+  Arena arena;
+  ShapeTable shapes;
+  std::vector<double> shape_max_ts;  // cumulative per-shape max (ms)
+  std::vector<uint8_t> shape_has_ts;
+  SvMap status_map{64};
+  std::vector<sv> statuses;
+  size_t shapes_acked = 0;
+  size_t statuses_acked = 0;
+
+  sv copy_sv(sv s) {
+    if (s.empty()) return sv("", 0);
+    char* mem = arena.alloc(s.size());
+    std::memcpy(mem, s.data(), s.size());
+    return sv(mem, s.size());
+  }
+
+  // intern a window-local shape; deep-copies on first sight
+  int32_t adopt(const Shape& local) {
+    uint64_t h = shape_hash(local);
+    int32_t before = static_cast<int32_t>(shapes.shapes.size());
+    int32_t gid = shapes.intern(local, h);
+    if (gid >= before) {
+      // freshly inserted: the stored svs still point at the caller's
+      // buffer — replace them with arena copies (the table's hash only
+      // covers f[0]/f[1]/bits, which copy to identical bytes, so slot
+      // hashes stay valid)
+      Shape& stored = shapes.shapes[gid];
+      for (int i = 0; i < kShapeFields; ++i) stored.f[i] = copy_sv(stored.f[i]);
+      shape_max_ts.push_back(0.0);
+      shape_has_ts.push_back(0);
+    }
+    if (local.has_ts &&
+        (!shape_has_ts[gid] || local.max_ts_ms > shape_max_ts[gid])) {
+      shape_max_ts[gid] = local.max_ts_ms;
+      shape_has_ts[gid] = 1;
+    }
+    return gid;
+  }
+
+  int32_t adopt_status(sv st) {
+    const int32_t* hit = status_map.find(st);
+    if (hit != nullptr) return *hit;
+    sv copy = copy_sv(st);
+    bool ins;
+    int32_t gid =
+        status_map.intern(copy, static_cast<int32_t>(statuses.size()), &ins);
+    if (ins) statuses.push_back(copy);
+    return gid;
+  }
+};
 
 // -- phase 2: parallel group parsing ----------------------------------------
 
@@ -1777,7 +1954,8 @@ constexpr uint32_t kMergeUsMask = (1u << kMergeUsBits) - 1;
 bool parse_pipeline(const char* json, size_t json_len,
                     const std::vector<std::pair<sv, bool>>& skip,
                     Arena* arena, std::vector<ThreadOut>& outs,
-                    Assembled* as, int n_threads_req) {
+                    Assembled* as, int n_threads_req,
+                    const SkipSet* ss = nullptr) {
   unsigned n_threads = pick_threads(n_threads_req);
   as->threads = n_threads;
 
@@ -1785,7 +1963,7 @@ bool parse_pipeline(const char* json, size_t json_len,
   if (n_threads <= 1) {
     // sequential mode: single fused pass (no separate prescan walk)
     outs.resize(1);
-    PrescanResult ps = prescan(json, json_len, skip, arena, &outs[0]);
+    PrescanResult ps = prescan(json, json_len, skip, arena, &outs[0], ss);
     if (!ps.ok || !outs[0].ok) return false;
     as->prescan_us = 0;
     as->parse_us = static_cast<uint32_t>(now_us() - p0);
@@ -1793,7 +1971,7 @@ bool parse_pipeline(const char* json, size_t json_len,
     return as->ok;
   }
 
-  PrescanResult ps = prescan_fast(json, json_len, skip, arena);
+  PrescanResult ps = prescan_fast(json, json_len, skip, arena, ss);
   if (!ps.ok) return false;
   uint64_t p1 = now_us();
   as->prescan_us = static_cast<uint32_t>(p1 - p0);
@@ -1918,6 +2096,94 @@ unsigned char* serialize(const Assembled& as, size_t* out_len) {
   return buf;
 }
 
+// session wire format (header ok=2): span columns carry session-global
+// ids; shape strings emit ONLY for shapes the consumer has not acked
+// (warm chunks: none). shape_max_ts is the session's cumulative
+// per-shape max — equivalent for the consumer's freshest-timestamp
+// logic, which is a monotone max.
+unsigned char* serialize_session(const Assembled& as, const ParseSession& ss,
+                                 size_t* out_len) {
+  size_t n = as.n;
+  size_t shapes_total = ss.shapes.shapes.size();
+  size_t statuses_total = ss.statuses.size();
+  size_t shape_base = ss.shapes_acked;
+  size_t status_base = ss.statuses_acked;
+
+  size_t sz = 40 + n * (8 + 8 + 4 + 4 + 4 + 4 + 1) + shapes_total * 8;
+  for (size_t i = shape_base; i < shapes_total; ++i) {
+    sz += 2 + kShapeFields * 4;
+    for (int f = 0; f < kShapeFields; ++f) sz += ss.shapes.shapes[i].f[f].size();
+  }
+  for (size_t i = status_base; i < statuses_total; ++i)
+    sz += 4 + ss.statuses[i].size();
+  // kept section: presence + length ARRAYS (vectorized consumer offsets)
+  // followed by the interleaved skip-entry records — the records double
+  // as the consumer's incremental dedup-blob append, byte-identical to
+  // encode_skip_entry layout
+  for (auto& g : as.kept) sz += 1 + 4 + 5 + g.tid.size();
+
+  unsigned char* buf = static_cast<unsigned char*>(std::malloc(sz));
+  if (buf == nullptr) return nullptr;
+  unsigned char* w = buf;
+  auto w_u32 = [&](uint32_t v) {
+    std::memcpy(w, &v, 4);
+    w += 4;
+  };
+  auto w_sv = [&](sv s) {
+    w_u32(static_cast<uint32_t>(s.size()));
+    std::memcpy(w, s.data(), s.size());
+    w += s.size();
+  };
+
+  w_u32(2);  // ok marker doubles as the format version
+  w_u32(static_cast<uint32_t>(n));
+  w_u32(static_cast<uint32_t>(shapes_total));
+  w_u32(static_cast<uint32_t>(statuses_total));
+  w_u32(static_cast<uint32_t>(shape_base));
+  w_u32(static_cast<uint32_t>(status_base));
+  w_u32(static_cast<uint32_t>(as.kept.size()));
+  w_u32(as.prescan_us);
+  w_u32(as.parse_us);
+  w_u32((as.threads << kMergeUsBits) | std::min(as.merge_us, kMergeUsMask));
+
+  for (size_t i = 0; i < n; ++i) {
+    std::memcpy(w + i * 8, &as.rows[i].latency_ms, 8);
+    std::memcpy(w + (n + i) * 8, &as.rows[i].timestamp_raw, 8);
+  }
+  w += n * 16;
+  std::memcpy(w, ss.shape_max_ts.data(), shapes_total * 8);
+  w += shapes_total * 8;
+  std::memcpy(w, as.parent_idx.data(), n * 4);
+  w += n * 4;
+  std::memcpy(w, as.shape_id.data(), n * 4);
+  w += n * 4;
+  std::memcpy(w, as.status_id.data(), n * 4);
+  w += n * 4;
+  std::memcpy(w, as.trace_of.data(), n * 4);
+  w += n * 4;
+  for (size_t i = 0; i < n; ++i)
+    w[i] = static_cast<uint8_t>(as.rows[i].kind);
+  w += n;
+  for (size_t i = shape_base; i < shapes_total; ++i) {
+    const Shape& sh = ss.shapes.shapes[i];
+    *w++ = sh.url_present;
+    *w++ = sh.key_present;
+    for (int f = 0; f < kShapeFields; ++f) w_sv(sh.f[f]);
+  }
+  for (size_t i = status_base; i < statuses_total; ++i) w_sv(ss.statuses[i]);
+  for (size_t g = 0; g < as.kept.size(); ++g)
+    *w++ = as.kept[g].tid_present ? 1 : 0;
+  for (size_t g = 0; g < as.kept.size(); ++g)
+    w_u32(static_cast<uint32_t>(as.kept[g].tid.size()));
+  for (size_t g = 0; g < as.kept.size(); ++g) {
+    *w++ = as.kept[g].tid_present ? 1 : 0;
+    w_sv(as.kept[g].tid);
+  }
+
+  *out_len = static_cast<size_t>(w - buf);
+  return buf;
+}
+
 }  // namespace
 
 extern "C" {
@@ -1954,6 +2220,95 @@ unsigned char* km_parse_spans_mt(const char* skip_blob, size_t skip_len,
   if (!parse_pipeline(json, json_len, skip, &arena, outs, &as, n_threads))
     return nullptr;
   return serialize(as, out_len);
+}
+
+// -- persistent skip-set handle (see SkipSet above) -------------------------
+
+void* km_skipset_new() { return new (std::nothrow) SkipSet(); }
+
+void km_skipset_free(void* h) { delete static_cast<SkipSet*>(h); }
+
+long long km_skipset_extend(void* h, const char* entries, size_t len) {
+  if (h == nullptr) return -1;
+  return static_cast<SkipSet*>(h)->extend(entries, len);
+}
+
+void km_skipset_clear(void* h) {
+  if (h != nullptr) static_cast<SkipSet*>(h)->clear();
+}
+
+unsigned long long km_skipset_size(void* h) {
+  if (h == nullptr) return 0;
+  SkipSet* ss = static_cast<SkipSet*>(h);
+  std::lock_guard<std::mutex> g(ss->mu);
+  return ss->count;
+}
+
+// parse against a persistent skip set INSTEAD of a per-call blob: the
+// set is consulted read-only (kept ids do NOT auto-register — the
+// caller registers after the fact, preserving the blob path's
+// at-least-once semantics and its ordering with the dedup lock).
+unsigned char* km_parse_spans_hs(void* h, const char* json, size_t json_len,
+                                 int n_threads, size_t* out_len) {
+  *out_len = 0;
+  static const std::vector<std::pair<sv, bool>> kNoSkip;
+  Arena arena;
+  std::vector<ThreadOut> outs;
+  Assembled as;
+  if (!parse_pipeline(json, json_len, kNoSkip, &arena, outs, &as, n_threads,
+                      static_cast<const SkipSet*>(h)))
+    return nullptr;
+  return serialize(as, out_len);
+}
+
+// -- persistent parse session (see ParseSession above) ----------------------
+
+void* km_session_new() { return new (std::nothrow) ParseSession(); }
+
+void km_session_free(void* h) { delete static_cast<ParseSession*>(h); }
+
+// consumer acknowledges it decoded shapes/statuses up to these counts;
+// until then every parse re-emits the unacked tail (monotone)
+void km_session_ack(void* h, uint32_t shapes_known, uint32_t statuses_known) {
+  ParseSession* sess = static_cast<ParseSession*>(h);
+  if (sess == nullptr) return;
+  std::lock_guard<std::mutex> g(sess->mu);
+  sess->shapes_acked =
+      std::min<size_t>(std::max<size_t>(sess->shapes_acked, shapes_known),
+                       sess->shapes.shapes.size());
+  sess->statuses_acked =
+      std::min<size_t>(std::max<size_t>(sess->statuses_acked, statuses_known),
+                       sess->statuses.size());
+}
+
+// session parse: window-local tables remap onto the session's persistent
+// ones, spans emit session-global ids, and only unacked shape/status
+// strings serialize (format ok=2). skip_h may be null.
+unsigned char* km_parse_spans_sess(void* sess_h, void* skip_h,
+                                   const char* json, size_t json_len,
+                                   int n_threads, size_t* out_len) {
+  *out_len = 0;
+  ParseSession* sess = static_cast<ParseSession*>(sess_h);
+  if (sess == nullptr) return nullptr;
+  std::lock_guard<std::mutex> g(sess->mu);
+  static const std::vector<std::pair<sv, bool>> kNoSkip;
+  Arena arena;
+  std::vector<ThreadOut> outs;
+  Assembled as;
+  if (!parse_pipeline(json, json_len, kNoSkip, &arena, outs, &as, n_threads,
+                      static_cast<const SkipSet*>(skip_h)))
+    return nullptr;
+  std::vector<int32_t> shape_remap(as.shapes.shapes.size());
+  for (size_t i = 0; i < as.shapes.shapes.size(); ++i)
+    shape_remap[i] = sess->adopt(as.shapes.shapes[i]);
+  std::vector<int32_t> status_remap(as.statuses.size());
+  for (size_t i = 0; i < as.statuses.size(); ++i)
+    status_remap[i] = sess->adopt_status(as.statuses[i]);
+  for (size_t i = 0; i < as.n; ++i) {
+    as.shape_id[i] = shape_remap[as.shape_id[i]];
+    as.status_id[i] = status_remap[as.status_id[i]];
+  }
+  return serialize_session(as, *sess, out_len);
 }
 
 unsigned char* km_parse_spans(const char* skip_blob, size_t skip_len,

@@ -924,3 +924,220 @@ def test_malformed_utf8_rejects_without_interner_mutation():
     interner = EndpointInterner()
     assert raw_spans_to_batch(bad, interner=interner) is None
     assert len(interner.endpoints) == 0
+
+
+class TestSkipSetHandle:
+    """Persistent native skip set (km_skipset_*): the streaming dedup
+    path's replacement for re-encoding the processed-trace blob per
+    chunk (processor passes the handle; data_processor.rs:30-56 is the
+    Arc<Mutex<HashMap>> dedup this mirrors)."""
+
+    def test_extend_dedup_and_parse(self):
+        ss = native.SkipSet()
+        assert ss.handle is not None
+        entries = (
+            native.encode_skip_entry("tA")
+            + native.encode_skip_entry(None)
+            + native.encode_skip_entry("tA")  # duplicate: not re-counted
+        )
+        assert ss.extend(bytes(entries)) == 3
+        assert len(ss) == 2  # tA + the None sentinel
+        raw = json.dumps(
+            [[mk_span("tA", "a")], [mk_span("tB", "b")]]
+        ).encode()
+        parsed = native.parse_spans(raw, skipset=ss)
+        assert parsed["trace_ids"] == ["tB"]
+        ss.clear()
+        assert len(ss) == 0
+        parsed = native.parse_spans(raw, skipset=ss)
+        assert parsed["trace_ids"] == ["tA", "tB"]
+
+    def test_none_sentinel_collapses_absent_ids(self):
+        ss = native.SkipSet()
+        ss.extend(bytes(native.encode_skip_entry(None)))
+        raw = json.dumps(
+            [[{k: v for k, v in mk_span("x", "a").items() if k != "traceId"}]]
+        ).encode()
+        parsed = native.parse_spans(raw, skipset=ss)
+        assert parsed["trace_ids"] == []  # absent-id group skipped
+
+    def test_malformed_extend_rejected(self):
+        ss = native.SkipSet()
+        assert ss.extend(b"\x01\xff\xff\xff\xff") == -1  # truncated
+        assert len(ss) == 0
+
+
+class TestParseSessionPath:
+    """Persistent parse session: cross-chunk shape/status tables with
+    delta string emission (the warm-path payload carries zero naming
+    strings). Parity against the per-call path is exact — interners
+    built in the same order produce identical ids and infos."""
+
+    def _window(self, prefix, n=40):
+        groups = []
+        for t in range(n):
+            parent = mk_span(f"{prefix}{t}", f"p{t}")
+            child = mk_span(
+                f"{prefix}{t}",
+                f"c{t}",
+                parent=f"p{t}",
+                kind="CLIENT",
+                name=f"down{t % 7}.ns.svc.cluster.local:80/*",
+                timestamp=1_700_000_000_000_000 + t * 1000,
+            )
+            child["tags"]["istio.canonical_service"] = f"down{t % 7}"
+            groups.append([parent, child])
+        return json.dumps(groups).encode()
+
+    def test_batch_parity_with_plain_path(self):
+        import numpy as np
+
+        from kmamiz_tpu.core.interning import EndpointInterner
+        from kmamiz_tpu.core.spans import RawIngestSession
+
+        raw1 = self._window("w1")
+        raw2 = self._window("w2")
+
+        i1 = EndpointInterner()
+        b1a, k1a = raw_spans_to_batch(raw1, interner=i1)
+        b1b, k1b = raw_spans_to_batch(raw2, interner=i1)
+
+        i2 = EndpointInterner()
+        sess = RawIngestSession(i2)
+        assert sess.available
+        b2a, k2a = raw_spans_to_batch(raw1, interner=i2, session=sess)
+        b2b, k2b = raw_spans_to_batch(raw2, interner=i2, session=sess)
+
+        assert list(k1a) == list(k2a) and list(k1b) == list(k2b)
+        for ref, got in ((b1a, b2a), (b1b, b2b)):
+            for f in (
+                "kind",
+                "parent_idx",
+                "endpoint_id",
+                "service_id",
+                "rt_endpoint_id",
+                "rt_service_id",
+                "status_class",
+                "latency_ms",
+                "timestamp_us",
+                "trace_of",
+                "valid",
+            ):
+                assert np.array_equal(
+                    getattr(ref, f), getattr(got, f)
+                ), f
+        assert i1.endpoints.strings == i2.endpoints.strings
+        assert i1.endpoint_infos == i2.endpoint_infos
+        # status STRINGS per id must agree even though the session shares
+        # one interner across windows
+        s1 = [b1b.statuses.lookup(int(i)) for i in b1b.status_id[: b1b.n_spans]]
+        s2 = [b2b.statuses.lookup(int(i)) for i in b2b.status_id[: b2b.n_spans]]
+        assert s1 == s2
+
+    def test_warm_chunk_emits_no_shape_strings(self):
+        from kmamiz_tpu.core.interning import EndpointInterner
+        from kmamiz_tpu.core.spans import RawIngestSession
+
+        i = EndpointInterner()
+        sess = RawIngestSession(i)
+        raw_spans_to_batch(self._window("a"), interner=i, session=sess)
+        parsed = native.parse_spans(
+            self._window("b"), session=sess.native
+        )
+        assert parsed["session_format"]
+        assert parsed["new_shapes"] == []  # all shapes already acked
+        assert parsed["new_statuses"] == []
+
+    def test_unacked_shapes_reemit(self):
+        from kmamiz_tpu.core.interning import EndpointInterner
+        from kmamiz_tpu.core.spans import RawIngestSession
+
+        i = EndpointInterner()
+        sess = RawIngestSession(i)
+        # raw native call WITHOUT ack: the next call re-emits
+        p1 = native.parse_spans(self._window("a"), session=sess.native)
+        assert len(p1["new_shapes"]) > 0
+        p2 = native.parse_spans(self._window("a2"), session=sess.native)
+        assert len(p2["new_shapes"]) >= len(p1["new_shapes"])
+        assert p2["shape_base"] == 0  # nothing acked yet
+
+    def test_malformed_payload_resets_session(self):
+        from kmamiz_tpu.core.interning import EndpointInterner
+        from kmamiz_tpu.core.spans import RawIngestSession
+
+        i = EndpointInterner()
+        sess = RawIngestSession(i)
+        native1 = sess.native
+        assert (
+            raw_spans_to_batch(b"[[{oops", interner=i, session=sess) is None
+        )
+        assert sess.native is not native1  # fresh native session
+        out = raw_spans_to_batch(
+            self._window("ok"), interner=i, session=sess
+        )
+        assert out is not None and out[0].n_spans == 80
+
+    def test_kept_blob_matches_encode_skip_entry(self):
+        from kmamiz_tpu.core.interning import EndpointInterner
+        from kmamiz_tpu.core.spans import RawIngestSession
+
+        i = EndpointInterner()
+        sess = RawIngestSession(i)
+        _b, kept = raw_spans_to_batch(
+            self._window("x"), interner=i, session=sess
+        )
+        expect = b"".join(native.encode_skip_entry(t) for t in kept)
+        assert bytes(kept.blob) == expect
+
+
+class TestProcessorSessionIntegration:
+    def test_register_processed_blob_fast_path(self):
+        """The blob fast path and the per-id path must leave identical
+        dedup state (dict keys, blob contents, count header)."""
+        from kmamiz_tpu.core.spans import KeptTraceIds
+        from kmamiz_tpu.server.processor import DataProcessor
+
+        ids = ["tA", "tB", None]
+        blob = b"".join(native.encode_skip_entry(t) for t in ids)
+
+        fast = DataProcessor(trace_source=lambda *a: [], use_device_stats=False)
+        fast._register_processed(KeptTraceIds(ids, blob), 1000.0)
+
+        slow = DataProcessor(trace_source=lambda *a: [], use_device_stats=False)
+        slow._register_processed(list(ids), 1000.0)
+
+        assert fast._processed == slow._processed
+        with fast._dedup_lock, slow._dedup_lock:
+            assert fast._skip_blob_locked() == slow._skip_blob_locked()
+
+    def test_skipset_resync_after_prune(self):
+        """TTL prune rebuilds the blob and bumps the generation: the
+        native skip set must clear + resync, so pruned ids parse again."""
+        from kmamiz_tpu.server.processor import (
+            PROCESSED_TRACE_TTL_MS,
+            DataProcessor,
+        )
+
+        clock = {"ms": 1_000_000.0}
+        dp = DataProcessor(
+            trace_source=lambda *a: [],
+            use_device_stats=False,
+            now_ms=lambda: clock["ms"],
+        )
+        raw = json.dumps([[mk_span("tOld", "a")]]).encode()
+        out = dp.ingest_raw_window(raw)
+        assert out["traces"] == 1
+        # within TTL: the same trace dedups away
+        again = dp.ingest_raw_window(raw)
+        assert again["traces"] == 0
+        # past TTL, first pass: the dedup snapshot predates the prune
+        # (pruning runs at registration, mirroring the Rust DP's
+        # end-of-tick cleanup, data_processor.rs:58-73) — still deduped,
+        # but THIS pass's registration prunes and bumps the generation
+        clock["ms"] += PROCESSED_TRACE_TTL_MS + 1_000
+        assert dp.ingest_raw_window(raw)["traces"] == 0
+        # second pass: the native set must have cleared + resynced to
+        # the rebuilt (now-empty) blob — without the generation bump it
+        # would still hold tOld and dedup forever
+        fresh = dp.ingest_raw_window(raw)
+        assert fresh["traces"] == 1

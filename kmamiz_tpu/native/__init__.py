@@ -32,33 +32,97 @@ _SOURCES = [
 ]
 _BUILD_DIR = _REPO_ROOT / "native" / "build"
 _LIB_PATH = _BUILD_DIR / "libkmamiz_native.so"
+_BUILD_INFO_PATH = _BUILD_DIR / "build_info.json"
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _load_failed = False
 
 
+def _cpu_signature() -> str:
+    """Stable fingerprint of this host's ISA (the cpu flags line): a
+    -march=native .so restored from a build cache onto a smaller-ISA
+    host would SIGILL on first call — no symbol/mtime check can catch
+    that, so the loader compares this signature instead."""
+    import hashlib
+
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith("flags"):
+                    return hashlib.sha256(line.encode()).hexdigest()
+    except OSError:
+        pass
+    import platform
+
+    return platform.machine()
+
+
+def _build_is_stale() -> bool:
+    """True when the cached .so must rebuild: missing, older than a
+    source, or compiled for a different host ISA (restored caches)."""
+    import json
+
+    if not _LIB_PATH.exists():
+        return True
+    if any(
+        src.exists() and src.stat().st_mtime > _LIB_PATH.stat().st_mtime
+        for src in _SOURCES
+    ):
+        return True
+    try:
+        info = json.loads(_BUILD_INFO_PATH.read_text())
+    except (OSError, ValueError):
+        return True  # unknown provenance: rebuild for THIS host
+    if info.get("march") == "native":
+        return info.get("cpu") != _cpu_signature()
+    return False
+
+
 def _build() -> bool:
+    import json
+
     if not all(src.exists() for src in _SOURCES):
         return False
     _BUILD_DIR.mkdir(parents=True, exist_ok=True)
-    cmd = [
-        os.environ.get("CXX", "g++"),
-        "-O3",
-        "-shared",
-        "-fPIC",
-        "-pthread",
-        "-std=c++17",
-        "-o",
-        str(_LIB_PATH),
-        *[str(src) for src in _SOURCES],
-    ]
-    try:
-        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-        return True
-    except (subprocess.SubprocessError, OSError) as err:
-        logger.warning("native build failed, using pure-Python path: %s", err)
-        return False
+
+    def cmd_for(arch_flags):
+        return [
+            os.environ.get("CXX", "g++"),
+            "-O3",
+            *arch_flags,
+            "-shared",
+            "-fPIC",
+            "-pthread",
+            "-std=c++17",
+            "-o",
+            str(_LIB_PATH),
+            *[str(src) for src in _SOURCES],
+        ]
+
+    # -march=native first: the .so is built on the host that runs it (the
+    # DP deployment builds in its own image), and the hash/number/memcpy
+    # paths gain a few percent beyond the hand-dispatched AVX2 scans.
+    # Portable fallback when the toolchain rejects it. The build records
+    # its ISA so a cache-restored .so never runs on a smaller host.
+    for arch, label in ((["-march=native"], "native"), ([], "generic")):
+        try:
+            subprocess.run(
+                cmd_for(arch), check=True, capture_output=True, timeout=120
+            )
+            try:
+                _BUILD_INFO_PATH.write_text(
+                    json.dumps({"march": label, "cpu": _cpu_signature()})
+                )
+            except OSError:
+                pass
+            return True
+        except (subprocess.SubprocessError, OSError) as err:
+            last_err = err
+    logger.warning(
+        "native build failed, using pure-Python path: %s", last_err
+    )
+    return False
 
 
 def _load() -> Optional[ctypes.CDLL]:
@@ -68,10 +132,7 @@ def _load() -> Optional[ctypes.CDLL]:
     with _lock:
         if _lib is not None or _load_failed:
             return _lib
-        if not _LIB_PATH.exists() or any(
-            src.exists() and src.stat().st_mtime > _LIB_PATH.stat().st_mtime
-            for src in _SOURCES
-        ):
+        if _build_is_stale():
             if not _build():
                 _load_failed = True
                 return None
@@ -338,6 +399,46 @@ class SkipSet:
                 pass
 
 
+def _unpack_timings(prescan_us: int, parse_us: int, merge_packed: int) -> dict:
+    # threads<<25 | merge_us (25-bit µs, ~33 s cap) — see kmamiz_spans.cpp
+    return {
+        "prescan_us": prescan_us,
+        "parse_us": parse_us,
+        "merge_us": merge_packed & 0x01FFFFFF,
+        "threads": merge_packed >> 25,
+    }
+
+
+def _read_shape_records(buf, pos: int, count: int):
+    """`count` serialized shape records (u8 url_present + u8 bits + 7x
+    length-prefixed field bytes) -> (records, new_pos). Fields stay raw
+    BYTES tuples: consumers cache resolutions keyed on them and decode
+    only on a cache miss."""
+    shapes = []
+    for _ in range(count):
+        url_present = buf[pos] != 0
+        bits = buf[pos + 1]
+        pos += 2
+        fields = []
+        for _f in range(7):
+            (flen,) = struct.unpack_from("<I", buf, pos)
+            pos += 4
+            fields.append(bytes(buf[pos : pos + flen]))
+            pos += flen
+        shapes.append((tuple(fields), url_present, bits))
+    return shapes, pos
+
+
+def _read_status_records(buf, pos: int, count: int):
+    statuses = []
+    for _ in range(count):
+        (slen,) = struct.unpack_from("<I", buf, pos)
+        pos += 4
+        statuses.append(buf[pos : pos + slen].decode("utf-8", "surrogatepass"))
+        pos += slen
+    return statuses, pos
+
+
 def _decode_session_payload(buf) -> Optional[dict]:
     """Decode the session wire format (header ok=2): span columns carry
     session-GLOBAL shape/status ids; shape/status strings appear only
@@ -357,12 +458,7 @@ def _decode_session_payload(buf) -> Optional[dict]:
         parse_us,
         merge_packed,
     ) = struct.unpack_from("<10I", buf, 0)
-    timings = {
-        "prescan_us": prescan_us,
-        "parse_us": parse_us,
-        "merge_us": merge_packed & 0x01FFFFFF,
-        "threads": merge_packed >> 25,
-    }
+    timings = _unpack_timings(prescan_us, parse_us, merge_packed)
     pos = 40
     latency_ms = np.frombuffer(buf, np.float64, n, pos)
     pos += 8 * n
@@ -381,27 +477,10 @@ def _decode_session_payload(buf) -> Optional[dict]:
     kind = np.frombuffer(buf, np.int8, n, pos)
     pos += n
 
-    new_shapes = []
-    for _ in range(shapes_total - shape_base):
-        url_present = buf[pos] != 0
-        bits = buf[pos + 1]
-        pos += 2
-        fields = []
-        for _f in range(7):
-            (flen,) = struct.unpack_from("<I", buf, pos)
-            pos += 4
-            fields.append(bytes(buf[pos : pos + flen]))
-            pos += flen
-        new_shapes.append((tuple(fields), url_present, bits))
-
-    new_statuses = []
-    for _ in range(statuses_total - status_base):
-        (slen,) = struct.unpack_from("<I", buf, pos)
-        pos += 4
-        new_statuses.append(
-            buf[pos : pos + slen].decode("utf-8", "surrogatepass")
-        )
-        pos += slen
+    new_shapes, pos = _read_shape_records(buf, pos, shapes_total - shape_base)
+    new_statuses, pos = _read_status_records(
+        buf, pos, statuses_total - status_base
+    )
 
     # kept trace ids, vectorized: presence + length arrays give every
     # record's offset in one cumsum; the ASCII fast path decodes the
@@ -535,6 +614,13 @@ def parse_spans(
     out_len = ctypes.c_size_t(0)
     # the json buffer crosses ctypes without a copy (c_char_p on bytes)
     raw = bytes(raw) if not isinstance(raw, bytes) else raw
+    # explicit blob-style skip args take precedence over the persistent
+    # handles: a caller that passes skip_trace_ids/skip_blob means THAT
+    # set, and silently consulting a different (handle) set instead
+    # would merge traces the caller asked to skip
+    if skip_trace_ids or skip_blob is not None:
+        session = None
+        skipset = None
     if session is not None and session.handle is not None:
         # persistent-session path: global ids + delta shape emission
         ptr = lib.km_parse_spans_sess(
@@ -590,13 +676,7 @@ def parse_spans(
         ) = struct.unpack_from("<8I", buf, 0)
         if ok != 1:
             return None
-        # threads<<25 | merge_us (25-bit µs, ~33 s cap) — see kmamiz_spans.cpp
-        timings = {
-            "prescan_us": prescan_us,
-            "parse_us": parse_us,
-            "merge_us": merge_packed & 0x01FFFFFF,
-            "threads": merge_packed >> 25,
-        }
+        timings = _unpack_timings(prescan_us, parse_us, merge_packed)
         pos = 32
         # read-only VIEWS over `buf` (which the arrays keep alive via
         # .base): raw_spans_to_batch copies once into its padded arrays,
@@ -618,31 +698,8 @@ def parse_spans(
         kind = np.frombuffer(buf, np.int8, n, pos)
         pos += n
 
-        # shape fields stay as raw BYTES tuples: the consumer
-        # (core.spans.raw_spans_to_batch) caches shape resolutions keyed
-        # on these tuples and decodes only on a cache miss — at 10k
-        # distinct shapes per production window, eagerly decoding 70k
-        # strings per chunk costs more than the decode the warm path
-        # ever uses
-        shapes = []
-        for _ in range(n_shapes):
-            url_present = buf[pos] != 0
-            bits = buf[pos + 1]
-            pos += 2
-            fields = []
-            for _f in range(7):
-                (flen,) = struct.unpack_from("<I", buf, pos)
-                pos += 4
-                fields.append(bytes(buf[pos : pos + flen]))
-                pos += flen
-            shapes.append((tuple(fields), url_present, bits))
-
-        statuses = []
-        for _ in range(n_statuses):
-            (slen,) = struct.unpack_from("<I", buf, pos)
-            pos += 4
-            statuses.append(buf[pos : pos + slen].decode("utf-8", "surrogatepass"))
-            pos += slen
+        shapes, pos = _read_shape_records(buf, pos, n_shapes)
+        statuses, pos = _read_status_records(buf, pos, n_statuses)
 
         trace_ids = []
         for _ in range(n_groups):

@@ -47,6 +47,7 @@ import json
 import os
 import sys
 import time
+from pathlib import Path
 
 import numpy as np
 
@@ -124,6 +125,23 @@ def critical_path_ms(chunk_detail, drain_ms: float) -> float:
 def main() -> None:
     global BENCH_T0
     BENCH_T0 = time.perf_counter()
+    # persistent XLA compilation cache, repo-local by default: the
+    # deployment ships with KMAMIZ_COMPILE_CACHE_DIR wired (deploy/
+    # kmamiz-tpu.yaml), so the bench measures the deployed
+    # configuration — steady-state programs load from disk instead of
+    # paying 50-70 s union compiles every run. Cold-compile behavior
+    # stays measured: the warm-boot subsection runs subprocesses against
+    # its OWN empty/warm cache dirs, and a fresh checkout's first bench
+    # run still records the cold walls. Opt out (fully cold run) with
+    # KMAMIZ_BENCH_NO_COMPILE_CACHE=1.
+    if os.environ.get("KMAMIZ_BENCH_NO_COMPILE_CACHE") != "1":
+        os.environ.setdefault(
+            "KMAMIZ_COMPILE_CACHE_DIR",
+            str(Path(__file__).resolve().parent / ".xla-cache"),
+        )
+        from kmamiz_tpu.core import compile_cache
+
+        compile_cache.enable_from_env()
     import jax
     import jax.numpy as jnp
 
@@ -1059,7 +1077,11 @@ def main() -> None:
             "(noise on this 1-core host is strictly additive; rep lists "
             "in extras); latency metrics (graph refresh p50, HTTP, DP "
             "tick) are median-of-N. Serial one-shot path in e2e_serial_*; "
-            "device-chain extra: fori_loop-chained kernels, rtt-adjusted"
+            "device-chain extra: fori_loop-chained kernels, rtt-adjusted. "
+            "XLA persistent compilation cache ON by default (repo-local "
+            ".xla-cache), matching the deployed configuration "
+            "(deploy/kmamiz-tpu.yaml wires KMAMIZ_COMPILE_CACHE_DIR); "
+            "KMAMIZ_BENCH_NO_COMPILE_CACHE=1 forces a fully cold run"
         ),
         "device": str(jax.devices()[0]),
     }

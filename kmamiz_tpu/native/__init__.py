@@ -58,11 +58,23 @@ def _cpu_signature() -> str:
     return platform.machine()
 
 
-def _build_is_stale() -> bool:
-    """True when the cached .so must rebuild: missing, older than a
-    source, or compiled for a different host ISA (restored caches)."""
+def _isa_mismatch() -> bool:
+    """True when build_info POSITIVELY says the .so was -march=native
+    compiled for a different cpu (a restored cache from another host):
+    loading such a library risks SIGILL, so it must never load as-is."""
     import json
 
+    try:
+        info = json.loads(_BUILD_INFO_PATH.read_text())
+    except (OSError, ValueError):
+        return False  # unknown provenance: prefer rebuild, allow load
+    return info.get("march") == "native" and info.get("cpu") != _cpu_signature()
+
+
+def _build_is_stale() -> bool:
+    """True when the cached .so should rebuild: missing, older than a
+    source, compiled for a different host ISA (restored caches), or of
+    unknown provenance (no build_info — rebuild pins it to THIS host)."""
     if not _LIB_PATH.exists():
         return True
     if any(
@@ -70,13 +82,9 @@ def _build_is_stale() -> bool:
         for src in _SOURCES
     ):
         return True
-    try:
-        info = json.loads(_BUILD_INFO_PATH.read_text())
-    except (OSError, ValueError):
-        return True  # unknown provenance: rebuild for THIS host
-    if info.get("march") == "native":
-        return info.get("cpu") != _cpu_signature()
-    return False
+    if not _BUILD_INFO_PATH.exists():
+        return True
+    return _isa_mismatch()
 
 
 def _build() -> bool:
@@ -134,8 +142,18 @@ def _load() -> Optional[ctypes.CDLL]:
             return _lib
         if _build_is_stale():
             if not _build():
-                _load_failed = True
-                return None
+                # rebuild impossible (no toolchain, stripped sources):
+                # a merely stale or unknown-provenance .so still LOADS
+                # — staleness prefers a rebuild but must not veto the
+                # native path (missing symbols are caught below). Only
+                # a positive ISA mismatch refuses: that .so can SIGILL.
+                if not _LIB_PATH.exists() or _isa_mismatch():
+                    _load_failed = True
+                    return None
+                logger.warning(
+                    "native rebuild unavailable; loading existing "
+                    "libkmamiz_native.so as-is"
+                )
         lib = _open_and_bind()
         if lib is None and _build():
             # a stale prebuilt .so can miss newer symbols even when the

@@ -386,3 +386,33 @@ def test_merge_edges_respects_valid_mask():
         valid=jnp.asarray([True, False]),
     )
     assert g.n_edges == 1
+
+
+def test_preunion_truncation_rewalks(bookinfo_traces, monkeypatch):
+    """Mid-stream pre-unions must preserve exactness when every window's
+    compacted prefix truncates (stage_cap far below the per-window
+    distinct-edge count): whichever branch resolves the check — ready at
+    pre-union time, or deferred into _preunion_checks until the drain —
+    the re-walk path must reproduce the fused edge set, and the pinned-
+    input accounting must return to zero after the drain."""
+    monkeypatch.setenv("KMAMIZ_STAGE_CAP", "4")
+
+    fused = EndpointGraph()
+    for group in bookinfo_traces:
+        fused.merge_window(spans_to_batch([group], interner=fused.interner))
+
+    staged = EndpointGraph()
+    for group in bookinfo_traces:
+        staged.merge_window(
+            spans_to_batch([group], interner=staged.interner), stage=True
+        )
+    assert staged._preunion is not None  # the stream pre-unioned
+    assert staged.n_edges == fused.n_edges
+    assert staged._preunion is None and not staged._preunion_checks
+    assert staged._preunion_rows == 0
+
+    s1, d1, dist1, m1 = (np.asarray(x) for x in fused.edge_arrays())
+    s2, d2, dist2, m2 = (np.asarray(x) for x in staged.edge_arrays())
+    e1 = {(int(a), int(b), int(c)) for a, b, c in zip(s1[m1], d1[m1], dist1[m1])}
+    e2 = {(int(a), int(b), int(c)) for a, b, c in zip(s2[m2], d2[m2], dist2[m2])}
+    assert e1 == e2

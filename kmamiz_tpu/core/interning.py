@@ -117,25 +117,53 @@ class EndpointInterner:
         with self._intern_lock:
             return np.asarray(self._info_ts, dtype=np.float64)
 
-    def refresh_info_timestamps(self, eids, ts_ms) -> None:
+    def refresh_info_timestamps(self, eids, ts_ms, expected_ts=None):
         """Bulk freshest-timestamp refresh: for each (eid, ts) pair,
         advance the existing info's timestamp in place when strictly
         newer — the session ingest path's vectorized equivalent of
         re-interning `{**info, "timestamp": ts}` per endpoint. Info
         CONTENT is unchanged by design: callers use this only when the
         winning naming shape for the endpoint is the one already
-        applied (otherwise they fall back to intern_endpoint)."""
+        applied.
+
+        `expected_ts` makes the update a compare-and-set: position i
+        applies only if the info's CURRENT timestamp equals
+        expected_ts[i] — a mismatch means another writer (e.g. the
+        dict-path realtime tick) refreshed the info since the caller
+        last applied, possibly with different content that an in-place
+        stamp must not bless. Returns the list of positions that did
+        NOT apply (missing info, stale expectation); callers route
+        those through the full intern_endpoint slow path. The check and
+        the write share one lock hold, closing the snapshot-then-apply
+        race a separate mirror read would leave open (review r5)."""
+        failed: List[int] = []
+        eids_l = eids.tolist() if hasattr(eids, "tolist") else list(eids)
+        ts_l = ts_ms.tolist() if hasattr(ts_ms, "tolist") else list(ts_ms)
+        exp_l = (
+            None
+            if expected_ts is None
+            else (
+                expected_ts.tolist()
+                if hasattr(expected_ts, "tolist")
+                else list(expected_ts)
+            )
+        )
         with self._intern_lock:
             infos = self._endpoint_infos
             mirror = self._info_ts
-            for eid, ts in zip(
-                eids.tolist() if hasattr(eids, "tolist") else eids,
-                ts_ms.tolist() if hasattr(ts_ms, "tolist") else ts_ms,
-            ):
+            for i, (eid, ts) in enumerate(zip(eids_l, ts_l)):
                 info = infos[eid]
-                if info is not None and ts > info.get("timestamp", 0):
+                if info is None:
+                    failed.append(i)
+                    continue
+                cur = info.get("timestamp", 0)
+                if exp_l is not None and cur != exp_l[i]:
+                    failed.append(i)
+                    continue
+                if ts > cur:
                     info["timestamp"] = ts
                     mirror[eid] = ts
+        return failed
 
     def service_of(self, endpoint_id: int) -> int:
         return self._endpoint_service[endpoint_id]

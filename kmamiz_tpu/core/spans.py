@@ -368,14 +368,18 @@ def raw_spans_to_batch(
     """
     from kmamiz_tpu import native as native_mod
 
-    # the session path carries dedup state ONLY via the skipset handle:
-    # honoring blob-style skip args there would silently drop them, so
-    # their presence routes to the per-call path instead
+    # the session path resolves against ITS OWN interner/status tables
+    # and carries dedup state ONLY via the skipset handle: taking it
+    # with a mismatched interner or blob-style skip args would silently
+    # ignore what the caller passed, so those route to the per-call
+    # path instead
     if (
         session is not None
         and session.available
         and not skip_trace_ids
         and skip_blob is None
+        and (interner is None or interner is session.interner)
+        and (statuses is None or statuses is session.statuses)
     ):
         return _raw_spans_to_batch_session(
             raw, session, pad, ts_base_us, skipset
@@ -693,34 +697,34 @@ def _session_batch_locked(
         win_code = code_all[order][first]
         n_ep = len(interner.endpoints)
         session._grow_applied(n_ep)
-        mirror = interner.info_timestamps()
         adv = win_ts > session.applied_ts[win_eid]
-        # in-place fast path: same winner as last time AND nothing else
-        # (e.g. the dict-path tick) refreshed the info since we did —
-        # then only the timestamp moves and content is already right
-        fast = (
-            adv
-            & (win_code == session.applied_code[win_eid])
-            & (session.applied_ts[win_eid] == mirror[win_eid])
-        )
-        slow = adv & ~fast
-        if fast.any():
-            interner.refresh_info_timestamps(win_eid[fast], win_ts[fast])
-        if slow.any():
-            for e, t, c in zip(
-                win_eid[slow].tolist(),
-                win_ts[slow].tolist(),
-                win_code[slow].tolist(),
-            ):
-                hit = session.entries[c >> 1]
-                if c & 1:
-                    interner.intern_endpoint(
-                        hit.rt_uen, {**hit.rt_base, "timestamp": t}
-                    )
-                else:
-                    interner.intern_endpoint(
-                        hit.uen, {**hit.info_base, "timestamp": t}
-                    )
+        # in-place fast path: same winner as last time AND (checked
+        # atomically inside the interner lock) nothing else — e.g. the
+        # dict-path tick — refreshed the info since we did, so only the
+        # timestamp moves and content is already right. A compare-and-
+        # set failure routes that endpoint through the exact slow path.
+        fast = adv & (win_code == session.applied_code[win_eid])
+        fast_pos = np.flatnonzero(fast)
+        slow_pos = np.flatnonzero(adv & ~fast)
+        if fast_pos.size:
+            failed = interner.refresh_info_timestamps(
+                win_eid[fast_pos],
+                win_ts[fast_pos],
+                expected_ts=session.applied_ts[win_eid[fast_pos]],
+            )
+            if failed:
+                slow_pos = np.concatenate([slow_pos, fast_pos[failed]])
+        for p in slow_pos.tolist():
+            e, t, c = int(win_eid[p]), float(win_ts[p]), int(win_code[p])
+            hit = session.entries[c >> 1]
+            if c & 1:
+                interner.intern_endpoint(
+                    hit.rt_uen, {**hit.rt_base, "timestamp": t}
+                )
+            else:
+                interner.intern_endpoint(
+                    hit.uen, {**hit.info_base, "timestamp": t}
+                )
         session.applied_ts[win_eid[adv]] = win_ts[adv]
         session.applied_code[win_eid[adv]] = win_code[adv]
 

@@ -53,7 +53,13 @@ def encode_array(a: np.ndarray) -> dict:
     }
 
 
-def decode_array(d: dict) -> np.ndarray:
+def decode_array(d) -> np.ndarray:
+    if isinstance(d, np.ndarray):
+        # already-decoded passthrough: the multi-part snapshot merge
+        # concatenates decoded arrays and hands them straight to the
+        # same consumers, skipping a re-encode/re-decode round trip
+        # over the multi-MB snapshot on the boot path
+        return d
     return (
         np.frombuffer(base64.b64decode(d["data"]), dtype=d["dtype"])
         .reshape(d["shape"])

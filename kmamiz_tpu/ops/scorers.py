@@ -189,10 +189,25 @@ def score_tuple_rows(
     instability = jnp.where(total > 0, inst_on / jnp.maximum(total, 1), 0.0)
 
     # -- ACS at distance 1: triples containing any distance-1 row ------------
-    # dist sorts before ml, so a triple's first row carries its min dist
-    d1_at_first = s_dist == 1
-    ads = owner_count(triple_first & fdir & d1_at_first)
-    ais_links = owner_count(triple_first & ~fdir & d1_at_first)
+    # dist sorts before ml, so rows within a triple are min-dist-first.
+    # The test must read the triple's FIRST ROW WITH dist >= 1 (not its
+    # first row outright): warm-start records can carry distance 0 or
+    # below (graph/store.py tracks _min_dist for exactly this class),
+    # and such a row sorting first must not hide a genuine distance-1
+    # link behind it. With all-dist>=1 data this reduces to the
+    # first-row read. At most one row per triple sets the flag.
+    prev_dist = jnp.concatenate([s_dist[:1], s_dist[:-1]])
+    same_triple_as_prev = jnp.concatenate(
+        [jnp.array([False]), ~prefix_neq]
+    )
+    first_ge1 = (
+        (s_dist >= 1)
+        & (~same_triple_as_prev | (prev_dist < 1))
+        & row_valid
+    )
+    d1_row = first_ge1 & (s_dist == 1)
+    ads = owner_count(d1_row & fdir)
+    ais_links = owner_count(d1_row & ~fdir)
 
     ais = ais_links + is_gateway.astype(jnp.float32)
     acs = ais * ads

@@ -572,7 +572,7 @@ class DataProcessor:
     def _assemble_snapshot_parts(docs) -> "Optional[dict]":
         """Pick the newest COMPLETE part set from stored snapshot
         documents and merge it back into one logical snapshot."""
-        from kmamiz_tpu.models.history import decode_array, encode_array
+        from kmamiz_tpu.models.history import decode_array
 
         groups: Dict[float, list] = {}
         for d in docs or []:
@@ -588,8 +588,11 @@ class DataProcessor:
                 return parts[0]
 
             def cat(getter, axis):
+                # returns the DECODED concatenation: downstream decode_array
+                # passes ndarrays through, so the boot restore never
+                # re-encodes the multi-MB snapshot just to re-decode it
                 arrs = [decode_array(getter(d)) for d in parts]
-                return encode_array(np.concatenate(arrs, axis=axis))
+                return np.concatenate(arrs, axis=axis)
 
             first = parts[0]
             merged = {

@@ -416,3 +416,50 @@ def test_preunion_truncation_rewalks(bookinfo_traces, monkeypatch):
     e1 = {(int(a), int(b), int(c)) for a, b, c in zip(s1[m1], d1[m1], dist1[m1])}
     e2 = {(int(a), int(b), int(c)) for a, b, c in zip(s2[m2], d2[m2], dist2[m2])}
     assert e1 == e2
+
+
+def test_distance_zero_row_does_not_hide_distance_one_acs():
+    """Regression (review r5): ACS/AIS count triples CONTAINING a
+    distance-1 row. A warm-start record at distance 0 for the same
+    (owner, linked) pair sorts before the live distance-1 row — the
+    sorted-run reduction must still see the distance-1 link instead of
+    reading only the triple's first (min-dist) row."""
+    def mk_info(svc, url="u"):
+        return {
+            "uniqueServiceName": f"{svc}\tns\tv",
+            "uniqueEndpointName": f"{svc}\tns\tv\tGET\t{url}",
+            "service": svc, "namespace": "ns", "version": "v", "url": url,
+            "host": "h", "path": "p", "port": "80", "method": "GET",
+            "clusterName": "c", "timestamp": 1,
+        }
+
+    def build(with_zero_row):
+        g = EndpointGraph()
+        a, b = mk_info("a"), mk_info("b")
+        records = [{
+            "endpoint": a,
+            "lastUsageTimestamp": 1,
+            "dependingOn": (
+                [{"endpoint": b, "distance": 0, "type": "t"}]
+                if with_zero_row else []
+            ) + [{"endpoint": b, "distance": 1, "type": "t"}],
+            "dependingBy": [],
+        }, {
+            "endpoint": b,
+            "lastUsageTimestamp": 1,
+            "dependingOn": [],
+            "dependingBy": [{"endpoint": a, "distance": 1, "type": "t"}],
+        }]
+        g.load_dependencies(records)
+        return g
+
+    plain = build(with_zero_row=False)
+    shadowed = build(with_zero_row=True)
+    for g in (plain, shadowed):
+        scores = g.service_scores()
+        sid_a = g.interner.services.get("a\tns\tv")
+        sid_b = g.interner.services.get("b\tns\tv")
+        ads = np.asarray(scores.ads)
+        ais = np.asarray(scores.ais)
+        assert ads[sid_a] == 1.0  # a -> b at distance 1 must count
+        assert ais[sid_b] == 1.0

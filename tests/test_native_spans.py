@@ -1201,3 +1201,69 @@ def test_fuzz_mutated_bytes_session_never_crashes():
             assert list(res[1]) == [f"g{i}"]
             ok_rounds += 1
     assert ok_rounds == 5
+
+
+class TestSessionTimestampRefresh:
+    def test_refresh_cas_rejects_stale_expectation(self):
+        """refresh_info_timestamps(expected_ts=...) must apply only when
+        the info's current timestamp equals the expectation — a failed
+        position reports back (the caller's slow path re-applies full
+        content) and the info stays untouched."""
+        import numpy as np
+
+        from kmamiz_tpu.core.interning import EndpointInterner
+
+        i = EndpointInterner()
+        eid = i.intern_endpoint(
+            "a\tns\tv\tGET\tu", {"uniqueEndpointName": "a", "timestamp": 100}
+        )
+        # expectation matches: applies
+        failed = i.refresh_info_timestamps(
+            np.array([eid]), np.array([170.0]), expected_ts=np.array([100.0])
+        )
+        assert failed == [] and i.info_of(eid)["timestamp"] == 170.0
+        # expectation stale (another writer moved it): rejected untouched
+        failed = i.refresh_info_timestamps(
+            np.array([eid]), np.array([200.0]), expected_ts=np.array([100.0])
+        )
+        assert failed == [0] and i.info_of(eid)["timestamp"] == 170.0
+
+    def test_interleaved_writer_content_wins_back(self):
+        """A dict-path writer replacing the info CONTENT between session
+        windows must not have the session's in-place stamp bless the
+        foreign content: the session detects the moved timestamp and
+        re-applies its own winning shape's full info."""
+        import json as _json
+
+        from kmamiz_tpu.core.interning import EndpointInterner
+        from kmamiz_tpu.core.spans import RawIngestSession
+
+        def window(prefix, ts_us):
+            return _json.dumps(
+                [[mk_span(f"{prefix}", "a", timestamp=ts_us)]]
+            ).encode()
+
+        i = EndpointInterner()
+        sess = RawIngestSession(i)
+        if not sess.available:
+            pytest.skip("native extension unavailable")
+        out = raw_spans_to_batch(
+            window("w1", 1_700_000_000_000_000), interner=i, session=sess
+        )
+        assert out is not None
+        eid = out[0].endpoint_id[0]
+        original = dict(i.info_of(int(eid)))
+        # foreign writer replaces the info with different content, newer ts
+        i.intern_endpoint(
+            original["uniqueEndpointName"],
+            {**original, "url": "http://foreign", "timestamp": original["timestamp"] + 1},
+        )
+        # session's next window wins with a strictly newer timestamp:
+        # full content must re-apply (not just a stamp on foreign data)
+        out2 = raw_spans_to_batch(
+            window("w2", 1_700_000_003_000_000), interner=i, session=sess
+        )
+        assert out2 is not None
+        info = i.info_of(int(eid))
+        assert info["url"] == original["url"]  # session shape's content
+        assert info["timestamp"] > original["timestamp"]

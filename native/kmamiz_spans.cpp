@@ -1606,8 +1606,10 @@ struct SpanIdTable {
         while ((r = slots[j].row.load(std::memory_order_acquire)) < 0)
           cpu_relax();
         const sv& k = ids[r];
+        // empty ids carry nullptr data; memcmp(nullptr, ..., 0) is UB
         if (k.size() == key.size() &&
-            std::memcmp(k.data(), key.data(), key.size()) == 0)
+            (key.empty() ||
+             std::memcmp(k.data(), key.data(), key.size()) == 0))
           return static_cast<int64_t>(j);
         // same hash, different key: keep probing
       }
@@ -1625,8 +1627,11 @@ struct SpanIdTable {
         int32_t r = slots[j].row.load(std::memory_order_acquire);
         if (r >= 0) {
           const sv& k = ids[r];
+          // empty ids carry nullptr data (span without an "id" probed
+          // by an empty parentId); memcmp(nullptr, ..., 0) is UB
           if (k.size() == key.size() &&
-              std::memcmp(k.data(), key.data(), key.size()) == 0)
+              (key.empty() ||
+               std::memcmp(k.data(), key.data(), key.size()) == 0))
             return r;
         }
       }
@@ -2047,7 +2052,7 @@ unsigned char* serialize(const Assembled& as, size_t* out_len) {
   };
   auto w_sv = [&](sv s) {
     w_u32(static_cast<uint32_t>(s.size()));
-    std::memcpy(w, s.data(), s.size());
+    if (!s.empty()) std::memcpy(w, s.data(), s.size());
     w += s.size();
   };
 
@@ -2070,13 +2075,13 @@ unsigned char* serialize(const Assembled& as, size_t* out_len) {
     std::memcpy(w, &as.shapes.shapes[i].max_ts_ms, 8);
     w += 8;
   }
-  std::memcpy(w, as.parent_idx.data(), n * 4);
+  if (n) std::memcpy(w, as.parent_idx.data(), n * 4);
   w += n * 4;
-  std::memcpy(w, as.shape_id.data(), n * 4);
+  if (n) std::memcpy(w, as.shape_id.data(), n * 4);
   w += n * 4;
-  std::memcpy(w, as.status_id.data(), n * 4);
+  if (n) std::memcpy(w, as.status_id.data(), n * 4);
   w += n * 4;
-  std::memcpy(w, as.trace_of.data(), n * 4);
+  if (n) std::memcpy(w, as.trace_of.data(), n * 4);
   w += n * 4;
   for (size_t i = 0; i < n; ++i)
     w[i] = static_cast<uint8_t>(as.rows[i].kind);
@@ -2131,7 +2136,7 @@ unsigned char* serialize_session(const Assembled& as, const ParseSession& ss,
   };
   auto w_sv = [&](sv s) {
     w_u32(static_cast<uint32_t>(s.size()));
-    std::memcpy(w, s.data(), s.size());
+    if (!s.empty()) std::memcpy(w, s.data(), s.size());
     w += s.size();
   };
 
@@ -2151,15 +2156,15 @@ unsigned char* serialize_session(const Assembled& as, const ParseSession& ss,
     std::memcpy(w + (n + i) * 8, &as.rows[i].timestamp_raw, 8);
   }
   w += n * 16;
-  std::memcpy(w, ss.shape_max_ts.data(), shapes_total * 8);
+  if (shapes_total) std::memcpy(w, ss.shape_max_ts.data(), shapes_total * 8);
   w += shapes_total * 8;
-  std::memcpy(w, as.parent_idx.data(), n * 4);
+  if (n) std::memcpy(w, as.parent_idx.data(), n * 4);
   w += n * 4;
-  std::memcpy(w, as.shape_id.data(), n * 4);
+  if (n) std::memcpy(w, as.shape_id.data(), n * 4);
   w += n * 4;
-  std::memcpy(w, as.status_id.data(), n * 4);
+  if (n) std::memcpy(w, as.status_id.data(), n * 4);
   w += n * 4;
-  std::memcpy(w, as.trace_of.data(), n * 4);
+  if (n) std::memcpy(w, as.trace_of.data(), n * 4);
   w += n * 4;
   for (size_t i = 0; i < n; ++i)
     w[i] = static_cast<uint8_t>(as.rows[i].kind);

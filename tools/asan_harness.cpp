@@ -1,0 +1,131 @@
+// ASan/UBSan harness for the native span loader: exercises the per-call
+// paths, the persistent skip set, and the parse session across repeated
+// windows and adversarial mutations.
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <random>
+#include <string>
+#include <vector>
+extern "C" {
+unsigned char* km_parse_spans_mt(const char*, size_t, const char*, size_t, int, size_t*);
+unsigned char* km_parse_spans_hs(void*, const char*, size_t, int, size_t*);
+unsigned char* km_parse_spans_sess(void*, void*, const char*, size_t, int, size_t*);
+void* km_skipset_new(); void km_skipset_free(void*);
+long long km_skipset_extend(void*, const char*, size_t);
+void km_skipset_clear(void*);
+void* km_session_new(); void km_session_free(void*);
+void km_session_ack(void*, unsigned, unsigned);
+unsigned char* km_split_groups(const char*, size_t, int, size_t*);
+void km_free(unsigned char*);
+}
+
+static std::string make_window(int base, int traces) {
+  std::string s = "[";
+  for (int t = 0; t < traces; ++t) {
+    if (t) s += ",";
+    char buf[1024];
+    snprintf(buf, sizeof buf,
+      "[{\"traceId\":\"t%d\",\"id\":\"p%d\",\"kind\":\"SERVER\","
+      "\"name\":\"svc%d.ns.svc.cluster.local:80/*\","
+      "\"timestamp\":%d,\"duration\":55,"
+      "\"tags\":{\"http.method\":\"GET\",\"http.status_code\":\"200\","
+      "\"http.url\":\"http://svc%d.ns/api\",\"istio.canonical_service\":\"svc%d\","
+      "\"istio.namespace\":\"ns\",\"istio.canonical_revision\":\"v1\"}},"
+      "{\"traceId\":\"t%d\",\"id\":\"c%d\",\"parentId\":\"p%d\",\"kind\":\"CLIENT\","
+      "\"name\":\"d%d.ns.svc.cluster.local:80/*\",\"timestamp\":%d,\"duration\":31}]",
+      base + t, base + t, (base + t) % 37, (base + t) % 37, (base + t) % 37,
+      base + t, base + t, base + t, (base + t) % 11, base + t + 1);
+    s += buf;
+  }
+  s += "]";
+  return s;
+}
+
+int main() {
+  unsigned int no_skip = 0;
+  const char* empty = reinterpret_cast<const char*>(&no_skip);
+  void* ss = km_skipset_new();
+  void* sess = km_session_new();
+
+  // steady windows through the session+skipset, with incremental extends
+  for (int w = 0; w < 12; ++w) {
+    std::string win = make_window(w * 50, 50);
+    size_t out_len = 0;
+    unsigned char* out = km_parse_spans_sess(sess, ss, win.data(), win.size(), 1, &out_len);
+    if (!out) { printf("unexpected reject w=%d\n", w); return 2; }
+    // ack roughly (large counts clamp internally)
+    km_session_ack(sess, 1u << 20, 1u << 20);
+    km_free(out);
+    // register this window's ids into the skip set
+    std::string entries;
+    for (int t = 0; t < 50; ++t) {
+      char idb[32]; int n = snprintf(idb, sizeof idb, "t%d", w * 50 + t);
+      unsigned char hdr[5]; hdr[0] = 1; unsigned len = (unsigned)n;
+      memcpy(hdr + 1, &len, 4);
+      entries.append(reinterpret_cast<char*>(hdr), 5);
+      entries.append(idb, n);
+    }
+    if (km_skipset_extend(ss, entries.data(), entries.size()) < 0) return 3;
+    // replay: everything must dedup
+    out = km_parse_spans_hs(ss, win.data(), win.size(), 1, &out_len);
+    if (!out) return 4;
+    km_free(out);
+  }
+  km_skipset_clear(ss);
+
+  // empty-id edge cases: a span with no "id" is claimed with an empty
+  // key; a sibling probing parentId:"" must hit the empty-key compare
+  // in BOTH SpanIdTable::claim and ::find without UB
+  {
+    const char* edge =
+        "[[{\"traceId\":\"e1\",\"kind\":\"SERVER\",\"name\":\"n\","
+        "\"timestamp\":1,\"duration\":5},"
+        "{\"traceId\":\"e1\",\"id\":\"b\",\"parentId\":\"\","
+        "\"kind\":\"SERVER\",\"name\":\"n\",\"timestamp\":2,"
+        "\"duration\":5},"
+        "{\"traceId\":\"e1\",\"id\":\"\",\"parentId\":\"b\","
+        "\"kind\":\"CLIENT\",\"name\":\"n\",\"timestamp\":3,"
+        "\"duration\":5}]]";
+    size_t out_len = 0;
+    for (int threads : {1, 4}) {
+      unsigned char* out = km_parse_spans_mt(empty, 4, edge, strlen(edge),
+                                             threads, &out_len);
+      if (out) km_free(out);
+    }
+  }
+
+  // fuzz: mutations through every entry point (incl. MT threads)
+  std::mt19937 rng(99);
+  std::string base = make_window(10000, 6);
+  for (int i = 0; i < 4000; ++i) {
+    std::string buf;
+    switch (rng() % 4) {
+      case 0: { buf.resize(rng() % 200); for (auto& c : buf) c = (char)(rng() & 0xff); break; }
+      case 1: buf = base.substr(0, rng() % (base.size() + 1)); break;
+      case 2: { buf = base; for (int k = rng() % 6 + 1; k--;) buf[rng() % buf.size()] = (char)(rng() & 0xff); break; }
+      default: { buf = base; const char ins[] = "[]{}\",\\\x00\x01"; for (int k = rng() % 8 + 1; k--;) buf.insert(buf.begin() + rng() % (buf.size() + 1), ins[rng() % 8]); break; }
+    }
+    size_t out_len = 0;
+    unsigned char* out;
+    switch (i % 4) {
+      case 0: out = km_parse_spans_mt(empty, 4, buf.data(), buf.size(), 1, &out_len); break;
+      case 1: out = km_parse_spans_mt(empty, 4, buf.data(), buf.size(), 4, &out_len); break;
+      case 2: out = km_parse_spans_hs(ss, buf.data(), buf.size(), 1, &out_len); break;
+      default: out = km_parse_spans_sess(sess, ss, buf.data(), buf.size(), 2, &out_len); break;
+    }
+    if (out) km_free(out);
+    // malformed skipset extends
+    if (i % 16 == 0 && !buf.empty())
+      km_skipset_extend(ss, buf.data(), buf.size() % 64);
+    // split_groups fuzz
+    if (i % 8 == 0) {
+      unsigned char* sp = km_split_groups(buf.data(), buf.size(), 3, &out_len);
+      if (sp) km_free(sp);
+    }
+  }
+  km_session_free(sess);
+  km_skipset_free(ss);
+  printf("ASAN harness done\n");
+  return 0;
+}

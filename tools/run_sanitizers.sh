@@ -1,0 +1,15 @@
+#!/bin/sh
+# ASan+UBSan pass over the native span loader: builds a standalone
+# harness (no Python) that drives the per-call entry points, the
+# persistent skip set, and the parse session through steady windows,
+# replays, and 4,000 adversarial byte mutations. Any sanitizer report
+# fails the run. (The round-5 pass found only memcpy/memcmp-on-nullptr
+# UB for empty inputs, now guarded at the call sites.)
+set -e
+cd "$(dirname "$0")/.."
+g++ -O1 -g -fsanitize=address,undefined -fno-omit-frame-pointer \
+    -pthread -std=c++17 -o /tmp/kmamiz_asan_parse \
+    tools/asan_harness.cpp native/kmamiz_spans.cpp \
+    native/kmamiz_json.cpp native/kmamiz_native.cpp
+ASAN_OPTIONS=halt_on_error=1 UBSAN_OPTIONS=halt_on_error=1 \
+    /tmp/kmamiz_asan_parse

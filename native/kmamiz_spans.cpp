@@ -1238,7 +1238,16 @@ struct GroupRange {
 
 // per-thread parse output: rows + small private tables
 struct ThreadOut {
-  std::vector<SpanRec> rows;
+  // per-span COLUMNS (SoA): a SpanRec is ~200 B of mostly naming svs
+  // that die the moment the shape interns — pushing whole records wrote
+  // 4x the bytes the pipeline ever reads back, and the assemble phase
+  // then re-gathered ids/parents into flat vectors anyway
+  std::vector<sv> ids;
+  std::vector<sv> parents;
+  std::vector<uint8_t> hasp;
+  std::vector<int8_t> kind;
+  std::vector<double> latency_ms;
+  std::vector<double> timestamp_raw;
   std::vector<int32_t> trace_of;   // GLOBAL kept-group index
   std::vector<int32_t> shape_id;   // local shape ids
   std::vector<int32_t> status_id;  // local status ids
@@ -1247,6 +1256,28 @@ struct ThreadOut {
   Arena arena;
   bool ok = true;
   uint64_t busy_us = 0;
+
+  size_t size() const { return ids.size(); }
+
+  // the ONE enumeration of the per-span columns: every bulk operation
+  // (reserve/move/copy/compact) goes through here so a new column can
+  // never be silently missed at one of the sites
+  template <typename F>
+  void span_cols(F&& f) {
+    f(ids);
+    f(parents);
+    f(hasp);
+    f(kind);
+    f(latency_ms);
+    f(timestamp_raw);
+    f(trace_of);
+    f(shape_id);
+    f(status_id);
+  }
+
+  void reserve(size_t n) {
+    span_cols([n](auto& c) { c.reserve(n); });
+  }
 };
 
 // direct-mapped shape-id cache: most windows carry a few hundred distinct
@@ -1330,7 +1361,12 @@ bool parse_group_spans(Scanner& s, int32_t global_group, ThreadOut* to,
       last_status = st;
       last_status_id = stid;
     }
-    to->rows.push_back(rec);
+    to->ids.push_back(rec.id);
+    to->parents.push_back(rec.parent_id);
+    to->hasp.push_back(rec.has_parent ? 1 : 0);
+    to->kind.push_back(rec.kind);
+    to->latency_ms.push_back(rec.latency_ms);
+    to->timestamp_raw.push_back(rec.timestamp_raw);
     to->trace_of.push_back(global_group);
     to->shape_id.push_back(sid);
     to->status_id.push_back(stid);
@@ -1405,10 +1441,7 @@ PrescanResult prescan(const char* json, size_t json_len,
   int32_t last_status_id = -1;
   auto shape_cache = std::make_unique<ShapeCache>();
   if (inline_out) {
-    inline_out->rows.reserve(json_len / 400 + 16);
-    inline_out->trace_of.reserve(json_len / 400 + 16);
-    inline_out->shape_id.reserve(json_len / 400 + 16);
-    inline_out->status_id.reserve(json_len / 400 + 16);
+    inline_out->reserve(json_len / 400 + 16);
   }
 
   if (!s.eat('[')) return out;
@@ -1544,10 +1577,7 @@ void parse_range(const std::vector<GroupRange>& kept, size_t g0, size_t g1,
   size_t bytes = 0;
   for (size_t g = g0; g < g1; ++g)
     bytes += static_cast<size_t>(kept[g].end - kept[g].begin);
-  to->rows.reserve(bytes / 400 + 16);
-  to->trace_of.reserve(bytes / 400 + 16);
-  to->shape_id.reserve(bytes / 400 + 16);
-  to->status_id.reserve(bytes / 400 + 16);
+  to->reserve(bytes / 400 + 16);
   for (size_t g = g0; g < g1; ++g) {
     Scanner s{kept[g].begin, kept[g].end, &to->arena};
     if (!parse_group_spans(s, static_cast<int32_t>(g), to, span_pred,
@@ -1689,13 +1719,48 @@ void resolve_parents_range(const SpanIdTable& tab, const sv* ids,
 
 struct Assembled {
   size_t n = 0;
-  std::vector<SpanRec> rows;  // flat, document order (moved/copied)
+  // flat per-span columns, document order (moved/copied from ThreadOut)
+  std::vector<sv> ids;
+  std::vector<sv> parents;
+  std::vector<uint8_t> hasp;
+  std::vector<int8_t> kind;
+  std::vector<double> latency_ms;
+  std::vector<double> timestamp_raw;
   std::vector<int32_t> trace_of;
   std::vector<int32_t> shape_id;   // global ids
   std::vector<int32_t> status_id;  // global ids
   std::vector<int32_t> parent_idx;
   ShapeTable shapes;        // global
   std::vector<sv> statuses;  // global
+
+  // same single-enumeration discipline as ThreadOut::span_cols; the
+  // two lists pair up positionally for the cross-struct zip below
+  template <typename F>
+  void span_cols(F&& f) {
+    f(ids);
+    f(parents);
+    f(hasp);
+    f(kind);
+    f(latency_ms);
+    f(timestamp_raw);
+    f(trace_of);
+    f(shape_id);
+    f(status_id);
+  }
+
+  // pairwise (Assembled column, ThreadOut column) visitor
+  template <typename F>
+  void zip_cols(ThreadOut& t, F&& f) {
+    f(ids, t.ids);
+    f(parents, t.parents);
+    f(hasp, t.hasp);
+    f(kind, t.kind);
+    f(latency_ms, t.latency_ms);
+    f(timestamp_raw, t.timestamp_raw);
+    f(trace_of, t.trace_of);
+    f(shape_id, t.shape_id);
+    f(status_id, t.status_id);
+  }
   std::vector<GroupRange> kept;
   bool ok = false;
   uint32_t prescan_us = 0, parse_us = 0, merge_us = 0;
@@ -1710,17 +1775,14 @@ void assemble(std::vector<ThreadOut>& outs, PrescanResult&& ps,
   as->kept = std::move(ps.kept);
 
   size_t n = 0;
-  for (auto& t : outs) n += t.rows.size();
+  for (auto& t : outs) n += t.size();
   as->n = n;
 
   if (outs.size() == 1) {
     // single worker: its tables ARE the global tables (ids assigned in
-    // document order already) -- move, don't copy ~150 MB of rows
+    // document order already) -- move, don't copy the span columns
     ThreadOut& t = outs[0];
-    as->rows = std::move(t.rows);
-    as->trace_of = std::move(t.trace_of);
-    as->shape_id = std::move(t.shape_id);
-    as->status_id = std::move(t.status_id);
+    as->zip_cols(t, [](auto& dst, auto& src) { dst = std::move(src); });
     as->shapes = std::move(t.shapes);
     as->statuses = std::move(t.statuses);
   } else {
@@ -1758,26 +1820,25 @@ void assemble(std::vector<ThreadOut>& outs, PrescanResult&& ps,
       }
     }
 
-    // the ~150 MB document-order row copy parallelizes: each worker owns
-    // a disjoint slice (bases from the prefix sum), remapping shape /
-    // status ids as it copies
-    as->rows.resize(n);
-    as->trace_of.resize(n);
-    as->shape_id.resize(n);
-    as->status_id.resize(n);
+    // the document-order column copy parallelizes: each worker owns a
+    // disjoint slice (bases from the prefix sum), remapping shape /
+    // status ids in place after the raw copy
+    as->span_cols([n](auto& c) { c.resize(n); });
     std::vector<size_t> bases(outs.size() + 1, 0);
     for (size_t ti = 0; ti < outs.size(); ++ti)
-      bases[ti + 1] = bases[ti] + outs[ti].rows.size();
+      bases[ti + 1] = bases[ti] + outs[ti].size();
     auto copy_slice = [&](size_t ti) {
       auto& t = outs[ti];
       size_t base = bases[ti];
       const auto& shape_remap = shape_remaps[ti];
       const auto& status_remap = status_remaps[ti];
-      for (size_t i = 0; i < t.rows.size(); ++i) {
-        as->rows[base + i] = t.rows[i];
-        as->trace_of[base + i] = t.trace_of[i];
-        as->shape_id[base + i] = shape_remap[t.shape_id[i]];
-        as->status_id[base + i] = status_remap[t.status_id[i]];
+      size_t cnt = t.size();
+      as->zip_cols(t, [base](auto& dst, auto& src) {
+        std::copy(src.begin(), src.end(), dst.begin() + base);
+      });
+      for (size_t i = 0; i < cnt; ++i) {
+        as->shape_id[base + i] = shape_remap[as->shape_id[base + i]];
+        as->status_id[base + i] = status_remap[as->status_id[base + i]];
       }
     };
     if (n < 4096) {  // small windows: spawn cost dwarfs the copy
@@ -1785,20 +1846,16 @@ void assemble(std::vector<ThreadOut>& outs, PrescanResult&& ps,
     } else {
       std::vector<std::thread> ths;
       for (size_t ti = 1; ti < outs.size(); ++ti)
-        if (outs[ti].rows.size()) ths.emplace_back(copy_slice, ti);
+        if (outs[ti].size()) ths.emplace_back(copy_slice, ti);
       copy_slice(0);
       for (auto& th : ths) th.join();
     }
   }
 
-  // flat id/parent views for the table phases
-  std::vector<sv> ids(n), parents(n);
-  std::vector<uint8_t> hasp(n);
-  for (size_t i = 0; i < n; ++i) {
-    ids[i] = as->rows[i].id;
-    parents[i] = as->rows[i].parent_id;
-    hasp[i] = as->rows[i].has_parent ? 1 : 0;
-  }
+  // the table phases read the assembled columns directly
+  std::vector<sv>& ids = as->ids;
+  std::vector<sv>& parents = as->parents;
+  std::vector<uint8_t>& hasp = as->hasp;
 
   SpanIdTable table(n);
   std::vector<std::vector<std::pair<int64_t, int32_t>>> dup_lists(n_threads);
@@ -1846,13 +1903,11 @@ void assemble(std::vector<ThreadOut>& outs, PrescanResult&& ps,
       for (size_t k = i; k < j; ++k)
         if (all[k].second != first) dead[all[k].second] = 1;
       if (last != first) {
-        SpanRec moved = as->rows[last];
+        // survivor keeps its position and GROUP; every other field
+        // comes from the last occurrence (JS-Map last-wins)
         int32_t keep_group = as->trace_of[first];
-        as->rows[first] = moved;
+        as->span_cols([&](auto& c) { c[first] = c[last]; });
         as->trace_of[first] = keep_group;
-        ids[first] = moved.id;
-        parents[first] = moved.parent_id;
-        hasp[first] = moved.has_parent ? 1 : 0;
       }
       table.slots[all[i].first].row.store(first, std::memory_order_relaxed);
       i = j;
@@ -1864,19 +1919,11 @@ void assemble(std::vector<ThreadOut>& outs, PrescanResult&& ps,
       if (dead[r]) continue;
       remap[r] = static_cast<int32_t>(w);
       if (w != r) {
-        as->rows[w] = as->rows[r];
-        as->trace_of[w] = as->trace_of[r];
-        ids[w] = ids[r];
-        parents[w] = parents[r];
-        hasp[w] = hasp[r];
+        as->span_cols([&](auto& c) { c[w] = c[r]; });
       }
       ++w;
     }
-    as->rows.resize(w);
-    as->trace_of.resize(w);
-    ids.resize(w);
-    parents.resize(w);
-    hasp.resize(w);
+    as->span_cols([w](auto& c) { c.resize(w); });
     as->n = w;
     n = w;
     // rebuild table rows through the remap
@@ -1887,35 +1934,30 @@ void assemble(std::vector<ThreadOut>& outs, PrescanResult&& ps,
       }
     }
     // last-wins overwrites may have left shape/status tables holding
-    // values seen only in dead records; rebuild over the FINAL rows (same
-    // rare path as the sequential scan)
-    as->shapes.clear();
+    // values seen only in dead records; rebuild over the FINAL rows
+    // (same rare path as the sequential scan). Shape identity rides the
+    // old ids — a row's old shape_id denotes exactly the fields the old
+    // intern saw — and per-shape max_ts re-accumulates from surviving
+    // rows only (a dead-record timestamp must not linger).
+    ShapeTable old_shapes = std::move(as->shapes);
+    std::vector<sv> old_statuses = std::move(as->statuses);
+    as->shapes = ShapeTable();
     as->statuses.clear();
-    as->shape_id.assign(n, 0);
-    as->status_id.assign(n, 0);
     SvMap rebuilt_status(64);
     bool ins;
     for (size_t r = 0; r < n; ++r) {
-      const SpanRec& rec = as->rows[r];
-      Shape sh;
-      sh.f[0] = rec.name;
-      sh.f[1] = rec.url;
-      sh.f[2] = rec.method;
-      sh.f[3] = rec.svc;
-      sh.f[4] = rec.ns;
-      sh.f[5] = rec.rev;
-      sh.f[6] = rec.mesh;
-      sh.key_present = rec.present & kKeyBits;
-      sh.url_present = rec.url_present ? 1 : 0;
-      int32_t sid = as->shapes.intern(sh);
+      Shape clean = old_shapes.shapes[as->shape_id[r]];
+      clean.has_ts = false;
+      clean.max_ts_ms = 0.0;
+      int32_t sid = as->shapes.intern(clean);
       as->shape_id[r] = sid;
       Shape& stored = as->shapes.shapes[sid];
-      double ts_ms = rec.timestamp_raw / 1000.0;
+      double ts_ms = as->timestamp_raw[r] / 1000.0;
       if (!stored.has_ts || ts_ms > stored.max_ts_ms) {
         stored.max_ts_ms = ts_ms;
         stored.has_ts = true;
       }
-      sv st = rec.status_present ? rec.status : sv("", 0);
+      sv st = old_statuses[as->status_id[r]];
       int32_t stid = rebuilt_status.intern(
           st, static_cast<int32_t>(as->statuses.size()), &ins);
       if (ins) as->statuses.push_back(st);
@@ -2066,9 +2108,9 @@ unsigned char* serialize(const Assembled& as, size_t* out_len) {
   w_u32((as.threads << kMergeUsBits) |
         std::min(as.merge_us, kMergeUsMask));
 
-  for (size_t i = 0; i < n; ++i) {
-    std::memcpy(w + i * 8, &as.rows[i].latency_ms, 8);
-    std::memcpy(w + (n + i) * 8, &as.rows[i].timestamp_raw, 8);
+  if (n) {
+    std::memcpy(w, as.latency_ms.data(), n * 8);
+    std::memcpy(w + n * 8, as.timestamp_raw.data(), n * 8);
   }
   w += n * 16;
   for (size_t i = 0; i < n_shapes; ++i) {
@@ -2083,8 +2125,7 @@ unsigned char* serialize(const Assembled& as, size_t* out_len) {
   w += n * 4;
   if (n) std::memcpy(w, as.trace_of.data(), n * 4);
   w += n * 4;
-  for (size_t i = 0; i < n; ++i)
-    w[i] = static_cast<uint8_t>(as.rows[i].kind);
+  if (n) std::memcpy(w, as.kind.data(), n);
   w += n;
   for (const Shape& sh : as.shapes.shapes) {
     *w++ = sh.url_present;
@@ -2151,9 +2192,9 @@ unsigned char* serialize_session(const Assembled& as, const ParseSession& ss,
   w_u32(as.parse_us);
   w_u32((as.threads << kMergeUsBits) | std::min(as.merge_us, kMergeUsMask));
 
-  for (size_t i = 0; i < n; ++i) {
-    std::memcpy(w + i * 8, &as.rows[i].latency_ms, 8);
-    std::memcpy(w + (n + i) * 8, &as.rows[i].timestamp_raw, 8);
+  if (n) {
+    std::memcpy(w, as.latency_ms.data(), n * 8);
+    std::memcpy(w + n * 8, as.timestamp_raw.data(), n * 8);
   }
   w += n * 16;
   if (shapes_total) std::memcpy(w, ss.shape_max_ts.data(), shapes_total * 8);
@@ -2166,8 +2207,7 @@ unsigned char* serialize_session(const Assembled& as, const ParseSession& ss,
   w += n * 4;
   if (n) std::memcpy(w, as.trace_of.data(), n * 4);
   w += n * 4;
-  for (size_t i = 0; i < n; ++i)
-    w[i] = static_cast<uint8_t>(as.rows[i].kind);
+  if (n) std::memcpy(w, as.kind.data(), n);
   w += n;
   for (size_t i = shape_base; i < shapes_total; ++i) {
     const Shape& sh = ss.shapes.shapes[i];

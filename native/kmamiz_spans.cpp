@@ -84,6 +84,7 @@
 #include <mutex>
 #include <string>
 #include <thread>
+#include <utility>
 #include <vector>
 
 namespace {
@@ -1259,26 +1260,30 @@ struct ThreadOut {
 
   size_t size() const { return ids.size(); }
 
-  // the ONE enumeration of the per-span columns: every bulk operation
-  // (reserve/move/copy/compact) goes through here so a new column can
-  // never be silently missed at one of the sites
-  template <typename F>
-  void span_cols(F&& f) {
-    f(ids);
-    f(parents);
-    f(hasp);
-    f(kind);
-    f(latency_ms);
-    f(timestamp_raw);
-    f(trace_of);
-    f(shape_id);
-    f(status_id);
-  }
-
-  void reserve(size_t n) {
-    span_cols([n](auto& c) { c.reserve(n); });
-  }
+  void reserve(size_t n);  // via zip_span_cols below
 };
+
+// THE one enumeration of the per-span columns, generic over the two
+// structs that carry them (ThreadOut and Assembled share member names):
+// every bulk operation — reserve, move, cross-struct copy, last-wins
+// fixup, compaction — instantiates this, so a new column added to the
+// structs can never be silently missed at one of the sites.
+template <typename A, typename B, typename F>
+void zip_span_cols(A& a, B& b, F&& f) {
+  f(a.ids, b.ids);
+  f(a.parents, b.parents);
+  f(a.hasp, b.hasp);
+  f(a.kind, b.kind);
+  f(a.latency_ms, b.latency_ms);
+  f(a.timestamp_raw, b.timestamp_raw);
+  f(a.trace_of, b.trace_of);
+  f(a.shape_id, b.shape_id);
+  f(a.status_id, b.status_id);
+}
+
+inline void ThreadOut::reserve(size_t n) {
+  zip_span_cols(*this, *this, [n](auto& c, auto&) { c.reserve(n); });
+}
 
 // direct-mapped shape-id cache: most windows carry a few hundred distinct
 // shapes but EVERY span pays the 7-string shape_hash without it. The cache
@@ -1733,33 +1738,15 @@ struct Assembled {
   ShapeTable shapes;        // global
   std::vector<sv> statuses;  // global
 
-  // same single-enumeration discipline as ThreadOut::span_cols; the
-  // two lists pair up positionally for the cross-struct zip below
+  // adapters over the single zip_span_cols enumeration
   template <typename F>
   void span_cols(F&& f) {
-    f(ids);
-    f(parents);
-    f(hasp);
-    f(kind);
-    f(latency_ms);
-    f(timestamp_raw);
-    f(trace_of);
-    f(shape_id);
-    f(status_id);
+    zip_span_cols(*this, *this, [&f](auto& c, auto&) { f(c); });
   }
 
-  // pairwise (Assembled column, ThreadOut column) visitor
   template <typename F>
   void zip_cols(ThreadOut& t, F&& f) {
-    f(ids, t.ids);
-    f(parents, t.parents);
-    f(hasp, t.hasp);
-    f(kind, t.kind);
-    f(latency_ms, t.latency_ms);
-    f(timestamp_raw, t.timestamp_raw);
-    f(trace_of, t.trace_of);
-    f(shape_id, t.shape_id);
-    f(status_id, t.status_id);
+    zip_span_cols(*this, t, std::forward<F>(f));
   }
   std::vector<GroupRange> kept;
   bool ok = false;

@@ -8,6 +8,7 @@ with the worst server-error rate.
 from __future__ import annotations
 
 import math
+import threading
 import time
 from typing import Callable, Dict, List, Optional
 
@@ -28,15 +29,25 @@ class AlertHandler(IRequestHandler):
         self._now_ms = now_ms
         self._last_update_time = 0.0
         self._violation: Dict[str, dict] = {}
+        # the reference mutates its violation map on Node's single event
+        # loop; here concurrent GET /alert/violation requests run on
+        # their own threads, so detection + expiry + the sorted read
+        # serialize (review r5: unlocked, one request's fresh violation
+        # could vanish under another's expiry rebuild)
+        self._violation_lock = threading.Lock()
         self.add_route("get", "/violation/:namespace?", self._violation_route)
 
     def _violation_route(self, req: Request) -> Response:
-        self.gather_risk_violations(
-            req.params.get("namespace"), req.query_int("notBefore") or 86_400_000
-        )
-        result = sorted(
-            self._violation.values(), key=lambda v: v["timeoutAt"], reverse=True
-        )
+        with self._violation_lock:
+            self.gather_risk_violations(
+                req.params.get("namespace"),
+                req.query_int("notBefore") or 86_400_000,
+            )
+            result = sorted(
+                self._violation.values(),
+                key=lambda v: v["timeoutAt"],
+                reverse=True,
+            )
         return Response(payload=result)
 
     def _clear_timed_out(self) -> None:
@@ -48,6 +59,8 @@ class AlertHandler(IRequestHandler):
     def gather_risk_violations(
         self, namespace: Optional[str] = None, not_before_ms: int = 86_400_000
     ) -> None:
+        """Caller holds _violation_lock (the route does; direct callers
+        in tests are single-threaded)."""
         self._clear_timed_out()
         update_time = self._ctx.cache.get("LookBackRealtimeData").last_update
         if self._last_update_time == update_time:

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import logging
 import math
+import threading
 from typing import Callable, List, Optional
 
 import numpy as np
@@ -315,19 +316,29 @@ class GraphHandler(IRequestHandler):
             namespace,
             extra_key,
         )
-        cache = getattr(self, "_scorer_payload_cache", None)
-        if cache is None:
-            cache = self._scorer_payload_cache = {}
-        hit = cache.get((kind, namespace))
-        if hit is not None and hit[0] == key:
-            return hit[1]
-        # evict entries from older graph versions (the namespace axis is
-        # caller-controlled; without this the dict grows per distinct query)
-        stale = [k for k, v in cache.items() if v[0][0] != key[0]]
-        for k in stale:
-            del cache[k]
-        payload = builder()
-        cache[(kind, namespace)] = (key, payload)
+        lock = getattr(self, "_scorer_cache_lock", None)
+        if lock is None:
+            lock = self.__dict__.setdefault(
+                "_scorer_cache_lock", threading.Lock()
+            )
+        with lock:
+            cache = getattr(self, "_scorer_payload_cache", None)
+            if cache is None:
+                cache = self._scorer_payload_cache = {}
+            hit = cache.get((kind, namespace))
+            if hit is not None and hit[0] == key:
+                return hit[1]
+            # evict entries from older graph versions (the namespace
+            # axis is caller-controlled; without this the dict grows per
+            # distinct query). Mutation and iteration both happen under
+            # the lock: dashboards poll several scorer routes
+            # concurrently after a version bump (review r5).
+            stale = [k for k, v in cache.items() if v[0][0] != key[0]]
+            for k in stale:
+                del cache[k]
+        payload = builder()  # device work happens OUTSIDE the lock
+        with lock:
+            cache[(kind, namespace)] = (key, payload)
         return payload
 
     @staticmethod

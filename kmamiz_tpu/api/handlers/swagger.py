@@ -109,18 +109,27 @@ class SwaggerHandler(IRequestHandler):
     def add_tagged_swagger(self, tagged: dict) -> None:
         self._ctx.cache.get("TaggedSwaggers").add(tagged)
 
-        data_types = [
-            d
-            for d in self._ctx.cache.get("EndpointDataType").get_data()
-            if d.to_json()["uniqueServiceName"] == tagged["uniqueServiceName"]
-        ]
+        # the reference's tagging freezes interfaces grouped by the
+        # datatypes' LABEL (SwaggerService.ts:112-147, where labelName
+        # was stamped onto the cached objects by an earlier getSwagger);
+        # this port's cached datatypes are immutable, so resolve the
+        # label through the label map here — the same resolution
+        # get_swagger uses — instead of reading a field that is never
+        # set (review r5: every datatype merged into one None-keyed
+        # bucket otherwise, cross-contaminating schemas)
+        label_map = self._ctx.cache.get("LabelMapping")
         merged: dict = {}
-        for d in data_types:
-            name = d.to_json().get("labelName")
-            merged[name] = merged[name].merge_schema_with(d) if name in merged else d
+        for d in self._ctx.cache.get("EndpointDataType").get_data():
+            raw = d.to_json()
+            if raw["uniqueServiceName"] != tagged["uniqueServiceName"]:
+                continue
+            name = label_map.get_label(raw["uniqueEndpointName"])
+            merged[name] = (
+                merged[name].merge_schema_with(d) if name in merged else d
+            )
 
         interfaces = self._ctx.cache.get("TaggedInterfaces")
-        for d in merged.values():
+        for name, d in merged.items():
             dt = d.to_json()
             status_map: dict = {}
             for s in sorted(dt["schemas"], key=lambda s: s["time"]):
@@ -134,7 +143,7 @@ class SwaggerHandler(IRequestHandler):
                         "userLabel": f"{tagged['tag']}-{s['status']}",
                         "uniqueLabelName": (
                             f"{dt['uniqueServiceName']}\t{dt['method']}\t"
-                            f"{dt.get('labelName')}"
+                            f"{name}"
                         ),
                         "boundToSwagger": True,
                     }

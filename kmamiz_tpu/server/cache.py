@@ -7,6 +7,7 @@ snapshots, and simulator mode disabling persistence hooks.
 """
 from __future__ import annotations
 
+import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -20,6 +21,13 @@ class Cacheable:
         self._init: Optional[Callable[[], None]] = None
         self._sync: Optional[Callable[[], None]] = None
         self._last_update = time.time() * 1000
+        # serializes compound read-modify-write updates (tag/label CRUD
+        # rebuilds a list from get_data and set_datas it back). The
+        # reference is safe on Node's single event loop; this port
+        # serves every request on its own thread, where two concurrent
+        # updates would silently drop one (review r5). Plain get/set
+        # stays lock-free: _data swaps are atomic under the GIL.
+        self._update_lock = threading.RLock()
 
     @property
     def name(self) -> str:

@@ -25,6 +25,18 @@ def _now_ms() -> float:
     return time.time() * 1000
 
 
+def _snap(getter, to_docs):
+    """One-read snapshot closure for sync hooks: `getter() and then
+    getter().to_json()` reads the cache twice, and the aggregation
+    thread's reset() landing between the reads turns the flush into an
+    AttributeError that silently skips the collection (review r5)."""
+    def fn():
+        data = getter()
+        return to_docs(data) if data else None
+
+    return fn
+
+
 def _replace_all_sync(store: Store, collection: str, docs_fn: Callable[[], list]):
     def sync() -> None:
         docs = docs_fn()
@@ -70,7 +82,7 @@ class CCombinedRealtimeData(Cacheable):
                 _replace_all_sync(
                     store,
                     "CombinedRealtimeData",
-                    lambda: self.get_data().to_json() if self.get_data() else None,
+                    _snap(self.get_data, lambda d: d.to_json()),
                 ),
                 simulator_mode,
             )
@@ -118,7 +130,7 @@ class CEndpointDependencies(Cacheable):
                 _replace_all_sync(
                     store,
                     "EndpointDependencies",
-                    lambda: self.get_data().to_json() if self.get_data() else None,
+                    _snap(self.get_data, lambda d: d.to_json()),
                 ),
                 simulator_mode,
             )
@@ -308,7 +320,13 @@ class CLabelMapping(Cacheable):
             for s in h["services"]
             for e in s["endpoints"]
         }
-        self.set_data(guess_and_merge_endpoints(list(unique_names), label_map))
+        # guess_and_merge_endpoints mutates the map it is given; work on
+        # a COPY and publish via set_data — other request threads iterate
+        # the live dict concurrently (review r5: in-place inserts raced
+        # GET /data/label and exports to intermittent 500s)
+        self.set_data(
+            guess_and_merge_endpoints(list(unique_names), dict(label_map))
+        )
         for h in historical_data:
             for s in h["services"]:
                 for e in s["endpoints"]:
@@ -324,7 +342,10 @@ class CLabelMapping(Cacheable):
             for s in aggregated_data["services"]
             for e in s["endpoints"]
         }
-        self.set_data(guess_and_merge_endpoints(list(unique_names), label_map))
+        # copy before the mutating guess-merge (see label_historical_data)
+        self.set_data(
+            guess_and_merge_endpoints(list(unique_names), dict(label_map))
+        )
         for s in aggregated_data["services"]:
             for e in s["endpoints"]:
                 e["labelName"] = self.get_label(e["uniqueEndpointName"])
@@ -373,37 +394,43 @@ class CUserDefinedLabel(Cacheable):
                 _replace_all_sync(
                     store,
                     "UserDefinedLabel",
-                    lambda: [self.get_data()] if self.get_data() else None,
+                    _snap(self.get_data, lambda d: [d]),
                 ),
                 simulator_mode,
             )
 
     def update(self, label: dict) -> None:
-        for l in label.get("labels", []):
-            self.delete(l["label"], l["uniqueServiceName"], l["method"])
-        self.add(label)
+        with self._update_lock:
+            for l in label.get("labels", []):
+                self.delete(l["label"], l["uniqueServiceName"], l["method"])
+            self.add(label)
 
     def add(self, label: dict) -> None:
-        data = self.get_data()
-        self.set_data(
-            {"labels": (data or {}).get("labels", []) + label.get("labels", [])}
-        )
+        with self._update_lock:
+            data = self.get_data()
+            self.set_data(
+                {
+                    "labels": (data or {}).get("labels", [])
+                    + label.get("labels", [])
+                }
+            )
 
     def delete(self, label_name: str, unique_service_name: str, method: str) -> None:
-        data = self.get_data()
-        if not data:
-            return
-        self.set_data(
-            {
-                "labels": [
-                    l
-                    for l in data.get("labels", [])
-                    if l["label"] != label_name
-                    or l["uniqueServiceName"] != unique_service_name
-                    or l["method"] != method
-                ]
-            }
-        )
+        with self._update_lock:
+            data = self.get_data()
+            if not data:
+                return
+            self.set_data(
+                {
+                    "labels": [
+                        l
+                        for l in data.get("labels", [])
+                        if l["label"] != label_name
+                        or l["uniqueServiceName"] != unique_service_name
+                        or l["method"] != method
+                    ]
+                }
+            )
 
 
 class CTaggedInterfaces(Cacheable):
@@ -436,18 +463,20 @@ class CTaggedInterfaces(Cacheable):
 
     def add(self, tagged: dict) -> None:
         tagged = {**tagged, "timestamp": _now_ms()}
-        self.set_data(self.get_data() + [tagged])
+        with self._update_lock:
+            self.set_data(self.get_data() + [tagged])
 
     def delete(self, unique_label_name: str, user_label: str) -> None:
         # mirror of the reference's AND-of-inequalities filter
-        self.set_data(
-            [
-                i
-                for i in self.get_data()
-                if i.get("uniqueLabelName") != unique_label_name
-                and i.get("userLabel") != user_label
-            ]
-        )
+        with self._update_lock:
+            self.set_data(
+                [
+                    i
+                    for i in self.get_data()
+                    if i.get("uniqueLabelName") != unique_label_name
+                    and i.get("userLabel") != user_label
+                ]
+            )
 
 
 class CTaggedSwaggers(Cacheable):
@@ -482,20 +511,24 @@ class CTaggedSwaggers(Cacheable):
         return [d for d in docs if d.get("tag") == tag]
 
     def add(self, tagged: dict) -> None:
-        if self.get_data(tagged.get("uniqueServiceName"), tagged.get("tag")):
-            return
-        tagged = {**tagged, "time": _now_ms()}
-        self.set_data(self.get_data() + [tagged])
+        with self._update_lock:
+            if self.get_data(
+                tagged.get("uniqueServiceName"), tagged.get("tag")
+            ):
+                return
+            tagged = {**tagged, "time": _now_ms()}
+            self.set_data(self.get_data() + [tagged])
 
     def delete(self, unique_service_name: str, tag: str) -> None:
-        self.set_data(
-            [
-                d
-                for d in self.get_data()
-                if d.get("tag") != tag
-                or d.get("uniqueServiceName") != unique_service_name
-            ]
-        )
+        with self._update_lock:
+            self.set_data(
+                [
+                    d
+                    for d in self.get_data()
+                    if d.get("tag") != tag
+                    or d.get("uniqueServiceName") != unique_service_name
+                ]
+            )
 
 
 class CTaggedDiffData(Cacheable):
@@ -533,12 +566,16 @@ class CTaggedDiffData(Cacheable):
         return [{"tag": d["tag"], "time": d["time"]} for d in self.get_data()]
 
     def add(self, tagged: dict) -> None:
-        if self.get_data_by_tag(tagged.get("tag")) is None:
-            tagged = {**tagged, "time": _now_ms()}
-            self.set_data((Cacheable.get_data(self) or []) + [tagged])
+        with self._update_lock:
+            if self.get_data_by_tag(tagged.get("tag")) is None:
+                tagged = {**tagged, "time": _now_ms()}
+                self.set_data((Cacheable.get_data(self) or []) + [tagged])
 
     def delete(self, tag: str) -> None:
-        self.set_data([d for d in self.get_data() if d.get("tag") != tag])
+        with self._update_lock:
+            self.set_data(
+                [d for d in self.get_data() if d.get("tag") != tag]
+            )
 
 
 class CLookBackRealtimeData(Cacheable):
@@ -614,12 +651,13 @@ class CTaggedSimulationYAML(Cacheable):
     def add(self, tagged: dict) -> None:
         if not tagged.get("tag"):
             tagged["tag"] = self.default_tag()
-        if self.get_data_by_tag(tagged["tag"]) is None:
-            tagged = {**tagged, "time": _now_ms()}
-            updated = sorted(
-                self.get_data() + [tagged], key=lambda d: -d["time"]
-            )[: self.MAX_STORE_COUNT]
-            self.set_data(updated)
+        with self._update_lock:
+            if self.get_data_by_tag(tagged["tag"]) is None:
+                tagged = {**tagged, "time": _now_ms()}
+                updated = sorted(
+                    self.get_data() + [tagged], key=lambda d: -d["time"]
+                )[: self.MAX_STORE_COUNT]
+                self.set_data(updated)
 
     def delete(self, tag: str) -> None:
         self.set_data([d for d in self.get_data() if d.get("tag") != tag])

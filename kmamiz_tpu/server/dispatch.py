@@ -19,7 +19,9 @@ logger = logging.getLogger("kmamiz_tpu.dispatch")
 class DispatchStorage:
     def __init__(self, cache: DataCache) -> None:
         self._cache = cache
-        self._lock = threading.Lock()
+        # reentrant: import_data holds paused() around a registry swap
+        # that itself ends in sync_all()
+        self._lock = threading.RLock()
         self._sync_type = 0
 
     @property
@@ -46,6 +48,14 @@ class DispatchStorage:
                 sync_fn()
             except Exception:  # noqa: BLE001 - one cache must not wedge the cron
                 logger.exception("dispatch sync of %s failed", name)
+
+    def paused(self):
+        """Hold the sync lock across a multi-step state swap: the import
+        path clears the store and rebuilds the cache registry, and a
+        dispatch tick interleaving mid-swap would flush a PRE-import
+        cache into the freshly cleared store, resurrecting old documents
+        (review r5). Usage: `with ctx.dispatch.paused(): ...`."""
+        return self._lock
 
     def sync_all(self) -> None:
         """Flush every cache (graceful-shutdown path). Per-cache error

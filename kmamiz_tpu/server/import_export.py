@@ -138,6 +138,15 @@ class ImportExportHandler:
         if not import_pairs:
             return False
         ctx = self._ctx
+        # hold the dispatch-rotation lock across the whole swap: a tick
+        # landing between clear_database and the registry rebuild would
+        # flush a PRE-import cache into the cleared store (review r5)
+        with ctx.dispatch.paused():
+            return self._import_data_locked(ctx, import_pairs, skip_collections)
+
+    def _import_data_locked(
+        self, ctx, import_pairs, skip_collections: bool
+    ) -> bool:
         ctx.store.clear_database()
 
         pairs = [tuple(p) for p in import_pairs]

@@ -72,9 +72,22 @@ class ServiceOperator:
 
     # -- realtime schedule (ServiceOperator.ts:282-307) ----------------------
 
+    #: registration older than this is an orphan (its tick never reached
+    #: post_retrieve: dropped tick, DP error, mismatched uniqueId echo)
+    LATENCY_MAP_TTL_MS = 10 * 60 * 1000
+
     def retrieve_realtime_data(self) -> None:
         t = self._now_ms()
         unique_id = f"{random.randrange(16 ** 4):04x}"
+        # prune orphans before registering: post_retrieve is the only
+        # other remover, and a tick that never reaches it (dropped /
+        # failed / id mismatch) would otherwise leak one entry per 5 s
+        # tick forever (review r5)
+        cutoff = t - self.LATENCY_MAP_TTL_MS
+        if any(v < cutoff for v in self._latency_map.values()):
+            self._latency_map = {
+                k: v for k, v in self._latency_map.items() if v >= cutoff
+            }
         self._latency_map[unique_id] = t
         logger.debug("Running realtime schedule [%s]", unique_id)
 

@@ -1124,3 +1124,57 @@ class TestServeOnlyBootWeight:
         ctx = app_mod.build_production_context(settings)
         assert called == []
         assert ctx.processor is not None  # clients still built (sync handshake)
+
+
+class TestSwaggerTagLabels:
+    SVC = "user-service%09pdas%09latest"
+
+    def test_frozen_interfaces_carry_resolved_labels(self, router, ctx):
+        """Regression (review r5): tagging resolves each datatype's
+        label through the label map (the way get_swagger does) — the
+        cached datatypes carry no labelName field, and reading it
+        yielded one None-keyed bucket merging every endpoint's schemas
+        with uniqueLabelName '...\\tNone'."""
+        import json as _json
+
+        doc = get(router, f"/api/v1/swagger/{self.SVC}").payload
+        tagged = {
+            "uniqueServiceName": "user-service\tpdas\tlatest",
+            "tag": "vlabels",
+            "openApiDocument": _json.dumps(doc),
+        }
+        assert (
+            router.dispatch(
+                "POST", "/api/v1/swagger/tags", _json.dumps(tagged).encode()
+            ).status
+            == 200
+        )
+        bound = [
+            i
+            for i in ctx.cache.get("TaggedInterfaces").get_data()
+            if i.get("boundToSwagger")
+        ]
+        assert bound
+        labels = {i["uniqueLabelName"].split("\t")[-1] for i in bound}
+        assert "None" not in labels  # every frozen interface got a label
+        # the labels match the label map's view of this service
+        label_map = ctx.cache.get("LabelMapping")
+        expected = {
+            label_map.get_label(d.to_json()["uniqueEndpointName"])
+            for d in ctx.cache.get("EndpointDataType").get_data()
+            if d.to_json()["uniqueServiceName"]
+            == "user-service\tpdas\tlatest"
+        }
+        assert labels == {str(e) for e in expected if e is not None} or (
+            labels and labels.issubset({str(e) for e in expected})
+        )
+        router.dispatch(
+            "DELETE",
+            "/api/v1/swagger/tags",
+            _json.dumps(
+                {
+                    "uniqueServiceName": "user-service\tpdas\tlatest",
+                    "tag": "vlabels",
+                }
+            ).encode(),
+        )

@@ -3,10 +3,11 @@
 The image ships no pymongo/bson, so the MongoStore
 (kmamiz_tpu.server.mongo) carries its own codec for the subset the
 framework persists — JSON-shaped documents (dict/list/str/int/float/
-bool/None). Decoding additionally understands ObjectId (as 24-hex str)
-and UTC datetime (as epoch ms) so documents written by other Mongo
-clients (the reference app shares the database,
-/root/reference/src/services/MongoOperator.ts:31-93) read back cleanly.
+bool/None). Decoding additionally understands ObjectId (as the
+round-tripping 24-hex str subclass below) and UTC datetime (as epoch
+ms) so documents written by other Mongo clients (the reference app
+shares the database, /root/reference/src/services/MongoOperator.ts:31-93)
+read back cleanly AND can be addressed by _id again.
 """
 from __future__ import annotations
 
@@ -24,6 +25,25 @@ class BsonError(ValueError):
 class Int64(int):
     """Marker forcing int64 encoding (tag 0x12) regardless of magnitude —
     MongoDB requires some fields (getMore cursor ids) to be BSON longs."""
+
+
+class ObjectId(str):
+    """A decoded BSON ObjectId, behaving as its 24-hex string (so JSON
+    dumps, dict keys, and string comparisons keep working) while
+    re-encoding byte-exactly as tag 0x07. Without the round trip, a
+    delete/upsert keyed by an _id the REFERENCE app wrote (Mongoose
+    ObjectIds in the shared database) re-encoded as a BSON string and
+    never matched: the replace-all sync could not purge those documents
+    and stale data was served forever (review r5)."""
+
+    __slots__ = ()
+
+    def __new__(cls, value: str) -> "ObjectId":
+        v = str(value)
+        if len(v) != 24:
+            raise BsonError(f"ObjectId must be 24 hex chars: {v!r}")
+        bytes.fromhex(v)  # validates
+        return super().__new__(cls, v)
 
 
 # -- encoding ---------------------------------------------------------------
@@ -59,6 +79,8 @@ def _encode_value(key: str, value: Any, out: bytearray) -> None:
         out += (
             b"\x05" + name + struct.pack("<i", len(value)) + b"\x00" + bytes(value)
         )
+    elif isinstance(value, ObjectId):  # before str: ObjectId IS a str
+        out += b"\x07" + name + bytes.fromhex(value)
     elif isinstance(value, str):
         raw = value.encode("utf-8")
         out += b"\x02" + name + struct.pack("<i", len(raw) + 1) + raw + b"\x00"
@@ -103,8 +125,8 @@ def _decode_value(tag: int, buf: bytes, pos: int) -> Tuple[Any, int]:
         (length,) = struct.unpack_from("<i", buf, pos)
         start = pos + 5
         return bytes(buf[start : start + length]), start + length
-    if tag == 0x07:  # ObjectId -> 24-hex string
-        return buf[pos : pos + 12].hex(), pos + 12
+    if tag == 0x07:  # ObjectId -> 24-hex string subclass (re-encodes 0x07)
+        return ObjectId(buf[pos : pos + 12].hex()), pos + 12
     if tag == 0x08:
         return buf[pos] != 0, pos + 1
     if tag == 0x09:  # UTC datetime -> epoch ms

@@ -65,7 +65,10 @@ def make_handler(processor: DataProcessor):
                 raw = self.rfile.read(length)
                 if self.headers.get("Content-Encoding") == "gzip":
                     raw = gzip.decompress(raw)
-            except (ValueError, OSError) as e:
+            except (ValueError, OSError, EOFError) as e:
+                # EOFError: gzip.decompress raises it (not OSError) on a
+                # truncated stream — without it a corrupt body killed the
+                # connection instead of answering 400 (review r5)
                 self._send_json(400, {"error": f"bad request: {e}"})
                 return
 

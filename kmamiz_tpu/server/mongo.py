@@ -223,14 +223,33 @@ class MongoClient:
                     f"unsupported authMechanism {self._auth_mechanism!r}"
                 )
             return self._auth_mechanism
-        hello = self._roundtrip(
-            sock,
-            {
-                "hello": 1,
-                "saslSupportedMechs": f"{self._auth_source}.{self._username}",
-                "$db": self._auth_source,
-            },
-        )
+        try:
+            hello = self._roundtrip(
+                sock,
+                {
+                    "hello": 1,
+                    "saslSupportedMechs": (
+                        f"{self._auth_source}.{self._username}"
+                    ),
+                    "$db": self._auth_source,
+                },
+            )
+        except MongoError:
+            # `hello` only exists on MongoDB >= 4.4.2; the 3.6-4.4 servers
+            # this client supports answer the legacy isMaster (which also
+            # reports saslSupportedMechs from 4.0 on) — without the
+            # fallback, negotiation errored before auth ever started on
+            # exactly the servers the SHA-1 path exists for (review r5)
+            hello = self._roundtrip(
+                sock,
+                {
+                    "ismaster": 1,  # the classic all-lowercase spelling
+                    "saslSupportedMechs": (
+                        f"{self._auth_source}.{self._username}"
+                    ),
+                    "$db": self._auth_source,
+                },
+            )
         mechs = hello.get("saslSupportedMechs") or []
         if "SCRAM-SHA-256" in mechs:
             return "SCRAM-SHA-256"
@@ -328,10 +347,32 @@ class MongoClient:
     def ping(self, db: str = "admin") -> None:
         self.command({"ping": 1, "$db": db})
 
+    #: conservative per-command budget for batched inserts: mongod caps
+    #: a COMMAND document at ~16 MB (real drivers split via kind-1
+    #: payload sequences; this client embeds documents in the command
+    #: doc, so it must split itself or a big flush — e.g. a replace-all
+    #: sync at 10k-endpoint scale — would error forever, review r5)
+    INSERT_BATCH_BYTES = 12 * 1024 * 1024
+    INSERT_BATCH_DOCS = 1000
+
     def insert_many(self, db: str, collection: str, docs: List[dict]) -> None:
-        if docs:
+        batch: List[dict] = []
+        batch_bytes = 0
+        for doc in docs:
+            size = len(bson.encode(doc))
+            if batch and (
+                batch_bytes + size > self.INSERT_BATCH_BYTES
+                or len(batch) >= self.INSERT_BATCH_DOCS
+            ):
+                self.command(
+                    {"insert": collection, "documents": batch, "$db": db}
+                )
+                batch, batch_bytes = [], 0
+            batch.append(doc)
+            batch_bytes += size
+        if batch:
             self.command(
-                {"insert": collection, "documents": list(docs), "$db": db}
+                {"insert": collection, "documents": batch, "$db": db}
             )
 
     def find_all(

@@ -238,7 +238,11 @@ class FileStore(MemoryStore):
                 self._data[collection] = {
                     d["_id"]: d for d in docs if "_id" in d
                 }
-            except (json.JSONDecodeError, KeyError):
+            except (json.JSONDecodeError, KeyError, TypeError, AttributeError):
+                # fail open on ANY corrupt snapshot shape (e.g. JSON that
+                # parses to non-dicts): start empty, let the journal and
+                # the next sync rebuild — a boot crash would be worse
+                # than a cold cache (review r5)
                 pass
         journal = self._journal_path(collection)
         if not journal.exists():

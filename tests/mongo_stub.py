@@ -50,10 +50,14 @@ class MiniMongo:
         users: Optional[Dict[str, str]] = None,
         mechanisms: Tuple[str, ...] = ("SCRAM-SHA-256", "SCRAM-SHA-1"),
         force_empty_exchange: bool = False,
+        legacy_hello: bool = False,
     ) -> None:
         self.batch_size = batch_size
         self.users = users or {}  # username -> password; empty = no auth
         self.mechanisms = mechanisms
+        # pre-4.4.2 servers have no `hello` command: reject it with
+        # CommandNotFound so clients must fall back to isMaster
+        self.legacy_hello = legacy_hello
         # ignore the client's skipEmptyExchange to exercise its final
         # empty saslContinue round (old-server behavior)
         self.force_empty_exchange = force_empty_exchange
@@ -263,6 +267,13 @@ class MiniMongo:
         op = next(iter(command))
         self.commands_seen.append(op)
         if op in ("hello", "ismaster"):
+            if op == "hello" and self.legacy_hello:
+                return {
+                    "ok": 0,
+                    "code": 59,
+                    "codeName": "CommandNotFound",
+                    "errmsg": "no such command: 'hello'",
+                }
             reply = {"ok": 1}
             if self.users and command.get("saslSupportedMechs"):
                 user = str(command["saslSupportedMechs"]).split(".", 1)[-1]

@@ -1178,3 +1178,68 @@ class TestSwaggerTagLabels:
                 }
             ).encode(),
         )
+
+
+class TestConcurrentCacheMutation:
+    def test_parallel_tagged_interface_adds_lose_nothing(self, ctx):
+        """Regression (review r5): compound read-modify-write updates on
+        the tagged caches serialize on the per-cache update lock — two
+        concurrent adds previously both read the same list and the
+        second set_data silently discarded the first item. 8 threads x
+        25 adds must all survive, across three cache kinds. A tiny GIL
+        switch interval forces preemption INSIDE the read-modify-write
+        window, which reliably loses items on the unlocked code."""
+        import sys
+        import threading
+
+        old_interval = sys.getswitchinterval()
+        sys.setswitchinterval(1e-5)
+        interfaces = ctx.cache.get("TaggedInterfaces")
+        swaggers = ctx.cache.get("TaggedSwaggers")
+        labels = ctx.cache.get("UserDefinedLabel")
+        n_threads, per = 8, 300
+
+        def work(t):
+            for i in range(per):
+                interfaces.add(
+                    {
+                        "uniqueLabelName": f"svc\tGET\tl{t}-{i}",
+                        "userLabel": f"u{t}-{i}",
+                        "requestSchema": "",
+                        "responseSchema": "",
+                    }
+                )
+                swaggers.add(
+                    {
+                        "uniqueServiceName": f"s{t}\tns\tv",
+                        "tag": f"tag{t}-{i}",
+                        "openApiDocument": "{}",
+                    }
+                )
+                labels.add(
+                    {
+                        "labels": [
+                            {
+                                "label": f"L{t}-{i}",
+                                "uniqueServiceName": f"s{t}\tns\tv",
+                                "method": "GET",
+                                "samples": [],
+                            }
+                        ]
+                    }
+                )
+
+        threads = [
+            threading.Thread(target=work, args=(t,)) for t in range(n_threads)
+        ]
+        try:
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join()
+        finally:
+            sys.setswitchinterval(old_interval)
+
+        assert len(interfaces.get_data()) == n_threads * per
+        assert len(swaggers.get_data()) == n_threads * per
+        assert len(labels.get_data()["labels"]) == n_threads * per

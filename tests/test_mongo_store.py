@@ -370,3 +370,59 @@ class TestModelHistoryOverMongo:
             dp2.forecast_snapshot["features"],
             dp1.forecast_snapshot["features"],
         )
+
+
+class TestWireFixesR5:
+    def test_objectid_roundtrips_as_native_type(self):
+        """Regression (review r5): an ObjectId _id decoded from a
+        reference-written document must re-encode as tag 0x07 — a
+        plain-string re-encode (tag 0x02) never matched the original
+        document in delete/upsert, so the replace-all sync could not
+        purge reference-written docs."""
+        import struct
+
+        oid = bytes(range(1, 13))
+        body = b"\x07_id\x00" + oid
+        raw = struct.pack("<i", len(body) + 5) + body + b"\x00"
+        decoded = bson.decode(raw)
+        assert decoded["_id"] == oid.hex()  # still string-comparable
+        assert bson.encode(decoded) == raw  # byte-exact round trip
+        # json serialization keeps working (export paths)
+        import json as _json
+
+        assert _json.dumps(decoded["_id"]) == f'"{oid.hex()}"'
+
+    def test_insert_many_batches_under_command_cap(self, mongo):
+        from kmamiz_tpu.server.mongo import MongoClient
+
+        client = MongoClient("127.0.0.1", mongo.port)
+        client.INSERT_BATCH_DOCS = 10  # force splitting without 16MB docs
+        docs = [{"_id": f"d{i}", "v": i} for i in range(35)]
+        client.insert_many("db", "batched", docs)
+        inserts = [c for c in mongo.commands_seen if c == "insert"]
+        assert len(inserts) == 4  # 10+10+10+5
+        assert len(client.find_all("db", "batched")) == 35
+        client.close()
+
+    def test_auth_negotiation_falls_back_to_ismaster(self):
+        """A pre-4.4.2 server rejects `hello` with CommandNotFound; the
+        client must renegotiate via isMaster and authenticate."""
+        from kmamiz_tpu.server.mongo import MongoClient
+
+        server = MiniMongo(
+            users={"u": "pw"}, legacy_hello=True
+        ).start()
+        try:
+            client = MongoClient(
+                "127.0.0.1",
+                server.port,
+                username="u",
+                password="pw",
+                auth_source="admin",
+            )
+            client.insert_many("db", "c", [{"_id": "x", "v": 1}])
+            assert [d["_id"] for d in client.find_all("db", "c")] == ["x"]
+            assert "ismaster" in server.commands_seen
+            client.close()
+        finally:
+            server.stop()

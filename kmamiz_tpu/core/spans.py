@@ -824,6 +824,41 @@ class PackedRows:
         return pslot
 
 
+def max_ancestor_chain(parent_idx: np.ndarray, n_spans: int) -> int:
+    """Longest parent-chain length in HOPS across the window, memoized
+    O(n) (each span's depth computes once). Used by the flat-gather
+    merge fallback — the path taken exactly when pack_trace_rows cannot
+    lay the window out (overlong traces, cross-trace parents) — to size
+    its walk depth: a fixed cap there silently dropped ancestors past it
+    while the reference walk is unbounded (review r5). A parent CYCLE
+    (possible only under adversarial duplicate span ids; the reference's
+    while-loop would not terminate on one) counts as a chain end at the
+    revisited span."""
+    if n_spans == 0:
+        return 0
+    p = np.asarray(parent_idx[:n_spans], dtype=np.int64)
+    depth = np.full(n_spans, 0, dtype=np.int64)  # 0 = unknown; else nodes
+    VISITING = -1
+    for i in range(n_spans):
+        if depth[i] > 0:
+            continue
+        path = []
+        j = i
+        while j >= 0 and depth[j] <= 0:
+            if depth[j] == VISITING:
+                j = -1  # cycle: treat the revisited span as a root edge
+                break
+            depth[j] = VISITING
+            path.append(j)
+            nxt = p[j]
+            j = int(nxt) if 0 <= nxt < n_spans else -1
+        base = int(depth[j]) if j >= 0 else 0
+        for k in reversed(path):
+            base += 1
+            depth[k] = base
+    return int(depth.max()) - 1  # hops = chain nodes - 1
+
+
 def pack_trace_rows(
     trace_of: np.ndarray, n_spans: int, parent_idx: Optional[np.ndarray] = None
 ) -> Optional[PackedRows]:

@@ -54,14 +54,26 @@ def _merge_edges(src_a, dst_a, dist_a, mask_a, src_b, dst_b, dist_b, mask_b):
     return s, d, ds, valid
 
 
-@jax.jit
-def _window_merge(parent_idx, kind, valid, endpoint_id, src, dst, dist, mask):
+@partial(jax.jit, static_argnames=("max_depth",))
+def _window_merge(
+    parent_idx,
+    kind,
+    valid,
+    endpoint_id,
+    src,
+    dst,
+    dist,
+    mask,
+    max_depth=window_ops.MAX_DEPTH,
+):
     """Fused window edge-extraction + set-union merge.
 
-    One jitted program per (batch-capacity, store-capacity) bucket so a
-    realtime tick costs a single device round trip: the only host sync is
-    the returned valid-edge count scalar."""
-    edges = window_ops.dependency_edges(parent_idx, kind, valid, endpoint_id)
+    One jitted program per (batch-capacity, store-capacity, depth-bucket)
+    so a realtime tick costs a single device round trip: the only host
+    sync is the returned valid-edge count scalar."""
+    edges = window_ops.dependency_edges(
+        parent_idx, kind, valid, endpoint_id, max_depth=max_depth
+    )
     s, d, ds, v = _merge_edges(
         src,
         dst,
@@ -432,7 +444,19 @@ class EndpointGraph:
                 max_depth=depth,
             )
         else:  # overlong trace / cross-trace parent: flat gather fallback
-            self._max_dist = max(self._max_dist, window_ops.MAX_DEPTH)
+            # size the walk to the window's TRUE longest parent chain
+            # (pow2-bucketed, floored at the packed path's default): the
+            # deep-trace case is exactly what routes here, and a fixed
+            # cap silently dropped ancestors past it while the reference
+            # walk is unbounded (review r5). The O(n) host chain scan is
+            # fine on this rare path.
+            from kmamiz_tpu.core.spans import max_ancestor_chain
+
+            depth = _pow2(
+                max(max_ancestor_chain(batch.parent_idx, batch.n_spans), 1),
+                minimum=window_ops.MAX_DEPTH,
+            )
+            self._max_dist = max(self._max_dist, depth)
             dev_in, transfer_ms = self._to_device(
                 batch.parent_idx, batch.kind, batch.valid, batch.endpoint_id
             )
@@ -442,6 +466,7 @@ class EndpointGraph:
                 self._dst,
                 self._dist,
                 self._src != SENTINEL,
+                max_depth=depth,
             )
         # Defer the count sync: dispatch is async, so the tick returns without
         # blocking on the device round trip; the copy streams back in the
@@ -1098,13 +1123,21 @@ class EndpointGraph:
 
     def active_services(self, now_ms=None) -> np.ndarray:
         """bool[num_services]: services owning at least one non-deprecated
-        endpoint record."""
+        endpoint record. Vectorized over the interner's endpoint->service
+        relation — the former per-endpoint Python loop cost tens of ms
+        per scorer API call at 100k endpoints, held under the store lock
+        (review r5)."""
         with self._lock:
             n_ep = len(self.interner.endpoints)
             self._ensure_ep_arrays(n_ep)
             fresh = self._fresh_mask(_pow2(max(n_ep, 1)), now_ms)
             out = np.zeros(len(self.interner.services), dtype=bool)
-            for eid in range(n_ep):
-                if self._ep_record[eid] and fresh[eid]:
-                    out[self.interner.service_of(eid)] = True
+            if n_ep:
+                ep_svc = np.asarray(
+                    self.interner.endpoint_service_ids[:n_ep], dtype=np.int64
+                )
+                live = np.asarray(self._ep_record[:n_ep]) & np.asarray(
+                    fresh[:n_ep]
+                )
+                out[ep_svc[live]] = True
             return out

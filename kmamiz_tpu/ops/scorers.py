@@ -70,26 +70,44 @@ def service_scores(
     ep_has_record: bool[num_endpoints] — endpoints with a dependency record
     (seen as SERVER spans); gateway detection only considers these.
     """
-    rows = edge_direction_tuples(src_ep, dst_ep, dist, mask, ep_service, ep_ml)
+    rows = edge_direction_tuples(
+        src_ep, dst_ep, dist, mask, ep_service, ep_ml, ep_has_record
+    )
     is_gateway = gateway_mask(
         dst_ep, mask, ep_service, ep_has_record, num_services
     )
     return score_tuple_rows(*rows, is_gateway, num_services=num_services)
 
 
-def edge_direction_tuples(src_ep, dst_ep, dist, mask, ep_service, ep_ml):
+def edge_direction_tuples(
+    src_ep, dst_ep, dist, mask, ep_service, ep_ml, ep_has_record
+):
     """Expand flat edges into BOTH direction-tuple rows:
     "on" = owner src sees linked dst; "by" = owner dst sees linked src —
     distinct (owner, linked_svc, dir, dist, linked_ml) tuples feed
     score_tuple_rows. Shared by the single-device scorer and the
     per-shard stage of the mesh-sharded scorer. Returns (owner, linked,
-    ddir, ddist, linked_ml, both_mask)."""
+    ddir, ddist, linked_ml, both_mask).
+
+    Each direction exists only where its OWNER endpoint holds a
+    dependency record: the reference derives dependingOn/dependingBy
+    details by iterating RECORDS, which only SERVER-seen endpoints own
+    (domain/traces.py:177-181; EndpointDependencies.ts:369-470 walks
+    this.dependencies). An edge whose ancestor endpoint was never a
+    SERVER span (PRODUCER/kindless ancestors, or a warm-start
+    dependingOn target absent from the cache page) must not give that
+    ancestor's service instability_on/ADS — the host scorer reports
+    nothing for it (review r5). The LINKED side stays ungated: a
+    record's detail lists its counterpart endpoint regardless of the
+    counterpart's own recordness."""
     src_safe = jnp.maximum(src_ep, 0)
     dst_safe = jnp.maximum(dst_ep, 0)
     src_svc = ep_service[src_safe]
     dst_svc = ep_service[dst_safe]
     src_ml = ep_ml[src_safe]
     dst_ml = ep_ml[dst_safe]
+    src_rec = ep_has_record[src_safe]
+    dst_rec = ep_has_record[dst_safe]
     dist32 = dist.astype(jnp.int32)
     owner = jnp.concatenate([src_svc, dst_svc])
     linked = jnp.concatenate([dst_svc, src_svc])
@@ -98,7 +116,7 @@ def edge_direction_tuples(src_ep, dst_ep, dist, mask, ep_service, ep_ml):
     ddir = jnp.concatenate(
         [jnp.zeros_like(dist32), jnp.ones_like(dist32)]
     )  # 0 = on/SERVER, 1 = by/CLIENT
-    both_mask = jnp.concatenate([mask, mask])
+    both_mask = jnp.concatenate([mask & src_rec, mask & dst_rec])
     return owner, linked, ddir, ddist, linked_ml, both_mask
 
 
@@ -302,15 +320,21 @@ def usage_cohesion(
     )
     owner_total = total_endpoints[jnp.minimum(g_owner, park - 1)]
     consumes_at_first = pair_counts[jnp.maximum(group_gid, 0)]
+    # a service owning ZERO endpoint records must not appear at all:
+    # the reference's toServiceEndpointCohesion iterates record-owning
+    # services only (EndpointDependencies.ts:565-612) — a warm-start
+    # dependingOn target without its own record in the page would
+    # otherwise gain a spurious consumer entry (review r5)
+    group_emit = group_first & (owner_total > 0)
     frac = jnp.where(
-        group_first & (owner_total > 0),
+        group_emit,
         consumes_at_first / jnp.maximum(owner_total, 1),
         0.0,
     )
-    pair_owner_seg = jnp.where(group_first, g_owner, park)
+    pair_owner_seg = jnp.where(group_emit, g_owner, park)
     frac_sum = jax.ops.segment_sum(frac, pair_owner_seg, num_segments=park + 1)[:-1]
     consumer_count = jax.ops.segment_sum(
-        group_first.astype(jnp.float32), pair_owner_seg, num_segments=park + 1
+        group_emit.astype(jnp.float32), pair_owner_seg, num_segments=park + 1
     )[:-1]
     cohesion = jnp.where(
         consumer_count > 0, frac_sum / jnp.maximum(consumer_count, 1), 0.0
@@ -319,10 +343,10 @@ def usage_cohesion(
         total_endpoints=total_endpoints,
         consumer_count=consumer_count,
         usage_cohesion=cohesion,
-        pair_owner=jnp.where(group_first, g_owner, SENTINEL),
-        pair_consumer=jnp.where(group_first, g_consumer, SENTINEL),
+        pair_owner=jnp.where(group_emit, g_owner, SENTINEL),
+        pair_consumer=jnp.where(group_emit, g_consumer, SENTINEL),
         pair_consumes=consumes_at_first,
-        pair_valid=group_first,
+        pair_valid=group_emit,
     )
 
 

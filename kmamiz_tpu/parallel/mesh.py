@@ -641,9 +641,9 @@ def sharded_service_scores(
     spec = P(axis)
     num_endpoints = ep_service.shape[0]
 
-    def local(srcs, dsts, dists, masks, ep_svc, ep_ml_t):
+    def local(srcs, dsts, dists, masks, ep_svc, ep_ml_t, ep_rec_t):
         rows = scorer_ops.edge_direction_tuples(
-            srcs, dsts, dists, masks, ep_svc, ep_ml_t
+            srcs, dsts, dists, masks, ep_svc, ep_ml_t, ep_rec_t
         )
         cols, uniq = lex_unique(rows[:-1], rows[-1])
         comp, valid = scatter_compact(cols, uniq)
@@ -659,9 +659,9 @@ def sharded_service_scores(
     o, l, dr, dd, ml, valid, by_deg = shard_map(
         local,
         mesh=mesh,
-        in_specs=(spec, spec, spec, spec, P(), P()),
+        in_specs=(spec, spec, spec, spec, P(), P(), P()),
         out_specs=(spec, spec, spec, spec, spec, spec, P()),
-    )(src_ep, dst_ep, dist, mask, ep_service, ep_ml)
+    )(src_ep, dst_ep, dist, mask, ep_service, ep_ml, ep_has_record)
 
     is_gateway = scorer_ops.gateway_mask(
         dst_ep, mask, ep_service, ep_has_record, num_services, by_deg=by_deg

@@ -463,3 +463,77 @@ def test_distance_zero_row_does_not_hide_distance_one_acs():
         ais = np.asarray(scores.ais)
         assert ads[sid_a] == 1.0  # a -> b at distance 1 must count
         assert ais[sid_b] == 1.0
+
+
+def _mk_info(svc, url="u"):
+    return {
+        "uniqueServiceName": f"{svc}\tns\tv",
+        "uniqueEndpointName": f"{svc}\tns\tv\tGET\t{url}",
+        "service": svc, "namespace": "ns", "version": "v", "url": url,
+        "host": "h", "path": "p", "port": "80", "method": "GET",
+        "clusterName": "c", "timestamp": 1,
+    }
+
+
+def test_recordless_endpoint_gets_no_owner_scores():
+    """Regression (review r5): scorer tuples exist only where the OWNER
+    endpoint holds a dependency record — the reference derives
+    dependingOn/dependingBy details by iterating records (SERVER-seen
+    endpoints). A warm-start dependingOn target with no record of its
+    own must score nothing as an owner (no instability_by, no ADS/AIS,
+    no cohesion consumers), exactly like the host scorer."""
+    g = EndpointGraph()
+    a, b = _mk_info("a"), _mk_info("b")
+    g.load_dependencies([
+        {
+            "endpoint": a,
+            "lastUsageTimestamp": 1,
+            "dependingOn": [{"endpoint": b, "distance": 1, "type": "t"}],
+            "dependingBy": [],
+        }
+    ])
+    sid_a = g.interner.services.get("a\tns\tv")
+    sid_b = g.interner.services.get("b\tns\tv")
+    scores = g.service_scores()
+    # a OWNS a record: its dependingOn detail counts b
+    assert np.asarray(scores.instability_on)[sid_a] == 1.0
+    assert np.asarray(scores.ads)[sid_a] == 1.0
+    # b owns NO record: the host scorer emits nothing for it
+    assert np.asarray(scores.instability_by)[sid_b] == 0.0
+    assert np.asarray(scores.ais)[sid_b] == 0.0
+    assert np.asarray(scores.acs)[sid_b] == 0.0
+    cohesion = g.usage_cohesion()
+    assert np.asarray(cohesion.consumer_count)[sid_b] == 0.0
+    assert not np.any(
+        np.asarray(cohesion.pair_owner)[np.asarray(cohesion.pair_valid)]
+        == sid_b
+    )
+
+
+def test_deep_trace_fallback_keeps_all_distances():
+    """Regression (review r5): a trace too long to row-pack routes to the
+    flat-gather fallback, which previously capped the walk at 32 hops
+    and silently dropped deeper ancestors; the reference walk is
+    unbounded. A 70-SERVER-span chain must produce every (ancestor,
+    descendant) pair up to distance 69."""
+    n = 70
+    spans = []
+    for i in range(n):
+        spans.append(
+            {
+                "traceId": "deep",
+                "id": f"s{i}",
+                "parentId": f"s{i-1}" if i else None,
+                "kind": "SERVER",
+                "name": f"svc{i}.ns.svc.cluster.local:80/*",
+                "timestamp": 1_700_000_000_000_000 + i,
+                "duration": 10,
+                "tags": {"http.method": "GET", "http.status_code": "200"},
+            }
+        )
+    batch = spans_to_batch([spans])
+    g = EndpointGraph(interner=batch.interner)
+    g.merge_window(batch)
+    s, d, dist, m = (np.asarray(x) for x in g.edge_arrays())
+    assert g.n_edges == n * (n - 1) // 2  # every (ancestor, desc) pair
+    assert int(dist[m].max()) == n - 1

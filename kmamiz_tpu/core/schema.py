@@ -300,10 +300,15 @@ def merge(a: Any, b: Any) -> Any:
 
 
 def _spread(value: Any) -> dict:
-    """JS object-spread semantics: dicts spread their entries, strings their
-    indexed characters, everything else (null/number/bool) spreads to nothing."""
+    """JS object-spread semantics: dicts spread their entries, ARRAYS
+    their index-keyed elements ({...[x]} === {"0": x} — array-bodied
+    JSON samples must reach the interface inference, review r5),
+    strings their indexed characters, everything else (null/number/
+    bool) spreads to nothing."""
     if isinstance(value, dict):
         return value
+    if isinstance(value, (list, tuple)):
+        return {str(i): v for i, v in enumerate(value)}
     if isinstance(value, str):
         return {str(i): c for i, c in enumerate(value)}
     return {}

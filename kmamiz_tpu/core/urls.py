@@ -15,7 +15,12 @@ from typing import List, NamedTuple, Optional
 
 _SCHEME_RE = re.compile(r"[a-z]+://")
 _HOST_RE = re.compile(r"://([^:/]*)([:0-9]*)(.*)", re.S)
-_SVC_RE = re.compile(r"(.*)\.svc[\.]*(.*)")
+#: the dot before `svc` is deliberately UNESCAPED — the reference's
+#: /(.*).svc[\.]*(.*)/ (Utils.ts:90; url_matcher.rs:9) matches ANY
+#: character there, so a host like "books-svc:8080" parses the same way
+#: it does upstream (review r5: escaping it diverged the service naming
+#: for hosts containing "svc" without a literal dot)
+_SVC_RE = re.compile(r"(.*).svc[\.]*(.*)")
 
 
 class ExplodedUrl(NamedTuple):

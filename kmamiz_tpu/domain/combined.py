@@ -17,7 +17,11 @@ from kmamiz_tpu.core.urls import get_params_from_url
 
 def _get_scale_shift(mean1: float, mean2: float) -> int:
     def safe_log10(x: float) -> int:
-        if x <= 0:
+        # NaN from a corrupt snapshot must PROPAGATE like the JS math
+        # (Math.floor(NaN) is NaN, folded to 0 shift here so the scale
+        # stays usable) instead of raising out of the whole merge
+        # (review r5)
+        if not math.isfinite(x) or x <= 0:
             return 0
         return math.floor(math.log10(x))
 
@@ -38,6 +42,10 @@ def combine_latency_cv_and_mean(
     std2s = cv2 * mean2s
 
     total_n = n1 + n2
+    if total_n == 0:
+        # JS 0/0 is NaN; a ZeroDivisionError would abort the whole
+        # cache merge over one empty pair (review r5)
+        return {"mean": float("nan"), "cv": float("nan")}
     mean_total = (n1 * mean1s + n2 * mean2s) / total_n
 
     pooled_variance = (
@@ -47,7 +55,10 @@ def combine_latency_cv_and_mean(
         + n2 * (mean2s - mean_total) ** 2
     ) / total_n
 
-    std_total = math.sqrt(pooled_variance)
+    # math.sqrt raises on NaN/negative where Math.sqrt yields NaN
+    std_total = (
+        math.sqrt(pooled_variance) if pooled_variance >= 0 else float("nan")
+    )
     cv_total = 0.0 if mean_total == 0 else std_total / mean_total
     return {"mean": mean_total * scale, "cv": cv_total}
 
@@ -133,6 +144,25 @@ class CombinedRealtimeDataList:
         mean = sum(valid) / len(valid)
         return mean if math.isfinite(mean) else 0.0
 
+    @staticmethod
+    def _mean_latency_service(rows: List[dict]) -> float:
+        """The SERVICE rollup filters each ELEMENT like the reference
+        (`typeof number && isFinite` per row, CombinedRealtimeDataList.
+        ts:129): one NaN/string mean from a bad snapshot must not sink
+        the whole service's latencyMean (the endpoint path above keeps
+        the reference's other filter: include, then zero a non-finite
+        RESULT). Review r5."""
+        valid = [
+            m
+            for r in rows
+            if isinstance((m := r["latency"].get("mean")), (int, float))
+            and not isinstance(m, bool)
+            and math.isfinite(m)
+        ]
+        if not valid:
+            return 0.0
+        return sum(valid) / len(valid)
+
     def _historical_endpoint_info(
         self,
         endpoint_map: Dict[str, List[dict]],
@@ -191,7 +221,7 @@ class CombinedRealtimeDataList:
                     "requests": requests,
                     "requestErrors": request_errors,
                     "serverErrors": server_errors,
-                    "latencyMean": self._mean_latency(rows),
+                    "latencyMean": self._mean_latency_service(rows),
                     "latencyCV": max(r["latency"].get("cv") or 0 for r in rows),
                     "uniqueServiceName": unique_service_name,
                     "risk": risk,

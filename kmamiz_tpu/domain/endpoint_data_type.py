@@ -176,10 +176,17 @@ class EndpointDataType:
                 }
             elif n:
                 combined[status] = n
+        # the reference's mapToMap SORTS this.schemas in place (JS
+        # Array.sort mutates) before the concat, so the merged object
+        # carries time-DESC-ordered own schemas — later last-wins dedup
+        # by status must see the same order (review r5)
+        own_sorted = sorted(
+            self._data["schemas"], key=lambda s: -(s.get("time") or 0)
+        )
         return EndpointDataType(
             {
                 **self._data,
-                "schemas": self._data["schemas"] + list(combined.values()),
+                "schemas": own_sorted + list(combined.values()),
             }
         )
 

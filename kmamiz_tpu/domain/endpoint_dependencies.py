@@ -39,7 +39,11 @@ class EndpointDependencies:
         deprecated_names: Set[str] = set()
         kept = []
         for dep in dependencies:
-            if (dep.get("lastUsageTimestamp") or 0) < deprecated_ts:
+            last_used = dep.get("lastUsageTimestamp")
+            # a record WITHOUT the timestamp stays: the reference's
+            # `undefined < deprecatedTimestamp` is false (review r5 —
+            # older documents lack the field and must not be purged)
+            if last_used is not None and last_used < deprecated_ts:
                 deprecated_names.add(dep["endpoint"]["uniqueEndpointName"])
             else:
                 kept.append(dep)

@@ -19,6 +19,10 @@ from kmamiz_tpu.core.timeutils import to_precise
 from kmamiz_tpu.domain.combined import CombinedRealtimeDataList
 
 
+def _reject_constant(name: str):
+    raise ValueError(f"non-JSON constant {name}")
+
+
 def welford_mean_cv(latencies: List[float]) -> tuple:
     if not latencies:
         return 0.0, 0.0
@@ -43,19 +47,26 @@ def parse_request_response_body(data: dict) -> dict:
         "responseBody": None,
         "responseSchema": None,
     }
+
+    def strict_loads(raw):
+        # JSON.parse rejects NaN/Infinity literals; Python's json.loads
+        # accepts them by default — bodies the reference discards must
+        # not sneak schemas in here (review r5)
+        return json.loads(raw, parse_constant=_reject_constant)
+
     if data.get("requestContentType") == "application/json":
         try:
-            body = json.loads(data.get("requestBody"))
+            body = strict_loads(data.get("requestBody"))
             result["requestBody"] = body
             result["requestSchema"] = schema.object_to_interface_string(body)
-        except (json.JSONDecodeError, TypeError):
+        except (json.JSONDecodeError, TypeError, ValueError):
             pass
     if data.get("responseContentType") == "application/json":
         try:
-            body = json.loads(data.get("responseBody"))
+            body = strict_loads(data.get("responseBody"))
             result["responseBody"] = body
             result["responseSchema"] = schema.object_to_interface_string(body)
-        except (json.JSONDecodeError, TypeError):
+        except (json.JSONDecodeError, TypeError, ValueError):
             pass
     return result
 

@@ -275,7 +275,11 @@ def simulate_propagation(
         metrics = metrics_per_slot[key]
         acc = _StatsAccumulator()
         for entry in sorted(metrics.entry_request_counts):
-            count = int(metrics.get_entry_request_count(entry))
+            # the reference's `for (i = 0; i < count; i++)` runs
+            # ceil(count) times for fractional counts (traffic
+            # multipliers make them common); int() truncated one
+            # request off every such slot (review r5)
+            count = math.ceil(metrics.get_entry_request_count(entry))
             if count <= 0:
                 continue
             if entry not in topo_cache:

@@ -138,7 +138,9 @@ class Simulator:
                         }
                         by_status = {}
                         for resp in responses:
-                            by_status.setdefault(str(resp["status"]), resp)
+                            # Map.set overwrites: LAST declaration of a
+                            # duplicated status wins (review r5)
+                            by_status[str(resp["status"])] = resp
                         for status, resp in by_status.items():
                             sample_rows.append(
                                 {

@@ -305,3 +305,43 @@ class TestNormalizer:
         assert normalizer.sigmoid([1, 2, 3]) == pytest.approx(
             [1 / (1 + math.exp(-v)) for v in [1, 2, 3]]
         )
+
+
+class TestReferenceParityEdgesR5:
+    def test_spread_indexes_arrays_like_js(self):
+        """{...[x, y]} === {"0": x, "1": y}: array-bodied JSON samples
+        must reach interface inference (review r5)."""
+        from kmamiz_tpu.core import schema
+
+        assert schema._spread([{"a": 1}, 2]) == {"0": {"a": 1}, "1": 2}
+
+    def test_svc_regex_dot_unescaped(self):
+        """The reference's /(.*).svc[.]*(.*)/ matches ANY char before
+        'svc' (review r5): a host with 'svc' but no literal dot parses
+        the same way upstream does (it MATCHES, rather than yielding
+        None service/namespace)."""
+        from kmamiz_tpu.core.urls import explode_url
+
+        out = explode_url("http://books-svc:8080/api", is_service_url=True)
+        # greedy (.*) eats through the last 'svc'... the JS regex
+        # matches "books-svc": group(1)="book" (any-char = 's'); the
+        # port must agree instead of reporting no service at all
+        assert out.service is not None
+        # JS: "books".slice(0, -1) -> "book", slice(0) -> "books"
+        assert (out.service, out.namespace) == ("book", "books")
+
+    def test_strict_json_rejects_nan_literals(self):
+        """JSON.parse throws on NaN/Infinity; the realtime body parser
+        must discard such bodies instead of schema-inferring them."""
+        from kmamiz_tpu.domain.realtime import parse_request_response_body
+
+        out = parse_request_response_body(
+            {
+                "requestContentType": "application/json",
+                "requestBody": '{"x": NaN}',
+                "responseContentType": "application/json",
+                "responseBody": '{"ok": 1}',
+            }
+        )
+        assert out["requestBody"] is None and out["requestSchema"] is None
+        assert out["responseBody"] == {"ok": 1}

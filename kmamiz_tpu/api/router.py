@@ -154,9 +154,11 @@ class Router:
     def dispatch(self, method: str, target: str, body: bytes = b"") -> Response:
         split = urlsplit(target)
         path = split.path
-        query = {
-            k: unquote(v[0]) for k, v in parse_qs(split.query).items() if v
-        }
+        # query values: parse_qs already percent-decodes ONCE — a second
+        # unquote corrupted any value containing a %-escape after one
+        # decode (the dashboard single-encodes; review r5). Express also
+        # decodes query values exactly once.
+        query = {k: v[0] for k, v in parse_qs(split.query).items() if v}
         matched_path = False
         for route in self._routes:
             m = route.pattern.match(path)
@@ -165,8 +167,15 @@ class Router:
             matched_path = True
             if route.method != method.upper():
                 continue
+            # path params decode TWICE: Express decodes captured params,
+            # and every reference handler then calls decodeURIComponent
+            # on them again (DataService.ts:57, SwaggerService.ts:24 …)
+            # — clients following that convention double-encode names
+            # containing tabs/slashes (review r5)
             params = {
-                k: unquote(v) for k, v in m.groupdict().items() if v is not None
+                k: unquote(unquote(v))
+                for k, v in m.groupdict().items()
+                if v is not None
             }
             req = Request(
                 method=method.upper(),
@@ -246,7 +255,7 @@ def make_http_handler(router: Router, cache_max_age: int = 5):
         def log_message(self, fmt: str, *args) -> None:
             logger.debug("%s " + fmt, self.address_string(), *args)
 
-        def _respond(self, response: Response) -> None:
+        def _respond(self, response: Response, head: bool = False) -> None:
             if response.status in (204, 304):  # bodyless statuses (RFC 7230)
                 body = b""
             elif response.raw_body is not None:
@@ -269,6 +278,8 @@ def make_http_handler(router: Router, cache_max_age: int = 5):
                 self.send_header("Content-Type", response.content_type)
             if "Cache-Control" not in response.headers:
                 self.send_header("Cache-Control", f"max-age={cache_max_age}")
+            # the reference mounts cors() on every route (index.ts)
+            self.send_header("Access-Control-Allow-Origin", "*")
             if use_gzip:
                 self.send_header("Content-Encoding", "gzip")
             for k, v in response.headers.items():
@@ -276,12 +287,36 @@ def make_http_handler(router: Router, cache_max_age: int = 5):
             if not bodyless:
                 self.send_header("Content-Length", str(len(body)))
             self.end_headers()
-            if not bodyless:
+            if not bodyless and not head:
                 self.wfile.write(body)
 
+        def _read_chunked(self) -> bytes:
+            """Minimal Transfer-Encoding: chunked reader — Node/Express
+            accepts chunked request bodies, and clients that stream
+            (curl --data from a pipe, HTTP libraries) send them; reading
+            only Content-Length silently treated those bodies as empty
+            (review r5)."""
+            out = bytearray()
+            while True:
+                size_line = self.rfile.readline(65536).strip()
+                size = int(size_line.split(b";", 1)[0], 16)
+                if size == 0:
+                    # drain optional trailers up to the final blank line
+                    while True:
+                        line = self.rfile.readline(65536)
+                        if line in (b"\r\n", b"\n", b""):
+                            break
+                    return bytes(out)
+                out += self.rfile.read(size)
+                self.rfile.readline(65536)  # CRLF after each chunk
+
         def _read_body(self) -> bytes:
-            length = int(self.headers.get("Content-Length", 0) or 0)
-            raw = self.rfile.read(length) if length else b""
+            te = (self.headers.get("Transfer-Encoding") or "").lower()
+            if "chunked" in te:
+                raw = self._read_chunked()
+            else:
+                length = int(self.headers.get("Content-Length", 0) or 0)
+                raw = self.rfile.read(length) if length else b""
             if self.headers.get("Content-Encoding") == "gzip":
                 raw = gzip.decompress(raw)
             return raw
@@ -297,6 +332,33 @@ def make_http_handler(router: Router, cache_max_age: int = 5):
 
         def do_GET(self) -> None:
             self._handle("GET")
+
+        def do_HEAD(self) -> None:
+            # Express answers HEAD like GET: same headers (true
+            # Content-Length included), no body bytes
+            try:
+                response = router.dispatch("GET", self.path, b"")
+            except Exception:  # noqa: BLE001
+                logger.exception("dispatch error")
+                response = Response.status_only(500)
+            self._respond(response, head=True)
+
+        def do_OPTIONS(self) -> None:
+            # CORS preflight: the reference mounts cors() globally
+            # (index.ts app.use(cors())) — a cross-origin dashboard must
+            # get its preflight answered, not a 501 (review r5)
+            self.send_response(204)
+            self.send_header("Access-Control-Allow-Origin", "*")
+            self.send_header(
+                "Access-Control-Allow-Methods",
+                "GET, POST, PUT, DELETE, OPTIONS",
+            )
+            self.send_header(
+                "Access-Control-Allow-Headers",
+                self.headers.get("Access-Control-Request-Headers")
+                or "Content-Type",
+            )
+            self.end_headers()
 
         def do_POST(self) -> None:
             self._handle("POST")

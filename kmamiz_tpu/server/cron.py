@@ -127,8 +127,13 @@ class CronExpr:
         self.months = parsed[4][0]
         # normalize 7 -> 0 for Sunday
         self.dows = frozenset(v % 7 for v in parsed[5][0])
-        self._dom_wild = parsed[3][1]
-        self._dow_wild = parsed[5][1]
+        # vixie's DOM_STAR/DOW_STAR flags are set whenever the field
+        # BEGINS with '*' — including stepped stars like */2 — and the
+        # dom/dow OR applies only when NEITHER is star-prefixed. Using
+        # "fully unrestricted" here made '0 12 */2 * 1' fire on every
+        # odd day OR Monday instead of odd-day Mondays (review r5).
+        self._dom_wild = fields[3].startswith("*")
+        self._dow_wild = fields[5].startswith("*")
         if tz is None:
             self.tzinfo = None
         else:
@@ -145,13 +150,12 @@ class CronExpr:
         dom_ok = local.day in self.days
         # Python weekday(): Monday=0; cron: Sunday=0
         dow_ok = ((local.weekday() + 1) % 7) in self.dows
-        if self._dom_wild and self._dow_wild:
-            return True
-        if self._dom_wild:
-            return dow_ok
-        if self._dow_wild:
-            return dom_ok
-        return dom_ok or dow_ok  # vixie-cron OR semantics
+        # vixie: either field star-PREFIXED (incl. stepped */N) -> both
+        # bitmasks must match (a plain * passes trivially); neither
+        # star-prefixed -> classic OR
+        if self._dom_wild or self._dow_wild:
+            return dom_ok and dow_ok
+        return dom_ok or dow_ok
 
     def matches(self, local: _dt.datetime) -> bool:
         return (

@@ -79,6 +79,9 @@ class AppContext:
             cache,
             st,
             unbounded_reads=s.read_only_mode or s.simulator_mode,
+            # read-only keeps $lte now like the reference; only the
+            # simulator is unbounded upward (MongoOperator.ts:55-66)
+            keep_upper_bound=s.read_only_mode and not s.simulator_mode,
         )
         operator = ServiceOperator(
             cache,

@@ -31,15 +31,21 @@ class ServiceUtils:
         store: Store,
         now_ms: Optional[object] = None,
         unbounded_reads: bool = False,
+        keep_upper_bound: bool = False,
     ) -> None:
         import time
 
         self._cache = cache
         self._store = store
         self._now_ms = now_ms or (lambda: time.time() * 1000)
-        # read-only / simulator modes read without a retention window
-        # (MongoOperator.ts matchMonitorMode $gte new Date(0))
+        # read-only / simulator modes read without the 30-day retention
+        # window (MongoOperator.ts: $gte new Date(0)); read-only ALSO
+        # keeps the $lte now upper bound — only SimulatorMode is
+        # unbounded upward (review r5: a snapshot with future-dated
+        # documents must filter them in monitor modes like the
+        # reference does)
         self._unbounded_reads = unbounded_reads
+        self._keep_upper_bound = keep_upper_bound
 
     # -- label mapping (ServiceUtils.ts:54-100) ------------------------------
 
@@ -52,7 +58,12 @@ class ServiceUtils:
 
         user_defined = user_defined_label.get_data()
         data_types = data_type.get_data()
-        if data_types:
+        # the reference's `if (dataTypeData)` is ALWAYS truthy (getData
+        # returns `|| []`, and an empty JS array is truthy): the rebuild
+        # must run even with zero datatypes so user-defined label rules
+        # alone can populate the mapping on a fresh or just-cleared
+        # system (review r5 — a Python empty list is falsy)
+        if data_types is not None:
             preprocessed: dict = {}
             if user_defined:
                 for rule in user_defined.get("labels", []):
@@ -93,7 +104,9 @@ class ServiceUtils:
         ms (reference ServiceUtils.ts:102 passes it straight to
         MongoOperator's timeOffset, default 30 days)."""
         if self._unbounded_reads:
-            window = None
+            # read-only: look back over the whole epoch but keep the
+            # upper bound at now; simulator: fully unbounded
+            window = self._now_ms() if self._keep_upper_bound else None
         else:
             window = (
                 time_offset_ms if time_offset_ms is not None else 30 * 86_400_000

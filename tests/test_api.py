@@ -1243,3 +1243,80 @@ class TestConcurrentCacheMutation:
         assert len(interfaces.get_data()) == n_threads * per
         assert len(swaggers.get_data()) == n_threads * per
         assert len(labels.get_data()["labels"]) == n_threads * per
+
+
+class TestRouterHttpSemantics:
+    """Review r5: the HTTP layer must match the reference's Express
+    stack — single query decode, double path-param decode, chunked
+    request bodies, CORS on every response, OPTIONS preflight, HEAD."""
+
+    def _server(self):
+        from kmamiz_tpu.api.router import (
+            ApiServer,
+            IRequestHandler,
+            Response,
+            Router,
+        )
+
+        class H(IRequestHandler):
+            def __init__(self):
+                super().__init__("t")
+                self.add_route(
+                    "get",
+                    "/echo",
+                    lambda req: Response(payload={"q": req.query}),
+                )
+                self.add_route(
+                    "get",
+                    "/p/:name",
+                    lambda req: Response(payload={"p": req.params["name"]}),
+                )
+                self.add_route(
+                    "post",
+                    "/body",
+                    lambda req: Response(
+                        payload={"len": len(req.body or b"")}
+                    ),
+                )
+
+        r = Router()
+        r.add_handler(H())
+        srv = ApiServer(r, host="127.0.0.1", port=0)
+        srv.start()
+        return srv, srv._server.server_address[1]
+
+    def test_http_layer_matches_express(self):
+        import socket
+        import urllib.request
+
+        srv, port = self._server()
+        base = f"http://127.0.0.1:{port}/api/v1/t"
+        try:
+            # query: decoded exactly ONCE (parse_qs); %2520 -> "%20"
+            with urllib.request.urlopen(base + "/echo?tag=50%2520off") as r:
+                assert json.loads(r.read())["q"]["tag"] == "50%20off"
+            # path params: decoded TWICE (Express + handler convention)
+            with urllib.request.urlopen(base + "/p/a%2509b") as r:
+                assert json.loads(r.read())["p"] == "a\tb"
+            # HEAD: true content-length, no body, CORS header
+            req = urllib.request.Request(base + "/echo", method="HEAD")
+            with urllib.request.urlopen(req) as r:
+                assert int(r.headers["Content-Length"]) > 0
+                assert r.read() == b""
+                assert r.headers["Access-Control-Allow-Origin"] == "*"
+            # OPTIONS preflight answers 204 + CORS
+            req = urllib.request.Request(base + "/echo", method="OPTIONS")
+            with urllib.request.urlopen(req) as r:
+                assert r.status == 204
+                assert r.headers["Access-Control-Allow-Origin"] == "*"
+            # chunked request body
+            s = socket.create_connection(("127.0.0.1", port))
+            s.sendall(
+                b"POST /api/v1/t/body HTTP/1.1\r\nHost: x\r\n"
+                b"Transfer-Encoding: chunked\r\n\r\n"
+                b"5\r\nhello\r\n3\r\nabc\r\n0\r\n\r\n"
+            )
+            assert b'"len": 8' in s.recv(65536)
+            s.close()
+        finally:
+            srv.stop()

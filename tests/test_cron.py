@@ -215,3 +215,21 @@ class TestSchedulerIntegration:
         )
         assert (fire.hour, fire.minute, fire.second) == (11, 0, 0)
         sched.stop()
+
+
+def test_star_prefixed_day_fields_use_vixie_and():
+    """Review r5: vixie sets DOM_STAR/DOW_STAR for any field BEGINNING
+    with '*' (including stepped */N) and then requires dom AND dow;
+    the OR applies only when neither field is star-prefixed."""
+    import datetime as dt
+
+    from kmamiz_tpu.server.cron import CronExpr
+
+    stepped = CronExpr("0 12 */2 * 1")  # odd days AND Mondays
+    assert not stepped.matches(dt.datetime(2026, 8, 5, 12, 0))   # Wed odd
+    assert not stepped.matches(dt.datetime(2026, 8, 10, 12, 0))  # Mon even
+    assert stepped.matches(dt.datetime(2026, 8, 3, 12, 0))       # Mon odd
+
+    classic = CronExpr("0 12 15 * 1")  # neither star-prefixed: OR
+    assert classic.matches(dt.datetime(2026, 8, 15, 12, 0))  # the 15th
+    assert classic.matches(dt.datetime(2026, 8, 10, 12, 0))  # a Monday

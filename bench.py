@@ -891,7 +891,7 @@ def main() -> None:
 
     # pre-generate every rep's window OUTSIDE the timed region: the metric
     # charges only DataProcessor.collect, not test-data synthesis
-    prebuilt = [tick_traces(i) for i in range(6)]
+    prebuilt = [tick_traces(i) for i in range(12)]
 
     def source(_lb, _t, _lim):
         return prebuilt.pop(0)
@@ -907,6 +907,24 @@ def main() -> None:
 
     # latency metric vs the reference's 5 s tick budget: median
     dp_tick_ms = _timed_median(one_tick, reps=5) * 1000  # first call warms
+
+    # steady-state tick: same workload shape, but every warmable layer is
+    # hot — endpoint-info/record templates, XLA executables, the graph's
+    # device-resident scorer tables — i.e. production cadence after boot
+    dp_tick_cached_ms = _timed_median(one_tick, reps=5) * 1000
+
+    # scorer read path between merges: the first read after a merge
+    # computes (full or dirty-incremental), every repeated HTTP read is an
+    # O(1) memo hit on (cache key, graph version)
+    scorer_now_ms = float(dp._now_ms())
+    dp.graph.service_scores(now_ms=scorer_now_ms)  # compute + fill memo
+    scorer_cached_read_ms = (
+        _timed_median(
+            lambda: dp.graph.service_scores(now_ms=scorer_now_ms), reps=5
+        )
+        * 1000
+    )
+    scorer_stats = dp.graph.scorer_cache_stats()
 
     # ---- restart warmth (VERDICT r4 #5b) -----------------------------------
     # two fresh subprocesses share one persistent compilation cache dir:
@@ -1006,6 +1024,8 @@ def main() -> None:
                     "e2e_stream_critical_path_ms": round(cp_ms, 1),
                     "e2e_stream_wall_ms": round(wall_s * 1000, 1),
                     "e2e_stream_chunks": N_CHUNKS,
+                    "e2e_stream_pipeline_depth": summary.get("pipeline_depth"),
+                    "e2e_stream_ring_peak": summary.get("ring_peak"),
                     "e2e_stream_drain_ms": summary["drain_ms"],
                     "e2e_stream_chunk_detail": summary["chunk_detail"],
                     "e2e_stream_cp_reps_ms": stream_cp_ms,
@@ -1055,12 +1075,20 @@ def main() -> None:
         "n_endpoints": N_ENDPOINTS,
         "n_services": N_SERVICES,
         "dp_tick_ms_2500_traces": round(dp_tick_ms, 1),
+        "dp_tick_cached_ms": round(dp_tick_cached_ms, 1),
+        "dp_scorer_cached_read_ms": round(scorer_cached_read_ms, 3),
+        "dp_scorer_cache_hit_rate": scorer_stats.get("hit_rate"),
+        "dp_scorer_cache_stats": scorer_stats,
         "dp_tick_budget_ms": 5000.0,  # the reference's realtime cadence
         **warm_boot_extras,
         "chained_iters": ITERS,
         "tunnel_rtt_ms": round(rtt * 1000, 1),
         "packing_host_ms": round(packing_host_ms, 1),
+        # raw env setting (0 = auto) AND the resolved worker count the
+        # native scan actually runs with on this host (BENCH_r05's bare
+        # `0` was ambiguous)
         "native_parse_threads": native_mod.parse_threads(),
+        "native_parse_threads_effective": native_mod.effective_parse_threads(),
         "timing_method": (
             "headline: deployed streaming route (DataProcessor."
             "ingest_raw_stream over paginated chunks at the deployed "

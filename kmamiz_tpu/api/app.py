@@ -99,7 +99,7 @@ def build_router(
         AlertHandler(ctx),
         ComparatorHandler(ctx, graph_handler=graph, data_handler=data),
         ConfigurationHandler(ctx),
-        HealthHandler(),
+        HealthHandler(ctx),
         ModelHandler(ctx),
     ]
     try:  # simulator routes only exist when the simulator package is in use

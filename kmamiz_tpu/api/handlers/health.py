@@ -1,17 +1,39 @@
-"""Health REST handler (reference src/handler/HealthService.ts)."""
+"""Health REST handler (reference src/handler/HealthService.ts).
+
+Beyond the reference's bare liveness probe, GET /timings exposes the
+process-wide step timer (per-phase tick timings: parse / pack / transfer
+/ merge / scorers) and the device graph's scorer-cache counters, so the
+pipeline can be inspected in production without a profiler attached.
+"""
 from __future__ import annotations
 
 import time
+from typing import Optional
 
 from kmamiz_tpu.api.router import IRequestHandler, Request, Response
+from kmamiz_tpu.core.profiling import step_timer
 
 
 class HealthHandler(IRequestHandler):
-    def __init__(self) -> None:
+    def __init__(self, ctx: Optional[object] = None) -> None:
         super().__init__("health")
+        self._ctx = ctx
         self.add_route("get", "/", self._health)
+        self.add_route("get", "/timings", self._timings)
 
     def _health(self, req: Request) -> Response:
         return Response(
             payload={"status": "UP", "serverTime": int(time.time() * 1000)}
         )
+
+    def _timings(self, req: Request) -> Response:
+        payload = {
+            "serverTime": int(time.time() * 1000),
+            "phases": step_timer.summary(),
+        }
+        graph = getattr(
+            getattr(self._ctx, "processor", None), "graph", None
+        )
+        if graph is not None and hasattr(graph, "scorer_cache_stats"):
+            payload["scorerCache"] = graph.scorer_cache_stats()
+        return Response(payload=payload)

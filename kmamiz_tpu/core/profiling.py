@@ -45,6 +45,19 @@ class StepTimer:
                 entry["total_ms"] += elapsed_ms
                 entry["max_ms"] = max(entry["max_ms"], elapsed_ms)
 
+    def record(self, name: str, elapsed_ms: float) -> None:
+        """Fold an externally measured duration into the same stats shape
+        as phase(): used where the region is already timed for its own
+        accounting (device transfers) or runs on a worker thread whose
+        wall time would double-count an enclosing phase."""
+        with self._lock:
+            entry = self._stats.setdefault(
+                name, {"count": 0, "total_ms": 0.0, "max_ms": 0.0}
+            )
+            entry["count"] += 1
+            entry["total_ms"] += elapsed_ms
+            entry["max_ms"] = max(entry["max_ms"], elapsed_ms)
+
     def summary(self) -> Dict[str, Dict[str, float]]:
         with self._lock:
             return {

@@ -241,40 +241,43 @@ def spans_to_batch(
     n = len(spans)
     capacity = _pad_size(n) if pad else max(n, 1)
 
-    valid = np.zeros(capacity, dtype=bool)
-    kind = np.zeros(capacity, dtype=np.int8)
-    parent_idx = np.full(capacity, -1, dtype=np.int32)
-    endpoint_id = np.zeros(capacity, dtype=np.int32)
-    service_id = np.zeros(capacity, dtype=np.int32)
-    rt_endpoint_id = np.zeros(capacity, dtype=np.int32)
-    rt_service_id = np.zeros(capacity, dtype=np.int32)
-    status_id = np.zeros(capacity, dtype=np.int32)
-    status_class = np.zeros(capacity, dtype=np.int8)
-    latency_ms = np.zeros(capacity, dtype=np.float64)
-    timestamp_us = np.zeros(capacity, dtype=np.int64)
-    trace_of = np.zeros(capacity, dtype=np.int32)
-
     # per-window memo: spans repeat a small set of naming shapes, so the
     # string formatting / URL explode / interning runs once per distinct
     # (name, url, method, istio tags) combination instead of per span
     # (~3x host ingest). Statuses cache separately (an endpoint emitting
     # five statuses still resolves its naming once). Freshest-timestamp
     # info semantics are preserved by tracking the max-ts span per
-    # endpoint and applying it after the loop.
+    # endpoint and applying it after the loop. The per-span columns
+    # accumulate in Python lists and land in the arrays as one bulk
+    # assignment each — per-element numpy scalar stores were the single
+    # largest host cost of the pack.
     naming_cache: Dict[tuple, "_NamingEntry"] = {}
     status_cache: Dict[Optional[str], Tuple[int, int]] = {}
     best_ts: Dict[int, Tuple[float, "_NamingEntry"]] = {}
 
-    for i, span in enumerate(spans):
-        valid[i] = True
-        trace_of[i] = trace_of_id[span["id"]]
+    kind_l = []
+    parent_l = []
+    eid_l = []
+    sid_l = []
+    rt_eid_l = []
+    rt_sid_l = []
+    stid_l = []
+    stcl_l = []
+    lat_l = []
+    ts_l = []
+    trace_l = []
+
+    for span in spans:
+        trace_l.append(trace_of_id[span["id"]])
         k = span.get("kind")
-        kind[i] = (
+        kind_l.append(
             KIND_SERVER if k == "SERVER" else KIND_CLIENT if k == "CLIENT" else KIND_OTHER
         )
         parent = span.get("parentId")
-        if parent is not None and parent in index_of:
-            parent_idx[i] = index_of[parent]
+        if parent is not None:
+            parent_l.append(index_of.get(parent, -1))
+        else:
+            parent_l.append(-1)
 
         tags = span.get("tags", {})
         key = (
@@ -301,19 +304,46 @@ def spans_to_batch(
             )
             status_cache[raw_status] = st
 
-        endpoint_id[i] = hit.eid
-        service_id[i] = hit.sid
-        rt_endpoint_id[i] = hit.rt_eid
-        rt_service_id[i] = hit.rt_sid
-        status_id[i], status_class[i] = st
-        latency_ms[i] = span.get("duration", 0) / 1000
+        eid_l.append(hit.eid)
+        sid_l.append(hit.sid)
+        rt_eid_l.append(hit.rt_eid)
+        rt_sid_l.append(hit.rt_sid)
+        stid_l.append(st[0])
+        stcl_l.append(st[1])
+        lat_l.append(span.get("duration", 0) / 1000)
         ts_us = span.get("timestamp", 0)
-        timestamp_us[i] = ts_us
+        ts_l.append(ts_us)
         ts_ms = ts_us / 1000
         for key_eid in (hit.eid, hit.rt_eid):
             prev = best_ts.get(key_eid)
             if prev is None or ts_ms > prev[0]:
                 best_ts[key_eid] = (ts_ms, hit)
+
+    valid = np.zeros(capacity, dtype=bool)
+    kind = np.zeros(capacity, dtype=np.int8)
+    parent_idx = np.full(capacity, -1, dtype=np.int32)
+    endpoint_id = np.zeros(capacity, dtype=np.int32)
+    service_id = np.zeros(capacity, dtype=np.int32)
+    rt_endpoint_id = np.zeros(capacity, dtype=np.int32)
+    rt_service_id = np.zeros(capacity, dtype=np.int32)
+    status_id = np.zeros(capacity, dtype=np.int32)
+    status_class = np.zeros(capacity, dtype=np.int8)
+    latency_ms = np.zeros(capacity, dtype=np.float64)
+    timestamp_us = np.zeros(capacity, dtype=np.int64)
+    trace_of = np.zeros(capacity, dtype=np.int32)
+    if n:
+        valid[:n] = True
+        kind[:n] = kind_l
+        parent_idx[:n] = parent_l
+        endpoint_id[:n] = eid_l
+        service_id[:n] = sid_l
+        rt_endpoint_id[:n] = rt_eid_l
+        rt_service_id[:n] = rt_sid_l
+        status_id[:n] = stid_l
+        status_class[:n] = stcl_l
+        latency_ms[:n] = lat_l
+        timestamp_us[:n] = ts_l
+        trace_of[:n] = trace_l
 
     _apply_best_ts(best_ts, interner)
     endpoint_infos = [i for i in interner.endpoint_infos if i is not None]

@@ -21,6 +21,10 @@ def _now_ms() -> float:
 
 
 class EndpointDependencies:
+    # raw (caller_uen, callee_uen, distance) triples for the window, captured
+    # BEFORE deprecation filtering; only set by Traces.to_endpoint_dependencies
+    window_edges = None
+
     def __init__(
         self,
         dependencies: List[dict],
@@ -61,15 +65,29 @@ class EndpointDependencies:
         return kept
 
     def to_json(self) -> List[dict]:
+        # The top-level dict is always a fresh copy (store insert_many
+        # stamps "_id" onto the documents it is handed; aliasing it would
+        # write that into this instance). The by/on ENTRY dicts are only
+        # rebuilt when they actually carry a Mongo "_id" — for the tick
+        # path (records fresh from Traces) nothing does, and the former
+        # unconditional per-entry dict rebuilds were one of the largest
+        # host costs of the DataProcessor tick. Downstream code never
+        # mutates entry dicts in place (label/trim/combine_with all build
+        # {**d, ...} copies), so sharing them is safe.
         out = []
         for dep in self._dependencies:
-            d = {k: v for k, v in dep.items() if k != "_id"}
-            d["dependingBy"] = [
-                {k: v for k, v in x.items() if k != "_id"} for x in d["dependingBy"]
-            ]
-            d["dependingOn"] = [
-                {k: v for k, v in x.items() if k != "_id"} for x in d["dependingOn"]
-            ]
+            d = dict(dep)
+            d.pop("_id", None)
+            by = d["dependingBy"]
+            if any("_id" in x for x in by):
+                d["dependingBy"] = [
+                    {k: v for k, v in x.items() if k != "_id"} for x in by
+                ]
+            on = d["dependingOn"]
+            if any("_id" in x for x in on):
+                d["dependingOn"] = [
+                    {k: v for k, v in x.items() if k != "_id"} for x in on
+                ]
             out.append(d)
         return out
 

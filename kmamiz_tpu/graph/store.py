@@ -26,6 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from kmamiz_tpu.core.interning import EndpointInterner, StringInterner
+from kmamiz_tpu.core.profiling import step_timer
 from kmamiz_tpu.core.spans import (
     KIND_SERVER,
     ROW_SLOTS,
@@ -236,6 +237,38 @@ class EndpointGraph:
         # monotonic state-change counter: API layers key scorer-payload
         # caches on it (bumped by merges and warm-start loads)
         self._version = 0
+        # -- scorer caching (ISSUE 1 tentpole) --------------------------
+        # label-epoch: bumped by invalidate_labels so cached scorer
+        # outputs keyed on it can never survive a label-mapping change
+        self._label_epoch = 0
+        # device-resident mirrors of the per-endpoint scorer-input
+        # tables / fresh mask (keyed snapshots; one upload per table
+        # change instead of one per scorer call)
+        self._ep_tables_dev = None
+        self._fresh_dev = None
+        # output memo: full cache key -> ServiceScores/CohesionScores.
+        # Entries of older graph versions are pruned on miss, so repeated
+        # HTTP reads between merges are O(1) dict hits.
+        self._scorer_memo = {}
+        # incremental-recompute bases: base key (everything but version)
+        # -> (version, outputs); consulted when the dirty-service journal
+        # covers the gap
+        self._scorer_prev = {}
+        # dirty-service journal: (version, frozenset(service_ids)) per
+        # window merge. Bounded; merges the journal cannot attribute
+        # (bulk edges, warm-start loads, label changes) raise the floor
+        # so bases older than it always take the full recompute.
+        self._dirty_journal = []
+        self._dirty_floor = 0
+        # observability: hit/miss/upload/incremental counters (read by
+        # the health handler and the bench smoke test)
+        self.scorer_stats = {
+            "hits": 0,
+            "misses": 0,
+            "uploads": 0,
+            "incremental": 0,
+            "full": 0,
+        }
         # per-endpoint host-side metadata, padded on demand
         self._ep_record = np.zeros(0, dtype=bool)
         self._ep_last_ts = np.zeros(0, dtype=np.float64)
@@ -285,6 +318,7 @@ class EndpointGraph:
         out = jax.block_until_ready([jnp.asarray(a) for a in host_arrays])
         ms = (time.perf_counter() - t0) * 1000.0
         self.last_transfer_ms = ms
+        step_timer.record("transfer", ms)
         return out, ms
 
     def _to_device_sharded(self, mesh, *host_arrays):
@@ -300,6 +334,7 @@ class EndpointGraph:
         )
         ms = (time.perf_counter() - t0) * 1000.0
         self.last_transfer_ms = ms
+        step_timer.record("transfer", ms)
         return out, ms
 
     @staticmethod
@@ -349,6 +384,7 @@ class EndpointGraph:
 
     def _merge_window_locked(self, batch: SpanBatch, stage: bool = False) -> float:
         self._version += 1
+        self._note_dirty_locked(batch)
         packed = pack_trace_rows(
             batch.trace_of, batch.n_spans, batch.parent_idx
         )
@@ -476,6 +512,65 @@ class EndpointGraph:
         self._pending = (src, dst, dist, valid_count)
         self._update_ep_metadata(batch)
         return transfer_ms
+
+    def merge_window_edges(self, edges, batch: SpanBatch):
+        """Host-edge fast path for tick merges: union a window's
+        already-computed (caller_uen, callee_uen, distance) triples — the
+        edge set the host dependency walk just produced for this same
+        window — instead of re-deriving it with the packed walk kernel.
+        Every walked (ancestor, server, distance) pair appears in some
+        SERVER record's dependingBy list, so the triples cover exactly
+        the rows the kernel would emit; the device union kernel is shared
+        with load_dependencies, keeping the merged arrays bit-exact.
+
+        Returns this call's host->device copy ms, or None when an
+        endpoint name is missing from the interner — resolved BEFORE any
+        state change, so the caller can fall back to merge_window with
+        the store untouched."""
+        with self._lock:
+            eps = self.interner.endpoints
+            src_l, dst_l, dist_l = [], [], []
+            for caller, callee, dist in edges:
+                s_id = eps.get(caller)
+                d_id = eps.get(callee)
+                if s_id is None or d_id is None:
+                    return None
+                src_l.append(s_id)
+                dst_l.append(d_id)
+                dist_l.append(dist)
+            self._version += 1
+            self._note_dirty_locked(batch)
+            self._update_ep_metadata(batch)
+            if not src_l:
+                return 0.0
+            self._finalize_pending_locked()
+            self._max_dist = max(self._max_dist, max(dist_l))
+            self._min_dist = min(self._min_dist, min(dist_l))
+            cap = _pow2(len(src_l))
+            src = np.full(cap, SENTINEL, dtype=np.int32)
+            dst = np.full(cap, SENTINEL, dtype=np.int32)
+            dist = np.full(cap, SENTINEL, dtype=np.int32)
+            src[: len(src_l)] = src_l
+            dst[: len(dst_l)] = dst_l
+            dist[: len(dist_l)] = dist_l
+            (d_src, d_dst, d_dist), transfer_ms = self._to_device(
+                src, dst, dist
+            )
+            s, d, ds, v = _merge_edges(
+                self._src,
+                self._dst,
+                self._dist,
+                self._src != SENTINEL,
+                d_src,
+                d_dst,
+                d_dist,
+                d_src != SENTINEL,
+            )
+            valid_count = v.sum()
+            if hasattr(valid_count, "copy_to_host_async"):
+                valid_count.copy_to_host_async()
+            self._pending = (s, d, ds, valid_count)
+            return transfer_ms
 
     def _update_ep_metadata(self, batch: SpanBatch) -> None:
         """Per-endpoint record/last-usage metadata (host-side, no device
@@ -864,9 +959,59 @@ class EndpointGraph:
 
     def invalidate_labels(self) -> None:
         """Call when the label mapping changes; per-endpoint tables rebuild
-        on the next scorer call."""
+        on the next scorer call. Bumps the label epoch so every cached
+        scorer output and device-resident input table keyed on the old
+        mapping is unreachable from here on."""
         with self._lock:
             self._ep_tables_cache = None
+            self._label_epoch += 1
+            self._mark_dirty_full_locked()
+
+    # -- dirty-service journal (incremental recompute bookkeeping) -----------
+
+    def _mark_dirty_full_locked(self) -> None:
+        """Forget incremental bases: the next scorer call takes the full
+        recompute. Used by every mutation the journal cannot attribute to
+        a concrete service set (bulk edge unions, warm-start loads, label
+        remaps)."""
+        self._dirty_journal.clear()
+        self._dirty_floor = self._version
+        self._scorer_memo.clear()
+        self._scorer_prev.clear()
+        self._ep_tables_dev = None
+        self._fresh_dev = None
+
+    def _note_dirty_locked(self, batch: SpanBatch) -> None:
+        """Journal the services touched by a window merge under the
+        version the merge produced. A bounded journal: overflow raises
+        the floor, so very old incremental bases degrade to the full
+        recompute instead of growing host memory."""
+        ep_svc = np.asarray(self.interner.endpoint_service_ids, dtype=np.int32)
+        ids = batch.endpoint_id[batch.valid]
+        ids = ids[(ids >= 0) & (ids < ep_svc.shape[0])]
+        touched = frozenset(int(s) for s in np.unique(ep_svc[ids]))
+        self._dirty_journal.append((self._version, touched))
+        cap = self._dirty_journal_cap()
+        while len(self._dirty_journal) > cap:
+            self._dirty_floor = self._dirty_journal.pop(0)[0]
+
+    @staticmethod
+    def _dirty_journal_cap() -> int:
+        try:
+            return max(1, int(os.environ.get("KMAMIZ_DIRTY_JOURNAL_MAX", "256")))
+        except ValueError:
+            return 256
+
+    @staticmethod
+    def _dirty_fraction_threshold() -> float:
+        """Dirty-service fraction above which incremental recompute stops
+        paying for itself (subset compaction + lane merge approach the
+        full kernel's cost). Env-tunable; 0 disables the incremental
+        path, 1 always allows it."""
+        try:
+            return float(os.environ.get("KMAMIZ_DIRTY_FRACTION", "0.25"))
+        except ValueError:
+            return 0.25
 
     def _ep_tables(self, label_of=None):
         """Padded per-endpoint service/ml/record arrays (+ padded size).
@@ -952,6 +1097,23 @@ class EndpointGraph:
         return src, dst, dist, mask, ep_service, ep_ml, ep_record, svc_cap
 
     def service_scores(self, label_of=None, now_ms=None) -> scorer_ops.ServiceScores:
+        """Cached service scorers. Repeated reads between merges are O(1)
+        memo hits; small merges take the dirty-service incremental path;
+        everything else falls back to the full kernel (bit-exact either
+        way — see service_scores_uncached for the reference pipeline).
+
+        Cache-contract note (inherited from _ep_tables_locked): distinct
+        label MAPPINGS are distinguished only via the label epoch —
+        swapping the mapping requires invalidate_labels(), which bumps it.
+        """
+        return self._scored("svc", label_of, now_ms)
+
+    def service_scores_uncached(
+        self, label_of=None, now_ms=None
+    ) -> scorer_ops.ServiceScores:
+        """The seed's per-call pipeline (host-table snapshot + fresh
+        upload + full kernel), bypassing every cache layer. Kept as the
+        parity oracle for the cached path."""
         src, dst, dist, mask, ep_service, ep_ml, ep_record, svc_cap = (
             self._scorer_inputs(label_of, now_ms)
         )
@@ -986,6 +1148,15 @@ class EndpointGraph:
         )
 
     def usage_cohesion(self, now_ms=None) -> scorer_ops.CohesionScores:
+        """Cached cohesion scorers: output memo + device-resident input
+        tables. No incremental path — the cohesion outputs carry
+        capacity-length pair ROW TABLES (lexsorted over the whole edge
+        set), which a per-service lane splice cannot patch — so a version
+        change takes the full kernel over cached device inputs."""
+        return self._scored("coh", None, now_ms)
+
+    def usage_cohesion_uncached(self, now_ms=None) -> scorer_ops.CohesionScores:
+        """Cache-bypassing parity oracle (see service_scores_uncached)."""
         src, dst, dist, mask, ep_service, _ep_ml, ep_record, svc_cap = (
             self._scorer_inputs(None, now_ms)
         )
@@ -999,6 +1170,263 @@ class EndpointGraph:
             num_services=svc_cap,
         )
 
+    # -- cached scorer pipeline (ISSUE 1 tentpole) ---------------------------
+
+    def scorer_cache_stats(self) -> dict:
+        """Counters for the scorer cache layers: memo hits/misses, host->
+        device uploads on the scorer path, incremental vs full
+        recomputes. Read by the health handler and bench."""
+        with self._lock:
+            stats = dict(self.scorer_stats)
+            stats["memo_entries"] = len(self._scorer_memo)
+            stats["journal_len"] = len(self._dirty_journal)
+        total = stats["hits"] + stats["misses"]
+        stats["hit_rate"] = (stats["hits"] / total) if total else 0.0
+        return stats
+
+    def _count_uploads(self, arrays):
+        """jnp.asarray with upload accounting: every host->device copy on
+        the scorer path routes through here so the cache counters (and
+        the tier-1 zero-upload smoke test) see them all."""
+        out = [jnp.asarray(a) for a in arrays]
+        with self._lock:
+            self.scorer_stats["uploads"] += len(out)
+        return out
+
+    def _scorer_snapshot(self, label_of, now_ms):
+        """ONE lock hold across the whole snapshot (same rationale as
+        _scorer_inputs) returning immutable edge arrays, host tables, and
+        every cache-key ingredient: graph version, label epoch, fresh-
+        mask fingerprint, dirty journal + floor."""
+        with self._lock:
+            self._finalize_pending_locked()
+            mask = self._src != SENTINEL
+            src, dst, dist = self._src, self._dst, self._dist
+            ep_service, ep_ml, ep_record, ep_cap = self._ep_tables_locked(
+                label_of
+            )
+            tab_key = self._ep_tables_cache[0] + (self._label_epoch,)
+            fresh = self._fresh_mask_locked(ep_cap, now_ms)
+            svc_cap = _pow2(max(len(self.interner.services), 1))
+            return dict(
+                src=src,
+                dst=dst,
+                dist=dist,
+                mask=mask,
+                ep_service=ep_service,
+                ep_ml=ep_ml,
+                ep_record=ep_record,
+                ep_cap=ep_cap,
+                tab_key=tab_key,
+                fresh=fresh,
+                # a no-op horizon hashes to None so the common case adds
+                # nothing to the key; an active horizon fingerprints the
+                # mask bytes, so endpoints aging past the cutoff change
+                # the key and naturally expire stale cached outputs
+                fresh_fp=None if fresh.all() else hash(fresh.tobytes()),
+                svc_cap=svc_cap,
+                n_services=len(self.interner.services),
+                version=self._version,
+                label_epoch=self._label_epoch,
+                journal=list(self._dirty_journal),
+                floor=self._dirty_floor,
+            )
+
+    def _device_tables(self, snap):
+        """Device-resident mirrors of the per-endpoint tables, uploaded
+        once per table change instead of once per scorer call; the
+        fresh-horizon gate (edge mask and record bits) applies on device
+        so it costs no extra upload."""
+        cached = self._ep_tables_dev
+        if cached is not None and cached[0] == snap["tab_key"]:
+            ep_service_d, ep_ml_d, ep_record_d = cached[1]
+        else:
+            ep_service_d, ep_ml_d, ep_record_d = self._count_uploads(
+                (snap["ep_service"], snap["ep_ml"], snap["ep_record"])
+            )
+            with self._lock:
+                self._ep_tables_dev = (
+                    snap["tab_key"],
+                    (ep_service_d, ep_ml_d, ep_record_d),
+                )
+        mask = snap["mask"]
+        if snap["fresh_fp"] is not None:
+            ep_cap = snap["ep_cap"]
+            fkey = (ep_cap, snap["fresh_fp"])
+            fcached = self._fresh_dev
+            if fcached is not None and fcached[0] == fkey:
+                fresh_d = fcached[1]
+            else:
+                (fresh_d,) = self._count_uploads((snap["fresh"],))
+                with self._lock:
+                    self._fresh_dev = (fkey, fresh_d)
+            mask = (
+                mask
+                & fresh_d[jnp.clip(snap["src"], 0, ep_cap - 1)]
+                & fresh_d[jnp.clip(snap["dst"], 0, ep_cap - 1)]
+            )
+            ep_record_d = ep_record_d & fresh_d
+        return ep_service_d, ep_ml_d, ep_record_d, mask
+
+    def _scored(self, kind: str, label_of, now_ms):
+        """Memo -> incremental -> full resolution for both scorer kinds.
+
+        Cache key tuple: (kind, label_epoch, labeled?, svc_cap, ep_cap,
+        fresh_fp, mesh_fp) + graph version. Every invalidation source is
+        a key ingredient: merges bump the version, invalidate_labels
+        bumps the epoch, fresh-horizon expiry changes the mask
+        fingerprint, capacity growth changes the caps, and a mesh
+        deploy/undeploy (or an edge capacity no longer divisible by the
+        device count) changes mesh_fp — so the sharded path consults the
+        same key and can never serve a single-device entry or vice versa.
+        """
+        snap = self._scorer_snapshot(label_of, now_ms)
+        cap = int(snap["src"].shape[0])
+        mesh = self._deploy_mesh(cap) if kind == "svc" else None
+        use_mesh = mesh is not None and cap % mesh.shape["spans"] == 0
+        base_key = (
+            kind,
+            snap["label_epoch"],
+            label_of is not None,
+            snap["svc_cap"],
+            snap["ep_cap"],
+            snap["fresh_fp"],
+            int(mesh.shape["spans"]) if use_mesh else None,
+        )
+        memo_key = base_key + (snap["version"],)
+        hit = self._scorer_memo.get(memo_key)
+        if hit is not None:
+            with self._lock:
+                self.scorer_stats["hits"] += 1
+            return hit
+        with step_timer.phase("scorers"):
+            result = self._compute_scores(
+                kind, snap, base_key, mesh if use_mesh else None
+            )
+        with self._lock:
+            self.scorer_stats["misses"] += 1
+            if len(self._scorer_memo) >= 64:
+                self._scorer_memo.clear()
+            else:
+                # keys embed the version, so entries from older graph
+                # states are unreachable — prune them on the way in
+                for k in [
+                    k
+                    for k in self._scorer_memo
+                    if k[-1] != snap["version"]
+                ]:
+                    del self._scorer_memo[k]
+            self._scorer_memo[memo_key] = result
+            if len(self._scorer_prev) >= 32:
+                self._scorer_prev.clear()
+            self._scorer_prev[base_key] = (snap["version"], result)
+        return result
+
+    def _compute_scores(self, kind, snap, base_key, mesh):
+        src, dst, dist = snap["src"], snap["dst"], snap["dist"]
+        svc_cap = snap["svc_cap"]
+        ep_service_d, ep_ml_d, ep_record_d, mask = self._device_tables(snap)
+        if mesh is not None:
+            from kmamiz_tpu.parallel.mesh import sharded_service_scores
+
+            with self._lock:
+                self.scorer_stats["full"] += 1
+            return sharded_service_scores(
+                mesh,
+                src,
+                dst,
+                dist,
+                mask,
+                ep_service_d,
+                ep_ml_d,
+                ep_record_d,
+                num_services=svc_cap,
+            )
+        prev = self._scorer_prev.get(base_key)
+        if prev is not None:
+            inc = self._incremental_scores(
+                kind, snap, prev, mask, ep_service_d, ep_ml_d, ep_record_d
+            )
+            if inc is not None:
+                return inc
+        with self._lock:
+            self.scorer_stats["full"] += 1
+        if kind == "svc":
+            return scorer_ops.service_scores(
+                src,
+                dst,
+                dist,
+                mask,
+                ep_service_d,
+                ep_ml_d,
+                ep_record_d,
+                num_services=svc_cap,
+            )
+        return scorer_ops.usage_cohesion(
+            src,
+            dst,
+            dist,
+            mask,
+            ep_service_d,
+            ep_record_d,
+            num_services=svc_cap,
+        )
+
+    def _incremental_scores(
+        self, kind, snap, prev, mask, ep_service_d, ep_ml_d, ep_record_d
+    ):
+        """Dirty-service incremental recompute: score only the edges
+        incident to services the journal marks dirty since the cached
+        base, then splice their lanes into the base (bit-exact — see the
+        module note on ops.scorers.dirty_edge_subset). Returns None when
+        ineligible, which sends the caller to the full recompute."""
+        prev_version, prev_scores = prev
+        if prev_version >= snap["version"] or prev_version < snap["floor"]:
+            return None
+        dirty = set()
+        for v, svcs in snap["journal"]:
+            if v > prev_version:
+                dirty |= svcs
+        if not dirty:
+            # merges since the base touched no service (empty windows):
+            # the edge VALUES are unchanged, so the base is still exact
+            with self._lock:
+                self.scorer_stats["incremental"] += 1
+            return prev_scores
+        if kind != "svc":
+            return None
+        threshold = self._dirty_fraction_threshold()
+        if len(dirty) > threshold * max(snap["n_services"], 1):
+            return None
+        svc_cap = snap["svc_cap"]
+        dirty_host = np.zeros(svc_cap, dtype=bool)
+        dirty_host[list(dirty)] = True
+        (dirty_d,) = self._count_uploads((dirty_host,))
+        sub_s, sub_d, sub_ds, kept = scorer_ops.dirty_edge_subset(
+            snap["src"], snap["dst"], snap["dist"], mask, ep_service_d, dirty_d
+        )
+        k = int(kept)  # the path's ONE host<-device scalar sync
+        cap = int(snap["src"].shape[0])
+        sub_cap = _pow2(max(k, 1), minimum=min(256, cap))
+        if sub_cap >= cap:
+            return None  # subset as large as the store: full wins
+        sub_s = sub_s[:sub_cap]
+        sub_d = sub_d[:sub_cap]
+        sub_ds = sub_ds[:sub_cap]
+        inc = scorer_ops.service_scores(
+            sub_s,
+            sub_d,
+            sub_ds,
+            sub_s != SENTINEL,
+            ep_service_d,
+            ep_ml_d,
+            ep_record_d,
+            num_services=svc_cap,
+        )
+        with self._lock:
+            self.scorer_stats["incremental"] += 1
+        return scorer_ops.merge_service_lanes(dirty_d, inc, prev_scores)
+
     def merge_edges(self, src, dst, dist, valid=None) -> None:
         """Bulk set-union of raw (src, dst, dist) edge arrays into the
         store — the import/warm-start/bench path. Device-resident inputs
@@ -1006,6 +1434,9 @@ class EndpointGraph:
         deferred-count capacity policy as window merges apply."""
         with self._lock:
             self._version += 1
+            # bulk edges aren't attributable to a service set without a
+            # host round trip: degrade incremental bases to full
+            self._mark_dirty_full_locked()
             self._finalize_pending_locked()
             src = jnp.asarray(src, dtype=jnp.int32)
             dst = jnp.asarray(dst, dtype=jnp.int32)
@@ -1064,6 +1495,10 @@ class EndpointGraph:
 
     def _load_dependencies_locked(self, records) -> None:
         self._version += 1
+        # record bits / recency can change even when no edges load (the
+        # early return below), so mark full BEFORE the edge scan — the
+        # trailing invalidate_labels only covers the edge-bearing path
+        self._mark_dirty_full_locked()
         src_l, dst_l, dist_l = [], [], []
         for r in records:
             info = r.get("endpoint", {})

@@ -355,6 +355,17 @@ def parse_threads() -> int:
         return 0
 
 
+def effective_parse_threads() -> int:
+    """The worker count the native scan actually runs with: the raw
+    setting when explicit, else the same hardware-concurrency-capped-at-16
+    resolution kmamiz_spans.cpp applies to 0/auto. Benchmarks report this
+    instead of the raw env so results are comparable across machines."""
+    raw = parse_threads()
+    if raw > 0:
+        return raw
+    return max(1, min(os.cpu_count() or 1, 16))
+
+
 def encode_skip_entry(tid) -> bytes:
     """One skip-set entry in the km_parse_spans_mt blob layout
     (u8 present + u32 len + utf8 bytes; None markers encode as absent).

@@ -35,7 +35,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-from kmamiz_tpu.ops.sortutil import SENTINEL, lex_unique
+from kmamiz_tpu.ops.sortutil import SENTINEL, lex_unique, scatter_compact
 
 
 class ServiceScores(NamedTuple):
@@ -426,3 +426,44 @@ def risk_scores(
         0.0,
     )
     return RiskScores(impact=impact, probability=prob, risk=risk, norm_risk=norm)
+
+
+# -- incremental (dirty-service) recompute support ---------------------------
+#
+# Every ServiceScores lane for service s is a function of ONLY the edges
+# incident to s's endpoints: direction tuples owned by s come from such
+# edges; by-degree feeds gateway_mask per ENDPOINT before the per-service
+# max, and an endpoint's degree counts only its own incident edges. So the
+# edge subset { e : src_svc(e) in D or dst_svc(e) in D } reproduces every
+# dirty service's lanes bit-for-bit: lex_unique sorts identical tuple
+# values identically regardless of input order, the int32 cumsum counts
+# are order-free, and the float32 relying-factor segment sums see the
+# dirty owner's rows in the same sorted order as the full run. Lanes of
+# NON-dirty services computed from the subset are garbage (their edges are
+# only partially present) — merge_service_lanes discards them.
+
+
+@jax.jit
+def dirty_edge_subset(src_ep, dst_ep, dist, mask, ep_service, dirty_svc):
+    """Order-preserving compaction of the edges incident to any dirty
+    service. Returns (src, dst, dist, kept_count) at the input capacity;
+    the caller syncs kept_count once and slices to a pow2 sub-capacity
+    before running the scorer kernel over the (much smaller) subset."""
+    ep_cap = ep_service.shape[0]
+    src_dirty = dirty_svc[ep_service[jnp.clip(src_ep, 0, ep_cap - 1)]]
+    dst_dirty = dirty_svc[ep_service[jnp.clip(dst_ep, 0, ep_cap - 1)]]
+    keep = mask & (src_dirty | dst_dirty)
+    (s, d, ds), kept = scatter_compact((src_ep, dst_ep, dist), keep)
+    return s, d, ds, kept.sum()
+
+
+@jax.jit
+def merge_service_lanes(
+    dirty_svc: jnp.ndarray, inc: ServiceScores, base: ServiceScores
+) -> ServiceScores:
+    """Lane-wise splice of an incremental recompute into cached scores:
+    dirty services take the subset-recomputed value (exact — see module
+    note above), everything else keeps its cached lane."""
+    return ServiceScores(
+        *[jnp.where(dirty_svc, a, b) for a, b in zip(inc, base)]
+    )

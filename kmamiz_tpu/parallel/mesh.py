@@ -27,7 +27,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+
+try:
+    from jax import shard_map
+except ImportError:  # jax<0.5 keeps it under experimental
+    from jax.experimental.shard_map import shard_map
 
 from kmamiz_tpu.core.spans import KIND_SERVER, SpanBatch, spans_to_batch
 from kmamiz_tpu.ops import window as window_ops

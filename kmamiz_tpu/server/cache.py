@@ -21,6 +21,10 @@ class Cacheable:
         self._init: Optional[Callable[[], None]] = None
         self._sync: Optional[Callable[[], None]] = None
         self._last_update = time.time() * 1000
+        # monotonic change counter: bumps on every set_data/clear, so
+        # derived caches (e.g. the labeled dependency view) can key
+        # skip-if-unchanged checks on it instead of re-deriving per read
+        self._version = 0
         # serializes compound read-modify-write updates (tag/label CRUD
         # rebuilds a list from get_data and set_datas it back). The
         # reference is safe on Node's single event loop; this port
@@ -36,6 +40,10 @@ class Cacheable:
     @property
     def last_update(self) -> float:
         return self._last_update
+
+    @property
+    def version(self) -> int:
+        return self._version
 
     @property
     def init(self) -> Optional[Callable[[], None]]:
@@ -64,6 +72,7 @@ class Cacheable:
 
     def _touch(self) -> None:
         self._last_update = time.time() * 1000
+        self._version += 1
 
     def to_json(self) -> Any:
         data = self._data

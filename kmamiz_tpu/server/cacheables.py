@@ -158,12 +158,19 @@ class CLabeledEndpointDependencies(Cacheable):
         self,
         init_data: Optional[List[dict]] = None,
         get_label: Optional[Callable[[str], Optional[str]]] = None,
+        label_version: Optional[Callable[[], int]] = None,
     ) -> None:
         super().__init__(
             self.unique_name,
             EndpointDependencies(init_data) if init_data else None,
         )
         self._get_label = get_label or (lambda name: None)
+        # when wired to the label mapping's change counter, relabel()
+        # becomes a no-op until either this cache's data or the mapping
+        # actually changed; unwired callers keep the relabel-every-read
+        # behavior (correct, just slower)
+        self._label_version = label_version
+        self._relabel_key: Optional[tuple] = None
 
     def set_data(self, update: EndpointDependencies, *args: Any) -> None:
         Cacheable.set_data(
@@ -174,7 +181,14 @@ class CLabeledEndpointDependencies(Cacheable):
         data = Cacheable.get_data(self)
         if not data:
             return
+        lv = self._label_version() if self._label_version else None
+        if lv is not None and (self.version, lv) == self._relabel_key:
+            return
         self.set_data(EndpointDependencies(data.label(self._get_label)))
+        if lv is not None:
+            # key on the post-set version: the NEXT read with the same
+            # data + mapping skips the re-trim/relabel entirely
+            self._relabel_key = (self.version, lv)
 
     def get_data(self, namespace: Optional[str] = None):
         self.relabel()

@@ -118,6 +118,7 @@ class ImportExportHandler:
             "LabeledEndpointDependencies": lambda: CLabeledEndpointDependencies(
                 init_data=init,
                 get_label=lambda n: ctx.cache.get("LabelMapping").get_label(n),
+                label_version=lambda: ctx.cache.get("LabelMapping").version,
             ),
             "UserDefinedLabel": lambda: CUserDefinedLabel(
                 init_data=init, store=store, simulator_mode=sim

@@ -132,7 +132,8 @@ class Initializer:
             CTaggedSwaggers(store=store, simulator_mode=sim),
             CTaggedDiffData(store=store, simulator_mode=sim),
             CLabeledEndpointDependencies(
-                get_label=lambda name: ctx.cache.get("LabelMapping").get_label(name)
+                get_label=lambda name: ctx.cache.get("LabelMapping").get_label(name),
+                label_version=lambda: ctx.cache.get("LabelMapping").version,
             ),
             CUserDefinedLabel(store=store, simulator_mode=sim),
             CLookBackRealtimeData(store=store, simulator_mode=sim),

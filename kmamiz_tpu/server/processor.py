@@ -17,6 +17,8 @@ graph scorers.
 """
 from __future__ import annotations
 
+import os
+import queue
 import threading
 import time
 from typing import Callable, Dict, List, Optional, Sequence
@@ -40,11 +42,47 @@ from kmamiz_tpu.domain.traces import Traces
 # that per-chunk padding/assembly overhead stays small (measured sweet
 # spot on the bench's 1.05M-span window; 2-8 all land within ~8%)
 DEFAULT_STREAM_CHUNKS = 4
+#: parsed-but-unmerged chunks the raw-ingest ring may hold (see
+#: DataProcessor._stream_depth; env override KMAMIZ_INGEST_DEPTH)
+DEFAULT_STREAM_DEPTH = 2
 from kmamiz_tpu.graph.store import EndpointGraph
 from kmamiz_tpu.ops import window as window_ops
 
 PROCESSED_TRACE_TTL_MS = 300_000  # Rust DP keeps the dedup map for 5 min
 ZIPKIN_LIMIT = 2_500
+
+
+def _host_edge_merge_enabled() -> bool:
+    """KMAMIZ_HOST_EDGE_MERGE=0 restores the packed walk kernel for tick
+    merges (kill switch for the host-edge reuse fast path)."""
+    return os.environ.get("KMAMIZ_HOST_EDGE_MERGE", "1") != "0"
+
+
+_GC_TUNED = False
+
+
+def _tune_gc() -> None:
+    """Raise the gen-0 collection threshold for the serving process. A
+    2,500-trace tick allocates ~10^5 short-lived dicts (span copies, dep
+    records, response JSON); CPython's default gen-0 threshold of 700
+    triggers a young-generation scan every few hundred of them — ~45 ms
+    of a steady tick went to collector sweeps that freed almost nothing
+    mid-tick. KMAMIZ_GC_GEN0 overrides the threshold; 0 leaves the
+    interpreter defaults untouched. Collection stays ENABLED — only the
+    cadence changes, so cycles are still reclaimed between ticks."""
+    global _GC_TUNED
+    if _GC_TUNED:
+        return
+    _GC_TUNED = True
+    try:
+        gen0 = int(os.environ.get("KMAMIZ_GC_GEN0", 50_000))
+    except ValueError:
+        gen0 = 50_000
+    if gen0 > 0:
+        import gc
+
+        _, gen1, gen2 = gc.get_threshold()
+        gc.set_threshold(gen0, gen1, gen2)
 
 
 @jax.jit
@@ -69,6 +107,7 @@ class DataProcessor:
         use_device_stats: bool = True,
         now_ms: Callable[[], float] = lambda: time.time() * 1000,
     ) -> None:
+        _tune_gc()
         self._trace_source = trace_source
         self._k8s = k8s_source
         self._use_device_stats = use_device_stats
@@ -247,6 +286,9 @@ class DataProcessor:
 
         with step_timer.phase("dependencies"):
             dependencies = traces.to_endpoint_dependencies()
+            # the raw pre-filter window edges; combine_with returns a new
+            # instance without them, so capture before combining
+            window_edges = getattr(dependencies, "window_edges", None)
             if existing_dep:
                 dependencies = dependencies.combine_with(
                     EndpointDependencies(existing_dep)
@@ -258,7 +300,16 @@ class DataProcessor:
                 batch = spans_to_batch(
                     trace_groups, interner=self.graph.interner
                 )
-                self.graph.merge_window(batch)
+                merged = None
+                if window_edges is not None and _host_edge_merge_enabled():
+                    # reuse the host walk's edge set instead of re-deriving
+                    # it with the packed walk kernel; falls back when an
+                    # endpoint is missing from the graph interner
+                    merged = self.graph.merge_window_edges(
+                        window_edges, batch
+                    )
+                if merged is None:
+                    self.graph.merge_window(batch)
             self._observe_history(batch, req_time)
 
         with step_timer.phase("combine_assemble"), profiling.trace(
@@ -778,44 +829,77 @@ class DataProcessor:
                     self._processed[tid] = when_ms
             self._prune_processed_locked(when_ms)
 
-    # -- streaming raw ingest: parse(k+1) overlaps merge(k) ------------------
+    # -- streaming raw ingest: depth-k ring, parse(k+1..k+depth) ahead -------
 
-    def ingest_raw_stream(self, chunks) -> dict:
+    @staticmethod
+    def _stream_depth(depth: Optional[int] = None) -> int:
+        """Bounded-ring depth for ingest_raw_stream: how many parsed
+        chunks may sit between the fetch/parse stage and the
+        pack/transfer stage. depth=1 reproduces the former one-in-flight
+        pipeline; deeper rings let a fast parser absorb device-merge
+        jitter (each waiting chunk pins its SpanBatch host arrays, so the
+        bound is a memory knob too)."""
+        if depth is None:
+            try:
+                depth = int(
+                    os.environ.get("KMAMIZ_INGEST_DEPTH", DEFAULT_STREAM_DEPTH)
+                )
+            except ValueError:
+                depth = DEFAULT_STREAM_DEPTH
+        return max(1, depth)
+
+    def ingest_raw_stream(self, chunks, depth: Optional[int] = None) -> dict:
         """Pipelined uncapped ingest over an iterable of raw Zipkin
         responses (e.g. paginated fetches, or km_split_groups over one
-        giant buffer): the native parse of chunk k+1 runs on a worker
-        thread (ctypes releases the GIL) while chunk k packs, transfers,
-        and merges into the device graph — a bounded producer-consumer
-        with one chunk in flight, so parse wall time hides the device
-        round trips instead of serializing behind them (VERDICT r2 #1b).
+        giant buffer), structured as three decoupled stages around a
+        bounded ring of `depth` parsed chunks (KMAMIZ_INGEST_DEPTH,
+        default 2):
+
+        1. fetch/parse (worker thread): pulls the next raw chunk — so a
+           paginated source's HTTP fetch overlaps everything downstream —
+           native-parses it (ctypes releases the GIL), registers its kept
+           trace ids, and enqueues the batch;
+        2. pack/transfer (this thread): pops batches in order, packs
+           trace rows, and transfers + dispatches the walk kernel
+           (merge_window stage=True);
+        3. device-merge (device queue): staged windows collapse into
+           async pre-unions while later chunks stream, and the final
+           drain resolves ONE union sort over everything.
+
+        With depth > 1 the parser can run ahead of a slow device merge by
+        up to `depth` chunks instead of stalling after one, so parse wall
+        time hides the device round trips (VERDICT r2 #1b generalized).
 
         Dedup semantics match chunk-by-chunk ingest_raw_window exactly:
         chunk k's kept trace ids register BEFORE chunk k+1's parse
-        snapshots the processed set. The span-id map (duplicate-id
-        collapse + parent resolution) is scoped PER CHUNK — the same
-        scope the reference has under paginated Zipkin fetches, where
-        each page is a separate response with its own span map
-        (Traces.ts builds its Map per response). Span ids are unique
-        per trace in real Zipkin data and groups never split across
-        chunks, so graph results (edges/endpoints) are identical to the
-        one-shot path; only adversarial cross-trace id collisions can
-        change the processed-row count.
+        snapshots the processed set (both happen in order on the single
+        fetch/parse worker). The span-id map (duplicate-id collapse +
+        parent resolution) is scoped PER CHUNK — the same scope the
+        reference has under paginated Zipkin fetches, where each page is
+        a separate response with its own span map (Traces.ts builds its
+        Map per response). Span ids are unique per trace in real Zipkin
+        data and groups never split across chunks, so graph results
+        (edges/endpoints) are identical to the one-shot path; only
+        adversarial cross-trace id collisions can change the
+        processed-row count.
 
         Failure semantics: per-chunk at-least-once. A malformed LATER
-        chunk raises after earlier chunks already merged and registered
-        their trace ids (the set-union edge store makes re-merges benign;
-        the one-shot ingest_raw_window path stays all-or-nothing).
+        chunk rides the ring in order, so every chunk parsed before it
+        merges and registers first, THEN the error raises (the set-union
+        edge store makes re-merges benign; the one-shot
+        ingest_raw_window path stays all-or-nothing).
 
         Returns the ingest_raw_window totals plus overlap accounting
-        (parse_ms / merge_ms / saved_ms) and a per-chunk phase breakdown
-        (`chunk_detail`: spans / parse_ms / merge_ms / transfer_ms per
-        chunk, plus `drain_ms` for the final device sync) — enough to
-        reconstruct the pipeline's critical path with the host->device
-        copy priced at any bandwidth (bench.py does exactly that)."""
-        from concurrent.futures import ThreadPoolExecutor
-
+        (parse_ms / merge_ms / saved_ms), `pipeline_depth` and the peak
+        ring occupancy actually reached (`ring_peak`), and a per-chunk
+        phase breakdown (`chunk_detail`: spans / parse_ms / merge_ms /
+        transfer_ms per chunk, plus `drain_ms` for the final device
+        sync) — enough to reconstruct the pipeline's critical path with
+        the host->device copy priced at any bandwidth (bench.py does
+        exactly that)."""
         from kmamiz_tpu.core.spans import raw_spans_to_batch
 
+        depth = self._stream_depth(depth)
         wall_t0 = time.perf_counter()  # wall accounting: monotonic, not
         # the injectable domain clock (a virtual clock frozen mid-call
         # would zero ms/saved_ms)
@@ -823,51 +907,89 @@ class DataProcessor:
         merge_ms = 0.0
         totals = {"spans": 0, "traces": 0, "chunks": 0}
         chunk_detail = []
+        ring: "queue.Queue" = queue.Queue(maxsize=depth)
+        ring_peak = 0
+        stop = threading.Event()  # consumer bail-out: unblock the worker
 
-        it = iter(chunks)
+        def _put(item) -> bool:
+            while not stop.is_set():
+                try:
+                    ring.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
 
-        def _fetch_and_parse():
-            """Pull the NEXT chunk from the iterator and parse it — both
-            on the worker thread, so a paginated source's HTTP fetch
-            overlaps the device merge along with the parse (the iterator
-            has exactly one consumer at a time: the single in-flight
-            task). parse_ms therefore includes the source fetch.
-            Returns None when the source is exhausted."""
+        def _producer() -> None:
+            """Stage 1: fetch + parse + dedup-register, strictly in chunk
+            order. parse_ms per chunk includes the source fetch (the
+            iterator has exactly one consumer: this thread)."""
             try:
-                raw = next(it)
-            except StopIteration:
-                return None
-            with self._dedup_lock:
-                skipset = self._skipset_locked()
-                skip_blob = (
-                    None if skipset is not None else self._skip_blob_locked()
-                )
-                session = self._raw_session_locked()
-            t0 = time.perf_counter()
-            out = raw_spans_to_batch(
-                raw,
-                interner=self.graph.interner,
-                skip_blob=skip_blob,
-                skipset=skipset,
-                session=session,
-            )
-            return out, (time.perf_counter() - t0) * 1000.0
-
-        with ThreadPoolExecutor(max_workers=1) as pool:
-            current = _fetch_and_parse()
-            while current is not None:
-                out, dt = current
-                parse_ms += dt
-                if out is None:
-                    raise ValueError(
-                        "native span loader unavailable or malformed payload"
+                it = iter(chunks)
+                while not stop.is_set():
+                    try:
+                        raw = next(it)
+                    except StopIteration:
+                        break
+                    with self._dedup_lock:
+                        skipset = self._skipset_locked()
+                        skip_blob = (
+                            None
+                            if skipset is not None
+                            else self._skip_blob_locked()
+                        )
+                        session = self._raw_session_locked()
+                    t0 = time.perf_counter()
+                    out = raw_spans_to_batch(
+                        raw,
+                        interner=self.graph.interner,
+                        skip_blob=skip_blob,
+                        skipset=skipset,
+                        session=session,
                     )
-                batch, kept = out
-                # registration precedes the next fetch+parse submission,
-                # so chunk k+1's parse snapshots a processed set that
-                # already includes chunk k
-                self._register_processed(kept, self._now_ms())
-                fut = pool.submit(_fetch_and_parse)
+                    dt = (time.perf_counter() - t0) * 1000.0
+                    step_timer.record("ingest_parse", dt)
+                    if out is None:
+                        _put(
+                            (
+                                "error",
+                                ValueError(
+                                    "native span loader unavailable or "
+                                    "malformed payload"
+                                ),
+                                dt,
+                            )
+                        )
+                        return
+                    batch, kept = out
+                    # registration precedes the next iteration's parse,
+                    # so chunk k+1 snapshots a processed set that already
+                    # includes chunk k — regardless of ring depth
+                    self._register_processed(kept, self._now_ms())
+                    if not _put(("chunk", (batch, kept), dt)):
+                        return
+            except BaseException as err:  # source iterator raised: the
+                # former ThreadPoolExecutor surfaced it via fut.result()
+                _put(("error", err, 0.0))
+                return
+            _put(("end", None, 0.0))
+
+        worker = threading.Thread(
+            target=_producer, name="ingest-raw-parse", daemon=True
+        )
+        worker.start()
+        pending_err: Optional[BaseException] = None
+        try:
+            while True:
+                ring_peak = max(ring_peak, ring.qsize())
+                tag, payload, dt = ring.get()
+                if tag == "end":
+                    break
+                parse_ms += dt
+                if tag == "error":
+                    pending_err = payload
+                    break
+                batch, kept = payload
                 t0 = time.perf_counter()
                 chunk_transfer_ms = 0.0
                 if batch.n_spans:
@@ -880,6 +1002,7 @@ class DataProcessor:
                             batch, stage=True
                         )
                 chunk_merge_ms = (time.perf_counter() - t0) * 1000.0
+                step_timer.record("ingest_merge", chunk_merge_ms)
                 merge_ms += chunk_merge_ms
                 chunk_detail.append(
                     {
@@ -892,7 +1015,11 @@ class DataProcessor:
                 totals["spans"] += batch.n_spans
                 totals["traces"] += len(kept)
                 totals["chunks"] += 1
-                current = fut.result()
+        finally:
+            stop.set()
+            worker.join(timeout=30.0)
+        if pending_err is not None:
+            raise pending_err
 
         # the deferred merge chain resolves here: n_edges blocks on the
         # device queue, so charge it explicitly as the pipeline's drain
@@ -910,6 +1037,8 @@ class DataProcessor:
             "parse_ms": round(parse_ms, 1),
             "merge_ms": round(merge_ms, 1),
             "saved_ms": round(max(0.0, parse_ms + merge_ms - wall_ms), 1),
+            "pipeline_depth": depth,
+            "ring_peak": ring_peak,
         }
 
     def ingest_from_zipkin(

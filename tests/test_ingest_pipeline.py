@@ -1,0 +1,201 @@
+"""Depth-k ingest_raw_stream ring semantics (ISSUE 1 tentpole 3).
+
+test_native_spans.py covers the streaming path against the real native
+loader (and skips wholesale when the extension isn't built). These tests
+pin the PIPELINE semantics — depth knob, ring bounds, chunk-ordered dedup
+registration, per-chunk at-least-once failure — with a pure-Python parser
+standing in for raw_spans_to_batch, so they run everywhere: json.loads +
+the documented skip-blob dedup + spans_to_batch, i.e. exactly the
+semantics the native scanner is tested to be byte-identical to."""
+from __future__ import annotations
+
+import json
+import struct
+
+import pytest
+
+from kmamiz_tpu.core import spans as spans_mod
+from kmamiz_tpu.core.spans import spans_to_batch
+from kmamiz_tpu.server.processor import DataProcessor
+
+
+def mk_span(tid, sid, parent=None, **over):
+    s = {
+        "traceId": tid,
+        "id": sid,
+        "parentId": parent,
+        "kind": "SERVER",
+        "name": "svc.ns.svc.cluster.local:80/*",
+        "timestamp": 1_700_000_000_000_000,
+        "duration": 1000,
+        "tags": {
+            "http.method": "GET",
+            "http.status_code": "200",
+            "http.url": "http://svc.ns.svc.cluster.local/api",
+            "istio.canonical_revision": "v1",
+            "istio.canonical_service": "svc",
+            "istio.mesh_id": "cluster.local",
+            "istio.namespace": "ns",
+        },
+    }
+    s.update(over)
+    return s
+
+
+def _decode_skip_blob(blob):
+    """Inverse of native.encode_skip_entry under the '<I count' header."""
+    ids = set()
+    if not blob:
+        return ids
+    (count,) = struct.unpack_from("<I", blob, 0)
+    off = 4
+    for _ in range(count):
+        present, ln = struct.unpack_from("<BI", blob, off)
+        off += 5
+        if present:
+            ids.add(blob[off : off + ln].decode())
+            off += ln
+        else:
+            ids.add(None)
+    return ids
+
+
+def _fake_raw_parser(
+    raw,
+    interner=None,
+    skip_blob=None,
+    skipset=None,
+    session=None,
+    **kw,
+):
+    try:
+        groups = json.loads(raw)
+    except Exception:
+        return None
+    if not isinstance(groups, list) or any(
+        not isinstance(g, list) for g in groups
+    ):
+        return None
+    seen = _decode_skip_blob(skip_blob)
+    kept_groups, kept = [], []
+    for g in groups:
+        tid = g[0].get("traceId") if g else None
+        if tid in seen:
+            continue
+        seen.add(tid)
+        kept_groups.append(g)
+        kept.append(tid)
+    return spans_to_batch(kept_groups, interner=interner), kept
+
+
+@pytest.fixture
+def dp(monkeypatch):
+    """A DataProcessor whose raw-ingest parse is the pure-Python model:
+    the blob dedup path is forced so the fake sees the processed set the
+    same way the native blob path does."""
+    monkeypatch.setattr(spans_mod, "raw_spans_to_batch", _fake_raw_parser)
+
+    def build():
+        p = DataProcessor(trace_source=lambda *a: [], use_device_stats=False)
+        p._skipset_locked = lambda: None
+        p._raw_session_locked = lambda: None
+        return p
+
+    return build
+
+
+def svc_chunks(n_traces=36, n_chunks=6):
+    """n_traces two-span traces (distinct services -> distinct edges),
+    split into n_chunks standalone raw responses."""
+    groups = []
+    for t in range(n_traces):
+        parent = mk_span(f"t{t}", f"p{t}")
+        child = mk_span(
+            f"t{t}",
+            f"c{t}",
+            parent=f"p{t}",
+            name=f"down{t % 5}.ns.svc.cluster.local:80/*",
+        )
+        child["tags"]["istio.canonical_service"] = f"down{t % 5}"
+        child["tags"]["http.url"] = f"http://down{t % 5}.ns/api/{t % 3}"
+        groups.append([parent, child])
+    per = -(-n_traces // n_chunks)
+    return groups, [
+        json.dumps(groups[i : i + per]).encode()
+        for i in range(0, n_traces, per)
+    ]
+
+
+@pytest.mark.parametrize("depth", [1, 2, 4])
+def test_depth_k_matches_one_shot(dp, depth):
+    groups, chunks = svc_chunks()
+    whole = dp().ingest_raw_window(json.dumps(groups).encode())
+
+    streamed_dp = dp()
+    out = streamed_dp.ingest_raw_stream(chunks, depth=depth)
+    assert out["spans"] == whole["spans"] == 72
+    assert out["traces"] == whole["traces"] == 36
+    assert out["edges"] == whole["edges"]
+    assert out["endpoints"] == whole["endpoints"]
+    assert out["chunks"] == len(chunks)
+    assert out["pipeline_depth"] == depth
+    assert 0 <= out["ring_peak"] <= depth
+    # the dedup maps converged: a second pass over the same window is a no-op
+    again = streamed_dp.ingest_raw_stream(chunks, depth=depth)
+    assert again["spans"] == 0 and again["traces"] == 0
+
+
+def test_depth_env_knob(dp, monkeypatch):
+    _, chunks = svc_chunks(n_traces=12, n_chunks=3)
+    monkeypatch.setenv("KMAMIZ_INGEST_DEPTH", "3")
+    assert dp().ingest_raw_stream(chunks)["pipeline_depth"] == 3
+    # explicit arg beats the env; bogus env falls back to the default
+    assert dp().ingest_raw_stream(chunks, depth=1)["pipeline_depth"] == 1
+    monkeypatch.setenv("KMAMIZ_INGEST_DEPTH", "banana")
+    assert dp().ingest_raw_stream(chunks)["pipeline_depth"] == 2
+    monkeypatch.setenv("KMAMIZ_INGEST_DEPTH", "-4")
+    assert dp().ingest_raw_stream(chunks)["pipeline_depth"] == 1
+
+
+def test_dedup_registration_is_chunk_ordered(dp):
+    """Chunk k's kept ids register before chunk k+1's parse snapshots the
+    processed set — at EVERY depth, because fetch/parse/register stay on
+    one worker in order. The duplicate trace in chunk 3 must drop even
+    while chunks 1-3 can all sit in the ring together."""
+    c1 = json.dumps([[mk_span("tX", "a")], [mk_span("tY", "b")]]).encode()
+    c2 = json.dumps([[mk_span("tZ", "c")]]).encode()
+    c3 = json.dumps([[mk_span("tX", "d")], [mk_span("tW", "e")]]).encode()
+    out = dp().ingest_raw_stream([c1, c2, c3], depth=4)
+    assert out["traces"] == 4
+    assert out["spans"] == 4
+
+
+@pytest.mark.parametrize("depth", [1, 3])
+def test_malformed_later_chunk_at_least_once(dp, depth):
+    """The documented failure contract survives the deeper ring: the error
+    token rides the ring IN ORDER, so every chunk parsed before it merges
+    and registers first, then the error surfaces."""
+    good1 = json.dumps([[mk_span("tA", "a")]]).encode()
+    good2 = json.dumps([[mk_span("tB", "b")]]).encode()
+    bad = b'[[{"traceId": "tC", "id": '  # truncated
+    p = dp()
+    with pytest.raises(ValueError):
+        p.ingest_raw_stream([good1, good2, bad], depth=depth)
+    with p._dedup_lock:
+        assert "tA" in p._processed and "tB" in p._processed
+    assert len(p.graph.interner.endpoints) > 0
+
+
+def test_source_iterator_error_propagates(dp):
+    """An exception from the chunk SOURCE (paginated fetch) surfaces to
+    the caller after the chunks before it landed."""
+
+    def chunks():
+        yield json.dumps([[mk_span("tA", "a")]]).encode()
+        raise RuntimeError("zipkin went away")
+
+    p = dp()
+    with pytest.raises(RuntimeError, match="zipkin went away"):
+        p.ingest_raw_stream(chunks(), depth=2)
+    with p._dedup_lock:
+        assert "tA" in p._processed

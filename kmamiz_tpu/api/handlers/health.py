@@ -36,4 +36,7 @@ class HealthHandler(IRequestHandler):
         )
         if graph is not None and hasattr(graph, "scorer_cache_stats"):
             payload["scorerCache"] = graph.scorer_cache_stats()
+        from kmamiz_tpu.models import serving
+
+        payload["modelServe"] = serving.serve_stats()
         return Response(payload=payload)

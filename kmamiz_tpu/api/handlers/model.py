@@ -197,9 +197,13 @@ class ModelHandler(IRequestHandler):
         # dict wholesale once per hour, while dashboards poll every few
         # seconds — re-running the model forward + full-endpoint JSON
         # assembly per poll would be thousands of redundant forwards per
-        # hour at 10k endpoints
+        # hour at 10k endpoints. Keyed on the fold's (graph version,
+        # label epoch, hour) cache_key — the scorer cache's keying
+        # discipline — with snapshot identity as both tiebreak and
+        # fallback for restored snapshots that predate the key.
+        snap_key = snap.get("cache_key") or id(snap)
         cached = self._forecast_cache
-        if cached is not None and cached[0] is snap:
+        if cached is not None and (cached[0] is snap or cached[4] == snap_key):
             # pre-encoded (and pre-gzipped) bytes ride the response so
             # polls skip both the ~1 MB json.dumps and the per-request
             # gzip; .payload stays for in-process dispatch consumers
@@ -219,19 +223,16 @@ class ModelHandler(IRequestHandler):
                     )
                 },
             )
-        import jax
-        import jax.numpy as jnp
+        from kmamiz_tpu.models import serving
 
         names = snap["names"]
-        pred_lat, logit = model.forward(
-            params,
-            jnp.asarray(feats, jnp.float32),
-            snap["src"],
-            snap["dst"],
-            snap["mask"],
+        # bucket-padded jitted forward (models/serving.py): the compiled
+        # program is keyed by pow2 capacity buckets, so a growing endpoint
+        # set recompiles O(log N) times instead of every fold; timings
+        # land on /timings as model_forward + modelServe
+        lat_ms, prob = serving.forecast_forward(
+            params, feats, snap["src"], snap["dst"], snap["mask"], model
         )
-        prob = np.asarray(jax.nn.sigmoid(logit))
-        lat_ms = np.expm1(np.asarray(pred_lat))
         order = np.argsort(-prob)
         endpoints = [
             {
@@ -250,5 +251,5 @@ class ModelHandler(IRequestHandler):
 
         encoded = json.dumps(payload).encode()
         zipped = gzip.compress(encoded)
-        self._forecast_cache = (snap, payload, encoded, zipped)
+        self._forecast_cache = (snap, payload, encoded, zipped, snap_key)
         return Response(payload=payload, raw_body=encoded, raw_gzip=zipped)

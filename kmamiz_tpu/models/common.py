@@ -48,6 +48,19 @@ def make_loss_fn(forward, pos_weight: float = 1.0):
     return loss_fn
 
 
+def concat_embedding(features: jnp.ndarray, embedding) -> jnp.ndarray:
+    """Concatenate the learned node-identity embedding to the feature
+    block, zero-padding it when the features carry bucket-padded node rows
+    (models/stacked.py): padded nodes are masked out of the loss and have
+    no edges, so a zero identity is exact."""
+    if embedding is None:
+        return features
+    pad = features.shape[0] - embedding.shape[0]
+    if pad:
+        embedding = jnp.pad(embedding, ((0, pad), (0, 0)))
+    return jnp.concatenate([features, embedding], axis=1)
+
+
 def make_optimizer(lr: float = 1e-3):
     return optax.adamw(lr, weight_decay=1e-4)
 

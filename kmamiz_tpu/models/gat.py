@@ -145,9 +145,7 @@ def forward(
     edge_mask: jnp.ndarray,
 ):
     """Two attention layers -> (latency prediction [N], anomaly logits [N])."""
-    x = features
-    if params.embedding is not None:
-        x = jnp.concatenate([features, params.embedding], axis=1)
+    x = common.concat_embedding(features, params.embedding)
     h1 = _layer(
         x, src_ep, dst_ep, edge_mask,
         params.w_1, params.a_src_1, params.a_dst_1,

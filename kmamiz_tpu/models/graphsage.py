@@ -117,13 +117,40 @@ def init_params(
     )
 
 
+def neighbor_degree(
+    num_nodes: int,
+    src_ep: jnp.ndarray,  # [E]
+    dst_ep: jnp.ndarray,  # [E]
+    edge_mask: jnp.ndarray,  # [E]
+    dtype=jnp.float32,
+) -> jnp.ndarray:
+    """Per-node masked degree over both edge directions [N].
+
+    Depends only on the edge topology, not the layer states — forward
+    computes it ONCE and both SAGE layers divide by it, instead of each
+    neighbor_mean re-running two segment_sums of the same mask."""
+    n = num_nodes
+    src = jnp.where(edge_mask, src_ep, n)
+    dst = jnp.where(edge_mask, dst_ep, n)
+    deg = jax.ops.segment_sum(
+        edge_mask.astype(dtype), src, num_segments=n + 1
+    )[:-1]
+    return deg + jax.ops.segment_sum(
+        edge_mask.astype(dtype), dst, num_segments=n + 1
+    )[:-1]
+
+
 def neighbor_mean(
     h: jnp.ndarray,  # [N, F]
     src_ep: jnp.ndarray,  # [E]
     dst_ep: jnp.ndarray,  # [E]
     edge_mask: jnp.ndarray,  # [E]
+    deg: jnp.ndarray = None,  # [N] precomputed neighbor_degree
 ) -> jnp.ndarray:
-    """Mean of neighbor states over both edge directions (segment mean)."""
+    """Mean of neighbor states over both edge directions (segment mean).
+
+    deg omitted keeps the self-contained single-layer form; callers with
+    several layers over one topology (forward) pass the hoisted degree."""
     n = h.shape[0]
     src = jnp.where(edge_mask, src_ep, n)
     dst = jnp.where(edge_mask, dst_ep, n)
@@ -131,12 +158,8 @@ def neighbor_mean(
     src_h = h[jnp.minimum(src, n - 1)] * edge_mask[:, None]
     agg = jax.ops.segment_sum(dst_h, src, num_segments=n + 1)[:-1]
     agg = agg + jax.ops.segment_sum(src_h, dst, num_segments=n + 1)[:-1]
-    deg = jax.ops.segment_sum(
-        edge_mask.astype(h.dtype), src, num_segments=n + 1
-    )[:-1]
-    deg = deg + jax.ops.segment_sum(
-        edge_mask.astype(h.dtype), dst, num_segments=n + 1
-    )[:-1]
+    if deg is None:
+        deg = neighbor_degree(n, src_ep, dst_ep, edge_mask, dtype=h.dtype)
     return agg / jnp.maximum(deg, 1.0)[:, None]
 
 
@@ -148,14 +171,13 @@ def forward(
     edge_mask: jnp.ndarray,
 ):
     """Two SAGE layers -> (latency prediction [N], anomaly logits [N])."""
-    x = features
-    if params.embedding is not None:
-        x = jnp.concatenate([features, params.embedding], axis=1)
-    agg1 = neighbor_mean(x, src_ep, dst_ep, edge_mask)
+    x = _common.concat_embedding(features, params.embedding)
+    deg = neighbor_degree(features.shape[0], src_ep, dst_ep, edge_mask)
+    agg1 = neighbor_mean(x, src_ep, dst_ep, edge_mask, deg)
     h1 = jax.nn.relu(
         x @ params.w_self_1 + agg1 @ params.w_neigh_1 + params.b_1
     )
-    agg2 = neighbor_mean(h1, src_ep, dst_ep, edge_mask)
+    agg2 = neighbor_mean(h1, src_ep, dst_ep, edge_mask, deg)
     h2 = jax.nn.relu(h1 @ params.w_self_2 + agg2 @ params.w_neigh_2 + params.b_2)
     latency = (
         h2 @ params.w_latency + features @ params.w_latency_skip + params.b_latency

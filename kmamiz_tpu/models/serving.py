@@ -1,0 +1,98 @@
+"""Shape-stable jitted inference for the forecast route.
+
+The live endpoint set grows as traffic discovers new routes, so a naive
+`jit(model.forward)` on the raw snapshot arrays recompiles on every
+endpoint/edge-count change — a multi-second stall on the serving thread
+each time the graph grows by one endpoint. This module gives
+/model/forecast (api/handlers/model.py) the same discipline the training
+stack (models/stacked.py) and the graph store use: node and edge counts
+round up to power-of-two CAPACITY BUCKETS with masked padding, so the
+compiled program is keyed by the bucket (changes O(log N) times over the
+deployment's life, not O(N)), and the forward runs as one jitted call —
+sigmoid and expm1 included — returning host arrays sliced to the real
+endpoint count.
+
+Counters (per-call timings via core.profiling.step_timer under
+"model_forward", plus call/compile/bucket stats from serve_stats())
+surface on GET /timings next to PR 1's scorer-cache report.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from functools import lru_cache
+from typing import Tuple
+
+import numpy as np
+
+from kmamiz_tpu.core.profiling import step_timer
+from kmamiz_tpu.core.spans import _pad_size
+
+_lock = threading.Lock()
+_stats = {
+    "calls": 0,
+    "programs": 0,  # distinct (model, bucket) programs entered
+    "last_ms": 0.0,
+    "last_bucket": None,  # (bucket_nodes, bucket_edges) most recently served
+}
+_programs = set()
+
+
+@lru_cache(maxsize=8)
+def _jitted_forward(model):
+    import jax
+    import jax.numpy as jnp
+
+    def fwd(params, features, src, dst, mask):
+        lat, logit = model.forward(params, features, src, dst, mask)
+        return jnp.expm1(lat), jax.nn.sigmoid(logit)
+
+    return jax.jit(fwd)
+
+
+def forecast_forward(
+    params, features, src, dst, mask, model
+) -> Tuple[np.ndarray, np.ndarray]:
+    """One bucket-padded jitted forward -> (predicted latency ms [N],
+    anomaly probability [N]) as host float arrays for the REAL N rows."""
+    import jax.numpy as jnp
+
+    features = np.asarray(features, dtype=np.float32)
+    n, f = features.shape
+    e = int(np.asarray(src).shape[0])
+    nb, eb = _pad_size(n), _pad_size(e)
+
+    feats = np.zeros((nb, f), dtype=np.float32)
+    feats[:n] = features
+    src_p = np.zeros(eb, dtype=np.int32)
+    dst_p = np.zeros(eb, dtype=np.int32)
+    mask_p = np.zeros(eb, dtype=bool)
+    src_p[:e] = np.asarray(src, dtype=np.int32)
+    dst_p[:e] = np.asarray(dst, dtype=np.int32)
+    mask_p[:e] = np.asarray(mask, dtype=bool)
+
+    t0 = time.perf_counter()
+    with step_timer.phase("model_forward"):
+        lat_ms, prob = _jitted_forward(model)(
+            params,
+            jnp.asarray(feats),
+            jnp.asarray(src_p),
+            jnp.asarray(dst_p),
+            jnp.asarray(mask_p),
+        )
+        lat_ms = np.asarray(lat_ms)[:n]
+        prob = np.asarray(prob)[:n]
+    elapsed_ms = (time.perf_counter() - t0) * 1000
+    with _lock:
+        _stats["calls"] += 1
+        _stats["last_ms"] = elapsed_ms
+        _stats["last_bucket"] = [nb, eb]
+        _programs.add((model.__name__, f, nb, eb))
+        _stats["programs"] = len(_programs)
+    return lat_ms, prob
+
+
+def serve_stats() -> dict:
+    """Serving-forward counters for GET /timings (modelServe section)."""
+    with _lock:
+        return dict(_stats)

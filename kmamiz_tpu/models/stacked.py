@@ -1,0 +1,375 @@
+"""Device-resident stacked dataset + scan-fused GraphSAGE epochs.
+
+The host-driven trainer (models/trainer.py pre-stack) ran one jitted step
+per slot per epoch over ragged per-slot arrays: S dispatches per epoch,
+each paying a host round trip, plus a fresh host->device upload of every
+slot on every use — exactly the dispatch-bound pattern dense accelerators
+punish ("Fast Training of Sparse Graph Neural Networks on Dense
+Hardware", PAPERS.md). This module makes the dataset and the epoch loop
+device-native:
+
+- `stack_dataset` pads a GraphDataset's slots to CAPACITY BUCKETS — node
+  and edge counts rounded up to powers of two, the same discipline the
+  graph store applies to its edge arrays (graph/store.py) and the span
+  batches to their rows (core/spans._pad_size) — and stacks all slots
+  into [S, N, ...] device arrays uploaded ONCE. Bucketing keeps compiled
+  programs reusable as graphs grow; padded nodes/edges are masked so real
+  outputs are unchanged.
+- `epoch_runner` returns a single jitted program running WHOLE EPOCHS:
+  `lax.scan` over the stacked slots (one optimizer update per slot, the
+  legacy loop's exact schedule) nested in a scan over epochs, with
+  params/optimizer state donated — n_epochs * n_slots steps in ONE
+  dispatch instead of n_epochs * n_slots dispatches.
+- `dp_epoch_runner` is the data-parallel variant: slots grouped into
+  microbatches whose per-slot grads are vmapped and averaged (and, with
+  a mesh, sharded across devices with psum'd grads via
+  parallel/mesh.make_sharded_slot_grad) before a single update — the
+  multi-chip training path, verified by __graft_entry__.dryrun_multichip
+  and tests/test_parallel.py.
+- `predict_all` vmaps a head's forward over every stacked slot in one
+  jitted call — the batched evaluation path shared by trainer.evaluate
+  and trainer.calibrate_threshold.
+
+Bit discipline: with the default batch size of 1 the scan body performs
+the identical per-slot update sequence as the legacy Python loop; only
+array padding (masked, zero-contribution) and float32 loss averaging
+differ, so losses and params agree within fp32 tolerance
+(tests/test_trainer.py::TestFusedTraining).
+"""
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from kmamiz_tpu.core.spans import _pad_size
+from kmamiz_tpu.models import common
+
+
+@dataclass
+class StackedDataset:
+    """All slots of a GraphDataset as bucket-padded device arrays."""
+
+    features: jnp.ndarray  # [S, Nb, F] float32
+    target_latency: jnp.ndarray  # [S, Nb] float32
+    target_anomaly: jnp.ndarray  # [S, Nb] float32
+    node_mask: jnp.ndarray  # [S, Nb] bool (False on padded nodes)
+    src: jnp.ndarray  # [Eb] int32
+    dst: jnp.ndarray  # [Eb] int32
+    edge_mask: jnp.ndarray  # [Eb] bool (False on padded edges)
+    num_slots: int  # real S
+    num_nodes: int  # real N (<= bucket_nodes)
+    num_edges: int  # real E (<= bucket_edges)
+    bucket_nodes: int
+    bucket_edges: int
+
+    def layout(self) -> dict:
+        """The shape contract a checkpoint records (and resume validates):
+        compiled programs and the slot schedule are keyed by exactly
+        these."""
+        return {
+            "bucket_nodes": int(self.bucket_nodes),
+            "bucket_edges": int(self.bucket_edges),
+            "num_slots": int(self.num_slots),
+            "num_nodes": int(self.num_nodes),
+        }
+
+
+def dataset_layout(dataset) -> dict:
+    """A GraphDataset's stacked layout WITHOUT building/uploading the
+    stack — cheap enough for checkpoint-resume validation."""
+    n = dataset.num_nodes
+    e = int(np.asarray(dataset.src).shape[0])
+    return {
+        "bucket_nodes": _pad_size(n),
+        "bucket_edges": _pad_size(e),
+        "num_slots": len(dataset.features),
+        "num_nodes": n,
+    }
+
+
+def stack_dataset(dataset) -> StackedDataset:
+    """GraphDataset (per-slot list layout) -> one device-resident stack.
+
+    Memoized on the dataset instance: repeated train/evaluate/calibrate
+    calls over the same dataset reuse the single upload instead of
+    re-staging S slots each time. Node and edge counts pad to power-of-two
+    buckets (graph-store capacity discipline) with False masks, so padded
+    rows contribute nothing and bucket-shaped programs are shared across
+    datasets of the same bucket."""
+    cached = getattr(dataset, "_stacked_cache", None)
+    if cached is not None and cached.layout() == dataset_layout(dataset):
+        return cached
+
+    s = len(dataset.features)
+    n = dataset.num_nodes
+    f = (
+        int(np.asarray(dataset.features[0]).shape[1])
+        if s
+        else 0
+    )
+    e = int(np.asarray(dataset.src).shape[0])
+    nb, eb = _pad_size(n), _pad_size(e)
+
+    feats = np.zeros((s, nb, f), dtype=np.float32)
+    t_lat = np.zeros((s, nb), dtype=np.float32)
+    t_ano = np.zeros((s, nb), dtype=np.float32)
+    n_mask = np.zeros((s, nb), dtype=bool)
+    for i in range(s):
+        feats[i, :n] = np.asarray(dataset.features[i], dtype=np.float32)
+        t_lat[i, :n] = np.asarray(dataset.target_latency[i], dtype=np.float32)
+        t_ano[i, :n] = np.asarray(dataset.target_anomaly[i], dtype=np.float32)
+        n_mask[i, :n] = np.asarray(dataset.node_mask[i], dtype=bool)
+
+    src = np.zeros(eb, dtype=np.int32)
+    dst = np.zeros(eb, dtype=np.int32)
+    e_mask = np.zeros(eb, dtype=bool)
+    src[:e] = np.asarray(dataset.src, dtype=np.int32)
+    dst[:e] = np.asarray(dataset.dst, dtype=np.int32)
+    e_mask[:e] = np.asarray(dataset.edge_mask, dtype=bool)
+
+    stacked = StackedDataset(
+        features=jnp.asarray(feats),
+        target_latency=jnp.asarray(t_lat),
+        target_anomaly=jnp.asarray(t_ano),
+        node_mask=jnp.asarray(n_mask),
+        src=jnp.asarray(src),
+        dst=jnp.asarray(dst),
+        edge_mask=jnp.asarray(e_mask),
+        num_slots=s,
+        num_nodes=n,
+        num_edges=e,
+        bucket_nodes=nb,
+        bucket_edges=eb,
+    )
+    try:
+        dataset._stacked_cache = stacked
+    except (AttributeError, TypeError):  # frozen/slotted containers
+        pass
+    return stacked
+
+
+# ---------------------------------------------------------------------------
+# scan-fused epochs (sequential per-slot schedule, B = 1)
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=32)
+def epoch_runner(model, lr: float, pos_weight: float):
+    """(params, opt_state, stacked arrays, n_epochs) -> (params, opt_state,
+    losses [n_epochs, 3]) as ONE jitted program: scan over epochs around a
+    scan over slots, one optimizer update per slot — the legacy loop's
+    schedule without its per-slot dispatch and transfers. params/opt_state
+    are donated (they live and die on device across the whole run).
+
+    Memoized per (model, lr, pos_weight) so repeated train() calls in one
+    process reuse the compiled program family (jit then keys on the
+    bucket shapes)."""
+    optimizer = model.make_optimizer(lr)
+    loss_fn = common.make_loss_fn(model.forward, pos_weight)
+    grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+
+    @functools.partial(
+        jax.jit,
+        static_argnames=("n_epochs",),
+        donate_argnames=("params", "opt_state"),
+    )
+    def run(
+        params,
+        opt_state,
+        features,
+        target_latency,
+        target_anomaly,
+        node_mask,
+        src,
+        dst,
+        edge_mask,
+        n_epochs: int,
+    ):
+        def slot_step(carry, xs):
+            p, s = carry
+            f, tl, ta, nm = xs
+            (loss, (lat_l, ano_l)), grads = grad_fn(
+                p, f, src, dst, edge_mask, tl, ta, nm
+            )
+            updates, s = optimizer.update(grads, s, p)
+            p = optax.apply_updates(p, updates)
+            return (p, s), jnp.stack([loss, lat_l, ano_l])
+
+        def epoch_step(carry, _):
+            carry, per_slot = jax.lax.scan(
+                slot_step,
+                carry,
+                (features, target_latency, target_anomaly, node_mask),
+            )
+            return carry, per_slot.mean(axis=0)
+
+        (params, opt_state), losses = jax.lax.scan(
+            epoch_step, (params, opt_state), None, length=n_epochs
+        )
+        return params, opt_state, losses
+
+    return run
+
+
+# ---------------------------------------------------------------------------
+# data-parallel epochs (slot microbatches, optionally mesh-sharded)
+# ---------------------------------------------------------------------------
+
+
+def batch_slots_arrays(
+    stacked: StackedDataset, batch: int
+) -> Tuple[jnp.ndarray, ...]:
+    """Regroup the stacked slot arrays into [n_batches, batch, ...] with a
+    per-slot weight array ([n_batches, batch], 0.0 on padding slots) so
+    the last partial batch contributes only its real slots."""
+    s = stacked.num_slots
+    nb = -(-s // batch)  # ceil
+    pad = nb * batch - s
+
+    def group(a):
+        if pad:
+            a = jnp.concatenate(
+                [a, jnp.zeros((pad,) + a.shape[1:], a.dtype)], axis=0
+            )
+        return a.reshape((nb, batch) + a.shape[1:])
+
+    weights = jnp.concatenate(
+        [jnp.ones(s, jnp.float32), jnp.zeros(pad, jnp.float32)]
+    ).reshape(nb, batch)
+    return (
+        group(stacked.features),
+        group(stacked.target_latency),
+        group(stacked.target_anomaly),
+        group(stacked.node_mask),
+        weights,
+    )
+
+
+@functools.lru_cache(maxsize=32)
+def dp_epoch_runner(
+    model,
+    lr: float,
+    pos_weight: float,
+    mesh=None,
+    axis: str = "slots",
+):
+    """Scan-fused epochs over SLOT MICROBATCHES: per-slot grads inside a
+    batch are computed together (vmap) and averaged by slot weight before
+    ONE optimizer update — minibatch SGD over slots rather than the
+    sequential schedule, trading bit-parity with the legacy loop for a
+    batch axis that shards.
+
+    With `mesh`, the batch axis is sharded across the mesh's devices and
+    grads merge with a psum over ICI (parallel/mesh.make_sharded_slot_grad);
+    params stay replicated, so the returned update is identical to the
+    unsharded microbatch on one device (tests/test_parallel.py asserts
+    this grad parity)."""
+    optimizer = model.make_optimizer(lr)
+    loss_fn = common.make_loss_fn(model.forward, pos_weight)
+    grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+
+    if mesh is not None:
+        from kmamiz_tpu.parallel.mesh import make_sharded_slot_grad
+
+        batch_grads = make_sharded_slot_grad(mesh, grad_fn, axis=axis)
+    else:
+
+        def batch_grads(params, feats, tl, ta, nm, src, dst, em, w):
+            def per_slot(f, l, a, m, wi):
+                (loss, (lat_l, ano_l)), g = grad_fn(
+                    params, f, src, dst, em, l, a, m
+                )
+                g = jax.tree_util.tree_map(lambda x: x * wi, g)
+                return g, loss * wi, lat_l * wi, ano_l * wi
+
+            gs, ls, lat, ano = jax.vmap(per_slot)(feats, tl, ta, nm, w)
+            wsum = jnp.maximum(w.sum(), 1.0)
+            g = jax.tree_util.tree_map(lambda x: x.sum(0) / wsum, gs)
+            return g, ls.sum() / wsum, lat.sum() / wsum, ano.sum() / wsum
+
+    @functools.partial(
+        jax.jit,
+        static_argnames=("n_epochs",),
+        donate_argnames=("params", "opt_state"),
+    )
+    def run(
+        params,
+        opt_state,
+        b_features,  # [n_batches, B, Nb, F]
+        b_target_latency,
+        b_target_anomaly,
+        b_node_mask,
+        b_weights,  # [n_batches, B]
+        src,
+        dst,
+        edge_mask,
+        n_epochs: int,
+    ):
+        def batch_step(carry, xs):
+            p, s = carry
+            f, tl, ta, nm, w = xs
+            g, loss, lat_l, ano_l = batch_grads(
+                p, f, tl, ta, nm, src, dst, edge_mask, w
+            )
+            updates, s = optimizer.update(g, s, p)
+            p = optax.apply_updates(p, updates)
+            return (p, s), jnp.stack([loss, lat_l, ano_l]) * w.sum()
+
+        def epoch_step(carry, _):
+            carry, per_batch = jax.lax.scan(
+                batch_step,
+                carry,
+                (
+                    b_features,
+                    b_target_latency,
+                    b_target_anomaly,
+                    b_node_mask,
+                    b_weights,
+                ),
+            )
+            # slot-weighted epoch mean: partial final batches count only
+            # their real slots
+            return carry, per_batch.sum(axis=0) / jnp.maximum(
+                b_weights.sum(), 1.0
+            )
+
+        (params, opt_state), losses = jax.lax.scan(
+            epoch_step, (params, opt_state), None, length=n_epochs
+        )
+        return params, opt_state, losses
+
+    return run
+
+
+# ---------------------------------------------------------------------------
+# batched evaluation forward
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=16)
+def _batched_forward(model):
+    return jax.jit(
+        jax.vmap(model.forward, in_axes=(None, 0, None, None, None))
+    )
+
+
+def predict_all(
+    params, dataset, model
+) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """One vmapped jitted forward over EVERY slot of the dataset ->
+    (pred_latency [S, N], anomaly_logits [S, N]) as host arrays, sliced
+    back to the real node count. None for an empty dataset."""
+    if not len(dataset.features):
+        return None
+    st = stack_dataset(dataset)
+    lat, logit = _batched_forward(model)(
+        params, st.features, st.src, st.dst, st.edge_mask
+    )
+    n = st.num_nodes
+    return np.asarray(lat)[:, :n], np.asarray(logit)[:, :n]

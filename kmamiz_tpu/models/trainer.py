@@ -16,6 +16,7 @@ works on any simulation config.
 from __future__ import annotations
 
 import logging
+import os
 from dataclasses import dataclass, field
 from typing import Dict, List, Tuple
 
@@ -24,6 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from kmamiz_tpu.models import graphsage
+from kmamiz_tpu.models import stacked as stacked_mod
 from kmamiz_tpu.simulator.naming import extract_unique_service_name
 from kmamiz_tpu.simulator.slot_metrics import parse_slot_key
 
@@ -174,6 +176,20 @@ class TrainResult:
     anomaly_losses: List[float]
 
 
+def _epoch_blocks(start: int, total: int, every: int) -> List[Tuple[int, int]]:
+    """Epoch ranges between checkpoint boundaries: [start, total) cut at
+    multiples of `every` (every<=0: one block). The FUSED path runs one
+    jitted program per block, so a resumed run replays the identical
+    block sequence a fresh run would from that epoch — bit-exact resume."""
+    blocks = []
+    e = start
+    while e < total:
+        nxt = min((e // every + 1) * every, total) if every > 0 else total
+        blocks.append((e, nxt))
+        e = nxt
+    return blocks
+
+
 def train(
     dataset: GraphDataset,
     epochs: int = 30,
@@ -184,14 +200,37 @@ def train(
     checkpoint_every: int = 10,
     model=graphsage,
     use_node_embeddings: bool = False,
+    fused: bool = None,
+    batch_slots: int = 1,
+    mesh=None,
 ) -> TrainResult:
     """Full-graph training, one step per slot per epoch.
+
+    fused (default on; KMAMIZ_SAGE_FUSED=0 or fused=False for the legacy
+    host loop) stacks the dataset device-resident (models/stacked.py) and
+    runs whole epoch blocks as ONE jitted lax.scan with donated
+    params/optimizer state — the per-slot update schedule is identical to
+    the legacy loop, so losses/params agree within fp32 tolerance.
+
+    batch_slots > 1 switches to slot-minibatch SGD (per-batch averaged
+    grads, one update per batch); with `mesh` the batch axis additionally
+    shards across the mesh devices with psum'd grads
+    (parallel/mesh.make_sharded_slot_grad) — same updates as the
+    unsharded batch, any device count.
 
     With checkpoint_dir set, training resumes from the latest saved epoch
     (kmamiz_tpu.models.checkpoint) and snapshots every checkpoint_every
     epochs (0 = only at the end) plus at the end. Resuming validates the
-    saved hyperparameters against the requested ones."""
+    saved hyperparameters against the requested ones, and the saved
+    stacked layout (node/edge buckets, slot count) against the dataset's."""
     from kmamiz_tpu.models import checkpoint as ckpt
+
+    if fused is None:
+        fused = os.environ.get("KMAMIZ_SAGE_FUSED", "1") not in (
+            "0",
+            "off",
+            "false",
+        )
 
     # node-identity embeddings are OPT-IN: on the small simulator meshes
     # they overfit (held-out F1 drops ~0.02 and latency MAE inflates ~17x
@@ -260,6 +299,21 @@ def train(
                         f"checkpoint {checkpoint_dir} was trained with "
                         f"{name}={saved}, requested {name}={want}"
                     )
+            # the stacked layout (node/edge capacity buckets + slot count)
+            # is part of the training schedule: resuming against a dataset
+            # that stacks differently would silently change which compiled
+            # program and which slot sequence the remaining epochs run
+            saved_layout = meta.get("stacked")
+            if saved_layout is not None and dataset is not None:
+                current_layout = stacked_mod.dataset_layout(dataset)
+                if dict(saved_layout) != current_layout:
+                    raise ValueError(
+                        f"checkpoint {checkpoint_dir} step {resume_step} was "
+                        f"saved with stacked layout {dict(saved_layout)} but "
+                        f"the dataset stacks to {current_layout}; resume "
+                        "needs the same node/edge buckets and slot count "
+                        "(retrain, or rebuild the matching dataset)"
+                    )
             restored = ckpt.restore_checkpoint(
                 checkpoint_dir, params, opt_state, step=resume_step
             )
@@ -276,9 +330,68 @@ def train(
     tot = sum(float(np.asarray(m).sum()) for m in dataset.node_mask)
     base_rate = pos / tot if tot else 0.0
     pos_weight = float(np.clip(1.0 / base_rate, 1.0, 20.0)) if base_rate else 1.0
-    step = model.make_train_step(optimizer, pos_weight=pos_weight)
+
+    def metadata(last_loss):
+        return {
+            "loss": last_loss,
+            "hidden": hidden,
+            "lr": lr,
+            "seed": seed,
+            "model": model.__name__.rsplit(".", 1)[-1],
+            "num_features": num_features,
+            "num_nodes": num_nodes,
+            "stacked": stacked_mod.dataset_layout(dataset),
+        }
 
     losses, lat_losses, ano_losses = [], [], []
+    if fused and dataset.features:
+        st = stacked_mod.stack_dataset(dataset)
+        if batch_slots > 1 or mesh is not None:
+            axis = mesh.axis_names[0] if mesh is not None else "slots"
+            batch = max(batch_slots, mesh.shape[axis] if mesh is not None else 1)
+            runner = stacked_mod.dp_epoch_runner(
+                model, lr, pos_weight, mesh=mesh, axis=axis
+            )
+            batched = stacked_mod.batch_slots_arrays(st, batch)
+
+            def run_block(p, s, n_ep):
+                return runner(p, s, *batched, st.src, st.dst, st.edge_mask, n_ep)
+
+        else:
+            runner = stacked_mod.epoch_runner(model, lr, pos_weight)
+
+            def run_block(p, s, n_ep):
+                return runner(
+                    p,
+                    s,
+                    st.features,
+                    st.target_latency,
+                    st.target_anomaly,
+                    st.node_mask,
+                    st.src,
+                    st.dst,
+                    st.edge_mask,
+                    n_ep,
+                )
+
+        save_every = checkpoint_every if checkpoint_dir else 0
+        for e0, e1 in _epoch_blocks(start_epoch, epochs, save_every):
+            params, opt_state, block = run_block(params, opt_state, e1 - e0)
+            block = np.asarray(block, dtype=np.float64)  # [e1-e0, 3]
+            losses.extend(block[:, 0].tolist())
+            lat_losses.extend(block[:, 1].tolist())
+            ano_losses.extend(block[:, 2].tolist())
+            if checkpoint_dir:
+                ckpt.save_checkpoint(
+                    checkpoint_dir,
+                    params,
+                    opt_state,
+                    step=e1,
+                    metadata=metadata(losses[-1]),
+                )
+        return TrainResult(params, losses, lat_losses, ano_losses)
+
+    step = model.make_train_step(optimizer, pos_weight=pos_weight)
     for epoch in range(start_epoch, epochs):
         epoch_loss = epoch_lat = epoch_ano = 0.0
         for i in range(len(dataset.features)):
@@ -309,15 +422,7 @@ def train(
                 params,
                 opt_state,
                 step=epoch + 1,
-                metadata={
-                    "loss": losses[-1],
-                    "hidden": hidden,
-                    "lr": lr,
-                    "seed": seed,
-                    "model": model.__name__.rsplit(".", 1)[-1],
-                    "num_features": num_features,
-                    "num_nodes": num_nodes,
-                },
+                metadata=metadata(losses[-1]),
             )
     return TrainResult(params, losses, lat_losses, ano_losses)
 
@@ -398,16 +503,15 @@ def evaluate(
     threshold: float = 0.5,
     model=graphsage,
 ) -> EvalResult:
+    """All slots run as ONE vmapped jitted forward over the stacked
+    dataset (models/stacked.py) instead of a per-slot Python loop."""
+    preds = stacked_mod.predict_all(params, dataset, model)
+    if preds is not None:
+        latencies, logits = preds
+        probs = np.asarray(jax.nn.sigmoid(jnp.asarray(logits)))
+
     def predict(i):
-        pred_latency, logit = model.forward(
-            params,
-            dataset.features[i],
-            dataset.src,
-            dataset.dst,
-            dataset.edge_mask,
-        )
-        prob = np.asarray(jax.nn.sigmoid(logit))
-        return pred_latency, prob > threshold
+        return latencies[i], probs[i] > threshold
 
     result = _score_predictions(dataset, predict)
     result.threshold = threshold
@@ -520,16 +624,10 @@ def calibrate_threshold(
     Forward passes run once; only the thresholding sweeps."""
     if grid is None:
         grid = [i / 20 for i in range(1, 20)]
-    probs = []
-    for i in range(len(dataset.features)):
-        _lat, logit = model.forward(
-            params,
-            dataset.features[i],
-            dataset.src,
-            dataset.dst,
-            dataset.edge_mask,
-        )
-        probs.append(np.asarray(jax.nn.sigmoid(logit)))
+    preds = stacked_mod.predict_all(params, dataset, model)
+    if preds is None:
+        return 0.5
+    probs = np.asarray(jax.nn.sigmoid(jnp.asarray(preds[1])))  # [S, N]
     best_t, best_f1 = 0.5, 0.0
     for t in grid:
         tp = fp = fn = 0

@@ -612,6 +612,62 @@ def sharded_window_edges_compact(
     )(parent_slot, kind, valid, endpoint_id)
 
 
+def make_sharded_slot_grad(mesh: Mesh, grad_fn, axis: str = "slots"):
+    """Data-parallel gradient over a SLOT MICROBATCH of training windows
+    (the GraphSAGE trainer's stacked slots, models/stacked.py).
+
+    grad_fn is value_and_grad(loss_fn, has_aux=True) with the models/common
+    loss signature: grad_fn(params, features, src, dst, edge_mask,
+    target_latency, target_anomaly, node_mask) -> ((loss, (lat_l, ano_l)),
+    grads).
+
+    The returned batch_grads(params, feats[B,Nb,F], tl[B,Nb], ta[B,Nb],
+    nm[B,Nb], src, dst, edge_mask, w[B]) shards the batch axis across the
+    mesh: each device vmaps grad_fn over ITS B/n slots (weighted, so padded
+    batch entries contribute zero), locally sums, and ONE psum over ICI
+    merges grads and losses — params and the edge topology are replicated
+    (they are small next to the [B, Nb, F] feature block). Dividing the
+    psum'd sums by the psum'd weight total makes the result EQUAL to the
+    unsharded weighted batch mean on one device (tests/test_parallel.py
+    asserts this grad parity), so the optimizer update is
+    device-count-invariant."""
+    n = mesh.shape[axis]
+    spec = P(axis)
+
+    def local(params, feats, tl, ta, nm, src, dst, em, w):
+        def per_slot(f, l, a, m, wi):
+            (loss, (lat_l, ano_l)), g = grad_fn(params, f, src, dst, em, l, a, m)
+            g = jax.tree_util.tree_map(lambda x: x * wi, g)
+            return g, loss * wi, lat_l * wi, ano_l * wi
+
+        gs, ls, lat, ano = jax.vmap(per_slot)(feats, tl, ta, nm, w)
+        sums = jax.lax.psum(
+            jnp.stack([ls.sum(), lat.sum(), ano.sum(), w.sum()]), axis
+        )
+        wsum = jnp.maximum(sums[3], 1.0)
+        g = jax.tree_util.tree_map(
+            lambda x: jax.lax.psum(x.sum(0), axis) / wsum, gs
+        )
+        return g, sums[0] / wsum, sums[1] / wsum, sums[2] / wsum
+
+    sharded = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(), spec, spec, spec, spec, P(), P(), P(), spec),
+        out_specs=(P(), P(), P(), P()),
+    )
+
+    def batch_grads(params, feats, tl, ta, nm, src, dst, em, w):
+        if feats.shape[0] % n:
+            raise ValueError(
+                f"slot batch of {feats.shape[0]} does not shard over "
+                f"{n} devices; pick a batch size divisible by the mesh"
+            )
+        return sharded(params, feats, tl, ta, nm, src, dst, em, w)
+
+    return batch_grads
+
+
 @partial(jax.jit, static_argnames=("mesh", "num_services", "axis"))
 def sharded_service_scores(
     mesh: Mesh,

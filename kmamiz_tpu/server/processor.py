@@ -510,6 +510,15 @@ class DataProcessor:
             "mask": mask,
             "names": [interner.endpoints.lookup(i) for i in range(n)],
             "predicted_hour": self.history_predicted_hour,
+            # the forecast-payload memo key, mirroring the scorer cache's
+            # (version, label-epoch) discipline (graph/store.py): the
+            # served forecast is a pure function of the graph state at
+            # fold time plus which hour was folded
+            "cache_key": (
+                int(self.graph.version),
+                int(getattr(self.graph, "_label_epoch", 0)),
+                int(hour),
+            ),
         }
 
     # -- history persistence (VERDICT r4 #4) ---------------------------------

@@ -41,6 +41,15 @@ if "xla_force_host_platform_device_count" not in xla_flags:
 
 import pytest
 
+
+def pytest_configure(config):
+    # tier-1 runs with -m 'not slow' (ROADMAP.md); long training-epoch
+    # tests opt out of it with this marker
+    config.addinivalue_line(
+        "markers", "slow: long-running test excluded from the tier-1 sweep"
+    )
+
+
 FIXTURES = Path(__file__).parent / "fixtures"
 
 

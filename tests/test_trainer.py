@@ -398,6 +398,236 @@ class TestCheckpointResume:
         assert checkpoint.latest_complete_step(str(tmp_path)) is None
 
 
+def _synthetic_dataset(n_nodes=16, n_edges=24, n_slots=5, seed=0, anomaly=0.2):
+    import jax.numpy as jnp
+
+    from kmamiz_tpu.models import graphsage
+
+    rng = np.random.default_rng(seed)
+    return trainer.GraphDataset(
+        endpoint_names=[f"ep{i}" for i in range(n_nodes)],
+        src=jnp.asarray(rng.integers(0, n_nodes, n_edges, dtype=np.int32)),
+        dst=jnp.asarray(rng.integers(0, n_nodes, n_edges, dtype=np.int32)),
+        edge_mask=jnp.ones(n_edges, dtype=bool),
+        features=[
+            jnp.asarray(
+                rng.normal(size=(n_nodes, graphsage.NUM_FEATURES)).astype(
+                    np.float32
+                )
+            )
+            for _ in range(n_slots)
+        ],
+        target_latency=[
+            jnp.asarray(rng.normal(size=n_nodes).astype(np.float32))
+            for _ in range(n_slots)
+        ],
+        target_anomaly=[
+            jnp.asarray((rng.random(n_nodes) < anomaly).astype(np.float32))
+            for _ in range(n_slots)
+        ],
+        node_mask=[
+            jnp.asarray(rng.random(n_nodes) < 0.9) for _ in range(n_slots)
+        ],
+        slot_keys=[f"s{i}" for i in range(n_slots)],
+    )
+
+
+class TestStackedDataset:
+    """Device residency (models/stacked.py): capacity-bucket padding and
+    the one-upload stacked layout behind the scan-fused trainer."""
+
+    def test_buckets_and_masks(self):
+        from kmamiz_tpu.models import stacked
+
+        ds = _synthetic_dataset(n_nodes=10, n_edges=14, n_slots=6)
+        st = stacked.stack_dataset(ds)
+        # pow2 capacity buckets (graph-store discipline); slots stay exact
+        assert st.bucket_nodes == 16 and st.bucket_edges == 16
+        assert st.num_slots == 6 and st.num_nodes == 10 and st.num_edges == 14
+        assert st.features.shape == (6, 16, 10)
+        assert st.node_mask.shape == (6, 16)
+        # padded rows/edges are masked out
+        assert not np.asarray(st.node_mask)[:, 10:].any()
+        assert not np.asarray(st.edge_mask)[14:].any()
+        # real content round-trips
+        for i in range(6):
+            np.testing.assert_array_equal(
+                np.asarray(st.features[i, :10]), np.asarray(ds.features[i])
+            )
+        # repeated stacking reuses the single upload
+        assert stacked.stack_dataset(ds) is st
+
+    def test_layout_without_stacking(self):
+        from kmamiz_tpu.models import stacked
+
+        ds = _synthetic_dataset(n_nodes=10, n_edges=14, n_slots=6)
+        assert stacked.dataset_layout(ds) == {
+            "bucket_nodes": 16,
+            "bucket_edges": 16,
+            "num_slots": 6,
+            "num_nodes": 10,
+        }
+
+    def test_batched_forward_matches_per_slot(self):
+        import jax
+
+        from kmamiz_tpu.models import graphsage, stacked
+
+        ds = _synthetic_dataset()
+        params = graphsage.init_params(jax.random.PRNGKey(1), hidden=8)
+        lat, logit = stacked.predict_all(params, ds, graphsage)
+        assert lat.shape == (5, 16)
+        for i in range(5):
+            ref_lat, ref_logit = graphsage.forward(
+                params, ds.features[i], ds.src, ds.dst, ds.edge_mask
+            )
+            np.testing.assert_allclose(
+                lat[i], np.asarray(ref_lat), rtol=1e-5, atol=1e-6
+            )
+            np.testing.assert_allclose(
+                logit[i], np.asarray(ref_logit), rtol=1e-5, atol=1e-6
+            )
+
+
+class TestFusedTraining:
+    """Scan-fused epochs (models/stacked.py): the single jitted program
+    must reproduce the legacy host loop's update schedule."""
+
+    def test_fused_matches_legacy_loop(self):
+        import jax
+
+        ds = _synthetic_dataset()
+        r_legacy = trainer.train(ds, epochs=6, hidden=8, seed=0, fused=False)
+        r_fused = trainer.train(ds, epochs=6, hidden=8, seed=0, fused=True)
+        # same seed, same schedule: losses agree within fp32 tolerance
+        # (only padded-array reduction order differs)
+        np.testing.assert_allclose(
+            r_fused.losses, r_legacy.losses, rtol=1e-4, atol=1e-5
+        )
+        np.testing.assert_allclose(
+            r_fused.latency_losses, r_legacy.latency_losses, rtol=1e-4, atol=1e-5
+        )
+        for a, b in zip(
+            jax.tree_util.tree_leaves(r_fused.params),
+            jax.tree_util.tree_leaves(r_legacy.params),
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-5
+            )
+
+    def test_fused_matches_legacy_with_embeddings(self):
+        ds = _synthetic_dataset()
+        r_l = trainer.train(
+            ds, epochs=3, hidden=8, fused=False, use_node_embeddings=True
+        )
+        r_f = trainer.train(
+            ds, epochs=3, hidden=8, fused=True, use_node_embeddings=True
+        )
+        np.testing.assert_allclose(r_f.losses, r_l.losses, rtol=1e-4, atol=1e-5)
+        # padded rows never receive embedding gradient: table stays [N, D]
+        assert np.asarray(r_f.params.embedding).shape == (ds.num_nodes, 8)
+
+    def test_env_var_disables_fusion(self, monkeypatch):
+        from kmamiz_tpu.models import stacked
+
+        ds = _synthetic_dataset(n_slots=2)
+        monkeypatch.setenv("KMAMIZ_SAGE_FUSED", "0")
+        r = trainer.train(ds, epochs=1, hidden=8)
+        # legacy path does not build the device stack
+        assert not hasattr(ds, "_stacked_cache")
+        assert np.isfinite(r.losses[-1])
+
+    def test_dp_batched_runner_trains(self):
+        ds = _synthetic_dataset(n_slots=6)
+        r = trainer.train(ds, epochs=5, hidden=8, fused=True, batch_slots=2)
+        assert len(r.losses) == 5
+        assert np.isfinite(r.losses).all()
+        assert r.losses[-1] < r.losses[0]
+
+    def test_resume_mid_run_is_bit_exact(self, tmp_path):
+        """Regression: a run resumed from a mid-run checkpoint must replay
+        the identical epoch-block sequence — bit-equal losses and params
+        vs the uninterrupted run."""
+        import jax
+
+        ds = _synthetic_dataset()
+        d1, d2 = str(tmp_path / "a"), str(tmp_path / "b")
+        r_full = trainer.train(
+            ds, epochs=6, hidden=8, checkpoint_dir=d1, checkpoint_every=2
+        )
+        r_head = trainer.train(
+            ds, epochs=4, hidden=8, checkpoint_dir=d2, checkpoint_every=2
+        )
+        r_tail = trainer.train(
+            ds, epochs=6, hidden=8, checkpoint_dir=d2, checkpoint_every=2
+        )
+        assert len(r_tail.losses) == 2
+        assert r_full.losses == r_head.losses + r_tail.losses
+        for a, b in zip(
+            jax.tree_util.tree_leaves(r_full.params),
+            jax.tree_util.tree_leaves(r_tail.params),
+        ):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_resume_rejects_stacked_layout_mismatch(self, tmp_path):
+        ds = _synthetic_dataset(n_nodes=10, n_edges=14, n_slots=4)
+        d = str(tmp_path)
+        trainer.train(ds, epochs=2, hidden=8, checkpoint_dir=d)
+        # same endpoint count but an edge set in the next capacity bucket
+        ds2 = _synthetic_dataset(n_nodes=10, n_edges=40, n_slots=4)
+        with pytest.raises(ValueError, match="stacked layout"):
+            trainer.train(ds2, epochs=4, hidden=8, checkpoint_dir=d)
+
+    def test_checkpoint_metadata_records_layout(self, tmp_path):
+        from kmamiz_tpu.models import checkpoint, stacked
+
+        ds = _synthetic_dataset(n_nodes=10, n_edges=14, n_slots=4)
+        trainer.train(ds, epochs=2, hidden=8, checkpoint_dir=str(tmp_path))
+        meta = checkpoint.load_metadata(str(tmp_path), 2)
+        assert dict(meta["stacked"]) == stacked.dataset_layout(ds)
+
+    def test_evaluate_matches_legacy_scoring(self):
+        """The vmapped stacked evaluation must reproduce the per-slot
+        forward loop's metrics exactly (same thresholding math)."""
+        import jax
+
+        from kmamiz_tpu.models import graphsage
+
+        ds = _synthetic_dataset(n_slots=6, anomaly=0.3)
+        r = trainer.train(ds, epochs=3, hidden=8)
+        got = trainer.evaluate(r.params, ds, threshold=0.4)
+
+        def legacy_predict(i):
+            lat, logit = graphsage.forward(
+                r.params, ds.features[i], ds.src, ds.dst, ds.edge_mask
+            )
+            return lat, np.asarray(jax.nn.sigmoid(logit)) > 0.4
+
+        want = trainer._score_predictions(ds, legacy_predict)
+        assert got.per_slot_flagged == want.per_slot_flagged
+        np.testing.assert_allclose(
+            got.latency_mse, want.latency_mse, rtol=1e-6
+        )
+        assert got.anomaly_precision == want.anomaly_precision
+        assert got.anomaly_recall == want.anomaly_recall
+
+    @pytest.mark.slow
+    def test_fused_convergence_on_simulation(self, simulation):
+        """Long-epoch convergence check on the simulator mesh — slow
+        sweep only; tier-1 covers the same path with few epochs."""
+        result, metrics, _ds = trainer.train_on_simulation(
+            simulation.endpoint_dependencies,
+            simulation.realtime_data_per_slot,
+            simulation.replica_counts,
+            train_fraction=0.5,
+            epochs=80,
+            hidden=16,
+            seed=0,
+        )
+        assert result.losses[-1] < result.losses[0]
+        assert metrics.anomaly_recall > 0.5
+
+
 class TestHistoryFeatures:
     """Identity-free inductive features (models/history.py): causality,
     shapes, and the endpoint-holdout masking the inductive protocol

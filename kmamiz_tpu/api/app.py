@@ -196,6 +196,13 @@ def main() -> None:
     compile_cache.enable_from_env()  # before the first jit dispatch
     app = Application(ctx=build_production_context())
     app.start_up()
+    # boot prewarm plan (core/programs.py): hints-first AOT warm of the
+    # registered hot programs on a daemon thread; /api/v1/health answers
+    # 503 WARMING until done (readinessProbe gate, deploy/kmamiz-tpu.yaml)
+    from kmamiz_tpu.core import programs
+
+    graph = getattr(app.ctx.processor, "graph", None)
+    programs.boot_prewarm_from_env(graph=graph)
     app.listen()
 
     def _exit(signum, frame):

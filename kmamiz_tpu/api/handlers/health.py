@@ -11,6 +11,7 @@ import time
 from typing import Optional
 
 from kmamiz_tpu.api.router import IRequestHandler, Request, Response
+from kmamiz_tpu.core import programs
 from kmamiz_tpu.core.profiling import step_timer
 
 
@@ -22,8 +23,27 @@ class HealthHandler(IRequestHandler):
         self.add_route("get", "/timings", self._timings)
 
     def _health(self, req: Request) -> Response:
+        """Liveness + readiness: while the boot prewarm plan is running
+        (core/programs.py), status is WARMING and — unless
+        KMAMIZ_PREWARM_READY_GATE=0 — the HTTP status is 503, which the
+        deploy readinessProbe (deploy/kmamiz-tpu.yaml) reads as
+        not-ready, keeping traffic off the compile walls."""
+        warm = programs.warm_state()
+        if warm.get("status") == "warming" and programs.ready_gate_enabled():
+            return Response(
+                status=503,
+                payload={
+                    "status": "WARMING",
+                    "serverTime": int(time.time() * 1000),
+                    "prewarm": warm,
+                },
+            )
         return Response(
-            payload={"status": "UP", "serverTime": int(time.time() * 1000)}
+            payload={
+                "status": "UP",
+                "serverTime": int(time.time() * 1000),
+                "prewarm": warm,
+            }
         )
 
     def _timings(self, req: Request) -> Response:
@@ -39,4 +59,7 @@ class HealthHandler(IRequestHandler):
         from kmamiz_tpu.models import serving
 
         payload["modelServe"] = serving.serve_stats()
+        # per-program compile counters (compiles / compileMs / buckets):
+        # a steady-state tick after warm-up must add 0 compiles
+        payload["programs"] = programs.summary()
         return Response(payload=payload)

@@ -10,10 +10,18 @@ Two policies keep that cost off the serving path (VERDICT r4 #5b):
   previously compiled program from disk instead of re-compiling — the
   capacity-doubling design already bounds the program set to
   ~log2(max_edges) union shapes per lifetime (graph/store.py).
-- **boot pre-warm**: DataProcessor.prewarm_compile (below) AOT-compiles
-  the active (batch-capacity, store-capacity) merge programs before the
-  first tick, so a mid-tick capacity step never eats a compile wall
-  while a request waits.
+- **boot pre-warm**: the boot prewarm plan (core/programs.py) replays
+  the persisted shape hints — the exact (program, bucket) pairs the
+  previous process compiled — before the first tick, so a restart never
+  eats a compile wall while a request waits. On a cold cache it falls
+  back to EndpointGraph.prewarm_compile's default merge buckets.
+
+The persistent cache alone is NOT enough for a fast restart: reloading
+a program from disk still pays the jit trace+lower on first dispatch
+(multi-second for the union programs). The registry's dispatch-replay
+prewarm exists precisely to move that residue off the serving path; the
+hint file lives next to this cache (KMAMIZ_SHAPE_HINTS defaults into
+KMAMIZ_COMPILE_CACHE_DIR).
 """
 from __future__ import annotations
 

@@ -1,0 +1,666 @@
+"""Central registry of hot jitted programs: telemetry, shape hints, prewarm.
+
+Every jitted entry point that can sit on the serving path registers here
+(graph merges, the packed ancestor walk, window stats, the scorers, the
+stacked GraphSAGE epoch block, the forecast forward). Registration wraps
+the jitted callable in a :class:`Program` proxy that
+
+- counts per-program compiles and compile milliseconds (a dispatch whose
+  jit cache grew paid a trace/lower/compile wall — the /health/timings
+  ``programs`` section exposes the counters, and a steady-state tick
+  after warm-up must add 0);
+- records the exact argument *spec* (shapes + dtypes + static values) of
+  every newly compiled entry as a **shape hint**, persisted next to the
+  persistent XLA cache (core.compile_cache), so a restarted process can
+  prewarm exactly the (program, bucket) pairs production traffic
+  exercised;
+- replays those specs at boot with zero-filled arguments
+  (:meth:`Program.prewarm_spec`). A replayed dispatch populates the jit
+  *dispatch* cache — unlike ``fn.lower(...).compile()``, which AOT-fills
+  only the persistent XLA cache and still leaves the first live call a
+  multi-second trace+lower wall (measured on jax 0.4.37: lower+compile
+  leaves ``_cache_size()`` at 0; the first call re-traces).
+
+Boot flow (dp_server.main / api.app): ``start_background_prewarm()``
+runs the plan on a daemon thread; ``warm_state()`` drives the /health
+readiness gate (503 + status "WARMING" until done, see
+api/handlers/health.py and deploy/kmamiz-tpu.yaml's readinessProbe).
+
+Env:
+- ``KMAMIZ_SHAPE_HINTS``: hint-file path (default
+  ``$KMAMIZ_COMPILE_CACHE_DIR/shape_hints.json``; hints are disabled
+  when neither is set).
+- ``KMAMIZ_PREWARM``: "0" disables boot prewarm, "sync" blocks boot on
+  it, anything else (default "1") prewarms on a background thread.
+- ``KMAMIZ_PREWARM_READY_GATE``: "0" keeps /health answering 200 while
+  warming (gate off); default "1" answers 503.
+"""
+from __future__ import annotations
+
+import importlib
+import json
+import logging
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+logger = logging.getLogger("kmamiz_tpu.programs")
+
+_MAX_HINTS_PER_PROGRAM = 16
+
+_registry_lock = threading.Lock()
+_REGISTRY: Dict[str, "Program"] = {}
+#: family base name -> resolver(key) -> Program; dynamic programs
+#: (per-model jits built by lru_cache factories) register instances
+#: under "base[key]" and a resolver so a restart can rebuild them from
+#: a persisted hint before any live call exists.
+_FAMILIES: Dict[str, Callable[[str], Optional["Program"]]] = {}
+
+
+class UnencodableSpec(ValueError):
+    """Argument not expressible as a shape hint (opaque object leaf)."""
+
+
+# ---------------------------------------------------------------------------
+# argument-spec encode/decode
+#
+# A spec is the JSON-able skeleton of one dispatch's (args, kwargs):
+# array leaves become {"__arr__": [shape, dtype, weak]}, tuples and
+# namedtuples keep their container identity (the jit cache keys on the
+# pytree structure, so a tuple→list roundtrip would miss the cache),
+# and plain Python scalars stay literal — replaying a literal through
+# the jit boundary reproduces the live call's weak-type/static-arg
+# cache key exactly.
+# ---------------------------------------------------------------------------
+
+
+def _encode(x: Any) -> Any:
+    if x is None or isinstance(x, (bool, int, float, str)):
+        return x
+    if hasattr(x, "shape") and hasattr(x, "dtype"):
+        return {
+            "__arr__": [
+                [int(d) for d in x.shape],
+                str(x.dtype),
+                bool(getattr(x, "weak_type", False)),
+            ]
+        }
+    if isinstance(x, tuple):
+        fields = getattr(x, "_fields", None)
+        if fields is not None:  # namedtuple: keep the class for the pytree
+            cls = type(x)
+            return {
+                "__nt__": [cls.__module__, cls.__qualname__],
+                "items": [_encode(v) for v in x],
+            }
+        return {"__tuple__": [_encode(v) for v in x]}
+    if isinstance(x, list):
+        return [_encode(v) for v in x]
+    if isinstance(x, dict):
+        if not all(isinstance(k, str) for k in x):
+            raise UnencodableSpec(f"non-string dict keys: {list(x)[:3]}")
+        return {str(k): _encode(v) for k, v in x.items()}
+    raise UnencodableSpec(f"opaque leaf {type(x).__name__}")
+
+
+def _resolve_qualname(module: str, qualname: str):
+    obj = importlib.import_module(module)
+    for part in qualname.split("."):
+        obj = getattr(obj, part)
+    return obj
+
+
+def _decode_zeros(x: Any) -> Any:
+    """Spec -> concrete zero-filled arguments for a prewarm dispatch."""
+    if x is None or isinstance(x, (bool, int, float, str)):
+        return x
+    if isinstance(x, list):
+        return [_decode_zeros(v) for v in x]
+    if isinstance(x, dict):
+        if "__arr__" in x:
+            shape, dtype, weak = x["__arr__"]
+            if weak and not shape:
+                # weak-typed scalar: replay as the Python literal that
+                # produced it, so the cache key matches the live call
+                kind = str(dtype)
+                if kind.startswith("bool"):
+                    return False
+                if kind.startswith(("int", "uint")):
+                    return 0
+                return 0.0
+            import jax.numpy as jnp
+
+            return jnp.zeros(tuple(shape), dtype=str(dtype))
+        if "__tuple__" in x:
+            return tuple(_decode_zeros(v) for v in x["__tuple__"])
+        if "__nt__" in x:
+            cls = _resolve_qualname(*x["__nt__"])
+            return cls(*[_decode_zeros(v) for v in x["items"]])
+        return {k: _decode_zeros(v) for k, v in x.items()}
+    raise UnencodableSpec(f"bad spec node {type(x).__name__}")
+
+
+def _bucket_label(spec: Any) -> str:
+    """Compact human-readable bucket descriptor for telemetry tables:
+    array shapes and static scalars, pytree internals elided."""
+    args, kwargs = spec
+
+    def leaf(x):
+        if isinstance(x, dict):
+            if "__arr__" in x:
+                shape, dtype, _ = x["__arr__"]
+                return "x".join(str(d) for d in shape) or "scalar"
+            return "tree"
+        if isinstance(x, (list,)):
+            return "tree"
+        return repr(x)
+
+    parts = [leaf(a) for a in args]
+    parts += [f"{k}={leaf(v)}" for k, v in sorted(kwargs.items())]
+    return "(" + ",".join(parts) + ")"
+
+
+# ---------------------------------------------------------------------------
+# Program proxy
+# ---------------------------------------------------------------------------
+
+
+class Program:
+    """Instrumented wrapper around one jitted callable.
+
+    Transparent for callers: ``__call__`` delegates, and jit attributes
+    (``lower``, ``_cache_size`` — bench.py reads it) pass through via
+    ``__getattr__``. Telemetry costs two ``_cache_size()`` reads and one
+    timer per dispatch.
+    """
+
+    def __init__(self, name: str, fn: Callable) -> None:
+        self.name = name
+        self.fn = fn
+        self._lock = threading.Lock()
+        self.calls = 0
+        self.compiles = 0
+        self.compile_ms = 0.0
+        self.last_compile_ms = 0.0
+        self.prewarmed = 0
+        self.prewarm_ms = 0.0
+        self._specs: Dict[str, Any] = {}  # canonical json -> spec
+        self._suppress_record = False
+
+    # -- delegation ---------------------------------------------------------
+    def __call__(self, *args, **kwargs):
+        before = self._cache_entries()
+        t0 = time.perf_counter()
+        out = self.fn(*args, **kwargs)
+        elapsed_ms = (time.perf_counter() - t0) * 1000.0
+        grew = 0
+        if before is not None:
+            after = self._cache_entries()
+            if after is not None and after > before:
+                grew = after - before
+        with self._lock:
+            self.calls += 1
+            if grew:
+                self.compiles += grew
+                self.compile_ms += elapsed_ms
+                self.last_compile_ms = elapsed_ms
+        if grew and not self._suppress_record:
+            self._record_spec(args, kwargs)
+        return out
+
+    def __getattr__(self, item):
+        return getattr(self.fn, item)
+
+    def _cache_entries(self) -> Optional[int]:
+        try:
+            return int(self.fn._cache_size())
+        except Exception:  # noqa: BLE001 - non-jit callables track calls only
+            return None
+
+    # -- shape hints --------------------------------------------------------
+    def _record_spec(self, args, kwargs) -> None:
+        try:
+            import jax
+
+            if not jax.core.trace_state_clean():
+                return  # inner-jit retrace: not a top-level dispatch shape
+        except Exception:  # noqa: BLE001 - private API moved: record anyway
+            pass
+        try:
+            spec = (
+                [_encode(a) for a in args],
+                {k: _encode(v) for k, v in sorted(kwargs.items())},
+            )
+        except UnencodableSpec:
+            return
+        key = json.dumps(spec, sort_keys=True)
+        with self._lock:
+            if key in self._specs:
+                return
+            if len(self._specs) >= _MAX_HINTS_PER_PROGRAM:
+                return
+            self._specs[key] = spec
+        _autosave_hints()
+
+    def specs(self) -> List[Any]:
+        with self._lock:
+            return list(self._specs.values())
+
+    def adopt_specs(self, specs: List[Any]) -> None:
+        """Merge persisted hint specs (restart path) without re-saving."""
+        with self._lock:
+            for spec in specs:
+                key = json.dumps(spec, sort_keys=True)
+                if (
+                    key not in self._specs
+                    and len(self._specs) < _MAX_HINTS_PER_PROGRAM
+                ):
+                    self._specs[key] = spec
+
+    # -- prewarm ------------------------------------------------------------
+    def prewarm_spec(self, spec: Any) -> bool:
+        """Dispatch this program once with zero-filled arguments matching
+        ``spec``, so the jit dispatch cache (and the persistent XLA
+        cache) hold the program before live traffic arrives. Pure
+        kernels only — outputs are discarded."""
+        try:
+            args, kwargs = spec
+            concrete_args = [_decode_zeros(a) for a in args]
+            concrete_kwargs = {k: _decode_zeros(v) for k, v in kwargs.items()}
+        except Exception as e:  # noqa: BLE001 - stale/foreign hint
+            logger.warning("%s: undecodable hint (%s)", self.name, e)
+            return False
+        t0 = time.perf_counter()
+        self._suppress_record = True
+        try:
+            import jax
+
+            out = self(*concrete_args, **concrete_kwargs)
+            jax.block_until_ready(out)
+        except Exception as e:  # noqa: BLE001 - a bad hint must not kill boot
+            logger.warning("%s: prewarm failed (%s)", self.name, e)
+            return False
+        finally:
+            self._suppress_record = False
+        with self._lock:
+            self.prewarmed += 1
+            self.prewarm_ms += (time.perf_counter() - t0) * 1000.0
+        self.adopt_specs([spec])
+        return True
+
+    # -- reporting ----------------------------------------------------------
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "calls": self.calls,
+                "compiles": self.compiles,
+                "compileMs": round(self.compile_ms, 1),
+                "lastCompileMs": round(self.last_compile_ms, 1),
+                "prewarmed": self.prewarmed,
+                "prewarmMs": round(self.prewarm_ms, 1),
+                "cacheSize": self._cache_entries(),
+                "buckets": [_bucket_label(s) for s in self._specs.values()],
+            }
+
+
+# ---------------------------------------------------------------------------
+# registration
+# ---------------------------------------------------------------------------
+
+
+def register(name: str, fn: Optional[Callable] = None):
+    """Register a jitted callable under ``name``; usable as a decorator::
+
+        @programs.register("graph.merge_edges")
+        @jax.jit
+        def _merge_edges(...): ...
+    """
+    def _wrap(f: Callable) -> Program:
+        with _registry_lock:
+            existing = _REGISTRY.get(name)
+            if existing is not None and existing.fn is f:
+                return existing
+            prog = Program(name, f)
+            _REGISTRY[name] = prog
+            return prog
+
+    return _wrap if fn is None else _wrap(fn)
+
+
+def register_instance(base: str, key: str, fn: Callable) -> Program:
+    """Register a dynamically created jit (one per model/config) under
+    ``base[key]``. Idempotent per (name, fn)."""
+    return register(f"{base}[{key}]", fn)
+
+
+def register_family(base: str, resolver: Callable[[str], Optional[Program]]):
+    """Install a resolver that can rebuild ``base[key]`` instances from a
+    persisted hint at boot (before any live call constructs them)."""
+    with _registry_lock:
+        _FAMILIES[base] = resolver
+
+
+def get(name: str) -> Optional[Program]:
+    with _registry_lock:
+        prog = _REGISTRY.get(name)
+    if prog is not None:
+        return prog
+    if name.endswith("]") and "[" in name:
+        base, key = name[:-1].split("[", 1)
+        with _registry_lock:
+            resolver = _FAMILIES.get(base)
+        if resolver is not None:
+            try:
+                return resolver(key)
+            except Exception as e:  # noqa: BLE001 - unresolvable hint
+                logger.warning("cannot rebuild %s: %s", name, e)
+    return None
+
+
+def all_programs() -> Dict[str, Program]:
+    with _registry_lock:
+        return dict(_REGISTRY)
+
+
+def _ensure_registered() -> None:
+    """Import every module that registers hot programs, so summaries,
+    hints, and the prewarm plan see the full registry regardless of
+    which subsystem the process booted first."""
+    for mod in (
+        "kmamiz_tpu.graph.store",
+        "kmamiz_tpu.ops.window",
+        "kmamiz_tpu.ops.scorers",
+        "kmamiz_tpu.server.processor",
+        "kmamiz_tpu.models.serving",
+        "kmamiz_tpu.models.stacked",
+    ):
+        try:
+            importlib.import_module(mod)
+        except Exception as e:  # noqa: BLE001 - optional dep gated elsewhere
+            logger.debug("registry import %s failed: %s", mod, e)
+
+
+# ---------------------------------------------------------------------------
+# telemetry summaries
+# ---------------------------------------------------------------------------
+
+
+def summary() -> dict:
+    """Per-program counters for /health/timings and the warm-boot probe."""
+    progs = {name: p.stats() for name, p in sorted(all_programs().items())}
+    return {
+        "programs": progs,
+        "totalCompiles": sum(p["compiles"] for p in progs.values()),
+        "totalCompileMs": round(
+            sum(p["compileMs"] for p in progs.values()), 1
+        ),
+        "warm": warm_state(),
+    }
+
+
+def snapshot() -> Dict[str, int]:
+    """Compile-count snapshot; diff with :func:`new_compiles_since`."""
+    return {name: p.compiles for name, p in all_programs().items()}
+
+
+def new_compiles_since(snap: Dict[str, int]) -> Dict[str, int]:
+    """Programs that compiled since ``snap`` (steady state must be {})."""
+    out = {}
+    for name, p in all_programs().items():
+        delta = p.compiles - snap.get(name, 0)
+        if delta > 0:
+            out[name] = delta
+    return out
+
+
+# ---------------------------------------------------------------------------
+# persisted shape hints
+# ---------------------------------------------------------------------------
+
+_hints_lock = threading.Lock()
+_HINTS_VERSION = 1
+
+
+def hints_path() -> Optional[str]:
+    path = os.environ.get("KMAMIZ_SHAPE_HINTS")
+    if path:
+        return path
+    cache_dir = os.environ.get("KMAMIZ_COMPILE_CACHE_DIR")
+    if cache_dir:
+        return os.path.join(cache_dir, "shape_hints.json")
+    return None
+
+
+def save_hints(path: Optional[str] = None) -> Optional[str]:
+    """Write every program's observed specs (atomic replace). Returns the
+    path written, or None when hints are unconfigured."""
+    path = path or hints_path()
+    if not path:
+        return None
+    payload = {
+        "version": _HINTS_VERSION,
+        "programs": {
+            name: p.specs()
+            for name, p in sorted(all_programs().items())
+            if p.specs()
+        },
+    }
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with _hints_lock:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, path)
+    return path
+
+
+def load_hints(path: Optional[str] = None) -> Dict[str, List[Any]]:
+    path = path or hints_path()
+    if not path or not os.path.exists(path):
+        return {}
+    try:
+        with open(path) as f:
+            payload = json.load(f)
+        if payload.get("version") != _HINTS_VERSION:
+            return {}
+        out = {}
+        for name, specs in payload.get("programs", {}).items():
+            out[name] = [
+                (spec[0], spec[1]) for spec in specs if len(spec) == 2
+            ]
+        return out
+    except (OSError, ValueError, TypeError) as e:
+        logger.warning("bad shape-hint file %s: %s", path, e)
+        return {}
+
+
+def _autosave_hints() -> None:
+    """Persist on every NEW bucket observation (rare by construction:
+    pow2 bucketing bounds distinct specs to O(log) per program)."""
+    try:
+        save_hints()
+    except OSError as e:
+        logger.warning("shape-hint save failed: %s", e)
+
+
+# ---------------------------------------------------------------------------
+# boot prewarm plan + readiness state
+# ---------------------------------------------------------------------------
+
+_warm_lock = threading.Lock()
+_warm: Dict[str, Any] = {"status": "cold"}
+_warm_thread: Optional[threading.Thread] = None
+
+
+def warm_state() -> dict:
+    with _warm_lock:
+        return dict(_warm)
+
+
+def is_warming() -> bool:
+    return warm_state().get("status") == "warming"
+
+
+def ready_gate_enabled() -> bool:
+    return os.environ.get("KMAMIZ_PREWARM_READY_GATE", "1") != "0"
+
+
+def run_prewarm(
+    graph=None, hints: Optional[Dict[str, List[Any]]] = None
+) -> dict:
+    """Execute the boot prewarm plan synchronously:
+
+    1. replay every persisted (program, spec) hint — the exact buckets
+       the previous process compiled for production traffic;
+    2. for the graph-store merge family only, when NO hint covered it,
+       fall back to ``graph.prewarm_compile()`` default (rows, depth)
+       buckets (everything else is hint-driven: defaults for scorer or
+       model programs would guess capacities the deployment never uses).
+
+    Returns a report dict (also stored in :func:`warm_state`).
+    """
+    _ensure_registered()
+    t0 = time.perf_counter()
+    # the native extension's one-time lazy build (or its cached-failure
+    # probe) otherwise lands inside the first tick's combine phase — it
+    # is boot work, so the plan pays it here alongside the XLA warms
+    try:
+        from kmamiz_tpu import native
+
+        native.available()
+    except Exception:  # noqa: BLE001 - never let the probe block boot
+        logger.exception("native prewarm probe failed")
+    hints = load_hints() if hints is None else hints
+    report = {
+        "hintedPrograms": len(hints),
+        "warmed": 0,
+        "failed": 0,
+        "defaultGraphPrograms": 0,
+    }
+    for name, specs in sorted(hints.items()):
+        prog = get(name)
+        if prog is None:
+            report["failed"] += len(specs)
+            logger.warning("hint for unregistered program %s", name)
+            continue
+        for spec in specs:
+            if prog.prewarm_spec(spec):
+                report["warmed"] += 1
+            else:
+                report["failed"] += 1
+    graph_hinted = any(n.startswith("graph.") for n in hints)
+    if graph is not None and not graph_hinted:
+        try:
+            report["defaultGraphPrograms"] = graph.prewarm_compile()
+        except Exception as e:  # noqa: BLE001 - boot must survive
+            logger.warning("default graph prewarm failed: %s", e)
+    report["elapsedS"] = round(time.perf_counter() - t0, 2)
+    return report
+
+
+def start_background_prewarm(graph=None) -> Optional[threading.Thread]:
+    """Run the prewarm plan on a daemon thread; /health reports WARMING
+    (503 when the ready gate is on) until it completes. Idempotent."""
+    global _warm_thread
+    with _warm_lock:
+        if _warm["status"] in ("warming", "ready", "error"):
+            return _warm_thread
+        _warm.clear()
+        _warm.update({"status": "warming", "startedAt": time.time()})
+
+    def _run() -> None:
+        status = "ready"
+        report: Dict[str, Any] = {}
+        try:
+            report = run_prewarm(graph=graph)
+        except Exception as e:  # noqa: BLE001 - serve degraded, don't die
+            logger.exception("background prewarm failed")
+            status, report = "error", {"error": str(e)}
+        with _warm_lock:
+            _warm["status"] = status
+            _warm["report"] = report
+        logger.info("prewarm %s: %s", status, report)
+
+    _warm_thread = threading.Thread(
+        target=_run, name="kmamiz-prewarm", daemon=True
+    )
+    _warm_thread.start()
+    return _warm_thread
+
+
+def boot_prewarm_from_env(graph=None) -> None:
+    """KMAMIZ_PREWARM dispatcher for server mains: "0" off, "sync"
+    blocking, default background + readiness gate."""
+    mode = os.environ.get("KMAMIZ_PREWARM", "1")
+    if mode == "0":
+        with _warm_lock:
+            _warm.update({"status": "disabled"})
+        return
+    if mode == "sync":
+        with _warm_lock:
+            _warm.update({"status": "warming", "startedAt": time.time()})
+        report = run_prewarm(graph=graph)
+        with _warm_lock:
+            _warm.update({"status": "ready", "report": report})
+        return
+    start_background_prewarm(graph=graph)
+
+
+# ---------------------------------------------------------------------------
+# jit-site inventory (tier-1 guard test: tests/test_programs.py)
+#
+# Every `jax.jit` call site under kmamiz_tpu/ must appear in exactly one
+# of these tables, keyed "relative/path.py" -> {function name}. REGISTERED
+# sites are wrapped in a Program above/in their module; ALLOWLISTED sites
+# carry the reason they are exempt from registry coverage.
+# ---------------------------------------------------------------------------
+
+REGISTERED_JIT_SITES: Dict[str, set] = {
+    "kmamiz_tpu/graph/store.py": {
+        "_merge_edges",
+        "_window_merge",
+        "_window_edges_packed",
+        "_window_edges_compact",
+        "_window_merge_packed",
+    },
+    "kmamiz_tpu/ops/window.py": {
+        "skip_client_parents",
+        "dependency_edges",
+        "dependency_edges_packed",
+        "window_stats",
+        "service_stats",
+    },
+    "kmamiz_tpu/ops/scorers.py": {
+        "service_scores",
+        "usage_cohesion",
+        "risk_scores",
+        "dirty_edge_subset",
+        "merge_service_lanes",
+    },
+    "kmamiz_tpu/server/processor.py": {"_pack_stats"},
+    # scanner resolves inline jits to the nearest def: "fwd" is the
+    # body _jitted_forward jits (registered as models.forecast_forward),
+    # "run" the epoch blocks of epoch_runner/dp_epoch_runner
+    "kmamiz_tpu/models/serving.py": {"fwd"},
+    "kmamiz_tpu/models/stacked.py": {"run", "_batched_forward"},
+}
+
+ALLOWLISTED_JIT_SITES: Dict[str, Dict[str, str]] = {
+    "kmamiz_tpu/parallel/mesh.py": {
+        "sharded_window_stats": "multi-chip only; prewarmed via the "
+        "sharded branch of EndpointGraph.prewarm_compile",
+        "sharded_dependency_edges": "multi-chip only (see above)",
+        "sharded_dependency_edges_packed": "multi-chip only (see above)",
+        "sharded_window_edges_compact": "multi-chip only (see above)",
+        "sharded_service_scores": "multi-chip only (see above)",
+    },
+    "kmamiz_tpu/ops/pallas_kernels.py": {
+        "segment_stats_matmul": "inner kernel: dispatched only inside "
+        "window_stats' trace (registered there)",
+    },
+    "kmamiz_tpu/models/common.py": {
+        "train_step": "legacy per-slot trainer loop "
+        "(KMAMIZ_SAGE_FUSED=0 parity reference), off the serving path",
+    },
+}

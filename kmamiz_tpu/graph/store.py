@@ -25,6 +25,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from kmamiz_tpu.core import programs
 from kmamiz_tpu.core.interning import EndpointInterner, StringInterner
 from kmamiz_tpu.core.profiling import step_timer
 from kmamiz_tpu.core.spans import (
@@ -45,6 +46,7 @@ from kmamiz_tpu.ops.sortutil import (
 )
 
 
+@programs.register("graph.merge_edges")
 @jax.jit
 def _merge_edges(src_a, dst_a, dist_a, mask_a, src_b, dst_b, dist_b, mask_b):
     src = jnp.concatenate([src_a, src_b])
@@ -55,6 +57,7 @@ def _merge_edges(src_a, dst_a, dist_a, mask_a, src_b, dst_b, dist_b, mask_b):
     return s, d, ds, valid
 
 
+@programs.register("graph.window_merge")
 @partial(jax.jit, static_argnames=("max_depth",))
 def _window_merge(
     parent_idx,
@@ -88,6 +91,7 @@ def _window_merge(
     return s, d, ds, v, v.sum()
 
 
+@programs.register("graph.window_edges_packed")
 @partial(jax.jit, static_argnames=("max_depth",))
 def _window_edges_packed(parent_slot, kind, valid, endpoint_id, max_depth):
     """Walk-only kernel: this window's flat (ancestor, descendant,
@@ -105,6 +109,7 @@ def _window_edges_packed(parent_slot, kind, valid, endpoint_id, max_depth):
     )
 
 
+@programs.register("graph.window_edges_compact")
 @partial(jax.jit, static_argnames=("max_depth", "stage_cap", "packed_key"))
 def _window_edges_compact(
     parent_slot, kind, valid, endpoint_id, max_depth, stage_cap, packed_key
@@ -137,6 +142,7 @@ def _window_edges_compact(
     return s[:stage_cap], d[:stage_cap], ds[:stage_cap], v.sum()
 
 
+@programs.register("graph.window_merge_packed")
 @partial(jax.jit, static_argnames=("max_depth",))
 def _window_merge_packed(
     parent_slot, kind, valid, endpoint_id, src, dst, dist, mask, max_depth
@@ -295,6 +301,14 @@ class EndpointGraph:
         """Monotonic counter of graph state changes (merges/loads)."""
         with self._lock:
             return self._version
+
+    @property
+    def label_epoch(self) -> int:
+        """Monotonic counter of label-mapping changes; (version,
+        label_epoch) keys every derived payload (scorer caches, encoded
+        HTTP responses)."""
+        with self._lock:
+            return self._label_epoch
 
     def _ensure_ep_arrays(self, n: int) -> None:
         if len(self._ep_record) < n:

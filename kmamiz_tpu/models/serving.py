@@ -25,6 +25,7 @@ from typing import Tuple
 
 import numpy as np
 
+from kmamiz_tpu.core import programs
 from kmamiz_tpu.core.profiling import step_timer
 from kmamiz_tpu.core.spans import _pad_size
 
@@ -47,7 +48,25 @@ def _jitted_forward(model):
         lat, logit = model.forward(params, features, src, dst, mask)
         return jnp.expm1(lat), jax.nn.sigmoid(logit)
 
-    return jax.jit(fwd)
+    # registry instance per model module: the program registry tracks
+    # compiles/hints under "models.forecast_forward[<module>]" and the
+    # resolver below rebuilds it from a persisted hint at boot
+    return programs.register_instance(
+        "models.forecast_forward", model.__name__, jax.jit(fwd)
+    )
+
+
+def _resolve_forward(key: str):
+    """Hint resolver: 'kmamiz_tpu.models.graphsage' -> its instrumented
+    jitted forward (models are modules; the key is the module path)."""
+    import importlib
+
+    if not key.startswith("kmamiz_tpu.models."):
+        return None
+    return _jitted_forward(importlib.import_module(key))
+
+
+programs.register_family("models.forecast_forward", _resolve_forward)
 
 
 def forecast_forward(

@@ -47,8 +47,46 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from kmamiz_tpu.core import programs
 from kmamiz_tpu.core.spans import _pad_size
 from kmamiz_tpu.models import common
+
+
+def _resolve_epoch_runner(key: str):
+    """Hint resolver for 'models.sage_epoch_block[<module>|lr|pos_weight]':
+    rebuild the jitted epoch block for a persisted training config."""
+    import importlib
+
+    mod, lr, pw = key.split("|")
+    if not mod.startswith("kmamiz_tpu.models."):
+        return None
+    return epoch_runner(importlib.import_module(mod), float(lr), float(pw))
+
+
+def _resolve_dp_epoch_runner(key: str):
+    import importlib
+
+    mod, lr, pw, axis = key.split("|")
+    if not mod.startswith("kmamiz_tpu.models."):
+        return None
+    return dp_epoch_runner(
+        importlib.import_module(mod), float(lr), float(pw), axis=axis
+    )
+
+
+def _resolve_batched_forward(key: str):
+    import importlib
+
+    if not key.startswith("kmamiz_tpu.models."):
+        return None
+    return _batched_forward(importlib.import_module(key))
+
+
+programs.register_family("models.sage_epoch_block", _resolve_epoch_runner)
+programs.register_family(
+    "models.sage_dp_epoch_block", _resolve_dp_epoch_runner
+)
+programs.register_family("models.batched_forward", _resolve_batched_forward)
 
 
 @dataclass
@@ -214,7 +252,11 @@ def epoch_runner(model, lr: float, pos_weight: float):
         )
         return params, opt_state, losses
 
-    return run
+    return programs.register_instance(
+        "models.sage_epoch_block",
+        f"{model.__name__}|{lr}|{pos_weight}",
+        run,
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -344,6 +386,15 @@ def dp_epoch_runner(
         )
         return params, opt_state, losses
 
+    # mesh-sharded runners stay unregistered (device-bound programs can't
+    # replay from a hint on a different topology); single-device
+    # microbatch runs register like the sequential block
+    if mesh is None:
+        return programs.register_instance(
+            "models.sage_dp_epoch_block",
+            f"{model.__name__}|{lr}|{pos_weight}|{axis}",
+            run,
+        )
     return run
 
 
@@ -354,8 +405,12 @@ def dp_epoch_runner(
 
 @functools.lru_cache(maxsize=16)
 def _batched_forward(model):
-    return jax.jit(
-        jax.vmap(model.forward, in_axes=(None, 0, None, None, None))
+    return programs.register_instance(
+        "models.batched_forward",
+        model.__name__,
+        jax.jit(
+            jax.vmap(model.forward, in_axes=(None, 0, None, None, None))
+        ),
     )
 
 

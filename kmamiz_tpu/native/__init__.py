@@ -33,6 +33,7 @@ _SOURCES = [
 _BUILD_DIR = _REPO_ROOT / "native" / "build"
 _LIB_PATH = _BUILD_DIR / "libkmamiz_native.so"
 _BUILD_INFO_PATH = _BUILD_DIR / "build_info.json"
+_FAIL_INFO_PATH = _BUILD_DIR / "build_failed.json"
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
@@ -87,10 +88,35 @@ def _build_is_stale() -> bool:
     return _isa_mismatch()
 
 
+def _src_mtimes() -> dict:
+    return {
+        src.name: src.stat().st_mtime for src in _SOURCES if src.exists()
+    }
+
+
+def _build_known_failed() -> bool:
+    """True when a previous process already paid the compile attempt for
+    exactly these sources on exactly this host and it failed: every fresh
+    process would otherwise re-run the full g++ wall (~10 s) inside its
+    first tick just to rediscover the same failure."""
+    import json
+
+    try:
+        info = json.loads(_FAIL_INFO_PATH.read_text())
+    except (OSError, ValueError):
+        return False
+    return (
+        info.get("cpu") == _cpu_signature()
+        and info.get("mtimes") == _src_mtimes()
+    )
+
+
 def _build() -> bool:
     import json
 
     if not all(src.exists() for src in _SOURCES):
+        return False
+    if _build_known_failed():
         return False
     _BUILD_DIR.mkdir(parents=True, exist_ok=True)
 
@@ -122,6 +148,7 @@ def _build() -> bool:
                 _BUILD_INFO_PATH.write_text(
                     json.dumps({"march": label, "cpu": _cpu_signature()})
                 )
+                _FAIL_INFO_PATH.unlink(missing_ok=True)
             except OSError:
                 pass
             return True
@@ -130,6 +157,12 @@ def _build() -> bool:
     logger.warning(
         "native build failed, using pure-Python path: %s", last_err
     )
+    try:  # negative-cache the failure so the next process skips the wall
+        _FAIL_INFO_PATH.write_text(
+            json.dumps({"cpu": _cpu_signature(), "mtimes": _src_mtimes()})
+        )
+    except OSError:
+        pass
     return False
 
 

@@ -35,6 +35,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+from kmamiz_tpu.core import programs
 from kmamiz_tpu.ops.sortutil import SENTINEL, lex_unique, scatter_compact
 
 
@@ -49,6 +50,7 @@ class ServiceScores(NamedTuple):
     is_gateway: jnp.ndarray  # bool
 
 
+@programs.register("scorers.service_scores")
 @partial(jax.jit, static_argnames=("num_services",))
 def service_scores(
     src_ep: jnp.ndarray,
@@ -264,6 +266,7 @@ class CohesionScores(NamedTuple):
     pair_valid: jnp.ndarray
 
 
+@programs.register("scorers.usage_cohesion")
 @partial(jax.jit, static_argnames=("num_services",))
 def usage_cohesion(
     src_ep: jnp.ndarray,
@@ -376,6 +379,7 @@ class RiskScores(NamedTuple):
     norm_risk: jnp.ndarray
 
 
+@programs.register("scorers.risk_scores")
 @jax.jit
 def risk_scores(
     relying_factor: jnp.ndarray,
@@ -443,6 +447,7 @@ def risk_scores(
 # only partially present) — merge_service_lanes discards them.
 
 
+@programs.register("scorers.dirty_edge_subset")
 @jax.jit
 def dirty_edge_subset(src_ep, dst_ep, dist, mask, ep_service, dirty_svc):
     """Order-preserving compaction of the edges incident to any dirty
@@ -457,6 +462,7 @@ def dirty_edge_subset(src_ep, dst_ep, dist, mask, ep_service, dirty_svc):
     return s, d, ds, kept.sum()
 
 
+@programs.register("scorers.merge_service_lanes")
 @jax.jit
 def merge_service_lanes(
     dirty_svc: jnp.ndarray, inc: ServiceScores, base: ServiceScores

@@ -24,12 +24,14 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+from kmamiz_tpu.core import programs
 from kmamiz_tpu.core.spans import KIND_CLIENT, KIND_SERVER
 
 MAX_CLIENT_SKIP = 16  # max run of consecutive CLIENT spans in a parent chain
 MAX_DEPTH = 32  # max SERVER-ancestor depth recorded (trace trees are shallow)
 
 
+@programs.register("window.skip_client_parents")
 @partial(jax.jit, static_argnames=("max_client_skip",))
 def skip_client_parents(
     parent_idx: jnp.ndarray,
@@ -55,6 +57,7 @@ def skip_client_parents(
     return jnp.where(still_client, -1, c)
 
 
+@programs.register("window.dependency_edges")
 @partial(jax.jit, static_argnames=("max_depth", "max_client_skip"))
 def dependency_edges(
     parent_idx: jnp.ndarray,
@@ -110,6 +113,7 @@ class PackedEdges(NamedTuple):
     ancestor_slot: jnp.ndarray  # int32[T*L, max_depth] (packed flat index)
 
 
+@programs.register("window.dependency_edges_packed")
 @partial(jax.jit, static_argnames=("max_depth", "max_client_skip"))
 def dependency_edges_packed(
     parent_slot: jnp.ndarray,
@@ -222,6 +226,7 @@ class WindowStats(NamedTuple):
     latest_timestamp_rel: jnp.ndarray  # int32[S] (max offset from window base)
 
 
+@programs.register("window.stats")
 @partial(jax.jit, static_argnames=("num_endpoints", "num_statuses", "backend"))
 def window_stats(
     endpoint_id: jnp.ndarray,
@@ -335,6 +340,7 @@ def window_stats(
     )
 
 
+@programs.register("window.service_stats")
 @partial(jax.jit, static_argnames=("num_services",))
 def service_stats(
     service_of_segment: jnp.ndarray,

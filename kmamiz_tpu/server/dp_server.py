@@ -23,24 +23,62 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
+from kmamiz_tpu.core import programs
 from kmamiz_tpu.server.processor import DataProcessor
 
 logger = logging.getLogger("kmamiz_tpu.dp_server")
 
 
+class _EncodedPayloadCache:
+    """Memo of encoded response bytes for version-keyed payloads.
+
+    A tick response carries the FULL merged dependency graph; under the
+    threading server a host-side retry (or parallel pollers) re-entered
+    json.dumps + gzip per request thread for byte-identical output. The
+    key rides the same (graph version, label epoch) pair the scorer
+    cache uses, so any graph/label change naturally invalidates."""
+
+    def __init__(self, max_entries: int = 4) -> None:
+        self._lock = threading.Lock()
+        self._max = max_entries
+        self._entries: "dict[tuple, bytes]" = {}
+
+    def get_or_encode(self, key: tuple, payload: dict, use_gzip: bool) -> bytes:
+        full_key = key + (use_gzip,)
+        with self._lock:
+            body = self._entries.get(full_key)
+        if body is not None:
+            return body
+        body = json.dumps(payload).encode()
+        if use_gzip:
+            body = gzip.compress(body)
+        with self._lock:
+            while len(self._entries) >= self._max:
+                self._entries.pop(next(iter(self._entries)))
+            self._entries[full_key] = body
+        return body
+
+
 def make_handler(processor: DataProcessor):
+    encoded_cache = _EncodedPayloadCache()
+
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
 
         def log_message(self, fmt: str, *args) -> None:  # quiet default logs
             logger.debug("%s " + fmt, self.address_string(), *args)
 
-        def _send_json(self, status: int, payload: dict) -> None:
-            body = json.dumps(payload).encode()
+        def _send_json(
+            self, status: int, payload: dict, cache_key: tuple = None
+        ) -> None:
             accept = self.headers.get("Accept-Encoding", "")
             encoded = "gzip" in accept
-            if encoded:
-                body = gzip.compress(body)
+            if cache_key is not None:
+                body = encoded_cache.get_or_encode(cache_key, payload, encoded)
+            else:
+                body = json.dumps(payload).encode()
+                if encoded:
+                    body = gzip.compress(body)
             self.send_response(status)
             self.send_header("Content-Type", "application/json")
             if encoded:
@@ -53,10 +91,28 @@ def make_handler(processor: DataProcessor):
             if self.path.split("?", 1)[0].rstrip("/") == "/timings":
                 from kmamiz_tpu.core.profiling import step_timer
 
-                self._send_json(200, {"phases": step_timer.summary()})
+                self._send_json(
+                    200,
+                    {
+                        "phases": step_timer.summary(),
+                        "programs": programs.summary(),
+                    },
+                )
+                return
+            warm = programs.warm_state()
+            if (
+                warm.get("status") == "warming"
+                and programs.ready_gate_enabled()
+            ):
+                self._send_json(503, {"status": "WARMING", "prewarm": warm})
                 return
             self._send_json(
-                200, {"status": "UP", "service": "kmamiz-tpu-data-processor"}
+                200,
+                {
+                    "status": "UP",
+                    "service": "kmamiz-tpu-data-processor",
+                    "prewarm": warm,
+                },
             )
 
         def do_POST(self) -> None:
@@ -133,7 +189,18 @@ def make_handler(processor: DataProcessor):
                 logger.exception("collect failed")
                 self._send_json(500, {"error": str(e)})
                 return
-            self._send_json(200, response)
+            # version-keyed encode memo: a retried uniqueId against an
+            # unchanged graph re-sends the cached bytes instead of
+            # re-encoding the full dependency payload per thread
+            self._send_json(
+                200,
+                response,
+                cache_key=(
+                    request.get("uniqueId", ""),
+                    processor.graph.version,
+                    processor.graph.label_epoch,
+                ),
+            )
 
     return Handler
 
@@ -191,14 +258,12 @@ def main() -> None:
         ),
         k8s_source=k8s,
     )
-    if os.environ.get("KMAMIZ_PREWARM", "1") != "0":
-        import time as _time
-
-        t0 = _time.time()
-        n = processor.graph.prewarm_compile()
-        logger.info(
-            "pre-warmed %d merge programs in %.1fs", n, _time.time() - t0
-        )
+    # boot prewarm plan (core/programs.py): replay persisted shape hints
+    # (exact production buckets) or the default graph merge set, on a
+    # background thread by default — GET / answers 503 WARMING until
+    # done, so a readinessProbe holds traffic off the compile walls
+    # (KMAMIZ_PREWARM=0 disables, =sync blocks boot)
+    programs.boot_prewarm_from_env(graph=processor.graph)
     server = DataProcessorServer(
         processor,
         host=os.environ.get("BIND_IP", "0.0.0.0"),

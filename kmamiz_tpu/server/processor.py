@@ -27,8 +27,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from kmamiz_tpu.core import programs
 from kmamiz_tpu.core.envoy import EnvoyLogs
-from kmamiz_tpu.core.spans import KIND_SERVER, SpanBatch, spans_to_batch
+from kmamiz_tpu.core.spans import (
+    KIND_SERVER,
+    SpanBatch,
+    _pad_size,
+    spans_to_batch,
+)
 from kmamiz_tpu.core.timeutils import to_precise
 from kmamiz_tpu.domain.endpoint_dependencies import EndpointDependencies
 from kmamiz_tpu.domain.realtime import RealtimeDataList
@@ -85,6 +91,7 @@ def _tune_gc() -> None:
         gc.set_threshold(gen0, gen1, gen2)
 
 
+@programs.register("processor.pack_stats")
 @jax.jit
 def _pack_stats(count, mean, cv, ts_rel):
     """Pack the per-segment stats into ONE device buffer so the host pays a
@@ -1183,7 +1190,16 @@ class DeviceStatsJob:
 
         self._endpoints = endpoints
         self._statuses = statuses
-        self._num_statuses = max(len(statuses), 1)
+        # shape-canonicalization (PR 3 audit): num_endpoints/num_statuses
+        # are STATIC args of window_stats, so exact counts would compile
+        # a fresh XLA program for every distinct (endpoint, status)
+        # census — the recompiles no prewarm can anticipate. Pow2 buckets
+        # bound the program set to O(log^2) and keep per-segment sums
+        # bit-identical (padded segments receive no rows; result()
+        # decodes with the bucketed stride and still iterates only the
+        # real counts).
+        num_endpoints = _pad_size(max(len(endpoints), 1))
+        self._num_statuses = _pad_size(max(len(statuses), 1))
 
         from kmamiz_tpu.parallel.mesh import active_mesh
 
@@ -1208,7 +1224,7 @@ class DeviceStatsJob:
                 put(lat.astype(np.float64)),
                 put(ts_rel),
                 put(valid),
-                num_endpoints=max(len(endpoints), 1),
+                num_endpoints=num_endpoints,
                 num_statuses=self._num_statuses,
                 backend=segment_backend(),
             )
@@ -1220,7 +1236,7 @@ class DeviceStatsJob:
                 jnp.asarray(lat.astype(np.float64)),
                 jnp.asarray(ts_rel),
                 jnp.asarray(valid),
-                num_endpoints=max(len(endpoints), 1),
+                num_endpoints=num_endpoints,
                 num_statuses=self._num_statuses,
                 backend=segment_backend(),
             )

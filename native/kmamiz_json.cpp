@@ -27,6 +27,7 @@
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <locale.h>
 #include <optional>
 #include <string>
 #include <string_view>
@@ -84,6 +85,17 @@ bool js_truthy(const JValue& v) {
       const char* last = first + v.text.size();
       if (*first == '-') ++first;
       double d = 0.0;
+#if !defined(__cpp_lib_to_chars) || __cpp_lib_to_chars < 201611L
+      // libstdc++ < 11 ships integer from_chars only: parse with strtod_l
+      // under a pinned C locale instead. Its saturation already yields the
+      // outcomes the out-of-range branch below reconstructs — overflow
+      // gives +/-inf (truthy), underflow gives 0 or a denormal (falsy /
+      // truthy), matching Python float().
+      static const locale_t c_loc = newlocale(LC_ALL_MASK, "C", (locale_t)0);
+      const std::string token(first, last);
+      d = strtod_l(token.c_str(), nullptr, c_loc);
+      return d != 0.0 && !std::isnan(d);
+#else
       auto res = std::from_chars(first, last, d, std::chars_format::general);
       if (res.ec == std::errc::result_out_of_range) {
         // overflow (huge -> inf, truthy) vs underflow (tiny -> 0, falsy),
@@ -130,6 +142,7 @@ bool js_truthy(const JValue& v) {
       }
       if (res.ec != std::errc()) return true;  // unreachable for valid tokens
       return d != 0.0 && !std::isnan(d);
+#endif
     }
     case JValue::Str:
       return !v.text.empty();

@@ -1,0 +1,153 @@
+"""Restore-fallback behavior of the native extension loader: a
+`-march=native` .so restored from a build cache onto a host with a
+different CPU signature must rebuild (toolchain present) or fall back to
+the pure-Python path (toolchain absent) — it must NEVER load as-is
+(SIGILL risk) and never crash ingest. Also covers the on-disk
+negative-cache that keeps a known-failing build from re-running the full
+compiler wall in every fresh process."""
+from __future__ import annotations
+
+import json
+import shutil
+
+import pytest
+
+from kmamiz_tpu import native
+
+
+@pytest.fixture
+def sandbox(tmp_path, monkeypatch):
+    """Point the loader at a private build dir with clean module state."""
+    build_dir = tmp_path / "build"
+    build_dir.mkdir()
+    monkeypatch.setattr(native, "_BUILD_DIR", build_dir)
+    monkeypatch.setattr(native, "_LIB_PATH", build_dir / "libkmamiz_native.so")
+    monkeypatch.setattr(
+        native, "_BUILD_INFO_PATH", build_dir / "build_info.json"
+    )
+    monkeypatch.setattr(
+        native, "_FAIL_INFO_PATH", build_dir / "build_failed.json"
+    )
+    monkeypatch.setattr(native, "_lib", None)
+    monkeypatch.setattr(native, "_load_failed", False)
+    return build_dir
+
+
+def _plant_restored_so(build_dir, march: str, cpu: str) -> None:
+    """Simulate a build-cache restore: a real .so + provenance metadata."""
+    real = native._REPO_ROOT / "native" / "build" / "libkmamiz_native.so"
+    if real.exists():
+        shutil.copy(real, build_dir / "libkmamiz_native.so")
+    else:  # toolchain-less CI: any file marks "some .so was restored"
+        (build_dir / "libkmamiz_native.so").write_bytes(b"\x7fELF-stub")
+    (build_dir / "build_info.json").write_text(
+        json.dumps({"march": march, "cpu": cpu})
+    )
+
+
+class TestIsaMismatch:
+    def test_native_so_from_other_cpu_flagged(self, sandbox):
+        _plant_restored_so(sandbox, "native", cpu="other-host-flags")
+        assert native._isa_mismatch()
+        assert native._build_is_stale()
+
+    def test_same_cpu_not_flagged(self, sandbox):
+        _plant_restored_so(sandbox, "native", cpu=native._cpu_signature())
+        assert not native._isa_mismatch()
+        assert not native._build_is_stale()
+
+    def test_generic_build_portable(self, sandbox):
+        # a -march-less .so cannot SIGILL on a smaller host: not a mismatch
+        _plant_restored_so(sandbox, "generic", cpu="other-host-flags")
+        assert not native._isa_mismatch()
+
+    def test_unknown_provenance_prefers_rebuild(self, sandbox):
+        _plant_restored_so(sandbox, "native", cpu="other-host-flags")
+        (sandbox / "build_info.json").unlink()
+        assert not native._isa_mismatch()  # unknown: allowed to load
+        assert native._build_is_stale()  # but a rebuild is preferred
+
+
+class TestRestoreLoadPaths:
+    def test_mismatch_without_toolchain_falls_back_cleanly(
+        self, sandbox, monkeypatch
+    ):
+        """Restored foreign-ISA .so + no compiler: the loader must refuse
+        the .so and every public entry point must degrade to None (the
+        pure-Python fallback), not raise."""
+        _plant_restored_so(sandbox, "native", cpu="other-host-flags")
+        monkeypatch.setattr(native, "_build", lambda: False)
+        assert native._load() is None
+        assert not native.available()
+        assert native._load_failed  # sticky: probed once per process
+        # ingest-path entry points fall back instead of crashing
+        assert native.strip_istio_proxy_prefix(["line"]) is None
+        assert native.parse_envoy_lines(["line"]) is None
+        assert native.split_groups(b"[]", 2) is None
+        assert native.process_body_groups([([], [])]) is None
+
+    def test_mismatch_with_toolchain_rebuilds(self, sandbox):
+        """Restored foreign-ISA .so + working compiler: the loader
+        rebuilds for THIS host and the rebuilt library serves calls."""
+        _plant_restored_so(sandbox, "native", cpu="other-host-flags")
+        lib = native._load()
+        if lib is None:  # environment genuinely lacks a toolchain
+            pytest.skip("no C++ toolchain available")
+        info = json.loads((sandbox / "build_info.json").read_text())
+        assert info["cpu"] == native._cpu_signature()
+        assert native.strip_istio_proxy_prefix([]) == []
+
+    def test_merely_stale_so_loads_when_rebuild_impossible(
+        self, sandbox, monkeypatch
+    ):
+        """Same host, sources newer than the .so, no toolchain: staleness
+        prefers a rebuild but must not veto the native path."""
+        real = native._REPO_ROOT / "native" / "build" / "libkmamiz_native.so"
+        if not real.exists():
+            pytest.skip("no prebuilt native library")
+        _plant_restored_so(sandbox, "native", cpu=native._cpu_signature())
+        (sandbox / "build_info.json").unlink()  # unknown provenance
+        monkeypatch.setattr(native, "_build", lambda: False)
+        assert native._load() is not None
+
+
+class TestBuildFailureNegativeCache:
+    def test_failure_recorded_and_skipped(self, sandbox, monkeypatch):
+        calls = []
+
+        def failing_run(*args, **kwargs):
+            calls.append(args)
+            raise native.subprocess.SubprocessError("no compiler")
+
+        monkeypatch.setattr(native.subprocess, "run", failing_run)
+        assert not native._build()
+        assert calls  # first process really attempts the compile
+        assert (sandbox / "build_failed.json").exists()
+
+        calls.clear()
+        assert not native._build()  # marker short-circuits
+        assert calls == []
+
+    def test_source_change_invalidates_marker(self, sandbox, monkeypatch):
+        (sandbox / "build_failed.json").write_text(
+            json.dumps(
+                {"cpu": native._cpu_signature(), "mtimes": {"stale": 0.0}}
+            )
+        )
+        assert not native._build_known_failed()
+
+    def test_other_host_marker_ignored(self, sandbox):
+        (sandbox / "build_failed.json").write_text(
+            json.dumps({"cpu": "other", "mtimes": native._src_mtimes()})
+        )
+        assert not native._build_known_failed()
+
+    def test_successful_build_clears_marker(self, sandbox):
+        (sandbox / "build_failed.json").write_text(
+            json.dumps(
+                {"cpu": native._cpu_signature(), "mtimes": {"x": 1.0}}
+            )
+        )
+        if not native._build():
+            pytest.skip("no C++ toolchain available")
+        assert not (sandbox / "build_failed.json").exists()

@@ -3,14 +3,20 @@
 Run twice against the same KMAMIZ_COMPILE_CACHE_DIR to measure the
 production restart story (VERDICT r4 #5b):
 
-  run 1 (cold cache): the pre-warm pays the real compile walls, once;
-  run 2 (warm cache): the pre-warm reloads programs from disk and the
-  first tick runs with zero compile exposure.
+  run 1 (cold cache): the boot prewarm plan pays the real compile walls,
+  once, and autosaves the exercised bucket shapes into the shape-hint
+  file next to the cache dir (core/programs.py);
+  run 2 (warm cache): the plan replays exactly those hints — populating
+  the jit dispatch caches from the persistent XLA cache — and the first
+  tick runs with zero compile exposure.
 
-Prints ONE JSON line: {"prewarm_s": ..., "first_tick_ms": ...,
-"second_tick_ms": ...}. bench.py invokes this as a subprocess for the
-warm_first_tick_ms extra; it is also a deployable smoke check
-(KMAMIZ_COMPILE_CACHE_DIR=/var/cache/kmamiz python tools/warm_boot_probe.py).
+stdout carries ONE JSON line: {"prewarm_s": ..., "first_tick_ms": ...,
+"second_tick_ms": ..., "first_tick_new_compiles": ...,
+"second_tick_new_compiles": ..., "programs": {...}}. The per-program
+compile-count / compile-ms table goes to stderr. bench.py invokes this as
+a subprocess for the warm-boot extras; it is also a deployable smoke
+check (KMAMIZ_COMPILE_CACHE_DIR=/var/cache/kmamiz python
+tools/warm_boot_probe.py).
 """
 from __future__ import annotations
 
@@ -21,8 +27,38 @@ import time
 sys.path.insert(0, "/root/repo")
 
 
+def _print_program_table(summary: dict) -> None:
+    """Per-program compile telemetry, aligned, on stderr (stdout is the
+    one-JSON-line machine contract)."""
+    rows = [
+        (name, st)
+        for name, st in sorted(summary["programs"].items())
+        if st["calls"] or st["prewarmed"]
+    ]
+    if not rows:
+        return
+    width = max(len(name) for name, _ in rows)
+    print(
+        f"{'program':<{width}}  calls  compiles  compile_ms  "
+        "prewarmed  prewarm_ms  buckets",
+        file=sys.stderr,
+    )
+    for name, st in rows:
+        print(
+            f"{name:<{width}}  {st['calls']:>5}  {st['compiles']:>8}  "
+            f"{st['compileMs']:>10.1f}  {st['prewarmed']:>9}  "
+            f"{st['prewarmMs']:>10.1f}  {len(st.get('buckets', [])):>7}",
+            file=sys.stderr,
+        )
+    print(
+        f"total: {summary['totalCompiles']} compiles, "
+        f"{summary['totalCompileMs']:.1f} ms",
+        file=sys.stderr,
+    )
+
+
 def main() -> None:
-    from kmamiz_tpu.core import compile_cache
+    from kmamiz_tpu.core import compile_cache, programs
 
     compile_cache.enable_from_env()
 
@@ -33,10 +69,14 @@ def main() -> None:
     window = json.loads(make_raw_window(2_500, 7))
     dp = DataProcessor(trace_source=lambda lb, t, lim: window)
 
+    # boot prewarm plan: replay persisted shape hints when the previous
+    # run recorded them, else the graph-store default buckets — the same
+    # plan the server mains dispatch through boot_prewarm_from_env
     t0 = time.perf_counter()
-    n_programs = dp.graph.prewarm_compile(hints=((512, 8),))
+    report = programs.run_prewarm(graph=dp.graph)
     prewarm_s = time.perf_counter() - t0
 
+    snap = programs.snapshot()
     t0 = time.perf_counter()
     dp.collect({"uniqueId": "warm-1", "lookBack": 30_000, "time": 1_000_000})
     # drain the deferred merge INSIDE the timer: the staged union is the
@@ -44,21 +84,41 @@ def main() -> None:
     # second tick below charges it identically (comparable numbers)
     dp.graph.n_edges
     first_tick_ms = (time.perf_counter() - t0) * 1000
+    first_tick_new = programs.new_compiles_since(snap)
 
     window2 = json.loads(make_raw_window(2_500, 7, t_start=10_000))
     dp2 = DataProcessor(trace_source=lambda lb, t, lim: window2)
+    snap = programs.snapshot()
     t0 = time.perf_counter()
     dp2.collect({"uniqueId": "warm-2", "lookBack": 30_000, "time": 2_000_000})
     dp2.graph.n_edges
     second_tick_ms = (time.perf_counter() - t0) * 1000
+    second_tick_new = programs.new_compiles_since(snap)
 
+    summary = programs.summary()
+    _print_program_table(summary)
     print(
         json.dumps(
             {
                 "prewarm_s": round(prewarm_s, 1),
-                "prewarm_programs": n_programs,
+                "prewarm_programs": report["warmed"]
+                + report["defaultGraphPrograms"],
+                "prewarm_report": report,
                 "first_tick_ms": round(first_tick_ms, 1),
                 "second_tick_ms": round(second_tick_ms, 1),
+                # steady-state contract: compiles a warm process still
+                # paid INSIDE the timed ticks (0 when hints covered all)
+                "first_tick_new_compiles": sum(first_tick_new.values()),
+                "second_tick_new_compiles": sum(second_tick_new.values()),
+                "programs": {
+                    name: {
+                        "compiles": st["compiles"],
+                        "compileMs": round(st["compileMs"], 1),
+                        "prewarmed": st["prewarmed"],
+                    }
+                    for name, st in sorted(summary["programs"].items())
+                    if st["calls"] or st["prewarmed"]
+                },
             }
         )
     )

@@ -1240,9 +1240,20 @@ def main() -> None:
             "value": round(spans_per_sec, 0),
             "vs_baseline": round(spans_per_sec / BASELINE_SPANS_PER_SEC, 3),
         }
+    # static-analysis cost: one full graftlint pass over the package
+    # (what the tier-1 repo-clean test and --strict CI pay)
+    t0 = time.perf_counter()
+    from kmamiz_tpu.analysis import framework as lint_framework
+
+    lint_result = lint_framework.lint_repo()
+    graftlint_repo_ms = (time.perf_counter() - t0) * 1000
+
     result = {
         **headline,
         "unit": "spans/sec",
+        "graftlint_repo_ms": round(graftlint_repo_ms, 1),
+        "graftlint_findings": len(lint_result.findings),
+        "graftlint_suppressed": len(lint_result.suppressed),
         "device_chain_spans_per_sec": round(spans_per_sec, 0),
         **e2e_extras,
         "e2e_bytes_per_span": round(e2e_bytes_per_span, 0),

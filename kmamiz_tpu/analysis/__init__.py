@@ -1,0 +1,35 @@
+"""graftlint: JAX-aware static analysis enforcing the hot-path invariants.
+
+PRs 1-3 made the tick and training paths fast by hand-establishing a set
+of invariants — every hot jit registered in core/programs.py, statics
+pow2-bucketed, buffers donated, the tick free of implicit host<->device
+transfers. This package is the mechanical guard that keeps refactors
+from silently regressing them:
+
+- :mod:`framework` — AST lint engine: rule registry, per-line
+  suppressions (``# graftlint: disable=<rule> -- reason``), text/JSON
+  reporters;
+- :mod:`callgraph` — lightweight import+call-graph walk that decides
+  which functions are reachable from the tick/serve entry points;
+- :mod:`rules` — the six shipped rules (unregistered-jit,
+  host-sync-in-hot-path, shape-hazard, dtype-drift, donation-miss,
+  unguarded-shared-state);
+- :mod:`guards` — the RUNTIME enforcement layer: a context manager
+  wrapping a dp tick in ``jax.transfer_guard("disallow")`` plus the
+  program-registry recompile counters (KMAMIZ_TRANSFER_GUARD=1 turns it
+  on in the serving process).
+
+Run it via ``python tools/graftlint.py [--strict]``; docs in
+docs/STATIC_ANALYSIS.md. This module deliberately never imports jax —
+the CLI lints the repo without paying a jax import.
+"""
+from kmamiz_tpu.analysis.framework import (  # noqa: F401
+    Finding,
+    LintContext,
+    Rule,
+    all_rules,
+    lint_paths,
+    lint_repo,
+    render_json,
+    render_text,
+)

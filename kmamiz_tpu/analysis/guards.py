@@ -1,0 +1,111 @@
+"""Runtime enforcement layer for the invariants graftlint checks statically.
+
+The static rules catch the patterns that CAUSE hot-path stalls; this
+module catches the stalls themselves:
+
+- :func:`hot_path_guard` wraps a full dp tick (or any hot section) in
+  ``jax.transfer_guard(...)`` so any IMPLICIT host<->device transfer —
+  an eager op baking a host constant, a jit dispatch on a raw numpy
+  array — raises instead of silently stalling, and diffs the program
+  registry's compile counters across the section so steady-state
+  recompiles surface as well.
+- ``KMAMIZ_TRANSFER_GUARD`` turns it on in the serving process
+  (server/dp_server.py wraps each collect tick): ``1``/``disallow``
+  raises on implicit transfers, ``log`` only logs them (jax emits the
+  transfer stack), ``0``/unset leaves the tick unguarded.
+
+Note on CPU vs TPU: with the CPU backend, device_get and same-process
+numpy views are zero-copy so only host->device constant uploads trip the
+guard; on a real TPU every implicit direction trips. The tier-1 test
+(tests/test_transfer_guard.py) runs on CPU and still catches the h2d
+class — the one PRs keep reintroducing via bare ``arr != CONST`` eager
+ops.
+"""
+from __future__ import annotations
+
+import logging
+import os
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+log = logging.getLogger("kmamiz.guards")
+
+_LEVELS = {"allow", "log", "disallow", "log_explicit", "disallow_explicit"}
+
+
+def transfer_guard_level(default: Optional[str] = None) -> Optional[str]:
+    """Map KMAMIZ_TRANSFER_GUARD to a jax transfer-guard level (or None
+    when guarding is off)."""
+    raw = os.environ.get("KMAMIZ_TRANSFER_GUARD", "").strip().lower()
+    if raw in ("", "0", "off", "false"):
+        return default
+    if raw in ("1", "on", "true"):
+        return "disallow"
+    if raw in _LEVELS:
+        return raw
+    log.warning("unrecognized KMAMIZ_TRANSFER_GUARD=%r; guarding off", raw)
+    return default
+
+
+class RecompileInGuardedSection(RuntimeError):
+    """A registered program recompiled inside a guarded hot section."""
+
+
+@dataclass
+class GuardReport:
+    level: str
+    new_compiles: Dict[str, int] = field(default_factory=dict)
+
+    @property
+    def recompiled(self) -> bool:
+        return bool(self.new_compiles)
+
+
+@contextmanager
+def hot_path_guard(
+    level: Optional[str] = None, *, require_no_recompile: bool = False
+):
+    """Run a hot section under jax.transfer_guard + registry recompile
+    accounting.
+
+    Yields a :class:`GuardReport`; after the block exits,
+    ``report.new_compiles`` maps program name -> compiles that happened
+    inside the section (steady state must be {}). With
+    ``require_no_recompile=True`` a non-empty diff raises
+    :class:`RecompileInGuardedSection` — what the tier-1 steady-state
+    test asserts.
+    """
+    import jax
+
+    from kmamiz_tpu.core import programs
+
+    resolved = level or transfer_guard_level("disallow") or "disallow"
+    snap = programs.snapshot()
+    report = GuardReport(level=resolved)
+    try:
+        with jax.transfer_guard(resolved):
+            yield report
+    finally:
+        report.new_compiles = programs.new_compiles_since(snap)
+    if report.recompiled:
+        if require_no_recompile:
+            raise RecompileInGuardedSection(
+                f"programs recompiled under guard: {report.new_compiles}"
+            )
+        log.warning(
+            "programs recompiled inside guarded section: %s",
+            report.new_compiles,
+        )
+
+
+@contextmanager
+def maybe_guarded_tick():
+    """The serving-process form: guard the tick only when
+    KMAMIZ_TRANSFER_GUARD asks for it, otherwise run unwrapped."""
+    lvl = transfer_guard_level()
+    if lvl is None:
+        yield None
+        return
+    with hot_path_guard(lvl) as report:
+        yield report

@@ -1,0 +1,821 @@
+"""The six shipped graftlint rules.
+
+Each rule is a function (module, context) -> [Finding] registered via
+framework.rule(). Shared AST plumbing (jit-site extraction, parent maps,
+taint walks) lives at the top; the rules themselves stay short.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Set
+
+from kmamiz_tpu.analysis.framework import (
+    Finding,
+    LintContext,
+    ModuleInfo,
+    rule,
+)
+
+# ---------------------------------------------------------------------------
+# shared AST plumbing
+# ---------------------------------------------------------------------------
+
+
+def _parents(tree: ast.AST) -> Dict[ast.AST, ast.AST]:
+    return {
+        child: parent
+        for parent in ast.walk(tree)
+        for child in ast.iter_child_nodes(parent)
+    }
+
+
+def _attr_chain(node: ast.AST) -> Optional[List[str]]:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return list(reversed(parts))
+    return None
+
+
+def _chain_str(node: ast.AST) -> str:
+    chain = _attr_chain(node)
+    return ".".join(chain) if chain else ""
+
+
+_JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.pjit"}
+
+
+def _is_jit_callable(node: ast.AST) -> bool:
+    """True when `node` denotes jax.jit/pjit itself (Name or Attribute)."""
+    return _chain_str(node) in _JIT_NAMES
+
+
+@dataclass
+class JitSite:
+    name: str  # function name the site binds to (old-scanner semantics)
+    line: int
+    keywords: Set[str]  # kwargs passed to jit/partial(jit, ...)
+    fn_node: Optional[ast.AST]  # wrapped FunctionDef when resolvable
+    registered_by_construction: bool  # under @programs.register / register_instance
+
+
+def _jit_decorator(dec: ast.AST) -> Optional[Set[str]]:
+    """If `dec` applies jax.jit, return its kwarg names; else None."""
+    if _is_jit_callable(dec):
+        return set()
+    if isinstance(dec, ast.Call):
+        if _is_jit_callable(dec.func):
+            return {k.arg for k in dec.keywords if k.arg}
+        # partial(jax.jit, static_argnames=...)
+        if _chain_str(dec.func) in {"partial", "functools.partial"} and dec.args:
+            if _is_jit_callable(dec.args[0]):
+                return {k.arg for k in dec.keywords if k.arg}
+    return None
+
+
+def _is_register_decorator(dec: ast.AST) -> bool:
+    if isinstance(dec, ast.Call):
+        dec = dec.func
+    chain = _attr_chain(dec)
+    return bool(chain) and chain[-1] in {"register", "register_instance"}
+
+
+def _enclosing_defs(
+    node: ast.AST, parents: Dict[ast.AST, ast.AST]
+) -> List[ast.FunctionDef]:
+    out = []
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.append(cur)
+        cur = parents.get(cur)
+    return out
+
+
+def _under_register_call(node: ast.AST, parents: Dict[ast.AST, ast.AST]) -> bool:
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, ast.Call) and _is_register_decorator(cur.func):
+            return True
+        cur = parents.get(cur)
+    return False
+
+
+def jit_sites(mod: ModuleInfo) -> List[JitSite]:
+    """Every jax.jit/pjit application in the module, bound to a function
+    name the way core/programs' guard tables expect: decorators bind to
+    the decorated def; `jax.jit(f)` binds to f (if local) else the
+    assignment target else the nearest enclosing def."""
+    parents = _parents(mod.tree)
+    local_defs: Dict[str, ast.AST] = {
+        n.name: n
+        for n in ast.walk(mod.tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+    sites: List[JitSite] = []
+    seen_calls: Set[int] = set()
+
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            kw: Optional[Set[str]] = None
+            registered = False
+            for dec in node.decorator_list:
+                got = _jit_decorator(dec)
+                if got is not None:
+                    kw = got
+                if _is_register_decorator(dec):
+                    registered = True
+            if kw is not None:
+                for dec in node.decorator_list:
+                    if isinstance(dec, ast.Call) and _jit_decorator(dec) is not None:
+                        seen_calls.add(id(dec))
+                sites.append(
+                    JitSite(node.name, node.lineno, kw, node, registered)
+                )
+
+    for node in ast.walk(mod.tree):
+        if not (isinstance(node, ast.Call) and _is_jit_callable(node.func)):
+            continue
+        if id(node) in seen_calls:
+            continue
+        kw = {k.arg for k in node.keywords if k.arg}
+        name = None
+        fn_node = None
+        if node.args and isinstance(node.args[0], ast.Name):
+            name = node.args[0].id
+            fn_node = local_defs.get(name)
+        if name is None:
+            parent = parents.get(node)
+            if isinstance(parent, ast.Assign) and len(parent.targets) == 1:
+                tgt = parent.targets[0]
+                if isinstance(tgt, ast.Name):
+                    name = tgt.id
+        if name is None:
+            enc = _enclosing_defs(node, parents)
+            name = enc[0].name if enc else "<module>"
+        registered = _under_register_call(node, parents)
+        if fn_node is not None:
+            for dec in getattr(fn_node, "decorator_list", []):
+                if _is_register_decorator(dec):
+                    registered = True
+        sites.append(JitSite(name, node.lineno, kw, fn_node, registered))
+    return sites
+
+
+def collect_jit_bound_names(ctx: LintContext) -> Set[str]:
+    names = set()
+    for mod in ctx.modules.values():
+        for site in jit_sites(mod):
+            if site.name != "<module>":
+                names.add(site.name)
+    return names
+
+
+def _walk_own(fn_node: ast.AST):
+    """Walk a function body without descending into nested defs (they
+    lint under their own qualname)."""
+    stack = list(ast.iter_child_nodes(fn_node))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def _functions(mod: ModuleInfo):
+    """(qualname-suffix, node) for every def, class-qualified."""
+
+    def visit(node, prefix):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qn = f"{prefix}{child.name}"
+                yield qn, child
+                yield from visit(child, f"{qn}.")
+            elif isinstance(child, ast.ClassDef):
+                yield from visit(child, f"{prefix}{child.name}.")
+
+    yield from visit(mod.tree, "")
+
+
+# ---------------------------------------------------------------------------
+# rule 1: unregistered-jit
+# ---------------------------------------------------------------------------
+
+
+@rule(
+    "unregistered-jit",
+    "every jax.jit/pjit/lax.scan entry point must be wrapped in the "
+    "core/programs registry or listed in its guard tables",
+)
+def check_unregistered_jit(mod: ModuleInfo, ctx: LintContext) -> List[Finding]:
+    registered = (ctx.registered_sites or {}).get(mod.rel_path, set())
+    allowlisted = (ctx.allowlisted_sites or {}).get(mod.rel_path, set())
+    sites = jit_sites(mod)
+    findings: List[Finding] = []
+    covered_names: Set[str] = set()
+    for site in sites:
+        covered = (
+            site.registered_by_construction
+            or site.name in registered
+            or site.name in allowlisted
+        )
+        if covered:
+            covered_names.add(site.name)
+        else:
+            findings.append(
+                Finding(
+                    "unregistered-jit",
+                    mod.rel_path,
+                    site.line,
+                    f"jit site '{site.name}' is not wrapped in the program "
+                    "registry and not listed in REGISTERED_JIT_SITES/"
+                    "ALLOWLISTED_JIT_SITES (core/programs.py)",
+                )
+            )
+    # stale guard entries: table names with no site in the file at all
+    site_names = {s.name for s in sites}
+    for name in sorted((registered | allowlisted) - site_names):
+        findings.append(
+            Finding(
+                "unregistered-jit",
+                mod.rel_path,
+                1,
+                f"stale guard entry: '{name}' is listed for this file in "
+                "core/programs.py but no jit site binds to it",
+            )
+        )
+    # bare lax.scan outside any covered jit: a compiled loop the registry
+    # cannot see (prewarm/recompile counters miss it)
+    parents = _parents(mod.tree)
+    for node in ast.walk(mod.tree):
+        if not (
+            isinstance(node, ast.Call)
+            and _chain_str(node.func) in {"lax.scan", "jax.lax.scan"}
+        ):
+            continue
+        chain = _enclosing_defs(node, parents)
+        names_in_chain = {fn.name for fn in chain}
+        if names_in_chain & (covered_names | registered | allowlisted):
+            continue
+        if any(
+            _is_register_decorator(d)
+            for fn in chain
+            for d in fn.decorator_list
+        ):
+            continue
+        findings.append(
+            Finding(
+                "unregistered-jit",
+                mod.rel_path,
+                node.lineno,
+                "lax.scan outside any registered jit site: this compiled "
+                "loop is invisible to the program registry",
+            )
+        )
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# rule 2: host-sync-in-hot-path
+# ---------------------------------------------------------------------------
+
+_HOST_SYNC_CALLS = {
+    "jax.device_get": "explicit device->host fetch",
+    "jax.block_until_ready": "blocks the host on device work",
+    "np.asarray": "device->host copy when fed a device array",
+    "numpy.asarray": "device->host copy when fed a device array",
+}
+
+_DEVICE_PRODUCERS = ("jnp.", "jax.")
+_HOST_PRODUCERS = {
+    "jax.device_get",
+    "np.asarray",
+    "numpy.asarray",
+    "jax.devices",
+    "jax.local_devices",
+    "jax.device_count",
+}
+# attribute reads that return host metadata, not device data
+_METADATA_ATTRS = {"shape", "size", "ndim", "dtype"}
+
+
+def _device_taint(fn_node: ast.AST, ctx: LintContext) -> Set[str]:
+    """Names in this function assigned from jnp./jax. calls or calls to
+    known jitted callables — i.e. likely device arrays."""
+    taint: Set[str] = set()
+    for node in _walk_own(fn_node):
+        if not isinstance(node, ast.Assign) or not isinstance(node.value, ast.Call):
+            continue
+        cs = _chain_str(node.value.func)
+        produces_device = (
+            cs.startswith(_DEVICE_PRODUCERS) or cs.split(".")[-1] in ctx.jit_bound_names
+        ) and cs not in _HOST_PRODUCERS
+        if not produces_device:
+            continue
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name):
+                taint.add(tgt.id)
+            elif isinstance(tgt, ast.Tuple):
+                taint.update(
+                    e.id for e in tgt.elts if isinstance(e, ast.Name)
+                )
+    return taint
+
+
+def _mentions_taint(node: ast.AST, taint: Set[str]) -> bool:
+    """Does the expression read device DATA (not host metadata like
+    .shape/.size, and not through a host producer like device_get)?"""
+    stack = [node]
+    while stack:
+        sub = stack.pop()
+        if isinstance(sub, ast.Attribute) and sub.attr in _METADATA_ATTRS:
+            continue  # x.shape[...] etc. never touch device data
+        if isinstance(sub, ast.Call):
+            cs = _chain_str(sub.func)
+            if cs in _HOST_PRODUCERS:
+                continue  # returns a host value; the sync is its own finding
+            if cs.startswith(_DEVICE_PRODUCERS):
+                return True
+        if isinstance(sub, ast.Name) and sub.id in taint:
+            return True
+        stack.extend(ast.iter_child_nodes(sub))
+    return False
+
+
+@rule(
+    "host-sync-in-hot-path",
+    "no device->host synchronization (.item(), float()/int() on device "
+    "values, np.asarray/jax.device_get/block_until_ready) in functions "
+    "reachable from the tick/serve entry points",
+)
+def check_host_sync(mod: ModuleInfo, ctx: LintContext) -> List[Finding]:
+    findings: List[Finding] = []
+    for suffix, fn_node in _functions(mod):
+        if not ctx.is_hot(f"{mod.rel_path}:{suffix}"):
+            continue
+        taint = _device_taint(fn_node, ctx)
+        for node in _walk_own(fn_node):
+            if not isinstance(node, ast.Call):
+                continue
+            cs = _chain_str(node.func)
+            if isinstance(node.func, ast.Attribute):
+                if node.func.attr == "item" and not node.args:
+                    findings.append(
+                        Finding(
+                            "host-sync-in-hot-path",
+                            mod.rel_path,
+                            node.lineno,
+                            ".item() forces a device->host sync on the hot path",
+                        )
+                    )
+                    continue
+                if node.func.attr == "block_until_ready":
+                    findings.append(
+                        Finding(
+                            "host-sync-in-hot-path",
+                            mod.rel_path,
+                            node.lineno,
+                            ".block_until_ready() stalls the hot path on device work",
+                        )
+                    )
+                    continue
+            if cs in _HOST_SYNC_CALLS:
+                if cs in {"np.asarray", "numpy.asarray"} and not (
+                    node.args and _mentions_taint(node.args[0], taint)
+                ):
+                    continue  # asarray of host data is free
+                findings.append(
+                    Finding(
+                        "host-sync-in-hot-path",
+                        mod.rel_path,
+                        node.lineno,
+                        f"{cs}() on the hot path: {_HOST_SYNC_CALLS[cs]}",
+                    )
+                )
+                continue
+            if cs in {"float", "int", "bool"} and node.args:
+                if _mentions_taint(node.args[0], taint):
+                    findings.append(
+                        Finding(
+                            "host-sync-in-hot-path",
+                            mod.rel_path,
+                            node.lineno,
+                            f"{cs}() of a device value forces a device->host "
+                            "sync on the hot path",
+                        )
+                    )
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# rule 3: shape-hazard
+# ---------------------------------------------------------------------------
+
+_BUCKET_FNS = ("pad", "pow2", "bucket")
+
+
+def _raw_shape_expr(node: ast.AST, taint: Set[str]) -> bool:
+    """Structural test: is this expression a RAW shape scalar — x.shape,
+    x.shape[i], int() of one, arithmetic over them, or a name carrying
+    one? Any other call launders the value (in particular anything
+    routed through a *pad*/*pow2*/*bucket* helper)."""
+    if isinstance(node, ast.Attribute):
+        return node.attr == "shape"
+    if isinstance(node, ast.Subscript):
+        return _raw_shape_expr(node.value, taint)
+    if isinstance(node, ast.Name):
+        return node.id in taint
+    if isinstance(node, ast.Call):
+        if _chain_str(node.func) == "int" and node.args:
+            return _raw_shape_expr(node.args[0], taint)
+        return False
+    if isinstance(node, ast.BinOp):
+        return _raw_shape_expr(node.left, taint) or _raw_shape_expr(
+            node.right, taint
+        )
+    if isinstance(node, ast.UnaryOp):
+        return _raw_shape_expr(node.operand, taint)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return any(_raw_shape_expr(e, taint) for e in node.elts)
+    if isinstance(node, ast.Starred):
+        return _raw_shape_expr(node.value, taint)
+    return False
+
+
+def _shape_taint(fn_node: ast.AST) -> Set[str]:
+    """Names assigned a raw (unbucketed) Python shape scalar."""
+    taint: Set[str] = set()
+    for node in _walk_own(fn_node):
+        if not isinstance(node, ast.Assign):
+            continue
+        values = (
+            node.value.elts
+            if isinstance(node.value, ast.Tuple)
+            else [node.value]
+        )
+        for tgt in node.targets:
+            names = (
+                [e for e in tgt.elts if isinstance(e, ast.Name)]
+                if isinstance(tgt, ast.Tuple)
+                else ([tgt] if isinstance(tgt, ast.Name) else [])
+            )
+            if isinstance(tgt, ast.Tuple) and len(values) == len(tgt.elts):
+                for e, v in zip(tgt.elts, values):
+                    if isinstance(e, ast.Name) and _raw_shape_expr(v, taint):
+                        taint.add(e.id)
+            elif isinstance(tgt, ast.Tuple):
+                # n, f = x.shape: unpacking a shape taints every target
+                if _raw_shape_expr(node.value, taint):
+                    taint.update(e.id for e in names)
+            else:
+                if names and _raw_shape_expr(node.value, taint):
+                    taint.add(names[0].id)
+    return taint
+
+
+def _arg_is_raw_shape(arg: ast.AST, taint: Set[str]) -> bool:
+    return _raw_shape_expr(arg, taint)
+
+
+@rule(
+    "shape-hazard",
+    "raw Python scalars from arr.shape must pass through pow2 bucketing "
+    "(_pad_size/_pow2) before reaching jitted calls, f-strings or cache keys",
+)
+def check_shape_hazard(mod: ModuleInfo, ctx: LintContext) -> List[Finding]:
+    findings: List[Finding] = []
+    for suffix, fn_node in _functions(mod):
+        taint = _shape_taint(fn_node)
+        # shapes interpolated into raised error messages are diagnostics,
+        # not cache keys — skip f-strings under a raise
+        in_raise = {
+            id(sub)
+            for n in _walk_own(fn_node)
+            if isinstance(n, ast.Raise)
+            for sub in ast.walk(n)
+            if isinstance(sub, ast.JoinedStr)
+        }
+        for node in _walk_own(fn_node):
+            if isinstance(node, ast.JoinedStr) and id(node) in in_raise:
+                continue
+            if isinstance(node, ast.Call):
+                callee = _chain_str(node.func).split(".")[-1]
+                if callee in ctx.jit_bound_names:
+                    for arg in list(node.args) + [k.value for k in node.keywords]:
+                        if _arg_is_raw_shape(arg, taint):
+                            findings.append(
+                                Finding(
+                                    "shape-hazard",
+                                    mod.rel_path,
+                                    node.lineno,
+                                    f"raw shape scalar passed to jitted "
+                                    f"'{callee}' without pow2 bucketing: "
+                                    "every new shape is a recompile",
+                                )
+                            )
+                            break
+            elif isinstance(node, ast.JoinedStr):
+                for part in node.values:
+                    if isinstance(part, ast.FormattedValue) and _arg_is_raw_shape(
+                        part.value, taint
+                    ):
+                        findings.append(
+                            Finding(
+                                "shape-hazard",
+                                mod.rel_path,
+                                node.lineno,
+                                "f-string built from a raw array shape "
+                                "(unbounded-cardinality key/label)",
+                            )
+                        )
+                        break
+            elif isinstance(node, ast.Subscript) and isinstance(
+                node.ctx, ast.Store
+            ):
+                if _arg_is_raw_shape(node.slice, taint):
+                    findings.append(
+                        Finding(
+                            "shape-hazard",
+                            mod.rel_path,
+                            node.lineno,
+                            "cache/dict keyed on a raw array shape: "
+                            "unbounded key cardinality (bucket it first)",
+                        )
+                    )
+            elif isinstance(node, ast.Dict):
+                for key in node.keys:
+                    if key is not None and _arg_is_raw_shape(key, taint):
+                        findings.append(
+                            Finding(
+                                "shape-hazard",
+                                mod.rel_path,
+                                node.lineno,
+                                "dict literal keyed on a raw array shape "
+                                "(bucket it first)",
+                            )
+                        )
+                        break
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# rule 4: dtype-drift
+# ---------------------------------------------------------------------------
+
+_JNP_CTORS = {"zeros", "ones", "full", "empty", "arange", "linspace", "eye"}
+
+
+@rule(
+    "dtype-drift",
+    "no float64 on the hot path (TPUs emulate it in software) and no "
+    "jnp constructors relying on the ambient default dtype",
+)
+def check_dtype_drift(mod: ModuleInfo, ctx: LintContext) -> List[Finding]:
+    findings: List[Finding] = []
+    for suffix, fn_node in _functions(mod):
+        hot = ctx.is_hot(f"{mod.rel_path}:{suffix}")
+        for node in _walk_own(fn_node):
+            if isinstance(node, ast.Call):
+                chain = _attr_chain(node.func)
+                if (
+                    chain
+                    and len(chain) == 2
+                    and chain[0] == "jnp"
+                    and chain[1] in _JNP_CTORS
+                ):
+                    # dtype may ride positionally: zeros/ones/empty take
+                    # it 2nd, full 3rd (after the fill value)
+                    pos_ok = len(node.args) >= (
+                        3 if chain[1] == "full" else 2
+                    ) and chain[1] not in {"arange", "linspace"}
+                    if not any(k.arg == "dtype" for k in node.keywords) and not pos_ok:
+                        findings.append(
+                            Finding(
+                                "dtype-drift",
+                                mod.rel_path,
+                                node.lineno,
+                                f"jnp.{chain[1]} without dtype=: inherits the "
+                                "ambient default and drifts across x64 configs",
+                            )
+                        )
+                if hot and isinstance(node.func, ast.Attribute):
+                    if node.func.attr == "astype" and node.args:
+                        a = node.args[0]
+                        is64 = (
+                            isinstance(a, ast.Constant) and a.value == "float64"
+                        ) or _chain_str(a) in {"np.float64", "jnp.float64"}
+                        if is64:
+                            findings.append(
+                                Finding(
+                                    "dtype-drift",
+                                    mod.rel_path,
+                                    node.lineno,
+                                    "astype(float64) on the hot path: TPUs "
+                                    "emulate f64 in software",
+                                )
+                            )
+            elif hot and isinstance(node, ast.Attribute):
+                if _chain_str(node) in {"np.float64", "jnp.float64"}:
+                    findings.append(
+                        Finding(
+                            "dtype-drift",
+                            mod.rel_path,
+                            node.lineno,
+                            "float64 dtype on the hot path: TPUs emulate "
+                            "f64 in software (use f32 + compensated sums)",
+                        )
+                    )
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# rule 5: donation-miss
+# ---------------------------------------------------------------------------
+
+_CARRY_PARAMS = {"params", "opt_state", "state", "carry", "buffers"}
+_DONATE_KW = {"donate_argnums", "donate_argnames"}
+
+
+@rule(
+    "donation-miss",
+    "jit sites that thread large carries (params/opt_state) through a "
+    "lax.scan or update step must donate them to avoid double-buffering",
+)
+def check_donation_miss(mod: ModuleInfo, ctx: LintContext) -> List[Finding]:
+    findings: List[Finding] = []
+    for site in jit_sites(mod):
+        if site.fn_node is None or site.keywords & _DONATE_KW:
+            continue
+        args = getattr(site.fn_node, "args", None)
+        if args is None:
+            continue
+        param_names = {a.arg for a in args.args + args.kwonlyargs}
+        carries = param_names & _CARRY_PARAMS
+        if not carries:
+            continue
+        has_scan = any(
+            isinstance(n, ast.Call)
+            and _chain_str(n.func) in {"lax.scan", "jax.lax.scan"}
+            for n in ast.walk(site.fn_node)
+        )
+        has_update = any(
+            isinstance(n, ast.Call)
+            and _chain_str(n.func).split(".")[-1] == "apply_updates"
+            for n in ast.walk(site.fn_node)
+        )
+        if has_scan or has_update:
+            findings.append(
+                Finding(
+                    "donation-miss",
+                    mod.rel_path,
+                    site.line,
+                    f"jit site '{site.name}' threads {sorted(carries)} "
+                    "through a scan/update without donate_argnums: the "
+                    "carry is double-buffered on device",
+                )
+            )
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# rule 6: unguarded-shared-state
+# ---------------------------------------------------------------------------
+
+_MUTABLE_CTORS = {
+    "dict",
+    "list",
+    "set",
+    "defaultdict",
+    "deque",
+    "OrderedDict",
+    "Counter",
+    "bytearray",
+}
+_MUTATORS = {
+    "append",
+    "add",
+    "update",
+    "clear",
+    "pop",
+    "popitem",
+    "extend",
+    "setdefault",
+    "remove",
+    "discard",
+    "insert",
+    "appendleft",
+}
+_STATE_SCOPES = ("kmamiz_tpu/server/", "kmamiz_tpu/core/")
+
+
+def _module_mutables(mod: ModuleInfo) -> Set[str]:
+    names: Set[str] = set()
+    for node in mod.tree.body:
+        if isinstance(node, ast.If):
+            stmts = list(node.body) + list(node.orelse)
+        else:
+            stmts = [node]
+        for stmt in stmts:
+            if not isinstance(stmt, ast.Assign):
+                continue
+            v = stmt.value
+            mutable = isinstance(
+                v, (ast.Dict, ast.List, ast.Set, ast.ListComp, ast.DictComp)
+            ) or (
+                isinstance(v, ast.Call)
+                and _chain_str(v.func).split(".")[-1] in _MUTABLE_CTORS
+            )
+            if not mutable:
+                continue
+            names.update(
+                t.id for t in stmt.targets if isinstance(t, ast.Name)
+            )
+    return names
+
+
+def _lockish(expr: ast.AST) -> bool:
+    return "lock" in _chain_str(expr).lower() or (
+        isinstance(expr, ast.Call) and "lock" in _chain_str(expr.func).lower()
+    )
+
+
+@rule(
+    "unguarded-shared-state",
+    "module-level mutable containers in server/ and core/ may only be "
+    "written under a lock (or inside *_locked helpers)",
+)
+def check_unguarded_shared_state(
+    mod: ModuleInfo, ctx: LintContext
+) -> List[Finding]:
+    if not mod.rel_path.startswith(_STATE_SCOPES):
+        return []
+    shared = _module_mutables(mod)
+    if not shared:
+        return []
+    findings: List[Finding] = []
+
+    def visit(node, fn_stack, lock_depth):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fn_stack = fn_stack + [node.name]
+            # a lock held when the closure was entered does not extend
+            # into the nested def's own call time
+            lock_depth = 0
+        if isinstance(node, ast.With) and any(
+            _lockish(item.context_expr) for item in node.items
+        ):
+            lock_depth += 1
+        if fn_stack and lock_depth == 0 and not fn_stack[-1].endswith("_locked"):
+            hit = _write_to_shared(node, shared)
+            if hit:
+                findings.append(
+                    Finding(
+                        "unguarded-shared-state",
+                        mod.rel_path,
+                        node.lineno,
+                        f"module-level '{hit}' written outside a lock "
+                        "(wrap in `with <lock>:` or a *_locked helper)",
+                    )
+                )
+        for child in ast.iter_child_nodes(node):
+            visit(child, fn_stack, lock_depth)
+
+    visit(mod.tree, [], 0)
+    return findings
+
+
+def _write_to_shared(node: ast.AST, shared: Set[str]) -> Optional[str]:
+    if isinstance(node, (ast.Assign, ast.AugAssign)):
+        targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+        for t in targets:
+            if (
+                isinstance(t, ast.Subscript)
+                and isinstance(t.value, ast.Name)
+                and t.value.id in shared
+            ):
+                return t.value.id
+    elif isinstance(node, ast.Delete):
+        for t in node.targets:
+            if (
+                isinstance(t, ast.Subscript)
+                and isinstance(t.value, ast.Name)
+                and t.value.id in shared
+            ):
+                return t.value.id
+    elif isinstance(node, ast.Expr) and isinstance(node.value, ast.Call):
+        f = node.value.func
+        if (
+            isinstance(f, ast.Attribute)
+            and f.attr in _MUTATORS
+            and isinstance(f.value, ast.Name)
+            and f.value.id in shared
+        ):
+            return f.value.id
+    elif isinstance(node, ast.Global):
+        hit = [n for n in node.names if n in shared]
+        if hit:
+            return hit[0]
+    return None

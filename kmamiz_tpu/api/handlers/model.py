@@ -217,6 +217,7 @@ class ModelHandler(IRequestHandler):
                 status=409,
                 payload={
                     "error": (
+                        # graftlint: disable=shape-hazard -- 409 reject payload, a diagnostic not a cache key
                         f"feature width {feats.shape[1]} != checkpoint's "
                         f"{meta['num_features']} (train with the matching "
                         "feature layout)"

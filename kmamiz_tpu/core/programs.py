@@ -277,6 +277,7 @@ class Program:
             import jax
 
             out = self(*concrete_args, **concrete_kwargs)
+            # graftlint: disable=host-sync-in-hot-path -- prewarm deliberately blocks at boot, off the tick
             jax.block_until_ready(out)
         except Exception as e:  # noqa: BLE001 - a bad hint must not kill boot
             logger.warning("%s: prewarm failed (%s)", self.name, e)
@@ -623,6 +624,8 @@ REGISTERED_JIT_SITES: Dict[str, set] = {
         "_window_edges_packed",
         "_window_edges_compact",
         "_window_merge_packed",
+        "_edge_mask",
+        "_fit_edges",
     },
     "kmamiz_tpu/ops/window.py": {
         "skip_client_parents",

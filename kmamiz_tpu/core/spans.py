@@ -328,6 +328,7 @@ def spans_to_batch(
     rt_service_id = np.zeros(capacity, dtype=np.int32)
     status_id = np.zeros(capacity, dtype=np.int32)
     status_class = np.zeros(capacity, dtype=np.int8)
+    # graftlint: disable=dtype-drift -- host span column: latency sums stay exact in f64; device path downcasts at upload
     latency_ms = np.zeros(capacity, dtype=np.float64)
     timestamp_us = np.zeros(capacity, dtype=np.int64)
     trace_of = np.zeros(capacity, dtype=np.int32)
@@ -524,7 +525,7 @@ def raw_spans_to_batch(
         rt_service_id=rt_service_id,
         status_id=status_id,
         status_class=status_class,
-        latency_ms=_padded(parsed["latency_ms"], np.float64),
+        latency_ms=_padded(parsed["latency_ms"], np.float64),  # graftlint: disable=dtype-drift -- host span column, f64 by design (see spans_to_batch)
         timestamp_us=timestamp_us,
         timestamp_rel=timestamp_rel,
         ts_base_us=ts_base,
@@ -590,7 +591,7 @@ class RawIngestSession:
         # per-ENDPOINT winner bookkeeping: code = 2*shape_idx + is_rt
         # (session shape ids are stable, so codes stay comparable)
         self.applied_code = np.full(0, -1, np.int64)
-        self.applied_ts = np.zeros(0, np.float64)
+        self.applied_ts = np.zeros(0, np.float64)  # graftlint: disable=dtype-drift -- epoch-ms bookkeeping exceeds f32 integer range
 
     @property
     def available(self) -> bool:
@@ -711,6 +712,7 @@ def _session_batch_locked(
     # saw window-local maxima — a monotone-max equivalence).
     n_shapes = parsed["shapes_total"]
     if n_shapes:
+        # graftlint: disable=dtype-drift -- epoch-ms timestamps exceed f32 integer range
         shape_ts = np.asarray(parsed["shape_max_ts_ms"], dtype=np.float64)
         idx = np.arange(n_shapes, dtype=np.int64)
         eids_all = np.concatenate(
@@ -800,7 +802,7 @@ def _session_batch_locked(
         rt_service_id=rt_service_id,
         status_id=status_id,
         status_class=status_class,
-        latency_ms=_padded(parsed["latency_ms"], np.float64),
+        latency_ms=_padded(parsed["latency_ms"], np.float64),  # graftlint: disable=dtype-drift -- host span column, f64 by design (see spans_to_batch)
         timestamp_us=timestamp_us,
         timestamp_rel=timestamp_rel,
         ts_base_us=ts_base,

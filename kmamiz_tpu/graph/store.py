@@ -46,6 +46,34 @@ from kmamiz_tpu.ops.sortutil import (
 )
 
 
+@programs.register("graph.edge_mask")
+@jax.jit
+def _edge_mask(col):
+    """Valid-edge mask for a SENTINEL-padded column, computed inside jit
+    so the hot tick never pays an eager op whose baked host constant is
+    an implicit host->device transfer (trips jax.transfer_guard)."""
+    return col != SENTINEL
+
+
+@programs.register("graph.fit_edges")
+@partial(jax.jit, static_argnames=("cap",))
+def _fit_edges(src, dst, dist, cap):
+    """Slice or SENTINEL-pad merged edge columns to exactly `cap` rows
+    (the next pow2 capacity). Jitted for the same transfer-guard reason
+    as _edge_mask: eager jnp.full/slice ops upload host constants per
+    capacity event, which trips jax.transfer_guard on the hot tick."""
+    n = int(src.shape[0])
+    if cap <= n:
+        # compact_unique packs valid edges first, so the prefix is exact
+        return src[:cap], dst[:cap], dist[:cap]
+    fill = jnp.full(cap - n, SENTINEL, dtype=jnp.int32)
+    return (
+        jnp.concatenate([src, fill]),
+        jnp.concatenate([dst, fill]),
+        jnp.concatenate([dist, fill]),
+    )
+
+
 @programs.register("graph.merge_edges")
 @jax.jit
 def _merge_edges(src_a, dst_a, dist_a, mask_a, src_b, dst_b, dist_b, mask_b):
@@ -317,6 +345,7 @@ class EndpointGraph:
                 [self._ep_record, np.zeros(grow, dtype=bool)]
             )
             self._ep_last_ts = np.concatenate(
+                # graftlint: disable=dtype-drift -- host-side mirror; epoch-ms exceeds f32 integer range
                 [self._ep_last_ts, np.zeros(grow, dtype=np.float64)]
             )
 
@@ -329,7 +358,10 @@ class EndpointGraph:
         framework work in the ingest accounting (on this dev harness the
         copy rides a ~10 MB/s tunnel; on a TPU VM it is PCIe)."""
         t0 = time.perf_counter()
-        out = jax.block_until_ready([jnp.asarray(a) for a in host_arrays])
+        # explicit device_put (not jnp.asarray): the implicit-transfer
+        # form trips jax.transfer_guard("disallow") on a real TPU
+        # graftlint: disable=host-sync-in-hot-path -- transfer accounting: the copy must land before the kernel; blocking IS the measurement
+        out = jax.block_until_ready([jax.device_put(a) for a in host_arrays])
         ms = (time.perf_counter() - t0) * 1000.0
         self.last_transfer_ms = ms
         step_timer.record("transfer", ms)
@@ -343,6 +375,7 @@ class EndpointGraph:
 
         sh = NamedSharding(mesh, P("spans", None))
         t0 = time.perf_counter()
+        # graftlint: disable=host-sync-in-hot-path -- transfer accounting (sharded): same measurement rationale as _to_device
         out = jax.block_until_ready(
             [jax.device_put(a, sh) for a in host_arrays]
         )
@@ -490,7 +523,7 @@ class EndpointGraph:
                 self._src,
                 self._dst,
                 self._dist,
-                self._src != SENTINEL,
+                _edge_mask(self._src),
                 max_depth=depth,
             )
         else:  # overlong trace / cross-trace parent: flat gather fallback
@@ -515,7 +548,7 @@ class EndpointGraph:
                 self._src,
                 self._dst,
                 self._dist,
-                self._src != SENTINEL,
+                _edge_mask(self._src),
                 max_depth=depth,
             )
         # Defer the count sync: dispatch is async, so the tick returns without
@@ -574,11 +607,11 @@ class EndpointGraph:
                 self._src,
                 self._dst,
                 self._dist,
-                self._src != SENTINEL,
+                _edge_mask(self._src),
                 d_src,
                 d_dst,
                 d_dist,
-                d_src != SENTINEL,
+                _edge_mask(d_src),
             )
             valid_count = v.sum()
             if hasattr(valid_count, "copy_to_host_async"):
@@ -654,19 +687,16 @@ class EndpointGraph:
     def _apply_merged(self, src, dst, dist, valid_count) -> None:
         """Adopt a merged edge set: fetch the count and re-pad to the next
         power-of-2 capacity."""
-        valid_count = int(valid_count)
+        # graftlint: disable=host-sync-in-hot-path -- one async-prefetched scalar per merge drives the capacity policy
+        valid_count = int(jax.device_get(valid_count))
         new_cap = _pow2(valid_count, minimum=int(self._src.shape[0]))
         merged_len = int(src.shape[0])
-        if new_cap <= merged_len:
-            # compact_unique packs valid edges first, so the prefix is exact
-            self._src = src[:new_cap]
-            self._dst = dst[:new_cap]
-            self._dist = dist[:new_cap]
+        if new_cap == merged_len:
+            self._src, self._dst, self._dist = src, dst, dist
         else:
-            pad = jnp.full(new_cap - merged_len, SENTINEL, dtype=jnp.int32)
-            self._src = jnp.concatenate([src, pad])
-            self._dst = jnp.concatenate([dst, pad])
-            self._dist = jnp.concatenate([dist, pad])
+            self._src, self._dst, self._dist = _fit_edges(
+                src, dst, dist, cap=new_cap
+            )
         self._n_edges = valid_count
 
     def _base_edge_cols(self):
@@ -684,16 +714,17 @@ class EndpointGraph:
                 # races)
                 k = min(
                     int(s0.shape[0]),
-                    _pow2(max(int(np.asarray(c)), 1), minimum=256),
+                    # graftlint: disable=host-sync-in-hot-path -- deferred staged count, already landed via copy_to_host_async
+                    _pow2(max(int(jax.device_get(c)), 1), minimum=256),
                 )
                 if k < int(s0.shape[0]):
                     s0, d0, ds0 = s0[:k], d0[:k], ds0[:k]
-            return [s0], [d0], [ds0], [s0 != SENTINEL]
+            return [s0], [d0], [ds0], [_edge_mask(s0)]
         return (
             [self._src],
             [self._dst],
             [self._dist],
-            [self._src != SENTINEL],
+            [_edge_mask(self._src)],
         )
 
     def _preunion_staged_locked(self) -> None:
@@ -718,7 +749,8 @@ class EndpointGraph:
                 still_deferred.append(chk)
                 continue
             self._preunion_rows -= int(dev_in_c[0].size)
-            if (np.asarray(count_c) > cap_c).any():
+            # graftlint: disable=host-sync-in-hot-path -- truncation check on a prefetched per-window count
+            if (jax.device_get(count_c) > cap_c).any():
                 s_, d_, ds_, m_ = self._rewalk_staged(dev_in_c, depth_c, mesh_c)
                 srcs.append(s_)
                 dsts.append(d_)
@@ -765,7 +797,7 @@ class EndpointGraph:
             rewalk = [
                 (dev_in, depth, mesh)
                 for c, cap, dev_in, depth, mesh in checks
-                if (np.asarray(c) > cap).any()
+                if (jax.device_get(c) > cap).any()  # graftlint: disable=host-sync-in-hot-path -- prefetched count, truncated-walk gate
             ]
             if rewalk:
                 extra = [self._rewalk_staged(*r) for r in rewalk]
@@ -773,7 +805,7 @@ class EndpointGraph:
                     [s] + [e[0] for e in extra],
                     [d] + [e[1] for e in extra],
                     [ds] + [e[2] for e in extra],
-                    [s != SENTINEL] + [e[3] for e in extra],
+                    [_edge_mask(s)] + [e[3] for e in extra],
                 )
                 count = v.sum()
             self._apply_merged(s, d, ds, count)
@@ -794,7 +826,7 @@ class EndpointGraph:
         rewalk = [
             (dev_in, depth, mesh)
             for count, cap, dev_in, depth, mesh in deferred
-            if (np.asarray(count) > cap).any()
+            if (jax.device_get(count) > cap).any()  # graftlint: disable=host-sync-in-hot-path -- prefetched count, truncated-walk gate
         ]
         if rewalk:
             extra = [self._rewalk_staged(*r) for r in rewalk]
@@ -823,7 +855,7 @@ class EndpointGraph:
             if not (
                 hasattr(count, "is_ready") and not count.is_ready()
             ):
-                counts = np.asarray(count)
+                counts = jax.device_get(count)  # graftlint: disable=host-sync-in-hot-path -- is_ready()-gated: only reads counts that already landed
                 if (counts > cap).any():  # truncated: re-walk now
                     s, d, ds, m = self._rewalk_staged(dev_in, depth, mesh)
                     srcs.append(s)
@@ -1093,14 +1125,14 @@ class EndpointGraph:
         # ep_cap when the fresh mask sizes from a stale table (ADVICE r2)
         with self._lock:
             self._finalize_pending_locked()
-            mask = self._src != SENTINEL
+            mask = _edge_mask(self._src)
             src, dst, dist = self._src, self._dst, self._dist
             ep_service, ep_ml, ep_record, ep_cap = self._ep_tables_locked(
                 label_of
             )
             fresh = self._fresh_mask_locked(ep_cap, now_ms)
         if not fresh.all():
-            fresh_j = jnp.asarray(fresh)
+            fresh_j = jax.device_put(fresh)
             mask = (
                 mask
                 & fresh_j[jnp.clip(src, 0, ep_cap - 1)]
@@ -1145,9 +1177,9 @@ class EndpointGraph:
                 dst,
                 dist,
                 mask,
-                jnp.asarray(ep_service),
-                jnp.asarray(ep_ml),
-                jnp.asarray(ep_record),
+                jax.device_put(ep_service),
+                jax.device_put(ep_ml),
+                jax.device_put(ep_record),
                 num_services=svc_cap,
             )
         return scorer_ops.service_scores(
@@ -1155,9 +1187,9 @@ class EndpointGraph:
             dst,
             dist,
             mask,
-            jnp.asarray(ep_service),
-            jnp.asarray(ep_ml),
-            jnp.asarray(ep_record),
+            jax.device_put(ep_service),
+            jax.device_put(ep_ml),
+            jax.device_put(ep_record),
             num_services=svc_cap,
         )
 
@@ -1179,8 +1211,8 @@ class EndpointGraph:
             dst,
             dist,
             mask,
-            jnp.asarray(ep_service),
-            jnp.asarray(ep_record),
+            jax.device_put(ep_service),
+            jax.device_put(ep_record),
             num_services=svc_cap,
         )
 
@@ -1199,10 +1231,10 @@ class EndpointGraph:
         return stats
 
     def _count_uploads(self, arrays):
-        """jnp.asarray with upload accounting: every host->device copy on
-        the scorer path routes through here so the cache counters (and
-        the tier-1 zero-upload smoke test) see them all."""
-        out = [jnp.asarray(a) for a in arrays]
+        """Explicit device_put with upload accounting: every host->device
+        copy on the scorer path routes through here so the cache counters
+        (and the tier-1 zero-upload smoke test) see them all."""
+        out = [jax.device_put(a) for a in arrays]
         with self._lock:
             self.scorer_stats["uploads"] += len(out)
         return out
@@ -1214,7 +1246,7 @@ class EndpointGraph:
         mask fingerprint, dirty journal + floor."""
         with self._lock:
             self._finalize_pending_locked()
-            mask = self._src != SENTINEL
+            mask = _edge_mask(self._src)
             src, dst, dist = self._src, self._dst, self._dist
             ep_service, ep_ml, ep_record, ep_cap = self._ep_tables_locked(
                 label_of
@@ -1333,6 +1365,7 @@ class EndpointGraph:
             self._scorer_memo[memo_key] = result
             if len(self._scorer_prev) >= 32:
                 self._scorer_prev.clear()
+            # graftlint: disable=shape-hazard -- key ingredient is the mesh axis size (bounded), not an array shape
             self._scorer_prev[base_key] = (snap["version"], result)
         return result
 

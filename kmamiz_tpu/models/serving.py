@@ -92,15 +92,21 @@ def forecast_forward(
 
     t0 = time.perf_counter()
     with step_timer.phase("model_forward"):
+        # explicit device_put/device_get: the implicit jnp.asarray /
+        # np.asarray forms trip jax.transfer_guard("disallow") when the
+        # serving process runs with KMAMIZ_TRANSFER_GUARD=1
+        import jax
+
         lat_ms, prob = _jitted_forward(model)(
             params,
-            jnp.asarray(feats),
-            jnp.asarray(src_p),
-            jnp.asarray(dst_p),
-            jnp.asarray(mask_p),
+            jax.device_put(feats),
+            jax.device_put(src_p),
+            jax.device_put(dst_p),
+            jax.device_put(mask_p),
         )
-        lat_ms = np.asarray(lat_ms)[:n]
-        prob = np.asarray(prob)[:n]
+        # graftlint: disable=host-sync-in-hot-path -- the route returns host arrays; one fetch per forward
+        lat_ms = jax.device_get(lat_ms)[:n]
+        prob = jax.device_get(prob)[:n]  # graftlint: disable=host-sync-in-hot-path -- same fetch as the line above
     elapsed_ms = (time.perf_counter() - t0) * 1000
     with _lock:
         _stats["calls"] += 1

@@ -53,6 +53,12 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax renamed TPUCompilerParams -> CompilerParams (~0.6); take whichever
+# this jax ships
+_CompilerParams = getattr(
+    pltpu, "CompilerParams", getattr(pltpu, "TPUCompilerParams", None)
+)
+
 # block sizes: K spans x BS segments per tile; both ride the f32 (8, 128)
 # tiling and keep the one-hot tile (K*BS*4B = 1MB) well inside VMEM
 SPAN_BLOCK = 512
@@ -141,7 +147,7 @@ def segment_stats_matmul(
             jax.ShapeDtypeStruct((m, s_pad), jnp.float32),
             jax.ShapeDtypeStruct((1, s_pad), jnp.int32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary")
         ),
         interpret=interpret,

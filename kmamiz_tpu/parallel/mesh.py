@@ -29,9 +29,29 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 try:
-    from jax import shard_map
+    from jax import shard_map as _shard_map
 except ImportError:  # jax<0.5 keeps it under experimental
-    from jax.experimental.shard_map import shard_map
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+import inspect as _inspect
+
+# jax renamed the replication/varying-axes check kwarg (check_rep ->
+# check_vma around 0.6); dispatch to whichever this jax understands
+_CHECK_KW = (
+    "check_vma"
+    if "check_vma" in _inspect.signature(_shard_map).parameters
+    else "check_rep"
+)
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+    return _shard_map(
+        f,
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        **{_CHECK_KW: check_vma},
+    )
 
 from kmamiz_tpu.core.spans import KIND_SERVER, SpanBatch, spans_to_batch
 from kmamiz_tpu.ops import window as window_ops

@@ -23,6 +23,7 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
+from kmamiz_tpu.analysis import guards
 from kmamiz_tpu.core import programs
 from kmamiz_tpu.server.processor import DataProcessor
 
@@ -184,7 +185,16 @@ def make_handler(processor: DataProcessor):
                 self._send_json(400, {"error": f"bad request: {e}"})
                 return
             try:
-                response = processor.collect(request)
+                # opt-in hot-path enforcement: KMAMIZ_TRANSFER_GUARD=1
+                # runs the tick under jax.transfer_guard("disallow") and
+                # diffs the program registry's compile counters
+                with guards.maybe_guarded_tick() as guard_report:
+                    response = processor.collect(request)
+                if guard_report is not None and guard_report.recompiled:
+                    logger.warning(
+                        "collect tick recompiled programs: %s",
+                        guard_report.new_compiles,
+                    )
             except Exception as e:  # noqa: BLE001 - report, let caller fall back
                 logger.exception("collect failed")
                 self._send_json(500, {"error": str(e)})

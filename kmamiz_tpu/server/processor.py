@@ -374,9 +374,10 @@ class DataProcessor:
         abs_hour = int(min(req_time_ms, self._now_ms()) // 3_600_000)
         sel = batch.valid & (batch.kind == KIND_SERVER)
         eids = batch.endpoint_id[sel]
+        # graftlint: disable=dtype-drift -- host-side hour-bucket accumulators; f64 keeps long-run sums exact
         err4 = (batch.status_class[sel] == 4).astype(np.float64)
-        err5 = (batch.status_class[sel] == 5).astype(np.float64)
-        lat = np.asarray(batch.latency_ms, dtype=np.float64)[sel]
+        err5 = (batch.status_class[sel] == 5).astype(np.float64)  # graftlint: disable=dtype-drift -- host-side accumulator (see above)
+        lat = np.asarray(batch.latency_ms, dtype=np.float64)[sel]  # graftlint: disable=dtype-drift -- host-side accumulator (see above)
 
         scls = np.clip(
             np.asarray(batch.status_class, dtype=np.int64)[sel], 0, 5
@@ -1215,13 +1216,13 @@ class DeviceStatsJob:
             from kmamiz_tpu.parallel.mesh import sharded_window_stats
 
             sh = NamedSharding(mesh, P("spans"))
-            put = lambda a: jax.device_put(jnp.asarray(a), sh)
+            put = lambda a: jax.device_put(np.asarray(a), sh)
             stats = sharded_window_stats(
                 mesh,
                 put(eid),
                 put(sid),
                 put(scl),
-                put(lat.astype(np.float64)),
+                put(lat.astype(np.float32)),
                 put(ts_rel),
                 put(valid),
                 num_endpoints=num_endpoints,
@@ -1229,13 +1230,15 @@ class DeviceStatsJob:
                 backend=segment_backend(),
             )
         else:
+            # explicit device_put (not jnp.asarray): implicit transfers
+            # trip jax.transfer_guard("disallow") on a real TPU tick
             stats = window_ops.window_stats(
-                jnp.asarray(eid),
-                jnp.asarray(sid),
-                jnp.asarray(scl),
-                jnp.asarray(lat.astype(np.float64)),
-                jnp.asarray(ts_rel),
-                jnp.asarray(valid),
+                jax.device_put(eid),
+                jax.device_put(sid),
+                jax.device_put(scl),
+                jax.device_put(lat.astype(np.float32)),
+                jax.device_put(ts_rel),
+                jax.device_put(valid),
                 num_endpoints=num_endpoints,
                 num_statuses=self._num_statuses,
                 backend=segment_backend(),
@@ -1252,7 +1255,7 @@ class DeviceStatsJob:
             self._packed.copy_to_host_async()
 
     def result(self) -> Dict[tuple, dict]:
-        packed = jax.device_get(self._packed)
+        packed = jax.device_get(self._packed)  # graftlint: disable=host-sync-in-hot-path -- single packed fetch per tick, prefetched via copy_to_host_async
         count, mean, cv = packed[0], packed[1], packed[2]
         ts = packed[3].view(np.int32).astype(np.int64) + self._ts_base
 

@@ -1,0 +1,13 @@
+"""graftlint fixture — COLD module: the exact host-sync patterns the hot
+twin (server/processor.py) gets flagged for, but unreachable from the
+tick/serve seeds, so the call-graph gating must produce ZERO findings
+here (the fixture test asserts exact equality, which covers this)."""
+import jax
+import jax.numpy as jnp
+
+
+def export_report(arr):
+    dev = jnp.asarray(arr)
+    host = jax.device_get(dev)  # cold path: fine
+    dev.block_until_ready()  # cold path: fine
+    return float(dev.sum()), host.item()  # cold path: fine

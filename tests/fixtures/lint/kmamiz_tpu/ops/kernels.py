@@ -1,0 +1,49 @@
+"""graftlint fixture — jit registration + donation: one seeded violation
+per pattern plus a registry-wrapped clean twin."""
+from functools import partial
+
+import jax
+from jax import lax
+
+from kmamiz_tpu.core import programs
+
+
+@jax.jit
+def kernel(x, n):  # EXPECT: unregistered-jit
+    return x * n
+
+
+@programs.register("fixture.padded_kernel")
+@jax.jit
+def padded_kernel(x, n):  # clean twin: registry-wrapped
+    return x + n
+
+
+inline = jax.jit(lambda x: x - 1)  # EXPECT: unregistered-jit
+
+
+def scan_walk(xs):
+    def step(c, x):
+        return c + x, c
+
+    return lax.scan(step, 0, xs)  # EXPECT: unregistered-jit
+
+
+@programs.register("fixture.train_epoch")
+@jax.jit
+def train_epoch(params, opt_state, batch):  # EXPECT: donation-miss
+    def step(carry, x):
+        return carry, x
+
+    out, _ = lax.scan(step, (params, opt_state), batch)
+    return out
+
+
+@programs.register("fixture.train_epoch_donated")
+@partial(jax.jit, donate_argnums=(0, 1))
+def train_epoch_donated(params, opt_state, batch):  # clean twin: donated
+    def step(carry, x):
+        return carry, x
+
+    out, _ = lax.scan(step, (params, opt_state), batch)
+    return out

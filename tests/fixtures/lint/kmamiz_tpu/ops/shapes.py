@@ -1,0 +1,37 @@
+"""graftlint fixture — shape hazards: raw shape scalars into jitted
+calls, f-strings and cache keys; bucketed/diagnostic twins stay clean."""
+from kmamiz_tpu.ops.kernels import kernel
+
+
+def _pad_size(n):
+    return max(8, 1 << (int(n) - 1).bit_length())
+
+
+def prepare(arr):
+    n = arr.shape[0]
+    return kernel(arr, n)  # EXPECT: shape-hazard
+
+
+def prepare_inline(arr):
+    return kernel(arr, arr.shape[0] * 2)  # EXPECT: shape-hazard
+
+
+def prepare_fstring(arr):
+    n = arr.shape[0]
+    return f"rows={n}"  # EXPECT: shape-hazard
+
+
+def prepare_keyed(cache, arr):
+    cache[arr.shape] = arr  # EXPECT: shape-hazard
+    return cache
+
+
+def prepare_clean(arr):
+    n = _pad_size(arr.shape[0])  # bucketed: launders the scalar
+    return kernel(arr, n)
+
+
+def prepare_clean_raise(arr):
+    if arr.shape[0] % 8:
+        raise ValueError(f"bad row count {arr.shape[0]}")  # diagnostic
+    return arr

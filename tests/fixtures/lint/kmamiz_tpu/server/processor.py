@@ -1,0 +1,54 @@
+"""graftlint fixture — hot seed module (mirrors the real processor's
+place in the call graph; parsed by the linter, never imported).
+
+Violation lines carry EXPECT markers naming their rule; the test
+computes the expected finding set from them and requires exact equality.
+"""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from kmamiz_tpu.cold import offline  # noqa: F401  (imported, never called)
+from kmamiz_tpu.ops import shapes
+
+
+def tick(batch):
+    dev = jnp.asarray(batch)
+    stats = jax.device_get(dev)  # EXPECT: host-sync-in-hot-path
+    flag = bool(dev.any())  # EXPECT: host-sync-in-hot-path
+    return stats, flag
+
+
+def tick_item(batch):
+    dev = jnp.asarray(batch)
+    return dev.sum().item()  # EXPECT: host-sync-in-hot-path
+
+
+def tick_float(batch):
+    dev = jnp.asarray(batch)
+    return float(dev.sum())  # EXPECT: host-sync-in-hot-path
+
+
+def tick_blocked(batch):
+    dev = jnp.asarray(batch)
+    dev.block_until_ready()  # EXPECT: host-sync-in-hot-path
+    return dev
+
+
+def tick_suppressed(batch):
+    dev = jnp.asarray(batch)
+    return jax.device_get(dev)  # graftlint: disable=host-sync-in-hot-path -- fixture: suppressed on purpose
+
+
+def tick_dtype(batch):
+    acc = np.zeros(8, dtype=np.float64)  # EXPECT: dtype-drift
+    buf = jnp.zeros(8)  # EXPECT: dtype-drift
+    wide = batch.astype("float64")  # EXPECT: dtype-drift
+    return acc, buf, wide
+
+
+def tick_clean(batch):
+    dev = jax.device_put(batch)
+    n_meta = int(dev.shape[0])  # metadata read, not a device sync
+    return shapes.prepare_clean(dev), n_meta

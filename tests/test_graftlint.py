@@ -1,0 +1,151 @@
+"""graftlint framework + rules against the seeded fixture corpus.
+
+The corpus (tests/fixtures/lint/) is a mini-repo: every violation line
+carries an `# EXPECT: <rule>` marker, clean twins sit next to each
+violation, and one cold module repeats the hot patterns to prove the
+call-graph gating. The core assertion is EXACT set equality between
+markers and findings — no unflagged violations, no false positives on
+the twins.
+"""
+from __future__ import annotations
+
+import json
+import re
+from pathlib import Path
+
+import pytest
+
+from kmamiz_tpu.analysis import framework
+
+FIXTURE_ROOT = Path(__file__).parent / "fixtures" / "lint"
+_EXPECT_RE = re.compile(r"#\s*EXPECT:\s*([\w,\s-]+)")
+
+ALL_RULES = {
+    "unregistered-jit",
+    "host-sync-in-hot-path",
+    "shape-hazard",
+    "dtype-drift",
+    "donation-miss",
+    "unguarded-shared-state",
+}
+
+
+def _expected_from_markers():
+    expected = set()
+    for path in sorted(FIXTURE_ROOT.rglob("*.py")):
+        rel = path.relative_to(FIXTURE_ROOT).as_posix()
+        for lineno, line in enumerate(path.read_text().splitlines(), 1):
+            m = _EXPECT_RE.search(line)
+            if not m:
+                continue
+            for rule in m.group(1).split(","):
+                expected.add((rel, lineno, rule.strip()))
+    return expected
+
+
+@pytest.fixture(scope="module")
+def corpus_result():
+    # empty jit tables: the corpus must not inherit the live guard tables
+    # (its processor.py path collides with a real entry)
+    return framework.lint_paths(str(FIXTURE_ROOT), tables=({}, {}))
+
+
+class TestFixtureCorpus:
+    def test_findings_match_markers_exactly(self, corpus_result):
+        got = {(f.path, f.line, f.rule) for f in corpus_result.findings}
+        expected = _expected_from_markers()
+        assert got == expected, (
+            f"missing: {sorted(expected - got)}\n"
+            f"unexpected: {sorted(got - expected)}"
+        )
+
+    def test_every_rule_catches_its_seeded_violation(self, corpus_result):
+        assert {f.rule for f in corpus_result.findings} == ALL_RULES
+
+    def test_suppressions_divert_not_delete(self, corpus_result):
+        sup = {(f.path, f.rule) for f in corpus_result.suppressed}
+        assert sup == {
+            ("kmamiz_tpu/server/processor.py", "host-sync-in-hot-path"),
+            ("kmamiz_tpu/server/state.py", "unguarded-shared-state"),
+        }
+
+    def test_strict_flags_reasonless_suppressions(self, corpus_result):
+        # state.py's suppression has no `-- reason`; processor.py's does
+        missing = corpus_result.missing_reasons()
+        assert [p for p, _ in missing] == ["kmamiz_tpu/server/state.py"]
+
+    def test_cold_twin_has_zero_findings(self, corpus_result):
+        assert not [
+            f for f in corpus_result.findings if f.path.endswith("offline.py")
+        ]
+
+
+class TestFrameworkMechanics:
+    def test_rule_subset_and_unknown_rule(self):
+        result = framework.lint_paths(
+            str(FIXTURE_ROOT), rules=["unguarded-shared-state"], tables=({}, {})
+        )
+        assert {f.rule for f in result.findings} == {"unguarded-shared-state"}
+        with pytest.raises(ValueError, match="unknown rule"):
+            framework.lint_paths(str(FIXTURE_ROOT), rules=["no-such-rule"])
+
+    def test_suppression_comment_above_line(self, tmp_path):
+        pkg = tmp_path / "kmamiz_tpu" / "server"
+        pkg.mkdir(parents=True)
+        (pkg / "m.py").write_text(
+            "_CACHE = {}\n"
+            "def f(k, v):\n"
+            "    # graftlint: disable=unguarded-shared-state -- test above-line form\n"
+            "    _CACHE[k] = v\n"
+        )
+        result = framework.lint_paths(str(tmp_path))
+        assert not result.findings and len(result.suppressed) == 1
+
+    def test_render_json_roundtrips(self, corpus_result):
+        doc = json.loads(framework.render_json(corpus_result))
+        assert doc["counts"]["findings"] == len(corpus_result.findings)
+        assert {f["rule"] for f in doc["findings"]} == ALL_RULES
+
+    def test_render_text_counts(self, corpus_result):
+        text = framework.render_text(corpus_result)
+        assert f"{len(corpus_result.findings)} finding(s)" in text
+        assert "2 suppressed" in text
+
+    def test_all_rules_registered(self):
+        assert set(framework.all_rules()) == ALL_RULES
+
+
+class TestHotGatingKnobs:
+    def test_hot_all_flags_cold_module(self):
+        result = framework.lint_paths(
+            str(FIXTURE_ROOT),
+            rules=["host-sync-in-hot-path"],
+            hot_all=True,
+        )
+        assert [f for f in result.findings if f.path.endswith("offline.py")]
+
+    def test_explicit_seed_narrows_hot_set(self):
+        result = framework.lint_paths(
+            str(FIXTURE_ROOT),
+            rules=["host-sync-in-hot-path"],
+            seeds=["kmamiz_tpu/cold/offline.py"],
+        )
+        paths = {f.path for f in result.findings}
+        assert paths == {"kmamiz_tpu/cold/offline.py"}
+
+
+class TestCLI:
+    def test_list_rules(self, capsys):
+        from tools.graftlint import main
+
+        assert main(["--list-rules"]) == 0
+        out = capsys.readouterr().out
+        for rule in ALL_RULES:
+            assert rule in out
+
+    def test_json_on_repo_parses(self, capsys):
+        from tools.graftlint import main
+
+        assert main(["--json", "kmamiz_tpu/analysis"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["findings"] == []

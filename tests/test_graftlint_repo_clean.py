@@ -1,0 +1,42 @@
+"""Tier-1: the repo itself must be graftlint-clean.
+
+Two layers: the CLI contract (``python tools/graftlint.py --strict``
+exits 0 — what CI and the pre-merge check run) and the in-process
+invariants (zero unsuppressed findings, every suppression carries a
+``-- reason``). A new hot-path host sync, shape hazard, dtype drift or
+unregistered jit anywhere under kmamiz_tpu/ fails this test with the
+offending file:line in the message.
+"""
+import subprocess
+import sys
+from pathlib import Path
+
+from kmamiz_tpu.analysis import framework
+
+REPO_ROOT = Path(__file__).parent.parent
+
+
+class TestRepoClean:
+    def test_repo_has_no_unsuppressed_findings(self):
+        result = framework.lint_repo()
+        assert not result.findings, "\n" + framework.render_text(result)
+
+    def test_every_suppression_has_a_reason(self):
+        result = framework.lint_repo()
+        missing = result.missing_reasons()
+        assert not missing, (
+            "suppressions without `-- <why>`: "
+            + ", ".join(f"{p}:{s.line}" for p, s in missing)
+        )
+
+    def test_cli_strict_exits_zero(self):
+        proc = subprocess.run(
+            [sys.executable, str(REPO_ROOT / "tools" / "graftlint.py"),
+             "--strict"],
+            cwd=REPO_ROOT,
+            capture_output=True,
+            text=True,
+            timeout=300,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "0 finding(s)" in proc.stdout

@@ -99,7 +99,7 @@ class TestRingCollectives:
     def test_ring_all_reduce_matches_psum(self):
         import jax
         from jax.sharding import PartitionSpec as P
-        from jax import shard_map
+        from kmamiz_tpu.parallel.mesh import shard_map
 
         from kmamiz_tpu.parallel import mesh as pmesh
 
@@ -125,7 +125,7 @@ class TestRingCollectives:
     def test_ring_max(self):
         import jax
         from jax.sharding import PartitionSpec as P
-        from jax import shard_map
+        from kmamiz_tpu.parallel.mesh import shard_map
 
         from kmamiz_tpu.parallel import mesh as pmesh
 
@@ -152,7 +152,7 @@ class TestRingCollectives:
         """Device i must own fully reduced chunk i after reduce-scatter."""
         import jax
         from jax.sharding import PartitionSpec as P
-        from jax import shard_map
+        from kmamiz_tpu.parallel.mesh import shard_map
 
         from kmamiz_tpu.parallel import mesh as pmesh
 
@@ -217,7 +217,7 @@ class TestHierarchicalMerge:
     def test_hierarchical_all_reduce_matches_psum(self):
         import jax
         from jax.sharding import PartitionSpec as P
-        from jax import shard_map
+        from kmamiz_tpu.parallel.mesh import shard_map
 
         from kmamiz_tpu.parallel import mesh as pmesh
 

@@ -6,7 +6,6 @@ explicitly allowlisted."""
 from __future__ import annotations
 
 import json
-import re
 from pathlib import Path
 
 import jax
@@ -326,90 +325,37 @@ class TestEncodedPayloadCache:
 
 
 # ---------------------------------------------------------------------------
-# jit-site guard
+# jit-site guard (delegates to graftlint's unregistered-jit rule: one
+# scanner — the old per-test regex walker lives on as the rule's AST
+# implementation in kmamiz_tpu/analysis/rules.py)
 # ---------------------------------------------------------------------------
-
-_JIT_RE = re.compile(r"(?<![\w.])(?:jax\.)?jit\s*\(|@jax\.jit\b")
-_DEF_RE = re.compile(r"^\s*def\s+(\w+)")
-
-
-def _jit_sites(path: Path):
-    """(function name, line) for each jax.jit call site in a file.
-
-    A decorator line (a `@...` within the 3 lines at or above the match)
-    binds to the next `def`; an inline jit binds to the nearest enclosing
-    (preceding) `def`."""
-    lines = path.read_text().splitlines()
-    sites = []
-    for i, line in enumerate(lines):
-        if "jax.jit" not in line:
-            continue
-        is_decorator = False
-        for back in range(0, 4):
-            if i - back < 0:
-                break
-            stripped = lines[i - back].lstrip()
-            if stripped.startswith("@"):
-                is_decorator = True
-                break
-            if back and not stripped.startswith(("@", ")", "#")):
-                break
-        name = None
-        if is_decorator:
-            for j in range(i + 1, min(i + 11, len(lines))):
-                m = _DEF_RE.match(lines[j])
-                if m:
-                    name = m.group(1)
-                    break
-        else:
-            for j in range(i, -1, -1):
-                m = _DEF_RE.match(lines[j])
-                if m:
-                    name = m.group(1)
-                    break
-        sites.append((name or "<module>", i + 1))
-    return sites
 
 
 class TestJitSiteGuard:
     def test_every_jit_site_registered_or_allowlisted(self):
         """New jitted entry points must join the program registry (or the
         explicit allowlist with a reason): an unregistered jit is a
-        compile wall the boot prewarm plan cannot see."""
-        covered = {
-            rel: set(names) for rel, names in programs.REGISTERED_JIT_SITES.items()
-        }
-        for rel, names in programs.ALLOWLISTED_JIT_SITES.items():
-            covered.setdefault(rel, set()).update(names)
+        compile wall the boot prewarm plan cannot see. The same rule also
+        rejects stale table entries, so the tables track reality in both
+        directions."""
+        from kmamiz_tpu.analysis import framework
 
-        offenders = []
-        for path in sorted((REPO_ROOT / "kmamiz_tpu").rglob("*.py")):
-            rel = str(path.relative_to(REPO_ROOT))
-            if rel == "kmamiz_tpu/core/programs.py":
-                continue  # documents @jax.jit in its own docstring
-            for name, lineno in _jit_sites(path):
-                if name not in covered.get(rel, set()):
-                    offenders.append(f"{rel}:{lineno} ({name})")
+        result = framework.lint_paths(
+            str(REPO_ROOT), ["kmamiz_tpu"], rules=["unregistered-jit"]
+        )
+        offenders = [f.render() for f in result.findings]
         assert not offenders, (
-            "jax.jit sites missing from programs.REGISTERED_JIT_SITES / "
-            f"ALLOWLISTED_JIT_SITES: {offenders}"
+            "jax.jit sites out of sync with programs.REGISTERED_JIT_SITES /"
+            f" ALLOWLISTED_JIT_SITES: {offenders}"
         )
 
-    def test_inventory_matches_reality(self):
-        """The guard tables must not list sites that no longer exist."""
-        actual = {}
-        for path in sorted((REPO_ROOT / "kmamiz_tpu").rglob("*.py")):
-            rel = str(path.relative_to(REPO_ROOT))
-            if rel == "kmamiz_tpu/core/programs.py":
-                continue
-            names = {n for n, _ in _jit_sites(path)}
-            if names:
-                actual[rel] = names
-        for table in (
-            programs.REGISTERED_JIT_SITES,
-            programs.ALLOWLISTED_JIT_SITES,
-        ):
-            for rel, names in table.items():
-                assert rel in actual, f"{rel} listed but has no jit sites"
-                stale = set(names) - actual[rel]
-                assert not stale, f"{rel}: stale guard entries {stale}"
+    def test_rule_sees_the_known_sites(self):
+        """Sanity: the AST scanner actually resolves the registered sites
+        (guards against a silently-empty walk making the test vacuous)."""
+        from kmamiz_tpu.analysis import rules as lint_rules
+        from kmamiz_tpu.analysis.framework import ModuleInfo
+
+        rel = "kmamiz_tpu/graph/store.py"
+        mod = ModuleInfo(rel, (REPO_ROOT / rel).read_text())
+        names = {s.name for s in lint_rules.jit_sites(mod)}
+        assert programs.REGISTERED_JIT_SITES[rel] <= names

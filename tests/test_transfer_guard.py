@@ -1,0 +1,139 @@
+"""Runtime enforcement of the hot-path invariants (analysis/guards.py).
+
+The static graftlint rules catch the *patterns* that cause hot-path
+stalls; these tests prove the runtime layer catches the stalls
+themselves — and, tier-1, that a warm dp tick survives
+``jax.transfer_guard("disallow")`` end to end with bit-exact outputs.
+"""
+import json
+
+import numpy as np
+import pytest
+
+import jax
+
+from kmamiz_tpu.analysis import guards
+from kmamiz_tpu.core import programs
+
+
+class TestLevelParsing:
+    @pytest.mark.parametrize("raw", ["", "0", "off", "false", "OFF"])
+    def test_off_values_yield_default(self, monkeypatch, raw):
+        monkeypatch.setenv("KMAMIZ_TRANSFER_GUARD", raw)
+        assert guards.transfer_guard_level() is None
+        assert guards.transfer_guard_level("log") == "log"
+
+    @pytest.mark.parametrize("raw", ["1", "on", "true", "ON"])
+    def test_on_values_mean_disallow(self, monkeypatch, raw):
+        monkeypatch.setenv("KMAMIZ_TRANSFER_GUARD", raw)
+        assert guards.transfer_guard_level() == "disallow"
+
+    @pytest.mark.parametrize("raw", ["log", "disallow", "log_explicit"])
+    def test_literal_levels_pass_through(self, monkeypatch, raw):
+        monkeypatch.setenv("KMAMIZ_TRANSFER_GUARD", raw)
+        assert guards.transfer_guard_level() == raw
+
+    def test_garbage_falls_back_to_default(self, monkeypatch):
+        monkeypatch.setenv("KMAMIZ_TRANSFER_GUARD", "sometimes")
+        assert guards.transfer_guard_level() is None
+
+
+class TestHotPathGuard:
+    def test_implicit_h2d_transfer_raises(self):
+        host = np.arange(8, dtype=np.float32)
+        with pytest.raises(Exception, match="[Dd]isallow"):
+            with guards.hot_path_guard("disallow"):
+                # eager op on a raw numpy array forces an implicit upload
+                _ = (jax.numpy.asarray(host) + host).block_until_ready()
+
+    def test_explicit_device_put_is_allowed(self):
+        host = np.arange(8, dtype=np.float32)
+        with guards.hot_path_guard("disallow") as report:
+            dev = jax.device_put(host)
+            out = dev * dev
+            np.testing.assert_array_equal(jax.device_get(out), host * host)
+        assert report.level == "disallow"
+
+    def test_recompile_accounting(self):
+        @programs.register("guard_test_square")
+        @jax.jit
+        def _square(x):
+            return x * x
+
+        dev = jax.device_put(np.arange(4, dtype=np.float32))
+        with guards.hot_path_guard("disallow") as report:
+            _square(dev)  # first call: compiles inside the section
+        assert report.new_compiles.get("guard_test_square") == 1
+        assert report.recompiled
+
+        with guards.hot_path_guard("disallow") as report:
+            _square(dev)  # warm: no new compiles
+        assert report.new_compiles == {}
+
+        with pytest.raises(guards.RecompileInGuardedSection):
+            with guards.hot_path_guard(
+                "disallow", require_no_recompile=True
+            ):
+                _square(jax.device_put(np.arange(8, dtype=np.float32)))
+
+    def test_maybe_guarded_tick_off_by_default(self, monkeypatch):
+        monkeypatch.delenv("KMAMIZ_TRANSFER_GUARD", raising=False)
+        with guards.maybe_guarded_tick() as report:
+            assert report is None
+
+    def test_maybe_guarded_tick_on(self, monkeypatch):
+        monkeypatch.setenv("KMAMIZ_TRANSFER_GUARD", "1")
+        host = np.arange(4, dtype=np.float32)
+        with pytest.raises(Exception, match="[Dd]isallow"):
+            with guards.maybe_guarded_tick():
+                _ = (jax.numpy.asarray(host) + host).block_until_ready()
+
+
+def _strip_volatile(response: dict) -> dict:
+    out = dict(response)
+    out.pop("log", None)
+    return out
+
+
+class TestGuardedTick:
+    def test_warm_tick_is_transfer_clean_and_bit_exact(self, monkeypatch):
+        """Tier-1 acceptance: a full dp tick runs under
+        transfer_guard("disallow") without tripping, and its response is
+        bit-identical to the same tick run unguarded."""
+        monkeypatch.setenv("KMAMIZ_MESH", "0")
+        from kmamiz_tpu.server.processor import DataProcessor
+        from kmamiz_tpu.synth import make_raw_window
+
+        # warm the compile caches: two full ticks on distinct windows so
+        # the guarded tick below exercises only steady-state programs
+        for seed_t in (0, 10_000):
+            window = json.loads(make_raw_window(60, 5, t_start=seed_t))
+            dp = DataProcessor(trace_source=lambda lb, t, lim: window)
+            dp.collect(
+                {"uniqueId": f"warm{seed_t}", "lookBack": 30_000,
+                 "time": 1_000_000 + seed_t}
+            )
+            dp.graph.n_edges
+
+        window = json.loads(make_raw_window(60, 5, t_start=20_000))
+        request = {
+            "uniqueId": "guarded", "lookBack": 30_000, "time": 2_000_000,
+        }
+
+        dp_ref = DataProcessor(trace_source=lambda lb, t, lim: window)
+        reference = dp_ref.collect(dict(request))
+        dp_ref.graph.n_edges
+
+        dp_guarded = DataProcessor(trace_source=lambda lb, t, lim: window)
+        with guards.hot_path_guard("disallow") as report:
+            guarded = dp_guarded.collect(dict(request))
+            dp_guarded.graph.n_edges
+
+        assert json.dumps(
+            _strip_volatile(guarded), sort_keys=True, default=str
+        ) == json.dumps(
+            _strip_volatile(reference), sort_keys=True, default=str
+        )
+        # steady state: the guarded tick must not have recompiled any
+        # registered program (both warmup windows covered every shape)
+        assert report.new_compiles == {}, report.new_compiles

@@ -1,0 +1,81 @@
+#!/usr/bin/env python
+"""graftlint CLI: lint the kmamiz_tpu package for hot-path invariant drift.
+
+    python tools/graftlint.py                 # report, exit 0
+    python tools/graftlint.py --strict        # exit 1 on any unsuppressed
+                                              # finding or reason-less
+                                              # suppression (what CI runs)
+    python tools/graftlint.py --json          # machine-readable output
+    python tools/graftlint.py kmamiz_tpu/ops  # lint a subtree
+    python tools/graftlint.py --list-rules
+
+KMAMIZ_LINT_STRICT=1 makes --strict the default (used by the tier-1
+test and pre-merge hooks). Suppress a finding in source with
+`# graftlint: disable=<rule> -- <reason>` on (or directly above) the
+flagged line; docs/STATIC_ANALYSIS.md has the full rule catalogue.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kmamiz_tpu.analysis import framework  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="graftlint", description=__doc__.splitlines()[0]
+    )
+    ap.add_argument("paths", nargs="*", help="files/dirs (default: kmamiz_tpu/)")
+    ap.add_argument(
+        "--strict",
+        action="store_true",
+        default=os.environ.get("KMAMIZ_LINT_STRICT", "") not in ("", "0"),
+        help="exit 1 on unsuppressed findings or reason-less suppressions",
+    )
+    ap.add_argument("--json", action="store_true", help="JSON report")
+    ap.add_argument(
+        "--rules", help="comma-separated rule subset (default: all)"
+    )
+    ap.add_argument(
+        "--verbose", action="store_true", help="also list suppressed findings"
+    )
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for name, r in sorted(framework.all_rules().items()):
+            print(f"{name}: {r.doc}")
+        return 0
+
+    rules = [r.strip() for r in args.rules.split(",")] if args.rules else None
+    try:
+        result = framework.lint_paths(
+            framework.repo_root(), args.paths or None, rules
+        )
+    except ValueError as exc:
+        print(f"graftlint: {exc}", file=sys.stderr)
+        return 2
+
+    if args.json:
+        print(framework.render_json(result))
+    else:
+        print(framework.render_text(result, verbose=args.verbose))
+
+    if not args.strict:
+        return 0
+    bad = len(result.findings)
+    missing = result.missing_reasons()
+    if missing:
+        for path, sup in missing:
+            print(
+                f"graftlint: strict: {path}:{sup.line}: suppression "
+                "without a reason (add `-- <why>`)",
+                file=sys.stderr,
+            )
+    return 1 if (bad or missing) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1157,6 +1157,40 @@ def main() -> None:
                     + runs[1][1].get("second_tick_new_compiles", 0)
                 )
 
+    # ---- chaos resilience (ISSUE 5) ----------------------------------------
+    # one fresh subprocess runs tools/chaos_probe.py --seed 0: all four
+    # fault-layer invariants (quarantine bit-exactness, breaker state
+    # machine, stale-graph degradation, kill -9 -> WAL replay), plus the
+    # two numbers reported here — kill -> bit-exact-restore wall time
+    # and the latency of a degraded (stale) tick serve
+    chaos_extras = {}
+    try:
+        chaos_budget_ok = (
+            time.perf_counter() - BENCH_T0
+            < int(os.environ.get("KMAMIZ_BENCH_BUDGET_S", 3000)) - 700
+        )
+    except ValueError:
+        chaos_budget_ok = True
+    if chaos_budget_ok:
+        import subprocess
+
+        try:
+            out = subprocess.run(
+                [sys.executable, "tools/chaos_probe.py", "--seed", "0"],
+                capture_output=True,
+                text=True,
+                timeout=600,
+            )
+            probe = json.loads(out.stdout.strip().splitlines()[-1])
+            chaos_extras = {
+                "chaos_probe_ok": probe["ok"],
+                "chaos_recovery_ms": probe["chaos_recovery_ms"],
+                "degraded_serve_ms": probe["degraded_serve_ms"],
+                "chaos_quarantined": probe["quarantine"]["quarantined"],
+            }
+        except Exception as err:  # noqa: BLE001 - extra, not headline
+            chaos_extras = {"chaos_probe_error": str(err)}
+
     e2e_extras = {}
     headline = None
     if e2e_phases is not None:
@@ -1278,6 +1312,7 @@ def main() -> None:
         "dp_tick_budget_ms": 5000.0,  # the reference's realtime cadence
         **sage_extras,
         **warm_boot_extras,
+        **chaos_extras,
         "chained_iters": ITERS,
         "tunnel_rtt_ms": round(rtt * 1000, 1),
         "packing_host_ms": round(packing_host_ms, 1),

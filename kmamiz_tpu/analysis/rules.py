@@ -709,7 +709,14 @@ _MUTATORS = {
     "insert",
     "appendleft",
 }
-_STATE_SCOPES = ("kmamiz_tpu/server/", "kmamiz_tpu/core/")
+_STATE_SCOPES = (
+    "kmamiz_tpu/server/",
+    "kmamiz_tpu/core/",
+    # the resilience registries (breakers, counters, quarantine default)
+    # are written from scheduler threads, server threads, AND the ingest
+    # producer at once — exactly the state this rule exists for
+    "kmamiz_tpu/resilience/",
+)
 
 
 def _module_mutables(mod: ModuleInfo) -> Set[str]:
@@ -745,8 +752,8 @@ def _lockish(expr: ast.AST) -> bool:
 
 @rule(
     "unguarded-shared-state",
-    "module-level mutable containers in server/ and core/ may only be "
-    "written under a lock (or inside *_locked helpers)",
+    "module-level mutable containers in server/, core/ and resilience/ "
+    "may only be written under a lock (or inside *_locked helpers)",
 )
 def check_unguarded_shared_state(
     mod: ModuleInfo, ctx: LintContext

@@ -13,6 +13,7 @@ from typing import Optional
 from kmamiz_tpu.api.router import IRequestHandler, Request, Response
 from kmamiz_tpu.core import programs
 from kmamiz_tpu.core.profiling import step_timer
+from kmamiz_tpu.resilience import metrics as res_metrics
 
 
 class HealthHandler(IRequestHandler):
@@ -43,6 +44,9 @@ class HealthHandler(IRequestHandler):
                 "status": "UP",
                 "serverTime": int(time.time() * 1000),
                 "prewarm": warm,
+                # resilience at a glance: breaker states, scheduler-job
+                # failure streaks, quarantine totals, watchdog trips
+                "resilience": res_metrics.resilience_summary(),
             }
         )
 
@@ -62,4 +66,7 @@ class HealthHandler(IRequestHandler):
         # per-program compile counters (compiles / compileMs / buckets):
         # a steady-state tick after warm-up must add 0 compiles
         payload["programs"] = programs.summary()
+        # ingestDropped (ring backpressure), dpFallback, breakers, WAL,
+        # quarantine, watchdog — the fault-layer counters (ISSUE 5)
+        payload["resilience"] = res_metrics.resilience_summary()
         return Response(payload=payload)

@@ -95,6 +95,47 @@ class Settings:
         default_factory=lambda: int(os.environ.get("KMAMIZ_SPAN_BATCH_PAD", "2"))
     )  # pad batches to powers of this base to bound recompilation
 
+    # resilience layer (kmamiz_tpu/resilience/, docs/RESILIENCE.md).
+    # The modules read these env vars directly (they must work without a
+    # Settings instance, e.g. in the external DP process); the fields
+    # here mirror them so one `Settings()` dump shows the whole config.
+    quarantine_dir: str = field(
+        default_factory=lambda: os.environ.get(
+            "KMAMIZ_QUARANTINE_DIR", "./kmamiz-data/quarantine"
+        )
+    )
+    ingest_max_bytes: int = field(
+        default_factory=lambda: int(
+            os.environ.get("KMAMIZ_INGEST_MAX_BYTES", str(256 * 1024 * 1024))
+        )
+    )  # trace-bomb size cap for one raw ingest payload
+    tick_deadline_ms: float = field(
+        default_factory=lambda: float(
+            os.environ.get("KMAMIZ_TICK_DEADLINE_MS", "0")
+        )
+    )  # 0 = watchdog off; >0 = degrade to last-good past this
+    wal_enabled: bool = field(
+        default_factory=lambda: os.environ.get("KMAMIZ_WAL", "0") == "1"
+    )
+    wal_dir: str = field(
+        default_factory=lambda: os.environ.get(
+            "KMAMIZ_WAL_DIR", "./kmamiz-data/wal"
+        )
+    )
+    breaker_threshold: int = field(
+        default_factory=lambda: int(
+            os.environ.get("KMAMIZ_BREAKER_THRESHOLD", "5")
+        )
+    )  # consecutive failures before an upstream breaker opens
+    breaker_cooldown_s: float = field(
+        default_factory=lambda: float(
+            os.environ.get("KMAMIZ_BREAKER_COOLDOWN_S", "30")
+        )
+    )
+    dp_timeout_s: float = field(
+        default_factory=lambda: float(os.environ.get("KMAMIZ_DP_TIMEOUT_S", "30"))
+    )  # external-DP request timeout (was a hardcoded 30)
+
     def __post_init__(self) -> None:
         k8s_host = os.environ.get("KUBERNETES_SERVICE_HOST")
         k8s_port = os.environ.get("KUBERNETES_SERVICE_PORT")

@@ -1,0 +1,50 @@
+"""Fault-tolerance layer: stay up and stay correct under faults.
+
+The reference system encodes graceful degradation exactly once — when the
+external Rust data processor fails, KMamiz falls back to in-process
+computation (ServiceOperator.ts:300-306). This package generalizes that
+single fallback into four pillars threaded through ingestion, the collect
+tick, and serving (ISSUE 5, docs/RESILIENCE.md):
+
+1. **poison-input quarantine** (`quarantine.py`) — malformed raw ingest
+   batches (truncated JSON, garbage UTF-8, schema drift, trace bombs)
+   divert to a bounded on-disk quarantine with a reason code while the
+   tick proceeds bit-exact on the surviving batches;
+2. **retry + circuit breakers** (`retry.py`, `breaker.py`) — a shared
+   jittered-exponential-backoff `Retrier` and per-upstream
+   `CircuitBreaker` (closed -> open -> half-open) wrapping the Zipkin
+   poller, the operator's external-DP call, and Mongo snapshot I/O;
+3. **tick watchdog + stale-graph degradation** (`watchdog.py`) — a
+   deadline on each collect tick; on overrun or fault the DP server
+   serves the last-good graph with explicit staleness metadata instead
+   of 500s, compile-free by construction;
+4. **crash-safe recovery** (`wal.py`) — an append-only, fsynced,
+   size-rotated ingest WAL so a kill -9 mid-tick restarts to a
+   bit-exact graph via replay through `ingest_raw_window`.
+
+All pillars are exercised by the deterministic chaos harness
+(`chaos.py` + tools/chaos_probe.py): seeded fault plans injected at the
+ingest and upstream boundaries, extending the simulator's *modeled*
+faults (kmamiz_tpu/simulator/faults.py) to *infrastructure* faults.
+
+Everything here is jax-free, dependency-free host code; observable state
+aggregates in `metrics.py` and surfaces as the `resilience` section of
+GET /health/timings and the DP server's /timings.
+"""
+from kmamiz_tpu.resilience.breaker import (  # noqa: F401
+    BreakerOpenError,
+    CircuitBreaker,
+    breaker_states,
+    get_breaker,
+)
+from kmamiz_tpu.resilience.metrics import resilience_summary  # noqa: F401
+from kmamiz_tpu.resilience.quarantine import (  # noqa: F401
+    Quarantine,
+    classify_payload,
+)
+from kmamiz_tpu.resilience.retry import Retrier  # noqa: F401
+from kmamiz_tpu.resilience.wal import IngestWAL  # noqa: F401
+from kmamiz_tpu.resilience.watchdog import (  # noqa: F401
+    TickDeadlineExceeded,
+    TickWatchdog,
+)

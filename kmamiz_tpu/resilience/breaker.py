@@ -1,0 +1,215 @@
+"""Per-upstream circuit breakers (closed -> open -> half-open).
+
+A hung or down upstream (Zipkin, the external DP, Mongo) must not wedge
+the poller: after `threshold` consecutive failures the breaker OPENS and
+every call short-circuits with `BreakerOpenError` — no connection, no
+timeout wait — until `cooldown_s` elapses. The breaker then admits a
+bounded number of HALF-OPEN probes; one success closes it, one failure
+re-opens (and restarts the cooldown).
+
+Env knobs (docs/ENVIRONMENT.md), overridable per breaker:
+
+- ``KMAMIZ_BREAKER_THRESHOLD``    (default 5) consecutive failures to open;
+- ``KMAMIZ_BREAKER_COOLDOWN_S``   (default 30) open -> half-open delay;
+- ``KMAMIZ_BREAKER_HALFOPEN_MAX`` (default 1) concurrent half-open probes.
+
+The clock is injectable (chaos harness / tests advance a fake clock);
+state transitions serialize on a per-breaker lock. Breakers register in
+a process-wide registry so `breaker_states()` can surface every
+breaker's state in the /health `resilience` section.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+CLOSED = "closed"
+OPEN = "open"
+HALF_OPEN = "half-open"
+
+
+def _env_num(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+class BreakerOpenError(RuntimeError):
+    """Raised instead of calling the upstream while the breaker is open."""
+
+    def __init__(self, name: str, retry_in_s: float) -> None:
+        super().__init__(
+            f"circuit breaker '{name}' is open (retry in {retry_in_s:.1f}s)"
+        )
+        self.breaker_name = name
+        self.retry_in_s = retry_in_s
+
+
+class CircuitBreaker:
+    def __init__(
+        self,
+        name: str,
+        threshold: Optional[int] = None,
+        cooldown_s: Optional[float] = None,
+        half_open_max: Optional[int] = None,
+        now: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self.name = name
+        self.threshold = max(
+            1,
+            int(
+                threshold
+                if threshold is not None
+                else _env_num("KMAMIZ_BREAKER_THRESHOLD", 5)
+            ),
+        )
+        self.cooldown_s = (
+            cooldown_s
+            if cooldown_s is not None
+            else _env_num("KMAMIZ_BREAKER_COOLDOWN_S", 30.0)
+        )
+        self.half_open_max = max(
+            1,
+            int(
+                half_open_max
+                if half_open_max is not None
+                else _env_num("KMAMIZ_BREAKER_HALFOPEN_MAX", 1)
+            ),
+        )
+        self._now = now
+        self._lock = threading.Lock()
+        self._state = CLOSED
+        self._consecutive_failures = 0
+        self._opened_at: Optional[float] = None
+        self._half_open_inflight = 0
+        self._stats = {"opens": 0, "shortCircuits": 0, "failures": 0}
+
+    # -- state machine -------------------------------------------------------
+
+    def _state_locked(self) -> str:
+        """Resolve OPEN -> HALF_OPEN on cooldown expiry (lazy: there is
+        no timer thread, the transition happens on the next observation)."""
+        if (
+            self._state == OPEN
+            and self._opened_at is not None
+            and self._now() - self._opened_at >= self.cooldown_s
+        ):
+            self._state = HALF_OPEN
+            self._half_open_inflight = 0
+        return self._state
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state_locked()
+
+    def allow(self) -> None:
+        """Admission check. Raises BreakerOpenError while open (or while
+        the half-open probe quota is taken); otherwise reserves a
+        half-open probe slot when probing."""
+        with self._lock:
+            state = self._state_locked()
+            if state == CLOSED:
+                return
+            if state == HALF_OPEN:
+                if self._half_open_inflight < self.half_open_max:
+                    self._half_open_inflight += 1
+                    return
+                self._stats["shortCircuits"] += 1
+                raise BreakerOpenError(self.name, 0.0)
+            self._stats["shortCircuits"] += 1
+            remaining = self.cooldown_s
+            if self._opened_at is not None:
+                remaining = max(
+                    0.0, self.cooldown_s - (self._now() - self._opened_at)
+                )
+            raise BreakerOpenError(self.name, remaining)
+
+    def record_success(self) -> None:
+        with self._lock:
+            state = self._state_locked()
+            self._consecutive_failures = 0
+            if state == HALF_OPEN:
+                self._half_open_inflight = max(
+                    0, self._half_open_inflight - 1
+                )
+            self._state = CLOSED
+
+    def record_failure(self) -> None:
+        with self._lock:
+            state = self._state_locked()
+            self._stats["failures"] += 1
+            self._consecutive_failures += 1
+            if state == HALF_OPEN:
+                # a failed probe re-opens immediately, cooldown restarts
+                self._half_open_inflight = max(
+                    0, self._half_open_inflight - 1
+                )
+                self._trip_locked()
+            elif (
+                state == CLOSED
+                and self._consecutive_failures >= self.threshold
+            ):
+                self._trip_locked()
+
+    def _trip_locked(self) -> None:
+        self._state = OPEN
+        self._opened_at = self._now()
+        self._stats["opens"] += 1
+
+    def call(self, fn: Callable, *args, **kwargs):
+        """allow() -> fn() -> record_{success,failure}. The upstream's
+        exception propagates after being recorded."""
+        self.allow()
+        try:
+            result = fn(*args, **kwargs)
+        except Exception:
+            self.record_failure()
+            raise
+        self.record_success()
+        return result
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            state = self._state_locked()
+            return {
+                "state": state,
+                "consecutiveFailures": self._consecutive_failures,
+                "threshold": self.threshold,
+                "cooldownS": self.cooldown_s,
+                "opens": self._stats["opens"],
+                "failures": self._stats["failures"],
+                "shortCircuits": self._stats["shortCircuits"],
+            }
+
+
+# -- process-wide registry ---------------------------------------------------
+
+_REGISTRY_LOCK = threading.Lock()
+_REGISTRY: Dict[str, CircuitBreaker] = {}
+
+
+def get_breaker(name: str, **kwargs) -> CircuitBreaker:
+    """The process-wide breaker for an upstream, created on first use.
+    kwargs apply only at creation (all call sites of one upstream share
+    one breaker and therefore one failure budget)."""
+    with _REGISTRY_LOCK:
+        breaker = _REGISTRY.get(name)
+        if breaker is None:
+            breaker = CircuitBreaker(name, **kwargs)
+            _REGISTRY[name] = breaker
+        return breaker
+
+
+def breaker_states() -> Dict[str, dict]:
+    with _REGISTRY_LOCK:
+        breakers = dict(_REGISTRY)
+    return {name: b.snapshot() for name, b in breakers.items()}
+
+
+def reset_for_tests() -> None:
+    with _REGISTRY_LOCK:
+        _REGISTRY.clear()

@@ -1,0 +1,187 @@
+"""Deterministic chaos harness: seeded fault plans for every pillar.
+
+The simulator already injects *modeled* faults (latency inflation,
+error-rate spikes — simulator/faults.py) to test detection quality.
+This module injects *infrastructure* faults — malformed bytes, dead
+upstreams, stalled ticks, kill -9 — to test that the pipeline survives
+them. Everything derives from a single integer seed, so a failing chaos
+run reproduces exactly with the same seed (tools/chaos_probe.py
+``--seed``).
+
+Pieces:
+
+- `FaultPlan(seed)` — a seeded schedule assigning each ingest batch a
+  fault kind (`none`, `drop`, `truncate`, `corrupt`, `schema`, `bomb`)
+  and each upstream call an action (`ok`, `fail`, `delay`, `hang`);
+- `mutate_payload(raw, kind, rng)` — turn a clean raw Zipkin payload
+  into the requested poison (or None for `drop`), each kind landing in
+  a distinct quarantine reason code;
+- `chaos_chunks(chunks, plan)` — wrap a clean chunk stream, yielding
+  mutated payloads while recording which survive untouched (the
+  bit-exactness oracle);
+- `ChaosUpstream(fn, plan)` — wrap an upstream callable with scheduled
+  failures/delays/hangs to exercise Retrier + CircuitBreaker;
+- `graph_signature(graph)` — order-independent sha256 over the masked
+  (src, dst, distinct) edge triples, the equality oracle for both the
+  quarantine bit-exactness check and the kill -> WAL-replay check.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import random
+import time
+from typing import Callable, Iterable, Iterator, List, Optional, Sequence, Tuple
+
+PAYLOAD_FAULTS = ("none", "drop", "truncate", "corrupt", "schema", "bomb")
+UPSTREAM_ACTIONS = ("ok", "fail", "delay", "hang")
+
+
+class FaultPlan:
+    """Seeded fault schedule. Two independent streams (payload faults,
+    upstream actions) are derived from the seed, so adding upstream
+    calls never reshuffles the payload faults of an existing scenario."""
+
+    def __init__(
+        self,
+        seed: int,
+        payload_weights: Optional[dict] = None,
+        upstream_weights: Optional[dict] = None,
+    ) -> None:
+        self.seed = seed
+        self._payload_weights = payload_weights or {
+            "none": 0.55,
+            "drop": 0.09,
+            "truncate": 0.09,
+            "corrupt": 0.09,
+            "schema": 0.09,
+            "bomb": 0.09,
+        }
+        self._upstream_weights = upstream_weights or {
+            "ok": 0.6,
+            "fail": 0.25,
+            "delay": 0.1,
+            "hang": 0.05,
+        }
+        self._payload_rng = random.Random((seed << 1) ^ 0x9E3779B9)
+        self._upstream_rng = random.Random((seed << 1) | 1)
+        self.mutation_rng = random.Random(seed ^ 0x5DEECE66D)
+
+    @staticmethod
+    def _draw(rng: random.Random, weights: dict) -> str:
+        kinds = list(weights.keys())
+        return rng.choices(kinds, weights=[weights[k] for k in kinds], k=1)[0]
+
+    def payload_faults(self, n: int) -> List[str]:
+        return [
+            self._draw(self._payload_rng, self._payload_weights)
+            for _ in range(n)
+        ]
+
+    def upstream_actions(self, n: int) -> List[str]:
+        return [
+            self._draw(self._upstream_rng, self._upstream_weights)
+            for _ in range(n)
+        ]
+
+
+def mutate_payload(
+    raw: bytes, kind: str, rng: random.Random
+) -> Optional[bytes]:
+    """Apply one fault kind to a clean payload. Returns the poisoned
+    bytes, or None for `drop` (the batch never arrives)."""
+    if kind == "none":
+        return raw
+    if kind == "drop":
+        return None
+    if kind == "truncate":
+        # cut mid-document: valid UTF-8 prefix, invalid JSON
+        cut = rng.randint(1, max(1, len(raw) - 1))
+        return raw[:cut].decode("utf-8", errors="ignore").encode("utf-8")
+    if kind == "corrupt":
+        # splice invalid UTF-8 into the middle
+        pos = rng.randint(0, len(raw))
+        return raw[:pos] + b"\xff\xfe\xfd\xfc" + raw[pos:]
+    if kind == "schema":
+        # valid JSON, foreign shape (a metrics export, not trace groups)
+        return json.dumps(
+            {"metrics": [rng.random() for _ in range(4)], "v": 2}
+        ).encode("utf-8")
+    if kind == "bomb":
+        # structurally fine but inflated past the ingest size cap; the
+        # cap check fires before any parse, so keep it cheap to build
+        return b'[[{"pad": "' + b"A" * 4096 + b'"}]]'
+    raise ValueError(f"unknown payload fault kind: {kind}")
+
+
+def chaos_chunks(
+    chunks: Sequence[bytes], plan: FaultPlan
+) -> Tuple[List[bytes], List[int]]:
+    """Poison a clean chunk sequence per the plan. Returns (delivered
+    chunks, indices of chunks delivered untouched) — the second list is
+    the oracle: ingesting only those clean chunks must produce a graph
+    bit-exact with the chaos run's."""
+    faults = plan.payload_faults(len(chunks))
+    delivered: List[bytes] = []
+    clean_indices: List[int] = []
+    for index, (chunk, kind) in enumerate(zip(chunks, faults)):
+        mutated = mutate_payload(chunk, kind, plan.mutation_rng)
+        if mutated is None:
+            continue
+        delivered.append(mutated)
+        if kind == "none":
+            clean_indices.append(index)
+    return delivered, clean_indices
+
+
+class ChaosUpstream:
+    """Wrap an upstream callable with a scheduled action per call.
+
+    `fail` raises ConnectionError; `delay` sleeps `delay_s` then
+    succeeds; `hang` sleeps `hang_s` (callers should run it under a
+    timeout or a breaker); `ok` passes through. Calls beyond the
+    schedule succeed. `calls` records the actions actually taken."""
+
+    def __init__(
+        self,
+        fn: Callable,
+        actions: Iterable[str],
+        delay_s: float = 0.05,
+        hang_s: float = 5.0,
+        sleep: Callable[[float], None] = time.sleep,
+    ) -> None:
+        self._fn = fn
+        self._actions: Iterator[str] = iter(actions)
+        self._delay_s = delay_s
+        self._hang_s = hang_s
+        self._sleep = sleep
+        self.calls: List[str] = []
+
+    def __call__(self, *args, **kwargs):
+        action = next(self._actions, "ok")
+        self.calls.append(action)
+        if action == "fail":
+            raise ConnectionError("chaos: upstream failure injected")
+        if action == "delay":
+            self._sleep(self._delay_s)
+        elif action == "hang":
+            self._sleep(self._hang_s)
+        return self._fn(*args, **kwargs)
+
+
+def graph_signature(graph) -> str:
+    """Order-independent content hash of a device graph: sha256 over the
+    sorted masked (src, dst, distinct) edge triples. Two graphs with the
+    same signature carry the same dependency structure regardless of the
+    order merges happened in."""
+    import numpy as np
+
+    src, dst, dist, mask = (np.asarray(a) for a in graph.edge_arrays())
+    live = np.nonzero(mask)[0]
+    triples = sorted(
+        (int(src[i]), int(dst[i]), int(dist[i])) for i in live
+    )
+    digest = hashlib.sha256()
+    for s, d, c in triples:
+        digest.update(f"{s},{d},{c};".encode("ascii"))
+    return digest.hexdigest()

@@ -1,0 +1,174 @@
+"""Process-wide resilience counters.
+
+One small registry instead of counters scattered across modules: the
+ingest ring's backpressure drops (processor._put), the operator's
+external-DP fallback activations, watchdog trips + last-good serving
+metadata, per-job scheduler failure streaks, and quarantine/WAL totals
+all land here and surface together as the `resilience` section of
+GET /health/timings (api/handlers/health.py) and the DP server's
+/timings.
+
+Everything is guarded by one module lock — these are cold counters
+(a few increments per tick at most), so contention is irrelevant and
+the graftlint `unguarded-shared-state` rule (which covers this package)
+stays satisfied by construction.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+_LOCK = threading.Lock()
+
+#: flat named counters: ingestDropped, dpFallback, walRecords, ...
+_COUNTERS: Dict[str, int] = {}
+
+#: per-scheduler-job failure tracking: name -> {consecutiveFailures,
+#: totalFailures, lastError, lastFailureAt}
+_JOBS: Dict[str, dict] = {}
+
+#: watchdog state: trips, per-reason counts, last trip, last-good tick
+_WATCHDOG: Dict[str, object] = {
+    "trips": 0,
+    "byReason": {},
+    "lastTripReason": None,
+    "lastTripAt": None,
+    "lastGoodVersion": None,
+    "lastGoodLabelEpoch": None,
+    "lastGoodAt": None,
+    "staleServes": 0,
+}
+
+
+def incr(name: str, by: int = 1) -> int:
+    """Bump a named counter; returns the new value."""
+    with _LOCK:
+        _COUNTERS[name] = _COUNTERS.get(name, 0) + by
+        return _COUNTERS[name]
+
+
+def get(name: str) -> int:
+    with _LOCK:
+        return _COUNTERS.get(name, 0)
+
+
+def job_failed(name: str, err: BaseException, now_ms: Optional[float] = None) -> None:
+    """Record one scheduled-job failure (scheduler.py's except arms):
+    the consecutive-failure streak and last error string make swallowed
+    exceptions visible in /health instead of only in debug logs."""
+    with _LOCK:
+        entry = _JOBS.setdefault(
+            name,
+            {
+                "consecutiveFailures": 0,
+                "totalFailures": 0,
+                "lastError": None,
+                "lastFailureAt": None,
+            },
+        )
+        entry["consecutiveFailures"] += 1
+        entry["totalFailures"] += 1
+        entry["lastError"] = f"{type(err).__name__}: {err}"[:500]
+        entry["lastFailureAt"] = (
+            now_ms if now_ms is not None else time.time() * 1000
+        )
+
+
+def job_succeeded(name: str) -> None:
+    """Reset a job's consecutive-failure streak (its history remains)."""
+    with _LOCK:
+        entry = _JOBS.get(name)
+        if entry is not None:
+            entry["consecutiveFailures"] = 0
+
+
+def job_states() -> Dict[str, dict]:
+    with _LOCK:
+        return {name: dict(entry) for name, entry in _JOBS.items()}
+
+
+def watchdog_tripped(reason: str, now_ms: Optional[float] = None) -> None:
+    with _LOCK:
+        _WATCHDOG["trips"] = int(_WATCHDOG["trips"]) + 1
+        by = _WATCHDOG["byReason"]
+        by[reason] = by.get(reason, 0) + 1
+        _WATCHDOG["lastTripReason"] = reason
+        _WATCHDOG["lastTripAt"] = (
+            now_ms if now_ms is not None else time.time() * 1000
+        )
+
+
+def note_last_good(
+    version: int, label_epoch: int, now_ms: Optional[float] = None
+) -> None:
+    """Record the (graph version, label epoch) of the newest fully
+    successful collect tick — the payload the degraded path serves."""
+    with _LOCK:
+        _WATCHDOG["lastGoodVersion"] = int(version)
+        _WATCHDOG["lastGoodLabelEpoch"] = int(label_epoch)
+        _WATCHDOG["lastGoodAt"] = (
+            now_ms if now_ms is not None else time.time() * 1000
+        )
+
+
+def note_stale_serve() -> None:
+    with _LOCK:
+        _WATCHDOG["staleServes"] = int(_WATCHDOG["staleServes"]) + 1
+
+
+def watchdog_state(now_ms: Optional[float] = None) -> dict:
+    with _LOCK:
+        out = {
+            "trips": _WATCHDOG["trips"],
+            "byReason": dict(_WATCHDOG["byReason"]),
+            "lastTripReason": _WATCHDOG["lastTripReason"],
+            "lastTripAt": _WATCHDOG["lastTripAt"],
+            "lastGoodVersion": _WATCHDOG["lastGoodVersion"],
+            "lastGoodLabelEpoch": _WATCHDOG["lastGoodLabelEpoch"],
+            "lastGoodAt": _WATCHDOG["lastGoodAt"],
+            "staleServes": _WATCHDOG["staleServes"],
+        }
+    if out["lastGoodAt"] is not None:
+        now = now_ms if now_ms is not None else time.time() * 1000
+        out["lastGoodAgeMs"] = max(0.0, round(now - out["lastGoodAt"], 1))
+    return out
+
+
+def resilience_summary() -> dict:
+    """The full `resilience` payload for the health handlers: breaker
+    states, quarantine totals, watchdog/last-good, job streaks, and the
+    flat counters (ingestDropped, dpFallback, ...)."""
+    from kmamiz_tpu.resilience.breaker import breaker_states
+    from kmamiz_tpu.resilience.quarantine import quarantine_stats
+
+    with _LOCK:
+        counters = dict(_COUNTERS)
+    return {
+        "breakers": breaker_states(),
+        "quarantine": quarantine_stats(),
+        "watchdog": watchdog_state(),
+        "jobs": job_states(),
+        "counters": counters,
+        "ingestDropped": counters.get("ingestDropped", 0),
+        "dpFallback": counters.get("dpFallback", 0),
+    }
+
+
+def reset_for_tests() -> None:
+    """Zero every registry (test isolation only)."""
+    with _LOCK:
+        _COUNTERS.clear()
+        _JOBS.clear()
+        _WATCHDOG.update(
+            {
+                "trips": 0,
+                "byReason": {},
+                "lastTripReason": None,
+                "lastTripAt": None,
+                "lastGoodVersion": None,
+                "lastGoodLabelEpoch": None,
+                "lastGoodAt": None,
+                "staleServes": 0,
+            }
+        )

@@ -1,0 +1,264 @@
+"""Poison-input quarantine: divert malformed ingest batches, keep the tick.
+
+A single corrupt chunk (truncated body, garbage bytes, a foreign JSON
+shape, a trace bomb) used to abort the whole ingest call. With the
+quarantine enabled (default), the raw-ingest paths classify the failing
+payload, write it to a bounded on-disk quarantine directory with a
+reason code, and proceed bit-exact on the surviving batches — the same
+fail-open posture the storage layer already takes for corrupt documents
+(server/storage.py `_boundary_check_reads`).
+
+Reason codes (one fixture per code under tests/fixtures/chaos/):
+
+- ``trace-bomb``     payload over the ``KMAMIZ_INGEST_MAX_BYTES`` cap;
+- ``garbage-utf8``   bytes that do not decode as UTF-8;
+- ``truncated-json`` UTF-8 but not valid JSON (truncation, corruption);
+- ``schema-drift``   valid JSON that is not a Zipkin trace-group list;
+- ``parse-error``    structurally sound but rejected by the span parser.
+
+Each quarantined payload lands as ``<millis>-<seq>-<reason>.bin`` plus a
+``.meta.json`` sidecar ({reason, source, bytes, sha256, at}); the
+directory is bounded by ``KMAMIZ_QUARANTINE_MAX_BYTES`` /
+``KMAMIZ_QUARANTINE_MAX_FILES`` with oldest-first eviction, so an
+attacker streaming garbage cannot fill the disk. Totals surface in the
+/health `resilience` section.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import threading
+import time
+from pathlib import Path
+from typing import Optional
+
+logger = logging.getLogger("kmamiz_tpu.resilience.quarantine")
+
+REASON_TRACE_BOMB = "trace-bomb"
+REASON_GARBAGE_UTF8 = "garbage-utf8"
+REASON_TRUNCATED_JSON = "truncated-json"
+REASON_SCHEMA_DRIFT = "schema-drift"
+REASON_PARSE_ERROR = "parse-error"
+
+REASONS = (
+    REASON_TRACE_BOMB,
+    REASON_GARBAGE_UTF8,
+    REASON_TRUNCATED_JSON,
+    REASON_SCHEMA_DRIFT,
+    REASON_PARSE_ERROR,
+)
+
+#: default per-payload size cap: 256 MiB of raw Zipkin bytes is far past
+#: any legitimate window (the bench's 1.05M-span window is ~60 MB)
+DEFAULT_MAX_PAYLOAD_BYTES = 256 * 1024 * 1024
+
+
+def max_payload_bytes() -> int:
+    try:
+        return int(
+            os.environ.get("KMAMIZ_INGEST_MAX_BYTES", DEFAULT_MAX_PAYLOAD_BYTES)
+        )
+    except ValueError:
+        return DEFAULT_MAX_PAYLOAD_BYTES
+
+
+def classify_payload(raw: bytes, size_cap: Optional[int] = None) -> Optional[str]:
+    """Reason code for a malformed raw Zipkin payload, or None when the
+    payload is structurally sound (a list of trace groups of span dicts).
+
+    Runs only on the failure path (after the native parser rejected the
+    payload) or as the cheap pre-parse size gate, so the hot ingest path
+    never pays the host-side json.loads."""
+    cap = size_cap if size_cap is not None else max_payload_bytes()
+    if cap > 0 and len(raw) > cap:
+        return REASON_TRACE_BOMB
+    try:
+        text = raw.decode("utf-8")
+    except UnicodeDecodeError:
+        return REASON_GARBAGE_UTF8
+    try:
+        data = json.loads(text)
+    except json.JSONDecodeError:
+        return REASON_TRUNCATED_JSON
+    if not isinstance(data, list) or not all(
+        isinstance(group, list)
+        and all(isinstance(span, dict) for span in group)
+        for group in data
+    ):
+        return REASON_SCHEMA_DRIFT
+    # spans must carry the ids the dedup/graph paths key on
+    for group in data:
+        for span in group:
+            if "traceId" not in span or "id" not in span:
+                return REASON_SCHEMA_DRIFT
+    return None
+
+
+class Quarantine:
+    """Bounded on-disk quarantine with oldest-first eviction."""
+
+    def __init__(
+        self,
+        directory: Optional[str] = None,
+        max_bytes: Optional[int] = None,
+        max_files: Optional[int] = None,
+    ) -> None:
+        self._dir = Path(
+            directory
+            if directory is not None
+            else os.environ.get(
+                "KMAMIZ_QUARANTINE_DIR", "./kmamiz-data/quarantine"
+            )
+        )
+        try:
+            self._max_bytes = (
+                max_bytes
+                if max_bytes is not None
+                else int(
+                    os.environ.get("KMAMIZ_QUARANTINE_MAX_BYTES", 64 * 1024 * 1024)
+                )
+            )
+        except ValueError:
+            self._max_bytes = 64 * 1024 * 1024
+        try:
+            self._max_files = (
+                max_files
+                if max_files is not None
+                else int(os.environ.get("KMAMIZ_QUARANTINE_MAX_FILES", 256))
+            )
+        except ValueError:
+            self._max_files = 256
+        self._lock = threading.Lock()
+        self._seq = 0
+        # counters survive eviction: byReason counts every diversion ever
+        # made by this process, files/bytes reflect what is on disk now
+        self._by_reason = {}
+        self._total = 0
+
+    @property
+    def directory(self) -> Path:
+        return self._dir
+
+    def put(self, raw: bytes, reason: str, source: str = "") -> Optional[Path]:
+        """Divert one payload. Never raises — a quarantine-write failure
+        (full disk, bad permissions) logs and returns None; the caller's
+        contract is 'the bad batch is out of the pipeline', which holds
+        either way."""
+        from kmamiz_tpu.resilience import metrics
+
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+            self._by_reason[reason] = self._by_reason.get(reason, 0) + 1
+            self._total += 1
+        metrics.incr("quarantined")
+        metrics.incr(f"quarantined.{reason}")
+        try:
+            self._dir.mkdir(parents=True, exist_ok=True)
+            stamp = int(time.time() * 1000)
+            path = self._dir / f"{stamp}-{seq:04d}-{reason}.bin"
+            path.write_bytes(raw)
+            meta = {
+                "reason": reason,
+                "source": source,
+                "bytes": len(raw),
+                "sha256": hashlib.sha256(raw).hexdigest(),
+                "at": stamp,
+            }
+            path.with_suffix(".meta.json").write_text(json.dumps(meta))
+            with self._lock:
+                self._evict_locked()
+            logger.warning(
+                "quarantined %d-byte payload from %s as %s -> %s",
+                len(raw),
+                source or "<unknown>",
+                reason,
+                path.name,
+            )
+            return path
+        except OSError as err:
+            logger.error("quarantine write failed (%s); payload dropped", err)
+            return None
+
+    def _entries_locked(self):
+        try:
+            return sorted(
+                p for p in self._dir.glob("*.bin") if p.is_file()
+            )
+        except OSError:
+            return []
+
+    def _evict_locked(self) -> None:
+        entries = self._entries_locked()
+        total = 0
+        sizes = {}
+        for p in entries:
+            try:
+                sizes[p] = p.stat().st_size
+                total += sizes[p]
+            except OSError:
+                sizes[p] = 0
+        while entries and (
+            len(entries) > self._max_files
+            or (self._max_bytes > 0 and total > self._max_bytes)
+        ):
+            victim = entries.pop(0)  # lexicographic == oldest (ms prefix)
+            total -= sizes.get(victim, 0)
+            for path in (victim, victim.with_suffix(".meta.json")):
+                try:
+                    path.unlink()
+                except OSError:
+                    pass
+
+    def stats(self) -> dict:
+        with self._lock:
+            by_reason = dict(self._by_reason)
+            total = self._total
+            entries = self._entries_locked()
+        on_disk_bytes = 0
+        for p in entries:
+            try:
+                on_disk_bytes += p.stat().st_size
+            except OSError:
+                pass
+        return {
+            "count": total,
+            "byReason": by_reason,
+            "files": len(entries),
+            "bytes": on_disk_bytes,
+            "dir": str(self._dir),
+        }
+
+
+def enabled() -> bool:
+    """KMAMIZ_QUARANTINE=0 restores the old abort-the-call behavior."""
+    return os.environ.get("KMAMIZ_QUARANTINE", "1") != "0"
+
+
+_DEFAULT_LOCK = threading.Lock()
+_DEFAULT: dict = {"instance": None}
+
+
+def default_quarantine() -> Quarantine:
+    """Process-wide quarantine, lazily bound to the env-configured
+    directory on first use (so tests may point KMAMIZ_QUARANTINE_DIR at
+    a tmpdir before anything ingests)."""
+    with _DEFAULT_LOCK:
+        if _DEFAULT["instance"] is None:
+            _DEFAULT["instance"] = Quarantine()
+        return _DEFAULT["instance"]
+
+
+def quarantine_stats() -> dict:
+    with _DEFAULT_LOCK:
+        instance = _DEFAULT["instance"]
+    if instance is None:
+        return {"count": 0, "byReason": {}, "files": 0, "bytes": 0, "dir": None}
+    return instance.stats()
+
+
+def reset_for_tests() -> None:
+    with _DEFAULT_LOCK:
+        _DEFAULT["instance"] = None

@@ -1,0 +1,127 @@
+"""Shared retry with jittered exponential backoff.
+
+Replaces the server layer's bare one-shot `urlopen(req, timeout=30)`
+calls: a transient upstream blip (connection reset, brief 5xx, DNS
+hiccup) retries with full jitter instead of failing the whole tick,
+while a genuinely down upstream still fails fast enough for the caller's
+fallback (and trips its CircuitBreaker, which then short-circuits the
+retries entirely).
+
+Env knobs (docs/ENVIRONMENT.md), overridable per call site via
+constructor args:
+
+- ``KMAMIZ_RETRY_ATTEMPTS`` (default 2): total attempts (1 = no retry);
+- ``KMAMIZ_RETRY_BASE_MS`` (default 100): first backoff ceiling;
+- ``KMAMIZ_RETRY_MAX_MS``  (default 2000): per-sleep ceiling;
+- ``KMAMIZ_RETRY_DEADLINE_MS`` (default 0 = off): wall-clock budget for
+  the whole call chain — no retry starts past it.
+
+Jitter is "full jitter" (sleep ~ U[0, min(max, base * 2^k)]); the rng
+and sleep are injectable so the chaos harness replays deterministic
+schedules and tests never actually sleep.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import random
+import time
+from typing import Callable, Optional, Tuple, Type
+
+logger = logging.getLogger("kmamiz_tpu.resilience.retry")
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+class Retrier:
+    """Callable wrapper: ``Retrier("zipkin").call(fn)`` runs fn up to
+    `attempts` times, sleeping a jittered exponential backoff between
+    failures. The last failure re-raises unchanged."""
+
+    def __init__(
+        self,
+        name: str,
+        attempts: Optional[int] = None,
+        base_ms: Optional[float] = None,
+        max_ms: Optional[float] = None,
+        deadline_ms: Optional[float] = None,
+        retry_on: Tuple[Type[BaseException], ...] = (Exception,),
+        rng: Optional[random.Random] = None,
+        sleep: Callable[[float], None] = time.sleep,
+        now: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self.name = name
+        self.attempts = max(
+            1,
+            attempts
+            if attempts is not None
+            else _env_int("KMAMIZ_RETRY_ATTEMPTS", 2),
+        )
+        self.base_ms = (
+            base_ms
+            if base_ms is not None
+            else float(_env_int("KMAMIZ_RETRY_BASE_MS", 100))
+        )
+        self.max_ms = (
+            max_ms
+            if max_ms is not None
+            else float(_env_int("KMAMIZ_RETRY_MAX_MS", 2000))
+        )
+        self.deadline_ms = (
+            deadline_ms
+            if deadline_ms is not None
+            else float(_env_int("KMAMIZ_RETRY_DEADLINE_MS", 0))
+        )
+        self.retry_on = retry_on
+        self._rng = rng if rng is not None else random.Random()
+        self._sleep = sleep
+        self._now = now
+
+    def backoff_ms(self, attempt: int) -> float:
+        """Full-jitter backoff before attempt `attempt` (1-based retry
+        index): U[0, min(max_ms, base_ms * 2^(attempt-1))]."""
+        ceiling = min(self.max_ms, self.base_ms * (2 ** (attempt - 1)))
+        return self._rng.uniform(0.0, ceiling)
+
+    def call(self, fn: Callable, *args, **kwargs):
+        """Run fn(*args, **kwargs) with retries. Exceptions outside
+        `retry_on` (e.g. BreakerOpenError) propagate immediately —
+        retrying into an open breaker would just burn the backoff."""
+        start = self._now()
+        attempt = 0
+        while True:
+            attempt += 1
+            try:
+                return fn(*args, **kwargs)
+            except self.retry_on as err:
+                if attempt >= self.attempts:
+                    raise
+                if (
+                    self.deadline_ms > 0
+                    and (self._now() - start) * 1000.0 >= self.deadline_ms
+                ):
+                    logger.debug(
+                        "%s: retry deadline exhausted after %d attempts",
+                        self.name,
+                        attempt,
+                    )
+                    raise
+                delay_ms = self.backoff_ms(attempt)
+                logger.debug(
+                    "%s: attempt %d/%d failed (%s: %s), retrying in %.0f ms",
+                    self.name,
+                    attempt,
+                    self.attempts,
+                    type(err).__name__,
+                    err,
+                    delay_ms,
+                )
+                from kmamiz_tpu.resilience import metrics
+
+                metrics.incr(f"retry.{self.name}")
+                self._sleep(delay_ms / 1000.0)

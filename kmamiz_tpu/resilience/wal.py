@@ -1,0 +1,228 @@
+"""Crash-safe ingest WAL: append-only, fsynced, size-rotated, torn-tail
+tolerant.
+
+Every raw ingest payload that *parses successfully* is appended to the
+WAL before its spans merge into the graph ("write-ahead" with respect to
+state mutation). After a kill -9 anywhere in the tick, a fresh process
+replays the WAL through the same `ingest_raw_window` path and arrives at
+a bit-exact graph: the edge-store merge is deterministic and a fresh
+processor's empty dedup map reconstructs exactly the state the payload
+sequence implies.
+
+Record framing (per record, little-endian):
+
+    [u32 payload_len][u32 crc32(payload)][payload bytes]
+
+Append is O_APPEND + flush + fsync, so a record is either fully durable
+or detectably torn; replay stops cleanly at the first short/corrupt
+record (the torn tail of the segment being written when the process
+died) instead of raising. Segments rotate at ``KMAMIZ_WAL_SEGMENT_MB``
+(default 64) and the newest ``KMAMIZ_WAL_KEEP_SEGMENTS`` (default 4)
+are retained; `truncate()` clears all segments once their contents are
+known to be captured by a durable snapshot.
+
+Enable with ``KMAMIZ_WAL=1`` (+ optional ``KMAMIZ_WAL_DIR``); off by
+default so the fsync-per-ingest cost is strictly opt-in.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import struct
+import threading
+import zlib
+from pathlib import Path
+from typing import Iterator, List, Optional
+
+logger = logging.getLogger("kmamiz_tpu.resilience.wal")
+
+_HEADER = struct.Struct("<II")  # payload_len, crc32
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+class IngestWAL:
+    """Append-only write-ahead log of raw ingest payloads."""
+
+    def __init__(
+        self,
+        directory: str,
+        segment_bytes: Optional[int] = None,
+        keep_segments: Optional[int] = None,
+        fsync: bool = True,
+    ) -> None:
+        self._dir = Path(directory)
+        self._segment_bytes = (
+            segment_bytes
+            if segment_bytes is not None
+            else _env_int("KMAMIZ_WAL_SEGMENT_MB", 64) * 1024 * 1024
+        )
+        self._keep_segments = max(
+            1,
+            keep_segments
+            if keep_segments is not None
+            else _env_int("KMAMIZ_WAL_KEEP_SEGMENTS", 4),
+        )
+        self._fsync = fsync
+        self._lock = threading.Lock()
+        self._fh = None
+        self._fh_path: Optional[Path] = None
+        self._records_appended = 0
+
+    @classmethod
+    def from_env(cls) -> Optional["IngestWAL"]:
+        """The env-configured WAL, or None when KMAMIZ_WAL is unset/0."""
+        if os.environ.get("KMAMIZ_WAL", "0") != "1":
+            return None
+        directory = os.environ.get("KMAMIZ_WAL_DIR", "./kmamiz-data/wal")
+        return cls(directory)
+
+    @property
+    def directory(self) -> Path:
+        return self._dir
+
+    @property
+    def records_appended(self) -> int:
+        with self._lock:
+            return self._records_appended
+
+    # -- segments ------------------------------------------------------------
+
+    def _segments_locked(self) -> List[Path]:
+        try:
+            return sorted(p for p in self._dir.glob("*.wal") if p.is_file())
+        except OSError:
+            return []
+
+    def _next_segment_path_locked(self) -> Path:
+        segments = self._segments_locked()
+        if segments:
+            last = segments[-1].stem  # "000007"
+            try:
+                index = int(last) + 1
+            except ValueError:
+                index = len(segments)
+        else:
+            index = 0
+        return self._dir / f"{index:06d}.wal"
+
+    def _open_locked(self) -> None:
+        if self._fh is not None:
+            return
+        self._dir.mkdir(parents=True, exist_ok=True)
+        segments = self._segments_locked()
+        if segments and segments[-1].stat().st_size < self._segment_bytes:
+            path = segments[-1]
+        else:
+            path = self._next_segment_path_locked()
+        self._fh = open(path, "ab")
+        self._fh_path = path
+
+    def _rotate_if_needed_locked(self) -> None:
+        if self._fh is None or self._fh_path is None:
+            return
+        if self._fh.tell() < self._segment_bytes:
+            return
+        self._fh.close()
+        self._fh = None
+        path = self._next_segment_path_locked()
+        self._fh = open(path, "ab")
+        self._fh_path = path
+        # retire segments beyond the retention window, oldest first
+        segments = self._segments_locked()
+        while len(segments) > self._keep_segments:
+            victim = segments.pop(0)
+            try:
+                victim.unlink()
+                logger.info("wal: retired segment %s", victim.name)
+            except OSError:
+                pass
+
+    # -- append / replay -----------------------------------------------------
+
+    def append(self, payload: bytes) -> None:
+        """Durably append one record. Raises OSError on I/O failure —
+        the caller decides whether ingest proceeds without durability."""
+        frame = _HEADER.pack(len(payload), zlib.crc32(payload)) + payload
+        with self._lock:
+            self._open_locked()
+            self._fh.write(frame)
+            self._fh.flush()
+            if self._fsync:
+                os.fsync(self._fh.fileno())
+            self._records_appended += 1
+            self._rotate_if_needed_locked()
+        from kmamiz_tpu.resilience import metrics
+
+        metrics.incr("walRecords")
+
+    def replay(self) -> Iterator[bytes]:
+        """Yield every durable payload, oldest first. Stops cleanly at
+        the first torn/corrupt record (crash tail); later segments are
+        not read past it because append order is segment order."""
+        with self._lock:
+            segments = self._segments_locked()
+        for segment in segments:
+            try:
+                data = segment.read_bytes()
+            except OSError as err:
+                logger.warning("wal: cannot read %s (%s)", segment.name, err)
+                return
+            offset = 0
+            while offset + _HEADER.size <= len(data):
+                length, crc = _HEADER.unpack_from(data, offset)
+                start = offset + _HEADER.size
+                end = start + length
+                if end > len(data):
+                    logger.warning(
+                        "wal: torn record at %s+%d, stopping replay",
+                        segment.name,
+                        offset,
+                    )
+                    return
+                payload = data[start:end]
+                if zlib.crc32(payload) != crc:
+                    logger.warning(
+                        "wal: crc mismatch at %s+%d, stopping replay",
+                        segment.name,
+                        offset,
+                    )
+                    return
+                yield payload
+                offset = end
+            if offset != len(data):
+                logger.warning(
+                    "wal: %d trailing bytes in %s, stopping replay",
+                    len(data) - offset,
+                    segment.name,
+                )
+                return
+
+    def record_count(self) -> int:
+        return sum(1 for _ in self.replay())
+
+    def truncate(self) -> None:
+        """Drop all segments (their contents are captured by a durable
+        snapshot, or a test wants a clean slate)."""
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+                self._fh_path = None
+            for segment in self._segments_locked():
+                try:
+                    segment.unlink()
+                except OSError:
+                    pass
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+                self._fh_path = None

@@ -1,0 +1,145 @@
+"""Tick watchdog: bound the collect tick, degrade instead of failing.
+
+The realtime loop's contract is "a fresh graph every tick"; the
+watchdog weakens that to "a graph every tick, fresh when possible" —
+which is the contract a dashboard actually needs. `run()` executes the
+tick on a worker thread and waits at most the deadline:
+
+- worker finishes in time -> its result/exception passes through
+  unchanged (the normal path is untouched);
+- deadline overruns -> `TickDeadlineExceeded` is raised and the caller
+  serves the last-good payload with staleness metadata. Python threads
+  cannot be killed, so the straggler keeps running in the background and
+  its eventual result is delivered through `on_late_result` (refreshing
+  last-good) — the overrun costs freshness, never correctness;
+- a previous straggler is still in flight -> `TickDeadlineExceeded`
+  with reason ``tick-in-flight`` immediately, so stragglers never pile
+  up an unbounded thread backlog.
+
+Enable with ``KMAMIZ_TICK_DEADLINE_MS`` > 0 (default 0 = off; the bare
+loop behaves exactly as before). Trips are counted per reason in
+resilience metrics and surface in /health.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Callable, Optional
+
+from kmamiz_tpu.resilience import metrics
+
+logger = logging.getLogger("kmamiz_tpu.resilience.watchdog")
+
+REASON_DEADLINE = "deadline"
+REASON_IN_FLIGHT = "tick-in-flight"
+REASON_FAULT = "tick-fault"
+
+
+def deadline_ms_from_env() -> float:
+    try:
+        return float(os.environ.get("KMAMIZ_TICK_DEADLINE_MS", 0))
+    except ValueError:
+        return 0.0
+
+
+class TickDeadlineExceeded(RuntimeError):
+    def __init__(self, reason: str, deadline_ms: float) -> None:
+        super().__init__(
+            f"collect tick exceeded its deadline ({deadline_ms:.0f} ms): {reason}"
+        )
+        self.reason = reason
+        self.deadline_ms = deadline_ms
+
+
+class TickWatchdog:
+    def __init__(
+        self,
+        deadline_ms: Optional[float] = None,
+        on_late_result: Optional[Callable[[object], None]] = None,
+    ) -> None:
+        # None -> consult the env on every run, so a live server honors
+        # KMAMIZ_TICK_DEADLINE_MS changes without a restart
+        self._deadline_ms = deadline_ms
+        self._on_late_result = on_late_result
+        self._lock = threading.Lock()
+        # in_flight: a worker thread is still executing a tick.
+        # abandoned: the waiter gave up on that worker (deadline trip);
+        # the worker delivers its eventual result via on_late_result.
+        self._in_flight = False
+        self._abandoned = False
+
+    @property
+    def deadline_ms(self) -> float:
+        return (
+            self._deadline_ms
+            if self._deadline_ms is not None
+            else deadline_ms_from_env()
+        )
+
+    @property
+    def enabled(self) -> bool:
+        return self.deadline_ms > 0
+
+    def run(self, fn: Callable[[], object]) -> object:
+        """Run fn under the deadline. Returns fn's result, re-raises
+        fn's exception, or raises TickDeadlineExceeded on overrun /
+        straggler overlap."""
+        deadline_ms = self.deadline_ms
+        if deadline_ms <= 0:
+            return fn()
+        with self._lock:
+            if self._in_flight:
+                metrics.watchdog_tripped(REASON_IN_FLIGHT)
+                raise TickDeadlineExceeded(REASON_IN_FLIGHT, deadline_ms)
+            self._in_flight = True
+            self._abandoned = False
+
+        done = threading.Event()
+        box = {"result": None, "error": None}
+
+        def _worker() -> None:
+            try:
+                box["result"] = fn()
+            except BaseException as err:  # delivered to the waiter below
+                box["error"] = err
+            finally:
+                with self._lock:
+                    was_abandoned = self._abandoned
+                    self._in_flight = False
+                    self._abandoned = False
+                done.set()
+                if was_abandoned and box["error"] is None:
+                    # straggler finished after the waiter gave up: hand
+                    # the fresh result back so last-good catches up
+                    logger.info("watchdog: late tick completed, refreshing")
+                    if self._on_late_result is not None:
+                        try:
+                            self._on_late_result(box["result"])
+                        except Exception:
+                            logger.exception("watchdog: on_late_result failed")
+
+        thread = threading.Thread(
+            target=_worker, name="kmamiz-tick-watchdog", daemon=True
+        )
+        thread.start()
+        if done.wait(deadline_ms / 1000.0):
+            if box["error"] is not None:
+                raise box["error"]
+            return box["result"]
+        with self._lock:
+            if self._in_flight:
+                # genuine overrun: abandon the straggler (it stays
+                # in-flight so the next tick trips ``tick-in-flight``)
+                self._abandoned = True
+                finished_at_the_wire = False
+            else:
+                # worker completed between the wait timing out and us
+                # taking the lock — treat it as an in-time finish
+                finished_at_the_wire = True
+        if finished_at_the_wire:
+            if box["error"] is not None:
+                raise box["error"]
+            return box["result"]
+        metrics.watchdog_tripped(REASON_DEADLINE)
+        raise TickDeadlineExceeded(REASON_DEADLINE, deadline_ms)

@@ -20,14 +20,60 @@ import json
 import logging
 import os
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from kmamiz_tpu.analysis import guards
 from kmamiz_tpu.core import programs
+from kmamiz_tpu.resilience import metrics as res_metrics
+from kmamiz_tpu.resilience.watchdog import (
+    REASON_FAULT,
+    TickDeadlineExceeded,
+    TickWatchdog,
+)
 from kmamiz_tpu.server.processor import DataProcessor
 
 logger = logging.getLogger("kmamiz_tpu.dp_server")
+
+
+class _LastGoodTick:
+    """The newest fully successful collect response and the graph
+    coordinates it was computed at. When a tick overruns its watchdog
+    deadline or faults, the server degrades to this payload — marked
+    stale, never a 500 — instead of making the host app's poller eat an
+    error and fall back to in-process computation. Serving it is pure
+    host work on an already-encoded dict: no jax call, no compile
+    (tools/chaos_probe.py asserts zero new compiles on the stale path)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._payload: Optional[dict] = None
+        self._at_ms: Optional[float] = None
+
+    def update(self, payload: dict, version: int, label_epoch: int) -> None:
+        now_ms = time.time() * 1000
+        with self._lock:
+            self._payload = payload
+            self._at_ms = now_ms
+        res_metrics.note_last_good(version, label_epoch, now_ms)
+
+    def serve_stale(self, unique_id: str, reason: str) -> Optional[dict]:
+        """A copy of the last-good payload re-addressed to the current
+        request, with explicit staleness metadata; None when no tick has
+        succeeded yet (callers then keep the old 5xx contract)."""
+        with self._lock:
+            if self._payload is None:
+                return None
+            payload = dict(self._payload)
+            at_ms = self._at_ms
+        age_ms = max(0.0, time.time() * 1000 - at_ms)
+        payload["uniqueId"] = unique_id
+        payload["stale"] = True
+        payload["staleAgeMs"] = round(age_ms, 1)
+        payload["staleReason"] = reason
+        res_metrics.note_stale_serve()
+        return payload
 
 
 class _EncodedPayloadCache:
@@ -62,6 +108,18 @@ class _EncodedPayloadCache:
 
 def make_handler(processor: DataProcessor):
     encoded_cache = _EncodedPayloadCache()
+    last_good = _LastGoodTick()
+    # env-driven deadline (KMAMIZ_TICK_DEADLINE_MS, 0 = off); a straggler
+    # that finishes after the trip still refreshes last_good
+    watchdog = TickWatchdog(
+        on_late_result=lambda result: last_good.update(
+            result,
+            processor.graph.version,
+            processor.graph.label_epoch,
+        )
+        if isinstance(result, dict)
+        else None
+    )
 
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
@@ -70,7 +128,11 @@ def make_handler(processor: DataProcessor):
             logger.debug("%s " + fmt, self.address_string(), *args)
 
         def _send_json(
-            self, status: int, payload: dict, cache_key: tuple = None
+            self,
+            status: int,
+            payload: dict,
+            cache_key: tuple = None,
+            extra_headers: Optional[dict] = None,
         ) -> None:
             accept = self.headers.get("Accept-Encoding", "")
             encoded = "gzip" in accept
@@ -84,9 +146,23 @@ def make_handler(processor: DataProcessor):
             self.send_header("Content-Type", "application/json")
             if encoded:
                 self.send_header("Content-Encoding", "gzip")
+            if extra_headers:
+                for name, value in extra_headers.items():
+                    self.send_header(name, str(value))
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
+
+        def _send_stale(self, stale_payload: dict) -> None:
+            """Degraded serve: 200 + the last-good graph, staleness
+            spelled out in both the payload and a response header."""
+            self._send_json(
+                200,
+                stale_payload,
+                extra_headers={
+                    "X-KMamiz-Stale-Age-Ms": stale_payload["staleAgeMs"]
+                },
+            )
 
         def do_GET(self) -> None:  # health check (main.rs:28-31)
             if self.path.split("?", 1)[0].rstrip("/") == "/timings":
@@ -97,6 +173,7 @@ def make_handler(processor: DataProcessor):
                     {
                         "phases": step_timer.summary(),
                         "programs": programs.summary(),
+                        "resilience": res_metrics.resilience_summary(),
                     },
                 )
                 return
@@ -184,21 +261,47 @@ def make_handler(processor: DataProcessor):
             except ValueError as e:
                 self._send_json(400, {"error": f"bad request: {e}"})
                 return
-            try:
+            def _tick() -> dict:
                 # opt-in hot-path enforcement: KMAMIZ_TRANSFER_GUARD=1
                 # runs the tick under jax.transfer_guard("disallow") and
                 # diffs the program registry's compile counters
                 with guards.maybe_guarded_tick() as guard_report:
-                    response = processor.collect(request)
+                    result = processor.collect(request)
                 if guard_report is not None and guard_report.recompiled:
                     logger.warning(
                         "collect tick recompiled programs: %s",
                         guard_report.new_compiles,
                     )
-            except Exception as e:  # noqa: BLE001 - report, let caller fall back
+                return result
+
+            try:
+                response = watchdog.run(_tick)
+            except TickDeadlineExceeded as e:
+                # tick overran its deadline (or a straggler is still in
+                # flight): serve the last-good graph, explicitly stale
+                logger.warning("collect tick degraded: %s", e)
+                stale = last_good.serve_stale(
+                    request.get("uniqueId", ""), e.reason
+                )
+                if stale is not None:
+                    self._send_stale(stale)
+                    return
+                self._send_json(503, {"error": str(e), "reason": e.reason})
+                return
+            except Exception as e:  # noqa: BLE001 - degrade, else fall back
                 logger.exception("collect failed")
+                stale = last_good.serve_stale(
+                    request.get("uniqueId", ""), REASON_FAULT
+                )
+                if stale is not None:
+                    res_metrics.watchdog_tripped(REASON_FAULT)
+                    self._send_stale(stale)
+                    return
                 self._send_json(500, {"error": str(e)})
                 return
+            last_good.update(
+                response, processor.graph.version, processor.graph.label_epoch
+            )
             # version-keyed encode memo: a retried uniqueId against an
             # unchanged graph re-sends the cached bytes instead of
             # re-encoding the full dependency payload per thread
@@ -268,6 +371,11 @@ def main() -> None:
         ),
         k8s_source=k8s,
     )
+    # crash recovery first: with KMAMIZ_WAL=1 the boot replays the ingest
+    # WAL so the graph resumes bit-exact from wherever kill -9 landed
+    recovered = processor.replay_wal()
+    if recovered["replayed"]:
+        logger.info("wal replay: %s", recovered)
     # boot prewarm plan (core/programs.py): replay persisted shape hints
     # (exact production buckets) or the default graph merge set, on a
     # background thread by default — GET / answers 503 WARMING until

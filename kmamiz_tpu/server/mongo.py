@@ -198,21 +198,36 @@ class MongoClient:
 
     def command(self, doc: Dict[str, Any]) -> Dict[str, Any]:
         """Run one command document; returns the reply body, raising on
-        ok: 0 or write errors."""
+        ok: 0 or write errors.
+
+        Guarded by the shared `mongo` circuit breaker: only TRANSPORT
+        failures (socket/framing) count toward tripping it — a command
+        the server answered with ok: 0 proves the upstream is alive.
+        While open, calls short-circuit with BreakerOpenError before
+        touching the socket, so a dead mongod costs snapshot jobs
+        microseconds instead of a connect timeout per fire."""
+        from kmamiz_tpu.resilience import get_breaker
+
+        breaker = get_breaker("mongo")
+        breaker.allow()
         with self._lock:
             try:
                 sock = self._connect()
-                return self._roundtrip(sock, doc)
+                reply = self._roundtrip(sock, doc)
             except (OSError, struct.error) as err:
                 # transport/framing breakage (ConnectionError covers
                 # server-closed and lost framing): drop the socket so the
                 # next call reconnects and re-authenticates
                 self._sock = None
+                breaker.record_failure()
                 raise MongoError(f"mongo transport error: {err}") from err
             except MongoError:
                 # command-level failure (ok: 0, write errors): the
                 # connection itself stays usable
+                breaker.record_success()
                 raise
+        breaker.record_success()
+        return reply
 
     # -- SCRAM authentication (RFC 5802 over saslStart/saslContinue) ---------
 
@@ -488,11 +503,22 @@ class MongoStore(Store):
             auth_mechanism=(query.get("authMechanism") or [None])[0],
         )
 
+    @staticmethod
+    def _retrier():
+        """Backoff retries for the IDEMPOTENT store operations (reads,
+        upserts by _id, deletes by query): one transient transport blip
+        does not lose a snapshot save or boot restore. insert_many stays
+        single-attempt — replayed inserts would duplicate-key. An open
+        `mongo` breaker raises BreakerOpenError, which is not retried."""
+        from kmamiz_tpu.resilience import Retrier
+
+        return Retrier("mongo", retry_on=(MongoError,))
+
     def ping(self) -> None:
-        self._client.ping()
+        self._retrier().call(self._client.ping)
 
     def find_all(self, collection: str) -> List[dict]:
-        docs = self._client.find_all(self._db, collection)
+        docs = self._retrier().call(self._client.find_all, self._db, collection)
         # the Mongo database is writable by other clients: the boundary
         # check migrates old documents and quarantines foreign/corrupt
         # ones with a logged error (reference: Mongoose model casting,
@@ -503,8 +529,8 @@ class MongoStore(Store):
 
     def find_ids(self, collection: str) -> List[str]:
         # _id projection: the rotation transfers no document bodies
-        docs = self._client.find_all(
-            self._db, collection, projection={"_id": 1}
+        docs = self._retrier().call(
+            self._client.find_all, self._db, collection, projection={"_id": 1}
         )
         return [d["_id"] for d in docs if "_id" in d]
 
@@ -529,11 +555,13 @@ class MongoStore(Store):
             schemas.validate_doc(collection, doc)
         d = schemas.stamp(dict(doc))
         d.setdefault("_id", uuid.uuid4().hex)
-        self._client.upsert_by_id(self._db, collection, d)
+        self._retrier().call(self._client.upsert_by_id, self._db, collection, d)
         return d
 
     def delete_many(self, collection: str, ids: List[str]) -> int:
-        return self._client.delete_ids(self._db, collection, ids)
+        return self._retrier().call(
+            self._client.delete_ids, self._db, collection, ids
+        )
 
     def clear_collection(self, collection: str) -> None:
-        self._client.delete_all(self._db, collection)
+        self._retrier().call(self._client.delete_all, self._db, collection)

@@ -19,6 +19,7 @@ from __future__ import annotations
 import gzip
 import json
 import logging
+import os
 import random
 import threading
 import time
@@ -41,6 +42,16 @@ logger = logging.getLogger("kmamiz_tpu.operator")
 
 RISK_LOOK_BACK_TIME_MS = 1_800_000  # ServiceOperator.ts:37
 REALTIME_LOOK_BACK_MS = 30_000  # ServiceOperator.ts:295
+
+
+def _dp_timeout_s() -> float:
+    """External-DP request timeout (KMAMIZ_DP_TIMEOUT_S, default the
+    reference's fixed 30 s). Tune it down when the in-process fallback
+    is cheap and a slow external DP should lose its slot quickly."""
+    try:
+        return float(os.environ.get("KMAMIZ_DP_TIMEOUT_S", 30))
+    except ValueError:
+        return 30.0
 
 
 class ServiceOperator:
@@ -103,6 +114,12 @@ class ServiceOperator:
                 self.external_retrieve(request)
                 return
             except Exception:  # noqa: BLE001 - any DP failure falls back
+                from kmamiz_tpu.resilience import metrics as res_metrics
+
+                # the reference's silent worker fallback
+                # (ServiceOperator.ts:300-306), now counted: a fleet
+                # quietly running in-process shows up in /health
+                res_metrics.incr("dpFallback")
                 logger.debug(
                     "External data processor failed, fallback to in-process.",
                     exc_info=True,
@@ -117,7 +134,19 @@ class ServiceOperator:
         self.post_retrieve(self._processor.collect(request))
 
     def external_retrieve(self, request: dict) -> None:
-        """HTTP POST to an external DP service (ServiceOperator.ts:253-280)."""
+        """HTTP POST to an external DP service (ServiceOperator.ts:253-280).
+
+        Hardened (resilience pillar 2): the request runs under the
+        shared `external-dp` circuit breaker with jittered-backoff
+        retries on transport errors (OSError covers URLError/HTTPError/
+        timeouts). A down DP trips the breaker after N consecutive
+        failures, after which ticks skip straight to the in-process
+        fallback without waiting out the timeout; retrying a POST is
+        safe because the DP server's encode memo is keyed on the
+        request's uniqueId and the graph edge store merges by set union.
+        The timeout itself is KMAMIZ_DP_TIMEOUT_S (was a fixed 30)."""
+        from kmamiz_tpu.resilience import Retrier, get_breaker
+
         body = json.dumps(request).encode()
         req = urllib.request.Request(
             self._external_dp_url,
@@ -128,15 +157,26 @@ class ServiceOperator:
                 "Accept-Encoding": "gzip",
             },
         )
-        with urllib.request.urlopen(req, timeout=30) as res:
-            if res.status != 200:
-                raise urllib.error.HTTPError(
-                    self._external_dp_url, res.status, "bad status", res.headers, None
-                )
-            raw = res.read()
-            if res.headers.get("Content-Encoding") == "gzip":
-                raw = gzip.decompress(raw)
-        self.post_retrieve(json.loads(raw))
+        timeout_s = _dp_timeout_s()
+
+        def _post() -> dict:
+            with urllib.request.urlopen(req, timeout=timeout_s) as res:
+                if res.status != 200:
+                    raise urllib.error.HTTPError(
+                        self._external_dp_url,
+                        res.status,
+                        "bad status",
+                        res.headers,
+                        None,
+                    )
+                raw = res.read()
+                if res.headers.get("Content-Encoding") == "gzip":
+                    raw = gzip.decompress(raw)
+            return json.loads(raw)
+
+        breaker = get_breaker("external-dp")
+        retrier = Retrier("external-dp", retry_on=(OSError,))
+        self.post_retrieve(retrier.call(breaker.call, _post))
 
     def post_retrieve(self, response: dict) -> None:
         """Merge a DP response into the caches (ServiceOperator.ts:66-89).

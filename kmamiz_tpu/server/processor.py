@@ -17,6 +17,7 @@ graph scorers.
 """
 from __future__ import annotations
 
+import json
 import os
 import queue
 import threading
@@ -41,6 +42,9 @@ from kmamiz_tpu.domain.realtime import RealtimeDataList
 from kmamiz_tpu.core import profiling
 from kmamiz_tpu.core.profiling import step_timer
 from kmamiz_tpu.domain.traces import Traces
+from kmamiz_tpu.resilience import metrics as res_metrics
+from kmamiz_tpu.resilience import quarantine as res_quarantine
+from kmamiz_tpu.resilience.wal import IngestWAL
 
 # default pipeline width for chunked big-window ingest (DP-server body
 # splits, paginated Zipkin backfills): enough chunks that the native
@@ -160,6 +164,13 @@ class DataProcessor:
         #                            cls_count, cls_lat, cls_lat^2]
         self._history_lock = threading.Lock()
         self._last_replicas: Dict[str, float] = {}
+        # crash-safe ingest WAL (resilience/wal.py), None unless
+        # KMAMIZ_WAL=1: every successfully parsed ingest payload appends
+        # BEFORE its graph merge, so a kill -9 mid-tick replays to a
+        # bit-exact graph on restart (replay_wal). _wal_replaying
+        # suppresses re-appends while the replay itself runs.
+        self._wal = IngestWAL.from_env()
+        self._wal_replaying = False
 
     # -- trace dedup (data_processor.rs:30-73) -------------------------------
 
@@ -252,6 +263,11 @@ class DataProcessor:
         with step_timer.phase("fetch_traces"):
             trace_groups = self._trace_source(look_back, req_time, ZIPKIN_LIMIT)
             trace_groups = self._filter_traces(trace_groups, t_start)
+        if trace_groups and self._wal is not None:
+            # WAL the tick's kept (post-dedup) groups as raw Zipkin JSON
+            # before any graph mutation; replay re-ingests them through
+            # ingest_raw_window, which merges the same edges
+            self._wal_append(json.dumps(trace_groups).encode("utf-8"))
 
         traces = Traces(trace_groups)
         namespaces = {
@@ -764,6 +780,71 @@ class DataProcessor:
                     "predicted_hour": fc["predictedHour"],
                 }
 
+    def _wal_append(self, raw: bytes) -> None:
+        """Durably log one successfully parsed ingest payload before its
+        graph merge. No-op when the WAL is off or during WAL replay. An
+        append failure counts (`walAppendErrors`) but does not abort the
+        ingest — availability over durability, matching the storage
+        layer's fail-open posture."""
+        if self._wal is None or self._wal_replaying:
+            return
+        try:
+            self._wal.append(raw)
+        except OSError:
+            res_metrics.incr("walAppendErrors")
+
+    def _divert_poison(self, raw: bytes, source: str) -> str:
+        """Classify a payload the native parser rejected and move it to
+        the quarantine. Returns the reason code; raises ValueError
+        instead when the real cause is a missing native extension (the
+        payload is fine — callers fall back to the capped JSON path)."""
+        from kmamiz_tpu import native
+
+        reason = res_quarantine.classify_payload(raw)
+        if reason is None:
+            if not native.available():
+                raise ValueError("native span loader unavailable")
+            reason = res_quarantine.REASON_PARSE_ERROR
+        res_quarantine.default_quarantine().put(raw, reason, source=source)
+        return reason
+
+    def replay_wal(self) -> dict:
+        """Rebuild ingest state from the WAL (boot path, after a crash).
+        Each durable payload re-ingests through ingest_raw_window; the
+        edge-store merge is deterministic and the fresh dedup map replays
+        registrations in the original order, so the recovered graph is
+        bit-exact with the pre-crash one (tools/chaos_probe.py pillar 4
+        asserts the signature). Only parsed payloads were appended, but a
+        payload that fails to re-parse quarantines instead of aborting
+        the boot."""
+        totals = {"replayed": 0, "spans": 0, "quarantined": 0}
+        if self._wal is None:
+            return totals
+        self._wal_replaying = True
+        try:
+            for payload in self._wal.replay():
+                out = self.ingest_raw_window(payload)
+                totals["replayed"] += 1
+                totals["spans"] += out.get("spans", 0)
+                totals["quarantined"] += out.get("quarantined", 0)
+        finally:
+            self._wal_replaying = False
+        res_metrics.incr("walReplays")
+        return totals
+
+    def _quarantined_summary(self, reason: str, wall_t0: float) -> dict:
+        """ingest_raw_window's return shape for a fully diverted payload:
+        zero new spans, the graph untouched."""
+        return {
+            "spans": 0,
+            "traces": 0,
+            "endpoints": len(self.graph.interner.endpoints),
+            "edges": int(self.graph.n_edges),
+            "quarantined": 1,
+            "reason": reason,
+            "ms": round((time.perf_counter() - wall_t0) * 1000, 1),
+        }
+
     def ingest_raw_window(self, raw: bytes) -> dict:
         """Raw Zipkin response bytes -> persistent device graph, uncapped.
 
@@ -775,12 +856,26 @@ class DataProcessor:
         store serving the graph scorers. Feed it from
         ZipkinClient.get_trace_list_raw (POST /ingest on the DP server).
 
-        Raises ValueError when the native loader is unavailable or the
-        payload is malformed (callers may fall back to collect)."""
+        A malformed payload (or one over the KMAMIZ_INGEST_MAX_BYTES
+        cap) diverts to the quarantine with a reason code and returns a
+        zero-span summary carrying ``quarantined``/``reason`` — the
+        caller's pipeline keeps going. KMAMIZ_QUARANTINE=0 restores the
+        old behavior (ValueError). A missing native extension still
+        raises ValueError either way (callers fall back to collect)."""
         from kmamiz_tpu.core.spans import raw_spans_to_batch
 
         t_start = self._now_ms()  # domain time for the dedup registration
         wall_t0 = time.perf_counter()
+        quarantine_on = res_quarantine.enabled()
+        if quarantine_on and len(raw) > res_quarantine.max_payload_bytes():
+            # size gate BEFORE the parse: a trace bomb never reaches the
+            # native scanner, the interner, or the device
+            res_quarantine.default_quarantine().put(
+                raw, res_quarantine.REASON_TRACE_BOMB, source="ingest_raw_window"
+            )
+            return self._quarantined_summary(
+                res_quarantine.REASON_TRACE_BOMB, wall_t0
+            )
         with self._dedup_lock:
             skipset = self._skipset_locked()
             skip_blob = None if skipset is not None else self._skip_blob_locked()
@@ -794,10 +889,14 @@ class DataProcessor:
                 session=session,
             )
         if out is None:
-            raise ValueError(
-                "native span loader unavailable or malformed payload"
-            )
+            if not quarantine_on:
+                raise ValueError(
+                    "native span loader unavailable or malformed payload"
+                )
+            reason = self._divert_poison(raw, "ingest_raw_window")
+            return self._quarantined_summary(reason, wall_t0)
         batch, kept = out
+        self._wal_append(raw)
         # dedup state during the (long) parse: the blob path snapshots
         # before parsing (a trace a concurrent collect() processes in
         # between merges twice — benign for the set-union edge store);
@@ -900,11 +999,14 @@ class DataProcessor:
         adversarial cross-trace id collisions can change the
         processed-row count.
 
-        Failure semantics: per-chunk at-least-once. A malformed LATER
-        chunk rides the ring in order, so every chunk parsed before it
-        merges and registers first, THEN the error raises (the set-union
-        edge store makes re-merges benign; the one-shot
-        ingest_raw_window path stays all-or-nothing).
+        Failure semantics: per-chunk quarantine. A malformed chunk
+        diverts to the quarantine with a reason code and the stream
+        KEEPS GOING — the graph the surviving chunks build is bit-exact
+        with ingesting only those chunks (tests/test_resilience.py).
+        With KMAMIZ_QUARANTINE=0 the old per-chunk at-least-once abort
+        returns: every chunk parsed before the poison merges and
+        registers first, then the error raises. A missing native
+        extension always aborts (nothing can parse).
 
         Returns the ingest_raw_window totals plus overlap accounting
         (parse_ms / merge_ms / saved_ms), `pipeline_depth` and the peak
@@ -923,6 +1025,7 @@ class DataProcessor:
         parse_ms = 0.0
         merge_ms = 0.0
         totals = {"spans": 0, "traces": 0, "chunks": 0}
+        quarantined = {"n": 0}
         chunk_detail = []
         ring: "queue.Queue" = queue.Queue(maxsize=depth)
         ring_peak = 0
@@ -935,12 +1038,19 @@ class DataProcessor:
                     return True
                 except queue.Full:
                     continue
+            if item[0] == "chunk":
+                # the consumer bailed with this parsed chunk in hand:
+                # it never merges. Count it — a silently shrinking
+                # window must be visible in /health/timings.
+                res_metrics.incr("ingestDropped")
             return False
 
         def _producer() -> None:
             """Stage 1: fetch + parse + dedup-register, strictly in chunk
             order. parse_ms per chunk includes the source fetch (the
             iterator has exactly one consumer: this thread)."""
+            quarantine_on = res_quarantine.enabled()
+            size_cap = res_quarantine.max_payload_bytes()
             try:
                 it = iter(chunks)
                 while not stop.is_set():
@@ -948,6 +1058,14 @@ class DataProcessor:
                         raw = next(it)
                     except StopIteration:
                         break
+                    if quarantine_on and len(raw) > size_cap:
+                        res_quarantine.default_quarantine().put(
+                            raw,
+                            res_quarantine.REASON_TRACE_BOMB,
+                            source="ingest_raw_stream",
+                        )
+                        quarantined["n"] += 1
+                        continue
                     with self._dedup_lock:
                         skipset = self._skipset_locked()
                         skip_blob = (
@@ -967,6 +1085,14 @@ class DataProcessor:
                     dt = (time.perf_counter() - t0) * 1000.0
                     step_timer.record("ingest_parse", dt)
                     if out is None:
+                        if quarantine_on:
+                            # divert the poison chunk, keep streaming;
+                            # _divert_poison re-raises only for a
+                            # missing native extension, which aborts
+                            # below like any source error
+                            self._divert_poison(raw, "ingest_raw_stream")
+                            quarantined["n"] += 1
+                            continue
                         _put(
                             (
                                 "error",
@@ -979,6 +1105,7 @@ class DataProcessor:
                         )
                         return
                     batch, kept = out
+                    self._wal_append(raw)
                     # registration precedes the next iteration's parse,
                     # so chunk k+1 snapshots a processed set that already
                     # includes chunk k — regardless of ring depth
@@ -1046,6 +1173,7 @@ class DataProcessor:
         wall_ms = (time.perf_counter() - wall_t0) * 1000
         return {
             **totals,
+            "quarantined": quarantined["n"],
             "endpoints": len(self.graph.interner.endpoints),
             "edges": n_edges,
             "chunk_detail": chunk_detail,

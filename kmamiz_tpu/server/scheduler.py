@@ -20,6 +20,7 @@ import re
 import threading
 from typing import Callable, Dict, List, Optional, Union
 
+from kmamiz_tpu.resilience import metrics as res_metrics
 from kmamiz_tpu.server.cron import CronError, CronExpr
 
 logger = logging.getLogger("kmamiz_tpu.scheduler")
@@ -71,17 +72,25 @@ class Job:
             while True:
                 try:
                     delay = self._next_delay()
-                except Exception:  # noqa: BLE001 - delay errors must not kill the loop
+                except Exception as err:  # noqa: BLE001 - delay errors must not kill the loop
                     logger.exception(
                         "scheduled job %s cannot compute its next fire", self.name
                     )
+                    res_metrics.job_failed(self.name, err)
                     delay = 60.0
                 if self._stop.wait(delay):
                     return
                 try:
                     self.fn()
-                except Exception:  # noqa: BLE001 - job errors must not kill the loop
+                except Exception as err:  # noqa: BLE001 - job errors must not kill the loop
+                    # the loop survives, but the failure streak + last
+                    # error surface in /health's resilience section —
+                    # a job silently failing every fire is no longer
+                    # only visible at debug log level
                     logger.exception("scheduled job %s failed", self.name)
+                    res_metrics.job_failed(self.name, err)
+                else:
+                    res_metrics.job_succeeded(self.name)
 
         self._thread = threading.Thread(target=run, name=f"job-{self.name}", daemon=True)
         self._thread.start()

@@ -50,6 +50,20 @@ def pytest_configure(config):
     )
 
 
+@pytest.fixture(autouse=True)
+def _reset_resilience_state():
+    """The resilience layer keeps process-wide registries (circuit
+    breakers, counters, the default quarantine binding). A breaker a
+    test trips must not short-circuit the next test's upstream calls, so
+    every test starts from a clean slate."""
+    from kmamiz_tpu.resilience import breaker, metrics, quarantine
+
+    breaker.reset_for_tests()
+    metrics.reset_for_tests()
+    quarantine.reset_for_tests()
+    yield
+
+
 FIXTURES = Path(__file__).parent / "fixtures"
 
 

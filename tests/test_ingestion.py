@@ -177,7 +177,10 @@ class TestZipkinPagination:
         ]
         assert json.loads(pages[0])[0][0]["traceId"] == "t0"
 
-    def test_empty_and_failed_pages_are_skipped(self, mock_api):
+    def test_empty_and_failed_pages_are_skipped(self, mock_api, monkeypatch):
+        # single-attempt fetches pin the page-skip contract itself; the
+        # retry/backoff layered on top is covered in test_resilience.py
+        monkeypatch.setenv("KMAMIZ_RETRY_ATTEMPTS", "1")
         server, api = mock_api
         calls = {"n": 0}
 

@@ -327,12 +327,13 @@ class TestRawIngestSurface:
         response = dp.collect({"uniqueId": "x", "time": 1646208339000})
         assert response["combined"] == []
 
-    def test_http_ingest_route(self):
+    def test_http_ingest_route(self, monkeypatch, tmp_path):
         import urllib.request
 
         from kmamiz_tpu.server.dp_server import DataProcessorServer
         from kmamiz_tpu.server.processor import DataProcessor
 
+        monkeypatch.setenv("KMAMIZ_QUARANTINE_DIR", str(tmp_path / "q"))
         dp = DataProcessor(trace_source=lambda lb, t, lim: [])
         server = DataProcessorServer(dp, host="127.0.0.1", port=0)
         server.start()
@@ -343,10 +344,14 @@ class TestRawIngestSurface:
             )
             summary = json.loads(urllib.request.urlopen(req).read())
             assert summary["spans"] == 8 and summary["edges"] > 0
-            # malformed body -> 400, collect route untouched
+            # malformed body -> quarantined, graph untouched, 200
             bad = urllib.request.Request(
                 f"http://127.0.0.1:{server.port}/ingest", data=b"nope"
             )
+            summary = json.loads(urllib.request.urlopen(bad).read())
+            assert summary["quarantined"] == 1 and summary["spans"] == 0
+            # with the quarantine disabled, the legacy 400 contract holds
+            monkeypatch.setenv("KMAMIZ_QUARANTINE", "0")
             try:
                 urllib.request.urlopen(bad)
                 raise AssertionError("expected 400")
@@ -848,12 +853,15 @@ def test_mt_large_fuzz_window():
     assert seq["trace_ids"] == mt["trace_ids"]
 
 
-def test_stream_malformed_later_chunk_at_least_once():
-    """ingest_raw_stream's documented failure semantics: a malformed later
-    chunk raises AFTER earlier chunks merged and registered (per-chunk
-    at-least-once); the one-shot path stays all-or-nothing."""
+def test_stream_malformed_later_chunk_at_least_once(monkeypatch):
+    """ingest_raw_stream's legacy failure semantics (KMAMIZ_QUARANTINE=0):
+    a malformed later chunk raises AFTER earlier chunks merged and
+    registered (per-chunk at-least-once); the one-shot path stays
+    all-or-nothing. With the quarantine enabled (default) the malformed
+    chunk diverts instead — pinned in test_resilience.py."""
     from kmamiz_tpu.server.processor import DataProcessor
 
+    monkeypatch.setenv("KMAMIZ_QUARANTINE", "0")
     mk = mk_span
     good = json.dumps([[mk("tA", "a")], [mk("tB", "b")]]).encode()
     bad = b'[[{"traceId": "tC", "id": '  # truncated

@@ -1,0 +1,618 @@
+"""Resilience-layer pins (kmamiz_tpu/resilience/, docs/RESILIENCE.md).
+
+The three ISSUE-5 contracts plus the pieces they compose from:
+
+  (a) poison-input quarantine is *bit-exact* on survivors — a chaos run
+      over a poisoned chunk stream builds the same graph (same
+      signature) as ingesting only the untouched chunks;
+  (b) the circuit breaker walks closed -> open -> half-open -> closed
+      exactly as specified, short-circuiting without touching the
+      upstream while open;
+  (c) a crash between the WAL append and the graph merge replays to a
+      bit-exact graph on restart.
+
+Like test_ingest_pipeline.py, the ingest tests run the pure-Python
+stand-in for the native raw parser (json.loads + spans_to_batch — the
+semantics the native scanner is separately tested to be byte-identical
+to), so they pass with or without the built extension. The full-stack
+versions of these invariants — real parser, real HTTP server, real
+SIGKILL — live in tools/chaos_probe.py; the slow soak here runs it.
+"""
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+from kmamiz_tpu.core import spans as spans_mod
+from kmamiz_tpu.core.spans import spans_to_batch
+from kmamiz_tpu.resilience import metrics as res_metrics
+from kmamiz_tpu.resilience import quarantine as res_quarantine
+from kmamiz_tpu.resilience.breaker import (
+    CLOSED,
+    HALF_OPEN,
+    OPEN,
+    BreakerOpenError,
+    CircuitBreaker,
+)
+from kmamiz_tpu.resilience.chaos import (
+    FaultPlan,
+    chaos_chunks,
+    graph_signature,
+    mutate_payload,
+)
+from kmamiz_tpu.resilience.retry import Retrier
+from kmamiz_tpu.resilience.wal import IngestWAL
+from kmamiz_tpu.resilience.watchdog import (
+    REASON_DEADLINE,
+    REASON_IN_FLIGHT,
+    TickDeadlineExceeded,
+    TickWatchdog,
+)
+from kmamiz_tpu.server.processor import DataProcessor
+
+CHAOS_FIXTURES = Path(__file__).parent / "fixtures" / "chaos"
+
+
+# -- scaffolding: pure-Python raw parser (test_ingest_pipeline.py) -----------
+
+
+def mk_span(tid, sid, parent=None, svc="svc", url=None):
+    return {
+        "traceId": tid,
+        "id": sid,
+        "parentId": parent,
+        "kind": "SERVER",
+        "name": f"{svc}.ns.svc.cluster.local:80/*",
+        "timestamp": 1_700_000_000_000_000,
+        "duration": 1000,
+        "tags": {
+            "http.method": "GET",
+            "http.status_code": "200",
+            "http.url": url or f"http://{svc}.ns/api",
+            "istio.canonical_revision": "v1",
+            "istio.canonical_service": svc,
+            "istio.mesh_id": "cluster.local",
+            "istio.namespace": "ns",
+        },
+    }
+
+
+def clean_chunks(n_traces=24, per_chunk=2, prefix="t"):
+    groups = []
+    for t in range(n_traces):
+        tid = f"{prefix}{t}"
+        parent = mk_span(tid, f"{tid}p")
+        child = mk_span(
+            tid,
+            f"{tid}c",
+            parent=f"{tid}p",
+            svc=f"down{t % 5}",
+            url=f"http://down{t % 5}.ns/api/{t % 3}",
+        )
+        groups.append([parent, child])
+    return [
+        json.dumps(groups[i : i + per_chunk]).encode()
+        for i in range(0, len(groups), per_chunk)
+    ]
+
+
+def _fake_raw_parser(raw, interner=None, **kw):
+    """json.loads + spans_to_batch with the documented None-on-malformed
+    contract (dedup is irrelevant here: every test uses distinct ids)."""
+    try:
+        groups = json.loads(raw)
+    except Exception:
+        return None
+    if not isinstance(groups, list) or any(
+        not isinstance(g, list) for g in groups
+    ):
+        return None
+    return spans_to_batch(groups, interner=interner), [
+        g[0].get("traceId") for g in groups if g
+    ]
+
+
+@pytest.fixture
+def dp(monkeypatch, tmp_path):
+    monkeypatch.setattr(spans_mod, "raw_spans_to_batch", _fake_raw_parser)
+    monkeypatch.setenv("KMAMIZ_QUARANTINE_DIR", str(tmp_path / "quarantine"))
+
+    def build():
+        p = DataProcessor(trace_source=lambda *a: [], use_device_stats=False)
+        p._skipset_locked = lambda: None
+        p._raw_session_locked = lambda: None
+        return p
+
+    return build
+
+
+# -- (a) quarantine: fixtures corpus + bit-exactness -------------------------
+
+
+@pytest.mark.parametrize(
+    "name, reason",
+    [
+        ("truncated-json", res_quarantine.REASON_TRUNCATED_JSON),
+        ("garbage-utf8", res_quarantine.REASON_GARBAGE_UTF8),
+        ("schema-drift", res_quarantine.REASON_SCHEMA_DRIFT),
+        ("trace-bomb", res_quarantine.REASON_TRACE_BOMB),
+    ],
+)
+def test_fixture_corpus_classification(name, reason, monkeypatch):
+    monkeypatch.setenv("KMAMIZ_INGEST_MAX_BYTES", "4096")
+    raw = (CHAOS_FIXTURES / f"{name}.bin").read_bytes()
+    assert res_quarantine.classify_payload(raw) == reason
+
+
+def test_fixture_parse_error_is_structurally_sound():
+    # classify_payload clears it; only the parser itself can reject it
+    raw = (CHAOS_FIXTURES / "parse-error.bin").read_bytes()
+    assert res_quarantine.classify_payload(raw) is None
+
+
+@pytest.mark.parametrize(
+    "name, reason",
+    [
+        ("truncated-json", res_quarantine.REASON_TRUNCATED_JSON),
+        ("garbage-utf8", res_quarantine.REASON_GARBAGE_UTF8),
+        ("schema-drift", res_quarantine.REASON_SCHEMA_DRIFT),
+        ("trace-bomb", res_quarantine.REASON_TRACE_BOMB),
+    ],
+)
+def test_fixture_corpus_quarantined_on_ingest(dp, monkeypatch, name, reason):
+    monkeypatch.setenv("KMAMIZ_INGEST_MAX_BYTES", "4096")
+    raw = (CHAOS_FIXTURES / f"{name}.bin").read_bytes()
+    out = dp().ingest_raw_window(raw)
+    assert out["quarantined"] == 1
+    assert out["reason"] == reason
+    assert out["spans"] == 0
+    stats = res_quarantine.quarantine_stats()
+    assert stats["byReason"] == {reason: 1}
+    # the payload itself is preserved on disk for offline diagnosis
+    q_dir = Path(res_quarantine.default_quarantine()._dir)
+    (payload_file,) = q_dir.glob("*.bin")
+    assert payload_file.read_bytes() == raw
+    meta = json.loads(payload_file.with_suffix(".meta.json").read_text())
+    assert meta["reason"] == reason
+    assert meta["source"] == "ingest_raw_window"
+
+
+def test_parse_error_reason_when_native_rejects(dp, monkeypatch):
+    """A structurally sound payload the parser still rejects lands as
+    parse-error — provided the rejection isn't just a missing native
+    extension (then the old ValueError fallback contract holds)."""
+    from kmamiz_tpu import native
+
+    monkeypatch.setattr(
+        spans_mod, "raw_spans_to_batch", lambda raw, **kw: None
+    )
+    monkeypatch.setattr(native, "available", lambda: True)
+    raw = (CHAOS_FIXTURES / "parse-error.bin").read_bytes()
+    processor = dp()
+    out = processor.ingest_raw_window(raw)
+    assert out["quarantined"] == 1
+    assert out["reason"] == res_quarantine.REASON_PARSE_ERROR
+
+
+def test_native_unavailable_still_raises_not_quarantines(dp, monkeypatch):
+    from kmamiz_tpu import native
+
+    monkeypatch.setattr(
+        spans_mod, "raw_spans_to_batch", lambda raw, **kw: None
+    )
+    monkeypatch.setattr(native, "available", lambda: False)
+    raw = (CHAOS_FIXTURES / "parse-error.bin").read_bytes()
+    with pytest.raises(ValueError):
+        dp().ingest_raw_window(raw)
+    assert res_quarantine.quarantine_stats()["count"] == 0
+
+
+def test_quarantine_disabled_restores_abort_contract(dp, monkeypatch):
+    monkeypatch.setenv("KMAMIZ_QUARANTINE", "0")
+    raw = (CHAOS_FIXTURES / "truncated-json.bin").read_bytes()
+    with pytest.raises(ValueError):
+        dp().ingest_raw_window(raw)
+
+
+def test_clean_batches_bitexact_with_quarantine_enabled(dp, monkeypatch):
+    """Pillar (a): the chaos run's graph equals the clean-only run's —
+    poison is diverted, survivors merge bit-exactly, nothing leaks."""
+    monkeypatch.setenv("KMAMIZ_INGEST_MAX_BYTES", "4000")
+    chunks = clean_chunks()
+    delivered, clean_indices = chaos_chunks(chunks, FaultPlan(seed=3))
+    poisoned = len(delivered) - len(clean_indices)
+    assert 0 < len(clean_indices) < len(chunks)  # seed 3 poisons some
+
+    chaos_dp = dp()
+    quarantined = 0
+    for raw in delivered:
+        quarantined += chaos_dp.ingest_raw_window(raw).get("quarantined", 0)
+
+    clean_dp = dp()
+    for i in clean_indices:
+        out = clean_dp.ingest_raw_window(chunks[i])
+        assert out.get("quarantined", 0) == 0
+
+    assert quarantined == poisoned
+    assert graph_signature(chaos_dp.graph) == graph_signature(clean_dp.graph)
+    assert res_quarantine.quarantine_stats()["count"] == poisoned
+
+
+def test_quarantine_eviction_is_bounded(tmp_path):
+    q = res_quarantine.Quarantine(
+        directory=str(tmp_path / "q"), max_bytes=10_000, max_files=3
+    )
+    for i in range(8):
+        q.put(b"x" * 100, res_quarantine.REASON_SCHEMA_DRIFT, source="test")
+    stats = q.stats()
+    assert stats["count"] == 8  # totals keep counting
+    assert stats["files"] <= 3  # disk stays bounded
+
+
+def test_chaos_plan_is_deterministic():
+    chunks = clean_chunks()
+    d1, c1 = chaos_chunks(chunks, FaultPlan(seed=11))
+    d2, c2 = chaos_chunks(chunks, FaultPlan(seed=11))
+    assert d1 == d2 and c1 == c2
+    d3, _ = chaos_chunks(chunks, FaultPlan(seed=12))
+    assert d1 != d3
+
+
+# -- (b) circuit breaker state machine ---------------------------------------
+
+
+def test_breaker_opens_after_threshold_and_half_opens():
+    clock = {"t": 0.0}
+    breaker = CircuitBreaker(
+        "t-breaker", threshold=3, cooldown_s=5.0, now=lambda: clock["t"]
+    )
+
+    def boom():
+        raise ConnectionError("down")
+
+    assert breaker.state == CLOSED
+    for _ in range(2):
+        with pytest.raises(ConnectionError):
+            breaker.call(boom)
+    assert breaker.state == CLOSED  # below threshold
+    with pytest.raises(ConnectionError):
+        breaker.call(boom)
+    assert breaker.state == OPEN
+
+    # open: short-circuit, the upstream is never touched
+    calls = {"n": 0}
+
+    def probe():
+        calls["n"] += 1
+        return "ok"
+
+    with pytest.raises(BreakerOpenError) as err:
+        breaker.call(probe)
+    assert calls["n"] == 0
+    assert err.value.retry_in_s == pytest.approx(5.0)
+
+    clock["t"] += 5.0
+    assert breaker.state == HALF_OPEN
+    # failed probe re-opens and restarts the cooldown
+    with pytest.raises(ConnectionError):
+        breaker.call(boom)
+    assert breaker.state == OPEN
+    clock["t"] += 5.0
+    assert breaker.call(probe) == "ok"
+    assert breaker.state == CLOSED
+    assert calls["n"] == 1
+
+
+def test_breaker_half_open_probe_quota():
+    clock = {"t": 10.0}
+    breaker = CircuitBreaker(
+        "q-breaker",
+        threshold=1,
+        cooldown_s=1.0,
+        half_open_max=1,
+        now=lambda: clock["t"],
+    )
+    breaker.record_failure()
+    clock["t"] += 1.0
+    breaker.allow()  # reserves the single half-open slot
+    with pytest.raises(BreakerOpenError):
+        breaker.allow()  # second concurrent probe is short-circuited
+    breaker.record_success()
+    assert breaker.state == CLOSED
+
+
+def test_breaker_success_resets_failure_streak():
+    breaker = CircuitBreaker("s-breaker", threshold=3, cooldown_s=1.0)
+    breaker.record_failure()
+    breaker.record_failure()
+    breaker.record_success()
+    breaker.record_failure()
+    breaker.record_failure()
+    assert breaker.state == CLOSED  # streak restarted, never hit 3
+
+
+# -- retry --------------------------------------------------------------------
+
+
+def test_retrier_retries_then_succeeds():
+    sleeps = []
+    attempts = {"n": 0}
+
+    def flaky():
+        attempts["n"] += 1
+        if attempts["n"] < 3:
+            raise OSError("blip")
+        return "ok"
+
+    retrier = Retrier(
+        "t-retry",
+        attempts=3,
+        base_ms=100,
+        retry_on=(OSError,),
+        sleep=sleeps.append,
+    )
+    assert retrier.call(flaky) == "ok"
+    assert attempts["n"] == 3
+    assert len(sleeps) == 2
+    assert res_metrics.get("retry.t-retry") == 2
+
+
+def test_retrier_exhaustion_reraises_last_error():
+    def always():
+        raise OSError("still down")
+
+    retrier = Retrier(
+        "t-retry", attempts=2, retry_on=(OSError,), sleep=lambda s: None
+    )
+    with pytest.raises(OSError):
+        retrier.call(always)
+
+
+def test_retrier_does_not_retry_open_breaker():
+    """BreakerOpenError is outside retry_on: retrying into an open
+    breaker would burn backoff for a guaranteed short-circuit."""
+    attempts = {"n": 0}
+
+    def short_circuit():
+        attempts["n"] += 1
+        raise BreakerOpenError("b", 1.0)
+
+    retrier = Retrier(
+        "t-retry", attempts=5, retry_on=(OSError,), sleep=lambda s: None
+    )
+    with pytest.raises(BreakerOpenError):
+        retrier.call(short_circuit)
+    assert attempts["n"] == 1
+
+
+def test_retrier_backoff_is_jittered_exponential():
+    import random
+
+    retrier = Retrier(
+        "t-retry", attempts=4, base_ms=100, max_ms=250, rng=random.Random(0)
+    )
+    for attempt, ceiling in ((1, 100), (2, 200), (3, 250)):
+        for _ in range(20):
+            assert 0.0 <= retrier.backoff_ms(attempt) <= ceiling
+
+
+# -- (c) WAL: crash-safe recovery --------------------------------------------
+
+
+def test_wal_append_replay_roundtrip(tmp_path):
+    wal = IngestWAL(str(tmp_path / "wal"))
+    payloads = [f"payload-{i}".encode() for i in range(5)]
+    for p in payloads:
+        wal.append(p)
+    wal.close()
+    assert list(IngestWAL(str(tmp_path / "wal")).replay()) == payloads
+
+
+def test_wal_replay_stops_at_torn_tail(tmp_path):
+    wal = IngestWAL(str(tmp_path / "wal"))
+    wal.append(b"alpha")
+    wal.append(b"beta")
+    wal.close()
+    (segment,) = sorted((tmp_path / "wal").glob("*.wal"))
+    whole = segment.read_bytes()
+    segment.write_bytes(whole[:-3])  # kill -9 mid-write: torn last record
+    assert list(IngestWAL(str(tmp_path / "wal")).replay()) == [b"alpha"]
+
+
+def test_wal_rotation_keeps_newest_segments(tmp_path):
+    wal = IngestWAL(
+        str(tmp_path / "wal"), segment_bytes=64, keep_segments=2
+    )
+    for i in range(12):
+        wal.append(f"record-{i:02d}-{'x' * 40}".encode())
+    wal.close()
+    segments = sorted((tmp_path / "wal").glob("*.wal"))
+    assert len(segments) <= 2
+    replayed = list(IngestWAL(str(tmp_path / "wal"), segment_bytes=64).replay())
+    assert replayed  # newest records survive
+    assert replayed[-1].startswith(b"record-11")
+
+
+def test_kill_between_wal_append_and_merge_replays_bitexact(
+    dp, monkeypatch, tmp_path
+):
+    """Pillar (c): the WAL'd-but-unmerged window is recovered on replay
+    and the restored graph equals a run that never crashed."""
+    chunks = clean_chunks(prefix="w")
+
+    # reference: every window ingested, no crash, no WAL
+    reference = dp()
+    for raw in chunks:
+        reference.ingest_raw_window(raw)
+    reference_sig = graph_signature(reference.graph)
+
+    monkeypatch.setenv("KMAMIZ_WAL", "1")
+    monkeypatch.setenv("KMAMIZ_WAL_DIR", str(tmp_path / "wal"))
+    crashing = dp()
+    for raw in chunks[:-1]:
+        crashing.ingest_raw_window(raw)
+    # the crash point: final window durably appended, merge never ran
+    crashing._wal_append(chunks[-1])
+    del crashing  # kill -9 (the real-SIGKILL version: chaos_probe pillar 4)
+
+    recovered = dp()
+    replay = recovered.replay_wal()
+    assert replay["replayed"] == len(chunks)
+    assert replay["quarantined"] == 0
+    assert graph_signature(recovered.graph) == reference_sig
+    assert res_metrics.get("walReplays") == 1
+
+
+def test_wal_off_by_default(dp):
+    processor = dp()
+    assert processor._wal is None
+    assert processor.replay_wal() == {
+        "replayed": 0,
+        "spans": 0,
+        "quarantined": 0,
+    }
+
+
+# -- watchdog -----------------------------------------------------------------
+
+
+def test_watchdog_passthrough_when_disabled(monkeypatch):
+    monkeypatch.delenv("KMAMIZ_TICK_DEADLINE_MS", raising=False)
+    assert TickWatchdog().run(lambda: 41 + 1) == 42
+
+
+def test_watchdog_fast_tick_passes_result_and_errors():
+    watchdog = TickWatchdog(deadline_ms=5_000)
+    assert watchdog.run(lambda: {"ok": True}) == {"ok": True}
+
+    def boom():
+        raise RuntimeError("tick fault")
+
+    with pytest.raises(RuntimeError, match="tick fault"):
+        watchdog.run(boom)
+    # a fault is not an overrun: the next tick is admitted immediately
+    assert watchdog.run(lambda: "next") == "next"
+
+
+def test_watchdog_deadline_trip_delivers_late_result():
+    late = []
+    release = threading.Event()
+    delivered = threading.Event()
+
+    def deliver(result):
+        late.append(result)
+        delivered.set()
+
+    watchdog = TickWatchdog(deadline_ms=50, on_late_result=deliver)
+
+    def straggler():
+        release.wait(5.0)
+        return "late-graph"
+
+    with pytest.raises(TickDeadlineExceeded) as err:
+        watchdog.run(straggler)
+    assert err.value.reason == REASON_DEADLINE
+
+    # the straggler is still in flight: the next tick trips immediately
+    with pytest.raises(TickDeadlineExceeded) as err:
+        watchdog.run(lambda: "never-runs")
+    assert err.value.reason == REASON_IN_FLIGHT
+
+    release.set()
+    assert delivered.wait(5.0)
+    assert late == ["late-graph"]
+    state = res_metrics.watchdog_state()
+    assert state["byReason"] == {REASON_DEADLINE: 1, REASON_IN_FLIGHT: 1}
+    # straggler drained: a fresh tick runs again
+    assert watchdog.run(lambda: "fresh") == "fresh"
+
+
+# -- metrics surfacing --------------------------------------------------------
+
+
+def test_job_failure_streaks_and_reset():
+    res_metrics.job_failed("realtime", RuntimeError("zipkin down"))
+    res_metrics.job_failed("realtime", RuntimeError("zipkin down"))
+    state = res_metrics.job_states()["realtime"]
+    assert state["consecutiveFailures"] == 2
+    assert state["totalFailures"] == 2
+    assert "zipkin down" in state["lastError"]
+    res_metrics.job_succeeded("realtime")
+    state = res_metrics.job_states()["realtime"]
+    assert state["consecutiveFailures"] == 0
+    assert state["totalFailures"] == 2  # history survives the reset
+
+
+def test_resilience_summary_shape():
+    res_metrics.incr("ingestDropped")
+    res_metrics.incr("dpFallback", 2)
+    summary = res_metrics.resilience_summary()
+    assert summary["ingestDropped"] == 1
+    assert summary["dpFallback"] == 2
+    for key in ("breakers", "quarantine", "watchdog", "jobs", "counters"):
+        assert key in summary
+
+
+def test_scheduler_job_failure_surfaces_in_metrics():
+    from kmamiz_tpu.server.scheduler import Job
+
+    fired = threading.Event()
+
+    def flaky_job():
+        fired.set()
+        raise RuntimeError("job blew up")
+
+    job = Job("flaky", 0.01, flaky_job)
+    job.start()
+    try:
+        assert fired.wait(5.0)
+        deadline = time.time() + 5.0
+        while time.time() < deadline:
+            state = res_metrics.job_states().get("flaky")
+            if state and state["consecutiveFailures"] >= 1:
+                break
+            time.sleep(0.01)
+    finally:
+        job.stop()
+    state = res_metrics.job_states()["flaky"]
+    assert state["consecutiveFailures"] >= 1
+    assert "job blew up" in state["lastError"]
+
+
+def test_dp_timeout_env_knob(monkeypatch):
+    from kmamiz_tpu.server.operator import _dp_timeout_s
+
+    monkeypatch.delenv("KMAMIZ_DP_TIMEOUT_S", raising=False)
+    assert _dp_timeout_s() == 30.0
+    monkeypatch.setenv("KMAMIZ_DP_TIMEOUT_S", "2.5")
+    assert _dp_timeout_s() == 2.5
+    monkeypatch.setenv("KMAMIZ_DP_TIMEOUT_S", "not-a-number")
+    assert _dp_timeout_s() == 30.0
+
+
+# -- slow soak: the full-stack probe ------------------------------------------
+
+
+@pytest.mark.slow
+def test_chaos_probe_full_stack_soak():
+    """tools/chaos_probe.py --seed 0: all four pillars against the real
+    parser, the real DP HTTP server, and a real SIGKILL child."""
+    repo = Path(__file__).resolve().parents[1]
+    out = subprocess.run(
+        [sys.executable, str(repo / "tools" / "chaos_probe.py"), "--seed", "0"],
+        capture_output=True,
+        text=True,
+        timeout=600,
+        cwd=str(repo),
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    probe = json.loads(out.stdout.strip().splitlines()[-1])
+    assert probe["ok"] is True
+    for pillar in ("quarantine", "breaker", "degraded_serve", "wal_recovery"):
+        assert probe[pillar]["ok"] is True

@@ -891,7 +891,7 @@ def main() -> None:
 
     # pre-generate every rep's window OUTSIDE the timed region: the metric
     # charges only DataProcessor.collect, not test-data synthesis
-    prebuilt = [tick_traces(i) for i in range(12)]
+    prebuilt = [tick_traces(i) for i in range(17)]
 
     def source(_lb, _t, _lim):
         return prebuilt.pop(0)
@@ -912,6 +912,19 @@ def main() -> None:
     # hot — endpoint-info/record templates, XLA executables, the graph's
     # device-resident scorer tables — i.e. production cadence after boot
     dp_tick_cached_ms = _timed_median(one_tick, reps=5) * 1000
+
+    # telemetry overhead: the same warm tick with span tracing gated off
+    # (KMAMIZ_TELEMETRY=0). The acceptance bound is tracing-on within 5%
+    # of this number; both medians ride identical prebuilt windows
+    _tel_prev = os.environ.get("KMAMIZ_TELEMETRY")
+    os.environ["KMAMIZ_TELEMETRY"] = "0"
+    try:
+        dp_tick_telemetry_off_ms = _timed_median(one_tick, reps=5) * 1000
+    finally:
+        if _tel_prev is None:
+            os.environ.pop("KMAMIZ_TELEMETRY", None)
+        else:
+            os.environ["KMAMIZ_TELEMETRY"] = _tel_prev
 
     # scorer read path between merges: the first read after a merge
     # computes (full or dirty-incremental), every repeated HTTP read is an
@@ -1282,6 +1295,15 @@ def main() -> None:
     lint_result = lint_framework.lint_repo()
     graftlint_repo_ms = (time.perf_counter() - t0) * 1000
 
+    # SLO scorecard over this run's DP ticks (telemetry/slo.py): bench is
+    # the first consumer of the headline keys ROADMAP item 5 asks for;
+    # tools/slo_report.py --check gates regressions against these
+    from kmamiz_tpu.telemetry import slo as tel_slo
+
+    slo_extras = {
+        f"slo_{k}": v for k, v in tel_slo.SCORECARD.snapshot().items()
+    }
+
     result = {
         **headline,
         "unit": "spans/sec",
@@ -1294,6 +1316,16 @@ def main() -> None:
         "e2e_host_cores": os.cpu_count(),
         "p50_graph_refresh_ms_10k_endpoints": round(refresh_ms, 2),
         **scale_extras,
+        # graph-scale headline keys (ROADMAP item 2): always present, None
+        # when the optional 100k section was skipped or failed, so a
+        # regression can never hide inside a missing key
+        "graph_refresh_ms_100k": scale_extras.get("graph_refresh_ms_100k"),
+        "graph_merge_wall_ms_100k": (
+            max(scale_extras["graph_scale_merge_walls_ms"])
+            if scale_extras.get("graph_scale_merge_walls_ms")
+            else None
+        ),
+        "graph_refresh_pass": bool(refresh_ms <= 50.0),
         "http_instability_10k_endpoints_ms": round(http_api_refresh_ms, 1),
         "walk_mxu_packed_ms": round(walk_mxu_ms, 1),
         "walk_flat_gather_ms": round(walk_flat_ms, 1),
@@ -1306,6 +1338,8 @@ def main() -> None:
         "n_services": N_SERVICES,
         "dp_tick_ms_2500_traces": round(dp_tick_ms, 1),
         "dp_tick_cached_ms": round(dp_tick_cached_ms, 1),
+        "dp_tick_telemetry_off_ms": round(dp_tick_telemetry_off_ms, 1),
+        **slo_extras,
         "dp_scorer_cached_read_ms": round(scorer_cached_read_ms, 3),
         "dp_scorer_cache_hit_rate": scorer_stats.get("hit_rate"),
         "dp_scorer_cache_stats": scorer_stats,

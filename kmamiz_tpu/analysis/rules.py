@@ -1,4 +1,4 @@
-"""The six shipped graftlint rules.
+"""The seven shipped graftlint rules.
 
 Each rule is a function (module, context) -> [Finding] registered via
 framework.rule(). Shared AST plumbing (jit-site extraction, parent maps,
@@ -826,3 +826,102 @@ def _write_to_shared(node: ast.AST, shared: Set[str]) -> Optional[str]:
         if hit:
             return hit[0]
     return None
+
+
+# ---------------------------------------------------------------------------
+# rule 7: hot-path-metric-label
+# ---------------------------------------------------------------------------
+
+# methods that mint a new metric child / family: calling one per tick
+# means a dict lookup + possible allocation under the registry lock on
+# every increment, instead of a one-time lookup at import
+_HANDLE_ACQUIRERS = {
+    "handle",
+    "labels",
+    "counter",
+    "gauge",
+    "histogram",
+    "counter_family",
+    "gauge_family",
+    "histogram_family",
+}
+# metric write methods whose first argument names the counter/series
+_METRIC_WRITERS = {"incr", "inc", "observe"}
+# the registry implementation itself necessarily calls these
+_METRIC_IMPL_PATHS = ("kmamiz_tpu/telemetry/",)
+
+
+def _is_stringy(node: ast.AST) -> bool:
+    return (
+        isinstance(node, ast.Constant) and isinstance(node.value, str)
+    ) or isinstance(node, ast.JoinedStr)
+
+
+def _formatted_name(node: ast.AST) -> bool:
+    """Is this expression a metric name/label built per call — f-string
+    with interpolation, str.format(), %-format, or string concatenation?"""
+    if isinstance(node, ast.JoinedStr):
+        return any(isinstance(v, ast.FormattedValue) for v in node.values)
+    if (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr == "format"
+        and _is_stringy(node.func.value)
+    ):
+        return True
+    if isinstance(node, ast.BinOp) and isinstance(node.op, (ast.Mod, ast.Add)):
+        return _is_stringy(node.left) or _is_stringy(node.right)
+    return False
+
+
+@rule(
+    "hot-path-metric-label",
+    "hot-path metric writes must go through handles preallocated at "
+    "import time: no handle/family acquisition and no per-call label "
+    "formatting (f-string/.format/%/concat names) in functions reachable "
+    "from the tick/serve entry points",
+)
+def check_hot_path_metric_label(
+    mod: ModuleInfo, ctx: LintContext
+) -> List[Finding]:
+    if mod.rel_path.startswith(_METRIC_IMPL_PATHS):
+        return []
+    findings: List[Finding] = []
+    for suffix, fn_node in _functions(mod):
+        if not ctx.is_hot(f"{mod.rel_path}:{suffix}"):
+            continue
+        for node in _walk_own(fn_node):
+            if not isinstance(node, ast.Call) or not isinstance(
+                node.func, ast.Attribute
+            ):
+                continue
+            attr = node.func.attr
+            if attr in _HANDLE_ACQUIRERS:
+                findings.append(
+                    Finding(
+                        "hot-path-metric-label",
+                        mod.rel_path,
+                        node.lineno,
+                        f"metric handle acquisition '.{attr}(...)' on the "
+                        "hot path: look the handle up once at import time "
+                        "and write through it",
+                    )
+                )
+                continue
+            if (
+                attr in _METRIC_WRITERS
+                and node.args
+                and _formatted_name(node.args[0])
+            ):
+                findings.append(
+                    Finding(
+                        "hot-path-metric-label",
+                        mod.rel_path,
+                        node.lineno,
+                        f"per-call label formatting in '.{attr}(...)' on "
+                        "the hot path: a formatted metric name allocates "
+                        "every call and has unbounded cardinality — use a "
+                        "preallocated handle",
+                    )
+                )
+    return findings

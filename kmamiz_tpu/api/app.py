@@ -22,6 +22,7 @@ from kmamiz_tpu.api.handlers import (
     HealthHandler,
     ModelHandler,
     SwaggerHandler,
+    TelemetryHandler,
 )
 from kmamiz_tpu.api.router import ApiServer, Router
 from kmamiz_tpu.config import Settings, settings as default_settings
@@ -101,6 +102,7 @@ def build_router(
         ConfigurationHandler(ctx),
         HealthHandler(ctx),
         ModelHandler(ctx),
+        TelemetryHandler(ctx),
     ]
     try:  # simulator routes only exist when the simulator package is in use
         from kmamiz_tpu.simulator.handler import SimulationHandler

@@ -6,6 +6,7 @@ from kmamiz_tpu.api.handlers.graph import GraphHandler
 from kmamiz_tpu.api.handlers.health import HealthHandler
 from kmamiz_tpu.api.handlers.model import ModelHandler
 from kmamiz_tpu.api.handlers.swagger import SwaggerHandler
+from kmamiz_tpu.api.handlers.telemetry import TelemetryHandler
 
 __all__ = [
     "AlertHandler",
@@ -16,4 +17,5 @@ __all__ = [
     "HealthHandler",
     "ModelHandler",
     "SwaggerHandler",
+    "TelemetryHandler",
 ]

@@ -22,6 +22,16 @@ import threading
 import time
 from typing import Dict, Iterator, Optional
 
+from kmamiz_tpu.telemetry.registry import REGISTRY
+
+#: phase-duration histograms: same numbers as the /timings means, but
+#: with buckets, so /metrics gets percentiles. One handle per phase
+#: name, created on first use and cached (phase names are a small fixed
+#: vocabulary — see docs/TICK_PIPELINE.md)
+_PHASE_HIST = REGISTRY.histogram_family(
+    "kmamiz_step_phase_ms", "DP step-timer phase wall time (ms)", ("phase",)
+)
+
 
 class StepTimer:
     """Running per-phase wall-time stats (count / mean / max, in ms)."""
@@ -29,27 +39,9 @@ class StepTimer:
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._stats: Dict[str, Dict[str, float]] = {}
+        self._hists: Dict[str, object] = {}
 
-    @contextlib.contextmanager
-    def phase(self, name: str) -> Iterator[None]:
-        start = time.perf_counter()
-        try:
-            yield
-        finally:
-            elapsed_ms = (time.perf_counter() - start) * 1000
-            with self._lock:
-                entry = self._stats.setdefault(
-                    name, {"count": 0, "total_ms": 0.0, "max_ms": 0.0}
-                )
-                entry["count"] += 1
-                entry["total_ms"] += elapsed_ms
-                entry["max_ms"] = max(entry["max_ms"], elapsed_ms)
-
-    def record(self, name: str, elapsed_ms: float) -> None:
-        """Fold an externally measured duration into the same stats shape
-        as phase(): used where the region is already timed for its own
-        accounting (device transfers) or runs on a worker thread whose
-        wall time would double-count an enclosing phase."""
+    def _fold(self, name: str, elapsed_ms: float) -> None:
         with self._lock:
             entry = self._stats.setdefault(
                 name, {"count": 0, "total_ms": 0.0, "max_ms": 0.0}
@@ -57,6 +49,27 @@ class StepTimer:
             entry["count"] += 1
             entry["total_ms"] += elapsed_ms
             entry["max_ms"] = max(entry["max_ms"], elapsed_ms)
+            hist = self._hists.get(name)
+            if hist is None:
+                # first use of a phase name only; cached thereafter
+                hist = _PHASE_HIST.handle(name)  # graftlint: disable=hot-path-metric-label -- first-use registration, cached in _hists thereafter
+                self._hists[name] = hist
+        hist.observe(elapsed_ms)
+
+    @contextlib.contextmanager
+    def phase(self, name: str) -> Iterator[None]:
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            self._fold(name, (time.perf_counter() - start) * 1000)
+
+    def record(self, name: str, elapsed_ms: float) -> None:
+        """Fold an externally measured duration into the same stats shape
+        as phase(): used where the region is already timed for its own
+        accounting (device transfers) or runs on a worker thread whose
+        wall time would double-count an enclosing phase."""
+        self._fold(name, elapsed_ms)
 
     def summary(self) -> Dict[str, Dict[str, float]]:
         with self._lock:

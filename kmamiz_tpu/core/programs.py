@@ -400,6 +400,38 @@ def summary() -> dict:
     }
 
 
+def _scrape_programs() -> None:
+    """Scrape-time mirror of the per-program counters into the telemetry
+    registry — /metrics pulls the same `stats()` numbers /timings shows,
+    with zero hot-path writes (the registry callback runs at render
+    only)."""
+    from kmamiz_tpu.telemetry.registry import REGISTRY
+
+    calls = REGISTRY.gauge_family(
+        "kmamiz_program_calls_total", "Registered-program dispatches", ("program",)
+    )
+    compiles = REGISTRY.gauge_family(
+        "kmamiz_program_compiles_total", "Registered-program XLA compiles", ("program",)
+    )
+    compile_ms = REGISTRY.gauge_family(
+        "kmamiz_program_compile_ms_total", "Cumulative compile wall (ms)", ("program",)
+    )
+    for name, p in all_programs().items():
+        st = p.stats()
+        calls.handle(name).set(st["calls"])
+        compiles.handle(name).set(st["compiles"])
+        compile_ms.handle(name).set(st["compileMs"])
+
+
+def _register_scrape_callback() -> None:
+    from kmamiz_tpu.telemetry.registry import REGISTRY
+
+    REGISTRY.register_callback(_scrape_programs)
+
+
+_register_scrape_callback()
+
+
 def snapshot() -> Dict[str, int]:
     """Compile-count snapshot; diff with :func:`new_compiles_since`."""
     return {name: p.compiles for name, p in all_programs().items()}

@@ -36,6 +36,7 @@ from kmamiz_tpu.core.spans import (
     pack_trace_rows,
 )
 from kmamiz_tpu.ops import scorers as scorer_ops
+from kmamiz_tpu.telemetry.tracing import phase_span
 from kmamiz_tpu.ops import window as window_ops
 from kmamiz_tpu.ops.sortutil import (
     EDGE_KEY_MAX_DIST,
@@ -311,6 +312,38 @@ class EndpointGraph:
         # snapshot happens under this reentrant lock. Device kernels run
         # OUTSIDE the lock on immutable jnp snapshots.
         self._lock = threading.RLock()
+        _track_store_arenas(self)
+
+    def arena_bytes(self) -> Dict[str, int]:
+        """Tracked device-allocation sizes per arena, for the telemetry
+        HBM gauges. Reads `.nbytes` off array handles only (shape
+        metadata — no device sync, runs at scrape time anyway)."""
+
+        def nb(arr) -> int:
+            try:
+                return int(arr.nbytes)
+            except Exception:
+                return 0
+
+        with self._lock:
+            edges = nb(self._src) + nb(self._dst) + nb(self._dist)
+            staged = sum(
+                nb(a)
+                for entry in self._staged
+                for a in entry
+                if hasattr(a, "nbytes")
+            )
+            if self._preunion is not None:
+                staged += sum(nb(a) for a in self._preunion)
+            tables = 0
+            if self._ep_tables_dev is not None:
+                snap = self._ep_tables_dev
+                tbls = snap[1] if isinstance(snap, tuple) else snap
+                try:
+                    tables = sum(nb(a) for a in tbls if hasattr(a, "nbytes"))
+                except TypeError:
+                    tables = 0
+        return {"edges": edges, "staged": staged, "scorer_tables": tables}
 
     # -- capacity management -------------------------------------------------
 
@@ -1326,6 +1359,10 @@ class EndpointGraph:
         device count) changes mesh_fp — so the sharded path consults the
         same key and can never serve a single-device entry or vice versa.
         """
+        with phase_span("scorers"):
+            return self._scored_inner(kind, label_of, now_ms)
+
+    def _scored_inner(self, kind: str, label_of, now_ms):
         snap = self._scorer_snapshot(label_of, now_ms)
         cap = int(snap["src"].shape[0])
         mesh = self._deploy_mesh(cap) if kind == "svc" else None
@@ -1623,3 +1660,50 @@ class EndpointGraph:
                 )
                 out[ep_svc[live]] = True
             return out
+
+
+# ---------------------------------------------------------------------------
+# telemetry: HBM/arena residency gauges
+# ---------------------------------------------------------------------------
+
+_ARENA_STORES = []  # weakrefs of live EndpointGraph instances
+_ARENA_LOCK = threading.Lock()
+_ARENA_REGISTERED = False
+
+
+def _track_store_arenas(store: "EndpointGraph") -> None:
+    """Register `store` with the telemetry arena gauges. All live stores
+    sum into one kmamiz_arena_bytes{arena=graph.*} reading at scrape
+    time — the hot merge path never reports anything."""
+    import weakref
+
+    from kmamiz_tpu.telemetry import device as _tel_device
+
+    global _ARENA_REGISTERED
+    with _ARENA_LOCK:
+        _ARENA_STORES.append(weakref.ref(store))
+        if _ARENA_REGISTERED:
+            return
+        _ARENA_REGISTERED = True
+
+    def _sum(key: str):
+        def read() -> int:
+            total = 0
+            with _ARENA_LOCK:
+                refs = list(_ARENA_STORES)
+            live = []
+            for r in refs:
+                s = r()
+                if s is None:
+                    continue
+                live.append(r)
+                total += s.arena_bytes().get(key, 0)
+            if len(live) != len(refs):
+                with _ARENA_LOCK:
+                    _ARENA_STORES[:] = [r for r in _ARENA_STORES if r() is not None]
+            return total
+
+        return read
+
+    for key in ("edges", "staged", "scorer_tables"):
+        _tel_device.track_arena(f"graph.{key}", _sum(key))

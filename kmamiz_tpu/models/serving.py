@@ -28,8 +28,14 @@ import numpy as np
 from kmamiz_tpu.core import programs
 from kmamiz_tpu.core.profiling import step_timer
 from kmamiz_tpu.core.spans import _pad_size
+from kmamiz_tpu.telemetry.registry import REGISTRY
 
 _lock = threading.Lock()
+# preallocated serving counter: the forward increments by handle, never
+# by name lookup (graftscope hot-path discipline, docs/OBSERVABILITY.md)
+_SERVES = REGISTRY.counter(
+    "kmamiz_model_serves_total", "Forecast forward calls served"
+)
 _stats = {
     "calls": 0,
     "programs": 0,  # distinct (model, bucket) programs entered
@@ -108,6 +114,7 @@ def forecast_forward(
         lat_ms = jax.device_get(lat_ms)[:n]
         prob = jax.device_get(prob)[:n]  # graftlint: disable=host-sync-in-hot-path -- same fetch as the line above
     elapsed_ms = (time.perf_counter() - t0) * 1000
+    _SERVES.inc()
     with _lock:
         _stats["calls"] += 1
         _stats["last_ms"] = elapsed_ms

@@ -1,6 +1,6 @@
-"""Process-wide resilience counters.
+"""Process-wide resilience counters, backed by the telemetry registry.
 
-One small registry instead of counters scattered across modules: the
+One source of truth instead of counters scattered across modules: the
 ingest ring's backpressure drops (processor._put), the operator's
 external-DP fallback activations, watchdog trips + last-good serving
 metadata, per-job scheduler failure streaks, and quarantine/WAL totals
@@ -8,10 +8,17 @@ all land here and surface together as the `resilience` section of
 GET /health/timings (api/handlers/health.py) and the DP server's
 /timings.
 
-Everything is guarded by one module lock — these are cold counters
-(a few increments per tick at most), so contention is irrelevant and
-the graftlint `unguarded-shared-state` rule (which covers this package)
-stays satisfied by construction.
+Since PR 6 the flat counters are registry handles
+(kmamiz_tpu/telemetry/registry.py): `incr("ingestDropped")` bumps the
+same Counter object `GET /metrics` renders as
+`kmamiz_ingest_dropped_total`, so the Prometheus view, /health, and
+/timings can never disagree — they read the identical cell. Known names
+get module-scope handles (the hot ingest path never formats a label);
+unknown names (retry.*, quarantined.*) register once on first use.
+
+Job streaks and watchdog trip metadata stay structured dicts (they
+carry strings/timestamps), mirrored into gauges at scrape time via a
+registry callback.
 """
 from __future__ import annotations
 
@@ -19,10 +26,29 @@ import threading
 import time
 from typing import Dict, Optional
 
+from kmamiz_tpu.telemetry import slo as _slo
+from kmamiz_tpu.telemetry.registry import REGISTRY
+
 _LOCK = threading.Lock()
 
-#: flat named counters: ingestDropped, dpFallback, walRecords, ...
-_COUNTERS: Dict[str, int] = {}
+#: generic flat counters ride one labeled family...
+_FAM = REGISTRY.counter_family(
+    "kmamiz_resilience_total", "Flat resilience counters", ("counter",)
+)
+#: ...except the SLO-scorecard counters, which alias the scorecard's own
+#: handles so rate numerators match /metrics exactly
+_HANDLES: Dict[str, object] = {
+    "ingestDropped": _slo.INGEST_DROPPED,
+    "quarantined": _slo.QUARANTINED,
+    "dpFallback": _FAM.handle("dpFallback"),
+    "walRecords": _FAM.handle("walRecords"),
+    "walAppendErrors": _FAM.handle("walAppendErrors"),
+    "walReplays": _FAM.handle("walReplays"),
+}
+
+_WATCHDOG_TRIPS = REGISTRY.counter(
+    "kmamiz_watchdog_trips_total", "Tick watchdog trips"
+)
 
 #: per-scheduler-job failure tracking: name -> {consecutiveFailures,
 #: totalFailures, lastError, lastFailureAt}
@@ -37,20 +63,32 @@ _WATCHDOG: Dict[str, object] = {
     "lastGoodVersion": None,
     "lastGoodLabelEpoch": None,
     "lastGoodAt": None,
-    "staleServes": 0,
 }
+
+
+def _handle(name: str):
+    h = _HANDLES.get(name)
+    if h is None:
+        with _LOCK:
+            h = _HANDLES.get(name)
+            if h is None:
+                # cold first-use registration (retry.*, quarantined.*);
+                # cached, so steady state is a dict hit
+                h = _FAM.handle(name)  # graftlint: disable=hot-path-metric-label -- first-use registration, cached in _HANDLES thereafter
+                _HANDLES[name] = h
+    return h
 
 
 def incr(name: str, by: int = 1) -> int:
     """Bump a named counter; returns the new value."""
-    with _LOCK:
-        _COUNTERS[name] = _COUNTERS.get(name, 0) + by
-        return _COUNTERS[name]
+    h = _handle(name)
+    h.inc(by)
+    return int(h.value)
 
 
 def get(name: str) -> int:
-    with _LOCK:
-        return _COUNTERS.get(name, 0)
+    h = _HANDLES.get(name)
+    return int(h.value) if h is not None else 0
 
 
 def job_failed(name: str, err: BaseException, now_ms: Optional[float] = None) -> None:
@@ -83,12 +121,28 @@ def job_succeeded(name: str) -> None:
             entry["consecutiveFailures"] = 0
 
 
+def reset_job_streaks(names=None) -> None:
+    """Drop per-job failure state for `names` (or all jobs). Called by
+    Scheduler.start() so a scheduler (re)start begins every registered
+    job from a clean slate — a streak accumulated by a previous
+    scheduler instance (in-process restart, handover, tests) must not
+    leak into the new instance's /health as if the new jobs were
+    failing."""
+    with _LOCK:
+        if names is None:
+            _JOBS.clear()
+        else:
+            for n in names:
+                _JOBS.pop(n, None)
+
+
 def job_states() -> Dict[str, dict]:
     with _LOCK:
         return {name: dict(entry) for name, entry in _JOBS.items()}
 
 
 def watchdog_tripped(reason: str, now_ms: Optional[float] = None) -> None:
+    _WATCHDOG_TRIPS.inc()
     with _LOCK:
         _WATCHDOG["trips"] = int(_WATCHDOG["trips"]) + 1
         by = _WATCHDOG["byReason"]
@@ -113,8 +167,8 @@ def note_last_good(
 
 
 def note_stale_serve() -> None:
-    with _LOCK:
-        _WATCHDOG["staleServes"] = int(_WATCHDOG["staleServes"]) + 1
+    # same handle the SLO scorecard's stale-serve rate reads
+    _slo.STALE_SERVES.inc()
 
 
 def watchdog_state(now_ms: Optional[float] = None) -> dict:
@@ -127,7 +181,7 @@ def watchdog_state(now_ms: Optional[float] = None) -> dict:
             "lastGoodVersion": _WATCHDOG["lastGoodVersion"],
             "lastGoodLabelEpoch": _WATCHDOG["lastGoodLabelEpoch"],
             "lastGoodAt": _WATCHDOG["lastGoodAt"],
-            "staleServes": _WATCHDOG["staleServes"],
+            "staleServes": int(_slo.STALE_SERVES.value),
         }
     if out["lastGoodAt"] is not None:
         now = now_ms if now_ms is not None else time.time() * 1000
@@ -143,7 +197,9 @@ def resilience_summary() -> dict:
     from kmamiz_tpu.resilience.quarantine import quarantine_stats
 
     with _LOCK:
-        counters = dict(_COUNTERS)
+        counters = {
+            name: int(h.value) for name, h in _HANDLES.items() if h.value
+        }
     return {
         "breakers": breaker_states(),
         "quarantine": quarantine_stats(),
@@ -155,10 +211,28 @@ def resilience_summary() -> dict:
     }
 
 
+def _scrape_jobs() -> None:
+    """Scrape-time mirror of the job streak dicts into gauges."""
+    for name, entry in job_states().items():
+        _JOB_STREAK.handle(name).set(entry["consecutiveFailures"])
+        _JOB_FAILS.handle(name).set(entry["totalFailures"])
+
+
+_JOB_STREAK = REGISTRY.gauge_family(
+    "kmamiz_job_consecutive_failures", "Scheduler job failure streak", ("job",)
+)
+_JOB_FAILS = REGISTRY.gauge_family(
+    "kmamiz_job_failures_total", "Scheduler job total failures", ("job",)
+)
+REGISTRY.register_callback(_scrape_jobs)
+
+
 def reset_for_tests() -> None:
-    """Zero every registry (test isolation only)."""
+    """Zero every registry (test isolation only). Delegates the counter
+    cells to the telemetry registry's reset so both views restart from
+    the same zeros."""
+    REGISTRY.reset_for_tests()
     with _LOCK:
-        _COUNTERS.clear()
         _JOBS.clear()
         _WATCHDOG.update(
             {
@@ -169,6 +243,5 @@ def reset_for_tests() -> None:
                 "lastGoodVersion": None,
                 "lastGoodLabelEpoch": None,
                 "lastGoodAt": None,
-                "staleServes": 0,
             }
         )

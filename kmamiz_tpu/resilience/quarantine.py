@@ -154,6 +154,7 @@ class Quarantine:
             self._by_reason[reason] = self._by_reason.get(reason, 0) + 1
             self._total += 1
         metrics.incr("quarantined")
+        # graftlint: disable=hot-path-metric-label -- diversion path, not the clean tick: it already writes files and logs; the per-reason counter is the /timings contract
         metrics.incr(f"quarantined.{reason}")
         try:
             self._dir.mkdir(parents=True, exist_ok=True)

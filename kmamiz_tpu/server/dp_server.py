@@ -33,6 +33,8 @@ from kmamiz_tpu.resilience.watchdog import (
     TickWatchdog,
 )
 from kmamiz_tpu.server.processor import DataProcessor
+from kmamiz_tpu.telemetry import REGISTRY as TEL_REGISTRY
+from kmamiz_tpu.telemetry import TRACER
 
 logger = logging.getLogger("kmamiz_tpu.dp_server")
 
@@ -165,7 +167,8 @@ def make_handler(processor: DataProcessor):
             )
 
         def do_GET(self) -> None:  # health check (main.rs:28-31)
-            if self.path.split("?", 1)[0].rstrip("/") == "/timings":
+            path = self.path.split("?", 1)[0].rstrip("/")
+            if path == "/timings":
                 from kmamiz_tpu.core.profiling import step_timer
 
                 self._send_json(
@@ -176,6 +179,24 @@ def make_handler(processor: DataProcessor):
                         "resilience": res_metrics.resilience_summary(),
                     },
                 )
+                return
+            if path == "/metrics":
+                # Prometheus text exposition of the unified registry —
+                # the same cells /timings reads (docs/OBSERVABILITY.md)
+                body = TEL_REGISTRY.render().encode("utf-8")
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+                )
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
+            if path == "/debug/traces":
+                # the tick-span ring as Zipkin v2 trace groups; POSTing
+                # this body back to /ingest builds the pipeline's own
+                # dependency graph (self-trace)
+                self._send_json(200, TRACER.export_zipkin())
                 return
             warm = programs.warm_state()
             if (
@@ -206,7 +227,25 @@ def make_handler(processor: DataProcessor):
                 self._send_json(400, {"error": f"bad request: {e}"})
                 return
 
-            if self.path.split("?", 1)[0].rstrip("/") == "/ingest":
+            post_path = self.path.split("?", 1)[0].rstrip("/")
+            if post_path == "/debug/profile":
+                # on-demand jax.profiler capture: {"durationMs": N,
+                # "dir": optional} -> blocks for the window, answers
+                # with the capture directory (one at a time)
+                from kmamiz_tpu.telemetry import device as tel_device
+
+                try:
+                    req = json.loads(raw) if raw else {}
+                except ValueError as e:
+                    self._send_json(400, {"error": f"bad request: {e}"})
+                    return
+                out = tel_device.capture_profile(
+                    req.get("durationMs", 100), req.get("dir")
+                )
+                self._send_json(200 if out.get("ok") else 409, out)
+                return
+
+            if post_path == "/ingest":
                 # uncapped raw ingest: body IS the Zipkin response bytes.
                 # Large bodies split on trace-group boundaries and flow
                 # through the pipelined path so the native parse of chunk
@@ -226,26 +265,27 @@ def make_handler(processor: DataProcessor):
                     # gate on the DECOMPRESSED size (gzip bodies shrink
                     # ~15x on the wire, exactly the payloads that want
                     # the pipelined path)
-                    if len(raw) >= threshold:
-                        from kmamiz_tpu import native as native_mod
-                        from kmamiz_tpu.server.processor import (
-                            DEFAULT_STREAM_CHUNKS,
-                        )
-
-                        try:
-                            n_chunks = int(
-                                os.environ.get(
-                                    "KMAMIZ_INGEST_STREAM_CHUNKS",
-                                    DEFAULT_STREAM_CHUNKS,
-                                )
+                    with TRACER.tick(root_name="dp-ingest"):
+                        if len(raw) >= threshold:
+                            from kmamiz_tpu import native as native_mod
+                            from kmamiz_tpu.server.processor import (
+                                DEFAULT_STREAM_CHUNKS,
                             )
-                        except ValueError:
-                            n_chunks = DEFAULT_STREAM_CHUNKS
-                        chunks = native_mod.split_groups(raw, n_chunks)
-                        if chunks is not None and len(chunks) > 1:
-                            summary = processor.ingest_raw_stream(chunks)
-                    if summary is None:
-                        summary = processor.ingest_raw_window(raw)
+
+                            try:
+                                n_chunks = int(
+                                    os.environ.get(
+                                        "KMAMIZ_INGEST_STREAM_CHUNKS",
+                                        DEFAULT_STREAM_CHUNKS,
+                                    )
+                                )
+                            except ValueError:
+                                n_chunks = DEFAULT_STREAM_CHUNKS
+                            chunks = native_mod.split_groups(raw, n_chunks)
+                            if chunks is not None and len(chunks) > 1:
+                                summary = processor.ingest_raw_stream(chunks)
+                        if summary is None:
+                            summary = processor.ingest_raw_window(raw)
                 except ValueError as e:
                     self._send_json(400, {"error": str(e)})
                     return
@@ -305,6 +345,7 @@ def make_handler(processor: DataProcessor):
             # version-keyed encode memo: a retried uniqueId against an
             # unchanged graph re-sends the cached bytes instead of
             # re-encoding the full dependency payload per thread
+            t_enc = time.perf_counter()
             self._send_json(
                 200,
                 response,
@@ -313,6 +354,12 @@ def make_handler(processor: DataProcessor):
                     processor.graph.version,
                     processor.graph.label_epoch,
                 ),
+            )
+            # the encode happens after the tick's trace closed (and the
+            # tick itself may have run on a watchdog worker thread), so
+            # it attaches to the finished trace as a post-hoc span
+            TRACER.annotate_last(
+                "encode-serve", (time.perf_counter() - t_enc) * 1000
             )
 
     return Handler

@@ -45,6 +45,8 @@ from kmamiz_tpu.domain.traces import Traces
 from kmamiz_tpu.resilience import metrics as res_metrics
 from kmamiz_tpu.resilience import quarantine as res_quarantine
 from kmamiz_tpu.resilience.wal import IngestWAL
+from kmamiz_tpu.telemetry import slo as tel_slo
+from kmamiz_tpu.telemetry.tracing import TRACER, phase_span
 
 # default pipeline width for chunked big-window ingest (DP-server body
 # splits, paginated Zipkin backfills): enough chunks that the native
@@ -253,21 +255,30 @@ class DataProcessor:
 
         Each phase is step-timed (GET /timings on the DP server) and the
         device work can be captured with jax.profiler by setting
-        KMAMIZ_PROFILE_DIR (SURVEY.md §5 tracing/profiling parity)."""
+        KMAMIZ_PROFILE_DIR (SURVEY.md §5 tracing/profiling parity). With
+        telemetry on, the tick records a span trace of its phases (ring
+        exported at GET /debug/traces); span boundaries sit on fences the
+        tick already has, so tracing adds no host syncs."""
+        with TRACER.tick():  # no-op when dp_server already opened the trace
+            return self._collect_traced(request)
+
+    def _collect_traced(self, request: dict) -> dict:
         t_start = self._now_ms()  # domain time: dedup stamps, req default
         wall_t0 = time.perf_counter()
+        tel_slo.TICKS.inc()
         look_back = request.get("lookBack", 30_000)
         req_time = request.get("time", int(t_start))
         existing_dep = request.get("existingDep")
 
-        with step_timer.phase("fetch_traces"):
+        with step_timer.phase("fetch_traces"), phase_span("parse"):
             trace_groups = self._trace_source(look_back, req_time, ZIPKIN_LIMIT)
             trace_groups = self._filter_traces(trace_groups, t_start)
         if trace_groups and self._wal is not None:
             # WAL the tick's kept (post-dedup) groups as raw Zipkin JSON
             # before any graph mutation; replay re-ingests them through
             # ingest_raw_window, which merges the same edges
-            self._wal_append(json.dumps(trace_groups).encode("utf-8"))
+            with phase_span("wal-append"):
+                self._wal_append(json.dumps(trace_groups).encode("utf-8"))
 
         traces = Traces(trace_groups)
         namespaces = {
@@ -298,7 +309,9 @@ class DataProcessor:
         # dispatch the device stats FIRST: the kernel runs and its packed
         # result streams back (copy_to_host_async) while the host walks
         # dependencies and merges bodies, hiding the tunnel round trip
-        with step_timer.phase("combine_window"), profiling.trace("combine"):
+        with step_timer.phase("combine_window"), profiling.trace(
+            "combine"
+        ), phase_span("pack"):
             realtime = traces.combine_logs_to_realtime_data(
                 structured_logs, replicas
             )
@@ -307,7 +320,7 @@ class DataProcessor:
             if self._use_device_stats and trace_groups and records:
                 stats_job = DeviceStatsJob(records)
 
-        with step_timer.phase("dependencies"):
+        with step_timer.phase("dependencies"), phase_span("walk"):
             dependencies = traces.to_endpoint_dependencies()
             # the raw pre-filter window edges; combine_with returns a new
             # instance without them, so capture before combining
@@ -319,7 +332,9 @@ class DataProcessor:
 
         # feed the persistent device graph (serves the scorer/API path)
         if trace_groups:
-            with step_timer.phase("graph_merge"), profiling.trace("graph_merge"):
+            with step_timer.phase("graph_merge"), profiling.trace(
+                "graph_merge"
+            ), phase_span("merge"):
                 batch = spans_to_batch(
                     trace_groups, interner=self.graph.interner
                 )
@@ -345,6 +360,7 @@ class DataProcessor:
             ]
 
         elapsed = (time.perf_counter() - wall_t0) * 1000
+        tel_slo.SCORECARD.observe_tick(elapsed)
         return {
             "uniqueId": request.get("uniqueId", ""),
             "combined": combined.to_json(),
@@ -866,13 +882,17 @@ class DataProcessor:
 
         t_start = self._now_ms()  # domain time for the dedup registration
         wall_t0 = time.perf_counter()
+        tel_slo.INGEST_PAYLOADS.inc()
         quarantine_on = res_quarantine.enabled()
         if quarantine_on and len(raw) > res_quarantine.max_payload_bytes():
             # size gate BEFORE the parse: a trace bomb never reaches the
             # native scanner, the interner, or the device
-            res_quarantine.default_quarantine().put(
-                raw, res_quarantine.REASON_TRACE_BOMB, source="ingest_raw_window"
-            )
+            with phase_span("quarantine"):
+                res_quarantine.default_quarantine().put(
+                    raw,
+                    res_quarantine.REASON_TRACE_BOMB,
+                    source="ingest_raw_window",
+                )
             return self._quarantined_summary(
                 res_quarantine.REASON_TRACE_BOMB, wall_t0
             )
@@ -880,7 +900,7 @@ class DataProcessor:
             skipset = self._skipset_locked()
             skip_blob = None if skipset is not None else self._skip_blob_locked()
             session = self._raw_session_locked()
-        with step_timer.phase("raw_ingest_parse"):
+        with step_timer.phase("raw_ingest_parse"), phase_span("parse"):
             out = raw_spans_to_batch(
                 raw,
                 interner=self.graph.interner,
@@ -893,10 +913,12 @@ class DataProcessor:
                 raise ValueError(
                     "native span loader unavailable or malformed payload"
                 )
-            reason = self._divert_poison(raw, "ingest_raw_window")
+            with phase_span("quarantine"):
+                reason = self._divert_poison(raw, "ingest_raw_window")
             return self._quarantined_summary(reason, wall_t0)
         batch, kept = out
-        self._wal_append(raw)
+        with phase_span("wal-append"):
+            self._wal_append(raw)
         # dedup state during the (long) parse: the blob path snapshots
         # before parsing (a trace a concurrent collect() processes in
         # between merges twice — benign for the set-union edge store);
@@ -907,7 +929,7 @@ class DataProcessor:
         if batch.n_spans:
             with step_timer.phase("raw_ingest_graph"), profiling.trace(
                 "raw_ingest_graph"
-            ):
+            ), phase_span("merge"):
                 self.graph.merge_window(batch)
         return {
             "spans": batch.n_spans,
@@ -1058,6 +1080,7 @@ class DataProcessor:
                         raw = next(it)
                     except StopIteration:
                         break
+                    tel_slo.INGEST_PAYLOADS.inc()
                     if quarantine_on and len(raw) > size_cap:
                         res_quarantine.default_quarantine().put(
                             raw,
@@ -1139,7 +1162,7 @@ class DataProcessor:
                 if batch.n_spans:
                     with step_timer.phase("raw_ingest_graph"), profiling.trace(
                         "raw_ingest_graph"
-                    ):
+                    ), phase_span("merge"):
                         # stage: walk-only dispatch per chunk, ONE union
                         # sort over all chunks at the drain below
                         chunk_transfer_ms = self.graph.merge_window(
@@ -1166,9 +1189,12 @@ class DataProcessor:
             raise pending_err
 
         # the deferred merge chain resolves here: n_edges blocks on the
-        # device queue, so charge it explicitly as the pipeline's drain
+        # device queue, so charge it explicitly as the pipeline's drain —
+        # also the stream's one pre-existing device fence, so the
+        # host-transfer span boundary costs no extra sync
         t0 = time.perf_counter()
-        n_edges = int(self.graph.n_edges)
+        with phase_span("host-transfer"):
+            n_edges = int(self.graph.n_edges)
         drain_ms = (time.perf_counter() - t0) * 1000.0
         wall_ms = (time.perf_counter() - wall_t0) * 1000
         return {
@@ -1236,7 +1262,11 @@ class DataProcessor:
             schema.body_pairs_for_groups([rows for _key, rows in group_items])
         )
 
-        stats = stats_job.result()
+        # the one device->host fence the tick already pays: the packed
+        # stats drain (copy_to_host_async started at dispatch) — the span
+        # boundary rides it, adding no sync of its own
+        with phase_span("host-transfer"):
+            stats = stats_job.result()
         out: List[dict] = []
         for i, ((uen, status), rows) in enumerate(group_items):
             # both sides key segments by the RAW status value (spans without

@@ -168,6 +168,11 @@ class Scheduler:
             self._jobs[name].start()
 
     def start(self) -> None:
+        # a (re)started scheduler begins every registered job with a
+        # clean failure streak: a streak left by a previous instance
+        # (handover, in-process restart) would otherwise report the NEW
+        # jobs as failing in /health before they ever fired
+        res_metrics.reset_job_streaks(list(self._jobs))
         self._started = True
         for job in self._jobs.values():
             job.start()

@@ -1,0 +1,30 @@
+"""graftscope: the self-tracing telemetry layer (docs/OBSERVABILITY.md).
+
+Four parts behind one package:
+
+- `registry`  — unified metrics registry (counters / gauges /
+  fixed-bucket histograms, preallocated handles, Prometheus text
+  exposition at `GET /metrics`).
+- `tracing`   — per-tick span traces in a ring, exported as Zipkin v2
+  JSON at `GET /debug/traces`; the processor can re-ingest its own
+  export (self-trace).
+- `device`    — HBM/arena residency gauges and the on-demand
+  `POST /debug/profile` jax.profiler capture.
+- `slo`       — the rolling SLO scorecard bench.py emits as headline
+  keys and `tools/slo_report.py` gates on.
+
+`KMAMIZ_TELEMETRY=0` disables span capture; the metrics registry stays
+live regardless (the resilience counters and `/timings` ride on it).
+"""
+from .registry import REGISTRY, MetricsRegistry  # noqa: F401
+from .tracing import TRACER, phase_span, telemetry_enabled  # noqa: F401
+from .slo import SCORECARD  # noqa: F401
+from . import device  # noqa: F401  (registers its scrape callback)
+
+
+def reset_for_tests() -> None:
+    """Zero all metric values (keeping registered handles live), drop
+    buffered traces, and clear the scorecard window."""
+    REGISTRY.reset_for_tests()
+    TRACER.reset_for_tests()
+    SCORECARD.reset_for_tests()

@@ -1,0 +1,119 @@
+"""Device telemetry: HBM residency gauges and on-demand profiler capture.
+
+Two sources, merged at scrape time (never on the tick):
+
+- `memory_stats()` from the first addressable device, where the backend
+  supports it (TPU does; CPU returns None) — bytes_in_use / peak /
+  limit as `kmamiz_device_*` gauges.
+- Tracked arena sizes: device-resident subsystems (graph-store edge
+  arena, endpoint metadata, staged streaming buffers, scorer caches)
+  report their allocation sizes via `track_arena`, exported per-arena
+  as `kmamiz_arena_bytes{arena=...}`. This is the fallback accounting
+  when `memory_stats()` is unavailable, and the per-subsystem breakdown
+  when it is.
+
+Profiling: `capture_profile(duration_ms)` wraps `jax.profiler`
+start/stop for `POST /debug/profile` — one capture at a time, written
+under `KMAMIZ_PROFILE_DIR` (or an explicit directory).
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from .registry import REGISTRY
+
+_ARENA_BYTES = REGISTRY.gauge_family(
+    "kmamiz_arena_bytes",
+    "Tracked device-resident allocation bytes per arena",
+    ("arena",),
+)
+_DEV_IN_USE = REGISTRY.gauge(
+    "kmamiz_device_bytes_in_use", "Device bytes in use (memory_stats)"
+)
+_DEV_PEAK = REGISTRY.gauge(
+    "kmamiz_device_bytes_peak", "Peak device bytes in use (memory_stats)"
+)
+_DEV_LIMIT = REGISTRY.gauge(
+    "kmamiz_device_bytes_limit", "Device memory limit (memory_stats)"
+)
+
+_arena_sources: Dict[str, Callable[[], float]] = {}
+_arena_handles: Dict[str, object] = {}
+_arena_lock = threading.Lock()
+
+
+def track_arena(name: str, size_fn: Callable[[], float]) -> None:
+    """Register a pull source for one arena's byte size. Called at init
+    scope by the owning subsystem; `size_fn` runs only at scrape time."""
+    with _arena_lock:
+        _arena_sources[name] = size_fn
+        if name not in _arena_handles:
+            _arena_handles[name] = _ARENA_BYTES.handle(name)
+
+
+def device_memory_stats() -> Optional[dict]:
+    try:
+        import jax
+
+        devs = jax.local_devices()
+        if not devs:
+            return None
+        return devs[0].memory_stats()
+    except Exception:
+        return None
+
+
+def _collect() -> None:
+    with _arena_lock:
+        items = list(_arena_sources.items())
+    for name, fn in items:
+        try:
+            _arena_handles[name].set(float(fn()))
+        except Exception:
+            pass
+    stats = device_memory_stats()
+    if stats:
+        _DEV_IN_USE.set(float(stats.get("bytes_in_use", 0) or 0))
+        _DEV_PEAK.set(float(stats.get("peak_bytes_in_use", 0) or 0))
+        _DEV_LIMIT.set(float(stats.get("bytes_limit", 0) or 0))
+
+
+REGISTRY.register_callback(_collect)
+
+
+# -- on-demand profiler capture (POST /debug/profile) --------------------
+
+_profile_lock = threading.Lock()
+_PROFILES = REGISTRY.counter(
+    "kmamiz_profile_captures_total", "On-demand jax.profiler captures"
+)
+
+
+def capture_profile(duration_ms: int, out_dir: Optional[str] = None) -> dict:
+    """Capture a jax.profiler trace for `duration_ms` to `out_dir`
+    (default `KMAMIZ_PROFILE_DIR`, else ./kmamiz-data/profiles). Blocks
+    the caller for the capture window; one capture at a time."""
+    target = out_dir or os.environ.get("KMAMIZ_PROFILE_DIR") or os.path.join(
+        "kmamiz-data", "profiles"
+    )
+    duration_ms = max(1, min(int(duration_ms), 60_000))
+    if not _profile_lock.acquire(blocking=False):
+        return {"ok": False, "error": "capture already in progress"}
+    try:
+        os.makedirs(target, exist_ok=True)
+        import jax
+
+        jax.profiler.start_trace(target)
+        try:
+            time.sleep(duration_ms / 1000.0)
+        finally:
+            jax.profiler.stop_trace()
+        _PROFILES.inc()
+        return {"ok": True, "dir": target, "duration_ms": duration_ms}
+    except Exception as exc:  # profiler unavailable on some backends
+        return {"ok": False, "error": f"{type(exc).__name__}: {exc}"}
+    finally:
+        _profile_lock.release()

@@ -1,0 +1,103 @@
+"""SLO scorecard: the handful of numbers that say whether serving is OK.
+
+Rolling tick-latency percentiles (p50/p95/p99 over the last
+`KMAMIZ_SLO_WINDOW` ticks) plus rates derived from registry counters:
+stale-serve rate, ingest-drop rate, quarantine rate, and the process
+recompile count from the program registry. `bench.py` emits the
+scorecard as headline keys; `tools/slo_report.py --check` gates
+regressions against the last recorded BENCH_r*.json.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from collections import deque
+from typing import Dict, List
+
+from .registry import REGISTRY
+
+# scorecard counters: single source of truth shared with the resilience
+# summary (resilience/metrics.py increments these same handles)
+TICKS = REGISTRY.counter("kmamiz_ticks_total", "Collect ticks attempted")
+STALE_SERVES = REGISTRY.counter(
+    "kmamiz_stale_serves_total", "Ticks answered from the last-good graph"
+)
+INGEST_PAYLOADS = REGISTRY.counter(
+    "kmamiz_ingest_payloads_total", "Raw ingest payloads accepted for parse"
+)
+INGEST_DROPPED = REGISTRY.counter(
+    "kmamiz_ingest_dropped_total", "Ingest chunks dropped under backpressure"
+)
+QUARANTINED = REGISTRY.counter(
+    "kmamiz_quarantined_total", "Payloads diverted to the quarantine"
+)
+
+
+def _window() -> int:
+    try:
+        return max(8, int(os.environ.get("KMAMIZ_SLO_WINDOW", "512")))
+    except ValueError:
+        return 512
+
+
+def percentile(sorted_vals: List[float], q: float) -> float:
+    """Nearest-rank percentile over a pre-sorted sample."""
+    if not sorted_vals:
+        return 0.0
+    k = max(0, min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1)))))
+    return sorted_vals[k]
+
+
+class Scorecard:
+    """Rolling tick-latency window + counter-derived rates."""
+
+    def __init__(self) -> None:
+        self._ticks_ms: deque = deque(maxlen=_window())
+        self._lock = threading.Lock()
+
+    def observe_tick(self, ms: float) -> None:
+        with self._lock:
+            self._ticks_ms.append(float(ms))
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            vals = sorted(self._ticks_ms)
+        ticks = TICKS.value
+        payloads = INGEST_PAYLOADS.value
+        recompiles = 0.0
+        try:
+            from ..core import programs
+
+            recompiles = float(programs.summary().get("totalCompiles", 0))
+        except Exception:
+            pass
+        return {
+            "tick_p50_ms": round(percentile(vals, 0.50), 3),
+            "tick_p95_ms": round(percentile(vals, 0.95), 3),
+            "tick_p99_ms": round(percentile(vals, 0.99), 3),
+            "stale_serve_rate": round(STALE_SERVES.value / max(1.0, ticks), 6),
+            "ingest_drop_rate": round(
+                INGEST_DROPPED.value / max(1.0, payloads), 6
+            ),
+            "quarantine_rate": round(QUARANTINED.value / max(1.0, payloads), 6),
+            "recompile_count": recompiles,
+        }
+
+    def reset_for_tests(self) -> None:
+        with self._lock:
+            self._ticks_ms = deque(maxlen=_window())
+
+
+SCORECARD = Scorecard()
+
+# the keys bench.py promotes to headline level, and the direction in
+# which each regresses (for tools/slo_report.py --check)
+SLO_KEYS_HIGHER_IS_WORSE = (
+    "tick_p50_ms",
+    "tick_p95_ms",
+    "tick_p99_ms",
+    "stale_serve_rate",
+    "ingest_drop_rate",
+    "quarantine_rate",
+    "recompile_count",
+)

@@ -1,0 +1,258 @@
+"""Per-tick span tracing: the collect tick as a trace of its own phases.
+
+Each DP collect tick (and each raw-ingest window) opens a trace; the
+pipeline phases — parse / quarantine / WAL append / merge / pack /
+host→device transfer / walk / scorers / encode-serve — record spans into
+a preallocated builder. Device phases take their span boundaries at
+points the tick ALREADY synchronizes (`block_until_ready` fences that
+exist for correctness), so tracing adds zero host syncs and zero device
+round-trips; span timing is host `perf_counter_ns` only.
+
+Finished traces land in a ring (`KMAMIZ_TRACE_RING` traces, default
+256) and export as Zipkin v2 JSON trace groups at `GET /debug/traces` —
+in exactly the Istio-sidecar span shape the ingest path parses
+(`synth.make_raw_window`), so the processor can re-ingest its own
+export and build a dependency graph of its own pipeline (dogfooding:
+the self-trace round-trip test).
+
+Overhead: when disabled (`KMAMIZ_TELEMETRY=0`) `tick()`/`span()` yield
+immediately with no allocation. When enabled, a span is one list append
+of a 4-tuple; Zipkin formatting happens only at export time, never on
+the tick.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from typing import List, Optional, Tuple
+
+from .registry import REGISTRY
+
+# span taxonomy: canonical phase names (docs/OBSERVABILITY.md)
+PHASES = (
+    "parse",
+    "quarantine",
+    "wal-append",
+    "merge",
+    "pack",
+    "host-transfer",
+    "walk",
+    "scorers",
+    "encode-serve",
+)
+
+_SELFTRACE_NAMESPACE = "graftscope"
+_ROOT_SERVICE = "dp-tick"
+
+
+def _ring_size() -> int:
+    try:
+        return max(1, int(os.environ.get("KMAMIZ_TRACE_RING", "256")))
+    except ValueError:
+        return 256
+
+
+def telemetry_enabled() -> bool:
+    """KMAMIZ_TELEMETRY gate, default ON. Re-read per tick (not per
+    span) so tests and operators can flip it without a restart."""
+    return os.environ.get("KMAMIZ_TELEMETRY", "1") not in ("0", "false", "")
+
+
+class _TraceBuilder:
+    """One in-flight trace: spans as (name, start_ns, dur_ns, parent_idx).
+
+    Built once per tick; appends are the only hot-path operation.
+    """
+
+    __slots__ = ("trace_id", "wall_us", "t0_ns", "spans", "_stack", "status")
+
+    def __init__(self, trace_id: str, root_name: str) -> None:
+        self.trace_id = trace_id
+        self.wall_us = time.time_ns() // 1000
+        self.t0_ns = time.perf_counter_ns()
+        # span 0 is the root; dur filled at close
+        self.spans: List[Tuple[str, int, int, int]] = [(root_name, 0, -1, -1)]
+        self._stack = [0]
+        self.status = "200"
+
+    def open_span(self, name: str) -> int:
+        idx = len(self.spans)
+        self.spans.append(
+            (name, time.perf_counter_ns() - self.t0_ns, -1, self._stack[-1])
+        )
+        self._stack.append(idx)
+        return idx
+
+    def close_span(self, idx: int) -> None:
+        name, start, _, parent = self.spans[idx]
+        self.spans[idx] = (
+            name,
+            start,
+            time.perf_counter_ns() - self.t0_ns - start,
+            parent,
+        )
+        if self._stack and self._stack[-1] == idx:
+            self._stack.pop()
+
+    def close(self) -> None:
+        name, start, _, parent = self.spans[0]
+        self.spans[0] = (
+            name,
+            start,
+            time.perf_counter_ns() - self.t0_ns,
+            parent,
+        )
+
+
+class TickTracer:
+    """Ring of finished tick traces + the per-thread open builder."""
+
+    def __init__(self) -> None:
+        self._ring: deque = deque(maxlen=_ring_size())
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._tls = threading.local()
+
+    # -- hot path --------------------------------------------------------
+    def current(self) -> Optional[_TraceBuilder]:
+        return getattr(self._tls, "builder", None)
+
+    @contextmanager
+    def tick(self, root_name: str = _ROOT_SERVICE):
+        """Open a trace for one tick. No-op (yields None) when telemetry
+        is off or a trace is already open on this thread (re-entrancy:
+        ingest-inside-collect keeps one trace)."""
+        if not telemetry_enabled() or self.current() is not None:
+            yield None
+            return
+        with self._lock:
+            self._seq += 1
+            trace_id = f"graftscope-{self._seq}"
+        builder = _TraceBuilder(trace_id, root_name)
+        self._tls.builder = builder
+        try:
+            yield builder
+        finally:
+            self._tls.builder = None
+            builder.close()
+            with self._lock:
+                self._ring.append(builder)
+
+    @contextmanager
+    def span(self, name: str):
+        """Record one phase span on the current trace (no-op outside a
+        tick or with telemetry off)."""
+        builder = self.current()
+        if builder is None:
+            yield
+            return
+        idx = builder.open_span(name)
+        try:
+            yield
+        finally:
+            builder.close_span(idx)
+
+    def annotate_last(self, name: str, dur_ms: float) -> None:
+        """Append a post-tick span (e.g. encode-serve, which happens
+        after the tick's trace closed — possibly on a different thread
+        when the watchdog ran the tick on a worker) to the most recent
+        trace in the ring, parented on its root."""
+        if not telemetry_enabled():
+            return
+        with self._lock:
+            if not self._ring:
+                return
+            tb = self._ring[-1]
+            _rn, rstart, rdur, _rp = tb.spans[0]
+            start = rstart + (rdur if rdur >= 0 else 0)
+            tb.spans.append((name, start, max(0, int(dur_ms * 1e6)), 0))
+        h = SPAN_HANDLES.get(name)
+        if h is not None:
+            h.observe(dur_ms)
+
+    # -- export (cold path) ----------------------------------------------
+    def traces(self) -> List[_TraceBuilder]:
+        with self._lock:
+            return list(self._ring)
+
+    def export_zipkin(self) -> List[List[dict]]:
+        """Ring contents as Zipkin v2 JSON trace groups, in the
+        Istio-sidecar span shape the raw-ingest path parses — feeding
+        this back into `ingest_raw_window` yields the pipeline's own
+        dependency graph."""
+        groups = []
+        for tb in self.traces():
+            group = []
+            for i, (name, start_ns, dur_ns, parent) in enumerate(tb.spans):
+                svc = name.replace("_", "-").replace(".", "-")
+                ns = _SELFTRACE_NAMESPACE
+                url = f"http://{svc}.{ns}.svc.cluster.local/tick/{svc}"
+                group.append(
+                    {
+                        "traceId": tb.trace_id,
+                        "id": f"{tb.trace_id}-{i}",
+                        "parentId": f"{tb.trace_id}-{parent}" if parent >= 0 else None,
+                        "kind": "SERVER",
+                        "name": f"{svc}.{ns}.svc.cluster.local:80/*",
+                        "timestamp": tb.wall_us + start_ns // 1000,
+                        "duration": max(1, dur_ns // 1000),
+                        "localEndpoint": {"serviceName": svc},
+                        "tags": {
+                            "component": "proxy",
+                            "http.method": "POST",
+                            "http.protocol": "HTTP/1.1",
+                            "http.status_code": tb.status,
+                            "http.url": url,
+                            "istio.canonical_revision": "latest",
+                            "istio.canonical_service": svc,
+                            "istio.mesh_id": "cluster.local",
+                            "istio.namespace": ns,
+                            "response_flags": "-",
+                            "upstream_cluster": "inbound|9080||",
+                        },
+                    }
+                )
+            if group:
+                groups.append(group)
+        return groups
+
+    def reset_for_tests(self) -> None:
+        with self._lock:
+            self._ring = deque(maxlen=_ring_size())
+            self._seq = 0
+        self._tls = threading.local()
+
+
+# the process-wide tracer (mirrors REGISTRY's singleton pattern)
+TRACER = TickTracer()
+
+# span-latency histogram: one preallocated handle per canonical phase —
+# the tick looks handles up by identity, never by formatted label
+_SPAN_MS = REGISTRY.histogram_family(
+    "kmamiz_tick_span_ms",
+    "Per-phase span latency within one collect tick (ms)",
+    ("phase",),
+)
+SPAN_HANDLES = {p: _SPAN_MS.handle(p) for p in PHASES}
+
+
+@contextmanager
+def phase_span(name: str):
+    """Span + histogram observation for one canonical phase. The handle
+    dict is module-scope; unknown names trace but skip the histogram."""
+    builder = TRACER.current()
+    if builder is None:
+        yield
+        return
+    h = SPAN_HANDLES.get(name)
+    idx = builder.open_span(name)
+    try:
+        yield
+    finally:
+        builder.close_span(idx)
+        if h is not None:
+            _n, _s, dur_ns, _p = builder.spans[idx]
+            h.observe(dur_ns / 1e6)

@@ -11,3 +11,9 @@ def export_report(arr):
     host = jax.device_get(dev)  # cold path: fine
     dev.block_until_ready()  # cold path: fine
     return float(dev.sum()), host.item()  # cold path: fine
+
+
+def export_metrics(counters, reason):
+    h = counters.handle("exports")  # cold path: fine
+    counters.incr(f"exports.{reason}")  # cold path: fine
+    return h

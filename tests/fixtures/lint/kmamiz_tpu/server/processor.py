@@ -52,3 +52,27 @@ def tick_clean(batch):
     dev = jax.device_put(batch)
     n_meta = int(dev.shape[0])  # metadata read, not a device sync
     return shapes.prepare_clean(dev), n_meta
+
+
+# stands in for a handle preallocated at import time (the fixture is
+# parsed, never imported, so the value is irrelevant)
+DROP_HANDLE = None
+
+
+def tick_metrics(registry, counters, reason):
+    h = counters.handle("drops")  # EXPECT: hot-path-metric-label
+    fam = registry.counter_family("d", "help", ("r",))  # EXPECT: hot-path-metric-label
+    counters.incr(f"drops.{reason}")  # EXPECT: hot-path-metric-label
+    counters.incr("drops." + reason)  # EXPECT: hot-path-metric-label
+    counters.observe("lat_%s" % reason, 1.0)  # EXPECT: hot-path-metric-label
+    return h, fam
+
+
+def tick_metrics_suppressed(counters, reason):
+    counters.incr(f"drops.{reason}")  # graftlint: disable=hot-path-metric-label -- fixture: suppressed on purpose
+
+
+def tick_metrics_clean(counters):
+    DROP_HANDLE.inc()  # write through a preallocated handle: fine
+    counters.incr("drops")  # constant name: fine
+    counters.observe(12.5)  # plain value, no label: fine

@@ -27,6 +27,7 @@ ALL_RULES = {
     "dtype-drift",
     "donation-miss",
     "unguarded-shared-state",
+    "hot-path-metric-label",
 }
 
 
@@ -66,6 +67,7 @@ class TestFixtureCorpus:
         sup = {(f.path, f.rule) for f in corpus_result.suppressed}
         assert sup == {
             ("kmamiz_tpu/server/processor.py", "host-sync-in-hot-path"),
+            ("kmamiz_tpu/server/processor.py", "hot-path-metric-label"),
             ("kmamiz_tpu/server/state.py", "unguarded-shared-state"),
         }
 
@@ -109,7 +111,7 @@ class TestFrameworkMechanics:
     def test_render_text_counts(self, corpus_result):
         text = framework.render_text(corpus_result)
         assert f"{len(corpus_result.findings)} finding(s)" in text
-        assert "2 suppressed" in text
+        assert "3 suppressed" in text
 
     def test_all_rules_registered(self):
         assert set(framework.all_rules()) == ALL_RULES

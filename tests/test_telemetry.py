@@ -1,0 +1,359 @@
+"""graftscope telemetry (kmamiz_tpu/telemetry/): Prometheus exposition
+conformance, span-tree well-formedness, the self-trace round trip, SLO
+scorecard math, and the telemetry-on transfer-guard tick.
+
+The exposition tests parse render() output generically — every histogram
+in the registry must have monotonic cumulative buckets ending at +Inf ==
+_count, every sample name must be legal — so new instruments added later
+are covered without editing this file.
+"""
+import json
+import re
+
+import pytest
+
+from kmamiz_tpu.telemetry import REGISTRY, SCORECARD, TRACER
+from kmamiz_tpu.telemetry.registry import MetricsRegistry
+from kmamiz_tpu.telemetry.tracing import PHASES, phase_span
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>[^}]*)\})? (?P<value>\S+)$"
+)
+
+
+def _parse_exposition(text: str):
+    """(types, samples): types[name] = counter|gauge|histogram, samples =
+    [(name, labels-dict, value)]. Raises on any malformed line."""
+    types, samples = {}, []
+    for line in text.strip().splitlines():
+        if line.startswith("# HELP"):
+            continue
+        if line.startswith("# TYPE"):
+            _, _, name, kind = line.split(maxsplit=3)
+            assert _NAME_RE.match(name), name
+            assert kind in ("counter", "gauge", "histogram"), line
+            types[name] = kind
+            continue
+        m = _SAMPLE_RE.match(line)
+        assert m, f"malformed sample line: {line!r}"
+        labels = {}
+        if m.group("labels"):
+            for part in re.findall(r'(\w+)="((?:[^"\\]|\\.)*)"', m.group("labels")):
+                labels[part[0]] = part[1]
+        samples.append((m.group("name"), labels, float(m.group("value"))))
+    return types, samples
+
+
+class TestExpositionConformance:
+    def test_counter_gauge_histogram_render(self):
+        reg = MetricsRegistry()
+        c = reg.counter("t_requests_total", "requests")
+        g = reg.gauge("t_depth", "queue depth")
+        h = reg.histogram("t_latency_ms", "latency", buckets=(1, 5, 25))
+        c.inc()
+        c.inc(2)
+        g.set(7)
+        for v in (0.3, 3.0, 100.0):
+            h.observe(v)
+
+        types, samples = _parse_exposition(reg.render())
+        assert types == {
+            "t_requests_total": "counter",
+            "t_depth": "gauge",
+            "t_latency_ms": "histogram",
+        }
+        flat = {(n, tuple(sorted(l.items()))): v for n, l, v in samples}
+        assert flat[("t_requests_total", ())] == 3
+        assert flat[("t_depth", ())] == 7
+        assert flat[("t_latency_ms_sum", ())] == pytest.approx(103.3)
+        assert flat[("t_latency_ms_count", ())] == 3
+
+    def test_histogram_buckets_cumulative_monotonic_ending_at_count(self):
+        reg = MetricsRegistry()
+        fam = reg.histogram_family(
+            "t_span_ms", "spans", ("phase",), buckets=(0.5, 2, 10)
+        )
+        h = fam.handle("merge")
+        for v in (0.1, 0.6, 1.9, 50.0):
+            h.observe(v)
+        _, samples = _parse_exposition(reg.render())
+        buckets = [
+            (l["le"], v) for n, l, v in samples if n == "t_span_ms_bucket"
+        ]
+        count = next(v for n, l, v in samples if n == "t_span_ms_count")
+        assert [b for b, _ in buckets] == ["0.5", "2", "10", "+Inf"]
+        values = [v for _, v in buckets]
+        assert values == sorted(values), "buckets must be cumulative"
+        assert values[-1] == count == 4
+        assert values == [1, 3, 3, 4]
+
+    def test_global_registry_renders_conformant(self):
+        """The LIVE registry — every instrument the package registered at
+        import time — must render cleanly, with monotonic buckets."""
+        text = REGISTRY.render()
+        types, samples = _parse_exposition(text)
+        assert "kmamiz_ticks_total" in types
+        assert types["kmamiz_tick_span_ms"] == "histogram"
+        # per histogram child: cumulative monotonic, +Inf == _count
+        hist_names = [n for n, k in types.items() if k == "histogram"]
+        for name in hist_names:
+            by_child = {}
+            for n, labels, v in samples:
+                if n == f"{name}_bucket":
+                    key = tuple(sorted(
+                        (k, x) for k, x in labels.items() if k != "le"
+                    ))
+                    by_child.setdefault(key, []).append((labels["le"], v))
+            counts = {
+                tuple(sorted(labels.items())): v
+                for n, labels, v in samples
+                if n == f"{name}_count"
+            }
+            for key, buckets in by_child.items():
+                values = [v for _, v in buckets]
+                assert values == sorted(values), (name, key)
+                assert buckets[-1][0] == "+Inf"
+                assert values[-1] == counts[key], (name, key)
+
+    def test_schema_conflict_rejected(self):
+        reg = MetricsRegistry()
+        reg.counter("t_thing_total", "x")
+        with pytest.raises(ValueError, match="different schema"):
+            reg.gauge("t_thing_total", "x")
+
+    def test_reset_keeps_handles_live(self):
+        reg = MetricsRegistry()
+        c = reg.counter("t_total", "x")
+        c.inc(5)
+        reg.reset_for_tests()
+        assert c.value == 0
+        c.inc()  # the import-scope handle still feeds the same family
+        assert reg.get_value("t_total") == 1
+
+
+class TestSpanTree:
+    def test_nested_spans_form_a_tree(self, monkeypatch):
+        monkeypatch.setenv("KMAMIZ_TELEMETRY", "1")
+        with TRACER.tick():
+            with phase_span("parse"):
+                pass
+            with phase_span("merge"):
+                with phase_span("pack"):
+                    pass
+        tb = TRACER.traces()[-1]
+        names = [s[0] for s in tb.spans]
+        assert names == ["dp-tick", "parse", "merge", "pack"]
+        # root closed, every span closed, parents precede children
+        for i, (name, start, dur, parent) in enumerate(tb.spans):
+            assert dur >= 0, f"span {name} never closed"
+            assert parent < i
+            assert (parent == -1) == (i == 0)
+        # pack nests under merge, not under root
+        assert tb.spans[3][3] == 2
+        assert tb.spans[1][3] == tb.spans[2][3] == 0
+
+    def test_zipkin_export_shape(self, monkeypatch):
+        monkeypatch.setenv("KMAMIZ_TELEMETRY", "1")
+        with TRACER.tick():
+            with phase_span("parse"):
+                pass
+        groups = TRACER.export_zipkin()
+        assert groups, "ring should hold the finished trace"
+        group = groups[-1]
+        by_id = {s["id"]: s for s in group}
+        roots = [s for s in group if s["parentId"] is None]
+        assert len(roots) == 1
+        for span in group:
+            assert span["kind"] == "SERVER"
+            assert span["duration"] >= 1  # microseconds, never zero
+            assert span["tags"]["istio.namespace"] == "graftscope"
+            assert span["name"].endswith(".svc.cluster.local:80/*")
+            if span["parentId"] is not None:
+                assert span["parentId"] in by_id
+
+    def test_disabled_records_nothing(self, monkeypatch):
+        monkeypatch.setenv("KMAMIZ_TELEMETRY", "0")
+        before = len(TRACER.traces())
+        with TRACER.tick() as t:
+            assert t is None
+            with phase_span("parse"):
+                pass
+        assert len(TRACER.traces()) == before
+
+    def test_reentrant_tick_keeps_one_trace(self, monkeypatch):
+        monkeypatch.setenv("KMAMIZ_TELEMETRY", "1")
+        with TRACER.tick(root_name="outer"):
+            with TRACER.tick(root_name="inner") as inner:
+                assert inner is None
+                with phase_span("merge"):
+                    pass
+        tb = TRACER.traces()[-1]
+        assert [s[0] for s in tb.spans] == ["outer", "merge"]
+
+    def test_span_histogram_observed_via_preallocated_handle(
+        self, monkeypatch
+    ):
+        monkeypatch.setenv("KMAMIZ_TELEMETRY", "1")
+        base = REGISTRY.get_value("kmamiz_tick_span_ms", ("walk",))
+        with TRACER.tick():
+            with phase_span("walk"):
+                pass
+        assert REGISTRY.get_value("kmamiz_tick_span_ms", ("walk",)) == base + 1
+
+
+class TestScorecard:
+    def test_percentiles_and_rates(self, monkeypatch):
+        from kmamiz_tpu.telemetry import slo
+
+        for ms in range(1, 101):
+            SCORECARD.observe_tick(float(ms))
+        slo.TICKS.inc(10)
+        slo.STALE_SERVES.inc(1)
+        slo.INGEST_PAYLOADS.inc(20)
+        slo.INGEST_DROPPED.inc(2)
+        slo.QUARANTINED.inc(1)
+        snap = SCORECARD.snapshot()
+        assert snap["tick_p50_ms"] == pytest.approx(50.0, abs=1.5)
+        assert snap["tick_p95_ms"] == pytest.approx(95.0, abs=1.5)
+        assert snap["tick_p99_ms"] == pytest.approx(99.0, abs=1.5)
+        assert snap["stale_serve_rate"] == pytest.approx(0.1)
+        assert snap["ingest_drop_rate"] == pytest.approx(0.1)
+        assert snap["quarantine_rate"] == pytest.approx(0.05)
+        assert set(snap) == set(slo.SLO_KEYS_HIGHER_IS_WORSE)
+
+    def test_window_rolls(self, monkeypatch):
+        monkeypatch.setenv("KMAMIZ_SLO_WINDOW", "8")
+        from kmamiz_tpu.telemetry.slo import Scorecard
+
+        card = Scorecard()
+        for ms in (1000.0,) * 8 + (1.0,) * 8:
+            card.observe_tick(ms)
+        assert card.snapshot()["tick_p99_ms"] == 1.0
+
+
+class TestSloReportTool:
+    def test_check_flags_regression_and_passes_clean(self, tmp_path):
+        from tools.slo_report import main
+
+        base = {"slo_tick_p95_ms": 100.0, "dp_tick_ms_2500_traces": 500.0}
+        good = {"slo_tick_p95_ms": 104.0, "dp_tick_ms_2500_traces": 510.0}
+        bad = {"slo_tick_p95_ms": 150.0, "dp_tick_ms_2500_traces": 510.0}
+        (tmp_path / "BENCH_r01.json").write_text(json.dumps(base))
+        good_p = tmp_path / "good.json"
+        bad_p = tmp_path / "bad.json"
+        good_p.write_text(json.dumps(good))
+        bad_p.write_text(json.dumps(bad))
+        assert main(["--check", str(good_p), "--root", str(tmp_path)]) == 0
+        assert main(["--check", str(bad_p), "--root", str(tmp_path)]) == 1
+
+    def test_driver_wrapper_and_truncated_tail(self, tmp_path):
+        from tools.slo_report import main
+
+        wrapped = {"rc": 0, "parsed": {"slo_tick_p95_ms": 10.0}, "tail": ""}
+        truncated = {"rc": 0, "parsed": None, "tail": "no json here"}
+        (tmp_path / "BENCH_r01.json").write_text(json.dumps(wrapped))
+        (tmp_path / "BENCH_r02.json").write_text(json.dumps(truncated))
+        # render mode walks past the unparseable newest artifact
+        assert main(["--root", str(tmp_path)]) == 0
+
+
+class TestHttpSurfaces:
+    def test_api_handler_metrics_and_traces(self, monkeypatch):
+        from kmamiz_tpu.api.handlers import TelemetryHandler
+        from kmamiz_tpu.api.router import Request
+
+        monkeypatch.setenv("KMAMIZ_TELEMETRY", "1")
+        with TRACER.tick():
+            with phase_span("parse"):
+                pass
+        handler = TelemetryHandler(None)
+        resp = handler._metrics(Request(method="get", path="/metrics"))
+        assert resp.content_type.startswith("text/plain; version=0.0.4")
+        _parse_exposition(resp.raw_body.decode("utf-8"))
+        resp = handler._traces(Request(method="get", path="/traces"))
+        assert resp.payload and resp.payload[-1][0]["traceId"]
+
+
+@pytest.fixture
+def raw_tick_window():
+    from kmamiz_tpu.synth import make_raw_window
+
+    return json.loads(make_raw_window(30, 4, t_start=0))
+
+
+class TestSelfTraceRoundTrip:
+    def test_processor_ingests_its_own_export(
+        self, monkeypatch, raw_tick_window
+    ):
+        """Dogfooding acceptance: tick traces exported as Zipkin v2 feed
+        back through the raw-ingest path and yield a NON-EMPTY dependency
+        graph of the pipeline itself."""
+        monkeypatch.setenv("KMAMIZ_MESH", "0")
+        monkeypatch.setenv("KMAMIZ_TELEMETRY", "1")
+        from kmamiz_tpu.server.processor import DataProcessor
+
+        dp = DataProcessor(
+            trace_source=lambda lb, t, lim: raw_tick_window,
+            use_device_stats=False,
+        )
+        dp.collect({"uniqueId": "self", "lookBack": 30_000, "time": 1_000})
+        export = TRACER.export_zipkin()
+        assert export, "the tick must have recorded a trace"
+
+        sink = DataProcessor(
+            trace_source=lambda lb, t, lim: [], use_device_stats=False
+        )
+        out = sink.ingest_raw_window(json.dumps(export).encode("utf-8"))
+        assert out["spans"] > 0
+        assert out["traces"] == len(export)
+        assert out["endpoints"] > 0, "self-trace must produce endpoints"
+        assert out["edges"] > 0, (
+            "nested tick spans must become dependency-graph edges"
+        )
+
+
+class TestGuardedTickWithTelemetry:
+    def test_warm_tick_telemetry_on_is_clean_and_traced(self, monkeypatch):
+        """Acceptance: with KMAMIZ_TELEMETRY=1 a warm tick survives
+        transfer_guard("disallow") with ZERO new compiles (spans add no
+        host syncs, no implicit transfers) and records a span tree."""
+        monkeypatch.setenv("KMAMIZ_MESH", "0")
+        monkeypatch.setenv("KMAMIZ_TELEMETRY", "1")
+        from kmamiz_tpu.analysis import guards
+        from kmamiz_tpu.server.processor import DataProcessor
+        from kmamiz_tpu.synth import make_raw_window
+
+        # warm every program shape on two distinct windows
+        for seed_t in (0, 10_000):
+            window = json.loads(make_raw_window(60, 5, t_start=seed_t))
+            dp = DataProcessor(trace_source=lambda lb, t, lim: window)
+            dp.collect(
+                {
+                    "uniqueId": f"warm{seed_t}",
+                    "lookBack": 30_000,
+                    "time": 1_000_000 + seed_t,
+                }
+            )
+            dp.graph.n_edges
+
+        window = json.loads(make_raw_window(60, 5, t_start=20_000))
+        dp_guarded = DataProcessor(trace_source=lambda lb, t, lim: window)
+        traces_before = len(TRACER.traces())
+        with guards.hot_path_guard("disallow") as report:
+            dp_guarded.collect(
+                {"uniqueId": "guarded", "lookBack": 30_000, "time": 2_000_000}
+            )
+            dp_guarded.graph.n_edges
+        assert report.new_compiles == {}, report.new_compiles
+
+        new_traces = TRACER.traces()[traces_before:]
+        assert new_traces, "telemetry-on tick must record its trace"
+        spans = new_traces[-1].spans
+        names = {s[0] for s in spans}
+        # the collect tick must at least time parse, pack, and walk
+        assert {"parse", "pack", "walk"} <= names, names
+        assert all(name in PHASES or name == "dp-tick" for name in names)
+        for i, (name, _start, dur, parent) in enumerate(spans):
+            assert dur >= 0 and parent < i

@@ -1,0 +1,203 @@
+"""SLO scorecard report + regression gate over bench artifacts.
+
+Renders the graftscope scorecard keys (telemetry/slo.py) from bench
+result JSON, and — with --check — compares a candidate result against
+the latest BENCH_r*.json baseline, exiting nonzero when any
+higher-is-worse SLO key regresses beyond the threshold. Runnable as a
+tier-1-adjacent gate:
+
+    python tools/slo_report.py                     # render latest artifact
+    python tools/slo_report.py --check new.json    # gate new vs latest
+    python tools/slo_report.py --check             # gate latest vs previous
+
+Artifact shapes accepted: the driver's {cmd, rc, parsed, tail} wrapper
+(parsed dict preferred, else the last JSON line found in tail) or a bare
+bench.py result object.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kmamiz_tpu.telemetry.slo import SLO_KEYS_HIGHER_IS_WORSE  # noqa: E402
+
+# bench keys gated alongside the scorecard: the tick-latency headline
+# pair and the 100k-endpoint refresh (ROADMAP item 2)
+_EXTRA_GATED = (
+    "dp_tick_ms_2500_traces",
+    "dp_tick_cached_ms",
+    "graph_refresh_ms_100k",
+)
+# absolute slack per key class: rates jitter in the 3rd decimal on tiny
+# denominators, recompile counts are integers, latencies get 0.5 ms
+_ABS_SLACK_RATE = 0.005
+_ABS_SLACK_COUNT = 1.0
+_ABS_SLACK_MS = 0.5
+
+
+def gated_keys():
+    return ["slo_" + k for k in SLO_KEYS_HIGHER_IS_WORSE] + list(_EXTRA_GATED)
+
+
+def _abs_slack(key: str) -> float:
+    if key.endswith("_rate"):
+        return _ABS_SLACK_RATE
+    if key.endswith("_count"):
+        return _ABS_SLACK_COUNT
+    return _ABS_SLACK_MS
+
+
+def _extract_result(doc: dict):
+    """Bench result object from either artifact shape."""
+    if not isinstance(doc, dict):
+        return None
+    if "parsed" in doc or "tail" in doc:  # driver wrapper
+        if isinstance(doc.get("parsed"), dict):
+            return doc["parsed"]
+        tail = doc.get("tail") or ""
+        for line in reversed(tail.strip().splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    return json.loads(line)
+                except ValueError:
+                    return None
+        return None
+    return doc
+
+
+def load_result(path: str):
+    with open(path) as f:
+        return _extract_result(json.load(f))
+
+
+def find_artifacts(root: str):
+    """BENCH_r*.json sorted oldest -> newest by round number."""
+
+    def round_no(p):
+        m = re.search(r"BENCH_r(\d+)\.json$", p)
+        return int(m.group(1)) if m else -1
+
+    return sorted(glob.glob(os.path.join(root, "BENCH_r*.json")), key=round_no)
+
+
+def render(result: dict, label: str) -> str:
+    lines = [f"SLO scorecard — {label}"]
+    for key in gated_keys():
+        val = result.get(key)
+        lines.append(f"  {key:28s} {val if val is not None else '(absent)'}")
+    return "\n".join(lines)
+
+
+def check(candidate: dict, baseline: dict, threshold: float):
+    """(regressions, compared): each regression is (key, old, new)."""
+    regressions, compared = [], []
+    for key in gated_keys():
+        new, old = candidate.get(key), baseline.get(key)
+        if not isinstance(new, (int, float)) or not isinstance(
+            old, (int, float)
+        ):
+            continue  # absent on either side: nothing to gate
+        compared.append(key)
+        if new > old * (1.0 + threshold) + _abs_slack(key):
+            regressions.append((key, old, new))
+    return regressions, compared
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--check",
+        nargs="?",
+        const="",
+        default=None,
+        metavar="CANDIDATE_JSON",
+        help="gate CANDIDATE (default: latest artifact) against the "
+        "previous BENCH_r*.json; exit 1 on any SLO regression",
+    )
+    ap.add_argument(
+        "--threshold",
+        type=float,
+        default=0.10,
+        help="relative regression threshold (default 0.10 = +10%%)",
+    )
+    ap.add_argument(
+        "--root",
+        default=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        help="directory holding BENCH_r*.json artifacts",
+    )
+    args = ap.parse_args(argv)
+
+    artifacts = find_artifacts(args.root)
+
+    def newest_parseable(pool):
+        """(result, path, remaining-older-pool) — some rounds' wrappers
+        hold only a truncated tail with no JSON line; walk past them."""
+        for i in range(len(pool) - 1, -1, -1):
+            got = load_result(pool[i])
+            if got is not None:
+                return got, pool[i], pool[:i]
+        return None, None, []
+
+    if args.check is None:
+        result, path, _ = newest_parseable(artifacts)
+        if result is None:
+            print("no parseable BENCH_r*.json artifacts found", file=sys.stderr)
+            return 2
+        print(render(result, os.path.basename(path)))
+        return 0
+
+    # --check: candidate vs the newest parseable artifact strictly before it
+    if args.check:
+        candidate = load_result(args.check)
+        cand_label = args.check
+        baseline_pool = artifacts
+        if candidate is None:
+            print(f"could not parse candidate {cand_label}", file=sys.stderr)
+            return 2
+    else:
+        candidate, cand_path, baseline_pool = newest_parseable(artifacts)
+        if candidate is None:
+            print("no parseable BENCH_r*.json artifacts found", file=sys.stderr)
+            return 2
+        cand_label = os.path.basename(cand_path)
+        if not baseline_pool:
+            print("need >=2 parseable artifacts for --check without a candidate")
+            return 0
+    baseline = None
+    base_label = None
+    for path in reversed(baseline_pool):
+        got = load_result(path)
+        if got is not None:
+            baseline, base_label = got, os.path.basename(path)
+            break
+    if baseline is None:
+        print("no parseable baseline artifact; nothing to gate")
+        return 0
+
+    regressions, compared = check(candidate, baseline, args.threshold)
+    print(render(candidate, cand_label))
+    print(f"baseline: {base_label}; compared {len(compared)} key(s)")
+    if not compared:
+        print("no overlapping SLO keys (baseline predates graftscope)")
+        return 0
+    for key, old, new in regressions:
+        print(
+            f"REGRESSION {key}: {old} -> {new} "
+            f"(+{(new - old) / max(abs(old), 1e-9) * 100:.1f}%, "
+            f"threshold {args.threshold * 100:.0f}%)"
+        )
+    if regressions:
+        return 1
+    print("all gated SLO keys within threshold")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

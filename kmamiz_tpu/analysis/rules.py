@@ -716,6 +716,9 @@ _STATE_SCOPES = (
     # are written from scheduler threads, server threads, AND the ingest
     # producer at once — exactly the state this rule exists for
     "kmamiz_tpu/resilience/",
+    # the tenancy layer's process-wide registries (arena, per-tenant
+    # runtimes, micro-batch queue) take writes from every server thread
+    "kmamiz_tpu/tenancy/",
 )
 
 

@@ -136,6 +136,31 @@ class Settings:
         default_factory=lambda: float(os.environ.get("KMAMIZ_DP_TIMEOUT_S", "30"))
     )  # external-DP request timeout (was a hardcoded 30)
 
+    # tenancy layer (kmamiz_tpu/tenancy/, docs/TENANCY.md). Like the
+    # resilience knobs, the tenancy modules read these env vars directly;
+    # the fields mirror them so one `Settings()` dump shows everything.
+    tenant_header: str = field(
+        default_factory=lambda: os.environ.get(
+            "KMAMIZ_TENANT_HEADER", "x-kmamiz-tenant"
+        )
+    )  # HTTP header carrying the tenant name (the /t/<tenant>/ path prefix wins)
+    max_tenants: int = field(
+        default_factory=lambda: int(os.environ.get("KMAMIZ_MAX_TENANTS", "64"))
+    )  # arena admission cap; joins past it get 429
+    tenant_batch_window_ms: float = field(
+        default_factory=lambda: float(
+            os.environ.get("KMAMIZ_TENANT_BATCH_WINDOW_MS", "0")
+        )
+    )  # 0 = per-request ticks; >0 = gather concurrent tenant ticks this long
+    max_tenant_series: int = field(
+        default_factory=lambda: int(
+            os.environ.get("KMAMIZ_MAX_TENANT_SERIES", "32")
+        )
+    )  # distinct tenant label values before folding into __other__
+    tenant_shard: bool = field(
+        default_factory=lambda: os.environ.get("KMAMIZ_TENANT_SHARD", "1") != "0"
+    )  # shard the stacked tenant arena over the device mesh's spans axis
+
     def __post_init__(self) -> None:
         k8s_host = os.environ.get("KUBERNETES_SERVICE_HOST")
         k8s_port = os.environ.get("KUBERNETES_SERVICE_PORT")

@@ -197,6 +197,13 @@ def _window_merge_packed(
     return s, d, ds, v, v.sum()
 
 
+class StoreVersionDrift(RuntimeError):
+    """A stacked-merge lane was built from an arena snapshot the store
+    has since moved past (concurrent merge between snapshot and adopt).
+    The caller re-merges its window serially against the current store —
+    merges are set unions, so the fallback stays bit-exact."""
+
+
 class EndpointGraph:
     """Capacity-padded edge set keyed (src_ep -> dst_ep, distance).
 
@@ -228,7 +235,9 @@ class EndpointGraph:
         interner: Optional[EndpointInterner] = None,
         ml_interner: Optional[StringInterner] = None,
         capacity: int = 1024,
+        tenant: str = "default",
     ) -> None:
+        self.tenant = tenant
         self.interner = interner or EndpointInterner()
         self.ml_interner = ml_interner or StringInterner()
         self._src = jnp.full(capacity, SENTINEL, dtype=jnp.int32)
@@ -313,6 +322,13 @@ class EndpointGraph:
         # OUTSIDE the lock on immutable jnp snapshots.
         self._lock = threading.RLock()
         _track_store_arenas(self)
+        # every graph self-registers into the process-wide tenant arena:
+        # an EndpointGraph IS the arena's (tenant, version) index target.
+        # Held by weakref there, so short-lived graphs don't accumulate;
+        # re-admitting "default" (tests, benches) just replaces the slot.
+        from kmamiz_tpu.tenancy.arena import default_arena
+
+        default_arena().admit(tenant, self)
 
     def arena_bytes(self) -> Dict[str, int]:
         """Tracked device-allocation sizes per arena, for the telemetry
@@ -651,6 +667,77 @@ class EndpointGraph:
                 valid_count.copy_to_host_async()
             self._pending = (s, d, ds, valid_count)
             return transfer_ms
+
+    def capacity_bucket(self) -> int:
+        """The pow2 edge capacity this graph's padded arrays occupy — the
+        tenant arena's bucketing key (kmamiz_tpu/tenancy/arena.py):
+        same-bucket graphs dispatch identical compiled program shapes."""
+        return self.capacity
+
+    def intern_window_edges(self, edges):
+        """Read-only intern of a window's (caller_uen, callee_uen,
+        distance) triples into id columns — the host half of
+        merge_window_edges, WITHOUT any state change. Returns
+        (src_ids, dst_ids, dist) int lists, or None when the window is
+        empty or an endpoint is missing from the interner (the caller
+        falls back to the walk-kernel merge path). Used by the tenancy
+        router to build stacked same-bucket windows before committing
+        any per-tenant merge."""
+        with self._lock:
+            eps = self.interner.endpoints
+            src_l, dst_l, dist_l = [], [], []
+            for caller, callee, dist in edges:
+                s_id = eps.get(caller)
+                d_id = eps.get(callee)
+                if s_id is None or d_id is None:
+                    return None
+                src_l.append(s_id)
+                dst_l.append(d_id)
+                dist_l.append(dist)
+        if not src_l:
+            return None
+        return src_l, dst_l, dist_l
+
+    def adopt_batched_merged(
+        self,
+        src,
+        dst,
+        dist,
+        valid_count,
+        batch: SpanBatch,
+        max_dist: int,
+        min_dist: int,
+        expected_version=None,
+    ):
+        """Adopt one lane of a stacked same-bucket union
+        (tenancy.batch.batched_merge_edges) as this tick's merge,
+        mirroring merge_window_edges' bookkeeping exactly: version bump,
+        dirty-journal note, endpoint metadata, distance bounds, deferred
+        count resolution. The lane was computed OUTSIDE the lock from an
+        arena snapshot, so adoption is valid only if the store still sits
+        at the snapshot's version with nothing staged or pending —
+        anything else raises StoreVersionDrift and the caller re-merges
+        serially (set union: idempotent, so the fallback is bit-exact)."""
+        with self._lock:
+            drifted = (
+                expected_version is not None
+                and self._version != expected_version
+            )
+            if drifted or self._pending is not None or self._staged or (
+                self._preunion is not None
+            ):
+                raise StoreVersionDrift(
+                    f"store v{self._version} (expected v{expected_version}); "
+                    "stacked lane is stale"
+                )
+            self._version += 1
+            self._note_dirty_locked(batch)
+            self._update_ep_metadata(batch)
+            self._max_dist = max(self._max_dist, max_dist)
+            self._min_dist = min(self._min_dist, min_dist)
+            if hasattr(valid_count, "copy_to_host_async"):
+                valid_count.copy_to_host_async()
+            self._pending = (src, dst, dist, valid_count)
 
     def _update_ep_metadata(self, batch: SpanBatch) -> None:
         """Per-endpoint record/last-usage metadata (host-side, no device

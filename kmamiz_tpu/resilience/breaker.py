@@ -192,22 +192,52 @@ _REGISTRY_LOCK = threading.Lock()
 _REGISTRY: Dict[str, CircuitBreaker] = {}
 
 
-def get_breaker(name: str, **kwargs) -> CircuitBreaker:
-    """The process-wide breaker for an upstream, created on first use.
-    kwargs apply only at creation (all call sites of one upstream share
-    one breaker and therefore one failure budget)."""
+def _registry_key(name: str, tenant) -> str:
+    if tenant in (None, "", "default"):
+        return name
+    return f"{tenant}:{name}"
+
+
+def get_breaker(name: str, tenant=None, **kwargs) -> CircuitBreaker:
+    """The breaker for an upstream, created on first use. kwargs apply
+    only at creation (all call sites of one upstream share one breaker
+    and therefore one failure budget). A non-default `tenant` scopes the
+    registry key to ``<tenant>:<name>`` so each tenant's upstream gets
+    its own failure budget — one tenant's flapping source cannot trip
+    another tenant's breaker. The default tenant keeps the legacy
+    process-wide names."""
+    key = _registry_key(name, tenant)
     with _REGISTRY_LOCK:
-        breaker = _REGISTRY.get(name)
+        breaker = _REGISTRY.get(key)
         if breaker is None:
-            breaker = CircuitBreaker(name, **kwargs)
-            _REGISTRY[name] = breaker
+            breaker = CircuitBreaker(key, **kwargs)
+            _REGISTRY[key] = breaker
         return breaker
 
 
-def breaker_states() -> Dict[str, dict]:
+def breaker_states(tenant=None) -> Dict[str, dict]:
+    """All breaker snapshots, or (with `tenant`) only that tenant's
+    ``<tenant>:``-prefixed entries."""
     with _REGISTRY_LOCK:
         breakers = dict(_REGISTRY)
+    if tenant not in (None, "", "default"):
+        prefix = f"{tenant}:"
+        breakers = {
+            name: b for name, b in breakers.items()
+            if name.startswith(prefix)
+        }
     return {name: b.snapshot() for name, b in breakers.items()}
+
+
+def reset_tenant(tenant: str) -> None:
+    """Drop one tenant's breakers (its ``<tenant>:``-prefixed registry
+    entries) without touching any other tenant's failure budgets."""
+    if tenant in (None, "", "default"):
+        return
+    prefix = f"{tenant}:"
+    with _REGISTRY_LOCK:
+        for key in [k for k in _REGISTRY if k.startswith(prefix)]:
+            del _REGISTRY[key]
 
 
 def reset_for_tests() -> None:

@@ -121,18 +121,23 @@ def job_succeeded(name: str) -> None:
             entry["consecutiveFailures"] = 0
 
 
-def reset_job_streaks(names=None) -> None:
-    """Drop per-job failure state for `names` (or all jobs). Called by
+def reset_job_streaks(names=None, prefix=None) -> None:
+    """Drop per-job failure state for `names`, every job under `prefix`
+    (the tenancy layer's ``<tenant>/`` namespace — one tenant's job
+    restart resets only that tenant's streaks), or all jobs. Called by
     Scheduler.start() so a scheduler (re)start begins every registered
     job from a clean slate — a streak accumulated by a previous
     scheduler instance (in-process restart, handover, tests) must not
     leak into the new instance's /health as if the new jobs were
     failing."""
     with _LOCK:
-        if names is None:
+        if names is None and prefix is None:
             _JOBS.clear()
-        else:
-            for n in names:
+            return
+        for n in names or ():
+            _JOBS.pop(n, None)
+        if prefix is not None:
+            for n in [k for k in _JOBS if k.startswith(prefix)]:
                 _JOBS.pop(n, None)
 
 
@@ -194,7 +199,10 @@ def resilience_summary() -> dict:
     states, quarantine totals, watchdog/last-good, job streaks, and the
     flat counters (ingestDropped, dpFallback, ...)."""
     from kmamiz_tpu.resilience.breaker import breaker_states
-    from kmamiz_tpu.resilience.quarantine import quarantine_stats
+    from kmamiz_tpu.resilience.quarantine import (
+        quarantine_stats,
+        tenant_quarantine_stats,
+    )
 
     with _LOCK:
         counters = {
@@ -203,6 +211,7 @@ def resilience_summary() -> dict:
     return {
         "breakers": breaker_states(),
         "quarantine": quarantine_stats(),
+        "tenantQuarantine": tenant_quarantine_stats(),
         "watchdog": watchdog_state(),
         "jobs": job_states(),
         "counters": counters,

@@ -240,6 +240,9 @@ def enabled() -> bool:
 
 _DEFAULT_LOCK = threading.Lock()
 _DEFAULT: dict = {"instance": None}
+# per-tenant quarantines (tenancy layer): tenant -> Quarantine bound to
+# <base>/tenants/<tenant>, created on first diversion for that tenant
+_TENANT_INSTANCES: dict = {}
 
 
 def default_quarantine() -> Quarantine:
@@ -252,6 +255,38 @@ def default_quarantine() -> Quarantine:
         return _DEFAULT["instance"]
 
 
+def quarantine_for(tenant: str = "default") -> Quarantine:
+    """The tenant's quarantine. The default tenant keeps the exact
+    legacy directory; any other tenant gets its OWN bounded directory
+    under ``<base>/tenants/<tenant>`` — its files never count against
+    (or evict from) another tenant's quarantine budget. Tenant names are
+    re-validated here (defense in depth: they become a path component)."""
+    if tenant in (None, "", "default"):
+        return default_quarantine()
+    from kmamiz_tpu.tenancy.arena import TenantNameError, valid_tenant
+
+    if not valid_tenant(tenant):
+        raise TenantNameError(f"invalid tenant name: {tenant!r}")
+    with _DEFAULT_LOCK:
+        instance = _TENANT_INSTANCES.get(tenant)
+        if instance is None:
+            base = os.environ.get(
+                "KMAMIZ_QUARANTINE_DIR", "./kmamiz-data/quarantine"
+            )
+            instance = Quarantine(
+                directory=os.path.join(base, "tenants", tenant)
+            )
+            _TENANT_INSTANCES[tenant] = instance
+    return instance
+
+
+def drop_tenant(tenant: str) -> None:
+    """Forget one tenant's quarantine binding (its on-disk files stay
+    for operator inspection; a re-created binding re-counts them)."""
+    with _DEFAULT_LOCK:
+        _TENANT_INSTANCES.pop(tenant, None)
+
+
 def quarantine_stats() -> dict:
     with _DEFAULT_LOCK:
         instance = _DEFAULT["instance"]
@@ -260,6 +295,15 @@ def quarantine_stats() -> dict:
     return instance.stats()
 
 
+def tenant_quarantine_stats() -> dict:
+    """Per-tenant quarantine stats for the /timings and health surfaces
+    (default tenant under its usual quarantine_stats() key, not here)."""
+    with _DEFAULT_LOCK:
+        instances = dict(_TENANT_INSTANCES)
+    return {tenant: q.stats() for tenant, q in sorted(instances.items())}
+
+
 def reset_for_tests() -> None:
     with _DEFAULT_LOCK:
         _DEFAULT["instance"] = None
+        _TENANT_INSTANCES.clear()

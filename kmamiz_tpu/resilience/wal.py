@@ -75,11 +75,22 @@ class IngestWAL:
         self._records_appended = 0
 
     @classmethod
-    def from_env(cls) -> Optional["IngestWAL"]:
-        """The env-configured WAL, or None when KMAMIZ_WAL is unset/0."""
+    def from_env(cls, tenant: str = "default") -> Optional["IngestWAL"]:
+        """The env-configured WAL, or None when KMAMIZ_WAL is unset/0.
+        A non-default tenant logs under its OWN namespace,
+        ``<wal-dir>/tenants/<tenant>`` — tenants append and replay
+        independently, so each graph restores bit-exact after kill -9
+        regardless of what other tenants logged. Tenant names are
+        re-validated before becoming a path component."""
         if os.environ.get("KMAMIZ_WAL", "0") != "1":
             return None
         directory = os.environ.get("KMAMIZ_WAL_DIR", "./kmamiz-data/wal")
+        if tenant not in (None, "", "default"):
+            from kmamiz_tpu.tenancy.arena import TenantNameError, valid_tenant
+
+            if not valid_tenant(tenant):
+                raise TenantNameError(f"invalid tenant name: {tenant!r}")
+            directory = os.path.join(directory, "tenants", tenant)
         return cls(directory)
 
     @property

@@ -108,20 +108,63 @@ class _EncodedPayloadCache:
         return body
 
 
-def make_handler(processor: DataProcessor):
-    encoded_cache = _EncodedPayloadCache()
+def _make_runtime(tenant: str, proc: DataProcessor):
+    """One tenant's serving state: its processor plus PER-TENANT edge
+    layers — last-good payload, tick watchdog, encoded-payload cache.
+    Per-instance state is the isolation: tenant A's overrun trips only
+    A's in-flight-overlap detector, A's stale serve reads only A's
+    last-good graph, and the encode memo cannot leak one tenant's
+    dependency payload into another's response."""
+    from kmamiz_tpu.tenancy.router import TenantRuntime
+
     last_good = _LastGoodTick()
     # env-driven deadline (KMAMIZ_TICK_DEADLINE_MS, 0 = off); a straggler
-    # that finishes after the trip still refreshes last_good
+    # that finishes after the trip still refreshes this tenant's last_good
     watchdog = TickWatchdog(
         on_late_result=lambda result: last_good.update(
             result,
-            processor.graph.version,
-            processor.graph.label_epoch,
+            proc.graph.version,
+            proc.graph.label_epoch,
         )
         if isinstance(result, dict)
         else None
     )
+    return TenantRuntime(
+        tenant,
+        proc,
+        last_good=last_good,
+        watchdog=watchdog,
+        encoded_cache=_EncodedPayloadCache(),
+    )
+
+
+def make_handler(processor: DataProcessor, router=None):
+    from kmamiz_tpu.tenancy.arena import (
+        DEFAULT_TENANT,
+        TenantLimitError,
+        TenantNameError,
+    )
+    from kmamiz_tpu.tenancy.router import (
+        TenantResolutionError,
+        TickRouter,
+        batch_window_ms,
+        resolve_tenant,
+    )
+    from kmamiz_tpu.telemetry import slo as tel_slo
+
+    if router is None:
+        def _factory(tenant: str):
+            if tenant == DEFAULT_TENANT:
+                return _make_runtime(tenant, processor)
+            proc = processor.sibling_for_tenant(tenant)
+            # the tenant's own WAL namespace replays before first serve,
+            # so a restarted server answers from its recovered graph
+            recovered = proc.replay_wal()
+            if recovered["replayed"]:
+                logger.info("tenant %s wal replay: %s", tenant, recovered)
+            return _make_runtime(tenant, proc)
+
+        router = TickRouter(_factory)
 
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
@@ -129,17 +172,39 @@ def make_handler(processor: DataProcessor):
         def log_message(self, fmt: str, *args) -> None:  # quiet default logs
             logger.debug("%s " + fmt, self.address_string(), *args)
 
+        def _route(self):
+            """(tenant, de-prefixed path) for this request, or None after
+            answering 400 for an unroutable tenant name."""
+            try:
+                return resolve_tenant(self.headers, self.path)
+            except (TenantResolutionError, TenantNameError) as e:
+                self._send_json(400, {"error": str(e)})
+                return None
+
+        def _runtime(self, tenant: str):
+            """The tenant's runtime (created on first request), or None
+            after answering 429 (tenant limit) / 400 (bad name)."""
+            try:
+                return router.runtime(tenant)
+            except TenantLimitError as e:
+                self._send_json(429, {"error": str(e)})
+                return None
+            except TenantNameError as e:
+                self._send_json(400, {"error": str(e)})
+                return None
+
         def _send_json(
             self,
             status: int,
             payload: dict,
             cache_key: tuple = None,
             extra_headers: Optional[dict] = None,
+            cache: "_EncodedPayloadCache | None" = None,
         ) -> None:
             accept = self.headers.get("Accept-Encoding", "")
             encoded = "gzip" in accept
-            if cache_key is not None:
-                body = encoded_cache.get_or_encode(cache_key, payload, encoded)
+            if cache_key is not None and cache is not None:
+                body = cache.get_or_encode(cache_key, payload, encoded)
             else:
                 body = json.dumps(payload).encode()
                 if encoded:
@@ -167,7 +232,11 @@ def make_handler(processor: DataProcessor):
             )
 
         def do_GET(self) -> None:  # health check (main.rs:28-31)
-            path = self.path.split("?", 1)[0].rstrip("/")
+            route = self._route()
+            if route is None:
+                return
+            _tenant, path = route
+            path = path.split("?", 1)[0].rstrip("/")
             if path == "/timings":
                 from kmamiz_tpu.core.profiling import step_timer
 
@@ -177,6 +246,8 @@ def make_handler(processor: DataProcessor):
                         "phases": step_timer.summary(),
                         "programs": programs.summary(),
                         "resilience": res_metrics.resilience_summary(),
+                        "tenancy": router.summary(),
+                        "tenants": tel_slo.TENANTS.snapshot(),
                     },
                 )
                 return
@@ -227,7 +298,11 @@ def make_handler(processor: DataProcessor):
                 self._send_json(400, {"error": f"bad request: {e}"})
                 return
 
-            post_path = self.path.split("?", 1)[0].rstrip("/")
+            route = self._route()
+            if route is None:
+                return
+            tenant, stripped = route
+            post_path = stripped.split("?", 1)[0].rstrip("/")
             if post_path == "/debug/profile":
                 # on-demand jax.profiler capture: {"durationMs": N,
                 # "dir": optional} -> blocks for the window, answers
@@ -252,6 +327,9 @@ def make_handler(processor: DataProcessor):
                 # k+1 overlaps the device merge of chunk k. Span-id maps
                 # are then scoped per chunk (the reference's own scope
                 # under paginated fetches; see ingest_raw_stream).
+                rt = self._runtime(tenant)
+                if rt is None:
+                    return
                 try:
                     summary = None
                     try:
@@ -283,9 +361,11 @@ def make_handler(processor: DataProcessor):
                                 n_chunks = DEFAULT_STREAM_CHUNKS
                             chunks = native_mod.split_groups(raw, n_chunks)
                             if chunks is not None and len(chunks) > 1:
-                                summary = processor.ingest_raw_stream(chunks)
+                                summary = rt.processor.ingest_raw_stream(
+                                    chunks
+                                )
                         if summary is None:
-                            summary = processor.ingest_raw_window(raw)
+                            summary = rt.processor.ingest_raw_window(raw)
                 except ValueError as e:
                     self._send_json(400, {"error": str(e)})
                     return
@@ -301,12 +381,24 @@ def make_handler(processor: DataProcessor):
             except ValueError as e:
                 self._send_json(400, {"error": f"bad request: {e}"})
                 return
+            rt = self._runtime(tenant)
+            if rt is None:
+                return
+
             def _tick() -> dict:
                 # opt-in hot-path enforcement: KMAMIZ_TRANSFER_GUARD=1
                 # runs the tick under jax.transfer_guard("disallow") and
                 # diffs the program registry's compile counters
                 with guards.maybe_guarded_tick() as guard_report:
-                    result = processor.collect(request)
+                    if batch_window_ms() > 0:
+                        # gather-window coalescing: concurrent same-bucket
+                        # tenant ticks batch into ONE stacked dispatch
+                        # (tenancy/router.py submit). The per-tick
+                        # watchdog deadline spans the whole gathered
+                        # batch in this mode.
+                        result = router.submit(tenant, request)
+                    else:
+                        result = rt.processor.collect(request)
                 if guard_report is not None and guard_report.recompiled:
                     logger.warning(
                         "collect tick recompiled programs: %s",
@@ -315,45 +407,50 @@ def make_handler(processor: DataProcessor):
                 return result
 
             try:
-                response = watchdog.run(_tick)
+                response = rt.watchdog.run(_tick)
             except TickDeadlineExceeded as e:
                 # tick overran its deadline (or a straggler is still in
-                # flight): serve the last-good graph, explicitly stale
-                logger.warning("collect tick degraded: %s", e)
-                stale = last_good.serve_stale(
+                # flight): serve the tenant's last-good graph, explicitly
+                # stale — never another tenant's payload
+                logger.warning(
+                    "collect tick degraded (tenant %s): %s", tenant, e
+                )
+                stale = rt.last_good.serve_stale(
                     request.get("uniqueId", ""), e.reason
                 )
                 if stale is not None:
+                    tel_slo.TENANTS.note_stale(tenant)
                     self._send_stale(stale)
                     return
                 self._send_json(503, {"error": str(e), "reason": e.reason})
                 return
             except Exception as e:  # noqa: BLE001 - degrade, else fall back
-                logger.exception("collect failed")
-                stale = last_good.serve_stale(
+                logger.exception("collect failed (tenant %s)", tenant)
+                stale = rt.last_good.serve_stale(
                     request.get("uniqueId", ""), REASON_FAULT
                 )
                 if stale is not None:
                     res_metrics.watchdog_tripped(REASON_FAULT)
+                    tel_slo.TENANTS.note_stale(tenant)
                     self._send_stale(stale)
                     return
                 self._send_json(500, {"error": str(e)})
                 return
-            last_good.update(
-                response, processor.graph.version, processor.graph.label_epoch
-            )
-            # version-keyed encode memo: a retried uniqueId against an
-            # unchanged graph re-sends the cached bytes instead of
-            # re-encoding the full dependency payload per thread
+            graph = rt.processor.graph
+            rt.last_good.update(response, graph.version, graph.label_epoch)
+            # version-keyed encode memo (per tenant): a retried uniqueId
+            # against an unchanged graph re-sends the cached bytes instead
+            # of re-encoding the full dependency payload per thread
             t_enc = time.perf_counter()
             self._send_json(
                 200,
                 response,
                 cache_key=(
                     request.get("uniqueId", ""),
-                    processor.graph.version,
-                    processor.graph.label_epoch,
+                    graph.version,
+                    graph.label_epoch,
                 ),
+                cache=rt.encoded_cache,
             )
             # the encode happens after the tick's trace closed (and the
             # tick itself may have run on a watchdog worker thread), so
@@ -362,6 +459,7 @@ def make_handler(processor: DataProcessor):
                 "encode-serve", (time.perf_counter() - t_enc) * 1000
             )
 
+    Handler.router = router  # tests and embedders reach the tick router here
     return Handler
 
 

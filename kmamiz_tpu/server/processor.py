@@ -109,6 +109,39 @@ def _pack_stats(count, mean, cv, ts_rel):
     return jnp.stack([count, mean, cv, ts_bits])
 
 
+class _PreparedTick:
+    """A tick's host-stage results between prepare_tick and finish_tick:
+    the routing unit of the tenancy layer's stacked dispatch (several
+    tenants prepare, one stacked device merge, then each finishes)."""
+
+    __slots__ = (
+        "request",
+        "t_start",
+        "wall_t0",
+        "req_time",
+        "trace_groups",
+        "realtime",
+        "stats_job",
+        "dependencies",
+        "window_edges",
+        "batch",
+        "merged",
+    )
+
+    def __init__(self, request: dict) -> None:
+        self.request = request
+        self.t_start = 0.0
+        self.wall_t0 = 0.0
+        self.req_time = 0
+        self.trace_groups = []
+        self.realtime = None
+        self.stats_job = None
+        self.dependencies = None
+        self.window_edges = None
+        self.batch = None
+        self.merged = False
+
+
 class DataProcessor:
     """One instance per DP service; holds the processed-trace dedup map and
     the persistent device graph."""
@@ -119,8 +152,10 @@ class DataProcessor:
         k8s_source: Optional[object] = None,
         use_device_stats: bool = True,
         now_ms: Callable[[], float] = lambda: time.time() * 1000,
+        tenant: str = "default",
     ) -> None:
         _tune_gc()
+        self.tenant = tenant
         self._trace_source = trace_source
         self._k8s = k8s_source
         self._use_device_stats = use_device_stats
@@ -148,7 +183,7 @@ class DataProcessor:
         # arrive on other server threads; dedup-map transitions serialize
         # here (the graph store carries its own lock)
         self._dedup_lock = threading.Lock()
-        self.graph = EndpointGraph()
+        self.graph = EndpointGraph(tenant=tenant)
         # online history-feature state (models/history.HistoryState),
         # created lazily on the first observed tick; ticks accumulate
         # into the current hour's bucket and fold on rollover. collect()
@@ -171,8 +206,22 @@ class DataProcessor:
         # BEFORE its graph merge, so a kill -9 mid-tick replays to a
         # bit-exact graph on restart (replay_wal). _wal_replaying
         # suppresses re-appends while the replay itself runs.
-        self._wal = IngestWAL.from_env()
+        self._wal = IngestWAL.from_env(tenant=tenant)
         self._wal_replaying = False
+
+    def sibling_for_tenant(self, tenant: str) -> "DataProcessor":
+        """A fresh DataProcessor for another tenant sharing this one's
+        sources and clock but NOTHING stateful: its own graph (admitted
+        into the arena under `tenant`), its own WAL namespace, its own
+        dedup map and history. The tenancy router's runtime factory uses
+        this to bring tenants up from the default processor's wiring."""
+        return DataProcessor(
+            self._trace_source,
+            k8s_source=self._k8s,
+            use_device_stats=self._use_device_stats,
+            now_ms=self._now_ms,
+            tenant=tenant,
+        )
 
     # -- trace dedup (data_processor.rs:30-73) -------------------------------
 
@@ -263,11 +312,25 @@ class DataProcessor:
             return self._collect_traced(request)
 
     def _collect_traced(self, request: dict) -> dict:
-        t_start = self._now_ms()  # domain time: dedup stamps, req default
-        wall_t0 = time.perf_counter()
+        prep = self.prepare_tick(request)
+        self.merge_prepared(prep)
+        return self.finish_tick(prep)
+
+    def prepare_tick(self, request: dict) -> "_PreparedTick":
+        """The tick's host stages: fetch/dedup/WAL, cluster state, the
+        device-stats dispatch, the dependency walk, and the span-batch
+        build — everything up to (but NOT including) the graph merge.
+        The tenancy router runs prepare for several tenants, stacks their
+        merges into one device dispatch, then finishes each tick; the
+        serial path is prepare -> merge_prepared -> finish_tick."""
+        p = _PreparedTick(request)
+        p.t_start = self._now_ms()  # domain time: dedup stamps, req default
+        p.wall_t0 = time.perf_counter()
         tel_slo.TICKS.inc()
+        t_start = p.t_start
         look_back = request.get("lookBack", 30_000)
         req_time = request.get("time", int(t_start))
+        p.req_time = req_time
         existing_dep = request.get("existingDep")
 
         with step_timer.phase("fetch_traces"), phase_span("parse"):
@@ -330,41 +393,98 @@ class DataProcessor:
                     EndpointDependencies(existing_dep)
                 )
 
-        # feed the persistent device graph (serves the scorer/API path)
+        p.trace_groups = trace_groups
+        p.realtime = realtime
+        p.stats_job = stats_job
+        p.dependencies = dependencies
+        p.window_edges = window_edges
         if trace_groups:
-            with step_timer.phase("graph_merge"), profiling.trace(
-                "graph_merge"
-            ), phase_span("merge"):
-                batch = spans_to_batch(
+            with step_timer.phase("graph_merge"), phase_span("merge"):
+                p.batch = spans_to_batch(
                     trace_groups, interner=self.graph.interner
                 )
-                merged = None
-                if window_edges is not None and _host_edge_merge_enabled():
-                    # reuse the host walk's edge set instead of re-deriving
-                    # it with the packed walk kernel; falls back when an
-                    # endpoint is missing from the graph interner
-                    merged = self.graph.merge_window_edges(
-                        window_edges, batch
-                    )
-                if merged is None:
-                    self.graph.merge_window(batch)
-            self._observe_history(batch, req_time)
+        return p
 
+    def merge_prepared(self, p: "_PreparedTick") -> None:
+        """The tick's graph merge (serial, single-tenant path). No-op if
+        this tick already merged (the router's stacked path adopted a
+        batched lane instead)."""
+        if not p.trace_groups or p.merged:
+            return
+        with step_timer.phase("graph_merge"), profiling.trace(
+            "graph_merge"
+        ), phase_span("merge"):
+            merged = None
+            if p.window_edges is not None and _host_edge_merge_enabled():
+                # reuse the host walk's edge set instead of re-deriving
+                # it with the packed walk kernel; falls back when an
+                # endpoint is missing from the graph interner
+                merged = self.graph.merge_window_edges(
+                    p.window_edges, p.batch
+                )
+            if merged is None:
+                self.graph.merge_window(p.batch)
+        p.merged = True
+        self._observe_history(p.batch, p.req_time)
+
+    def prepare_batched_merge(self, p: "_PreparedTick"):
+        """The interned window columns for the router's stacked merge, or
+        None when this tick cannot join a stack (no spans, no host edge
+        set, the fast path disabled, or an endpoint missing from the
+        interner) — the caller then takes merge_prepared serially."""
+        if (
+            not p.trace_groups
+            or p.merged
+            or p.window_edges is None
+            or not _host_edge_merge_enabled()
+        ):
+            return None
+        return self.graph.intern_window_edges(p.window_edges)
+
+    def adopt_batched_merge(
+        self, p, src_row, dst_row, dist_row, count, cols, expected_version
+    ) -> None:
+        """Adopt this tick's lane of a stacked same-bucket union as its
+        merge (tenancy/router.py). Raises StoreVersionDrift when the
+        graph moved past the stacked snapshot — the router falls back to
+        merge_prepared, which is bit-exact (set union)."""
+        src_l, dst_l, dist_l = cols
+        with step_timer.phase("graph_merge"), phase_span("merge"):
+            self.graph.adopt_batched_merged(
+                src_row,
+                dst_row,
+                dist_row,
+                count,
+                p.batch,
+                max(dist_l),
+                min(dist_l),
+                expected_version=expected_version,
+            )
+        p.merged = True
+        self._observe_history(p.batch, p.req_time)
+
+    def finish_tick(self, p: "_PreparedTick") -> dict:
+        """The tick's response assembly: device-stats drain + host body
+        merge + datatypes, scorecard observation (process-wide and
+        per-tenant), response dict."""
+        request = p.request
+        trace_groups = p.trace_groups
         with step_timer.phase("combine_assemble"), profiling.trace(
             "combine_assemble"
         ):
-            combined = self._combine(realtime, stats_job)
+            combined = self._combine(p.realtime, p.stats_job)
             datatypes = [
                 d.to_json()
                 for d in combined_list_datatypes(combined)
             ]
 
-        elapsed = (time.perf_counter() - wall_t0) * 1000
+        elapsed = (time.perf_counter() - p.wall_t0) * 1000
         tel_slo.SCORECARD.observe_tick(elapsed)
+        tel_slo.TENANTS.observe_tick(self.tenant, elapsed)
         return {
             "uniqueId": request.get("uniqueId", ""),
             "combined": combined.to_json(),
-            "dependencies": dependencies.to_json(),
+            "dependencies": p.dependencies.to_json(),
             "datatype": datatypes,
             "log": (
                 f"processed {sum(len(g) for g in trace_groups)} spans / "
@@ -821,7 +941,7 @@ class DataProcessor:
             if not native.available():
                 raise ValueError("native span loader unavailable")
             reason = res_quarantine.REASON_PARSE_ERROR
-        res_quarantine.default_quarantine().put(raw, reason, source=source)
+        res_quarantine.quarantine_for(self.tenant).put(raw, reason, source=source)
         return reason
 
     def replay_wal(self) -> dict:
@@ -888,7 +1008,7 @@ class DataProcessor:
             # size gate BEFORE the parse: a trace bomb never reaches the
             # native scanner, the interner, or the device
             with phase_span("quarantine"):
-                res_quarantine.default_quarantine().put(
+                res_quarantine.quarantine_for(self.tenant).put(
                     raw,
                     res_quarantine.REASON_TRACE_BOMB,
                     source="ingest_raw_window",
@@ -1082,7 +1202,7 @@ class DataProcessor:
                         break
                     tel_slo.INGEST_PAYLOADS.inc()
                     if quarantine_on and len(raw) > size_cap:
-                        res_quarantine.default_quarantine().put(
+                        res_quarantine.quarantine_for(self.tenant).put(
                             raw,
                             res_quarantine.REASON_TRACE_BOMB,
                             source="ingest_raw_stream",

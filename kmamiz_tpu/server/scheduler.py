@@ -158,7 +158,16 @@ class Scheduler:
         name: str,
         interval: "float | str",
         fn: Callable[[], None],
+        tenant: Optional[str] = None,
     ) -> None:
+        """Register (or replace) a job. A non-default `tenant` namespaces
+        the job name to ``<tenant>/<name>`` (tenancy.isolation
+        tenant_job_name), so per-tenant jobs replace, stop, and streak
+        independently of every other tenant's."""
+        if tenant not in (None, "", "default"):
+            from kmamiz_tpu.tenancy.isolation import tenant_job_name
+
+            name = tenant_job_name(tenant, name)
         job = self._make_job(name, interval, fn)
         existing = self._jobs.get(name)
         if existing is not None:
@@ -181,6 +190,18 @@ class Scheduler:
         for job in self._jobs.values():
             job.stop()
         self._started = False
+
+    def stop_tenant(self, tenant: str) -> None:
+        """Stop and remove ONE tenant's ``<tenant>/``-prefixed jobs and
+        reset their failure streaks, leaving every other tenant's jobs
+        (and the default tenant's unprefixed jobs) running."""
+        if tenant in (None, "", "default"):
+            return
+        prefix = f"{tenant}/"
+        doomed = [n for n in self._jobs if n.startswith(prefix)]
+        for name in doomed:
+            self._jobs.pop(name).stop()
+        res_metrics.reset_job_streaks(prefix=prefix)
 
     @property
     def jobs(self) -> List[str]:

@@ -18,13 +18,15 @@ live regardless (the resilience counters and `/timings` ride on it).
 """
 from .registry import REGISTRY, MetricsRegistry  # noqa: F401
 from .tracing import TRACER, phase_span, telemetry_enabled  # noqa: F401
-from .slo import SCORECARD  # noqa: F401
+from .slo import SCORECARD, TENANTS  # noqa: F401
 from . import device  # noqa: F401  (registers its scrape callback)
 
 
 def reset_for_tests() -> None:
     """Zero all metric values (keeping registered handles live), drop
-    buffered traces, and clear the scorecard window."""
+    buffered traces, and clear the scorecard windows (process-wide and
+    per-tenant, including the tenant-label slug table)."""
     REGISTRY.reset_for_tests()
     TRACER.reset_for_tests()
     SCORECARD.reset_for_tests()
+    TENANTS.reset_for_tests()

@@ -101,3 +101,113 @@ SLO_KEYS_HIGHER_IS_WORSE = (
     "quarantine_rate",
     "recompile_count",
 )
+
+
+# -- per-tenant SLO (tenancy layer) ------------------------------------------
+
+#: per-tenant tick/stale counter families; the tenant label value is
+#: ALWAYS routed through tenant_label() so cardinality stays bounded
+TENANT_TICKS = REGISTRY.counter_family(
+    "kmamiz_tenant_ticks_total", "Collect ticks attempted, per tenant", ("tenant",)
+)
+TENANT_STALE_SERVES = REGISTRY.counter_family(
+    "kmamiz_tenant_stale_serves_total",
+    "Ticks answered from the tenant's last-good graph",
+    ("tenant",),
+)
+
+_TENANT_SERIES_LOCK = threading.Lock()
+# first-seen order of distinct tenant slugs; index < max_tenant_series()
+# keeps its own label, the tail folds into "__other__"
+_TENANT_SLUGS: Dict[str, int] = {}
+
+OTHER_TENANT_LABEL = "__other__"
+
+
+def max_tenant_series() -> int:
+    try:
+        return max(1, int(os.environ.get("KMAMIZ_MAX_TENANT_SERIES", "32")))
+    except ValueError:
+        return 32
+
+
+def tenant_label(tenant: str) -> str:
+    """The metric label value for a tenant: itself for the first
+    KMAMIZ_MAX_TENANT_SERIES distinct tenants this process has seen,
+    "__other__" for the tail. Every tenant-labelled family routes its
+    label through here, so a tenant flood cannot blow up scrape-side
+    cardinality."""
+    with _TENANT_SERIES_LOCK:
+        idx = _TENANT_SLUGS.get(tenant)
+        if idx is None:
+            idx = len(_TENANT_SLUGS)
+            _TENANT_SLUGS[tenant] = idx
+    return tenant if idx < max_tenant_series() else OTHER_TENANT_LABEL
+
+
+class TenantScorecards:
+    """Per-tenant rolling scorecards + counter handles.
+
+    Handles are acquired once per tenant label (cold path, under the
+    lock) and cached — the per-tick observe is a dict hit plus a deque
+    append, so the hot path never formats a label (the
+    hot-path-metric-label discipline; telemetry/ is the one layer
+    allowed to touch handles)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._cards: Dict[str, Scorecard] = {}
+        self._ticks: Dict[str, object] = {}
+        self._stales: Dict[str, object] = {}
+
+    def _slot(self, tenant: str):
+        label = tenant_label(tenant)
+        with self._lock:
+            card = self._cards.get(label)
+            if card is None:
+                card = Scorecard()
+                self._cards[label] = card
+                self._ticks[label] = TENANT_TICKS.handle(label)
+                self._stales[label] = TENANT_STALE_SERVES.handle(label)
+            return label, card
+
+    def observe_tick(self, tenant: str, ms: float) -> None:
+        label, card = self._slot(tenant)
+        card.observe_tick(ms)
+        self._ticks[label].inc()
+
+    def note_stale(self, tenant: str) -> None:
+        label, _card = self._slot(tenant)
+        self._stales[label].inc()
+
+    def snapshot(self) -> Dict[str, Dict[str, float]]:
+        """Per-tenant-label scorecard rows: tick percentiles + tick /
+        stale-serve counts + stale rate."""
+        with self._lock:
+            cards = dict(self._cards)
+        rows: Dict[str, Dict[str, float]] = {}
+        for label, card in sorted(cards.items()):
+            with card._lock:
+                vals = sorted(card._ticks_ms)
+            ticks = self._ticks[label].value
+            stales = self._stales[label].value
+            rows[label] = {
+                "tick_p50_ms": round(percentile(vals, 0.50), 3),
+                "tick_p95_ms": round(percentile(vals, 0.95), 3),
+                "tick_p99_ms": round(percentile(vals, 0.99), 3),
+                "ticks": ticks,
+                "stale_serves": stales,
+                "stale_serve_rate": round(stales / max(1.0, ticks), 6),
+            }
+        return rows
+
+    def reset_for_tests(self) -> None:
+        with self._lock:
+            self._cards.clear()
+            self._ticks.clear()
+            self._stales.clear()
+        with _TENANT_SERIES_LOCK:
+            _TENANT_SLUGS.clear()
+
+
+TENANTS = TenantScorecards()

@@ -1,0 +1,81 @@
+"""Multi-tenant serving: many endpoint graphs, one TPU deployment.
+
+Public surface of the tenancy subsystem (docs/TENANCY.md):
+
+- :mod:`.arena` — the capacity-bucketed ``(tenant, version)`` device
+  arena every EndpointGraph self-registers into; same-bucket tenants
+  share compiled programs and stack into ``[T, cap]`` views.
+- :mod:`.batch` — stacked same-bucket kernels (merge + scorers vmapped
+  over the tenant axis), registered in the program registry.
+- :mod:`.router` — request-time tenant resolution, per-tenant runtimes,
+  and the batched tick dispatcher the DP server mounts.
+- :mod:`.isolation` — per-tenant keying of the resilience edge layers
+  (quarantine dirs, WAL namespaces, breakers, job streaks).
+"""
+from __future__ import annotations
+
+from kmamiz_tpu.tenancy.arena import (
+    DEFAULT_TENANT,
+    ArenaView,
+    TenantArena,
+    TenantLimitError,
+    TenantNameError,
+    default_arena,
+    max_tenants,
+    tenant_shard_enabled,
+    valid_tenant,
+)
+from kmamiz_tpu.tenancy.batch import (
+    batched_merge_edges,
+    batched_service_scores,
+)
+from kmamiz_tpu.tenancy.isolation import (
+    reset_tenant,
+    tenant_breaker,
+    tenant_job_name,
+    tenant_quarantine,
+    tenant_wal,
+)
+from kmamiz_tpu.tenancy.router import (
+    TenantResolutionError,
+    TenantRuntime,
+    TickRouter,
+    batch_window_ms,
+    resolve_tenant,
+    tenant_header,
+)
+
+__all__ = [
+    "DEFAULT_TENANT",
+    "ArenaView",
+    "TenantArena",
+    "TenantLimitError",
+    "TenantNameError",
+    "TenantResolutionError",
+    "TenantRuntime",
+    "TickRouter",
+    "batch_window_ms",
+    "batched_merge_edges",
+    "batched_service_scores",
+    "default_arena",
+    "max_tenants",
+    "reset_for_tests",
+    "reset_tenant",
+    "resolve_tenant",
+    "tenant_breaker",
+    "tenant_header",
+    "tenant_job_name",
+    "tenant_quarantine",
+    "tenant_shard_enabled",
+    "tenant_wal",
+    "valid_tenant",
+]
+
+
+def reset_for_tests() -> None:
+    """Clear the process-wide arena (telemetry reset lives in
+    kmamiz_tpu.telemetry.reset_for_tests, which also clears the
+    per-tenant SLO scorecards)."""
+    from kmamiz_tpu.tenancy import arena
+
+    arena.reset_for_tests()

@@ -1,0 +1,315 @@
+"""Tenant arena: many endpoint graphs, one device, one program set.
+
+A single TPU serving process hosts MANY monitored clusters (tenants),
+each with its own :class:`~kmamiz_tpu.graph.store.EndpointGraph`. The
+arena is the process-wide index over those graphs: an
+``arena[(tenant, version)]`` lookup resolves to an immutable edge-array
+snapshot, and graphs group into *capacity buckets* — the pow2 edge
+capacity their padded arrays occupy. Every hot kernel in the repo is a
+module-level jitted program keyed on shapes, so two tenants in the same
+bucket dispatch the SAME compiled executables: a tenant joining an
+existing bucket triggers zero new steady-state compiles (the
+``tenant_join_compile_count`` bench key pins this).
+
+Same-bucket tenants can also serve as ONE stacked ``[T, cap]`` dispatch
+(`stacked_edges` + ``tenancy.batch`` kernels); when a device mesh is
+deployed and the tenant count divides it, the stacked arrays land
+sharded over the mesh so the tenant axis spreads across chips
+(``KMAMIZ_TENANT_SHARD=0`` disables).
+
+Admission is bounded by ``KMAMIZ_MAX_TENANTS`` (default 64) distinct
+tenant names; graphs are held by weakref so short-lived stores (tests,
+benches) never pin HBM through the arena. ``docs/TENANCY.md`` has the
+full layout.
+"""
+from __future__ import annotations
+
+import os
+import re
+import threading
+import weakref
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+DEFAULT_TENANT = "default"
+
+#: tenant names become directory components (quarantine/WAL namespaces)
+#: and metric label values, so the charset is locked down hard — no
+#:  separators, no dotfiles, bounded length (path-traversal hygiene)
+_TENANT_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]{0,63}$")
+
+
+def valid_tenant(name: str) -> bool:
+    return (
+        isinstance(name, str)
+        and bool(_TENANT_RE.match(name))
+        and ".." not in name
+    )
+
+
+class TenantLimitError(RuntimeError):
+    """Raised when admitting one more DISTINCT tenant would exceed
+    ``KMAMIZ_MAX_TENANTS``."""
+
+
+class TenantNameError(ValueError):
+    """Raised for tenant names outside the safe charset."""
+
+
+def max_tenants() -> int:
+    try:
+        return max(1, int(os.environ.get("KMAMIZ_MAX_TENANTS", "64")))
+    except ValueError:
+        return 64
+
+
+def tenant_shard_enabled() -> bool:
+    return os.environ.get("KMAMIZ_TENANT_SHARD", "1") != "0"
+
+
+class ArenaView(NamedTuple):
+    """Immutable snapshot a ``(tenant, version)`` index resolves to."""
+
+    tenant: str
+    version: int
+    capacity: int
+    src: jnp.ndarray
+    dst: jnp.ndarray
+    dist: jnp.ndarray
+    mask: jnp.ndarray
+
+
+class TenantArena:
+    """Process-wide ``tenant -> EndpointGraph`` registry with
+    capacity-bucket grouping and stacked same-bucket views."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._graphs: "Dict[str, weakref.ref]" = {}
+        # memo of the last stacked view: (tenant, version) tuple -> arrays
+        self._stacked_key: Optional[tuple] = None
+        self._stacked_val: Optional[tuple] = None
+
+    # -- admission -----------------------------------------------------------
+
+    def admit(self, tenant: str, graph) -> None:
+        """Register a tenant's graph. Re-admitting a tenant replaces its
+        graph (latest wins — restarts, tests); a NEW tenant name past the
+        ``KMAMIZ_MAX_TENANTS`` bound raises TenantLimitError."""
+        if not valid_tenant(tenant):
+            raise TenantNameError(f"invalid tenant name: {tenant!r}")
+        with self._lock:
+            self._prune_locked()
+            if tenant not in self._graphs and len(self._graphs) >= max_tenants():
+                raise TenantLimitError(
+                    f"tenant limit reached ({max_tenants()}); "
+                    f"cannot admit {tenant!r}"
+                )
+            self._graphs[tenant] = weakref.ref(graph)
+            self._stacked_key = None
+            self._stacked_val = None
+
+    def _prune_locked(self) -> None:
+        dead = [t for t, r in self._graphs.items() if r() is None]
+        for t in dead:
+            del self._graphs[t]
+
+    def evict(self, tenant: str) -> None:
+        with self._lock:
+            self._graphs.pop(tenant, None)
+            self._stacked_key = None
+            self._stacked_val = None
+
+    # -- lookup --------------------------------------------------------------
+
+    def get(self, tenant: str):
+        with self._lock:
+            ref = self._graphs.get(tenant)
+        return ref() if ref is not None else None
+
+    def tenants(self) -> List[str]:
+        with self._lock:
+            self._prune_locked()
+            return sorted(self._graphs)
+
+    def buckets(self) -> Dict[int, List[str]]:
+        """Capacity bucket -> tenants whose graphs occupy it. Same-bucket
+        tenants share every compiled program and are stackable."""
+        out: Dict[int, List[str]] = {}
+        for tenant in self.tenants():
+            graph = self.get(tenant)
+            if graph is None:
+                continue
+            out.setdefault(graph.capacity, []).append(tenant)
+        return out
+
+    def snapshot(self, tenant: str) -> ArenaView:
+        graph = self.get(tenant)
+        if graph is None:
+            raise KeyError(f"unknown tenant: {tenant!r}")
+        src, dst, dist, mask = graph.edge_arrays()
+        return ArenaView(
+            tenant=tenant,
+            version=graph.version,
+            capacity=int(src.shape[0]),
+            src=src,
+            dst=dst,
+            dist=dist,
+            mask=mask,
+        )
+
+    def __getitem__(self, key: Tuple[str, int]) -> ArenaView:
+        """``arena[(tenant, version)]`` — the versioned index an
+        EndpointGraph now IS: resolves to the snapshot iff the graph
+        still sits at that version, else KeyError (the caller re-reads
+        the current version and re-indexes)."""
+        tenant, version = key
+        view = self.snapshot(tenant)
+        if view.version != int(version):
+            raise KeyError(
+                f"stale index ({tenant!r}, {version}); "
+                f"current version is {view.version}"
+            )
+        return view
+
+    # -- stacked same-bucket views -------------------------------------------
+
+    def stacked_edges(self, tenants: Sequence[str]):
+        """``[T, cap]`` stacked (src, dst, dist, mask) over same-bucket
+        tenants, plus the per-tenant views the stack was built from.
+        Memoized on the ``(tenant, version)`` tuple, so repeated batched
+        reads between merges reuse the device stack. When a mesh is
+        deployed, the tenant count divides it, and sharding is enabled,
+        the stack lands sharded over the mesh's leading axis."""
+        views = [self.snapshot(t) for t in tenants]
+        caps = {v.capacity for v in views}
+        if len(caps) != 1:
+            raise ValueError(f"tenants span capacity buckets: {sorted(caps)}")
+        key = tuple((v.tenant, v.version) for v in views)
+        with self._lock:
+            if key == self._stacked_key and self._stacked_val is not None:
+                return self._stacked_val, views
+        src = jnp.stack([v.src for v in views])
+        dst = jnp.stack([v.dst for v in views])
+        dist = jnp.stack([v.dist for v in views])
+        mask = jnp.stack([v.mask for v in views])
+        sharding = _tenant_sharding(len(views))
+        if sharding is not None:
+            src, dst, dist, mask = (
+                jax.device_put(a, sharding) for a in (src, dst, dist, mask)
+            )
+        stacked = (src, dst, dist, mask)
+        with self._lock:
+            self._stacked_key = key
+            self._stacked_val = stacked
+        return stacked, views
+
+    # -- introspection -------------------------------------------------------
+
+    def summary(self) -> dict:
+        """Per-bucket tenant/byte accounting for /timings and docs."""
+        buckets = {}
+        total_bytes = 0
+        for cap, members in self.buckets().items():
+            byts = 0
+            for t in members:
+                graph = self.get(t)
+                if graph is not None:
+                    byts += sum(graph.arena_bytes().values())
+            total_bytes += byts
+            buckets[str(cap)] = {"tenants": members, "bytes": byts}
+        return {
+            "tenants": len(self.tenants()),
+            "maxTenants": max_tenants(),
+            "buckets": buckets,
+            "bytes": total_bytes,
+        }
+
+    def arena_bytes_by_tenant(self) -> Dict[str, int]:
+        return {
+            t: sum(g.arena_bytes().values())
+            for t in self.tenants()
+            if (g := self.get(t)) is not None
+        }
+
+    def reset_for_tests(self) -> None:
+        with self._lock:
+            self._graphs.clear()
+            self._stacked_key = None
+            self._stacked_val = None
+
+
+def _tenant_sharding(n_tenants: int):
+    """NamedSharding spreading the tenant axis over the deployed mesh,
+    or None when undeployed / indivisible / disabled. The mesh's one
+    axis is named "spans" everywhere in parallel/mesh.py; the stacked
+    tenant dim rides the same axis name."""
+    if not tenant_shard_enabled():
+        return None
+    from kmamiz_tpu.parallel.mesh import active_mesh
+
+    mesh = active_mesh()
+    if mesh is None or n_tenants % mesh.shape["spans"] != 0:
+        return None
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(mesh, P("spans", None))
+
+
+# -- process-wide default arena + per-tenant HBM telemetry -------------------
+
+_DEFAULT_LOCK = threading.Lock()
+_DEFAULT: dict = {"instance": None}
+_TELEMETRY_REGISTERED = False
+
+
+def default_arena() -> TenantArena:
+    """The process-wide arena every EndpointGraph self-registers into."""
+    global _TELEMETRY_REGISTERED
+    with _DEFAULT_LOCK:
+        if _DEFAULT["instance"] is None:
+            _DEFAULT["instance"] = TenantArena()
+        if not _TELEMETRY_REGISTERED:
+            _TELEMETRY_REGISTERED = True
+            _register_arena_telemetry()
+        return _DEFAULT["instance"]
+
+
+def _register_arena_telemetry() -> None:
+    """Scrape-time per-tenant HBM gauges: kmamiz_tenant_arena_bytes
+    {tenant=...}. Pull-only (register_callback) — the merge hot path
+    never touches a label; cardinality is bounded by the SLO layer's
+    tenant_label folding (KMAMIZ_MAX_TENANT_SERIES)."""
+    from kmamiz_tpu.telemetry import REGISTRY
+    from kmamiz_tpu.telemetry.slo import tenant_label
+
+    # graftlint: disable=hot-path-metric-label -- one-time registration, called once per process from default_arena()
+    family = REGISTRY.gauge_family(
+        "kmamiz_tenant_arena_bytes",
+        "Tracked device-arena bytes per tenant graph",
+        ("tenant",),
+    )
+
+    def scrape() -> None:
+        with _DEFAULT_LOCK:
+            arena = _DEFAULT["instance"]
+        if arena is None:
+            return
+        totals: Dict[str, int] = {}
+        for tenant, nbytes in arena.arena_bytes_by_tenant().items():
+            label = tenant_label(tenant)
+            totals[label] = totals.get(label, 0) + nbytes
+        for label, nbytes in totals.items():
+            # graftlint: disable=hot-path-metric-label -- scrape-time pull callback, never on the tick path
+            family.handle(label).set(float(nbytes))
+
+    REGISTRY.register_callback(scrape)
+
+
+def reset_for_tests() -> None:
+    with _DEFAULT_LOCK:
+        instance = _DEFAULT["instance"]
+    if instance is not None:
+        instance.reset_for_tests()

@@ -1,0 +1,87 @@
+"""Stacked same-bucket tenant kernels: one dispatch for T tenants.
+
+Serving N tenants as N serialized single-tenant dispatches pays N
+kernel-launch round trips for work the device could do in one. These
+kernels vmap the existing single-tenant pipelines over a leading tenant
+axis — the SAME ops (variadic lexsort dedup, segment reductions), so
+each tenant's lane is bit-identical to its single-tenant run (pinned in
+tests/test_tenancy.py) — and same-bucket tenants share the one compiled
+program per stacked shape.
+
+Inputs are ``[T, cap]`` stacks from :mod:`kmamiz_tpu.tenancy.arena`
+(`stacked_edges`); when the arena sharded the stack over the deployed
+mesh, XLA partitions the vmapped lanes across chips for free (the tenant
+axis is embarrassingly parallel — no cross-lane collectives anywhere in
+these kernels).
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from kmamiz_tpu.core import programs
+from kmamiz_tpu.ops import scorers as scorer_ops
+from kmamiz_tpu.ops.sortutil import compact_unique
+
+
+def _merge_one(src_a, dst_a, dist_a, mask_a, src_b, dst_b, dist_b, mask_b):
+    """One tenant's lane: the exact body of graph.store._merge_edges
+    (concat + compact_unique), restated here so vmap traces the raw ops
+    instead of re-entering the registered jit proxy."""
+    src = jnp.concatenate([src_a, src_b])
+    dst = jnp.concatenate([dst_a, dst_b])
+    dist = jnp.concatenate([dist_a, dist_b])
+    mask = jnp.concatenate([mask_a, mask_b])
+    (s, d, ds), valid = compact_unique((src, dst, dist), mask)
+    return s, d, ds, valid
+
+
+@programs.register("tenancy.batched_merge_edges")
+@jax.jit
+def batched_merge_edges(
+    src_a, dst_a, dist_a, mask_a, src_b, dst_b, dist_b, mask_b
+):
+    """Union T tenants' window edges into their T stores in ONE dispatch.
+
+    a-side: ``[T, cap]`` stacked store columns (one capacity bucket);
+    b-side: ``[T, wcap]`` stacked window columns (SENTINEL-padded to the
+    group's widest window — extra padding rows are masked out and cannot
+    change any lane's valid unique prefix). Returns per-tenant merged
+    columns, validity, and valid counts ``[T]``."""
+    s, d, ds, valid = jax.vmap(_merge_one)(
+        src_a, dst_a, dist_a, mask_a, src_b, dst_b, dist_b, mask_b
+    )
+    return s, d, ds, valid, valid.sum(axis=1)
+
+
+def _scores_one(src, dst, dist, mask, ep_service, ep_ml, ep_rec, num_services):
+    rows = scorer_ops.edge_direction_tuples(
+        src, dst, dist, mask, ep_service, ep_ml, ep_rec
+    )
+    gw = scorer_ops.gateway_mask(dst, mask, ep_service, ep_rec, num_services)
+    return scorer_ops.score_tuple_rows(*rows, gw, num_services=num_services)
+
+
+@programs.register("tenancy.batched_service_scores")
+@partial(jax.jit, static_argnames=("num_services",))
+def batched_service_scores(
+    src_ep,
+    dst_ep,
+    dist,
+    mask,
+    ep_service,
+    ep_ml,
+    ep_has_record,
+    num_services: int,
+):
+    """scorers.service_scores vmapped over the tenant axis: ``[T, cap]``
+    edge stacks + ``[T, ep_cap]`` endpoint tables -> per-tenant
+    ServiceScores with ``[T, num_services]`` fields. num_services is the
+    batch-wide pow2 service capacity (each tenant reads its own prefix;
+    surplus service lanes score zero — the padded tables carry no edges
+    for them)."""
+    return jax.vmap(
+        partial(_scores_one, num_services=num_services)
+    )(src_ep, dst_ep, dist, mask, ep_service, ep_ml, ep_has_record)

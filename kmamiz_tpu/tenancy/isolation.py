@@ -1,0 +1,79 @@
+"""Per-tenant isolation of the resilience edge layers.
+
+One tenant's poison traffic, overrunning ticks, or flapping upstream
+must not degrade another tenant. The primitives already exist
+(resilience/: quarantine, WAL, breakers, watchdog; telemetry/: SLO
+scorecard) — this module is the one place that keys them by tenant, and
+the contract docs/TENANCY.md spells out:
+
+- quarantine: tenant payloads divert to ``<dir>/tenants/<tenant>`` —
+  the default tenant keeps the exact legacy directory, so a poisoned
+  tenant's files never appear in (or evict from) another tenant's
+  quarantine budget;
+- WAL: tenant logs live under ``<wal-dir>/tenants/<tenant>`` and replay
+  independently (each tenant's graph restores bit-exact after kill -9
+  regardless of what other tenants logged);
+- breakers: ``<tenant>:<upstream>`` registry keys give each tenant its
+  own failure budget for per-tenant upstreams;
+- scheduler job streaks: per-tenant job names (``<tenant>/<job>``)
+  reset coherently when ONE tenant's jobs restart;
+- watchdog / last-good / encoded-payload cache: per-instance state, one
+  instance per TenantRuntime (tenancy/router.py) — tenant A's straggler
+  can trip only tenant A's in-flight-overlap detector.
+
+Tenant names are validated against the arena's safe charset before
+becoming a path component (arena.valid_tenant) — defense in depth on
+top of the router's request-time sanitization.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from kmamiz_tpu.tenancy.arena import DEFAULT_TENANT, TenantNameError, valid_tenant
+
+
+def _check(tenant: str) -> str:
+    if tenant != DEFAULT_TENANT and not valid_tenant(tenant):
+        raise TenantNameError(f"invalid tenant name: {tenant!r}")
+    return tenant
+
+
+def tenant_breaker(name: str, tenant: str = DEFAULT_TENANT, **kwargs):
+    """The tenant-scoped circuit breaker for an upstream (the default
+    tenant shares the legacy process-wide breaker names)."""
+    from kmamiz_tpu.resilience.breaker import get_breaker
+
+    return get_breaker(name, tenant=_check(tenant), **kwargs)
+
+
+def tenant_quarantine(tenant: str = DEFAULT_TENANT):
+    from kmamiz_tpu.resilience.quarantine import quarantine_for
+
+    return quarantine_for(_check(tenant))
+
+
+def tenant_wal(tenant: str = DEFAULT_TENANT):
+    """The tenant's env-configured ingest WAL (None when KMAMIZ_WAL is
+    off)."""
+    from kmamiz_tpu.resilience.wal import IngestWAL
+
+    return IngestWAL.from_env(tenant=_check(tenant))
+
+
+def tenant_job_name(tenant: str, name: str) -> str:
+    """Scheduler job-name namespacing (server/scheduler.py applies the
+    same form for register(..., tenant=...))."""
+    _check(tenant)
+    return name if tenant == DEFAULT_TENANT else f"{tenant}/{name}"
+
+
+def reset_tenant(tenant: str) -> None:
+    """Drop one tenant's resilience state (breakers, quarantine binding,
+    job streaks) without touching any other tenant — the per-tenant
+    analogue of the process-wide reset_for_tests() helpers."""
+    from kmamiz_tpu.resilience import breaker, metrics, quarantine
+
+    _check(tenant)
+    breaker.reset_tenant(tenant)
+    quarantine.drop_tenant(tenant)
+    metrics.reset_job_streaks(prefix=f"{tenant}/")

@@ -1,0 +1,372 @@
+"""Tick router: tenant resolution, per-tenant runtimes, stacked dispatch.
+
+The DP server's request layer asks three things of this module:
+
+1. **Who is this request for?** ``resolve_tenant`` reads the tenant
+   header (``KMAMIZ_TENANT_HEADER``, default ``x-kmamiz-tenant``) or a
+   ``/t/<tenant>/...`` path prefix (the prefix wins), validates the name
+   against the arena's safe charset (tenant names become quarantine/WAL
+   directory components), and hands back the de-prefixed route.
+
+2. **This tenant's serving state.** ``TickRouter.runtime`` lazily
+   creates one :class:`TenantRuntime` per tenant via the factory the DP
+   server supplies — its own DataProcessor (own graph, own WAL
+   namespace, own dedup map), last-good payload, tick watchdog, and
+   encoded-payload cache. Per-instance state IS the isolation: tenant
+   A's straggler trips only A's watchdog, A's stale serve never leaves
+   A's last-good.
+
+3. **Batch what can batch.** ``batched_collect`` runs N tenants' ticks
+   with the per-tenant host stages serial (parse, combine, walk — they
+   hold the GIL anyway) and the device stage STACKED: same-capacity
+   tenants' window unions dispatch as ONE ``tenancy.batched_merge_edges``
+   call over the ``[T, cap]`` arena stack instead of N serialized kernel
+   round trips. Any tenant that can't join a stack (different bucket,
+   no host edge set, version drift) falls back to its bit-exact serial
+   merge. ``submit`` adds an optional leader-elected gather window
+   (``KMAMIZ_TENANT_BATCH_WINDOW_MS``) so concurrent HTTP ticks coalesce
+   into one stacked dispatch.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from kmamiz_tpu.tenancy.arena import (
+    DEFAULT_TENANT,
+    TenantNameError,
+    valid_tenant,
+)
+
+logger = logging.getLogger("kmamiz_tpu.tenancy.router")
+
+
+def tenant_header() -> str:
+    """Header carrying the tenant id (case-insensitive at lookup)."""
+    return os.environ.get("KMAMIZ_TENANT_HEADER", "x-kmamiz-tenant")
+
+
+def batch_window_ms() -> float:
+    """Gather window for coalescing concurrent HTTP ticks into one
+    stacked dispatch; 0 (default) serves every tick directly."""
+    try:
+        return max(0.0, float(os.environ.get("KMAMIZ_TENANT_BATCH_WINDOW_MS", "0")))
+    except ValueError:
+        return 0.0
+
+
+class TenantResolutionError(ValueError):
+    """Unroutable request: malformed tenant name."""
+
+
+def resolve_tenant(headers, path: str) -> Tuple[str, str]:
+    """(tenant, de-prefixed path) for a request. ``/t/<tenant>/...``
+    path routing wins over the header; no signal means the default
+    tenant, so single-tenant deployments never change behavior. Raises
+    TenantResolutionError on names outside the safe charset (they would
+    otherwise become directory components downstream)."""
+    tenant: Optional[str] = None
+    if path.startswith("/t/"):
+        rest = path[3:]
+        tenant, _, tail = rest.partition("/")
+        path = "/" + tail
+        if not tenant:
+            raise TenantResolutionError("empty tenant in /t/ route")
+    else:
+        try:
+            tenant = headers.get(tenant_header())
+        except AttributeError:
+            tenant = None
+    if tenant is None or tenant == "":
+        return DEFAULT_TENANT, path
+    if not valid_tenant(tenant):
+        raise TenantResolutionError(f"invalid tenant name: {tenant!r}")
+    return tenant, path
+
+
+class TenantRuntime:
+    """One tenant's serving state: processor + the per-tenant edge
+    layers. Plain container — the DP server's factory decides the
+    concrete last-good/watchdog/cache objects so this module stays free
+    of server imports."""
+
+    __slots__ = ("tenant", "processor", "last_good", "watchdog", "encoded_cache")
+
+    def __init__(
+        self, tenant, processor, last_good=None, watchdog=None, encoded_cache=None
+    ) -> None:
+        self.tenant = tenant
+        self.processor = processor
+        self.last_good = last_good
+        self.watchdog = watchdog
+        self.encoded_cache = encoded_cache
+
+
+class _PendingTick:
+    __slots__ = ("tenant", "request", "done", "result", "error")
+
+    def __init__(self, tenant: str, request: dict) -> None:
+        self.tenant = tenant
+        self.request = request
+        self.done = threading.Event()
+        self.result: Optional[dict] = None
+        self.error: Optional[BaseException] = None
+
+
+class TickRouter:
+    """Tenant -> runtime registry + the stacked tick dispatcher."""
+
+    def __init__(
+        self,
+        runtime_factory: Callable[[str], TenantRuntime],
+        default_runtime: Optional[TenantRuntime] = None,
+    ) -> None:
+        self._factory = runtime_factory
+        self._lock = threading.RLock()
+        self._runtimes: Dict[str, TenantRuntime] = {}
+        if default_runtime is not None:
+            self._runtimes[DEFAULT_TENANT] = default_runtime
+        # micro-batch gather queue (submit); leader-elected
+        self._q_lock = threading.Lock()
+        self._queue: List[_PendingTick] = []
+        self._leader_active = False
+
+    def runtime(self, tenant: str) -> TenantRuntime:
+        """Get-or-create the tenant's runtime. Creation happens under
+        the registry lock (it replays the tenant's WAL and admits the
+        graph into the arena — racing duplicates would double-replay);
+        steady-state lookups are one dict hit."""
+        if tenant != DEFAULT_TENANT and not valid_tenant(tenant):
+            raise TenantNameError(f"invalid tenant name: {tenant!r}")
+        with self._lock:
+            rt = self._runtimes.get(tenant)
+            if rt is None:
+                rt = self._factory(tenant)
+                self._runtimes[tenant] = rt
+            return rt
+
+    def tenants(self) -> List[str]:
+        with self._lock:
+            return sorted(self._runtimes)
+
+    def summary(self) -> dict:
+        from kmamiz_tpu.tenancy.arena import default_arena
+
+        return {
+            "tenants": self.tenants(),
+            "batchWindowMs": batch_window_ms(),
+            "arena": default_arena().summary(),
+        }
+
+    # -- stacked dispatch ----------------------------------------------------
+
+    def batched_collect(
+        self, requests: Sequence[Tuple[str, dict]]
+    ) -> List[dict]:
+        """Run every (tenant, request) tick, batching same-bucket device
+        merges into one stacked dispatch. Responses come back in request
+        order; each tenant's merged graph is bit-exact with its serial
+        single-tenant path (the stacked kernel is the same dedup-sort
+        vmapped — tests/test_tenancy.py pins this)."""
+        entries = []
+        for tenant, request in requests:
+            rt = self.runtime(tenant)
+            entries.append((rt, rt.processor.prepare_tick(request)))
+
+        groups: Dict[int, List[int]] = {}
+        merge_cols: Dict[int, tuple] = {}
+        serial: List[int] = []
+        for i, (rt, prep) in enumerate(entries):
+            cols = rt.processor.prepare_batched_merge(prep)
+            if cols is None:
+                serial.append(i)
+            else:
+                merge_cols[i] = cols
+                groups.setdefault(rt.processor.graph.capacity, []).append(i)
+
+        for cap, idxs in sorted(groups.items()):
+            if len(idxs) < 2:
+                serial.extend(idxs)
+                continue
+            leftover = self._dispatch_stacked(
+                [entries[i] for i in idxs], [merge_cols[i] for i in idxs]
+            )
+            serial.extend(idxs[j] for j in leftover)
+
+        for i in serial:
+            rt, prep = entries[i]
+            rt.processor.merge_prepared(prep)
+        return [rt.processor.finish_tick(prep) for rt, prep in entries]
+
+    def _dispatch_stacked(self, group, cols_list) -> List[int]:
+        """One stacked merge over a same-capacity group. Returns the
+        group-local indices that must fall back to the serial path (the
+        set union is idempotent, so a post-dispatch fallback re-merging
+        the same window is still bit-exact)."""
+        from kmamiz_tpu.core.spans import _pad_size as _pow2
+        from kmamiz_tpu.graph.store import StoreVersionDrift
+        from kmamiz_tpu.ops.sortutil import SENTINEL
+        from kmamiz_tpu.tenancy import batch as batch_kernels
+        from kmamiz_tpu.tenancy.arena import default_arena
+
+        try:
+            tenants = [rt.tenant for rt, _ in group]
+            (s_src, s_dst, s_dist, s_mask), views = default_arena().stacked_edges(
+                tenants
+            )
+            n = len(group)
+            wcap = _pow2(max(len(c[0]) for c in cols_list), minimum=64)
+            w_src = np.full((n, wcap), SENTINEL, dtype=np.int32)
+            w_dst = np.full((n, wcap), SENTINEL, dtype=np.int32)
+            w_dist = np.full((n, wcap), SENTINEL, dtype=np.int32)
+            for i, (src_l, dst_l, dist_l) in enumerate(cols_list):
+                w_src[i, : len(src_l)] = src_l
+                w_dst[i, : len(dst_l)] = dst_l
+                w_dist[i, : len(dist_l)] = dist_l
+            w_mask = w_src != SENTINEL
+            # explicit device_put (transfer-guard discipline). Pass a
+            # sharding ONLY when the arena stack is mesh-sharded: a
+            # SingleDeviceSharding here would COMMIT the stack, and the
+            # adopted lane slices would then refuse to reshard into the
+            # mesh-sharded scorer path (serial merges keep arrays
+            # uncommitted; the adopted lanes must match)
+            from jax.sharding import NamedSharding
+
+            sharding = getattr(s_src, "sharding", None)
+            if not isinstance(sharding, NamedSharding):
+                sharding = None
+            dev_w = [
+                jax.device_put(a, sharding)
+                for a in (w_src, w_dst, w_dist, w_mask)
+            ]
+            s, d, ds, _v, counts = batch_kernels.batched_merge_edges(
+                s_src, s_dst, s_dist, s_mask, *dev_w
+            )
+            if hasattr(counts, "copy_to_host_async"):
+                counts.copy_to_host_async()
+        except Exception:
+            logger.exception("stacked merge dispatch failed; serial fallback")
+            return list(range(len(group)))
+
+        leftover: List[int] = []
+        for i, (rt, prep) in enumerate(group):
+            try:
+                rt.processor.adopt_batched_merge(
+                    prep,
+                    s[i],
+                    d[i],
+                    ds[i],
+                    counts[i],
+                    cols_list[i],
+                    expected_version=views[i].version,
+                )
+            except StoreVersionDrift:
+                # a concurrent merge landed between snapshot and adopt:
+                # this lane's stacked result is stale — re-merge serially
+                # against the current store (union, so still exact)
+                leftover.append(i)
+            except Exception:
+                logger.exception(
+                    "stacked adopt failed for %s; serial fallback", rt.tenant
+                )
+                leftover.append(i)
+        return leftover
+
+    def batched_service_scores(self, tenants: Sequence[str]):
+        """Stacked service scorers over same-bucket tenants: one
+        ``tenancy.batched_service_scores`` dispatch. Returns the stacked
+        ServiceScores (fields ``[T, num_services]``) plus the per-tenant
+        svc capacities for slicing lanes back out."""
+        import jax.numpy as jnp
+
+        from kmamiz_tpu.core.spans import _pad_size as _pow2
+        from kmamiz_tpu.tenancy import batch as batch_kernels
+
+        inputs = []
+        for t in tenants:
+            graph = self.runtime(t).processor.graph
+            inputs.append(graph._scorer_inputs())
+        caps = {int(i[0].shape[0]) for i in inputs}
+        if len(caps) != 1:
+            raise ValueError(f"tenants span capacity buckets: {sorted(caps)}")
+        ep_cap = max(int(i[4].shape[0]) for i in inputs)
+        svc_cap = _pow2(max(int(i[7]) for i in inputs))
+        svc_caps = [int(i[7]) for i in inputs]
+
+        def pad_to(a, n, fill):
+            a = np.asarray(a)
+            if a.shape[0] == n:
+                return a
+            out = np.full((n,), fill, dtype=a.dtype)
+            out[: a.shape[0]] = a
+            return out
+
+        src = jnp.stack([i[0] for i in inputs])
+        dst = jnp.stack([i[1] for i in inputs])
+        dist = jnp.stack([i[2] for i in inputs])
+        mask = jnp.stack([i[3] for i in inputs])
+        ep_service = jax.device_put(
+            np.stack([pad_to(i[4], ep_cap, 0) for i in inputs])
+        )
+        ep_ml = jax.device_put(
+            np.stack([pad_to(i[5], ep_cap, 0) for i in inputs])
+        )
+        ep_rec = jax.device_put(
+            np.stack([pad_to(i[6], ep_cap, False) for i in inputs])
+        )
+        scores = batch_kernels.batched_service_scores(
+            src, dst, dist, mask, ep_service, ep_ml, ep_rec,
+            num_services=svc_cap,
+        )
+        return scores, svc_caps
+
+    # -- gather-window micro-batching (HTTP coalescing) ----------------------
+
+    def submit(self, tenant: str, request: dict) -> dict:
+        """One tick, coalescing with concurrent submits when the gather
+        window is on: the first arrival becomes the leader, sleeps the
+        window out, and dispatches everything queued behind it as one
+        batched_collect. Window 0 (default) short-circuits to the
+        tenant's direct serial tick."""
+        window = batch_window_ms()
+        if window <= 0:
+            return self.runtime(tenant).processor.collect(request)
+        item = _PendingTick(tenant, request)
+        with self._q_lock:
+            self._queue.append(item)
+            lead = not self._leader_active
+            if lead:
+                self._leader_active = True
+        if lead:
+            time.sleep(window / 1000.0)
+            with self._q_lock:
+                batch, self._queue = self._queue, []
+                self._leader_active = False
+            try:
+                results = self.batched_collect(
+                    [(it.tenant, it.request) for it in batch]
+                )
+                for it, res in zip(batch, results):
+                    it.result = res
+            except BaseException as e:  # noqa: BLE001 - fan the error out
+                for it in batch:
+                    it.error = e
+            finally:
+                for it in batch:
+                    it.done.set()
+        else:
+            # follower: bounded wait, then self-serve (a dying leader
+            # must not wedge every queued tenant behind its window)
+            if not item.done.wait(timeout=window / 1000.0 + 30.0):
+                return self.runtime(tenant).processor.collect(request)
+        if item.error is not None:
+            raise item.error
+        if item.result is None:  # leader never picked us up (shutdown race)
+            return self.runtime(tenant).processor.collect(request)
+        return item.result

@@ -56,13 +56,14 @@ def _reset_resilience_state():
     breakers, counters, the default quarantine binding). A breaker a
     test trips must not short-circuit the next test's upstream calls, so
     every test starts from a clean slate."""
-    from kmamiz_tpu import telemetry
+    from kmamiz_tpu import telemetry, tenancy
     from kmamiz_tpu.resilience import breaker, metrics, quarantine
 
     breaker.reset_for_tests()
     metrics.reset_for_tests()
     quarantine.reset_for_tests()
     telemetry.reset_for_tests()
+    tenancy.reset_for_tests()
     yield
 
 

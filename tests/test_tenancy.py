@@ -1,0 +1,500 @@
+"""Tenancy layer: arena indexing, stacked dispatch, per-tenant isolation.
+
+The load-bearing claims (docs/TENANCY.md):
+
+  (a) an EndpointGraph is an index — arena[(tenant, version)] resolves to
+      its snapshot, same-bucket tenants share compiled programs, and a
+      tenant joining a warm bucket compiles NOTHING new;
+  (b) the stacked batched tick is *bit-exact* with the serial
+      single-tenant path, per tenant;
+  (c) the edge layers do not bleed: poisoning tenant A leaves tenant B's
+      graph bit-exact, non-stale, and B's quarantine/WAL/breaker state
+      untouched.
+"""
+import json
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from kmamiz_tpu.core import programs, spans as spans_mod
+from kmamiz_tpu.core.spans import spans_to_batch
+from kmamiz_tpu.graph.store import EndpointGraph
+from kmamiz_tpu.resilience import metrics as res_metrics
+from kmamiz_tpu.resilience import quarantine as res_quarantine
+from kmamiz_tpu.resilience.breaker import get_breaker, breaker_states
+from kmamiz_tpu.resilience.chaos import graph_signature
+from kmamiz_tpu.server.processor import DataProcessor
+from kmamiz_tpu.server.scheduler import Scheduler
+from kmamiz_tpu.tenancy import (
+    DEFAULT_TENANT,
+    TenantLimitError,
+    TenantNameError,
+    TenantResolutionError,
+    TenantRuntime,
+    TickRouter,
+    default_arena,
+    resolve_tenant,
+    reset_tenant,
+    tenant_job_name,
+)
+from kmamiz_tpu.telemetry import slo as tel_slo
+
+CHAOS_FIXTURES = Path(__file__).parent / "fixtures" / "chaos"
+
+
+def make_processor(pdas_traces, tenant):
+    return DataProcessor(
+        trace_source=lambda look_back, time, limit: [pdas_traces],
+        k8s_source=None,
+        tenant=tenant,
+    )
+
+
+def make_router(pdas_traces):
+    return TickRouter(
+        lambda tenant: TenantRuntime(
+            tenant=tenant, processor=make_processor(pdas_traces, tenant)
+        )
+    )
+
+
+TICK = {"uniqueId": "tick-1", "lookBack": 30000, "time": 1646208339000}
+
+
+# -- (a) arena: versioned index, buckets, admission ---------------------------
+
+
+class TestArena:
+    def test_graph_self_registers_and_indexes(self):
+        g = EndpointGraph(tenant="acme")
+        arena = default_arena()
+        assert arena.get("acme") is g
+        view = arena[("acme", g.version)]
+        assert view.tenant == "acme"
+        assert view.capacity == g.capacity
+        with pytest.raises(KeyError):
+            arena[("acme", g.version + 1)]  # stale index
+
+    def test_same_bucket_tenants_share_a_bucket(self):
+        g1 = EndpointGraph(tenant="a1")
+        g2 = EndpointGraph(tenant="a2")
+        assert g1.capacity == g2.capacity
+        buckets = default_arena().buckets()
+        assert set(buckets[g1.capacity]) >= {"a1", "a2"}
+
+    def test_max_tenants_bounds_admission(self, monkeypatch):
+        monkeypatch.setenv("KMAMIZ_MAX_TENANTS", "2")
+        keep = [EndpointGraph(tenant="t1"), EndpointGraph(tenant="t2")]
+        with pytest.raises(TenantLimitError):
+            EndpointGraph(tenant="t3")
+        # re-admitting an existing tenant is a replace, not a new slot
+        keep.append(EndpointGraph(tenant="t1"))
+
+    @pytest.mark.parametrize(
+        "name", ["", "../etc", "a/b", ".hidden", "x" * 65, "a\nb"]
+    )
+    def test_unsafe_names_rejected(self, name):
+        with pytest.raises(TenantNameError):
+            default_arena().admit(name, EndpointGraph())
+
+    def test_summary_accounts_bytes_per_bucket(self):
+        g = EndpointGraph(tenant="acct")
+        s = default_arena().summary()
+        assert s["tenants"] >= 1
+        bucket = s["buckets"][str(g.capacity)]
+        assert "acct" in bucket["tenants"]
+        assert bucket["bytes"] > 0
+
+
+# -- request routing ----------------------------------------------------------
+
+
+class TestResolveTenant:
+    def test_default_when_unsignalled(self):
+        assert resolve_tenant({}, "/graph") == (DEFAULT_TENANT, "/graph")
+
+    def test_header(self):
+        headers = {"x-kmamiz-tenant": "acme"}
+        assert resolve_tenant(headers, "/graph") == ("acme", "/graph")
+
+    def test_path_prefix_wins_over_header(self):
+        headers = {"x-kmamiz-tenant": "acme"}
+        assert resolve_tenant(headers, "/t/zed/graph") == ("zed", "/graph")
+
+    def test_env_header_name(self, monkeypatch):
+        monkeypatch.setenv("KMAMIZ_TENANT_HEADER", "x-org")
+        assert resolve_tenant({"x-org": "acme"}, "/") == ("acme", "/")
+
+    @pytest.mark.parametrize("bad", ["../up", "a/b", ".dot", "x" * 65])
+    def test_unsafe_names_rejected(self, bad):
+        with pytest.raises(TenantResolutionError):
+            resolve_tenant({"x-kmamiz-tenant": bad}, "/")
+        if "/" not in bad:  # a slash splits into path segments instead
+            with pytest.raises(TenantResolutionError):
+                resolve_tenant({}, f"/t/{bad}/graph")
+
+
+# -- (b) stacked dispatch: bit-exact, zero-compile joins ----------------------
+
+
+class TestBatchedTicks:
+    def test_batched_collect_bitexact_with_serial(self, pdas_traces):
+        router = make_router(pdas_traces)
+        out = router.batched_collect(
+            [("alpha", dict(TICK)), ("beta", dict(TICK))]
+        )
+
+        ref = make_processor(pdas_traces, "ref")
+        ref_resp = ref.collect(dict(TICK))
+
+        for tenant in ("alpha", "beta"):
+            g = router.runtime(tenant).processor.graph
+            assert graph_signature(g) == graph_signature(ref.graph)
+        for resp in out:
+            assert resp["uniqueId"] == TICK["uniqueId"]
+            assert resp["combined"] == ref_resp["combined"]
+            key = lambda d: json.dumps(d, sort_keys=True)
+            assert sorted(map(key, resp["dependencies"])) == sorted(
+                map(key, ref_resp["dependencies"])
+            )
+
+    def test_batched_service_scores_match_serial(self, pdas_traces):
+        router = make_router(pdas_traces)
+        router.batched_collect([("alpha", dict(TICK)), ("beta", dict(TICK))])
+        stacked, svc_caps = router.batched_service_scores(["alpha", "beta"])
+
+        ref = router.runtime("alpha").processor.graph.service_scores_uncached()
+        for lane in range(2):
+            n = svc_caps[lane]
+            for field, ref_field in zip(stacked, ref):
+                got = np.asarray(field)[lane][:n]
+                want = np.asarray(ref_field)[:n]
+                assert np.allclose(got, want), field
+
+    def test_tenant_join_compiles_nothing(self, pdas_traces):
+        """The acceptance gate: after a warm bucket exists, a brand-new
+        tenant's first full tick dispatches only already-compiled
+        programs (shape-keyed module-level jits)."""
+        router = make_router(pdas_traces)
+        router.batched_collect(
+            [("warm1", dict(TICK)), ("warm2", dict(TICK))]
+        )
+        before = programs.summary()["totalCompiles"]
+        router.batched_collect(
+            [("joiner", dict(TICK)), ("warm1", dict(TICK, uniqueId="t2"))]
+        )
+        assert programs.summary()["totalCompiles"] == before
+
+    def test_mixed_buckets_fall_back_serially(self, pdas_traces):
+        """A tenant in a different capacity bucket cannot join the stack
+        but still completes its tick bit-exactly via the serial path."""
+        router = make_router(pdas_traces)
+        big = router.runtime("bigcap").processor
+        # park the tenant in a bigger bucket than everyone else's
+        big.graph = EndpointGraph(tenant="bigcap", capacity=4096)
+
+        out = router.batched_collect(
+            [("alpha", dict(TICK)), ("bigcap", dict(TICK))]
+        )
+        assert [r["uniqueId"] for r in out] == ["tick-1", "tick-1"]
+        assert len(out[1]["combined"]) == len(out[0]["combined"]) == 3
+        ref = make_processor(pdas_traces, "ref2")
+        ref.collect(dict(TICK))
+        assert graph_signature(
+            router.runtime("alpha").processor.graph
+        ) == graph_signature(ref.graph)
+
+    def test_submit_window_coalesces(self, pdas_traces, monkeypatch):
+        import threading
+
+        monkeypatch.setenv("KMAMIZ_TENANT_BATCH_WINDOW_MS", "40")
+        router = make_router(pdas_traces)
+        results = {}
+
+        def run(tenant):
+            results[tenant] = router.submit(tenant, dict(TICK))
+
+        threads = [
+            threading.Thread(target=run, args=(t,)) for t in ("ta", "tb")
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert set(results) == {"ta", "tb"}
+        for resp in results.values():
+            assert resp["uniqueId"] == TICK["uniqueId"]
+            assert len(resp["combined"]) == 3
+
+
+# -- (c) isolation: chaos probe, WAL, breakers, jobs --------------------------
+
+
+def _fake_raw_parser(raw, interner=None, **kw):
+    try:
+        groups = json.loads(raw)
+    except Exception:
+        return None
+    if not isinstance(groups, list) or any(
+        not isinstance(g, list) for g in groups
+    ):
+        return None
+    return spans_to_batch(groups, interner=interner), [
+        g[0].get("traceId") for g in groups if g
+    ]
+
+
+def mk_span(tid, sid, parent=None, svc="svc", url=None):
+    return {
+        "traceId": tid,
+        "id": sid,
+        "parentId": parent,
+        "kind": "SERVER",
+        "name": f"{svc}.ns.svc.cluster.local:80/*",
+        "timestamp": 1_700_000_000_000_000,
+        "duration": 1000,
+        "tags": {
+            "http.method": "GET",
+            "http.status_code": "200",
+            "http.url": url or f"http://{svc}.ns/api",
+            "istio.canonical_revision": "v1",
+            "istio.canonical_service": svc,
+            "istio.mesh_id": "cluster.local",
+            "istio.namespace": "ns",
+        },
+    }
+
+
+def clean_chunks(n_traces=8, prefix="t"):
+    groups = []
+    for t in range(n_traces):
+        tid = f"{prefix}{t}"
+        groups.append(
+            [
+                mk_span(tid, f"{tid}p"),
+                mk_span(
+                    tid,
+                    f"{tid}c",
+                    parent=f"{tid}p",
+                    svc=f"down{t % 3}",
+                    url=f"http://down{t % 3}.ns/api/{t % 2}",
+                ),
+            ]
+        )
+    return [json.dumps([g]).encode() for g in groups]
+
+
+@pytest.fixture
+def raw_dp(monkeypatch, tmp_path):
+    monkeypatch.setattr(spans_mod, "raw_spans_to_batch", _fake_raw_parser)
+    monkeypatch.setenv("KMAMIZ_QUARANTINE_DIR", str(tmp_path / "quarantine"))
+
+    def build(tenant=DEFAULT_TENANT):
+        p = DataProcessor(
+            trace_source=lambda *a: [],
+            use_device_stats=False,
+            tenant=tenant,
+        )
+        p._skipset_locked = lambda: None
+        p._raw_session_locked = lambda: None
+        return p
+
+    return build
+
+
+class TestTenantIsolation:
+    def test_poisoning_a_leaves_b_bitexact_and_unquarantined(
+        self, raw_dp, tmp_path
+    ):
+        """The two-tenant chaos probe: garbage into A diverts to A's
+        quarantine namespace only; B's graph stays bit-exact with a
+        reference that never shared a process with the poison, B's tick
+        path compiles nothing new and serves nothing stale."""
+        dp_a = raw_dp("aaa")
+        dp_b = raw_dp("bbb")
+        chunks = clean_chunks(prefix="iso")
+        poison = (CHAOS_FIXTURES / "truncated-json.bin").read_bytes()
+
+        for raw in chunks:
+            dp_b.ingest_raw_window(raw)
+        out = dp_a.ingest_raw_window(poison)
+        assert out["quarantined"] == 1
+
+        reference = raw_dp("ccc")
+        for raw in chunks:
+            reference.ingest_raw_window(raw)
+
+        compiles_before = programs.summary()["totalCompiles"]
+        assert graph_signature(dp_b.graph) == graph_signature(reference.graph)
+        assert programs.summary()["totalCompiles"] == compiles_before
+
+        # poison landed in A's namespace, nowhere else
+        q_root = tmp_path / "quarantine"
+        assert list((q_root / "tenants" / "aaa").glob("*.bin"))
+        assert not list((q_root / "tenants" / "bbb").glob("*.bin"))
+        assert not list(q_root.glob("*.bin"))  # default tenant untouched
+        per_tenant = res_quarantine.tenant_quarantine_stats()
+        assert per_tenant["aaa"]["count"] == 1
+        assert "bbb" not in per_tenant or per_tenant["bbb"]["count"] == 0
+
+        # B served zero stale ticks
+        rows = tel_slo.TENANTS.snapshot()
+        assert rows.get("bbb", {}).get("stale_serves", 0) == 0
+
+    def test_per_tenant_wal_replays_independently(
+        self, raw_dp, monkeypatch, tmp_path
+    ):
+        monkeypatch.setenv("KMAMIZ_WAL", "1")
+        monkeypatch.setenv("KMAMIZ_WAL_DIR", str(tmp_path / "wal"))
+        chunks_a = clean_chunks(prefix="wa")
+        chunks_b = clean_chunks(prefix="wb")
+
+        crash_a = raw_dp("wta")
+        crash_b = raw_dp("wtb")
+        for raw in chunks_a:
+            crash_a.ingest_raw_window(raw)
+        for raw in chunks_b:
+            crash_b.ingest_raw_window(raw)
+        sig_a = graph_signature(crash_a.graph)
+        sig_b = graph_signature(crash_b.graph)
+        del crash_a, crash_b  # kill -9
+
+        # separate directories on disk
+        assert (tmp_path / "wal" / "tenants" / "wta").is_dir()
+        assert (tmp_path / "wal" / "tenants" / "wtb").is_dir()
+
+        rec_a = raw_dp("wta")
+        rec_b = raw_dp("wtb")
+        replay_a = rec_a.replay_wal()
+        replay_b = rec_b.replay_wal()
+        assert replay_a["replayed"] == len(chunks_a)
+        assert replay_b["replayed"] == len(chunks_b)
+        assert graph_signature(rec_a.graph) == sig_a
+        assert graph_signature(rec_b.graph) == sig_b
+
+    def test_breakers_key_per_tenant(self):
+        b_default = get_breaker("zipkin")
+        b_a = get_breaker("zipkin", tenant="bka")
+        b_b = get_breaker("zipkin", tenant="bkb")
+        assert b_a is not b_b and b_a is not b_default
+        assert get_breaker("zipkin", tenant="bka") is b_a
+
+        for _ in range(b_a.threshold):
+            try:
+                b_a.call(lambda: (_ for _ in ()).throw(RuntimeError("x")))
+            except RuntimeError:
+                pass
+        states = breaker_states()
+        assert states["bka:zipkin"]["state"] == "open"
+        assert states["zipkin"]["state"] == "closed"
+        assert "bkb:zipkin" not in breaker_states(tenant="bka")
+
+        reset_tenant("bka")
+        assert "bka:zipkin" not in breaker_states()
+        assert "bkb:zipkin" in breaker_states()  # other tenant untouched
+
+    def test_scheduler_jobs_namespace_and_stop_per_tenant(self):
+        sched = Scheduler()
+        fired = []
+        sched.register("sync", 3600.0, lambda: fired.append("d"))
+        sched.register("sync", 3600.0, lambda: fired.append("a"), tenant="scha")
+        sched.register("sync", 3600.0, lambda: fired.append("b"), tenant="schb")
+        assert sorted(sched.jobs) == ["scha/sync", "schb/sync", "sync"]
+        assert tenant_job_name("scha", "sync") == "scha/sync"
+        assert tenant_job_name(DEFAULT_TENANT, "sync") == "sync"
+
+        res_metrics.job_failed("scha/sync", RuntimeError("boom"))
+        res_metrics.job_failed("schb/sync", RuntimeError("boom"))
+        sched.stop_tenant("scha")
+        assert sorted(sched.jobs) == ["schb/sync", "sync"]
+        states = res_metrics.job_states()
+        assert "scha/sync" not in states  # streak reset with the jobs
+        assert states["schb/sync"]["consecutiveFailures"] == 1
+
+
+# -- telemetry: bounded tenant label cardinality ------------------------------
+
+
+class TestTenantTelemetry:
+    def test_scorecards_fold_past_series_cap(self, monkeypatch):
+        monkeypatch.setenv("KMAMIZ_MAX_TENANT_SERIES", "2")
+        for i in range(5):
+            tel_slo.TENANTS.observe_tick(f"card{i}", 10.0 + i)
+        rows = tel_slo.TENANTS.snapshot()
+        named = [k for k in rows if k != tel_slo.OTHER_TENANT_LABEL]
+        assert sorted(named) == ["card0", "card1"]
+        assert rows[tel_slo.OTHER_TENANT_LABEL]["ticks"] == 3
+
+    def test_stale_counter_rides_tenant_label(self):
+        tel_slo.TENANTS.observe_tick("stale-t", 5.0)
+        tel_slo.TENANTS.note_stale("stale-t")
+        rows = tel_slo.TENANTS.snapshot()
+        assert rows["stale-t"]["stale_serves"] == 1
+        assert rows["stale-t"]["stale_serve_rate"] == 1.0
+
+
+# -- HTTP layer ---------------------------------------------------------------
+
+
+class TestHTTPTenancy:
+    @pytest.fixture
+    def server(self, pdas_traces):
+        from kmamiz_tpu.server.dp_server import DataProcessorServer
+
+        processor = make_processor(pdas_traces, DEFAULT_TENANT)
+        srv = DataProcessorServer(processor, host="127.0.0.1", port=0)
+        srv.start()
+        yield f"http://127.0.0.1:{srv.port}"
+        srv.stop()
+
+    def _tick(self, base, unique_id, headers=None, path=""):
+        req = urllib.request.Request(
+            base + path,
+            data=json.dumps(
+                {
+                    "uniqueId": unique_id,
+                    "lookBack": 30000,
+                    "time": 1646208339000,
+                }
+            ).encode(),
+            headers={"Content-Type": "application/json", **(headers or {})},
+        )
+        return json.loads(urllib.request.urlopen(req).read())
+
+    def test_header_and_path_routing_isolate_graphs(self, server):
+        r_default = self._tick(server, "d1")
+        r_hdr = self._tick(server, "h1", headers={"x-kmamiz-tenant": "web"})
+        r_path = self._tick(server, "p1", path="/t/mobile/")
+        # same fixture traces -> same combined rows, three separate
+        # graphs: each tenant's first tick sees the spans as new (the
+        # dedup map is per processor)
+        assert len(r_default["combined"]) == 3
+        assert len(r_hdr["combined"]) == 3
+        assert len(r_path["combined"]) == 3
+
+        timings = json.loads(
+            urllib.request.urlopen(f"{server}/timings").read()
+        )
+        assert sorted(timings["tenancy"]["tenants"]) == [
+            "default",
+            "mobile",
+            "web",
+        ]
+        assert set(timings["tenants"]) >= {"default", "mobile", "web"}
+
+    def test_bad_tenant_name_is_400(self, server):
+        with pytest.raises(urllib.error.HTTPError) as err:
+            self._tick(server, "x", headers={"x-kmamiz-tenant": "../up"})
+        assert err.value.code == 400
+
+    def test_tenant_limit_is_429(self, server, monkeypatch):
+        # arena already holds the default tenant's graph; cap there
+        monkeypatch.setenv(
+            "KMAMIZ_MAX_TENANTS", str(len(default_arena().tenants()))
+        )
+        with pytest.raises(urllib.error.HTTPError) as err:
+            self._tick(server, "x", headers={"x-kmamiz-tenant": "overflow"})
+        assert err.value.code == 429

@@ -28,11 +28,15 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from kmamiz_tpu.telemetry.slo import SLO_KEYS_HIGHER_IS_WORSE  # noqa: E402
 
 # bench keys gated alongside the scorecard: the tick-latency headline
-# pair and the 100k-endpoint refresh (ROADMAP item 2)
+# pair, the 100k-endpoint refresh (ROADMAP item 2), and the tenancy
+# pair — the stacked 8-tenant dispatch latency and the join-compile
+# counter (a warm-bucket join must stay at zero compiles)
 _EXTRA_GATED = (
     "dp_tick_ms_2500_traces",
     "dp_tick_cached_ms",
     "graph_refresh_ms_100k",
+    "tenant_batched_tick_ms_8",
+    "tenant_join_compile_count",
 )
 # absolute slack per key class: rates jitter in the 3rd decimal on tiny
 # denominators, recompile counts are integers, latencies get 0.5 ms

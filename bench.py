@@ -1394,6 +1394,65 @@ def main() -> None:
                 f"{type(err).__name__}: {err}"[:300]
             )
 
+    # ---- scenarios: closed-loop soak matrix (ISSUE 8) ----------------------
+    # one fresh subprocess runs the first three archetypes of the seeded
+    # scenario matrix (tools/scenario_soak.py) against a real DP server:
+    # steady chain, cascading fan-out failure, and the multi-tenant mix
+    # (breaker flap + poison storm). The four keys are ALWAYS present
+    # (None on skip/failure) and gated by tools/slo_report.py;
+    # KMAMIZ_BENCH_SCENARIOS=0 skips.
+    scenario_extras = {
+        "scenario_matrix_pass": None,
+        "scenario_worst_p99_tick_ms": None,
+        "scenario_worst_recovery_ms": None,
+        "scenario_lost_spans": None,
+    }
+    try:
+        scenario_budget_ok = (
+            time.perf_counter() - BENCH_T0
+            < int(os.environ.get("KMAMIZ_BENCH_BUDGET_S", 3000)) - 300
+        )
+    except ValueError:
+        scenario_budget_ok = True
+    if (
+        os.environ.get("KMAMIZ_BENCH_SCENARIOS", "1") != "0"
+        and scenario_budget_ok
+    ):
+        import subprocess
+
+        try:
+            out = subprocess.run(
+                [
+                    sys.executable,
+                    "tools/scenario_soak.py",
+                    "--seed",
+                    "0",
+                    "--matrix",
+                    "3",
+                    "--ticks",
+                    "6",
+                ],
+                capture_output=True,
+                text=True,
+                timeout=600,
+            )
+            soak = json.loads(out.stdout.strip().splitlines()[-1])
+            scenario_extras = {
+                "scenario_matrix_pass": soak["scenario_matrix_pass"],
+                "scenario_worst_p99_tick_ms": soak[
+                    "scenario_worst_p99_tick_ms"
+                ],
+                "scenario_worst_recovery_ms": soak[
+                    "scenario_worst_recovery_ms"
+                ],
+                "scenario_lost_spans": soak["scenario_lost_spans"],
+                "scenario_matrix_size": len(soak["scenarios"]),
+            }
+        except Exception as err:  # noqa: BLE001 - extra, not headline
+            scenario_extras["scenario_soak_error"] = (
+                f"{type(err).__name__}: {err}"[:300]
+            )
+
     e2e_extras = {}
     headline = None
     if e2e_phases is not None:
@@ -1538,6 +1597,7 @@ def main() -> None:
         **warm_boot_extras,
         **chaos_extras,
         **tenancy_extras,
+        **scenario_extras,
         "chained_iters": ITERS,
         "tunnel_rtt_ms": round(rtt * 1000, 1),
         "packing_host_ms": round(packing_host_ms, 1),

@@ -719,6 +719,10 @@ _STATE_SCOPES = (
     # the tenancy layer's process-wide registries (arena, per-tenant
     # runtimes, micro-batch queue) take writes from every server thread
     "kmamiz_tpu/tenancy/",
+    # the scenario runner's shared mutables (the completed-run registry,
+    # per-tenant source queues) are written from the driving thread, the
+    # reader thread, and HTTP handler threads of the live soak server
+    "kmamiz_tpu/scenarios/",
 )
 
 

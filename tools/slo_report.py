@@ -28,16 +28,25 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from kmamiz_tpu.telemetry.slo import SLO_KEYS_HIGHER_IS_WORSE  # noqa: E402
 
 # bench keys gated alongside the scorecard: the tick-latency headline
-# pair, the 100k-endpoint refresh (ROADMAP item 2), and the tenancy
-# pair — the stacked 8-tenant dispatch latency and the join-compile
-# counter (a warm-bucket join must stay at zero compiles)
+# pair, the 100k-endpoint refresh (ROADMAP item 2), the tenancy pair —
+# the stacked 8-tenant dispatch latency and the join-compile counter (a
+# warm-bucket join must stay at zero compiles) — and the scenario-soak
+# headline trio (ISSUE 8: worst p99 tick, worst recovery-to-fresh,
+# total lost spans across the always-on matrix)
 _EXTRA_GATED = (
     "dp_tick_ms_2500_traces",
     "dp_tick_cached_ms",
     "graph_refresh_ms_100k",
     "tenant_batched_tick_ms_8",
     "tenant_join_compile_count",
+    "scenario_worst_p99_tick_ms",
+    "scenario_worst_recovery_ms",
+    "scenario_lost_spans",
 )
+# boolean pass/fail keys: any True -> False flip is a regression (bool
+# is an int subclass, so the numeric threshold check would wave a
+# True -> False transition through as 1.0 -> 0.0 "improvement")
+_BOOL_GATED = ("scenario_matrix_pass",)
 # absolute slack per key class: rates jitter in the 3rd decimal on tiny
 # denominators, recompile counts are integers, latencies get 0.5 ms
 _ABS_SLACK_RATE = 0.005
@@ -46,7 +55,11 @@ _ABS_SLACK_MS = 0.5
 
 
 def gated_keys():
-    return ["slo_" + k for k in SLO_KEYS_HIGHER_IS_WORSE] + list(_EXTRA_GATED)
+    return (
+        ["slo_" + k for k in SLO_KEYS_HIGHER_IS_WORSE]
+        + list(_EXTRA_GATED)
+        + list(_BOOL_GATED)
+    )
 
 
 def _abs_slack(key: str) -> float:
@@ -109,6 +122,10 @@ def check(candidate: dict, baseline: dict, threshold: float):
         ):
             continue  # absent on either side: nothing to gate
         compared.append(key)
+        if key in _BOOL_GATED:
+            if bool(old) and not bool(new):
+                regressions.append((key, old, new))
+            continue
         if new > old * (1.0 + threshold) + _abs_slack(key):
             regressions.append((key, old, new))
     return regressions, compared

@@ -890,8 +890,10 @@ def main() -> None:
         return groups
 
     # pre-generate every rep's window OUTSIDE the timed region: the metric
-    # charges only DataProcessor.collect, not test-data synthesis
-    prebuilt = [tick_traces(i) for i in range(17)]
+    # charges only DataProcessor.collect, not test-data synthesis. Four
+    # timed legs below (cold, cached, telemetry-off, prof-off) each burn
+    # 1 warmup + 5 reps = 24 windows.
+    prebuilt = [tick_traces(i) for i in range(24)]
 
     def source(_lb, _t, _lim):
         return prebuilt.pop(0)
@@ -925,6 +927,44 @@ def main() -> None:
             os.environ.pop("KMAMIZ_TELEMETRY", None)
         else:
             os.environ["KMAMIZ_TELEMETRY"] = _tel_prev
+
+    # graftprof overhead proof: the same warm tick with the profiler
+    # event ring gated off (KMAMIZ_PROF=0, tracing still ON). Acceptance:
+    # the prof-on steady tick (dp_tick_cached_ms) within 3% of this.
+    _prof_prev = os.environ.get("KMAMIZ_PROF")
+    os.environ["KMAMIZ_PROF"] = "0"
+    try:
+        dp_tick_prof_off_ms = _timed_median(one_tick, reps=5) * 1000
+    finally:
+        if _prof_prev is None:
+            os.environ.pop("KMAMIZ_PROF", None)
+        else:
+            os.environ["KMAMIZ_PROF"] = _prof_prev
+
+    # per-phase attribution keys from the graftprof host event ring,
+    # ALWAYS present (0.0 when a phase recorded nothing, so slo_report
+    # can gate them across rounds without key-existence special cases).
+    # One small native raw-ingest under a traced tick first, so the
+    # native merge/lock-wait delta events have a sample at the deployed
+    # parse-thread setting.
+    from kmamiz_tpu.telemetry.profiling import events as prof_ring
+    from kmamiz_tpu.telemetry.tracing import TRACER as _PROF_TRACER
+
+    with _PROF_TRACER.tick(root_name="dp-ingest"):
+        try:
+            dp.ingest_raw_window(
+                make_raw_window(200, 10, t_start=990_000, trace_prefix="prof-")
+            )
+        except ValueError:
+            pass  # native loader absent: the prof keys report 0.0
+    prof_phase_keys = {
+        "prof_parse_ms_p95": prof_ring.phase_p95_ms("parse"),
+        "prof_merge_lockwait_ms_p95": prof_ring.phase_p95_ms(
+            "native-merge-lockwait"
+        ),
+        "prof_transfer_ms_p95": prof_ring.phase_p95_ms("host-transfer"),
+        "prof_device_walk_ms_p95": prof_ring.phase_p95_ms("walk"),
+    }
 
     # scorer read path between merges: the first read after a merge
     # computes (full or dirty-incremental), every repeated HTTP read is an
@@ -1588,6 +1628,8 @@ def main() -> None:
         "dp_tick_ms_2500_traces": round(dp_tick_ms, 1),
         "dp_tick_cached_ms": round(dp_tick_cached_ms, 1),
         "dp_tick_telemetry_off_ms": round(dp_tick_telemetry_off_ms, 1),
+        "dp_tick_prof_off_ms": round(dp_tick_prof_off_ms, 1),
+        **prof_phase_keys,
         **slo_extras,
         "dp_scorer_cached_read_ms": round(scorer_cached_read_ms, 3),
         "dp_scorer_cache_hit_rate": scorer_stats.get("hit_rate"),

@@ -1,4 +1,4 @@
-"""The seven shipped graftlint rules.
+"""The eight shipped graftlint rules.
 
 Each rule is a function (module, context) -> [Finding] registered via
 framework.rule(). Shared AST plumbing (jit-site extraction, parent maps,
@@ -929,6 +929,75 @@ def check_hot_path_metric_label(
                         "the hot path: a formatted metric name allocates "
                         "every call and has unbounded cardinality — use a "
                         "preallocated handle",
+                    )
+                )
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# rule 8: hot-path-clock
+# ---------------------------------------------------------------------------
+
+# raw clock reads: each is a syscall-backed read the profiler cannot see.
+# Hot code routes through telemetry/profiling/events.now_ns/now_ms/wall_ms
+# — one blessed, greppable detour that keeps every hot clock swappable
+# (and lets graftprof account for the reads it makes itself).
+_RAW_CLOCKS = {
+    "time.time",
+    "time.time_ns",
+    "time.perf_counter",
+    "time.perf_counter_ns",
+    "time.monotonic",
+    "time.monotonic_ns",
+}
+# bare names from `from time import perf_counter` style imports
+_RAW_CLOCK_BASENAMES = {c.split(".", 1)[1] for c in _RAW_CLOCKS}
+# the sanctioned clock helpers (and the step timer that predates them)
+# necessarily read the raw clocks
+_CLOCK_IMPL_PATHS = (
+    "kmamiz_tpu/telemetry/",
+    "kmamiz_tpu/core/profiling.py",
+)
+
+
+@rule(
+    "hot-path-clock",
+    "hot-path code must read clocks through the graftprof helpers "
+    "(telemetry.profiling.events.now_ns/now_ms/wall_ms), not raw "
+    "time.time()/perf_counter(): raw reads scatter unaccountable timing "
+    "syscalls through the tick and dodge the host event ring",
+)
+def check_hot_path_clock(mod: ModuleInfo, ctx: LintContext) -> List[Finding]:
+    if mod.rel_path.startswith(_CLOCK_IMPL_PATHS):
+        return []
+    # a module that imports the time module under a different alias is
+    # out of scope for the chain match; the common idioms are covered
+    findings: List[Finding] = []
+    bare_clock_imports: Set[str] = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "time":
+            for alias in node.names:
+                if alias.name in _RAW_CLOCK_BASENAMES:
+                    bare_clock_imports.add(alias.asname or alias.name)
+    for suffix, fn_node in _functions(mod):
+        if not ctx.is_hot(f"{mod.rel_path}:{suffix}"):
+            continue
+        for node in _walk_own(fn_node):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = _chain_str(node.func)
+            if chain in _RAW_CLOCKS or (
+                chain in bare_clock_imports and "." not in chain
+            ):
+                findings.append(
+                    Finding(
+                        "hot-path-clock",
+                        mod.rel_path,
+                        node.lineno,
+                        f"raw clock read '{chain}()' on the hot path: "
+                        "route it through the graftprof clock helpers "
+                        "(telemetry.profiling.events.now_ns/now_ms/"
+                        "wall_ms) so tick timing stays attributable",
                     )
                 )
     return findings

@@ -181,6 +181,40 @@ class Settings:
         )
     )  # comma list filtering the storyline vocabulary ("all" = everything)
 
+    # graftprof profiler (kmamiz_tpu/telemetry/profiling/, the
+    # "Profiling" section of docs/OBSERVABILITY.md). The profiling
+    # modules read these env vars directly (the host event ring must
+    # work before any Settings instance exists); the fields mirror them
+    # so one `Settings()` dump shows everything.
+    prof_enabled: bool = field(
+        default_factory=lambda: os.environ.get("KMAMIZ_PROF", "1")
+        not in ("0", "false", "")
+    )  # master gate for the host event ring (re-read once per tick)
+    prof_ring: int = field(
+        default_factory=lambda: int(os.environ.get("KMAMIZ_PROF_RING", "4096"))
+    )  # host event ring capacity, in events (min 64)
+    prof_flight_dir: str = field(
+        default_factory=lambda: os.environ.get(
+            "KMAMIZ_PROF_FLIGHT_DIR", "./kmamiz-data/flight"
+        )
+    )  # flight-recorder crash box for SLO-breach artifacts
+    prof_flight_ticks: int = field(
+        default_factory=lambda: int(
+            os.environ.get("KMAMIZ_PROF_FLIGHT_TICKS", "64")
+        )
+    )  # ticks of evidence frozen into each flight artifact
+    prof_flight_max: int = field(
+        default_factory=lambda: int(os.environ.get("KMAMIZ_PROF_FLIGHT_MAX", "16"))
+    )  # newest artifacts kept; older ones pruned
+    prof_flight_debounce_s: float = field(
+        default_factory=lambda: float(
+            os.environ.get("KMAMIZ_PROF_FLIGHT_DEBOUNCE_S", "5")
+        )
+    )  # min seconds between artifacts (breaker flaps must not flood)
+    profile_max_s: float = field(
+        default_factory=lambda: float(os.environ.get("KMAMIZ_PROFILE_MAX_S", "10"))
+    )  # hard bound on one POST /debug/profile jax.profiler capture
+
     def __post_init__(self) -> None:
         k8s_host = os.environ.get("KUBERNETES_SERVICE_HOST")
         k8s_port = os.environ.get("KUBERNETES_SERVICE_PORT")

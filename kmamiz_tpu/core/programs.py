@@ -47,6 +47,15 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 logger = logging.getLogger("kmamiz_tpu.programs")
 
+# graftprof compile-cause hook: every real compile (cache-entry growth)
+# lands in the device-attribution log with its program name and wall
+# cost. Guarded — the registry must keep working under a partial
+# telemetry install (core is importable before/without telemetry).
+try:
+    from kmamiz_tpu.telemetry.profiling import device_attr as _prof_device_attr
+except Exception:  # noqa: BLE001 - profiling is optional at this layer
+    _prof_device_attr = None
+
 _MAX_HINTS_PER_PROGRAM = 16
 
 _registry_lock = threading.Lock()
@@ -205,8 +214,11 @@ class Program:
                 self.compiles += grew
                 self.compile_ms += elapsed_ms
                 self.last_compile_ms = elapsed_ms
-        if grew and not self._suppress_record:
-            self._record_spec(args, kwargs)
+        if grew:
+            if _prof_device_attr is not None:
+                _prof_device_attr.note_compile(self.name, grew, elapsed_ms)
+            if not self._suppress_record:
+                self._record_spec(args, kwargs)
         return out
 
     def __getattr__(self, item):
@@ -271,7 +283,7 @@ class Program:
         except Exception as e:  # noqa: BLE001 - stale/foreign hint
             logger.warning("%s: undecodable hint (%s)", self.name, e)
             return False
-        t0 = time.perf_counter()
+        t0 = time.perf_counter()  # graftlint: disable=hot-path-clock -- boot-time prewarm accounting, off the tick
         self._suppress_record = True
         try:
             import jax
@@ -286,7 +298,7 @@ class Program:
             self._suppress_record = False
         with self._lock:
             self.prewarmed += 1
-            self.prewarm_ms += (time.perf_counter() - t0) * 1000.0
+            self.prewarm_ms += (time.perf_counter() - t0) * 1000.0  # graftlint: disable=hot-path-clock -- boot-time prewarm accounting, off the tick
         self.adopt_specs([spec])
         return True
 
@@ -554,7 +566,7 @@ def run_prewarm(
     Returns a report dict (also stored in :func:`warm_state`).
     """
     _ensure_registered()
-    t0 = time.perf_counter()
+    t0 = time.perf_counter()  # graftlint: disable=hot-path-clock -- boot-time prewarm accounting, off the tick
     # the native extension's one-time lazy build (or its cached-failure
     # probe) otherwise lands inside the first tick's combine phase — it
     # is boot work, so the plan pays it here alongside the XLA warms
@@ -588,7 +600,7 @@ def run_prewarm(
             report["defaultGraphPrograms"] = graph.prewarm_compile()
         except Exception as e:  # noqa: BLE001 - boot must survive
             logger.warning("default graph prewarm failed: %s", e)
-    report["elapsedS"] = round(time.perf_counter() - t0, 2)
+    report["elapsedS"] = round(time.perf_counter() - t0, 2)  # graftlint: disable=hot-path-clock -- boot-time prewarm accounting, off the tick
     return report
 
 
@@ -600,7 +612,7 @@ def start_background_prewarm(graph=None) -> Optional[threading.Thread]:
         if _warm["status"] in ("warming", "ready", "error"):
             return _warm_thread
         _warm.clear()
-        _warm.update({"status": "warming", "startedAt": time.time()})
+        _warm.update({"status": "warming", "startedAt": time.time()})  # graftlint: disable=hot-path-clock -- boot wall stamp for /health warm state, off the tick
 
     def _run() -> None:
         status = "ready"
@@ -632,7 +644,7 @@ def boot_prewarm_from_env(graph=None) -> None:
         return
     if mode == "sync":
         with _warm_lock:
-            _warm.update({"status": "warming", "startedAt": time.time()})
+            _warm.update({"status": "warming", "startedAt": time.time()})  # graftlint: disable=hot-path-clock -- boot wall stamp for /health warm state, off the tick
         report = run_prewarm(graph=graph)
         with _warm_lock:
             _warm.update({"status": "ready", "report": report})

@@ -17,7 +17,6 @@ from __future__ import annotations
 
 import os
 import threading
-import time
 from functools import partial
 from typing import Optional
 
@@ -36,6 +35,7 @@ from kmamiz_tpu.core.spans import (
     pack_trace_rows,
 )
 from kmamiz_tpu.ops import scorers as scorer_ops
+from kmamiz_tpu.telemetry.profiling import events as prof_events
 from kmamiz_tpu.telemetry.tracing import phase_span
 from kmamiz_tpu.ops import window as window_ops
 from kmamiz_tpu.ops.sortutil import (
@@ -406,12 +406,12 @@ class EndpointGraph:
         here costs nothing — and it makes the copy separable from
         framework work in the ingest accounting (on this dev harness the
         copy rides a ~10 MB/s tunnel; on a TPU VM it is PCIe)."""
-        t0 = time.perf_counter()
+        t0 = prof_events.now_ms()
         # explicit device_put (not jnp.asarray): the implicit-transfer
         # form trips jax.transfer_guard("disallow") on a real TPU
         # graftlint: disable=host-sync-in-hot-path -- transfer accounting: the copy must land before the kernel; blocking IS the measurement
         out = jax.block_until_ready([jax.device_put(a) for a in host_arrays])
-        ms = (time.perf_counter() - t0) * 1000.0
+        ms = prof_events.now_ms() - t0
         self.last_transfer_ms = ms
         step_timer.record("transfer", ms)
         return out, ms
@@ -423,12 +423,12 @@ class EndpointGraph:
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         sh = NamedSharding(mesh, P("spans", None))
-        t0 = time.perf_counter()
+        t0 = prof_events.now_ms()
         # graftlint: disable=host-sync-in-hot-path -- transfer accounting (sharded): same measurement rationale as _to_device
         out = jax.block_until_ready(
             [jax.device_put(a, sh) for a in host_arrays]
         )
-        ms = (time.perf_counter() - t0) * 1000.0
+        ms = prof_events.now_ms() - t0
         self.last_transfer_ms = ms
         step_timer.record("transfer", ms)
         return out, ms
@@ -1230,9 +1230,7 @@ class EndpointGraph:
         fresh = np.ones(ep_cap, dtype=bool)
         deprecated_ms = parse_threshold_ms(settings.deprecated_endpoint_threshold)
         if deprecated_ms:
-            import time as _time
-
-            cutoff = (now_ms if now_ms is not None else _time.time() * 1000) - deprecated_ms
+            cutoff = (now_ms if now_ms is not None else prof_events.wall_ms()) - deprecated_ms
             # under the caller's lock: n_ep cannot outgrow ep_cap here
             n_ep = min(len(self.interner.endpoints), ep_cap)
             self._ensure_ep_arrays(n_ep)

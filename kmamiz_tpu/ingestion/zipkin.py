@@ -9,8 +9,9 @@ from __future__ import annotations
 import gzip
 import json
 import logging
-import time
 import urllib.request
+
+from kmamiz_tpu.telemetry.profiling import events as prof_events
 from typing import List, Optional
 from urllib.parse import urlencode
 
@@ -67,7 +68,7 @@ class ZipkinClient:
         `end_ts` (ZipkinService.ts:44-57). Errors log and return [] like the
         reference's AxiosRequest wrapper (Utils.ts:187-200)."""
         if end_ts is None:
-            end_ts = time.time() * 1000
+            end_ts = prof_events.wall_ms()
         query = urlencode(
             {
                 "serviceName": service_name,
@@ -94,7 +95,7 @@ class ZipkinClient:
         for the native SoA loader (core.spans.raw_spans_to_batch), skipping
         json.loads entirely. None on error."""
         if end_ts is None:
-            end_ts = time.time() * 1000
+            end_ts = prof_events.wall_ms()
         query = urlencode(
             {
                 "serviceName": service_name,
@@ -129,7 +130,7 @@ class ZipkinClient:
         empty or failed pages are skipped, matching get_trace_list_raw's
         log-and-continue error posture."""
         if end_ts is None:
-            end_ts = time.time() * 1000
+            end_ts = prof_events.wall_ms()
         pages = max(1, int(pages))
         page_lb = look_back / pages
         for k in range(pages):

@@ -19,7 +19,6 @@ surface on GET /timings next to PR 1's scorer-cache report.
 from __future__ import annotations
 
 import threading
-import time
 from functools import lru_cache
 from typing import Tuple
 
@@ -28,6 +27,7 @@ import numpy as np
 from kmamiz_tpu.core import programs
 from kmamiz_tpu.core.profiling import step_timer
 from kmamiz_tpu.core.spans import _pad_size
+from kmamiz_tpu.telemetry.profiling import events as prof_events
 from kmamiz_tpu.telemetry.registry import REGISTRY
 
 _lock = threading.Lock()
@@ -96,7 +96,7 @@ def forecast_forward(
     dst_p[:e] = np.asarray(dst, dtype=np.int32)
     mask_p[:e] = np.asarray(mask, dtype=bool)
 
-    t0 = time.perf_counter()
+    t0 = prof_events.now_ms()
     with step_timer.phase("model_forward"):
         # explicit device_put/device_get: the implicit jnp.asarray /
         # np.asarray forms trip jax.transfer_guard("disallow") when the
@@ -113,7 +113,7 @@ def forecast_forward(
         # graftlint: disable=host-sync-in-hot-path -- the route returns host arrays; one fetch per forward
         lat_ms = jax.device_get(lat_ms)[:n]
         prob = jax.device_get(prob)[:n]  # graftlint: disable=host-sync-in-hot-path -- same fetch as the line above
-    elapsed_ms = (time.perf_counter() - t0) * 1000
+    elapsed_ms = prof_events.now_ms() - t0
     _SERVES.inc()
     with _lock:
         _stats["calls"] += 1

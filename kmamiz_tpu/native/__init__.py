@@ -281,6 +281,21 @@ def _open_and_bind() -> Optional[ctypes.CDLL]:
         lib.km_parse_spans_sess.restype = ctypes.c_void_p
         lib.km_free.argtypes = [ctypes.c_void_p]
         lib.km_free.restype = None
+        # graftprof counter exports: OPTIONAL — a prebuilt .so that
+        # predates them must still serve the parse path (prof_counters()
+        # then degrades to the zero snapshot)
+        try:
+            lib.km_prof_snapshot.argtypes = [
+                ctypes.POINTER(ctypes.c_size_t)
+            ]
+            lib.km_prof_snapshot.restype = ctypes.c_void_p
+            lib.km_prof_reset.argtypes = []
+            lib.km_prof_reset.restype = None
+        except AttributeError:
+            logger.warning(
+                "libkmamiz_native.so predates graftprof counters; "
+                "native profiling reports zeros"
+            )
         return lib
     except (OSError, AttributeError) as err:
         logger.warning("native load failed: %s", err)
@@ -289,6 +304,79 @@ def _open_and_bind() -> Optional[ctypes.CDLL]:
 
 def available() -> bool:
     return _load() is not None
+
+
+# -- graftprof native counters (telemetry/profiling) -------------------------
+
+_PROF_SCALARS = (
+    "parses",
+    "spans",
+    "merge_ns",
+    "merge_lock_wait_ns",
+    "merge_queue_depth_peak",
+    "claim_contended",
+    "intern_probes",
+    "intern_hits",
+)
+_PROF_HEADER_LEN = 8 + 8 * len(_PROF_SCALARS)
+
+
+def _prof_zero() -> dict:
+    out = {"available": False, "version": 0, "shards_used": 0, "shards": []}
+    for key in _PROF_SCALARS:
+        out[key] = 0
+    return out
+
+
+def prof_counters() -> dict:
+    """Cumulative graftprof counter snapshot from the native parse/merge
+    pipeline (see km_prof_snapshot in native/kmamiz_spans.cpp).
+
+    Never raises: without the library — or with a stale prebuilt .so
+    missing the symbols — the zero snapshot returns (available=False)."""
+    try:
+        lib = _load()
+        if lib is None or not hasattr(lib, "km_prof_snapshot"):
+            return _prof_zero()
+        out_len = ctypes.c_size_t(0)
+        ptr = lib.km_prof_snapshot(ctypes.byref(out_len))
+        if not ptr:
+            return _prof_zero()
+        try:
+            raw = ctypes.string_at(ptr, out_len.value)
+        finally:
+            lib.km_free(ptr)
+        if len(raw) < _PROF_HEADER_LEN:
+            return _prof_zero()
+        out = _prof_zero()
+        out["available"] = True
+        out["version"], out["shards_used"] = struct.unpack_from("<II", raw, 0)
+        scalars = struct.unpack_from(f"<{len(_PROF_SCALARS)}Q", raw, 8)
+        for key, val in zip(_PROF_SCALARS, scalars):
+            out[key] = val
+        off = _PROF_HEADER_LEN
+        for _ in range(out["shards_used"]):
+            if off + 24 > len(raw):
+                break
+            parse_ns, wait_ns, spans = struct.unpack_from("<3Q", raw, off)
+            out["shards"].append(
+                {"parse_ns": parse_ns, "wait_ns": wait_ns, "spans": spans}
+            )
+            off += 24
+        return out
+    except Exception:  # noqa: BLE001 - profiling must never break ingest
+        return _prof_zero()
+
+
+def prof_reset() -> None:
+    """Zero the native graftprof counters (tests, flight-recorder cuts).
+    No-op without the library or the symbol."""
+    try:
+        lib = _load()
+        if lib is not None and hasattr(lib, "km_prof_reset"):
+            lib.km_prof_reset()
+    except Exception:  # noqa: BLE001 - profiling must never break ingest
+        pass
 
 
 def _call_buffer_fn(fn, payload: bytes, *extra) -> Optional[str]:

@@ -139,6 +139,7 @@ class CircuitBreaker:
             self._state = CLOSED
 
     def record_failure(self) -> None:
+        tripped = False
         with self._lock:
             state = self._state_locked()
             self._stats["failures"] += 1
@@ -149,11 +150,21 @@ class CircuitBreaker:
                     0, self._half_open_inflight - 1
                 )
                 self._trip_locked()
+                tripped = True
             elif (
                 state == CLOSED
                 and self._consecutive_failures >= self.threshold
             ):
                 self._trip_locked()
+                tripped = True
+        if tripped:
+            # breaker open = upstream SLO breach: freeze the graftprof
+            # flight box. OUTSIDE the breaker lock — the recorder walks
+            # telemetry rings and must never extend the admission
+            # critical section (record() debounces and never raises).
+            from kmamiz_tpu.telemetry.profiling import recorder
+
+            recorder.record("breaker-open", self.name)
 
     def _trip_locked(self) -> None:
         self._state = OPEN

@@ -23,10 +23,10 @@ registry callback.
 from __future__ import annotations
 
 import threading
-import time
 from typing import Dict, Optional
 
 from kmamiz_tpu.telemetry import slo as _slo
+from kmamiz_tpu.telemetry.profiling import events as prof_events
 from kmamiz_tpu.telemetry.registry import REGISTRY
 
 _LOCK = threading.Lock()
@@ -109,7 +109,7 @@ def job_failed(name: str, err: BaseException, now_ms: Optional[float] = None) ->
         entry["totalFailures"] += 1
         entry["lastError"] = f"{type(err).__name__}: {err}"[:500]
         entry["lastFailureAt"] = (
-            now_ms if now_ms is not None else time.time() * 1000
+            now_ms if now_ms is not None else prof_events.wall_ms()
         )
 
 
@@ -154,8 +154,14 @@ def watchdog_tripped(reason: str, now_ms: Optional[float] = None) -> None:
         by[reason] = by.get(reason, 0) + 1
         _WATCHDOG["lastTripReason"] = reason
         _WATCHDOG["lastTripAt"] = (
-            now_ms if now_ms is not None else time.time() * 1000
+            now_ms if now_ms is not None else prof_events.wall_ms()
         )
+    # a trip is an SLO breach: freeze the graftprof evidence (lazy import
+    # keeps the resilience layer free of profiling at module load;
+    # record() debounces and never raises)
+    from kmamiz_tpu.telemetry.profiling import recorder
+
+    recorder.record("watchdog", reason)
 
 
 def note_last_good(
@@ -167,7 +173,7 @@ def note_last_good(
         _WATCHDOG["lastGoodVersion"] = int(version)
         _WATCHDOG["lastGoodLabelEpoch"] = int(label_epoch)
         _WATCHDOG["lastGoodAt"] = (
-            now_ms if now_ms is not None else time.time() * 1000
+            now_ms if now_ms is not None else prof_events.wall_ms()
         )
 
 
@@ -189,7 +195,7 @@ def watchdog_state(now_ms: Optional[float] = None) -> dict:
             "staleServes": int(_slo.STALE_SERVES.value),
         }
     if out["lastGoodAt"] is not None:
-        now = now_ms if now_ms is not None else time.time() * 1000
+        now = now_ms if now_ms is not None else prof_events.wall_ms()
         out["lastGoodAgeMs"] = max(0.0, round(now - out["lastGoodAt"], 1))
     return out
 

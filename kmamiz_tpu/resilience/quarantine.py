@@ -30,8 +30,9 @@ import json
 import logging
 import os
 import threading
-import time
 from pathlib import Path
+
+from kmamiz_tpu.telemetry.profiling import events as prof_events
 from typing import Optional
 
 logger = logging.getLogger("kmamiz_tpu.resilience.quarantine")
@@ -158,7 +159,7 @@ class Quarantine:
         metrics.incr(f"quarantined.{reason}")
         try:
             self._dir.mkdir(parents=True, exist_ok=True)
-            stamp = int(time.time() * 1000)
+            stamp = int(prof_events.wall_ms())
             path = self._dir / f"{stamp}-{seq:04d}-{reason}.bin"
             path.write_bytes(raw)
             meta = {

@@ -543,6 +543,16 @@ def _run_scenario_inner(spec: ScenarioSpec, tmpdir: str, verbose: bool) -> dict:
         "pass": all(gates.values()),
         "wall_s": round(time.time() - t_start, 1),
     }
+    if not card["pass"]:
+        # gate failure = reproducible SLO breach under a seeded storyline:
+        # freeze the graftprof flight box (force bypasses KMAMIZ_PROF=0
+        # and the debounce — a failed scenario always leaves evidence)
+        from kmamiz_tpu.telemetry.profiling import recorder
+
+        failed = sorted(g for g, ok in gates.items() if not ok)
+        card["flight_artifact"] = recorder.record(
+            f"scenario-{spec.name}", ",".join(failed), force=True
+        )
     if verbose:
         print(
             f"{spec.name}: pass={card['pass']} gates={gates}",

@@ -20,7 +20,6 @@ import json
 import logging
 import os
 import threading
-import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
@@ -35,6 +34,7 @@ from kmamiz_tpu.resilience.watchdog import (
 from kmamiz_tpu.server.processor import DataProcessor
 from kmamiz_tpu.telemetry import REGISTRY as TEL_REGISTRY
 from kmamiz_tpu.telemetry import TRACER
+from kmamiz_tpu.telemetry.profiling import events as prof_events
 
 logger = logging.getLogger("kmamiz_tpu.dp_server")
 
@@ -54,7 +54,7 @@ class _LastGoodTick:
         self._at_ms: Optional[float] = None
 
     def update(self, payload: dict, version: int, label_epoch: int) -> None:
-        now_ms = time.time() * 1000
+        now_ms = prof_events.wall_ms()
         with self._lock:
             self._payload = payload
             self._at_ms = now_ms
@@ -69,7 +69,7 @@ class _LastGoodTick:
                 return None
             payload = dict(self._payload)
             at_ms = self._at_ms
-        age_ms = max(0.0, time.time() * 1000 - at_ms)
+        age_ms = max(0.0, prof_events.wall_ms() - at_ms)
         payload["uniqueId"] = unique_id
         payload["stale"] = True
         payload["staleAgeMs"] = round(age_ms, 1)
@@ -269,6 +269,13 @@ def make_handler(processor: DataProcessor, router=None):
                 # dependency graph (self-trace)
                 self._send_json(200, TRACER.export_zipkin())
                 return
+            if path == "/debug/graftprof":
+                # the live graftprof profile: per-phase attribution of
+                # recent ticks, native contention counters, device plane
+                from kmamiz_tpu.telemetry.profiling import report as prof_report
+
+                self._send_json(200, prof_report.build_profile())
+                return
             warm = programs.warm_state()
             if (
                 warm.get("status") == "warming"
@@ -441,7 +448,7 @@ def make_handler(processor: DataProcessor, router=None):
             # version-keyed encode memo (per tenant): a retried uniqueId
             # against an unchanged graph re-sends the cached bytes instead
             # of re-encoding the full dependency payload per thread
-            t_enc = time.perf_counter()
+            t_enc = prof_events.now_ms()
             self._send_json(
                 200,
                 response,
@@ -456,7 +463,7 @@ def make_handler(processor: DataProcessor, router=None):
             # tick itself may have run on a watchdog worker thread), so
             # it attaches to the finished trace as a post-hoc span
             TRACER.annotate_last(
-                "encode-serve", (time.perf_counter() - t_enc) * 1000
+                "encode-serve", prof_events.now_ms() - t_enc
             )
 
     Handler.router = router  # tests and embedders reach the tick router here

@@ -12,6 +12,10 @@ Four parts behind one package:
   `POST /debug/profile` jax.profiler capture.
 - `slo`       — the rolling SLO scorecard bench.py emits as headline
   keys and `tools/slo_report.py` gates on.
+- `profiling` — graftprof: the lock-free host event ring, native
+  parse/merge contention counters, device attribution, and the
+  SLO-breach flight recorder (`GET /debug/graftprof`,
+  tools/graftprof.py).
 
 `KMAMIZ_TELEMETRY=0` disables span capture; the metrics registry stays
 live regardless (the resilience counters and `/timings` ride on it).
@@ -20,13 +24,16 @@ from .registry import REGISTRY, MetricsRegistry  # noqa: F401
 from .tracing import TRACER, phase_span, telemetry_enabled  # noqa: F401
 from .slo import SCORECARD, TENANTS  # noqa: F401
 from . import device  # noqa: F401  (registers its scrape callback)
+from . import profiling  # noqa: F401  (registers its scrape callback + hooks)
 
 
 def reset_for_tests() -> None:
     """Zero all metric values (keeping registered handles live), drop
-    buffered traces, and clear the scorecard windows (process-wide and
-    per-tenant, including the tenant-label slug table)."""
+    buffered traces, clear the scorecard windows (process-wide and
+    per-tenant, including the tenant-label slug table), and empty the
+    graftprof planes (event ring, native deltas, device logs)."""
     REGISTRY.reset_for_tests()
     TRACER.reset_for_tests()
     SCORECARD.reset_for_tests()
     TENANTS.reset_for_tests()
+    profiling.reset_for_tests()

@@ -86,22 +86,43 @@ REGISTRY.register_callback(_collect)
 
 # -- on-demand profiler capture (POST /debug/profile) --------------------
 
-_profile_lock = threading.Lock()
 _PROFILES = REGISTRY.counter(
     "kmamiz_profile_captures_total", "On-demand jax.profiler captures"
 )
 
 
+def profile_max_s() -> float:
+    """KMAMIZ_PROFILE_MAX_S: the hard bound on one on-demand capture
+    window (default 10 s) — a fat durationMs must not hold the profiler
+    guard (and the capture thread) for a minute."""
+    try:
+        return max(0.001, float(os.environ.get("KMAMIZ_PROFILE_MAX_S", "10")))
+    except ValueError:
+        return 10.0
+
+
 def capture_profile(duration_ms: int, out_dir: Optional[str] = None) -> dict:
     """Capture a jax.profiler trace for `duration_ms` to `out_dir`
     (default `KMAMIZ_PROFILE_DIR`, else ./kmamiz-data/profiles). Blocks
-    the caller for the capture window; one capture at a time."""
+    the caller for the capture window, clamped to ``KMAMIZ_PROFILE_MAX_S``.
+
+    One profiler session at a time, PROCESS-wide: the guard is shared
+    with `core.profiling.trace` (jax.profiler cannot nest sessions, so a
+    tick-scoped trace and an on-demand capture stacking would raise from
+    inside the tick). A busy guard answers ``busy: True`` — the server
+    maps it to 409."""
+    from kmamiz_tpu.core import profiling as core_profiling
+
     target = out_dir or os.environ.get("KMAMIZ_PROFILE_DIR") or os.path.join(
         "kmamiz-data", "profiles"
     )
-    duration_ms = max(1, min(int(duration_ms), 60_000))
-    if not _profile_lock.acquire(blocking=False):
-        return {"ok": False, "error": "capture already in progress"}
+    duration_ms = max(1, min(int(duration_ms), int(profile_max_s() * 1000)))
+    if not core_profiling._trace_guard.acquire(blocking=False):
+        return {
+            "ok": False,
+            "busy": True,
+            "error": "capture already in progress",
+        }
     try:
         os.makedirs(target, exist_ok=True)
         import jax
@@ -116,4 +137,4 @@ def capture_profile(duration_ms: int, out_dir: Optional[str] = None) -> dict:
     except Exception as exc:  # profiler unavailable on some backends
         return {"ok": False, "error": f"{type(exc).__name__}: {exc}"}
     finally:
-        _profile_lock.release()
+        core_profiling._trace_guard.release()

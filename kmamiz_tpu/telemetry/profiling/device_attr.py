@@ -1,0 +1,200 @@
+"""Device-plane attribution: kernel costs joined back to named programs.
+
+Three sources, all cold-path:
+
+- **Compile-cause log** — `core/programs.py` reports every cache-entry
+  growth (a real XLA compile) via `note_compile`; the ring here keeps
+  the last N causes with program name, wall stamp, and compile ms, so
+  "what recompiled and when" is answerable after the fact.
+- **HBM watermark timeline** — a per-tick sample of the existing device
+  gauges (`telemetry/device.device_memory_stats`), ring-buffered as
+  ``(tick_id, bytes_in_use, peak_bytes)`` — the flight recorder freezes
+  it next to the host events.
+- **jax.profiler trace join** — `parse_profile_dir` walks a capture
+  directory (the `POST /debug/profile` output), aggregates device-op
+  durations from the Chrome-trace/`.trace.json(.gz)` files, and joins
+  ``jit_<name>`` kernels back to `core/programs.py` registry entries,
+  mirrored as `kmamiz_prof_program_device_ms` gauges.
+"""
+from __future__ import annotations
+
+import gzip
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+from ..registry import REGISTRY
+from . import events
+
+_COMPILE_LOG_MAX = 256
+_HBM_MAX = 1024
+
+_lock = threading.Lock()
+_compile_log: deque = deque(maxlen=_COMPILE_LOG_MAX)
+_hbm: deque = deque(maxlen=_HBM_MAX)
+
+_COMPILE_EVENTS = REGISTRY.counter(
+    "kmamiz_prof_compile_events_total",
+    "Compile-cause log entries recorded (program cache growth)",
+)
+_PROG_DEVICE_MS = REGISTRY.gauge_family(
+    "kmamiz_prof_program_device_ms",
+    "Per-program device time from the last joined jax.profiler capture",
+    ("program",),
+)
+
+
+def note_compile(program: str, compiles: int, elapsed_ms: float) -> None:
+    """Compile-cause hook (called by core/programs.Program.__call__ when
+    the jit cache grew). Compiles are cold by definition — the wall
+    stamp is fine here."""
+    entry = {
+        "program": program,
+        "compiles": int(compiles),
+        "ms": round(float(elapsed_ms), 3),
+        "wall_s": round(time.time(), 3),
+        "tick": events._cur_tick,
+    }
+    with _lock:
+        _compile_log.append(entry)
+    _COMPILE_EVENTS.inc()
+    events.emit("compile", int(elapsed_ms * 1e6))
+
+
+def compile_log() -> List[dict]:
+    with _lock:
+        return list(_compile_log)
+
+
+def _sample_hbm(tick_id: int) -> None:
+    """Per-tick HBM watermark sample (events.on_tick_end hook)."""
+    from ..device import device_memory_stats
+
+    stats = device_memory_stats()
+    if not stats:
+        return
+    with _lock:
+        _hbm.append(
+            (
+                int(tick_id),
+                int(stats.get("bytes_in_use", 0) or 0),
+                int(stats.get("peak_bytes_in_use", 0) or 0),
+            )
+        )
+
+
+events.on_tick_end(_sample_hbm)
+
+
+def hbm_timeline() -> List[List[int]]:
+    """(tick_id, bytes_in_use, peak_bytes) rows, oldest first."""
+    with _lock:
+        return [list(row) for row in _hbm]
+
+
+# -- jax.profiler trace join -------------------------------------------------
+
+
+def _iter_trace_files(root: str) -> List[str]:
+    """All .trace.json(.gz) files under a profiler capture directory
+    (jax writes plugins/profile/<ts>/<host>.trace.json.gz)."""
+    found: List[str] = []
+    for dirpath, _dirs, files in os.walk(root):
+        for fname in files:
+            if fname.endswith(".trace.json") or fname.endswith(
+                ".trace.json.gz"
+            ):
+                found.append(os.path.join(dirpath, fname))
+    return sorted(found)
+
+
+def _load_trace_events(path: str) -> List[dict]:
+    try:
+        if path.endswith(".gz"):
+            with gzip.open(path, "rt", encoding="utf-8", errors="replace") as f:
+                doc = json.load(f)
+        else:
+            with open(path, encoding="utf-8", errors="replace") as f:
+                doc = json.load(f)
+    except (OSError, ValueError):
+        return []
+    if isinstance(doc, dict):
+        evs = doc.get("traceEvents", [])
+        return evs if isinstance(evs, list) else []
+    return doc if isinstance(doc, list) else []
+
+
+def _program_names() -> List[str]:
+    try:
+        from kmamiz_tpu.core import programs
+
+        return sorted(programs.all_programs().keys(), key=len, reverse=True)
+    except Exception:  # noqa: BLE001 - attribution without a registry
+        return []
+
+
+def join_kernels_to_programs(
+    kernel_us: Dict[str, float], names: Optional[List[str]] = None
+) -> Dict[str, float]:
+    """Fold per-kernel device microseconds onto registry program names:
+    a kernel named `jit_<prog>...` (or containing `<prog>`) credits
+    `<prog>`; the rest lands under `__unattributed__`. Longest program
+    name wins, so `forecast_forward_v2` never miscredits
+    `forecast_forward`."""
+    if names is None:
+        names = _program_names()
+    out: Dict[str, float] = {}
+    for kernel, us in kernel_us.items():
+        base = kernel[4:] if kernel.startswith("jit_") else kernel
+        target = "__unattributed__"
+        for name in names:
+            if base == name or base.startswith(name) or name in base:
+                target = name
+                break
+        out[target] = out.get(target, 0.0) + float(us)
+    return out
+
+
+def parse_profile_dir(root: str) -> dict:
+    """Aggregate a jax.profiler capture directory into per-program
+    device ms. Tolerant of partial/foreign captures: unparseable files
+    skip, unmatched kernels report as `__unattributed__`."""
+    files = _iter_trace_files(root)
+    kernel_us: Dict[str, float] = {}
+    n_events = 0
+    for path in files:
+        for ev in _load_trace_events(path):
+            if not isinstance(ev, dict) or ev.get("ph") != "X":
+                continue
+            name = ev.get("name")
+            dur = ev.get("dur")
+            if not name or not isinstance(dur, (int, float)):
+                continue
+            kernel_us[name] = kernel_us.get(name, 0.0) + float(dur)
+            n_events += 1
+    programs_us = join_kernels_to_programs(kernel_us)
+    programs_ms = {
+        name: round(us / 1000.0, 3) for name, us in sorted(programs_us.items())
+    }
+    for name, ms in programs_ms.items():
+        if name != "__unattributed__":
+            _PROG_DEVICE_MS.handle(name).set(ms)
+    total_ms = round(sum(programs_us.values()) / 1000.0, 3)
+    return {
+        "files": len(files),
+        "events": n_events,
+        "total_device_ms": total_ms,
+        "unattributed_ms": programs_ms.get("__unattributed__", 0.0),
+        "programs": {
+            k: v for k, v in programs_ms.items() if k != "__unattributed__"
+        },
+    }
+
+
+def reset_for_tests() -> None:
+    with _lock:
+        _compile_log.clear()
+        _hbm.clear()

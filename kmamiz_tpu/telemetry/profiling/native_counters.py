@@ -1,0 +1,110 @@
+"""Native-plane attribution: the C++ parse/merge counters as telemetry.
+
+`native/kmamiz_spans.cpp` keeps cumulative graftprof counters (per-shard
+parse ns, merge lock-wait ns — the barrier skew behind the t2 merge
+wall — merge queue depth, span-id claim contention, intern-table probe
+stats). This module is their Python face:
+
+- `counters()` — the raw snapshot via `native.prof_counters()` (zeros,
+  never raises, when the library or symbols are absent).
+- scrape-time mirror into the `kmamiz_prof_native*` registry families
+  (a `register_callback` collector: the hot path never touches it).
+- `poll(tick_id)` — the per-tick delta hook (events.on_tick_end): when
+  parses happened since the last tick, the merge-time and lock-wait
+  deltas land in the host event ring as `native-merge` /
+  `native-merge-lockwait` events, making the contention wall visible in
+  the same per-tick stream as the host phases.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+from ..registry import REGISTRY
+from . import events
+
+_SCALARS = (
+    "parses",
+    "spans",
+    "merge_ns",
+    "merge_lock_wait_ns",
+    "merge_queue_depth_peak",
+    "claim_contended",
+    "intern_probes",
+    "intern_hits",
+)
+
+_NATIVE = REGISTRY.gauge_family(
+    "kmamiz_prof_native",
+    "graftprof native parse/merge counters (cumulative)",
+    ("counter",),
+)
+_SCALAR_HANDLES = {k: _NATIVE.handle(k) for k in _SCALARS}
+_AVAILABLE = REGISTRY.gauge(
+    "kmamiz_prof_native_available",
+    "1 when libkmamiz_native exports the graftprof counters",
+)
+_SHARD = REGISTRY.gauge_family(
+    "kmamiz_prof_native_shard",
+    "graftprof per-shard stats of the last native parse",
+    ("shard", "field"),
+)
+
+_lock = threading.Lock()
+_last: Dict[str, int] = {}
+
+
+def counters() -> dict:
+    """Cumulative native counter snapshot; the zero snapshot (with
+    available=False) when the native layer cannot serve it."""
+    from kmamiz_tpu import native
+
+    return native.prof_counters()
+
+
+def _collect() -> None:
+    """Scrape-time mirror into the registry (render() callback)."""
+    snap = counters()
+    _AVAILABLE.set(1.0 if snap.get("available") else 0.0)
+    for key, handle in _SCALAR_HANDLES.items():
+        handle.set(float(snap.get(key, 0)))
+    for i, sh in enumerate(snap.get("shards", ())):
+        for field in ("parse_ns", "wait_ns", "spans"):
+            _SHARD.handle(str(i), field).set(float(sh.get(field, 0)))
+
+
+REGISTRY.register_callback(_collect)
+
+
+def poll(tick_id: int = 0) -> None:
+    """Per-tick delta poll: emit native merge/lock-wait deltas into the
+    host event ring. One ctypes snapshot per tick, nothing per span."""
+    snap = counters()
+    if not snap.get("available"):
+        return
+    with _lock:
+        prev = dict(_last)
+        for key in ("parses", "merge_ns", "merge_lock_wait_ns"):
+            _last[key] = int(snap.get(key, 0))
+    d_parses = int(snap.get("parses", 0)) - prev.get("parses", 0)
+    if d_parses <= 0:
+        return
+    d_merge = int(snap.get("merge_ns", 0)) - prev.get("merge_ns", 0)
+    d_wait = int(snap.get("merge_lock_wait_ns", 0)) - prev.get(
+        "merge_lock_wait_ns", 0
+    )
+    if d_merge >= 0:
+        events.emit("native-merge", d_merge)
+    if d_wait >= 0:
+        events.emit("native-merge-lockwait", d_wait)
+
+
+events.on_tick_end(poll)
+
+
+def reset_for_tests() -> None:
+    from kmamiz_tpu import native
+
+    with _lock:
+        _last.clear()
+    native.prof_reset()

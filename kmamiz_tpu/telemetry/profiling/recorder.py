@@ -1,0 +1,166 @@
+"""SLO-breach flight recorder: freeze the evidence when serving degrades.
+
+When the tick watchdog fires, an upstream circuit breaker opens, or a
+scenario gate fails, `record(trigger)` snapshots the last-N-ticks of
+host events, the span-trace ring, the SLO scorecard rows (process-wide
+and per-tenant), the native graftprof counters, the compile-cause log,
+and the HBM watermark timeline into one JSON artifact under
+``KMAMIZ_PROF_FLIGHT_DIR`` — the crash-box an operator (or the scenario
+runner's stderr table) opens *after* the incident, instead of trying to
+reproduce it.
+
+Discipline: `record` never raises, debounces trigger storms
+(``KMAMIZ_PROF_FLIGHT_DEBOUNCE_S``, breaker flaps would otherwise write
+hundreds of artifacts), keeps bounded retention
+(``KMAMIZ_PROF_FLIGHT_MAX`` newest artifacts survive), and writes
+atomically (tmp + rename) so a reader never sees a torn file. Trigger
+sites import this module lazily — the resilience layer must not pay for
+profiling at import time.
+"""
+from __future__ import annotations
+
+import itertools
+import json
+import logging
+import os
+import re
+import threading
+import time
+from typing import Optional
+
+from . import events
+
+logger = logging.getLogger("kmamiz_tpu.telemetry.profiling")
+
+ARTIFACT_KIND = "kmamiz-flight"
+ARTIFACT_VERSION = 1
+
+_lock = threading.Lock()
+_last_dump_monotonic = 0.0
+_seq = itertools.count(1)
+
+_SAFE_TRIGGER = re.compile(r"[^A-Za-z0-9_.-]+")
+
+
+def flight_dir() -> str:
+    return os.environ.get("KMAMIZ_PROF_FLIGHT_DIR") or os.path.join(
+        "kmamiz-data", "flight"
+    )
+
+
+def flight_ticks() -> int:
+    try:
+        return max(1, int(os.environ.get("KMAMIZ_PROF_FLIGHT_TICKS", "64")))
+    except ValueError:
+        return 64
+
+
+def flight_max() -> int:
+    try:
+        return max(1, int(os.environ.get("KMAMIZ_PROF_FLIGHT_MAX", "16")))
+    except ValueError:
+        return 16
+
+
+def _debounce_s() -> float:
+    try:
+        return max(
+            0.0, float(os.environ.get("KMAMIZ_PROF_FLIGHT_DEBOUNCE_S", "5"))
+        )
+    except ValueError:
+        return 5.0
+
+
+def build_artifact(trigger: str, detail: str = "") -> dict:
+    """The flight artifact dict (separate from I/O so tests and
+    /debug/graftprof can inspect it without touching disk)."""
+    from .. import slo, tracing
+    from . import device_attr, native_counters
+
+    keep = flight_ticks()
+    return {
+        "kind": ARTIFACT_KIND,
+        "version": ARTIFACT_VERSION,
+        "trigger": trigger,
+        "detail": detail,
+        "wall_s": round(time.time(), 3),
+        "flight_ticks": keep,
+        "events": [list(e) for e in events.snapshot(last_ticks=keep)],
+        "traces": [
+            {
+                "traceId": tb.trace_id,
+                "wallUs": tb.wall_us,
+                "status": tb.status,
+                "spans": [list(s) for s in tb.spans],
+            }
+            for tb in tracing.TRACER.traces()[-keep:]
+        ],
+        "scorecard": slo.SCORECARD.snapshot(),
+        "tenants": slo.TENANTS.snapshot(),
+        "native": native_counters.counters(),
+        "compileLog": device_attr.compile_log(),
+        "hbmTimeline": device_attr.hbm_timeline(),
+    }
+
+
+def record(
+    trigger: str, detail: str = "", force: bool = False
+) -> Optional[str]:
+    """Dump a flight artifact; returns its path, or None when skipped
+    (profiling off, debounced) or failed. NEVER raises — the trigger
+    sites are the resilience layer's own failure paths."""
+    try:
+        return _record(trigger, detail, force)
+    except Exception as exc:  # noqa: BLE001 - recorder must not re-fail a failure path
+        logger.warning("flight recorder dump failed: %s", exc)
+        return None
+
+
+def _record(trigger: str, detail: str, force: bool) -> Optional[str]:
+    global _last_dump_monotonic
+    events.refresh_from_env()
+    if not events.prof_enabled() and not force:
+        return None
+    now = time.monotonic()
+    with _lock:
+        if not force and (now - _last_dump_monotonic) < _debounce_s():
+            return None
+        _last_dump_monotonic = now
+        seq = next(_seq)
+    artifact = build_artifact(trigger, detail)
+    out_dir = flight_dir()
+    os.makedirs(out_dir, exist_ok=True)
+    slug = _SAFE_TRIGGER.sub("-", trigger) or "trigger"
+    fname = f"flight-{int(time.time() * 1000):013d}-{seq:04d}-{slug}.json"
+    path = os.path.join(out_dir, fname)
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(artifact, f, separators=(",", ":"))
+    os.replace(tmp, path)
+    _prune(out_dir)
+    return path
+
+
+def _prune(out_dir: str) -> None:
+    """Bounded retention: keep the newest flight_max() artifacts (the
+    timestamped names sort chronologically)."""
+    try:
+        names = sorted(
+            n
+            for n in os.listdir(out_dir)
+            if n.startswith("flight-") and n.endswith(".json")
+        )
+    except OSError:
+        return
+    for stale in names[: -flight_max()] if len(names) > flight_max() else []:
+        try:
+            os.remove(os.path.join(out_dir, stale))
+        except OSError:
+            pass
+
+
+def reset_for_tests() -> None:
+    global _last_dump_monotonic, _seq
+    with _lock:
+        _last_dump_monotonic = 0.0
+        _seq = itertools.count(1)

@@ -29,6 +29,7 @@ from collections import deque
 from contextlib import contextmanager
 from typing import List, Optional, Tuple
 
+from .profiling import events as prof_events
 from .registry import REGISTRY
 
 # span taxonomy: canonical phase names (docs/OBSERVABILITY.md)
@@ -37,6 +38,7 @@ PHASES = (
     "quarantine",
     "wal-append",
     "merge",
+    "assemble",
     "pack",
     "host-transfer",
     "walk",
@@ -133,6 +135,7 @@ class TickTracer:
             trace_id = f"graftscope-{self._seq}"
         builder = _TraceBuilder(trace_id, root_name)
         self._tls.builder = builder
+        prof_events.note_tick_start()
         try:
             yield builder
         finally:
@@ -140,6 +143,7 @@ class TickTracer:
             builder.close()
             with self._lock:
                 self._ring.append(builder)
+            prof_events.note_tick_end(root_name, builder.spans[0][2])
 
     @contextmanager
     def span(self, name: str):
@@ -169,6 +173,7 @@ class TickTracer:
             _rn, rstart, rdur, _rp = tb.spans[0]
             start = rstart + (rdur if rdur >= 0 else 0)
             tb.spans.append((name, start, max(0, int(dur_ms * 1e6)), 0))
+        prof_events.emit(name, max(0, int(dur_ms * 1e6)))
         h = SPAN_HANDLES.get(name)
         if h is not None:
             h.observe(dur_ms)
@@ -253,6 +258,7 @@ def phase_span(name: str):
         yield
     finally:
         builder.close_span(idx)
+        _n, _s, dur_ns, _p = builder.spans[idx]
+        prof_events.emit(name, dur_ns)
         if h is not None:
-            _n, _s, dur_ns, _p = builder.spans[idx]
             h.observe(dur_ns / 1e6)

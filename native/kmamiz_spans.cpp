@@ -104,6 +104,38 @@ inline void cpu_relax() {
 #endif
 }
 
+// -- graftprof native counters ----------------------------------------------
+// Cumulative attribution counters for the parse/merge pipeline, snapshotted
+// over the ctypes boundary by km_prof_snapshot (telemetry/profiling reads
+// them once per tick). Shard-granular: per-worker parse time and the time
+// each worker then spent waiting at the assemble barrier for the slowest
+// shard ("merge lock-wait" — the t2 contention wall as a per-shard number).
+// Writers flush under g_prof.mu once per parse; the per-span hot loops only
+// bump thread-local/table-local counters.
+
+constexpr uint32_t kProfMaxShards = 64;  // pick_threads caps at 64
+constexpr uint32_t kProfWireVersion = 1;
+
+struct ProfCounters {
+  std::mutex mu;
+  // cumulative scalars (since load or km_prof_reset)
+  uint64_t parses = 0;
+  uint64_t spans = 0;
+  uint64_t merge_ns = 0;            // assemble wall time
+  uint64_t merge_lock_wait_ns = 0;  // sum of per-shard barrier waits
+  uint64_t merge_queue_depth_peak = 0;  // max shards pending at assemble
+  uint64_t claim_contended = 0;     // span-id CAS losses + row spin entries
+  uint64_t intern_probes = 0;       // shape/status intern slot inspections
+  uint64_t intern_hits = 0;         // interns resolved to an existing id
+  // last parse, per shard
+  uint32_t shards_used = 0;
+  uint64_t shard_parse_ns[kProfMaxShards] = {0};
+  uint64_t shard_wait_ns[kProfMaxShards] = {0};
+  uint64_t shard_spans[kProfMaxShards] = {0};
+};
+
+ProfCounters g_prof;
+
 // -- arena for decoded (escaped) strings ------------------------------------
 
 struct Arena {
@@ -443,6 +475,7 @@ struct SvMap {
   };
   std::vector<Slot> slots;
   size_t mask = 0, count = 0;
+  mutable uint64_t probes = 0, hits = 0;  // graftprof intern stats
 
   explicit SvMap(size_t initial = 64) {
     size_t n = 16;
@@ -478,7 +511,11 @@ struct SvMap {
     uint64_t h = key_hash(key);
     size_t j = h & mask;
     while (slots[j].hash) {
-      if (slot_eq(slots[j], h, key)) return &slots[j].val;
+      ++probes;
+      if (slot_eq(slots[j], h, key)) {
+        ++hits;
+        return &slots[j].val;
+      }
       j = (j + 1) & mask;
     }
     return nullptr;
@@ -493,7 +530,9 @@ struct SvMap {
     uint64_t h = key_hash(key);
     size_t j = h & mask;
     while (slots[j].hash) {
+      ++probes;
       if (slot_eq(slots[j], h, key)) {
+        ++hits;
         *inserted = false;
         return slots[j].val;
       }
@@ -548,6 +587,7 @@ struct ShapeTable {
   std::vector<int32_t> slot_id;
   std::vector<uint64_t> slot_hash;
   size_t mask;
+  uint64_t probes = 0, hits = 0;  // graftprof intern stats
 
   ShapeTable() : slot_id(256, -1), slot_hash(256, 0), mask(255) {}
 
@@ -580,8 +620,11 @@ struct ShapeTable {
     if (shapes.size() * 2 >= mask) grow();
     size_t j = h & mask;
     while (slot_id[j] >= 0) {
-      if (slot_hash[j] == h && shape_eq(shapes[slot_id[j]], s))
+      ++probes;
+      if (slot_hash[j] == h && shape_eq(shapes[slot_id[j]], s)) {
+        ++hits;
         return slot_id[j];
+      }
       j = (j + 1) & mask;
     }
     int32_t id = static_cast<int32_t>(shapes.size());
@@ -1257,6 +1300,8 @@ struct ThreadOut {
   Arena arena;
   bool ok = true;
   uint64_t busy_us = 0;
+  uint64_t done_us = 0;  // graftprof: when this worker's parse finished
+  uint64_t intern_probes = 0, intern_hits = 0;  // graftprof intern stats
 
   size_t size() const { return ids.size(); }
 
@@ -1592,7 +1637,10 @@ void parse_range(const std::vector<GroupRange>& kept, size_t g0, size_t g1,
       break;
     }
   }
-  to->busy_us = now_us() - t0;
+  to->intern_probes += status_map.probes;
+  to->intern_hits += status_map.hits;
+  to->done_us = now_us();
+  to->busy_us = to->done_us - t0;
 }
 
 // -- phase 3: shared span-id table with atomic claims -----------------------
@@ -1623,8 +1671,10 @@ struct SpanIdTable {
   }
 
   // returns -1 when `row` claimed the slot, else the slot index of the
-  // existing claim (a duplicate id)
-  int64_t claim(sv key, uint64_t h, int32_t row, const sv* ids) {
+  // existing claim (a duplicate id). `contended` (graftprof) counts CAS
+  // losses and row spin-wait entries — cross-shard claim contention.
+  int64_t claim(sv key, uint64_t h, int32_t row, const sv* ids,
+                uint64_t* contended = nullptr) {
     size_t j = h & mask;
     for (;;) {
       uint64_t cur = slots[j].hash.load(std::memory_order_acquire);
@@ -1635,11 +1685,16 @@ struct SpanIdTable {
           return -1;
         }
         // lost the race; cur now holds the winner's hash -- fall through
+        if (contended != nullptr) ++*contended;
       }
       if (cur == h) {
-        int32_t r;
-        while ((r = slots[j].row.load(std::memory_order_acquire)) < 0)
-          cpu_relax();
+        int32_t r = slots[j].row.load(std::memory_order_acquire);
+        if (r < 0) {
+          if (contended != nullptr) ++*contended;
+          do {
+            cpu_relax();
+          } while ((r = slots[j].row.load(std::memory_order_acquire)) < 0);
+        }
         const sv& k = ids[r];
         // empty ids carry nullptr data; memcmp(nullptr, ..., 0) is UB
         if (k.size() == key.size() &&
@@ -1678,9 +1733,10 @@ struct SpanIdTable {
 constexpr size_t kPrefetchBlock = 32;
 
 // insert rows [r0, r1) into the table in prefetched blocks; duplicate
-// claims append (slot, row) to `dups`
+// claims append (slot, row) to `dups`; `contended` counts claim races
 void build_table_range(SpanIdTable& tab, const sv* ids, size_t r0, size_t r1,
-                       std::vector<std::pair<int64_t, int32_t>>* dups) {
+                       std::vector<std::pair<int64_t, int32_t>>* dups,
+                       uint64_t* contended) {
   uint64_t hashes[kPrefetchBlock];
   for (size_t b = r0; b < r1; b += kPrefetchBlock) {
     size_t e = b + kPrefetchBlock < r1 ? b + kPrefetchBlock : r1;
@@ -1691,7 +1747,7 @@ void build_table_range(SpanIdTable& tab, const sv* ids, size_t r0, size_t r1,
     }
     for (size_t i = b; i < e; ++i) {
       int64_t slot = tab.claim(ids[i], hashes[i - b],
-                               static_cast<int32_t>(i), ids);
+                               static_cast<int32_t>(i), ids, contended);
       if (slot >= 0) dups->emplace_back(slot, static_cast<int32_t>(i));
     }
   }
@@ -1764,6 +1820,20 @@ void assemble(std::vector<ThreadOut>& outs, PrescanResult&& ps,
   size_t n = 0;
   for (auto& t : outs) n += t.size();
   as->n = n;
+
+  // graftprof: fold each worker's shape-table probe stats into its
+  // ThreadOut — and pin its span count — before the columns/tables
+  // move/merge below (the single-worker path moves them out wholesale)
+  std::vector<uint64_t> shard_sizes(outs.size(), 0);
+  for (size_t ti = 0; ti < outs.size(); ++ti) {
+    ThreadOut& t = outs[ti];
+    shard_sizes[ti] = t.size();
+    t.intern_probes += t.shapes.probes;
+    t.intern_hits += t.shapes.hits;
+    // zero the table's own stats so a move into as->shapes (single-worker
+    // path) can't double-count them in the final flush
+    t.shapes.probes = t.shapes.hits = 0;
+  }
 
   if (outs.size() == 1) {
     // single worker: its tables ARE the global tables (ids assigned in
@@ -1846,8 +1916,10 @@ void assemble(std::vector<ThreadOut>& outs, PrescanResult&& ps,
 
   SpanIdTable table(n);
   std::vector<std::vector<std::pair<int64_t, int32_t>>> dup_lists(n_threads);
+  std::vector<uint64_t> claim_contended(n_threads, 0);
   if (n_threads <= 1 || n < 4096) {
-    build_table_range(table, ids.data(), 0, n, &dup_lists[0]);
+    build_table_range(table, ids.data(), 0, n, &dup_lists[0],
+                      &claim_contended[0]);
   } else {
     std::vector<std::thread> ths;
     size_t per = (n + n_threads - 1) / n_threads;
@@ -1855,7 +1927,7 @@ void assemble(std::vector<ThreadOut>& outs, PrescanResult&& ps,
       size_t r0 = t * per, r1 = std::min(n, r0 + per);
       if (r0 >= r1) break;
       ths.emplace_back(build_table_range, std::ref(table), ids.data(), r0,
-                       r1, &dup_lists[t]);
+                       r1, &dup_lists[t], &claim_contended[t]);
     }
     for (auto& th : ths) th.join();
   }
@@ -1972,6 +2044,47 @@ void assemble(std::vector<ThreadOut>& outs, PrescanResult&& ps,
 
   as->ok = true;
   as->merge_us = static_cast<uint32_t>(now_us() - m0);
+
+  // graftprof flush: one locked update per parse. Per-shard "merge
+  // lock-wait" is the barrier skew — how long each finished worker sat
+  // waiting for the slowest shard before assemble could start (zero in
+  // sequential mode, where done_us never gets set by parse_range's twin).
+  {
+    uint64_t done_max = 0;
+    for (auto& t : outs) done_max = std::max(done_max, t.done_us);
+    uint64_t contended = 0;
+    for (uint64_t c : claim_contended) contended += c;
+    std::lock_guard<std::mutex> g(g_prof.mu);
+    g_prof.parses += 1;
+    g_prof.spans += n;
+    g_prof.merge_ns += static_cast<uint64_t>(as->merge_us) * 1000;
+    g_prof.claim_contended += contended;
+    g_prof.intern_probes += as->shapes.probes;
+    g_prof.intern_hits += as->shapes.hits;
+    uint64_t pending = outs.size();
+    if (pending > g_prof.merge_queue_depth_peak)
+      g_prof.merge_queue_depth_peak = pending;
+    g_prof.shards_used =
+        static_cast<uint32_t>(std::min<size_t>(outs.size(), kProfMaxShards));
+    for (uint32_t ti = 0; ti < kProfMaxShards; ++ti) {
+      if (ti < outs.size()) {
+        ThreadOut& t = outs[ti];
+        uint64_t wait_us =
+            (t.done_us != 0 && done_max > t.done_us) ? done_max - t.done_us
+                                                     : 0;
+        g_prof.shard_parse_ns[ti] = t.busy_us * 1000;
+        g_prof.shard_wait_ns[ti] = wait_us * 1000;
+        g_prof.shard_spans[ti] = shard_sizes[ti];
+        g_prof.merge_lock_wait_ns += wait_us * 1000;
+        g_prof.intern_probes += t.intern_probes;
+        g_prof.intern_hits += t.intern_hits;
+      } else {
+        g_prof.shard_parse_ns[ti] = 0;
+        g_prof.shard_wait_ns[ti] = 0;
+        g_prof.shard_spans[ti] = 0;
+      }
+    }
+  }
 }
 
 unsigned pick_threads(int requested) {
@@ -2347,6 +2460,64 @@ unsigned char* km_parse_spans(const char* skip_blob, size_t skip_len,
                               const char* json, size_t json_len,
                               size_t* out_len) {
   return km_parse_spans_mt(skip_blob, skip_len, json, json_len, 0, out_len);
+}
+
+// -- graftprof counter snapshot ---------------------------------------------
+// Wire (little-endian, km_free to release):
+//   u32 version, u32 shards_used,
+//   u64 parses, spans, merge_ns, merge_lock_wait_ns,
+//       merge_queue_depth_peak, claim_contended, intern_probes, intern_hits,
+//   then shards_used * (u64 parse_ns, u64 wait_ns, u64 spans)
+unsigned char* km_prof_snapshot(size_t* out_len) {
+  *out_len = 0;
+  std::lock_guard<std::mutex> g(g_prof.mu);
+  size_t sz = 8 + 8 * 8 + static_cast<size_t>(g_prof.shards_used) * 24;
+  unsigned char* buf = static_cast<unsigned char*>(std::malloc(sz));
+  if (buf == nullptr) return nullptr;
+  unsigned char* w = buf;
+  auto w_u32 = [&](uint32_t v) {
+    std::memcpy(w, &v, 4);
+    w += 4;
+  };
+  auto w_u64 = [&](uint64_t v) {
+    std::memcpy(w, &v, 8);
+    w += 8;
+  };
+  w_u32(kProfWireVersion);
+  w_u32(g_prof.shards_used);
+  w_u64(g_prof.parses);
+  w_u64(g_prof.spans);
+  w_u64(g_prof.merge_ns);
+  w_u64(g_prof.merge_lock_wait_ns);
+  w_u64(g_prof.merge_queue_depth_peak);
+  w_u64(g_prof.claim_contended);
+  w_u64(g_prof.intern_probes);
+  w_u64(g_prof.intern_hits);
+  for (uint32_t ti = 0; ti < g_prof.shards_used; ++ti) {
+    w_u64(g_prof.shard_parse_ns[ti]);
+    w_u64(g_prof.shard_wait_ns[ti]);
+    w_u64(g_prof.shard_spans[ti]);
+  }
+  *out_len = sz;
+  return buf;
+}
+
+void km_prof_reset() {
+  std::lock_guard<std::mutex> g(g_prof.mu);
+  g_prof.parses = 0;
+  g_prof.spans = 0;
+  g_prof.merge_ns = 0;
+  g_prof.merge_lock_wait_ns = 0;
+  g_prof.merge_queue_depth_peak = 0;
+  g_prof.claim_contended = 0;
+  g_prof.intern_probes = 0;
+  g_prof.intern_hits = 0;
+  g_prof.shards_used = 0;
+  for (uint32_t ti = 0; ti < kProfMaxShards; ++ti) {
+    g_prof.shard_parse_ns[ti] = 0;
+    g_prof.shard_wait_ns[ti] = 0;
+    g_prof.shard_spans[ti] = 0;
+  }
 }
 
 // group-aligned split points for streaming ingest: walks the top-level

@@ -2,6 +2,8 @@
 twin (server/processor.py) gets flagged for, but unreachable from the
 tick/serve seeds, so the call-graph gating must produce ZERO findings
 here (the fixture test asserts exact equality, which covers this)."""
+import time
+
 import jax
 import jax.numpy as jnp
 
@@ -11,6 +13,11 @@ def export_report(arr):
     host = jax.device_get(dev)  # cold path: fine
     dev.block_until_ready()  # cold path: fine
     return float(dev.sum()), host.item()  # cold path: fine
+
+
+def export_timing():
+    t0 = time.perf_counter()  # cold path: fine
+    return time.time() - t0  # cold path: fine
 
 
 def export_metrics(counters, reason):

@@ -4,6 +4,9 @@ place in the call graph; parsed by the linter, never imported).
 Violation lines carry EXPECT markers naming their rule; the test
 computes the expected finding set from them and requires exact equality.
 """
+import time
+from time import perf_counter
+
 import numpy as np
 
 import jax
@@ -70,6 +73,22 @@ def tick_metrics(registry, counters, reason):
 
 def tick_metrics_suppressed(counters, reason):
     counters.incr(f"drops.{reason}")  # graftlint: disable=hot-path-metric-label -- fixture: suppressed on purpose
+
+
+def tick_timed(batch):
+    t0 = time.perf_counter()  # EXPECT: hot-path-clock
+    stamp = time.time()  # EXPECT: hot-path-clock
+    t1 = perf_counter()  # EXPECT: hot-path-clock
+    return batch, t0, t1, stamp
+
+
+def tick_timed_suppressed(batch):
+    return batch, time.time()  # graftlint: disable=hot-path-clock -- fixture: suppressed on purpose
+
+
+def tick_timed_clean(batch, prof_events):
+    t0 = prof_events.now_ms()  # sanctioned graftprof clock helper: fine
+    return batch, prof_events.wall_ms() - t0
 
 
 def tick_metrics_clean(counters):

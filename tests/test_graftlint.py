@@ -28,6 +28,7 @@ ALL_RULES = {
     "donation-miss",
     "unguarded-shared-state",
     "hot-path-metric-label",
+    "hot-path-clock",
 }
 
 
@@ -68,6 +69,7 @@ class TestFixtureCorpus:
         assert sup == {
             ("kmamiz_tpu/server/processor.py", "host-sync-in-hot-path"),
             ("kmamiz_tpu/server/processor.py", "hot-path-metric-label"),
+            ("kmamiz_tpu/server/processor.py", "hot-path-clock"),
             ("kmamiz_tpu/server/state.py", "unguarded-shared-state"),
         }
 
@@ -111,7 +113,7 @@ class TestFrameworkMechanics:
     def test_render_text_counts(self, corpus_result):
         text = framework.render_text(corpus_result)
         assert f"{len(corpus_result.findings)} finding(s)" in text
-        assert "3 suppressed" in text
+        assert "4 suppressed" in text
 
     def test_all_rules_registered(self):
         assert set(framework.all_rules()) == ALL_RULES

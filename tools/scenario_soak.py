@@ -71,6 +71,10 @@ def _table(cards) -> str:
             f"recompiles={c['steady_recompiles']} "
             f"wall={c['wall_s']}s{'  ' + str(fails) if fails else ''}"
         )
+        if c.get("flight_artifact"):
+            # the frozen graftprof evidence for this failure
+            # (tools/graftprof.py <path> renders it)
+            lines.append(f"{'':<{width}}  flight: {c['flight_artifact']}")
     return "\n".join(lines)
 
 

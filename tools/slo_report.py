@@ -25,6 +25,9 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from kmamiz_tpu.telemetry.profiling.report import (  # noqa: E402
+    DEFAULT_THRESHOLDS as _PROF_THRESHOLDS,
+)
 from kmamiz_tpu.telemetry.slo import SLO_KEYS_HIGHER_IS_WORSE  # noqa: E402
 
 # bench keys gated alongside the scorecard: the tick-latency headline
@@ -42,6 +45,13 @@ _EXTRA_GATED = (
     "scenario_worst_p99_tick_ms",
     "scenario_worst_recovery_ms",
     "scenario_lost_spans",
+    # graftprof per-phase attribution p95s (bench always emits them,
+    # 0.0 when a phase had no samples) — a per-phase regression fails
+    # the round even when the headline tick medians stay flat
+    "prof_parse_ms_p95",
+    "prof_merge_lockwait_ms_p95",
+    "prof_transfer_ms_p95",
+    "prof_device_walk_ms_p95",
 )
 # boolean pass/fail keys: any True -> False flip is a regression (bool
 # is an int subclass, so the numeric threshold check would wave a
@@ -52,6 +62,17 @@ _BOOL_GATED = ("scenario_matrix_pass",)
 _ABS_SLACK_RATE = 0.005
 _ABS_SLACK_COUNT = 1.0
 _ABS_SLACK_MS = 0.5
+# per-phase relative thresholds for the prof_* keys: shared with
+# tools/graftprof.py --diff so a phase regresses at the same bar whether
+# gated per-round here or artifact-vs-artifact there. Where a phase has
+# its own threshold (merge lock-wait jitters most) it overrides the
+# CLI-wide --threshold.
+_PROF_KEY_PHASE = {
+    "prof_parse_ms_p95": "parse",
+    "prof_merge_lockwait_ms_p95": "native-merge-lockwait",
+    "prof_transfer_ms_p95": "host-transfer",
+    "prof_device_walk_ms_p95": "walk",
+}
 
 
 def gated_keys():
@@ -126,7 +147,14 @@ def check(candidate: dict, baseline: dict, threshold: float):
             if bool(old) and not bool(new):
                 regressions.append((key, old, new))
             continue
-        if new > old * (1.0 + threshold) + _abs_slack(key):
+        rel = threshold
+        phase = _PROF_KEY_PHASE.get(key)
+        if phase is not None:
+            rel = max(
+                rel,
+                _PROF_THRESHOLDS.get(phase, _PROF_THRESHOLDS["default"]),
+            )
+        if new > old * (1.0 + rel) + _abs_slack(key):
             regressions.append((key, old, new))
     return regressions, compared
 

@@ -27,6 +27,10 @@ DEFAULT_SEED_MODULES = (
     "kmamiz_tpu/server/processor.py",
     "kmamiz_tpu/server/dp_server.py",
     "kmamiz_tpu/models/serving.py",
+    # the STLGT continual trainer runs inside the tick's fold path and
+    # its quantile forward inside the forecast route — both hot
+    "kmamiz_tpu/models/stlgt/trainer.py",
+    "kmamiz_tpu/models/stlgt/serving.py",
 )
 
 

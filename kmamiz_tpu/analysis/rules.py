@@ -723,6 +723,10 @@ _STATE_SCOPES = (
     # per-tenant source queues) are written from the driving thread, the
     # reader thread, and HTTP handler threads of the live soak server
     "kmamiz_tpu/scenarios/",
+    # the STLGT continual trainer's ring/stale/params state is written
+    # from the processor's fold path while /model/forecast and
+    # /model/stlgt read it from server threads
+    "kmamiz_tpu/models/stlgt/",
 )
 
 

@@ -8,7 +8,12 @@ features the realtime tick produces online (DataProcessor._observe_history
 
 - `GET /model/status` — checkpoint metadata + feature freshness.
 - `GET /model/forecast` — per-endpoint anomaly probability and predicted
-  latency for the upcoming hour.
+  latency for the upcoming hour. With the STLGT continual trainer live
+  (KMAMIZ_STLGT=1, docs/STLGT.md) the route grows `?quantile=` (p50|
+  p95|p99|all) and `?horizon=` (hours) parameters and a `stlgt` payload
+  section: per-endpoint latency quantiles plus the top per-edge
+  attribution scores; with no checkpoint configured the live STLGT
+  params serve the legacy shape too (model "stlgt-live").
 
 Configuration: KMAMIZ_MODEL_DIR points at a trainer checkpoint directory
 (models/checkpoint.py). Only identity-free heads serve here (num_nodes=0
@@ -174,11 +179,45 @@ class ModelHandler(IRequestHandler):
             }
         return Response(payload=payload)
 
+    #: quantile selector values the route accepts (column order matches
+    #: models/stlgt/model.QUANTILES)
+    _QUANTILE_COLS = {"p50": 0, "p95": 1, "p99": 2}
+    #: attribution edges returned per forecast (highest STLGT edge gate)
+    _TOP_EDGES = 20
+
     def _forecast(self, req: Request) -> Response:
+        # live STLGT params (continual trainer's last-good) serve the
+        # quantile surface — and the whole route when no checkpoint is
+        # configured; a checkpointed head alone serves the legacy shape
+        from kmamiz_tpu.models import stlgt as stlgt_pkg
+
+        live = stlgt_pkg.serving_params()
         loaded = self._load()
-        if loaded is None:
+        if loaded is None and live is None:
             return Response(
                 status=503, payload={"error": self._load_error}
+            )
+        qsel = (req.query.get("quantile") or "all").lower()
+        if qsel != "all" and qsel not in self._QUANTILE_COLS:
+            return Response(
+                status=400,
+                payload={
+                    "error": f"unknown quantile {qsel!r} "
+                    "(p50|p95|p99|all)"
+                },
+            )
+        horizon = req.query_int("horizon") or 1
+        horizon = max(1, min(int(horizon), 24))
+        if (qsel != "all" or horizon != 1) and live is None:
+            # the quantile/horizon surface is STLGT's: without a
+            # refreshed trainer there is no last-good to fall back to
+            return Response(
+                status=503,
+                payload={
+                    "error": "quantile/horizon forecasts need the STLGT "
+                    "continual trainer (KMAMIZ_STLGT=1) to have completed "
+                    "a refresh"
+                },
             )
         dp = self._ctx.processor
         # ONE attribute read: the fold publishes features + matching
@@ -202,8 +241,18 @@ class ModelHandler(IRequestHandler):
         # discipline — with snapshot identity as both tiebreak and
         # fallback for restored snapshots that predate the key.
         snap_key = snap.get("cache_key") or id(snap)
+        # the memo key grows the STLGT dimensions: a trainer refresh
+        # (params version bump) or a different quantile/horizon selection
+        # must recompute, while same-key polls stay memoized with zero
+        # forwards and zero compiles
+        memo_key = (
+            snap_key,
+            live["version"] if live is not None else 0,
+            qsel,
+            horizon,
+        )
         cached = self._forecast_cache
-        if cached is not None and (cached[0] is snap or cached[4] == snap_key):
+        if cached is not None and cached[4] == memo_key:
             # pre-encoded (and pre-gzipped) bytes ride the response so
             # polls skip both the ~1 MB json.dumps and the per-request
             # gzip; .payload stays for in-process dispatch consumers
@@ -211,29 +260,106 @@ class ModelHandler(IRequestHandler):
                 payload=cached[1], raw_body=cached[2], raw_gzip=cached[3]
             )
         feats = snap["features"]
-        params, meta, model = loaded
-        if feats.shape[1] != int(meta["num_features"]):
-            return Response(
-                status=409,
-                payload={
-                    "error": (
-                        # graftlint: disable=shape-hazard -- 409 reject payload, a diagnostic not a cache key
-                        f"feature width {feats.shape[1]} != checkpoint's "
-                        f"{meta['num_features']} (train with the matching "
-                        "feature layout)"
-                    )
-                },
-            )
-        from kmamiz_tpu.models import serving
-
         names = snap["names"]
-        # bucket-padded jitted forward (models/serving.py): the compiled
-        # program is keyed by pow2 capacity buckets, so a growing endpoint
-        # set recompiles O(log N) times instead of every fold; timings
-        # land on /timings as model_forward + modelServe
-        lat_ms, prob = serving.forecast_forward(
-            params, feats, snap["src"], snap["dst"], snap["mask"], model
-        )
+
+        stlgt_section = None
+        q_ms = s_prob = gate = None
+        if live is not None:
+            from kmamiz_tpu.models.stlgt import serving as stlgt_serving
+
+            q_ms, s_prob, gate = stlgt_serving.quantile_forward(
+                live["params"],
+                feats,
+                snap["src"],
+                snap["dst"],
+                snap["mask"],
+                live["model"],
+            )
+            if horizon > 1:
+                # multi-hour horizon: widen the tail spread by the
+                # independent-increments heuristic (sqrt scaling of the
+                # above-median excess; docs/STLGT.md#horizon) — p50 is
+                # carried flat, the tail columns grow
+                scale = float(np.sqrt(horizon))
+                q_ms = q_ms.copy()
+                q_ms[:, 1:] = q_ms[:, :1] + (
+                    q_ms[:, 1:] - q_ms[:, :1]
+                ) * scale
+            cols = (
+                self._QUANTILE_COLS
+                if qsel == "all"
+                else {qsel: self._QUANTILE_COLS[qsel]}
+            )
+            stlgt_endpoints = [
+                {
+                    "uniqueEndpointName": names[i],
+                    "anomalyProbability": round(float(s_prob[i]), 4),
+                    "latencyQuantilesMs": {
+                        level: round(float(max(q_ms[i, c], 0.0)), 2)
+                        for level, c in cols.items()
+                    },
+                }
+                for i in np.argsort(-s_prob)
+            ]
+            edge_mask = np.asarray(snap["mask"], dtype=bool)
+            src_ids = np.asarray(snap["src"])
+            dst_ids = np.asarray(snap["dst"])
+            n = len(names)
+            attributions = []
+            for e in np.argsort(-gate):
+                if len(attributions) >= self._TOP_EDGES:
+                    break
+                e = int(e)
+                if not edge_mask[e]:
+                    continue
+                s, d = int(src_ids[e]), int(dst_ids[e])
+                if s >= n or d >= n:
+                    continue
+                attributions.append(
+                    {
+                        "source": names[s],
+                        "target": names[d],
+                        "score": round(float(gate[e]), 4),
+                    }
+                )
+            stlgt_section = {
+                "paramsVersion": live["version"],
+                "quantile": qsel,
+                "horizon": horizon,
+                "quantileLevels": list(live["quantiles"]),
+                "endpoints": stlgt_endpoints,
+                "attributions": attributions,
+            }
+
+        if loaded is not None:
+            params, meta, model = loaded
+            if feats.shape[1] != int(meta["num_features"]):
+                return Response(
+                    status=409,
+                    payload={
+                        "error": (
+                            # graftlint: disable=shape-hazard -- 409 reject payload, a diagnostic not a cache key
+                            f"feature width {feats.shape[1]} != checkpoint's "
+                            f"{meta['num_features']} (train with the matching "
+                            "feature layout)"
+                        )
+                    },
+                )
+            from kmamiz_tpu.models import serving
+
+            # bucket-padded jitted forward (models/serving.py): the compiled
+            # program is keyed by pow2 capacity buckets, so a growing endpoint
+            # set recompiles O(log N) times instead of every fold; timings
+            # land on /timings as model_forward + modelServe
+            lat_ms, prob = serving.forecast_forward(
+                params, feats, snap["src"], snap["dst"], snap["mask"], model
+            )
+            model_name = meta.get("model")
+        else:
+            # no checkpoint configured: the live STLGT head serves the
+            # legacy shape too (p50 column + its anomaly probability)
+            lat_ms, prob = q_ms[:, 0], s_prob
+            model_name = "stlgt-live"
         order = np.argsort(-prob)
         endpoints = [
             {
@@ -245,12 +371,14 @@ class ModelHandler(IRequestHandler):
         ]
         payload = {
             "predictedHour": snap["predicted_hour"],
-            "model": meta.get("model"),
+            "model": model_name,
             "endpoints": endpoints,
         }
+        if stlgt_section is not None:
+            payload["stlgt"] = stlgt_section
         import gzip
 
         encoded = json.dumps(payload).encode()
         zipped = gzip.compress(encoded)
-        self._forecast_cache = (snap, payload, encoded, zipped, snap_key)
+        self._forecast_cache = (snap, payload, encoded, zipped, memo_key)
         return Response(payload=payload, raw_body=encoded, raw_gzip=zipped)

@@ -215,6 +215,36 @@ class Settings:
         default_factory=lambda: float(os.environ.get("KMAMIZ_PROFILE_MAX_S", "10"))
     )  # hard bound on one POST /debug/profile jax.profiler capture
 
+    # STLGT continual trainer (kmamiz_tpu/models/stlgt/, docs/STLGT.md).
+    # The trainer reads these env vars directly (it is constructed
+    # lazily at the first fold, before any Settings instance need
+    # exist); the fields mirror them so one `Settings()` dump shows
+    # everything.
+    stlgt_enabled: bool = field(
+        default_factory=lambda: os.environ.get("KMAMIZ_STLGT", "0")
+        not in ("0", "false", "")
+    )  # master gate for the continual trainer fold hook (default OFF)
+    stlgt_refresh: int = field(
+        default_factory=lambda: int(os.environ.get("KMAMIZ_STLGT_REFRESH", "1"))
+    )  # refresh cadence: stale-slot retrain every N folds
+    stlgt_history: int = field(
+        default_factory=lambda: int(os.environ.get("KMAMIZ_STLGT_HISTORY", "8"))
+    )  # example ring depth, in fold windows (pads to a pow2 bucket)
+    stlgt_epochs: int = field(
+        default_factory=lambda: int(os.environ.get("KMAMIZ_STLGT_EPOCHS", "2"))
+    )  # scan-fused epochs per refresh (static arg of the epoch block)
+    stlgt_hidden: int = field(
+        default_factory=lambda: int(os.environ.get("KMAMIZ_STLGT_HIDDEN", "32"))
+    )  # transformer width H (attention cost is O(N * H^2))
+    stlgt_lr: float = field(
+        default_factory=lambda: float(os.environ.get("KMAMIZ_STLGT_LR", "0.05"))
+    )  # adamw learning rate of the continual refresh
+    stlgt_quantiles: str = field(
+        default_factory=lambda: os.environ.get(
+            "KMAMIZ_STLGT_QUANTILES", "0.5,0.95,0.99"
+        )
+    )  # the three forecast quantile levels (comma list, ascending)
+
     def __post_init__(self) -> None:
         k8s_host = os.environ.get("KUBERNETES_SERVICE_HOST")
         k8s_port = os.environ.get("KUBERNETES_SERVICE_PORT")

@@ -387,6 +387,8 @@ def _ensure_registered() -> None:
         "kmamiz_tpu.server.processor",
         "kmamiz_tpu.models.serving",
         "kmamiz_tpu.models.stacked",
+        "kmamiz_tpu.models.stlgt.trainer",
+        "kmamiz_tpu.models.stlgt.serving",
     ):
         try:
             importlib.import_module(mod)
@@ -691,6 +693,11 @@ REGISTERED_JIT_SITES: Dict[str, set] = {
     # "run" the epoch blocks of epoch_runner/dp_epoch_runner
     "kmamiz_tpu/models/serving.py": {"fwd"},
     "kmamiz_tpu/models/stacked.py": {"run", "_batched_forward"},
+    # STLGT: "run" is the continual-refresh epoch block (registered as
+    # models.stlgt_epoch_block), "fwd" the quantile serving forward
+    # (models.stlgt_quantile_forward)
+    "kmamiz_tpu/models/stlgt/trainer.py": {"run"},
+    "kmamiz_tpu/models/stlgt/serving.py": {"fwd"},
 }
 
 ALLOWLISTED_JIT_SITES: Dict[str, Dict[str, str]] = {

@@ -1120,7 +1120,10 @@ class EndpointGraph:
         (immutable jnp arrays: safe to use after the lock releases)."""
         with self._lock:
             self._finalize_pending_locked()
-            mask = self._src != SENTINEL
+            # _edge_mask, not an eager `!= SENTINEL`: the fold path runs
+            # under jax.transfer_guard("disallow") and the eager compare
+            # uploads the sentinel as an implicit host->device constant
+            mask = _edge_mask(self._src)
             return self._src, self._dst, self._dist, mask
 
     def invalidate_labels(self) -> None:

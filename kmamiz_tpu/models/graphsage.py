@@ -35,22 +35,31 @@ def assemble_features(
     hour_of_day: float,
 ):
     """THE feature-column layout, shared by the trainer's per-slot builder
-    and the device window-stats path — one definition so the two can never
-    skew (train/serve skew is silent and deadly for the hour features)."""
-    angle = 2.0 * jnp.pi * hour_of_day / 24.0
-    rate = jnp.asarray(request_rate, dtype=jnp.float32)
-    return jnp.stack(
+    and the tick's hour fold — one definition so the two can never skew
+    (train/serve skew is silent and deadly for the hour features).
+
+    Host-side numpy on purpose: the hour fold runs under
+    jax.transfer_guard("disallow") when KMAMIZ_TRANSFER_GUARD=1, and the
+    previous eager-jnp form implicitly uploaded every host column (and
+    the baked sin/cos constants) to the device per fold. Consumers that
+    train/serve on device convert explicitly at their bucket-padding
+    step."""
+    import numpy as np
+
+    angle = 2.0 * np.pi * float(hour_of_day) / 24.0
+    rate = np.asarray(request_rate, dtype=np.float32)
+    return np.stack(
         [
             rate,
-            jnp.asarray(err4_share, dtype=jnp.float32),
-            jnp.asarray(err5_share, dtype=jnp.float32),
-            jnp.asarray(log_latency, dtype=jnp.float32),
-            jnp.asarray(latency_cv, dtype=jnp.float32),
-            jnp.asarray(replicas, dtype=jnp.float32),
-            jnp.asarray(log_volume, dtype=jnp.float32),
-            jnp.asarray(active, dtype=jnp.float32),
-            jnp.full_like(rate, jnp.sin(angle)),
-            jnp.full_like(rate, jnp.cos(angle)),
+            np.asarray(err4_share, dtype=np.float32),
+            np.asarray(err5_share, dtype=np.float32),
+            np.asarray(log_latency, dtype=np.float32),
+            np.asarray(latency_cv, dtype=np.float32),
+            np.asarray(replicas, dtype=np.float32),
+            np.asarray(log_volume, dtype=np.float32),
+            np.asarray(active, dtype=np.float32),
+            np.full_like(rate, np.float32(np.sin(angle))),
+            np.full_like(rate, np.float32(np.cos(angle))),
         ],
         axis=1,
     )

@@ -356,8 +356,25 @@ class HistoryState:
         """Re-key every per-endpoint column: saved index i becomes
         new_ids[i] in a fresh n_new-wide layout (restart re-interning —
         the saved snapshot's names resolve to different ids in the new
-        process; endpoints absent from the snapshot start empty)."""
+        process; endpoints absent from the snapshot start empty).
+
+        Ids are validated BEFORE any field is touched: a negative id
+        would silently wrap around and write one endpoint's profile
+        into another's column, a duplicate would silently drop a
+        profile (numpy fancy assignment, last write wins), and an
+        out-of-range id would raise mid-loop leaving the state
+        half-remapped — all three corrupt days of accumulated profile,
+        so they fail atomically here instead
+        (tests/test_trainer.py::TestHistoryState::test_remap_rejects_bad_ids).
+        """
         ids = np.asarray(new_ids, dtype=np.int64)
+        if ids.size and (ids.min() < 0 or ids.max() >= n_new):
+            raise ValueError(
+                f"remap ids must lie in [0, {n_new}); "
+                f"got range [{ids.min()}, {ids.max()}]"
+            )
+        if len(np.unique(ids)) != len(ids):
+            raise ValueError("remap ids must be unique (duplicate target id)")
 
         def scatter(a):
             out = np.zeros(a.shape[:-1] + (n_new,), dtype=a.dtype)

@@ -1,0 +1,24 @@
+"""STLGT subsystem: linear graph transformer tail-latency head with
+online continual training (docs/STLGT.md).
+
+- model.py    — kernelized softmax-free transformer block, monotone
+                p50/p95/p99 quantile head (pinball loss), per-edge
+                attribution gates; model-module interface compatible
+                with graphsage.py so every existing serving/training
+                surface accepts it.
+- trainer.py  — continual trainer driven from the collect tick: fold
+                snapshots become next-hour examples, dirty services mark
+                ring slots stale, a registered scan-fused donated-carry
+                epoch block refreshes only stale slots.
+- serving.py  — bucket-padded jitted quantile forward for the
+                /model/forecast quantile/horizon surface.
+"""
+from kmamiz_tpu.models.stlgt import model, serving, trainer  # noqa: F401
+from kmamiz_tpu.models.stlgt.trainer import (  # noqa: F401
+    enabled,
+    get_trainer,
+    on_fold,
+    reset_for_tests,
+    serving_params,
+    trainer_status,
+)

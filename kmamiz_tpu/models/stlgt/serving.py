@@ -1,0 +1,85 @@
+"""Shape-stable jitted STLGT inference: quantiles + attribution.
+
+Same discipline as models/serving.py (the /model/forecast forward):
+node/edge counts pad to pow2 capacity buckets, the whole readout —
+expm1 back to milliseconds, sigmoid on logits and edge gates included —
+runs as ONE jitted program registered in the program registry
+("models.stlgt_quantile_forward", family-resolvable so warm boot can
+prewarm it), and callers get host arrays sliced to the real counts.
+"""
+from __future__ import annotations
+
+from functools import lru_cache
+from typing import Tuple
+
+import numpy as np
+
+from kmamiz_tpu.core import programs
+from kmamiz_tpu.core.profiling import step_timer
+from kmamiz_tpu.core.spans import _pad_size
+
+
+@lru_cache(maxsize=8)
+def _jitted_quantiles(model):
+    import jax
+    import jax.numpy as jnp
+
+    def fwd(params, features, src, dst, mask):
+        q_log, logit, gate = model.forward_quantiles(
+            params, features, src, dst, mask
+        )
+        return jnp.expm1(q_log), jax.nn.sigmoid(logit), gate
+
+    return programs.register_instance(
+        "models.stlgt_quantile_forward", model.__name__, jax.jit(fwd)
+    )
+
+
+def _resolve_quantiles(key: str):
+    import importlib
+
+    if not key.startswith("kmamiz_tpu.models."):
+        return None
+    return _jitted_quantiles(importlib.import_module(key))
+
+
+programs.register_family("models.stlgt_quantile_forward", _resolve_quantiles)
+
+
+def quantile_forward(
+    params, features, src, dst, mask, model
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Bucket-padded jitted STLGT forward -> (latency quantiles ms
+    [N, 3], anomaly probability [N], edge attribution score [E]) as host
+    float arrays for the REAL rows/edges."""
+    features = np.asarray(features, dtype=np.float32)
+    n, f = features.shape
+    e = int(np.asarray(src).shape[0])
+    nb, eb = _pad_size(n), _pad_size(e)
+
+    feats = np.zeros((nb, f), dtype=np.float32)
+    feats[:n] = features
+    src_p = np.zeros(eb, dtype=np.int32)
+    dst_p = np.zeros(eb, dtype=np.int32)
+    mask_p = np.zeros(eb, dtype=bool)
+    src_p[:e] = np.asarray(src, dtype=np.int32)
+    dst_p[:e] = np.asarray(dst, dtype=np.int32)
+    mask_p[:e] = np.asarray(mask, dtype=bool)
+
+    with step_timer.phase("stlgt_forward"):
+        # explicit device_put/device_get: this path serves under
+        # jax.transfer_guard("disallow") when KMAMIZ_TRANSFER_GUARD=1
+        import jax
+
+        q_ms, prob, gate = _jitted_quantiles(model)(
+            params,
+            jax.device_put(feats),
+            jax.device_put(src_p),
+            jax.device_put(dst_p),
+            jax.device_put(mask_p),
+        )
+        # graftlint: disable=host-sync-in-hot-path -- the route returns host arrays; one fetch per forward
+        q_ms = jax.device_get(q_ms)[:n]
+        prob = jax.device_get(prob)[:n]  # graftlint: disable=host-sync-in-hot-path -- same fetch
+        gate = jax.device_get(gate)[:e]  # graftlint: disable=host-sync-in-hot-path -- same fetch
+    return q_ms, prob, gate

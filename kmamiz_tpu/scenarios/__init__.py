@@ -15,6 +15,7 @@ from kmamiz_tpu.scenarios.factory import (
     scenario_matrix,
     spec_signature,
 )
+from kmamiz_tpu.scenarios.labeled import labeled_windows
 from kmamiz_tpu.scenarios.runner import (
     recorded_runs,
     run_matrix,
@@ -47,6 +48,7 @@ __all__ = [
     "Topology",
     "build_scenario",
     "enabled_storylines",
+    "labeled_windows",
     "recorded_runs",
     "reset_for_tests",
     "run_matrix",

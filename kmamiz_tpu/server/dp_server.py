@@ -269,6 +269,13 @@ def make_handler(processor: DataProcessor, router=None):
                 # dependency graph (self-trace)
                 self._send_json(200, TRACER.export_zipkin())
                 return
+            if path == "/model/stlgt":
+                # continual-trainer health: ring depth, stale slots,
+                # refresh counters, params version (docs/STLGT.md)
+                from kmamiz_tpu.models.stlgt import trainer as stlgt_trainer
+
+                self._send_json(200, stlgt_trainer.trainer_status())
+                return
             if path == "/debug/graftprof":
                 # the live graftprof profile: per-phase attribution of
                 # recent ticks, native contention counters, device plane

@@ -688,6 +688,17 @@ class DataProcessor:
                 int(hour),
             ),
         }
+        # STLGT continual-training hook (KMAMIZ_STLGT=1): each fold
+        # becomes an online example and may trigger a stale-slot refresh
+        # inside the "stlgt-refresh" tick phase. Gated + lazily imported
+        # so the default pipeline pays one env read per fold; a trainer
+        # failure must not take the fold down (watchdog posture).
+        try:
+            from kmamiz_tpu.models import stlgt as _stlgt
+
+            _stlgt.on_fold(self.forecast_snapshot)
+        except Exception:
+            res_metrics.incr("stlgtFoldErrors")
 
     # -- history persistence (VERDICT r4 #4) ---------------------------------
 

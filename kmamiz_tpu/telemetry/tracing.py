@@ -44,6 +44,10 @@ PHASES = (
     "walk",
     "scorers",
     "encode-serve",
+    # STLGT continual-training refresh (models/stlgt/trainer.py): a
+    # first-class tick phase so online training shows up in warm tick
+    # attribution instead of hiding in the unattributed residue
+    "stlgt-refresh",
 )
 
 _SELFTRACE_NAMESPACE = "graftscope"

@@ -57,6 +57,7 @@ def _reset_resilience_state():
     test trips must not short-circuit the next test's upstream calls, so
     every test starts from a clean slate."""
     from kmamiz_tpu import scenarios, telemetry, tenancy
+    from kmamiz_tpu.models import stlgt
     from kmamiz_tpu.resilience import breaker, metrics, quarantine
 
     breaker.reset_for_tests()
@@ -65,6 +66,7 @@ def _reset_resilience_state():
     telemetry.reset_for_tests()
     tenancy.reset_for_tests()
     scenarios.reset_for_tests()
+    stlgt.reset_for_tests()
     yield
 
 

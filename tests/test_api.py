@@ -997,6 +997,61 @@ class TestModelRoutes:
         probs = [r["anomalyProbability"] for r in eps]
         assert probs == sorted(probs, reverse=True)
 
+    def test_forecast_memo_label_epoch_invalidation(
+        self, pdas_traces, tmp_path
+    ):
+        """The forecast memo keys on the fold's (graph version,
+        label epoch, hour) cache_key: a label-epoch bump must evict the
+        cached payload, the recompute must reuse the already-compiled
+        bucket program (zero new jit compiles — same shapes), and a
+        same-key poll must serve the identical payload object."""
+        from kmamiz_tpu.api.app import build_router as _build
+        from kmamiz_tpu.core import programs
+        from kmamiz_tpu.server.initializer import AppContext, Initializer
+        from kmamiz_tpu.server.processor import DataProcessor
+        from kmamiz_tpu.server.storage import MemoryStore
+
+        _train_tiny_checkpoint(tmp_path, epochs=1)
+        dp = DataProcessor(
+            trace_source=_prefixed_trace_source(pdas_traces, "memo"),
+            use_device_stats=False,
+        )
+        settings = Settings()
+        settings.external_data_processor = ""
+        settings.model_dir = str(tmp_path)
+        ctx = AppContext.build(
+            app_settings=settings, store=MemoryStore(), processor=dp
+        )
+        Initializer(ctx).register_data_caches()
+        model_router = _build(ctx)
+
+        H = 3_600_000
+        dp.collect({"uniqueId": "k1", "lookBack": 30_000, "time": 920 * H})
+        dp.collect({"uniqueId": "k2", "lookBack": 30_000, "time": 921 * H})
+        fc = model_router.dispatch("GET", "/api/v1/model/forecast").payload
+
+        # same key, same snapshot: memoized object, zero compiles
+        prog_snap = programs.snapshot()
+        fc2 = model_router.dispatch("GET", "/api/v1/model/forecast").payload
+        assert fc2 is fc
+        assert programs.new_compiles_since(prog_snap) == {}
+
+        # a label-epoch bump (what a label-advancing fold publishes)
+        # evicts: the payload is recomputed — but against the SAME
+        # capacity buckets, so still zero new compiles
+        snap = dp.forecast_snapshot
+        version, label_epoch, hour = snap["cache_key"]
+        bumped = dict(snap)
+        bumped["cache_key"] = (version, label_epoch + 1, hour)
+        dp.forecast_snapshot = bumped
+        prog_snap = programs.snapshot()
+        fc3 = model_router.dispatch("GET", "/api/v1/model/forecast").payload
+        assert fc3 is not fc
+        assert programs.new_compiles_since(prog_snap) == {}
+        # and the bumped key memoizes in turn
+        fc4 = model_router.dispatch("GET", "/api/v1/model/forecast").payload
+        assert fc4 is fc3
+
     def test_empty_checkpoint_dir_retries(self, tmp_path, monkeypatch):
         """A missing first checkpoint is TRANSIENT: the handler must
         re-attempt the load once the trainer writes one, instead of

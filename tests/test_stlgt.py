@@ -1,0 +1,481 @@
+"""STLGT subsystem: linear graph transformer quantile head, continual
+trainer, serving surface, and the zero-steady-state-compile acceptance
+gate (docs/STLGT.md).
+
+Covers the four subsystem layers end to end:
+
+- model: monotone quantiles, lane masking of padded rows, edge-masked
+  attribution gates, padding invariance through the jitted serving path;
+- continual trainer: refresh/versioning, select-merge stale gating
+  (a refresh with zero stale slots must be a bit-exact no-op on params),
+  dirty-service and version-bump staleness, watchdog-style failure
+  containment;
+- serving + routes: the grown /model/forecast quantile/horizon surface,
+  the stlgt-live fallback when no checkpoint is configured, and the
+  /model/stlgt debug endpoint;
+- acceptance: a warm transfer-guarded dp tick with KMAMIZ_STLGT=1 pins
+  ZERO new compiles across every registered program (the continual
+  refresh included).
+"""
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from kmamiz_tpu.config import Settings
+from kmamiz_tpu.core import programs
+from kmamiz_tpu.models.stlgt import model as stlgt_model
+from kmamiz_tpu.models.stlgt import serving as stlgt_serving
+from kmamiz_tpu.models.stlgt.trainer import ContinualTrainer
+
+from conftest import prefixed_trace_source
+
+
+def _params(hidden=8, num_features=10, seed=0):
+    import jax
+
+    return stlgt_model.init_params(
+        jax.random.PRNGKey(seed), hidden=hidden, num_features=num_features
+    )
+
+
+def _toy_graph(n=6, seed=0):
+    rng = np.random.default_rng(seed)
+    feats = (rng.random((n, 10)) * 0.5).astype(np.float32)
+    feats[:, 7] = 1.0  # active column: every lane real
+    src = np.arange(n - 1, dtype=np.int32)
+    dst = np.arange(1, n, dtype=np.int32)
+    mask = np.ones(n - 1, dtype=bool)
+    return feats, src, dst, mask
+
+
+def _snap(n=6, seed=0, hour=0, version=1, scale=1.0):
+    feats, src, dst, mask = _toy_graph(n, seed)
+    feats = feats * np.float32(scale)
+    feats[:, 7] = 1.0
+    return {
+        "features": feats,
+        "src": src,
+        "dst": dst,
+        "mask": mask,
+        "names": [f"svc\tns\tv1\tGET\t/api/e{i}" for i in range(n)],
+        "predicted_hour": (hour + 1) % 24,
+        "cache_key": (version, 0, hour),
+    }
+
+
+class TestStlgtModel:
+    def test_quantiles_monotone(self):
+        """The cumulative-softplus head makes p50 <= p95 <= p99 a
+        structural property, not a training outcome."""
+        feats, src, dst, mask = _toy_graph()
+        q, _logit, _gate = stlgt_model.forward_quantiles(
+            _params(), feats, src, dst, mask
+        )
+        q = np.asarray(q)
+        assert (q[:, 1] >= q[:, 0]).all()
+        assert (q[:, 2] >= q[:, 1]).all()
+
+    def test_lane_mask_padded_rows_emit_nothing(self):
+        """phi(0) = elu(0)+1 = 1, so WITHOUT the lane mask zero-padded
+        rows would pollute the linear-attention sums: real rows must be
+        unchanged by appended zero rows."""
+        feats, src, dst, mask = _toy_graph()
+        q1, l1, g1 = stlgt_model.forward_quantiles(
+            _params(), feats, src, dst, mask
+        )
+        padded = np.concatenate(
+            [feats, np.zeros((10, feats.shape[1]), np.float32)]
+        )
+        q2, l2, g2 = stlgt_model.forward_quantiles(
+            _params(), padded, src, dst, mask
+        )
+        n = feats.shape[0]
+        np.testing.assert_allclose(
+            np.asarray(q2)[:n], np.asarray(q1), rtol=1e-5, atol=1e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(l2)[:n], np.asarray(l1), rtol=1e-5, atol=1e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(g2), np.asarray(g1), rtol=1e-5, atol=1e-5
+        )
+
+    def test_attribution_gate_respects_edge_mask(self):
+        feats, src, dst, mask = _toy_graph()
+        mask = mask.copy()
+        mask[::2] = False
+        _q, _l, gate = stlgt_model.forward_quantiles(
+            _params(), feats, src, dst, mask
+        )
+        gate = np.asarray(gate)
+        assert (gate[~mask] == 0.0).all()
+        assert (gate[mask] > 0.0).all()  # sigmoid output on real edges
+
+    def test_serving_padding_invariance(self):
+        """The bucket-padded jitted serving path must agree with the
+        direct unpadded forward on the real rows/edges."""
+        feats, src, dst, mask = _toy_graph(n=6)
+        params = _params()
+        q_ms, prob, gate = stlgt_serving.quantile_forward(
+            params, feats, src, dst, mask, stlgt_model
+        )
+        q_ref, l_ref, g_ref = stlgt_model.forward_quantiles(
+            params, feats, src, dst, mask
+        )
+        np.testing.assert_allclose(
+            q_ms, np.expm1(np.asarray(q_ref)), rtol=1e-4, atol=1e-4
+        )
+        np.testing.assert_allclose(
+            prob,
+            1.0 / (1.0 + np.exp(-np.asarray(l_ref))),
+            rtol=1e-5,
+            atol=1e-5,
+        )
+        np.testing.assert_allclose(
+            gate, np.asarray(g_ref), rtol=1e-5, atol=1e-5
+        )
+        assert q_ms.shape == (6, 3) and gate.shape == (5,)
+
+
+class TestContinualTrainer:
+    def test_refresh_trains_and_versions(self):
+        t = ContinualTrainer(depth=4, epochs=1, hidden=8, lr=0.05)
+        assert t.serving() is None
+        assert t.observe_fold(_snap(hour=0, seed=0)) is None  # pending only
+        report = t.observe_fold(_snap(hour=1, seed=1))
+        assert report is not None and report["ok"], report
+        assert report["version"] == 1
+        assert np.isfinite(report["loss"])
+        live = t.serving()
+        assert live is not None and live["version"] == 1
+        assert live["quantiles"] == stlgt_model.QUANTILES
+        status = t.status()
+        assert status["refreshes"] == 1
+        assert status["stalenessTicks"] == 0
+        assert status["staleSlots"] == 0
+
+    def test_zero_stale_refresh_is_bit_exact_noop_on_params(self):
+        """Select-merge, observed from outside: adamw with zero grads
+        still applies weight decay and moment decay, so a refresh where
+        every slot weight is 0 must leave params BIT-IDENTICAL — any
+        drift means the gating skips grads but not the update."""
+        import jax
+
+        t = ContinualTrainer(depth=4, epochs=2, hidden=8, lr=0.05)
+        t.observe_fold(_snap(hour=0, seed=0))
+        t.observe_fold(_snap(hour=1, seed=1))
+        t._stale = [False] * len(t._ring)
+        before = jax.tree_util.tree_map(
+            lambda a: np.asarray(a).copy(), t._params
+        )
+        report = t.refresh()
+        assert report["ok"] and report["stale_slots"] == 0
+        after = jax.tree_util.tree_map(np.asarray, t._params)
+        for a, b in zip(
+            jax.tree_util.tree_leaves(before), jax.tree_util.tree_leaves(after)
+        ):
+            np.testing.assert_array_equal(a, b)
+
+    def test_stale_refresh_moves_params(self):
+        """Counter-check for the no-op test above: the same refresh with
+        the slots stale must actually train."""
+        import jax
+
+        t = ContinualTrainer(depth=4, epochs=2, hidden=8, lr=0.05)
+        t.observe_fold(_snap(hour=0, seed=0))
+        t.observe_fold(_snap(hour=1, seed=1))
+        t._stale = [True] * len(t._ring)
+        before = jax.tree_util.tree_map(
+            lambda a: np.asarray(a).copy(), t._params
+        )
+        assert t.refresh()["ok"]
+        moved = any(
+            not np.array_equal(a, np.asarray(b))
+            for a, b in zip(
+                jax.tree_util.tree_leaves(before),
+                jax.tree_util.tree_leaves(
+                    jax.tree_util.tree_map(np.asarray, t._params)
+                ),
+            )
+        )
+        assert moved
+
+    def test_version_bump_marks_all_slots_stale(self):
+        """Identical windows keep trained slots clean (nothing dirty);
+        a graph-version bump must still mark every slot stale."""
+        t = ContinualTrainer(depth=8, refresh_every=100, epochs=1, hidden=8)
+        t.observe_fold(_snap(hour=0, seed=7))
+        t.observe_fold(_snap(hour=1, seed=7))  # first refresh (params None)
+        t.observe_fold(_snap(hour=2, seed=7))  # cadence defers: 1 stale slot
+        assert t.status()["staleSlots"] == 1
+        t.observe_fold(_snap(hour=3, seed=7, version=2))  # topology changed
+        assert t.status()["staleSlots"] == t.status()["examples"] == 3
+
+    def test_quiet_mesh_marks_only_new_slot_stale(self):
+        """Identical consecutive windows: no dirty endpoints, so only
+        the never-trained newest slot is stale."""
+        t = ContinualTrainer(depth=8, refresh_every=100, epochs=1, hidden=8)
+        t.observe_fold(_snap(hour=0, seed=5))
+        t.observe_fold(_snap(hour=1, seed=5))  # refresh clears everything
+        t.observe_fold(_snap(hour=2, seed=5))  # same rows: nothing dirty
+        t.observe_fold(_snap(hour=3, seed=5))
+        assert t.status()["staleSlots"] == 2  # just the two new windows
+
+    def test_failure_keeps_last_good_serving(self, monkeypatch):
+        t = ContinualTrainer(depth=4, epochs=1, hidden=8)
+        t.observe_fold(_snap(hour=0, seed=0))
+        assert t.observe_fold(_snap(hour=1, seed=1))["ok"]
+        live = t.serving()
+
+        def boom():
+            raise RuntimeError("device fell over")
+
+        monkeypatch.setattr(t, "_run_epoch_block_locked", boom)
+        report = t.observe_fold(_snap(hour=2, seed=2))
+        assert report is not None and not report["ok"]
+        assert "device fell over" in report["error"]
+        status = t.status()
+        assert status["refreshFailures"] == 1
+        assert status["paramsVersion"] == 1
+        assert status["stalenessTicks"] == 1  # climbing: serving is stale
+        # last-good params still serve
+        still = t.serving()
+        assert still is not None and still["version"] == live["version"]
+
+    def test_example_labels_come_from_next_window(self):
+        """Window t's features predict window t+1's outcomes: the
+        appended example must carry the NEXT fold's latency column as
+        its target."""
+        t = ContinualTrainer(depth=4, epochs=1, hidden=8)
+        s0, s1 = _snap(hour=0, seed=0), _snap(hour=1, seed=1)
+        t.observe_fold(s0)
+        t.observe_fold(s1)
+        [ex] = t._ring
+        np.testing.assert_array_equal(ex["features"], s0["features"])
+        np.testing.assert_array_equal(
+            ex["target_latency"], s1["features"][:, 3]
+        )
+
+
+class TestLabeledWindows:
+    def test_deterministic_and_carries_storyline_truth(self):
+        from kmamiz_tpu.scenarios import build_scenario, labeled_windows
+
+        spec = build_scenario("cascade-fanout", 3, 0, 12)
+        a = labeled_windows(spec)
+        b = labeled_windows(spec)
+        assert a["names"] == b["names"]
+        assert len(a["windows"]) == 12
+        for wa, wb in zip(a["windows"], b["windows"]):
+            np.testing.assert_array_equal(wa["features"], wb["features"])
+            assert wa["truth_services"] == wb["truth_services"]
+        # the composed cascade marks at least one fault tick, and fault
+        # ticks name real services
+        fault = [w for w in a["windows"] if w["truth_services"]]
+        assert fault
+        assert set(fault[0]["truth_services"]) <= set(a["services"])
+        # lane-mask contract: inactive endpoints have all-zero rows
+        for w in a["windows"]:
+            inactive = ~w["active"]
+            if inactive.any():
+                assert np.abs(w["features"][inactive]).sum() == 0.0
+
+
+def _stlgt_ctx(pdas_traces, prefix):
+    from kmamiz_tpu.api.app import build_router
+    from kmamiz_tpu.server.initializer import AppContext, Initializer
+    from kmamiz_tpu.server.processor import DataProcessor
+    from kmamiz_tpu.server.storage import MemoryStore
+
+    dp = DataProcessor(
+        trace_source=prefixed_trace_source(pdas_traces, prefix),
+        use_device_stats=False,
+    )
+    settings = Settings()
+    settings.external_data_processor = ""
+    settings.model_dir = ""  # no checkpoint: STLGT-live serves alone
+    ctx = AppContext.build(
+        app_settings=settings, store=MemoryStore(), processor=dp
+    )
+    Initializer(ctx).register_data_caches()
+    return dp, build_router(ctx)
+
+
+@pytest.fixture()
+def stlgt_env(monkeypatch):
+    monkeypatch.setenv("KMAMIZ_STLGT", "1")
+    monkeypatch.setenv("KMAMIZ_STLGT_HIDDEN", "8")
+    monkeypatch.setenv("KMAMIZ_STLGT_EPOCHS", "1")
+    monkeypatch.setenv("KMAMIZ_STLGT_HISTORY", "2")
+    from kmamiz_tpu.models import stlgt
+
+    stlgt.reset_for_tests()  # rebuild the singleton under these knobs
+    yield
+
+
+class TestStlgtRoutes:
+    H = 3_600_000
+
+    def _tick(self, dp, uid, hour):
+        dp.collect(
+            {"uniqueId": uid, "lookBack": 30_000, "time": hour * self.H}
+        )
+
+    def test_forecast_grows_quantile_horizon_surface(
+        self, pdas_traces, stlgt_env
+    ):
+        dp, router = _stlgt_ctx(pdas_traces, "sq")
+        for i in range(3):  # two folds: pending -> example -> refresh
+            self._tick(dp, f"q{i}", 930 + i)
+        res = router.dispatch("GET", "/api/v1/model/forecast")
+        assert res.status == 200, res.payload
+        body = res.payload
+        assert body["model"] == "stlgt-live"
+        sec = body["stlgt"]
+        assert sec["paramsVersion"] >= 1
+        assert sec["quantile"] == "all" and sec["horizon"] == 1
+        assert sec["quantileLevels"] == [0.5, 0.95, 0.99]
+        row = sec["endpoints"][0]
+        q = row["latencyQuantilesMs"]
+        assert set(q) == {"p50", "p95", "p99"}
+        assert q["p50"] <= q["p95"] <= q["p99"]
+        assert all(
+            a["score"] >= b["score"]
+            for a, b in zip(sec["attributions"], sec["attributions"][1:])
+        )
+        # legacy shape intact for the dashboard
+        assert body["endpoints"][0].keys() >= {
+            "uniqueEndpointName", "anomalyProbability", "predictedLatencyMs"
+        }
+
+        one = router.dispatch(
+            "GET", "/api/v1/model/forecast?quantile=p99"
+        ).payload
+        assert set(one["stlgt"]["endpoints"][0]["latencyQuantilesMs"]) == {
+            "p99"
+        }
+
+        # horizon widens the tail (sqrt scaling), p50 carried flat
+        far = router.dispatch(
+            "GET", "/api/v1/model/forecast?horizon=9"
+        ).payload
+        assert far["stlgt"]["horizon"] == 9
+        by_name = {
+            r["uniqueEndpointName"]: r["latencyQuantilesMs"]
+            for r in sec["endpoints"]
+        }
+        for r in far["stlgt"]["endpoints"]:
+            near = by_name[r["uniqueEndpointName"]]
+            q9 = r["latencyQuantilesMs"]
+            assert q9["p50"] == pytest.approx(near["p50"], abs=0.02)
+            assert q9["p99"] >= near["p99"]
+
+        assert (
+            router.dispatch(
+                "GET", "/api/v1/model/forecast?quantile=p42"
+            ).status
+            == 400
+        )
+
+    def test_quantile_surface_503_without_stlgt(self, pdas_traces, tmp_path):
+        """STLGT off (the default): the legacy checkpoint route keeps
+        serving, but the quantile/horizon surface has no live params and
+        must say why."""
+        from test_api import _train_tiny_checkpoint
+
+        from kmamiz_tpu.api.app import build_router
+        from kmamiz_tpu.server.initializer import AppContext, Initializer
+        from kmamiz_tpu.server.processor import DataProcessor
+        from kmamiz_tpu.server.storage import MemoryStore
+
+        _train_tiny_checkpoint(tmp_path, epochs=1)
+        dp = DataProcessor(
+            trace_source=prefixed_trace_source(pdas_traces, "sd"),
+            use_device_stats=False,
+        )
+        settings = Settings()
+        settings.external_data_processor = ""
+        settings.model_dir = str(tmp_path)
+        ctx = AppContext.build(
+            app_settings=settings, store=MemoryStore(), processor=dp
+        )
+        Initializer(ctx).register_data_caches()
+        router = build_router(ctx)
+        for i in range(3):
+            self._tick(dp, f"d{i}", 940 + i)
+        assert router.dispatch("GET", "/api/v1/model/forecast").status == 200
+        res = router.dispatch("GET", "/api/v1/model/forecast?quantile=p99")
+        assert res.status == 503
+        assert "KMAMIZ_STLGT" in res.payload["error"]
+        res = router.dispatch("GET", "/api/v1/model/forecast?horizon=6")
+        assert res.status == 503
+
+    def test_dp_server_stlgt_status_endpoint(self, pdas_traces, stlgt_env):
+        from kmamiz_tpu.server.dp_server import DataProcessorServer
+
+        dp, _router = _stlgt_ctx(pdas_traces, "ss")
+        for i in range(3):
+            self._tick(dp, f"s{i}", 950 + i)
+        server = DataProcessorServer(dp, host="127.0.0.1", port=0)
+        server.start()
+        try:
+            doc = json.loads(
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{server.port}/model/stlgt"
+                ).read()
+            )
+        finally:
+            server.stop()
+        assert doc["enabled"] is True
+        assert doc["foldsSeen"] >= 2
+        assert doc["paramsVersion"] >= 1
+        assert doc["refreshFailures"] == 0
+
+
+class TestSteadyStateCompileGate:
+    def test_warm_guarded_tick_with_stlgt_pins_zero_compiles(
+        self, pdas_traces, stlgt_env, monkeypatch
+    ):
+        """ISSUE acceptance: with the continual trainer enabled, a warm
+        transfer-guarded tick — hour fold, STLGT refresh included — must
+        compile NOTHING (registry snapshot diff) and trip no implicit
+        transfers. Warmup covers every capacity bucket the steady state
+        uses: ring fills to depth 2 (slot bucket stable at 2) and the
+        endpoint/edge buckets stabilize with the graph."""
+        monkeypatch.setenv("KMAMIZ_MESH", "0")
+        from kmamiz_tpu.analysis import guards
+        from kmamiz_tpu.models.stlgt.trainer import get_trainer
+        from kmamiz_tpu.server.processor import DataProcessor
+
+        dp = DataProcessor(
+            trace_source=prefixed_trace_source(pdas_traces, "wg"),
+            use_device_stats=False,
+        )
+        # warm: 5 folds -> ring at depth 2, slot bucket 2, refresh ran
+        # at every fold since the first example
+        for i in range(6):
+            dp.collect(
+                {
+                    "uniqueId": f"w{i}",
+                    "lookBack": 30_000,
+                    "time": (960 + i) * 3_600_000,
+                }
+            )
+        warm_status = get_trainer().status()
+        assert warm_status["refreshes"] >= 3, warm_status
+
+        snap = programs.snapshot()
+        with guards.hot_path_guard("disallow") as report:
+            dp.collect(
+                {
+                    "uniqueId": "w-guarded",
+                    "lookBack": 30_000,
+                    "time": 966 * 3_600_000,
+                }
+            )
+        # the guarded tick really folded + refreshed
+        assert get_trainer().status()["refreshes"] > warm_status["refreshes"]
+        assert report.new_compiles == {}, report.new_compiles
+        assert programs.new_compiles_since(snap) == {}

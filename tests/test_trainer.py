@@ -824,3 +824,75 @@ class TestHistoryState:
         cols = state.step(0, [0.0] * 3, [0.0] * 3, [1] * 3)
         assert np.isclose(cols[0, 7], np.log1p(2))  # out-degree of node 0
         assert np.isclose(cols[2, 6], np.log1p(2))  # in-degree of node 2
+
+    @staticmethod
+    def _feed(state, n, steps, seed):
+        rng = np.random.default_rng(seed)
+        for t in range(steps):
+            state.step(
+                t % 24,
+                rng.random(n).astype(np.float32) * 0.3,
+                rng.random(n).astype(np.float32),
+                (rng.random(n) > 0.2).astype(np.float32),
+            )
+
+    def test_remap_then_grow_same_tick(self):
+        """Restart re-keying: a snapshot remapped by permutation into a
+        WIDER id space, immediately followed by a step that grows the
+        state further (new endpoints joined while the process was
+        down), must emit exactly the columns of a reference state that
+        lived in the final layout all along."""
+        from kmamiz_tpu.models import history
+
+        saved = history.HistoryState(5)
+        self._feed(saved, 5, 6, seed=1)
+        ids = np.array([3, 0, 6, 2, 7], dtype=np.int64)
+        saved.remap(ids, 8)
+        assert saved.num_endpoints == 8
+
+        # reference: the same stream replayed directly at the new ids
+        ref = history.HistoryState(8)
+        rng = np.random.default_rng(1)
+        for t in range(6):
+            err5 = np.zeros(8, np.float32)
+            lat = np.zeros(8, np.float32)
+            act = np.zeros(8, np.float32)
+            err5[ids] = rng.random(5).astype(np.float32) * 0.3
+            lat[ids] = rng.random(5).astype(np.float32)
+            act[ids] = (rng.random(5) > 0.2).astype(np.float32)
+            ref.step(t % 24, err5, lat, act)
+
+        # the very next bucket arrives with 10 endpoints: remap and
+        # grow land in the SAME tick
+        rng2 = np.random.default_rng(9)
+        err5 = rng2.random(10).astype(np.float32) * 0.3
+        lat = rng2.random(10).astype(np.float32)
+        act = np.ones(10, np.float32)
+        got = saved.step(6, err5, lat, act)
+        want = ref.step(6, err5, lat, act)
+        assert got.shape == (10, history.NUM_HISTORY_FEATURES)
+        np.testing.assert_array_equal(got, want)
+
+    def test_remap_rejects_bad_ids(self):
+        """A negative id would wrap around into another endpoint's
+        column, a duplicate would drop a profile (last write wins), an
+        out-of-range id would fail mid-loop — all must raise BEFORE any
+        field mutates, so days of profile survive a bad restart doc."""
+        from kmamiz_tpu.models import history
+
+        for bad, n_new in (
+            (np.array([0, 5, 1]), 4),   # out of range
+            (np.array([0, -1, 1]), 4),  # negative: silent wraparound
+            (np.array([0, 1, 1]), 4),   # duplicate: silent profile loss
+        ):
+            state = history.HistoryState(3)
+            self._feed(state, 3, 4, seed=2)
+            before = {
+                f: getattr(state, f).copy()
+                for f in history.HistoryState._ARRAY_FIELDS
+            }
+            with pytest.raises(ValueError):
+                state.remap(bad, n_new)
+            assert state.num_endpoints == 3
+            for f, a in before.items():
+                np.testing.assert_array_equal(getattr(state, f), a)

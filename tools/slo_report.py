@@ -52,11 +52,22 @@ _EXTRA_GATED = (
     "prof_merge_lockwait_ms_p95",
     "prof_transfer_ms_p95",
     "prof_device_walk_ms_p95",
+    # STLGT continual-model latency pair (ISSUE 10): the per-fold train
+    # tick and the served quantile forward behind /model/forecast
+    "stlgt_train_tick_ms",
+    "stlgt_infer_ms",
 )
 # boolean pass/fail keys: any True -> False flip is a regression (bool
 # is an int subclass, so the numeric threshold check would wave a
 # True -> False transition through as 1.0 -> 0.0 "improvement")
 _BOOL_GATED = ("scenario_matrix_pass",)
+# higher-is-BETTER float floors: the numeric check above only catches
+# increases, so a coverage collapse would read as an "improvement".
+# stlgt_p99_coverage is a [0,1] calibration rate where relative
+# thresholds are meaningless near 1.0 — the gate is absolute: new below
+# old minus the slack regresses
+_FLOOR_GATED = ("stlgt_p99_coverage",)
+_ABS_SLACK_FLOOR = 0.02
 # absolute slack per key class: rates jitter in the 3rd decimal on tiny
 # denominators, recompile counts are integers, latencies get 0.5 ms
 _ABS_SLACK_RATE = 0.005
@@ -80,6 +91,7 @@ def gated_keys():
         ["slo_" + k for k in SLO_KEYS_HIGHER_IS_WORSE]
         + list(_EXTRA_GATED)
         + list(_BOOL_GATED)
+        + list(_FLOOR_GATED)
     )
 
 
@@ -145,6 +157,10 @@ def check(candidate: dict, baseline: dict, threshold: float):
         compared.append(key)
         if key in _BOOL_GATED:
             if bool(old) and not bool(new):
+                regressions.append((key, old, new))
+            continue
+        if key in _FLOOR_GATED:
+            if new < old - _ABS_SLACK_FLOOR:
                 regressions.append((key, old, new))
             continue
         rel = threshold
@@ -239,7 +255,7 @@ def main(argv=None) -> int:
     for key, old, new in regressions:
         print(
             f"REGRESSION {key}: {old} -> {new} "
-            f"(+{(new - old) / max(abs(old), 1e-9) * 100:.1f}%, "
+            f"({(new - old) / max(abs(old), 1e-9) * 100:+.1f}%, "
             f"threshold {args.threshold * 100:.0f}%)"
         )
     if regressions:

@@ -1606,6 +1606,104 @@ def main() -> None:
                 f"{type(err).__name__}: {err}"[:300]
             )
 
+    # ---- graftpilot control plane (ISSUE 11) -------------------------------
+    # the controller's two latencies — the fold-boundary decision
+    # recompute (Controller.ingest over synthetic forecast views) and the
+    # serving-edge admission read the POST handler pays per tick — plus
+    # the counterfactual gate's prevented-violation count from a fresh
+    # tools/scenario_soak.py --counterfactual subprocess. The three keys
+    # are ALWAYS present (None on skip/failure); KMAMIZ_BENCH_CONTROL=0
+    # skips. Gated by tools/slo_report.py: the latency pair as
+    # higher-is-worse, the prevented count as a float floor.
+    control_extras = {
+        "control_decision_ms": None,
+        "control_tick_overhead_ms": None,
+        "control_counterfactual_prevented": None,
+    }
+    try:
+        control_budget_ok = (
+            time.perf_counter() - BENCH_T0
+            < int(os.environ.get("KMAMIZ_BENCH_BUDGET_S", 3000)) - 250
+        )
+    except ValueError:
+        control_budget_ok = True
+    if (
+        os.environ.get("KMAMIZ_BENCH_CONTROL", "1") != "0"
+        and control_budget_ok
+    ):
+        import subprocess
+
+        try:
+            from kmamiz_tpu import control as ctl_plane
+
+            saved_ctl = {
+                k: os.environ.get(k)
+                for k in ("KMAMIZ_CONTROL", "KMAMIZ_CONTROL_SLO_MS")
+            }
+            os.environ["KMAMIZ_CONTROL"] = "1"
+            os.environ["KMAMIZ_CONTROL_SLO_MS"] = "250"
+            try:
+                ctl_plane.reset_for_tests()
+                decide_walls = []
+                for i in range(64):
+                    view = ctl_plane.ForecastView(
+                        tenant="bench",
+                        p99_ms=120.0 + (i % 7) * 40.0,
+                        cost_ms=900.0 + i,
+                        attributions=(
+                            ("svc-a", "svc-b", 0.4 + (i % 3) * 0.2),
+                        ),
+                    )
+                    t0 = time.perf_counter()
+                    ctl_plane.ingest_forecast(view)
+                    decide_walls.append((time.perf_counter() - t0) * 1000)
+                # the admission read is sub-µs: time a 1000-call loop and
+                # charge the mean per call (single-call walls are all
+                # clock resolution)
+                tick_req = {"uniqueId": "bench", "lookBack": 30_000}
+                reads = 1000
+                t0 = time.perf_counter()
+                for _ in range(reads):
+                    ctl_plane.admission_verdict("bench", tick_req)
+                overhead_ms = (time.perf_counter() - t0) * 1000 / reads
+            finally:
+                for k, v in saved_ctl.items():
+                    if v is None:
+                        os.environ.pop(k, None)
+                    else:
+                        os.environ[k] = v
+                ctl_plane.reset_for_tests()
+
+            cf_out = subprocess.run(
+                [
+                    sys.executable,
+                    "tools/scenario_soak.py",
+                    "--counterfactual",
+                    "--seed",
+                    "0",
+                    "--ticks",
+                    "8",
+                ],
+                capture_output=True,
+                text=True,
+                timeout=600,
+            )
+            cf = json.loads(cf_out.stdout.strip().splitlines()[-1])
+            control_extras = {
+                "control_decision_ms": round(
+                    float(np.median(decide_walls)), 4
+                ),
+                "control_tick_overhead_ms": round(overhead_ms, 5),
+                "control_counterfactual_prevented": cf[
+                    "control_counterfactual_prevented"
+                ],
+                "control_counterfactual_pass": cf["counterfactual_pass"],
+            }
+        except Exception as err:  # noqa: BLE001 - extra, not headline
+            control_extras["control_error"] = (
+                f"{type(err).__name__}: {err}"[:300]
+            )
+
     e2e_extras = {}
     headline = None
     if e2e_phases is not None:
@@ -1754,6 +1852,7 @@ def main() -> None:
         **chaos_extras,
         **tenancy_extras,
         **scenario_extras,
+        **control_extras,
         "chained_iters": ITERS,
         "tunnel_rtt_ms": round(rtt * 1000, 1),
         "packing_host_ms": round(packing_host_ms, 1),

@@ -31,6 +31,13 @@ DEFAULT_SEED_MODULES = (
     # its quantile forward inside the forecast route — both hot
     "kmamiz_tpu/models/stlgt/trainer.py",
     "kmamiz_tpu/models/stlgt/serving.py",
+    # graftpilot: admission_verdict runs on the serving edge and the
+    # decision recompute inside the tick's fold path — hot by seed so
+    # the hot-path rules cover the whole control plane
+    "kmamiz_tpu/control/__init__.py",
+    "kmamiz_tpu/control/admission.py",
+    "kmamiz_tpu/control/policy.py",
+    "kmamiz_tpu/control/warmup.py",
 )
 
 

@@ -727,6 +727,10 @@ _STATE_SCOPES = (
     # from the processor's fold path while /model/forecast and
     # /model/stlgt read it from server threads
     "kmamiz_tpu/models/stlgt/",
+    # the graftpilot controller's decision stores (admission states,
+    # cost table, warmed-breaker sets) are swapped from the fold path
+    # while every serving thread reads verdicts per tick
+    "kmamiz_tpu/control/",
 )
 
 

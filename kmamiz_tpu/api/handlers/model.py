@@ -207,7 +207,21 @@ class ModelHandler(IRequestHandler):
                 },
             )
         horizon = req.query_int("horizon") or 1
-        horizon = max(1, min(int(horizon), 24))
+        horizon = max(1, int(horizon))
+        # sqrt-H widening has no natural ceiling: an absurd H would
+        # widen p99 past any plausible latency (and make forecast-driven
+        # admission control shed everything). Beyond the configured max
+        # the request is a caller error, not a forecast.
+        hmax = stlgt_pkg.horizon_max()
+        if horizon > hmax:
+            return Response(
+                status=400,
+                payload={
+                    "error": f"horizon {horizon} exceeds "
+                    f"KMAMIZ_STLGT_HORIZON_MAX={hmax}: sqrt-horizon "
+                    "widening is not meaningful that far out"
+                },
+            )
         if (qsel != "all" or horizon != 1) and live is None:
             # the quantile/horizon surface is STLGT's: without a
             # refreshed trainer there is no last-good to fall back to

@@ -244,6 +244,50 @@ class Settings:
             "KMAMIZ_STLGT_QUANTILES", "0.5,0.95,0.99"
         )
     )  # the three forecast quantile levels (comma list, ascending)
+    stlgt_horizon_max: int = field(
+        default_factory=lambda: int(
+            os.environ.get("KMAMIZ_STLGT_HORIZON_MAX", "24")
+        )
+    )  # upper clamp on ?horizon= sqrt-widening; the route 400s beyond
+
+    # graftpilot control plane (kmamiz_tpu/control/, docs/CONTROL.md).
+    # The controller reads these env vars directly at decision time
+    # (fold cadence); the fields mirror them so one `Settings()` dump
+    # shows everything.
+    control_enabled: bool = field(
+        default_factory=lambda: os.environ.get("KMAMIZ_CONTROL", "0")
+        not in ("0", "false", "")
+    )  # master gate for the forecast-driven control plane (default OFF)
+    control_slo_ms: float = field(
+        default_factory=lambda: float(
+            os.environ.get("KMAMIZ_CONTROL_SLO_MS", "250")
+        )
+    )  # forecast-p99 SLO; KMAMIZ_CONTROL_SLO_MS_<TENANT> overrides
+    control_hysteresis: int = field(
+        default_factory=lambda: int(
+            os.environ.get("KMAMIZ_CONTROL_HYSTERESIS", "2")
+        )
+    )  # consecutive evals to enter AND leave shedding (no-flap)
+    control_warmup_gate: float = field(
+        default_factory=lambda: float(
+            os.environ.get("KMAMIZ_CONTROL_WARMUP_GATE", "0.5")
+        )
+    )  # attribution score arming proactive breaker warm-up
+    control_mode: str = field(
+        default_factory=lambda: os.environ.get(
+            "KMAMIZ_CONTROL_MODE", "defer"
+        )
+    )  # defer (serve last-good, marked) or shed (429) on admission
+    control_horizon: int = field(
+        default_factory=lambda: int(
+            os.environ.get("KMAMIZ_CONTROL_HORIZON", "1")
+        )
+    )  # hours-ahead forecast admission judges (clamped to horizon max)
+    control_probe_s: float = field(
+        default_factory=lambda: float(
+            os.environ.get("KMAMIZ_CONTROL_PROBE_S", "1.0")
+        )
+    )  # shortened breaker probe cooldown while warmed
 
     def __post_init__(self) -> None:
         k8s_host = os.environ.get("KUBERNETES_SERVICE_HOST")

@@ -1,0 +1,398 @@
+"""graftpilot — forecast-driven control plane (docs/CONTROL.md).
+
+Closes the loop from STLGT prediction to serving action with three
+levers, each a thin facade over a pure decision core:
+
+- predictive admission control (control/admission.py): shed (429) or
+  defer (serve last-good, marked ``deferred``) a tenant's low-priority
+  ticks when its forecasted p99 crosses KMAMIZ_CONTROL_SLO_MS, with
+  hysteresis so a noisy forecast cannot flap admission;
+- attribution-guided breaker warm-up (control/warmup.py): pre-trip the
+  breakers for the upstream edges STLGT's neighbor-bias gates blame,
+  before the cascade lands, auto-reverting when attribution drops;
+- forecast-aware tick scheduling (control/policy.py): order the
+  TickRouter's gather-window batch by predicted per-tenant cost.
+
+Timing contract: every decision is a pure function of (forecast
+snapshot, config) computed HERE, at fold/refresh boundaries, under the
+``control-decide`` profiling phase. The serving edge reads stored
+verdicts — ``admission_verdict`` is one env check plus one dict lookup,
+no device work, no formatting, no clock reads beyond the graftprof
+helpers — so the warm tick stays compile-free and host-sync-free (the
+transfer-guard test pins this with the controller enabled).
+
+Gated off by default: KMAMIZ_CONTROL=1 enables the whole plane.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, Optional, Tuple
+
+from kmamiz_tpu.control import admission, policy, warmup
+from kmamiz_tpu.telemetry.profiling import events as prof_events
+from kmamiz_tpu.telemetry.registry import REGISTRY
+
+# ---------------------------------------------------------------------------
+# metrics: all handles preallocated at import time (the admission check
+# runs on the serving edge — hot-path-metric-label forbids per-call
+# handle acquisition or label formatting there)
+# ---------------------------------------------------------------------------
+_ADMISSION_FAMILY = REGISTRY.counter_family(
+    "kmamiz_control_admission_total",
+    "Tick admission outcomes decided at the serving edge",
+    ("action",),
+)
+_ADMISSION_HANDLES = {
+    action: _ADMISSION_FAMILY.handle(action)
+    for action in (admission.ALLOW, admission.DEFER, admission.SHED)
+}
+WARMUPS = REGISTRY.counter(
+    "kmamiz_control_warmups_total",
+    "Breakers proactively warmed (pre-tripped half-open) by attribution",
+)
+WARMUP_REVERTS = REGISTRY.counter(
+    "kmamiz_control_warmup_reverts_total",
+    "Warmed breakers reverted after attribution mass dropped",
+)
+SHEDDING_TENANTS = REGISTRY.gauge(
+    "kmamiz_control_shedding_tenants",
+    "Tenants currently in the shed/defer admission posture",
+)
+PREVENTED_VIOLATIONS = REGISTRY.gauge(
+    "kmamiz_control_prevented_violations",
+    "SLO violations prevented in the last counterfactual run (ON vs OFF)",
+)
+DECIDE_MS = REGISTRY.histogram(
+    "kmamiz_control_decide_ms",
+    "Controller decision latency per forecast ingest (fold boundary)",
+)
+
+
+# ---------------------------------------------------------------------------
+# config: read per decision (fold cadence), never per tick
+# ---------------------------------------------------------------------------
+def enabled() -> bool:
+    """Master gate — the control plane is opt-in (KMAMIZ_CONTROL=1)."""
+    return os.environ.get("KMAMIZ_CONTROL", "0") not in ("0", "false", "")
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, str(default)))
+    except ValueError:
+        return default
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, str(default)))
+    except ValueError:
+        return default
+
+
+def slo_ms(tenant: Optional[str] = None) -> float:
+    """Forecast-p99 SLO threshold; per-tenant override via
+    KMAMIZ_CONTROL_SLO_MS_<TENANT> (tenant uppercased, non-alnum -> _)."""
+    base = _env_float("KMAMIZ_CONTROL_SLO_MS", 250.0)
+    if not tenant:
+        return base
+    slug = "".join(c if c.isalnum() else "_" for c in tenant).upper()
+    override = os.environ.get(f"KMAMIZ_CONTROL_SLO_MS_{slug}")
+    if override is None:
+        return base
+    try:
+        return float(override)
+    except ValueError:
+        return base
+
+
+def hysteresis_ticks() -> int:
+    """Consecutive breaching (or clear) evaluations required to enter
+    (or leave) shedding — the no-flap knob."""
+    return max(1, _env_int("KMAMIZ_CONTROL_HYSTERESIS", 2))
+
+
+def warmup_gate_threshold() -> float:
+    """Attribution score that arms proactive breaker warm-up."""
+    return _env_float("KMAMIZ_CONTROL_WARMUP_GATE", 0.5)
+
+
+def probe_cooldown_s() -> float:
+    """Shortened breaker probe window while warmed."""
+    return _env_float("KMAMIZ_CONTROL_PROBE_S", 1.0)
+
+
+def mode() -> str:
+    """defer (serve last-good, marked) or shed (429) on admission."""
+    got = os.environ.get("KMAMIZ_CONTROL_MODE", admission.DEFER).lower()
+    return got if got in admission.MODES else admission.DEFER
+
+
+def control_horizon() -> int:
+    """Forecast horizon (hours ahead) admission judges against, clamped
+    to the same KMAMIZ_STLGT_HORIZON_MAX the /model/forecast route
+    enforces."""
+    from kmamiz_tpu.models import stlgt
+
+    return max(1, min(_env_int("KMAMIZ_CONTROL_HORIZON", 1),
+                      stlgt.horizon_max()))
+
+
+# ---------------------------------------------------------------------------
+# the controller
+# ---------------------------------------------------------------------------
+@dataclass(frozen=True)
+class ForecastView:
+    """The controller's sole input: one tenant's forecast, reduced to
+    what the three levers need. Built from an STLGT forward at the fold
+    boundary (``on_fold``) or synthesized directly (the counterfactual
+    harness and tests feed views through ``ingest_forecast``)."""
+
+    tenant: str
+    p99_ms: float  # worst endpoint forecast p99 at the control horizon
+    cost_ms: float  # total predicted latency mass (scheduling policy)
+    attributions: Tuple[warmup.Attribution, ...] = field(default=())
+    version: int = 0  # STLGT params version (observability only)
+
+
+class Controller:
+    """Process-wide decision store. ``ingest`` runs the pure cores and
+    swaps the per-tenant stores under a lock; readers take the lock for
+    one dict lookup. Breaker warm-up side effects are applied inside
+    ``ingest`` — fold cadence, never the warm tick."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._admission: Dict[str, admission.AdmissionState] = {}
+        self._costs: Dict[str, float] = {}
+        self._warmed: Dict[str, FrozenSet[str]] = {}
+        self._ingests = 0
+
+    def ingest(self, view: ForecastView) -> dict:
+        t0 = prof_events.now_ms()
+        adm_cfg = admission.AdmissionConfig(
+            slo_ms=slo_ms(view.tenant),
+            hysteresis=hysteresis_ticks(),
+            mode=mode(),
+        )
+        warm_cfg = warmup.WarmupConfig(
+            gate_threshold=warmup_gate_threshold(),
+            probe_cooldown_s=probe_cooldown_s(),
+        )
+        warm_decision = warmup.evaluate(view.attributions, warm_cfg)
+        with self._lock:
+            prev = self._admission.get(view.tenant)
+            prev_warm = self._warmed.get(view.tenant, frozenset())
+        state = admission.step(prev, view.p99_ms, adm_cfg)
+        warmed = warmup.apply(
+            view.tenant, warm_decision, warm_cfg, prev_warm
+        )
+        with self._lock:
+            self._admission[view.tenant] = state
+            self._costs[view.tenant] = float(view.cost_ms)
+            self._warmed[view.tenant] = warmed
+            self._ingests += 1
+            shedding = sum(1 for s in self._admission.values() if s.active)
+        newly_warmed = warmed - prev_warm
+        reverted = prev_warm - warmed
+        if newly_warmed:
+            WARMUPS.inc(len(newly_warmed))
+        if reverted:
+            WARMUP_REVERTS.inc(len(reverted))
+        SHEDDING_TENANTS.set(float(shedding))
+        DECIDE_MS.observe(prof_events.now_ms() - t0)
+        return {
+            "tenant": view.tenant,
+            "action": state.action,
+            "active": state.active,
+            "forecastP99Ms": round(state.forecast_p99_ms, 3),
+            "sloMs": state.slo_ms,
+            "warmed": sorted(warmed),
+            "attributionMass": round(warm_decision.mass, 4),
+        }
+
+    def admission_state(
+        self, tenant: str
+    ) -> Optional[admission.AdmissionState]:
+        with self._lock:
+            return self._admission.get(tenant)
+
+    def predicted_costs(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(self._costs)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "ingests": self._ingests,
+                "tenants": {
+                    t: {
+                        **s.as_dict(),
+                        "predictedCostMs": round(
+                            self._costs.get(t, 0.0), 3
+                        ),
+                        "warmedBreakers": sorted(
+                            self._warmed.get(t, frozenset())
+                        ),
+                    }
+                    for t, s in sorted(self._admission.items())
+                },
+            }
+
+
+_CONTROLLER: Optional[Controller] = None
+_CONTROLLER_LOCK = threading.Lock()
+
+
+def get_controller() -> Controller:
+    global _CONTROLLER
+    with _CONTROLLER_LOCK:
+        if _CONTROLLER is None:
+            _CONTROLLER = Controller()
+        return _CONTROLLER
+
+
+def reset_for_tests() -> None:
+    """Drop the controller singleton (conftest autouse): admission
+    states, cost tables, and warmed-breaker tracking all start clean."""
+    global _CONTROLLER
+    with _CONTROLLER_LOCK:
+        _CONTROLLER = None
+
+
+def ingest_forecast(view: ForecastView) -> dict:
+    """Public decision entry: one control evaluation for one tenant.
+    Both the processor's fold hook and the counterfactual harness feed
+    forecasts through here, so ON/OFF runs exercise the same code."""
+    return get_controller().ingest(view)
+
+
+def predicted_costs() -> Dict[str, float]:
+    """Latest per-tenant predicted cost table for the scheduling
+    policy; empty until a forecast has been ingested."""
+    ctl = _CONTROLLER
+    return ctl.predicted_costs() if ctl is not None else {}
+
+
+def snapshot() -> dict:
+    """Controller posture for /timings and debugging surfaces."""
+    ctl = _CONTROLLER
+    base = {"enabled": enabled(), "mode": mode()}
+    if ctl is None:
+        return {**base, "ingests": 0, "tenants": {}}
+    return {**base, **ctl.snapshot()}
+
+
+def admission_verdict(tenant: str, request: object) -> Optional[dict]:
+    """Serving-edge admission read: None admits; otherwise a verdict
+    dict with action defer|shed for the response surface.
+
+    Hot-path posture: one env read, one lock-guarded dict lookup, no
+    allocation on the admit path beyond the env string compare. High
+    priority ticks (``"priority": "high"`` in the tick request) always
+    bypass — admission only defers/sheds low-priority work."""
+    if not enabled():
+        return None
+    ctl = _CONTROLLER
+    if ctl is None:  # nothing decided yet: admit (fail open)
+        return None
+    state = ctl.admission_state(tenant)
+    if state is None or not state.active:
+        _ADMISSION_HANDLES[admission.ALLOW].inc()
+        return None
+    if (
+        isinstance(request, dict)
+        and str(request.get("priority", "")).lower() == "high"
+    ):
+        _ADMISSION_HANDLES[admission.ALLOW].inc()
+        return None
+    _ADMISSION_HANDLES[state.action].inc()
+    return {
+        "action": state.action,
+        "forecastP99Ms": round(state.forecast_p99_ms, 3),
+        "sloMs": state.slo_ms,
+    }
+
+
+# ---------------------------------------------------------------------------
+# fold-boundary hook: forecast snapshot -> view -> decisions
+# ---------------------------------------------------------------------------
+def view_from_forecast(
+    tenant: str,
+    q_ms,
+    gate,
+    snap: dict,
+    version: int = 0,
+    horizon: Optional[int] = None,
+) -> ForecastView:
+    """Reduce an STLGT quantile forward to a ForecastView: worst-case
+    endpoint p99 (sqrt-horizon widened, the /model/forecast rule), the
+    tenant's total predicted latency mass, and the top attribution
+    edges above zero. Pure numpy on already-fetched host arrays."""
+    import numpy as np
+
+    q_ms = np.asarray(q_ms, dtype=np.float32)
+    h = control_horizon() if horizon is None else max(1, int(horizon))
+    p99 = q_ms[:, 2]
+    if h > 1:
+        # docs/STLGT.md#horizon: independent-increments tail widening
+        p99 = q_ms[:, 0] + (p99 - q_ms[:, 0]) * float(np.sqrt(h))
+    p99 = np.clip(p99, 0.0, None)
+    names = snap["names"]
+    n = len(names)
+    edge_mask = np.asarray(snap["mask"], dtype=bool)
+    src_ids = np.asarray(snap["src"])
+    dst_ids = np.asarray(snap["dst"])
+    gate = np.asarray(gate, dtype=np.float32)
+    attributions = []
+    for e in np.argsort(-gate):
+        if len(attributions) >= 20:
+            break
+        e = int(e)
+        if not edge_mask[e] or gate[e] <= 0.0:
+            continue
+        s, d = int(src_ids[e]), int(dst_ids[e])
+        if s >= n or d >= n:
+            continue
+        attributions.append((str(names[s]), str(names[d]), float(gate[e])))
+    return ForecastView(
+        tenant=tenant,
+        p99_ms=float(p99.max()) if p99.size else 0.0,
+        cost_ms=policy.predicted_cost_ms(p99[:n].tolist()),
+        attributions=tuple(attributions),
+        version=int(version),
+    )
+
+
+def on_fold(tenant: str, snap: Optional[dict]) -> Optional[dict]:
+    """Fold-boundary recompute: run the live STLGT forward over the
+    freshly published forecast snapshot and ingest the resulting view.
+    No-op unless the control plane is enabled AND the trainer has
+    last-good params. Called from the processor's hour fold (off the
+    warm tick) under the ``control-decide`` phase so decision cost
+    shows up in graftprof attribution."""
+    if not enabled() or snap is None:
+        return None
+    from kmamiz_tpu.models import stlgt
+    from kmamiz_tpu.telemetry.tracing import phase_span
+
+    live = stlgt.serving_params()
+    if live is None:
+        return None
+    with phase_span("control-decide"):
+        from kmamiz_tpu.models.stlgt import serving as stlgt_serving
+
+        q_ms, _prob, gate = stlgt_serving.quantile_forward(
+            live["params"],
+            snap["features"],
+            snap["src"],
+            snap["dst"],
+            snap["mask"],
+            live["model"],
+        )
+        view = view_from_forecast(
+            tenant or "default", q_ms, gate, snap, version=live["version"]
+        )
+        return ingest_forecast(view)

@@ -17,6 +17,7 @@ from kmamiz_tpu.models.stlgt import model, serving, trainer  # noqa: F401
 from kmamiz_tpu.models.stlgt.trainer import (  # noqa: F401
     enabled,
     get_trainer,
+    horizon_max,
     on_fold,
     reset_for_tests,
     serving_params,

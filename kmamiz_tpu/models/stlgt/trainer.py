@@ -102,6 +102,15 @@ def enabled() -> bool:
     return os.environ.get("KMAMIZ_STLGT", "0") not in ("0", "false", "")
 
 
+def horizon_max() -> int:
+    """KMAMIZ_STLGT_HORIZON_MAX (default 24): upper clamp on the
+    ``/model/forecast?horizon=`` sqrt-widening AND on the control
+    plane's KMAMIZ_CONTROL_HORIZON. Beyond this the widened p99 grows
+    past any plausible latency — the route 400s rather than serving a
+    forecast that would make admission control shed everything."""
+    return max(1, _env_int("KMAMIZ_STLGT_HORIZON_MAX", 24))
+
+
 def configured_quantiles() -> Tuple[float, ...]:
     """KMAMIZ_STLGT_QUANTILES as a sorted tuple, default (.5,.95,.99)."""
     raw = os.environ.get("KMAMIZ_STLGT_QUANTILES", "")

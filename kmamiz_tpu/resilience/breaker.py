@@ -17,6 +17,13 @@ The clock is injectable (chaos harness / tests advance a fake clock);
 state transitions serialize on a per-breaker lock. Breakers register in
 a process-wide registry so `breaker_states()` can surface every
 breaker's state in the /health `resilience` section.
+
+graftpilot (docs/CONTROL.md) adds proactive *warm-up*: when STLGT
+attribution blames an upstream before a cascade lands, ``warm_up()``
+pre-trips a CLOSED breaker into a warmed HALF_OPEN with a shortened
+probe cooldown and a one-failure trip wire; ``revert_warm_up()``
+restores the configured posture when attribution mass drops. Warm-up
+never overrides a breaker that opened on real failures.
 """
 from __future__ import annotations
 
@@ -85,7 +92,14 @@ class CircuitBreaker:
         self._consecutive_failures = 0
         self._opened_at: Optional[float] = None
         self._half_open_inflight = 0
-        self._stats = {"opens": 0, "shortCircuits": 0, "failures": 0}
+        self._warmed = False
+        self._saved_cooldown_s: Optional[float] = None
+        self._stats = {
+            "opens": 0,
+            "shortCircuits": 0,
+            "failures": 0,
+            "warmUps": 0,
+        }
 
     # -- state machine -------------------------------------------------------
 
@@ -151,9 +165,12 @@ class CircuitBreaker:
                 )
                 self._trip_locked()
                 tripped = True
-            elif (
-                state == CLOSED
-                and self._consecutive_failures >= self.threshold
+            elif state == CLOSED and (
+                self._consecutive_failures >= self.threshold
+                # warmed by forecast attribution: the first real failure
+                # of the predicted cascade trips immediately instead of
+                # burning the full consecutive-failure budget
+                or self._warmed
             ):
                 self._trip_locked()
                 tripped = True
@@ -170,6 +187,46 @@ class CircuitBreaker:
         self._state = OPEN
         self._opened_at = self._now()
         self._stats["opens"] += 1
+
+    # -- graftpilot warm-up (control/warmup.py) ------------------------------
+
+    def warm_up(self, probe_cooldown_s: float) -> bool:
+        """Pre-trip into a warmed HALF_OPEN with a shortened probe
+        cooldown. Only a CLOSED breaker warms (True) — OPEN/HALF_OPEN
+        from real failures already outranks the forecast (False). While
+        warmed, a single failure trips regardless of `threshold`, and
+        the shortened cooldown keeps probe latency low until
+        ``revert_warm_up()`` restores the configured posture."""
+        with self._lock:
+            if self._state_locked() != CLOSED:
+                return False
+            if not self._warmed:
+                self._saved_cooldown_s = self.cooldown_s
+            self._warmed = True
+            self.cooldown_s = max(0.0, float(probe_cooldown_s))
+            self._state = HALF_OPEN
+            self._half_open_inflight = 0
+            self._stats["warmUps"] += 1
+            return True
+
+    def revert_warm_up(self) -> None:
+        """Undo ``warm_up``: restore the configured cooldown and return
+        a clean warmed HALF_OPEN to CLOSED. A breaker that tripped on a
+        real failure while warmed keeps its open/half-open state (with
+        the configured cooldown back in force)."""
+        with self._lock:
+            if not self._warmed:
+                return
+            self._warmed = False
+            if self._saved_cooldown_s is not None:
+                self.cooldown_s = self._saved_cooldown_s
+                self._saved_cooldown_s = None
+            if (
+                self._state_locked() == HALF_OPEN
+                and self._consecutive_failures == 0
+            ):
+                self._state = CLOSED
+                self._half_open_inflight = 0
 
     def call(self, fn: Callable, *args, **kwargs):
         """allow() -> fn() -> record_{success,failure}. The upstream's
@@ -194,6 +251,8 @@ class CircuitBreaker:
                 "opens": self._stats["opens"],
                 "failures": self._stats["failures"],
                 "shortCircuits": self._stats["shortCircuits"],
+                "warmed": self._warmed,
+                "warmUps": self._stats["warmUps"],
             }
 
 
@@ -238,6 +297,20 @@ def breaker_states(tenant=None) -> Dict[str, dict]:
             if name.startswith(prefix)
         }
     return {name: b.snapshot() for name, b in breakers.items()}
+
+
+def breakers_for(tenant=None) -> Dict[str, CircuitBreaker]:
+    """Live breaker objects scoped by ownership: the default tenant owns
+    the unprefixed process-wide names, a non-default tenant its
+    ``<tenant>:``-prefixed entries. graftpilot's warm-up reconciles a
+    tenant's breakers against exactly this set, so warming tenant A can
+    never touch tenant B's failure budgets."""
+    with _REGISTRY_LOCK:
+        breakers = dict(_REGISTRY)
+    if tenant in (None, "", "default"):
+        return {k: b for k, b in breakers.items() if ":" not in k}
+    prefix = f"{tenant}:"
+    return {k: b for k, b in breakers.items() if k.startswith(prefix)}
 
 
 def reset_tenant(tenant: str) -> None:

@@ -18,6 +18,7 @@ from kmamiz_tpu.scenarios.factory import (
 from kmamiz_tpu.scenarios.labeled import labeled_windows
 from kmamiz_tpu.scenarios.runner import (
     recorded_runs,
+    run_counterfactual,
     run_matrix,
     run_scenario,
 )
@@ -51,6 +52,7 @@ __all__ = [
     "labeled_windows",
     "recorded_runs",
     "reset_for_tests",
+    "run_counterfactual",
     "run_matrix",
     "run_scenario",
     "scenario_matrix",

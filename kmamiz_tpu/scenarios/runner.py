@@ -378,13 +378,14 @@ def run_scenario(
 def _reset_shared_state() -> None:
     """Per-scenario isolation: fresh breaker budgets, a fresh quarantine
     binding (the default instance caches its directory at first use), a
-    fresh tenant arena."""
+    fresh tenant arena, a fresh graftpilot controller."""
+    from kmamiz_tpu import control, tenancy
     from kmamiz_tpu.resilience import breaker, quarantine
-    from kmamiz_tpu import tenancy
 
     breaker.reset_for_tests()
     quarantine.reset_for_tests()
     tenancy.reset_for_tests()
+    control.reset_for_tests()
 
 
 def _run_scenario_inner(spec: ScenarioSpec, tmpdir: str, verbose: bool) -> dict:
@@ -827,6 +828,313 @@ def _reference_signatures(spec: ScenarioSpec, state: dict) -> Dict[str, str]:
                     )
             sigs[plan.tenant] = graph_signature(ref.graph)
     return sigs
+
+
+# -- graftpilot counterfactual (docs/CONTROL.md#counterfactual) --------------
+
+#: span-content SLO for the counterfactual runs: between the baseline
+#: window p99 (~1.3 ms: 1_000 + hop*37 µs spans) and the smallest
+#: cascade boost (multiplier 2 -> +10 ms), so OFF always violates on
+#: cascade ticks and never elsewhere
+CF_SLO_MS = 5.0
+
+#: the "all clear" forecast published outside the cascade window
+CF_CLEAR_P99_MS = 1.2
+
+
+def _window_p99_ms(groups: List[List[dict]]) -> float:
+    """Span-content p99 of one tick window, in ms (span ``duration`` is
+    µs). Pure arithmetic over the composed content — the violation
+    oracle both counterfactual runs share."""
+    from kmamiz_tpu.telemetry.slo import percentile
+
+    durs = sorted(
+        span["duration"] / 1000.0 for group in groups for span in group
+    )
+    return percentile(durs, 0.99)
+
+
+def _breach_ticks(plan) -> List[int]:
+    """Ticks whose storyline view carries a cascade latency boost — the
+    ticks an oracle forecast flags, and (with hysteresis 1) exactly the
+    ticks the ON run defers."""
+    return [
+        t
+        for t in range(len(plan.traffic))
+        if _tick_view(plan, t)["latency_us"] > 0
+    ]
+
+
+def _counterfactual_run(
+    spec: ScenarioSpec,
+    control_on: bool,
+    forecast_p99_ms: float,
+    attributions: Tuple,
+    tmpdir: str,
+) -> dict:
+    """One arm of the counterfactual: the cascade storyline against a
+    real server, driven serially, with the control plane ON or OFF. The
+    ON arm publishes the oracle forecast through the same
+    ``ingest_forecast`` entry the fold hook uses, one evaluation before
+    each tick; everything else — spec, windows, seeds — is identical."""
+    from kmamiz_tpu import control
+    from kmamiz_tpu.core import programs
+    from kmamiz_tpu.resilience import breaker as breaker_mod
+    from kmamiz_tpu.resilience.chaos import graph_signature
+    from kmamiz_tpu.server.dp_server import DataProcessorServer, _make_runtime
+    from kmamiz_tpu.server.processor import DataProcessor
+    from kmamiz_tpu.tenancy.router import TickRouter
+
+    plan = spec.tenants[0]
+    topo = plan.topology
+    tenant = plan.tenant
+    env: Dict[str, Optional[str]] = {
+        "KMAMIZ_TICK_DEADLINE_MS": "0",
+        "KMAMIZ_QUARANTINE_DIR": os.path.join(tmpdir, "quarantine"),
+        "KMAMIZ_INGEST_MAX_BYTES": None,
+        "KMAMIZ_WAL": "0",
+        "KMAMIZ_CONTROL": "1" if control_on else "0",
+        "KMAMIZ_CONTROL_SLO_MS": str(CF_SLO_MS),
+        "KMAMIZ_CONTROL_MODE": "defer",
+        # hysteresis 1: the oracle forecast is noise-free, so admission
+        # must track the cascade window edge-exactly
+        "KMAMIZ_CONTROL_HYSTERESIS": "1",
+        "KMAMIZ_CONTROL_WARMUP_GATE": "0.5",
+        "KMAMIZ_CONTROL_PROBE_S": "0.05",
+    }
+    breach = set(_breach_ticks(plan))
+    run = {
+        "control": control_on,
+        "posts": 0,
+        "violations": 0,
+        "deferred": 0,
+        "shed": 0,
+        "stale": 0,
+        "errors": [],
+    }
+    state: dict = {"expected": {tenant: []}}
+    with contextlib.ExitStack() as stack:
+        stack.enter_context(scoped_env(env))
+        _reset_shared_state()
+        source = _ScenarioSource(tenant)
+        procs = {
+            tenant: DataProcessor(
+                trace_source=source, use_device_stats=False, tenant=tenant
+            )
+        }
+        router = TickRouter(lambda t: _make_runtime(t, procs[t]))
+        server = DataProcessorServer(
+            procs[tenant], host="127.0.0.1", port=0, router=router
+        )
+        server.start()
+        try:
+            # terminal-shape warmup + window-shape rehearsal (the same
+            # compile discipline the scenario loop uses)
+            version_of = _deploy_version_fn(plan, -1)
+            warm = [
+                trace_group(topo, f"{spec.name}-cfwarm", 0, p_i)
+                for p_i in range(len(topo.paths))
+            ]
+            source.push(warm)
+            state["expected"][tenant].append(("collect", warm))
+            status, body, _ms = _post_tick(
+                server.port, tenant, f"{spec.name}-cfwarm"
+            )
+            if status != 200 or body.get("stale"):
+                run["errors"].append("counterfactual warmup failed")
+
+            def tick_window(t: int, name: str) -> List[List[dict]]:
+                view = _tick_view(plan, t)
+                return tick_groups(
+                    topo,
+                    name,
+                    t,
+                    plan.traffic[t],
+                    drop_services=frozenset(view["drop"]),
+                    error_services=frozenset(view["error"]),
+                    version_of=version_of,
+                    latency_boost_us=view["latency_us"],
+                )
+
+            rehearsed = set()
+            for t in range(spec.n_ticks):
+                groups = tick_window(t, f"{spec.name}-cfwr{t}")
+                shape_key = tuple(sorted(len(g) for g in groups))
+                if not groups or shape_key in rehearsed:
+                    continue
+                rehearsed.add(shape_key)
+                source.push(groups)
+                state["expected"][tenant].append(("collect", groups))
+                status, body, _ms = _post_tick(
+                    server.port, tenant, f"{spec.name}-cfwr{t}"
+                )
+                if status != 200 or body.get("stale"):
+                    run["errors"].append(f"counterfactual rehearsal {t} failed")
+
+            if control_on and breach:
+                # the ON arm's deferred windows all drain in ONE collect
+                # at the first clear tick — rehearse that combined window
+                # shape too, or the drain would compile in steady state
+                drain_tick = max(breach) + 1
+                combined: List[List[dict]] = []
+                for t in [*sorted(breach), drain_tick]:
+                    if t < spec.n_ticks:
+                        combined.extend(
+                            tick_window(t, f"{spec.name}-cfdrain{t}")
+                        )
+                if combined:
+                    source.push(combined)
+                    state["expected"][tenant].append(("collect", combined))
+                    status, body, _ms = _post_tick(
+                        server.port, tenant, f"{spec.name}-cfdrain"
+                    )
+                    if status != 200 or body.get("stale"):
+                        run["errors"].append(
+                            "counterfactual drain rehearsal failed"
+                        )
+
+            _ = procs[tenant].graph.capacity
+            snapshot = programs.snapshot()
+
+            for t in range(spec.n_ticks):
+                if control_on:
+                    # the oracle forecast, through the same entry the
+                    # processor's fold hook uses
+                    if t in breach:
+                        control.ingest_forecast(
+                            control.ForecastView(
+                                tenant=tenant,
+                                p99_ms=forecast_p99_ms,
+                                cost_ms=forecast_p99_ms * plan.traffic[t],
+                                attributions=tuple(attributions),
+                            )
+                        )
+                    else:
+                        control.ingest_forecast(
+                            control.ForecastView(
+                                tenant=tenant,
+                                p99_ms=CF_CLEAR_P99_MS,
+                                cost_ms=CF_CLEAR_P99_MS * plan.traffic[t],
+                            )
+                        )
+                groups = tick_window(t, spec.name)
+                source.push(groups)
+                state["expected"][tenant].append(("collect", groups))
+                status, body, _ms = _post_tick(
+                    server.port, tenant, f"{spec.name}-cf{t}"
+                )
+                run["posts"] += 1
+                if status == 429:
+                    run["shed"] += 1
+                elif status != 200:
+                    run["errors"].append(f"cf tick {t}: {status}")
+                elif body.get("deferred"):
+                    run["deferred"] += 1
+                elif body.get("stale"):
+                    run["stale"] += 1
+                    run["errors"].append(f"cf tick {t}: unexpected stale")
+                elif _window_p99_ms(groups) > CF_SLO_MS:
+                    # fresh serve whose own window content breaches the
+                    # SLO — the violation the controller exists to defer
+                    run["violations"] += 1
+
+            run["steady_recompiles"] = sum(
+                programs.new_compiles_since(snapshot).values()
+            )
+            run["signature"] = graph_signature(procs[tenant].graph)
+            lost, missing = _lost_spans(spec, state, procs)
+            run["lost_spans"] = lost
+            run["missing_traces"] = missing[:8]
+            brk = breaker_mod.breakers_for(tenant).get("scenario-upstream")
+            brk_snap = brk.snapshot() if brk is not None else {}
+            run["breaker_warm_ups"] = int(brk_snap.get("warmUps", 0))
+            run["breaker_warmed_at_end"] = bool(brk_snap.get("warmed", False))
+            run["control_snapshot"] = control.snapshot()
+        finally:
+            server.stop()
+        run["ref_signature"] = _reference_signatures(spec, state)[tenant]
+    return run
+
+
+def run_counterfactual(
+    seed: int = 0,
+    index: int = 1,
+    n_ticks: int = 10,
+    verbose: bool = False,
+) -> dict:
+    """The graftpilot validation gate: one seeded cascade storyline run
+    twice — control plane OFF then ON — with an oracle forecast derived
+    from the composed cascade event. Identical spec, identical windows;
+    the only difference is whether anyone acts on the forecast. The
+    scorecard gates ``slo_violations_prevented >= 1`` with zero lost
+    spans, bit-exact reference signatures, and zero steady-state
+    recompiles in both arms."""
+    from kmamiz_tpu import control, native
+    from kmamiz_tpu.scenarios.factory import spec_signature
+    from kmamiz_tpu.scenarios.storyline import cascade_forecast
+
+    if not native.available():
+        raise RuntimeError("counterfactual runner requires the native extension")
+    t_start = time.time()
+    spec = build_scenario("cascade-fanout", seed, index, n_ticks)
+    plan = spec.tenants[0]
+    cascade = next(
+        (ev for ev in plan.events if ev.kind == "cascade"), None
+    )
+    if cascade is None:
+        raise RuntimeError(
+            "cascade storyline disabled (KMAMIZ_SCENARIO_STORYLINES)"
+        )
+    forecast_p99_ms, attributions = cascade_forecast(cascade, plan.topology)
+
+    arms = {}
+    for label, control_on in (("off", False), ("on", True)):
+        with tempfile.TemporaryDirectory(prefix="kmamiz-cf-") as tmp:
+            arms[label] = _counterfactual_run(
+                spec, control_on, forecast_p99_ms, attributions, tmp
+            )
+    off, on = arms["off"], arms["on"]
+
+    prevented = off["violations"] - on["violations"]
+    control.PREVENTED_VIOLATIONS.set(float(max(0, prevented)))
+    gates = {
+        "off_violations_present": off["violations"] >= 1,
+        "violations_prevented": prevented >= 1,
+        "zero_lost_spans": off["lost_spans"] == 0 and on["lost_spans"] == 0,
+        "bit_exact": (
+            off["signature"] == off["ref_signature"]
+            and on["signature"] == on["ref_signature"]
+        ),
+        "zero_steady_recompiles": (
+            off["steady_recompiles"] == 0 and on["steady_recompiles"] == 0
+        ),
+        "breaker_warmed_and_reverted": (
+            on["breaker_warm_ups"] >= 1 and not on["breaker_warmed_at_end"]
+        ),
+        "no_errors": not off["errors"] and not on["errors"],
+    }
+    card = {
+        "name": f"counterfactual-{spec.name}",
+        "archetype": spec.archetype,
+        "spec_signature": spec_signature(spec),
+        "n_ticks": spec.n_ticks,
+        "slo_ms": CF_SLO_MS,
+        "forecast_p99_ms": round(forecast_p99_ms, 3),
+        "cascade_ticks": _breach_ticks(plan),
+        "off": off,
+        "on": on,
+        "slo_violations_prevented": prevented,
+        "gates": gates,
+        "pass": all(gates.values()),
+        "wall_s": round(time.time() - t_start, 1),
+    }
+    if verbose:
+        print(
+            f"{card['name']}: pass={card['pass']} "
+            f"prevented={prevented} gates={gates}",
+            file=sys.stderr,
+        )
+    return card
 
 
 def run_matrix(

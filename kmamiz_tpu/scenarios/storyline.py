@@ -177,6 +177,33 @@ def compose_cascade(
     )
 
 
+def cascade_forecast(
+    event: Event, topo: Topology
+) -> Tuple[float, Tuple[Tuple[str, str, float], ...]]:
+    """The forecast an oracle STLGT would publish ahead of a composed
+    cascade: (p99_ms, attribution edges) as pure functions of the event
+    params. The p99 mirrors the span arithmetic the cascade injects
+    (``topology.trace_group`` boosts span durations by ``5_000 *
+    multiplier`` µs), and the attributions blame the edges along the
+    affected-service chain — exactly what the neighbor-bias gates learn
+    from the storm. The counterfactual harness feeds this to the
+    controller, so ON/OFF runs differ only in whether anyone acts."""
+    if event.kind != "cascade":
+        raise ValueError(f"not a cascade event: {event.kind!r}")
+    affected, multiplier, _root_error = event.params
+    p99_ms = (1_000 + 5_000 * multiplier) / 1000.0
+    edges = [
+        (affected[i], affected[i + 1], 0.95)
+        for i in range(len(affected) - 1)
+    ]
+    if not edges:
+        # single-service storm: blame the root's first downstream edge
+        root = affected[0]
+        down = sorted(downstream_of(topo, root))
+        edges = [(root, down[0] if down else root, 0.95)]
+    return p99_ms, tuple(edges)
+
+
 # -- the other storyline families --------------------------------------------
 
 

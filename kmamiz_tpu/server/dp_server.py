@@ -23,6 +23,7 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
+from kmamiz_tpu import control as ctl_plane
 from kmamiz_tpu.analysis import guards
 from kmamiz_tpu.core import programs
 from kmamiz_tpu.resilience import metrics as res_metrics
@@ -75,6 +76,29 @@ class _LastGoodTick:
         payload["staleAgeMs"] = round(age_ms, 1)
         payload["staleReason"] = reason
         res_metrics.note_stale_serve()
+        return payload
+
+    def serve_deferred(self, unique_id: str, control: dict) -> Optional[dict]:
+        """graftpilot defer (docs/CONTROL.md): the controller predicted
+        this tenant's next tick would breach SLO, so the tick is NOT
+        executed — the last-good payload answers, marked ``deferred``
+        with the controller's verdict attached. Deliberately distinct
+        from serve_stale: a defer is a healthy, chosen degradation, so
+        it touches neither the stale-serve counters nor the tenant
+        stale scorecard (the scenario stale gates stay honest). None
+        when no tick has succeeded yet — callers then fail open and
+        admit the tick."""
+        with self._lock:
+            if self._payload is None:
+                return None
+            payload = dict(self._payload)
+            at_ms = self._at_ms
+        payload["uniqueId"] = unique_id
+        payload["deferred"] = True
+        payload["deferredAgeMs"] = round(
+            max(0.0, prof_events.wall_ms() - at_ms), 1
+        )
+        payload["control"] = control
         return payload
 
 
@@ -248,6 +272,7 @@ def make_handler(processor: DataProcessor, router=None):
                         "resilience": res_metrics.resilience_summary(),
                         "tenancy": router.summary(),
                         "tenants": tel_slo.TENANTS.snapshot(),
+                        "control": ctl_plane.snapshot(),
                     },
                 )
                 return
@@ -398,6 +423,42 @@ def make_handler(processor: DataProcessor, router=None):
             rt = self._runtime(tenant)
             if rt is None:
                 return
+
+            # graftpilot admission (docs/CONTROL.md): the controller's
+            # stored verdict — computed at the last fold boundary, read
+            # here as one dict lookup — decides whether this tick runs.
+            # shed -> explicit 429; defer -> last-good marked deferred
+            # (the skipped window's spans stay queued upstream and drain
+            # on the next admitted tick, so nothing is lost); no
+            # last-good yet -> fail open and admit.
+            verdict = ctl_plane.admission_verdict(tenant, request)
+            if verdict is not None:
+                if verdict["action"] == "shed":
+                    self._send_json(
+                        429,
+                        {
+                            "uniqueId": request.get("uniqueId", ""),
+                            "error": "tick shed: forecasted p99 "
+                            f"{verdict['forecastP99Ms']}ms exceeds SLO "
+                            f"{verdict['sloMs']}ms (KMAMIZ_CONTROL)",
+                            "control": verdict,
+                        },
+                        extra_headers={
+                            "Retry-After": "1",
+                            "X-KMamiz-Control": "shed",
+                        },
+                    )
+                    return
+                deferred = rt.last_good.serve_deferred(
+                    request.get("uniqueId", ""), verdict
+                )
+                if deferred is not None:
+                    self._send_json(
+                        200,
+                        deferred,
+                        extra_headers={"X-KMamiz-Control": "defer"},
+                    )
+                    return
 
             def _tick() -> dict:
                 # opt-in hot-path enforcement: KMAMIZ_TRANSFER_GUARD=1

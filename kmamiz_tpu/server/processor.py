@@ -699,6 +699,18 @@ class DataProcessor:
             _stlgt.on_fold(self.forecast_snapshot)
         except Exception:
             res_metrics.incr("stlgtFoldErrors")
+        # graftpilot recompute (KMAMIZ_CONTROL=1, docs/CONTROL.md):
+        # admission / warm-up / scheduling decisions are pure functions
+        # of (forecast, config) recomputed only here at the fold
+        # boundary — the warm tick reads a stored verdict and never
+        # computes. Same containment posture as the STLGT hook: a
+        # controller fault must not take the fold down.
+        try:
+            from kmamiz_tpu import control as _control
+
+            _control.on_fold(self.tenant, self.forecast_snapshot)
+        except Exception:
+            res_metrics.incr("controlFoldErrors")
 
     # -- history persistence (VERDICT r4 #4) ---------------------------------
 

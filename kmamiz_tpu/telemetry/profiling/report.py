@@ -37,6 +37,9 @@ DEFAULT_THRESHOLDS: Dict[str, float] = {
     "merge": 0.35,
     "native-merge": 0.35,
     "native-merge-lockwait": 0.50,
+    # graftpilot's fold-boundary decision recompute: tiny host-side
+    # work whose absolute cost jitters, so a looser relative bar
+    "control-decide": 0.50,
 }
 _DIFF_ABS_SLACK_MS = 0.5
 

@@ -48,6 +48,11 @@ PHASES = (
     # first-class tick phase so online training shows up in warm tick
     # attribution instead of hiding in the unattributed residue
     "stlgt-refresh",
+    # graftpilot decision recompute (control/, docs/CONTROL.md): runs
+    # at the fold boundary (forecast forward + admission/warm-up/
+    # scheduling decisions), a first-class phase so controller cost is
+    # attributable and gated like any other
+    "control-decide",
 )
 
 _SELFTRACE_NAMESPACE = "graftscope"

@@ -348,6 +348,23 @@ class TickRouter:
             with self._q_lock:
                 batch, self._queue = self._queue, []
                 self._leader_active = False
+            if len(batch) > 1:
+                # graftpilot scheduling lever (control/policy.py): order
+                # the drained window by predicted per-tenant cost so
+                # cheap tenants are not serialized behind a
+                # forecast-expensive one. The cost table was computed at
+                # the last fold boundary — this is a dict lookup plus a
+                # stable sort, nothing forecast-shaped runs here. The
+                # result zip below stays positional against the
+                # reordered batch.
+                from kmamiz_tpu import control
+
+                if control.enabled():
+                    batch = control.policy.order_batch(
+                        batch,
+                        control.predicted_costs(),
+                        lambda it: it.tenant,
+                    )
             try:
                 results = self.batched_collect(
                     [(it.tenant, it.request) for it in batch]

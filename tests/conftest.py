@@ -56,7 +56,7 @@ def _reset_resilience_state():
     breakers, counters, the default quarantine binding). A breaker a
     test trips must not short-circuit the next test's upstream calls, so
     every test starts from a clean slate."""
-    from kmamiz_tpu import scenarios, telemetry, tenancy
+    from kmamiz_tpu import control, scenarios, telemetry, tenancy
     from kmamiz_tpu.models import stlgt
     from kmamiz_tpu.resilience import breaker, metrics, quarantine
 
@@ -67,6 +67,7 @@ def _reset_resilience_state():
     tenancy.reset_for_tests()
     scenarios.reset_for_tests()
     stlgt.reset_for_tests()
+    control.reset_for_tests()
     yield
 
 

@@ -1,0 +1,475 @@
+"""graftpilot control plane (kmamiz_tpu/control/, docs/CONTROL.md).
+
+Pins the forecast-to-action loop end to end:
+
+- admission core: cross-process decision determinism (bit-identical
+  traces), hysteresis no-flap under an oscillating forecast;
+- breaker warm-up: pre-trip/revert unit semantics plus the
+  controller-driven warm -> auto-revert cycle;
+- scheduling policy: deterministic cheap-first batch ordering;
+- serving edge: defer/shed/priority-bypass responses over a real
+  DataProcessorServer, with two-tenant isolation (shedding tenant A
+  never defers or stales tenant B);
+- the /model/forecast horizon clamp (KMAMIZ_STLGT_HORIZON_MAX -> 400);
+- the counterfactual gate (scenarios/runner.run_counterfactual): same
+  seeded cascade ON vs OFF must prevent >= 1 SLO violation with zero
+  lost spans, bit-exact signatures, and zero steady recompiles;
+- timing contract: a warm dp tick with the controller enabled runs
+  under transfer_guard("disallow") with zero new compiles, and the
+  serving-edge admission read stays sub-3%-of-tick cheap.
+"""
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kmamiz_tpu import control
+from kmamiz_tpu.control import admission, policy, warmup
+from kmamiz_tpu.resilience import breaker as breaker_mod
+
+from conftest import prefixed_trace_source
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CFG = admission.AdmissionConfig(slo_ms=250.0, hysteresis=2, mode="defer")
+SEQ = [100.0, 300.0, 260.0, 251.0, 240.0, 500.0, 100.0, 90.0, 80.0, 400.0]
+
+
+# -- admission core -----------------------------------------------------------
+
+
+class TestAdmissionCore:
+    def test_decision_trace_deterministic_in_process(self):
+        a = admission.decision_trace(SEQ, CFG)
+        b = admission.decision_trace(list(SEQ), CFG)
+        assert json.dumps(a, sort_keys=True) == json.dumps(b, sort_keys=True)
+
+    def test_decision_trace_deterministic_across_processes(self):
+        """The determinism oracle: a fresh interpreter replaying the
+        same (sequence, config) must emit a bit-identical trace. The
+        child loads admission.py by file path — the pure core must not
+        depend on any process-global state."""
+        child_src = (
+            "import importlib.util, json, sys\n"
+            "spec = importlib.util.spec_from_file_location("
+            "'adm', sys.argv[1])\n"
+            "adm = importlib.util.module_from_spec(spec)\n"
+            "sys.modules['adm'] = adm\n"
+            "spec.loader.exec_module(adm)\n"
+            "cfg = adm.AdmissionConfig("
+            f"slo_ms={CFG.slo_ms!r}, hysteresis={CFG.hysteresis!r}, "
+            f"mode={CFG.mode!r})\n"
+            f"print(json.dumps(adm.decision_trace({SEQ!r}, cfg), "
+            "sort_keys=True))\n"
+        )
+        out = subprocess.run(
+            [
+                sys.executable,
+                "-c",
+                child_src,
+                os.path.join(REPO_ROOT, "kmamiz_tpu", "control", "admission.py"),
+            ],
+            capture_output=True,
+            text=True,
+            timeout=60,
+        )
+        assert out.returncode == 0, out.stderr
+        here = json.dumps(admission.decision_trace(SEQ, CFG), sort_keys=True)
+        assert out.stdout.strip() == here
+
+    def test_hysteresis_no_flap_under_oscillating_forecast(self):
+        """A forecast oscillating across the SLO every evaluation never
+        builds a streak of 2 — admission must not activate at all."""
+        osc = [300.0 if i % 2 == 0 else 100.0 for i in range(40)]
+        trace = admission.decision_trace(osc, CFG)
+        assert all(not d["active"] for d in trace)
+        assert trace[-1]["transitions"] == 0
+        assert all(d["action"] == admission.ALLOW for d in trace)
+
+    def test_hysteresis_enter_and_leave_streaks(self):
+        seq = [300.0, 300.0, 300.0, 100.0, 100.0, 100.0]
+        trace = admission.decision_trace(seq, CFG)
+        # active only after 2 consecutive breaches...
+        assert [d["active"] for d in trace[:3]] == [False, True, True]
+        # ...and deactivates only after 2 consecutive clears
+        assert [d["active"] for d in trace[3:]] == [True, False, False]
+        assert trace[-1]["transitions"] == 2
+        assert trace[1]["action"] == admission.DEFER
+
+    def test_mode_and_normalization(self):
+        shed_cfg = admission.AdmissionConfig(
+            slo_ms=10.0, hysteresis=0, mode="shed"
+        )
+        state = admission.step(None, 50.0, shed_cfg)  # hysteresis min 1
+        assert state.active and state.action == admission.SHED
+        bad = admission.AdmissionConfig(slo_ms=10.0, hysteresis=1, mode="wat")
+        assert admission.step(None, 50.0, bad).action == admission.DEFER
+
+
+# -- breaker warm-up ----------------------------------------------------------
+
+
+class TestWarmup:
+    def test_evaluate_is_pure_and_sorted(self):
+        cfg = warmup.WarmupConfig(gate_threshold=0.5, probe_cooldown_s=0.1)
+        decision = warmup.evaluate(
+            [("a", "b", 0.6), ("c", "d", 0.9), ("e", "f", 0.2)], cfg
+        )
+        assert decision.warm
+        assert decision.mass == pytest.approx(0.9)
+        assert [a[2] for a in decision.blamed] == [0.9, 0.6]
+        calm = warmup.evaluate([("a", "b", 0.4)], cfg)
+        assert not calm.warm and calm.blamed == ()
+
+    def test_breaker_warm_up_and_revert_unit(self):
+        brk = breaker_mod.get_breaker(
+            "ctl-warm-unit", threshold=5, cooldown_s=30.0
+        )
+        assert brk.warm_up(0.05) is True
+        snap = brk.snapshot()
+        assert snap["state"] == "half-open"
+        assert snap["warmed"] and snap["warmUps"] == 1
+        assert brk.cooldown_s == pytest.approx(0.05)
+        # already warmed (not CLOSED): a second warm is a no-op False
+        assert brk.warm_up(0.05) is False
+        brk.revert_warm_up()
+        snap = brk.snapshot()
+        assert snap["state"] == "closed" and not snap["warmed"]
+        assert brk.cooldown_s == pytest.approx(30.0)
+
+    def test_warmed_breaker_trips_on_single_failure(self):
+        brk = breaker_mod.get_breaker(
+            "ctl-warm-trip", threshold=5, cooldown_s=30.0
+        )
+        brk.warm_up(5.0)  # probe long enough that OPEN can't flip back
+
+        def boom():
+            raise ConnectionError("x")
+
+        with pytest.raises(ConnectionError):
+            brk.call(boom)  # one probe failure re-opens immediately
+        assert brk.snapshot()["state"] == "open"
+
+    def test_controller_drives_warm_then_auto_revert(self, monkeypatch):
+        monkeypatch.setenv("KMAMIZ_CONTROL", "1")
+        monkeypatch.setenv("KMAMIZ_CONTROL_PROBE_S", "0.05")
+        brk = breaker_mod.get_breaker(
+            "upstream", tenant="t1", threshold=5, cooldown_s=30.0
+        )
+        verdict = control.ingest_forecast(
+            control.ForecastView(
+                tenant="t1",
+                p99_ms=10.0,
+                cost_ms=10.0,
+                attributions=(("svc-a", "svc-b", 0.9),),
+            )
+        )
+        assert verdict["warmed"] == ["t1:upstream"]
+        assert brk.snapshot()["warmed"]
+        # attribution mass drops: the controller must revert on its own
+        verdict = control.ingest_forecast(
+            control.ForecastView(tenant="t1", p99_ms=10.0, cost_ms=10.0)
+        )
+        assert verdict["warmed"] == []
+        snap = brk.snapshot()
+        assert not snap["warmed"] and snap["state"] == "closed"
+        assert brk.cooldown_s == pytest.approx(30.0)
+
+
+# -- scheduling policy --------------------------------------------------------
+
+
+class TestPolicy:
+    def test_order_batch_cheap_first_stable(self):
+        items = [("b", 0), ("a", 1), ("c", 2), ("a", 3)]
+        costs = {"a": 5.0, "b": 50.0}  # c unknown -> 0.0
+        got = policy.order_batch(items, costs, lambda it: it[0])
+        assert got == [("c", 2), ("a", 1), ("a", 3), ("b", 0)]
+        # pure: input untouched, repeat identical
+        assert items[0] == ("b", 0)
+        assert got == policy.order_batch(items, costs, lambda it: it[0])
+
+    def test_controller_publishes_cost_table(self, monkeypatch):
+        monkeypatch.setenv("KMAMIZ_CONTROL", "1")
+        assert control.predicted_costs() == {}
+        control.ingest_forecast(
+            control.ForecastView(tenant="a", p99_ms=1.0, cost_ms=42.5)
+        )
+        assert control.predicted_costs() == {"a": 42.5}
+
+
+# -- serving edge over a real server -----------------------------------------
+
+
+class TestAdmissionHTTP:
+    @pytest.fixture
+    def server(self, pdas_traces):
+        from kmamiz_tpu.server.dp_server import DataProcessorServer
+        from kmamiz_tpu.server.processor import DataProcessor
+
+        dp = DataProcessor(
+            trace_source=prefixed_trace_source(pdas_traces, "ctl"),
+            use_device_stats=False,
+        )
+        srv = DataProcessorServer(dp, host="127.0.0.1", port=0)
+        srv.start()
+        yield f"http://127.0.0.1:{srv.port}"
+        srv.stop()
+
+    def _tick(self, base, unique_id, path="", extra=None):
+        body = {
+            "uniqueId": unique_id,
+            "lookBack": 30_000,
+            "time": int(time.time() * 1000),
+            **(extra or {}),
+        }
+        req = urllib.request.Request(
+            base + path,
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                return resp.status, json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+
+    def _breach(self, tenant):
+        return control.ingest_forecast(
+            control.ForecastView(tenant=tenant, p99_ms=50.0, cost_ms=100.0)
+        )
+
+    def _clear(self, tenant):
+        return control.ingest_forecast(
+            control.ForecastView(tenant=tenant, p99_ms=1.0, cost_ms=2.0)
+        )
+
+    @pytest.fixture
+    def control_env(self, monkeypatch):
+        monkeypatch.setenv("KMAMIZ_CONTROL", "1")
+        monkeypatch.setenv("KMAMIZ_CONTROL_SLO_MS", "5")
+        monkeypatch.setenv("KMAMIZ_CONTROL_HYSTERESIS", "1")
+        monkeypatch.setenv("KMAMIZ_CONTROL_MODE", "defer")
+
+    def test_two_tenant_isolation_defer_and_recovery(
+        self, server, control_env
+    ):
+        # establish last-good for both tenants (controller empty: admit)
+        status, body = self._tick(server, "a1", path="/t/alpha/")
+        assert status == 200 and "deferred" not in body
+        status, body = self._tick(server, "b1", path="/t/beta/")
+        assert status == 200
+
+        self._breach("alpha")
+        status, body = self._tick(server, "a2", path="/t/alpha/")
+        assert status == 200
+        assert body.get("deferred") is True
+        assert body["control"]["action"] == "defer"
+        assert "deferredAgeMs" in body
+        # a defer is a chosen degradation, not a stale serve
+        assert not body.get("stale")
+
+        # tenant B must be untouched: fresh, never deferred, never stale
+        status, body = self._tick(server, "b2", path="/t/beta/")
+        assert status == 200
+        assert "deferred" not in body and not body.get("stale")
+
+        # high-priority ticks bypass admission even while active
+        status, body = self._tick(
+            server, "a3", path="/t/alpha/", extra={"priority": "high"}
+        )
+        assert status == 200 and "deferred" not in body
+
+        # forecast clears -> tenant A serves fresh again
+        self._clear("alpha")
+        status, body = self._tick(server, "a4", path="/t/alpha/")
+        assert status == 200 and "deferred" not in body
+
+    def test_shed_mode_returns_429(self, server, control_env, monkeypatch):
+        status, _ = self._tick(server, "s1", path="/t/alpha/")
+        assert status == 200
+        monkeypatch.setenv("KMAMIZ_CONTROL_MODE", "shed")
+        self._breach("alpha")
+        status, body = self._tick(server, "s2", path="/t/alpha/")
+        assert status == 429
+        assert "shed" in body["error"]
+        assert body["control"]["action"] == "shed"
+
+    def test_timings_exposes_control_snapshot(self, server, control_env):
+        self._tick(server, "t1", path="/t/alpha/")
+        self._breach("alpha")
+        with urllib.request.urlopen(server + "/timings", timeout=60) as resp:
+            timings = json.loads(resp.read())
+        ctl = timings["control"]
+        assert ctl["enabled"] is True
+        assert ctl["tenants"]["alpha"]["active"] is True
+
+    def test_disabled_control_never_defers(self, server, monkeypatch):
+        monkeypatch.setenv("KMAMIZ_CONTROL", "0")
+        monkeypatch.setenv("KMAMIZ_CONTROL_SLO_MS", "5")
+        self._tick(server, "d1", path="/t/alpha/")
+        self._breach("alpha")  # state exists, but the gate is off
+        status, body = self._tick(server, "d2", path="/t/alpha/")
+        assert status == 200 and "deferred" not in body
+
+
+# -- /model/forecast horizon clamp -------------------------------------------
+
+
+class TestHorizonClamp:
+    def test_horizon_clamp_after_live_refresh(
+        self, pdas_traces, monkeypatch
+    ):
+        """Beyond KMAMIZ_STLGT_HORIZON_MAX the request is a caller error
+        (400 naming the knob) even with a healthy live trainer; at the
+        max it still serves."""
+        from test_stlgt import _stlgt_ctx
+
+        from kmamiz_tpu.models import stlgt
+
+        monkeypatch.setenv("KMAMIZ_STLGT", "1")
+        monkeypatch.setenv("KMAMIZ_STLGT_HIDDEN", "8")
+        monkeypatch.setenv("KMAMIZ_STLGT_EPOCHS", "1")
+        monkeypatch.setenv("KMAMIZ_STLGT_HISTORY", "2")
+        monkeypatch.setenv("KMAMIZ_STLGT_HORIZON_MAX", "5")
+        stlgt.reset_for_tests()  # rebuild the singleton under these knobs
+        dp, router = _stlgt_ctx(pdas_traces, "hzc")
+        for i in range(3):  # two folds: pending -> example -> refresh
+            dp.collect(
+                {
+                    "uniqueId": f"hz{i}",
+                    "lookBack": 30_000,
+                    "time": (930 + i) * 3_600_000,
+                }
+            )
+        res = router.dispatch("GET", "/api/v1/model/forecast?horizon=6")
+        assert res.status == 400
+        assert "KMAMIZ_STLGT_HORIZON_MAX=5" in res.payload["error"]
+        res = router.dispatch("GET", "/api/v1/model/forecast?horizon=5")
+        assert res.status == 200, res.payload
+        assert res.payload["stlgt"]["horizon"] == 5
+
+
+# -- counterfactual gate ------------------------------------------------------
+
+
+class TestCounterfactual:
+    def test_cascade_forecast_is_pure_spec_content(self):
+        from kmamiz_tpu.scenarios import build_scenario
+        from kmamiz_tpu.scenarios.storyline import cascade_forecast
+
+        spec = build_scenario("cascade-fanout", 0, 1, 8)
+        plan = spec.tenants[0]
+        ev = next(e for e in plan.events if e.kind == "cascade")
+        p99, attrs = cascade_forecast(ev, plan.topology)
+        affected, multiplier, _ = ev.params
+        assert p99 == pytest.approx((1_000 + 5_000 * multiplier) / 1000.0)
+        assert attrs and all(score == 0.95 for _s, _d, score in attrs)
+        # deterministic: same event, same forecast
+        assert (p99, attrs) == cascade_forecast(ev, plan.topology)
+
+    def test_counterfactual_prevents_violations(self):
+        from kmamiz_tpu import native
+        from kmamiz_tpu.scenarios import run_counterfactual
+
+        if not native.available():
+            pytest.skip("scenario runner requires the native extension")
+        card = run_counterfactual(seed=0, n_ticks=8)
+        assert card["pass"], card["gates"]
+        assert card["slo_violations_prevented"] >= 1
+        assert card["off"]["violations"] >= 1
+        assert card["on"]["violations"] == 0
+        assert card["on"]["deferred"] >= 1
+        assert card["off"]["lost_spans"] == 0
+        assert card["on"]["lost_spans"] == 0
+        assert card["off"]["signature"] == card["off"]["ref_signature"]
+        assert card["on"]["signature"] == card["on"]["ref_signature"]
+        assert card["on"]["steady_recompiles"] == 0
+        assert card["on"]["breaker_warm_ups"] >= 1
+        assert not card["on"]["breaker_warmed_at_end"]
+
+
+# -- timing contract ----------------------------------------------------------
+
+
+class TestControlTickContract:
+    def test_warm_tick_with_controller_is_compile_free(self, monkeypatch):
+        """The ISSUE 11 acceptance pin: with the control plane enabled
+        and a live admission state, a warm transfer-guarded tick (plus
+        serving-edge admission reads) compiles nothing and stays
+        bit-exact vs the same tick with control disabled."""
+        monkeypatch.setenv("KMAMIZ_MESH", "0")
+        monkeypatch.setenv("KMAMIZ_CONTROL", "1")
+        monkeypatch.setenv("KMAMIZ_CONTROL_SLO_MS", "250")
+        from kmamiz_tpu.server.processor import DataProcessor
+        from kmamiz_tpu.synth import make_raw_window
+        from kmamiz_tpu.analysis import guards
+
+        control.ingest_forecast(
+            control.ForecastView(tenant="default", p99_ms=10.0, cost_ms=20.0)
+        )
+
+        for seed_t in (0, 10_000):  # warm the compile caches
+            window = json.loads(make_raw_window(60, 5, t_start=seed_t))
+            dp = DataProcessor(trace_source=lambda lb, t, lim: window)
+            dp.collect(
+                {
+                    "uniqueId": f"cw{seed_t}",
+                    "lookBack": 30_000,
+                    "time": 1_000_000 + seed_t,
+                }
+            )
+            dp.graph.n_edges
+
+        window = json.loads(make_raw_window(60, 5, t_start=20_000))
+        request = {
+            "uniqueId": "ctl-guarded",
+            "lookBack": 30_000,
+            "time": 2_000_000,
+        }
+        monkeypatch.setenv("KMAMIZ_CONTROL", "0")
+        dp_ref = DataProcessor(trace_source=lambda lb, t, lim: window)
+        reference = dp_ref.collect(dict(request))
+        dp_ref.graph.n_edges
+        monkeypatch.setenv("KMAMIZ_CONTROL", "1")
+
+        dp_live = DataProcessor(trace_source=lambda lb, t, lim: window)
+        with guards.hot_path_guard("disallow") as report:
+            guarded = dp_live.collect(dict(request))
+            dp_live.graph.n_edges
+            for _ in range(100):  # the per-tick serving-edge read
+                control.admission_verdict("default", request)
+        assert report.new_compiles == {}, report.new_compiles
+
+        def strip(resp):
+            out = dict(resp)
+            out.pop("log", None)
+            return out
+
+        assert json.dumps(
+            strip(guarded), sort_keys=True, default=str
+        ) == json.dumps(strip(reference), sort_keys=True, default=str)
+
+    def test_admission_read_is_cheap(self, monkeypatch):
+        """The serving-edge read must be microseconds — a generous 0.2ms
+        mean bound keeps the 3%-of-tick budget honest without flaking on
+        a loaded CI box (dp_tick is tens of ms)."""
+        monkeypatch.setenv("KMAMIZ_CONTROL", "1")
+        monkeypatch.setenv("KMAMIZ_CONTROL_SLO_MS", "5")
+        control.ingest_forecast(
+            control.ForecastView(tenant="bench", p99_ms=50.0, cost_ms=10.0)
+        )
+        request = {"uniqueId": "x", "lookBack": 30_000}
+        control.admission_verdict("bench", request)  # warm the path
+        reads = 2_000
+        t0 = time.perf_counter()
+        for _ in range(reads):
+            control.admission_verdict("bench", request)
+        mean_ms = (time.perf_counter() - t0) * 1000 / reads
+        assert mean_ms < 0.2, f"admission read {mean_ms:.4f} ms/call"

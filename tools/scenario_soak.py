@@ -25,6 +25,12 @@ headline keys across rounds.
     python tools/scenario_soak.py --matrix 3 --ticks 6  # bench subset
     python tools/scenario_soak.py --scenario kill9-wal-replay
     python tools/scenario_soak.py --list                # compose only
+    python tools/scenario_soak.py --counterfactual      # graftpilot gate
+
+With --counterfactual the seeded cascade scenario runs twice — control
+plane OFF then ON — and the JSON line carries the graftpilot gate keys
+instead (``control_counterfactual_prevented``, ``counterfactual_pass``;
+docs/CONTROL.md#counterfactual).
 """
 from __future__ import annotations
 
@@ -36,6 +42,7 @@ sys.path.insert(0, "/root/repo")
 
 from kmamiz_tpu.scenarios import (  # noqa: E402
     ARCHETYPES,
+    run_counterfactual,
     run_matrix,
     scenario_matrix,
     spec_signature,
@@ -103,7 +110,43 @@ def main(argv=None) -> int:
         help="exit nonzero unless every scenario passes its scorecard "
         "(the default; kept explicit for gate invocations)",
     )
+    ap.add_argument(
+        "--counterfactual",
+        action="store_true",
+        help="run the graftpilot counterfactual gate (cascade scenario "
+        "with the control plane OFF vs ON) instead of the matrix",
+    )
     args = ap.parse_args(argv)
+
+    if args.counterfactual:
+        card = run_counterfactual(
+            seed=args.seed if args.seed is not None else 0,
+            n_ticks=args.ticks if args.ticks is not None else 10,
+            verbose=True,
+        )
+        fails = [k for k, v in card["gates"].items() if not v]
+        print(
+            f"{card['name']}  {'PASS' if card['pass'] else 'FAIL'}  "
+            f"prevented={card['slo_violations_prevented']} "
+            f"off_violations={card['off']['violations']} "
+            f"on_deferred={card['on']['deferred']} "
+            f"lost={card['off']['lost_spans']}+{card['on']['lost_spans']} "
+            f"wall={card['wall_s']}s"
+            f"{'  ' + str(fails) if fails else ''}",
+            file=sys.stderr,
+        )
+        print(
+            json.dumps(
+                {
+                    "counterfactual": card,
+                    "control_counterfactual_prevented": card[
+                        "slo_violations_prevented"
+                    ],
+                    "counterfactual_pass": card["pass"],
+                }
+            )
+        )
+        return 0 if card["pass"] else 1
 
     specs = scenario_matrix(args.seed, args.matrix, args.ticks)
     if args.scenario is not None:

@@ -56,6 +56,11 @@ _EXTRA_GATED = (
     # tick and the served quantile forward behind /model/forecast
     "stlgt_train_tick_ms",
     "stlgt_infer_ms",
+    # graftpilot latency pair (ISSUE 11): the fold-boundary decision
+    # recompute and the serving-edge admission read (must stay within
+    # 3% of dp_tick — bench asserts the ratio, this gates the drift)
+    "control_decision_ms",
+    "control_tick_overhead_ms",
 )
 # boolean pass/fail keys: any True -> False flip is a regression (bool
 # is an int subclass, so the numeric threshold check would wave a
@@ -66,7 +71,7 @@ _BOOL_GATED = ("scenario_matrix_pass",)
 # stlgt_p99_coverage is a [0,1] calibration rate where relative
 # thresholds are meaningless near 1.0 — the gate is absolute: new below
 # old minus the slack regresses
-_FLOOR_GATED = ("stlgt_p99_coverage",)
+_FLOOR_GATED = ("stlgt_p99_coverage", "control_counterfactual_prevented")
 _ABS_SLACK_FLOOR = 0.02
 # absolute slack per key class: rates jitter in the 3rd decimal on tiny
 # denominators, recompile counts are integers, latencies get 0.5 ms

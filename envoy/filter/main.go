@@ -15,7 +15,10 @@
 package main
 
 import (
+	"encoding/binary"
 	"encoding/json"
+	"hash/crc32"
+	"time"
 
 	"github.com/tetratelabs/proxy-wasm-go-sdk/proxywasm"
 	"github.com/tetratelabs/proxy-wasm-go-sdk/proxywasm/types"
@@ -32,15 +35,104 @@ type vmContext struct {
 }
 
 func (*vmContext) NewPluginContext(uint32) types.PluginContext {
-	return &pluginContext{}
+	return &pluginContext{wireFormat: "json", flushSpans: 512}
 }
 
+// pluginContext carries the columnar-ingest emitter state. With
+// wire_format "columnar" the filter batches one span record per HTTP
+// stream and flushes them as a compact "KMZC" SoA frame straight to the
+// DP's /ingest (skipping Zipkin JSON entirely); "json" (default) keeps
+// the legacy log-line telemetry only. Plugin configuration (JSON):
+//
+//	{"wire_format": "columnar",      // or "json"
+//	 "ingest_cluster": "kmamiz_dp",  // Envoy cluster for /ingest
+//	 "flush_spans": 512,             // frame flush threshold
+//	 "service": "productpage",       // istio.canonical_service
+//	 "namespace": "default",         // istio.namespace
+//	 "revision": "v1",               // istio.canonical_revision
+//	 "mesh": "mesh1"}                // istio.mesh_id
+//
+// The frame layout is specified in docs/INGEST_WIRE.md and mirrored by
+// kmamiz_tpu/core/wire.py (reference codec) and the native decoder in
+// native/kmamiz_spans.cpp — encodeColumnarFrame must stay byte-exact
+// with wire.encode_groups.
 type pluginContext struct {
 	types.DefaultPluginContext
+
+	wireFormat    string
+	ingestCluster string
+	flushSpans    int
+	svc, ns, rev  string
+	mesh          string
+	pending       []colSpan
 }
 
-func (*pluginContext) NewHttpContext(uint32) types.HttpContext {
+func (ctx *pluginContext) OnPluginStart(confSize int) types.OnPluginStartStatus {
+	if confSize > 0 {
+		raw, err := proxywasm.GetPluginConfiguration()
+		if err == nil {
+			var conf map[string]interface{}
+			if json.Unmarshal(raw, &conf) == nil {
+				if v, ok := conf["wire_format"].(string); ok {
+					ctx.wireFormat = v
+				}
+				if v, ok := conf["ingest_cluster"].(string); ok {
+					ctx.ingestCluster = v
+				}
+				if v, ok := conf["flush_spans"].(float64); ok && v >= 1 {
+					ctx.flushSpans = int(v)
+				}
+				if v, ok := conf["service"].(string); ok {
+					ctx.svc = v
+				}
+				if v, ok := conf["namespace"].(string); ok {
+					ctx.ns = v
+				}
+				if v, ok := conf["revision"].(string); ok {
+					ctx.rev = v
+				}
+				if v, ok := conf["mesh"].(string); ok {
+					ctx.mesh = v
+				}
+			}
+		}
+	}
+	return types.OnPluginStartStatusOK
+}
+
+func (ctx *pluginContext) record(span colSpan) {
+	if ctx.wireFormat != "columnar" {
+		return
+	}
+	ctx.pending = append(ctx.pending, span)
+	if len(ctx.pending) >= ctx.flushSpans {
+		ctx.flush()
+	}
+}
+
+func (ctx *pluginContext) flush() {
+	if len(ctx.pending) == 0 || ctx.ingestCluster == "" {
+		return
+	}
+	frame := encodeColumnarFrame(ctx.pending)
+	ctx.pending = ctx.pending[:0]
+	headers := [][2]string{
+		{":method", "POST"},
+		{":path", "/ingest"},
+		{":authority", ctx.ingestCluster},
+		{"content-type", "application/x-kmamiz-columnar"},
+	}
+	// fire-and-forget: the DP quarantines malformed frames; a failed
+	// dispatch drops the batch like a dropped Zipkin report would
+	_, _ = proxywasm.DispatchHttpCall(
+		ctx.ingestCluster, headers, frame, nil, 5000,
+		func(int, int, int) {},
+	)
+}
+
+func (ctx *pluginContext) NewHttpContext(uint32) types.HttpContext {
 	return &httpContext{
+		plugin:     ctx,
 		requestID:  noID,
 		traceID:    noID,
 		spanID:     noID,
@@ -48,14 +140,155 @@ func (*pluginContext) NewHttpContext(uint32) types.HttpContext {
 	}
 }
 
+// -- columnar ingest frame ("KMZC") encoder ---------------------------------
+
+type colSpan struct {
+	traceID, spanID, parentID           string
+	hasTrace, hasParent                 bool
+	name, url, method, svc, ns          string
+	rev, mesh, status                   string
+	hasURL, hasMethod, hasSvc, hasNs    bool
+	hasRev, hasMesh, hasStatus, hasName bool
+	kind                                int8
+	timestampUs, durationUs             int64
+}
+
+type colStringTable struct {
+	ids     map[string]int32
+	entries []string
+	bytes   int
+}
+
+func (t *colStringTable) sid(value string, present bool) int32 {
+	if !present {
+		return -1
+	}
+	if id, ok := t.ids[value]; ok {
+		return id
+	}
+	id := int32(len(t.entries))
+	t.ids[value] = id
+	t.entries = append(t.entries, value)
+	t.bytes += len(value)
+	return id
+}
+
+// encodeColumnarFrame mirrors kmamiz_tpu/core/wire.py encode_groups byte
+// for byte: header (magic/version/flags/len/crc32), string table, group
+// table (spans grouped by traceId in first-appearance order), then the
+// fixed-width SoA columns.
+func encodeColumnarFrame(spans []colSpan) []byte {
+	tab := colStringTable{ids: map[string]int32{}}
+	order := []string{}
+	groups := map[string][]int{}
+	for i := range spans {
+		key := spans[i].traceID
+		if !spans[i].hasTrace {
+			key = "\x00absent"
+		}
+		if _, ok := groups[key]; !ok {
+			order = append(order, key)
+		}
+		groups[key] = append(groups[key], i)
+	}
+
+	n := len(spans)
+	cols := make([][]int32, 10)
+	for c := range cols {
+		cols[c] = make([]int32, 0, n)
+	}
+	kinds := make([]int8, 0, n)
+	tsCol := make([]int64, 0, n)
+	durCol := make([]int64, 0, n)
+	type groupRec struct {
+		tidSid int32
+		count  uint32
+	}
+	groupRecs := make([]groupRec, 0, len(order))
+	for _, key := range order {
+		rows := groups[key]
+		s0 := spans[rows[0]]
+		groupRecs = append(groupRecs, groupRec{
+			tab.sid(s0.traceID, s0.hasTrace), uint32(len(rows)),
+		})
+		for _, i := range rows {
+			s := spans[i]
+			cols[0] = append(cols[0], tab.sid(s.spanID, true))
+			cols[1] = append(cols[1], tab.sid(s.parentID, s.hasParent))
+			cols[2] = append(cols[2], tab.sid(s.name, s.hasName))
+			cols[3] = append(cols[3], tab.sid(s.url, s.hasURL))
+			cols[4] = append(cols[4], tab.sid(s.method, s.hasMethod))
+			cols[5] = append(cols[5], tab.sid(s.svc, s.hasSvc))
+			cols[6] = append(cols[6], tab.sid(s.ns, s.hasNs))
+			cols[7] = append(cols[7], tab.sid(s.rev, s.hasRev))
+			cols[8] = append(cols[8], tab.sid(s.mesh, s.hasMesh))
+			cols[9] = append(cols[9], tab.sid(s.status, s.hasStatus))
+			kinds = append(kinds, s.kind)
+			tsCol = append(tsCol, s.timestampUs)
+			durCol = append(durCol, s.durationUs)
+		}
+	}
+
+	bodyLen := 4 + 4*len(tab.entries) + tab.bytes +
+		4 + 8*len(groupRecs) + 4 + n*(10*4+1+2*8)
+	body := make([]byte, 0, bodyLen)
+	le32 := func(v uint32) {
+		var b [4]byte
+		binary.LittleEndian.PutUint32(b[:], v)
+		body = append(body, b[:]...)
+	}
+	le64 := func(v uint64) {
+		var b [8]byte
+		binary.LittleEndian.PutUint64(b[:], v)
+		body = append(body, b[:]...)
+	}
+	le32(uint32(len(tab.entries)))
+	for _, entry := range tab.entries {
+		le32(uint32(len(entry)))
+		body = append(body, entry...)
+	}
+	le32(uint32(len(groupRecs)))
+	for _, g := range groupRecs {
+		le32(uint32(g.tidSid))
+		le32(g.count)
+	}
+	le32(uint32(n))
+	for c := 0; c < 10; c++ {
+		for _, v := range cols[c] {
+			le32(uint32(v))
+		}
+	}
+	for _, k := range kinds {
+		body = append(body, byte(k))
+	}
+	for _, v := range tsCol {
+		le64(uint64(v))
+	}
+	for _, v := range durCol {
+		le64(uint64(v))
+	}
+
+	frame := make([]byte, 0, 16+len(body))
+	frame = append(frame, 'K', 'M', 'Z', 'C')
+	frame = append(frame, 1, 0, 0, 0) // version, flags, reserved u16
+	var b [4]byte
+	binary.LittleEndian.PutUint32(b[:], uint32(len(body)))
+	frame = append(frame, b[:]...)
+	binary.LittleEndian.PutUint32(b[:], crc32.ChecksumIEEE(body))
+	frame = append(frame, b[:]...)
+	return append(frame, body...)
+}
+
 type httpContext struct {
 	types.DefaultHttpContext
 
+	plugin                                 *pluginContext
 	requestID, traceID, spanID, parentSpan string
 	method, host, path                     string
 	reqContentType, respContentType        string
 	status                                 string
 	reqBody, respBody                      []byte
+	startUs                                int64
 }
 
 func headerOr(name, fallback string) string {
@@ -75,6 +308,7 @@ func (ctx *httpContext) OnHttpRequestHeaders(int, bool) types.Action {
 	ctx.host = headerOr(":authority", "")
 	ctx.path = headerOr(":path", "")
 	ctx.reqContentType = headerOr("content-type", "")
+	ctx.startUs = time.Now().UnixMicro()
 	return types.ActionContinue
 }
 
@@ -176,4 +410,34 @@ func (ctx *httpContext) OnHttpStreamDone() {
 		}
 	}
 	proxywasm.LogInfo(response)
+
+	if ctx.plugin != nil && ctx.plugin.wireFormat == "columnar" {
+		p := ctx.plugin
+		ctx.plugin.record(colSpan{
+			traceID:     ctx.traceID,
+			hasTrace:    ctx.traceID != noID,
+			spanID:      ctx.spanID,
+			parentID:    ctx.parentSpan,
+			hasParent:   ctx.parentSpan != noID,
+			name:        ctx.method + " " + ctx.host + ctx.path,
+			hasName:     true,
+			url:         ctx.host + ctx.path,
+			hasURL:      true,
+			method:      ctx.method,
+			hasMethod:   ctx.method != "",
+			svc:         p.svc,
+			hasSvc:      p.svc != "",
+			ns:          p.ns,
+			hasNs:       p.ns != "",
+			rev:         p.rev,
+			hasRev:      p.rev != "",
+			mesh:        p.mesh,
+			hasMesh:     p.mesh != "",
+			status:      ctx.status,
+			hasStatus:   ctx.status != "",
+			kind:        1, // the sidecar observes the SERVER side
+			timestampUs: ctx.startUs,
+			durationUs:  time.Now().UnixMicro() - ctx.startUs,
+		})
+	}
 }

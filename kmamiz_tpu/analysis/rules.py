@@ -1,4 +1,4 @@
-"""The eight shipped graftlint rules.
+"""The nine shipped graftlint rules.
 
 Each rule is a function (module, context) -> [Finding] registered via
 framework.rule(). Shared AST plumbing (jit-site extraction, parent maps,
@@ -7,6 +7,8 @@ taint walks) lives at the top; the rules themselves stay short.
 from __future__ import annotations
 
 import ast
+import os
+import re
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Set
 
@@ -1008,4 +1010,92 @@ def check_hot_path_clock(mod: ModuleInfo, ctx: LintContext) -> List[Finding]:
                         "wall_ms) so tick timing stays attributable",
                     )
                 )
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# rule: prof-counter-wire
+# ---------------------------------------------------------------------------
+
+# the ctypes decoder module whose _PROF_SCALARS tuples name the wire
+_PROF_DECODER = "kmamiz_tpu/native/__init__.py"
+_PROF_CPP_REL = os.path.join("native", "kmamiz_spans.cpp")
+_PROF_TUPLE_NAMES = {"_PROF_SCALARS", "_PROF_SCALARS_V1"}
+# a cumulative scalar in the ProfCounters struct: `uint64_t name = 0;`
+# (the per-shard arrays initialize with `= {0}` and never match)
+_PROF_SCALAR_RE = re.compile(r"^\s*uint64_t\s+(\w+)\s*=\s*0\s*;")
+_PROF_STRUCT_RE = re.compile(r"struct\s+ProfCounters\s*\{(.*?)\n\};", re.S)
+
+
+def _cpp_prof_scalars(root: str) -> Optional[List[str]]:
+    """Scalar counter names in native/kmamiz_spans.cpp's ProfCounters
+    struct, declaration (= wire) order; None when the source or struct
+    is absent (fixture repos without a native tree)."""
+    try:
+        with open(os.path.join(root, _PROF_CPP_REL), encoding="utf-8") as fh:
+            source = fh.read()
+    except OSError:
+        return None
+    m = _PROF_STRUCT_RE.search(source)
+    if not m:
+        return None
+    return [
+        sm.group(1)
+        for line in m.group(1).splitlines()
+        if (sm := _PROF_SCALAR_RE.match(line))
+    ]
+
+
+@rule(
+    "prof-counter-wire",
+    "every cumulative uint64 scalar in native ProfCounters "
+    "(native/kmamiz_spans.cpp) must be named in _PROF_SCALARS in "
+    "kmamiz_tpu/native/__init__.py, and vice versa: the snapshot wire "
+    "serializes the struct in declaration order, so an unlisted scalar "
+    "silently shifts every later field the Python decoder reads",
+)
+def check_prof_counter_wire(mod: ModuleInfo, ctx: LintContext) -> List[Finding]:
+    if mod.rel_path != _PROF_DECODER:
+        return []
+    cpp_scalars = _cpp_prof_scalars(ctx.root)
+    if cpp_scalars is None:
+        return []
+    declared: Set[str] = set()
+    anchor = 1
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        targets = {t.id for t in node.targets if isinstance(t, ast.Name)}
+        if not targets & _PROF_TUPLE_NAMES:
+            continue
+        anchor = max(anchor, node.lineno)
+        for sub in ast.walk(node.value):
+            if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+                declared.add(sub.value)
+    findings: List[Finding] = []
+    for name in cpp_scalars:
+        if name not in declared:
+            findings.append(
+                Finding(
+                    "prof-counter-wire",
+                    mod.rel_path,
+                    anchor,
+                    f"native ProfCounters scalar '{name}' is not listed in "
+                    "_PROF_SCALARS: the snapshot wire serializes struct "
+                    "declaration order, so the decoder misreads every "
+                    "field after it (bump kProfWireVersion and append the "
+                    "name)",
+                )
+            )
+    for name in sorted(declared - set(cpp_scalars)):
+        findings.append(
+            Finding(
+                "prof-counter-wire",
+                mod.rel_path,
+                anchor,
+                f"_PROF_SCALARS entry '{name}' has no matching uint64_t "
+                "scalar in the native ProfCounters struct: a stale "
+                "decoder entry misaligns the counter wire",
+            )
+        )
     return findings

@@ -109,6 +109,21 @@ class Settings:
             os.environ.get("KMAMIZ_INGEST_MAX_BYTES", str(256 * 1024 * 1024))
         )
     )  # trace-bomb size cap for one raw ingest payload
+    # -- ingest wire / transfer overlap (docs/INGEST_WIRE.md) ----------
+    parse_shards: int = field(
+        default_factory=lambda: int(
+            os.environ.get("KMAMIZ_PARSE_SHARDS", "4")
+        )
+    )  # work-stealing chunks per parse worker (clamped 1..64 natively)
+    upload_depth: int = field(
+        default_factory=lambda: int(
+            os.environ.get("KMAMIZ_UPLOAD_DEPTH", "2")
+        )
+    )  # in-flight host->device upload windows (0 = legacy synchronous)
+    # the wire FORMAT itself has no env toggle on this side: ingest
+    # auto-detects per payload (KMZC magic -> columnar, else JSON); the
+    # emitter toggle is the Envoy filter's plugin-config `wire_format`
+    # key (envoy/EnvoyFilter-WASM.yaml)
     tick_deadline_ms: float = field(
         default_factory=lambda: float(
             os.environ.get("KMAMIZ_TICK_DEADLINE_MS", "0")

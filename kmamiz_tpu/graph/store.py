@@ -35,6 +35,7 @@ from kmamiz_tpu.core.spans import (
     pack_trace_rows,
 )
 from kmamiz_tpu.ops import scorers as scorer_ops
+from kmamiz_tpu.ops.double_buffer import UploadPipeline
 from kmamiz_tpu.telemetry.profiling import events as prof_events
 from kmamiz_tpu.telemetry.tracing import phase_span
 from kmamiz_tpu.ops import window as window_ops
@@ -248,6 +249,11 @@ class EndpointGraph:
         # for casual introspection only — concurrent mergers use
         # merge_window's per-call return value for accounting.
         self.last_transfer_ms = 0.0
+        # double-buffered uploads (ops/double_buffer.py): up to
+        # KMAMIZ_UPLOAD_DEPTH window-input groups stream host->device
+        # while the host packs the next window; touched only under
+        # self._lock, drained at the finalize/read fence
+        self._uploads = UploadPipeline()
         self._pending = None  # deferred (src, dst, dist, count) of last merge
         # staged windows (compacted src/dst/dist prefixes + pinned walk
         # inputs) awaiting the batched drain union; bounded by
@@ -401,17 +407,19 @@ class EndpointGraph:
     # -- ingestion -----------------------------------------------------------
 
     def _to_device(self, *host_arrays):
-        """Copy host arrays to the device; returns (arrays, copy_ms). The
-        inputs must land before the merge kernel can start, so blocking
-        here costs nothing — and it makes the copy separable from
-        framework work in the ingest accounting (on this dev harness the
-        copy rides a ~10 MB/s tunnel; on a TPU VM it is PCIe)."""
-        t0 = prof_events.now_ms()
+        """Enqueue host arrays to the device; returns (arrays, wait_ms).
+        The copy itself is asynchronous — the device sequences any kernel
+        dispatched on these arrays after the bytes land, so the host
+        never needs them ready. `wait_ms` is the stall this call actually
+        paid: at KMAMIZ_UPLOAD_DEPTH=0 the full copy (legacy synchronous
+        behavior, the raw-bandwidth measurement), otherwise only the
+        pipeline's backpressure on the OLDEST in-flight window (on this
+        dev harness the copy rides a ~10 MB/s tunnel; on a TPU VM it is
+        PCIe — either way window N's copy now overlaps window N-1's
+        kernel and window N+1's host-side pack)."""
         # explicit device_put (not jnp.asarray): the implicit-transfer
         # form trips jax.transfer_guard("disallow") on a real TPU
-        # graftlint: disable=host-sync-in-hot-path -- transfer accounting: the copy must land before the kernel; blocking IS the measurement
-        out = jax.block_until_ready([jax.device_put(a) for a in host_arrays])
-        ms = prof_events.now_ms() - t0
+        out, ms = self._uploads.put(host_arrays)
         self.last_transfer_ms = ms
         step_timer.record("transfer", ms)
         return out, ms
@@ -423,15 +431,16 @@ class EndpointGraph:
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         sh = NamedSharding(mesh, P("spans", None))
-        t0 = prof_events.now_ms()
-        # graftlint: disable=host-sync-in-hot-path -- transfer accounting (sharded): same measurement rationale as _to_device
-        out = jax.block_until_ready(
-            [jax.device_put(a, sh) for a in host_arrays]
-        )
-        ms = prof_events.now_ms() - t0
+        out, ms = self._uploads.put(host_arrays, sharding=sh)
         self.last_transfer_ms = ms
         step_timer.record("transfer", ms)
         return out, ms
+
+    def upload_stats(self) -> dict:
+        """Upload-pipeline counters for /timings and the bench (depth,
+        uploads, in_flight, peak_in_flight, blocked_ms)."""
+        with self._lock:
+            return self._uploads.stats()
 
     @staticmethod
     def _deploy_mesh(n_rows: int):
@@ -796,6 +805,10 @@ class EndpointGraph:
             self._finalize_pending_locked()
 
     def _finalize_pending_locked(self) -> None:
+        # retire any still-streaming uploads first: this IS the read
+        # fence the pipeline defers its waits to (in steady state the
+        # copies landed chunks ago and this returns immediately)
+        self._uploads.drain()
         if self._staged or self._preunion is not None:
             self._drain_staged_locked()  # resolves _pending too
             return

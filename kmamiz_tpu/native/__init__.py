@@ -296,6 +296,22 @@ def _open_and_bind() -> Optional[ctypes.CDLL]:
                 "libkmamiz_native.so predates graftprof counters; "
                 "native profiling reports zeros"
             )
+        # columnar wire capability + parse-shard knob: OPTIONAL — a .so
+        # without km_wire_caps predates the "KMZC" frame format (the
+        # binding then transcodes frames to JSON in Python)
+        try:
+            lib.km_wire_caps.argtypes = []
+            lib.km_wire_caps.restype = ctypes.c_uint
+            lib.km_set_parse_shards.argtypes = [ctypes.c_int]
+            lib.km_set_parse_shards.restype = None
+            shards = os.environ.get("KMAMIZ_PARSE_SHARDS")
+            if shards:
+                lib.km_set_parse_shards(int(shards))
+        except (AttributeError, ValueError):
+            logger.warning(
+                "libkmamiz_native.so predates the columnar wire; "
+                "KMZC frames transcode through Python"
+            )
         return lib
     except (OSError, AttributeError) as err:
         logger.warning("native load failed: %s", err)
@@ -306,9 +322,17 @@ def available() -> bool:
     return _load() is not None
 
 
+def supports_columnar() -> bool:
+    """True when the loaded .so decodes "KMZC" columnar frames natively
+    (km_wire_caps bit 0). False -> parse_spans transcodes frames to
+    Zipkin JSON through kmamiz_tpu.core.wire first."""
+    lib = _load()
+    return lib is not None and hasattr(lib, "km_wire_caps")
+
+
 # -- graftprof native counters (telemetry/profiling) -------------------------
 
-_PROF_SCALARS = (
+_PROF_SCALARS_V1 = (
     "parses",
     "spans",
     "merge_ns",
@@ -318,7 +342,11 @@ _PROF_SCALARS = (
     "intern_probes",
     "intern_hits",
 )
-_PROF_HEADER_LEN = 8 + 8 * len(_PROF_SCALARS)
+# v2 appends the shard-table fold counters (lock-free merge rework);
+# graftlint cross-checks these names against the ProfCounters struct in
+# native/kmamiz_spans.cpp (prof-counter-wire rule).
+_PROF_SCALARS = _PROF_SCALARS_V1 + ("fold_ns", "fold_chunks")
+_PROF_HEADER_LEN = 8 + 8 * len(_PROF_SCALARS_V1)
 
 
 def _prof_zero() -> dict:
@@ -351,10 +379,13 @@ def prof_counters() -> dict:
         out = _prof_zero()
         out["available"] = True
         out["version"], out["shards_used"] = struct.unpack_from("<II", raw, 0)
-        scalars = struct.unpack_from(f"<{len(_PROF_SCALARS)}Q", raw, 8)
-        for key, val in zip(_PROF_SCALARS, scalars):
+        names = _PROF_SCALARS if out["version"] >= 2 else _PROF_SCALARS_V1
+        if len(raw) < 8 + 8 * len(names):
+            names = _PROF_SCALARS_V1
+        scalars = struct.unpack_from(f"<{len(names)}Q", raw, 8)
+        for key, val in zip(names, scalars):
             out[key] = val
-        off = _PROF_HEADER_LEN
+        off = 8 + 8 * len(names)
         for _ in range(out["shards_used"]):
             if off + 24 > len(raw):
                 break
@@ -764,6 +795,14 @@ def parse_spans(
     out_len = ctypes.c_size_t(0)
     # the json buffer crosses ctypes without a copy (c_char_p on bytes)
     raw = bytes(raw) if not isinstance(raw, bytes) else raw
+    if raw[:4] == b"KMZC" and not hasattr(lib, "km_wire_caps"):
+        # stale prebuilt .so without the columnar decoder: transcode the
+        # frame to Zipkin JSON in Python (same rows, host-speed only)
+        from kmamiz_tpu.core import wire
+
+        raw = wire.columnar_to_json(raw)
+        if raw is None:
+            return None
     # explicit blob-style skip args take precedence over the persistent
     # handles: a caller that passes skip_trace_ids/skip_blob means THAT
     # set, and silently consulting a different (handle) set instead

@@ -75,6 +75,17 @@ def classify_payload(raw: bytes, size_cap: Optional[int] = None) -> Optional[str
     cap = size_cap if size_cap is not None else max_payload_bytes()
     if cap > 0 and len(raw) > cap:
         return REASON_TRACE_BOMB
+    if raw[:4] == b"KMZC":
+        # columnar frame: the reference codec replays the native
+        # decoder's all-or-nothing validation (magic/version/CRC/sids);
+        # a truncated or corrupt frame lands with the same reason a
+        # parser-rejected JSON payload gets — identical quarantine
+        # behavior across the two wire formats
+        from kmamiz_tpu.core import wire
+
+        if wire.decode_groups(raw) is None:
+            return REASON_PARSE_ERROR
+        return None
     try:
         text = raw.decode("utf-8")
     except UnicodeDecodeError:

@@ -9,17 +9,26 @@ a bit-exact graph: the edge-store merge is deterministic and a fresh
 processor's empty dedup map reconstructs exactly the state the payload
 sequence implies.
 
-Record framing (per record, little-endian):
+Record framing. v2 segments open with an 8-byte magic and frame each
+record with an explicit wire-format kind byte (0 = Zipkin JSON, 1 =
+columnar KMZC; docs/INGEST_WIRE.md), so a replayed columnar window is
+routed by what the WAL says it is, not by sniffing bytes that might be
+a torn JSON body that happens to start with 'K':
 
-    [u32 payload_len][u32 crc32(payload)][payload bytes]
+    [8B "KMWL\\x02\\0\\0\\0"]                                (once per segment)
+    [u32 payload_len][u32 crc32(payload)][u8 kind][payload]  (per record)
 
-Append is O_APPEND + flush + fsync, so a record is either fully durable
-or detectably torn; replay stops cleanly at the first short/corrupt
-record (the torn tail of the segment being written when the process
-died) instead of raising. Segments rotate at ``KMAMIZ_WAL_SEGMENT_MB``
-(default 64) and the newest ``KMAMIZ_WAL_KEEP_SEGMENTS`` (default 4)
-are retained; `truncate()` clears all segments once their contents are
-known to be captured by a durable snapshot.
+Pre-upgrade v1 segments (no magic; records are [u32 len][u32 crc]
+[payload], kind implicitly JSON) still replay bit-exact; append never
+mixes framings — a live v1 segment is rotated away on the first v2
+append. Append is O_APPEND + flush + fsync, so a record is either fully
+durable or detectably torn; replay stops cleanly at the first
+short/corrupt record (the torn tail of the segment being written when
+the process died) instead of raising. Segments rotate at
+``KMAMIZ_WAL_SEGMENT_MB`` (default 64) and the newest
+``KMAMIZ_WAL_KEEP_SEGMENTS`` (default 4) are retained; `truncate()`
+clears all segments once their contents are known to be captured by a
+durable snapshot.
 
 Enable with ``KMAMIZ_WAL=1`` (+ optional ``KMAMIZ_WAL_DIR``); off by
 default so the fsync-per-ingest cost is strictly opt-in.
@@ -36,7 +45,13 @@ from typing import Iterator, List, Optional
 
 logger = logging.getLogger("kmamiz_tpu.resilience.wal")
 
-_HEADER = struct.Struct("<II")  # payload_len, crc32
+_HEADER = struct.Struct("<II")  # v1: payload_len, crc32
+_HEADER_V2 = struct.Struct("<IIB")  # payload_len, crc32, kind
+_SEGMENT_MAGIC = b"KMWL\x02\x00\x00\x00"
+
+#: record wire-format kinds (the v2 frame kind byte)
+KIND_JSON = 0
+KIND_COLUMNAR = 1
 
 
 def _env_int(name: str, default: int) -> int:
@@ -122,17 +137,41 @@ class IngestWAL:
             index = 0
         return self._dir / f"{index:06d}.wal"
 
+    @staticmethod
+    def _is_v2_segment(path: Path) -> bool:
+        try:
+            with open(path, "rb") as f:
+                return f.read(len(_SEGMENT_MAGIC)) == _SEGMENT_MAGIC
+        except OSError:
+            return False
+
+    def _open_segment_locked(self, path: Path) -> None:
+        """Open `path` for append, stamping the v2 magic on an empty
+        segment (append framing is always v2; v1 segments are read-only
+        history)."""
+        self._fh = open(path, "ab")
+        self._fh_path = path
+        if self._fh.tell() == 0:
+            self._fh.write(_SEGMENT_MAGIC)
+
     def _open_locked(self) -> None:
         if self._fh is not None:
             return
         self._dir.mkdir(parents=True, exist_ok=True)
         segments = self._segments_locked()
-        if segments and segments[-1].stat().st_size < self._segment_bytes:
+        if (
+            segments
+            and segments[-1].stat().st_size < self._segment_bytes
+            and (
+                segments[-1].stat().st_size == 0
+                or self._is_v2_segment(segments[-1])
+            )
+        ):
             path = segments[-1]
         else:
+            # full, or a live pre-upgrade v1 segment: never mix framings
             path = self._next_segment_path_locked()
-        self._fh = open(path, "ab")
-        self._fh_path = path
+        self._open_segment_locked(path)
 
     def _rotate_if_needed_locked(self) -> None:
         if self._fh is None or self._fh_path is None:
@@ -141,9 +180,7 @@ class IngestWAL:
             return
         self._fh.close()
         self._fh = None
-        path = self._next_segment_path_locked()
-        self._fh = open(path, "ab")
-        self._fh_path = path
+        self._open_segment_locked(self._next_segment_path_locked())
         # retire segments beyond the retention window, oldest first
         segments = self._segments_locked()
         while len(segments) > self._keep_segments:
@@ -156,10 +193,16 @@ class IngestWAL:
 
     # -- append / replay -----------------------------------------------------
 
-    def append(self, payload: bytes) -> None:
+    def append(self, payload: bytes, kind: Optional[int] = None) -> None:
         """Durably append one record. Raises OSError on I/O failure —
-        the caller decides whether ingest proceeds without durability."""
-        frame = _HEADER.pack(len(payload), zlib.crc32(payload)) + payload
+        the caller decides whether ingest proceeds without durability.
+        `kind` defaults to what the payload's leading bytes say it is
+        (KMZC magic -> columnar, anything else -> JSON)."""
+        if kind is None:
+            kind = KIND_COLUMNAR if payload[:4] == b"KMZC" else KIND_JSON
+        frame = (
+            _HEADER_V2.pack(len(payload), zlib.crc32(payload), kind) + payload
+        )
         with self._lock:
             self._open_locked()
             self._fh.write(frame)
@@ -173,9 +216,19 @@ class IngestWAL:
         metrics.incr("walRecords")
 
     def replay(self) -> Iterator[bytes]:
-        """Yield every durable payload, oldest first. Stops cleanly at
-        the first torn/corrupt record (crash tail); later segments are
-        not read past it because append order is segment order."""
+        """Yield every durable payload, oldest first (kind dropped; the
+        ingest path re-routes on it — see replay_records)."""
+        for _kind, payload in self.replay_records():
+            yield payload
+
+    def replay_records(self) -> "Iterator[tuple]":
+        """Yield every durable (kind, payload), oldest first. v1 segments
+        carry only JSON so their records report KIND_JSON. Stops cleanly
+        at the first torn/corrupt record (crash tail); later segments are
+        not read past it because append order is segment order. A kind
+        byte that contradicts the payload (columnar without the KMZC
+        magic, or vice versa) is corruption, not a torn tail — same
+        stop-clean treatment."""
         with self._lock:
             segments = self._segments_locked()
         for segment in segments:
@@ -184,10 +237,16 @@ class IngestWAL:
             except OSError as err:
                 logger.warning("wal: cannot read %s (%s)", segment.name, err)
                 return
-            offset = 0
-            while offset + _HEADER.size <= len(data):
-                length, crc = _HEADER.unpack_from(data, offset)
-                start = offset + _HEADER.size
+            v2 = data[: len(_SEGMENT_MAGIC)] == _SEGMENT_MAGIC
+            offset = len(_SEGMENT_MAGIC) if v2 else 0
+            header = _HEADER_V2 if v2 else _HEADER
+            while offset + header.size <= len(data):
+                if v2:
+                    length, crc, kind = header.unpack_from(data, offset)
+                else:
+                    length, crc = header.unpack_from(data, offset)
+                    kind = KIND_JSON
+                start = offset + header.size
                 end = start + length
                 if end > len(data):
                     logger.warning(
@@ -204,7 +263,20 @@ class IngestWAL:
                         offset,
                     )
                     return
-                yield payload
+                is_columnar = payload[:4] == b"KMZC"
+                if v2 and (
+                    kind not in (KIND_JSON, KIND_COLUMNAR)
+                    or (kind == KIND_COLUMNAR) != is_columnar
+                ):
+                    logger.warning(
+                        "wal: kind byte %d contradicts payload at %s+%d, "
+                        "stopping replay",
+                        kind,
+                        segment.name,
+                        offset,
+                    )
+                    return
+                yield kind, payload
                 offset = end
             if offset != len(data):
                 logger.warning(

@@ -383,7 +383,9 @@ def make_handler(processor: DataProcessor, router=None):
                     # ~15x on the wire, exactly the payloads that want
                     # the pipelined path)
                     with TRACER.tick(root_name="dp-ingest"):
-                        if len(raw) >= threshold:
+                        # columnar (KMZC) frames are indivisible: the
+                        # group splitter only understands the JSON wire
+                        if len(raw) >= threshold and raw[:4] != b"KMZC":
                             from kmamiz_tpu import native as native_mod
                             from kmamiz_tpu.server.processor import (
                                 DEFAULT_STREAM_CHUNKS,

@@ -114,19 +114,25 @@ inline void cpu_relax() {
 // bump thread-local/table-local counters.
 
 constexpr uint32_t kProfMaxShards = 64;  // pick_threads caps at 64
-constexpr uint32_t kProfWireVersion = 1;
+constexpr uint32_t kProfWireVersion = 2;
 
 struct ProfCounters {
   std::mutex mu;
-  // cumulative scalars (since load or km_prof_reset)
+  // cumulative scalars (since load or km_prof_reset). The wire serializes
+  // these in declaration order; a new scalar appends AFTER the existing
+  // ones and bumps kProfWireVersion (the Python decoder is version-aware,
+  // and the graftlint prof-counter-wire rule cross-checks the names
+  // against _PROF_SCALARS in kmamiz_tpu/native/__init__.py).
   uint64_t parses = 0;
   uint64_t spans = 0;
   uint64_t merge_ns = 0;            // assemble wall time
-  uint64_t merge_lock_wait_ns = 0;  // sum of per-shard barrier waits
-  uint64_t merge_queue_depth_peak = 0;  // max shards pending at assemble
-  uint64_t claim_contended = 0;     // span-id CAS losses + row spin entries
+  uint64_t merge_lock_wait_ns = 0;  // sum of per-worker barrier waits
+  uint64_t merge_queue_depth_peak = 0;  // max workers pending at assemble
+  uint64_t claim_contended = 0;     // 0 since the lock-free shard fold
   uint64_t intern_probes = 0;       // shape/status intern slot inspections
   uint64_t intern_hits = 0;         // interns resolved to an existing id
+  uint64_t fold_ns = 0;             // sequential shard-table fold wall
+  uint64_t fold_chunks = 0;         // work-stealing chunks folded
   // last parse, per shard
   uint32_t shards_used = 0;
   uint64_t shard_parse_ns[kProfMaxShards] = {0};
@@ -1281,6 +1287,75 @@ struct GroupRange {
 };
 
 // per-thread parse output: rows + small private tables
+// -- lock-free per-shard span-id table --------------------------------------
+// Plain open addressing, NO atomics: each parse worker builds one of
+// these privately for its chunk (zero sharing), and the assemble phase
+// folds the per-chunk tables into one final FlatIdTable in a single
+// sequential pass (document order, so first-position-wins dedup falls
+// out of insertion order). This replaces the old shared atomic
+// SpanIdTable whose CAS claims + row spin-waits were the t2 merge wall.
+
+constexpr size_t kPrefetchBlock = 32;
+
+struct FlatIdTable {
+  std::vector<uint64_t> hashes;  // 0 = empty (SvMap::key_hash sets |1)
+  std::vector<int32_t> rows;
+  size_t mask = 0;
+
+  void init(size_t n_rows) {
+    size_t n = 64;
+    while (n < n_rows * 2) n <<= 1;
+    hashes.assign(n, 0);
+    rows.assign(n, -1);
+    mask = n - 1;
+  }
+
+  // returns -1 when `row` claimed the slot, else the slot index of the
+  // existing claim (a duplicate id)
+  int64_t insert(sv key, uint64_t h, int32_t row, const sv* ids) {
+    size_t j = h & mask;
+    for (;;) {
+      uint64_t cur = hashes[j];
+      if (cur == 0) {
+        hashes[j] = h;
+        rows[j] = row;
+        return -1;
+      }
+      if (cur == h) {
+        const sv& k = ids[rows[j]];
+        // empty ids carry nullptr data; memcmp(nullptr, ..., 0) is UB
+        if (k.size() == key.size() &&
+            (key.empty() ||
+             std::memcmp(k.data(), key.data(), key.size()) == 0))
+          return static_cast<int64_t>(j);
+        // same hash, different key: keep probing
+      }
+      j = (j + 1) & mask;
+    }
+  }
+
+  // read-only lookup; -1 when absent
+  int32_t find(sv key, uint64_t h, const sv* ids) const {
+    if (hashes.empty()) return -1;
+    size_t j = h & mask;
+    for (;;) {
+      uint64_t cur = hashes[j];
+      if (cur == 0) return -1;
+      if (cur == h) {
+        int32_t r = rows[j];
+        if (r >= 0) {
+          const sv& k = ids[r];
+          if (k.size() == key.size() &&
+              (key.empty() ||
+               std::memcmp(k.data(), key.data(), key.size()) == 0))
+            return r;
+        }
+      }
+      j = (j + 1) & mask;
+    }
+  }
+};
+
 struct ThreadOut {
   // per-span COLUMNS (SoA): a SpanRec is ~200 B of mostly naming svs
   // that die the moment the shape interns — pushing whole records wrote
@@ -1295,12 +1370,19 @@ struct ThreadOut {
   std::vector<int32_t> trace_of;   // GLOBAL kept-group index
   std::vector<int32_t> shape_id;   // local shape ids
   std::vector<int32_t> status_id;  // local status ids
+  std::vector<uint64_t> id_hash;   // per-row span-id hash (fold reuses)
+  std::vector<int32_t> parent_idx; // chunk-local resolution; -2 = retry
   ShapeTable shapes;
   std::vector<sv> statuses;
   Arena arena;
+  // chunk-private span-id table + intra-chunk duplicate claims, built
+  // during the parallel phase by finish_chunk (zero shared state)
+  FlatIdTable tab;
+  std::vector<std::pair<int64_t, int32_t>> local_dups;
+  uint32_t worker = 0;  // which work-stealing worker parsed this chunk
   bool ok = true;
   uint64_t busy_us = 0;
-  uint64_t done_us = 0;  // graftprof: when this worker's parse finished
+  uint64_t done_us = 0;  // graftprof: when this chunk's parse finished
   uint64_t intern_probes = 0, intern_hits = 0;  // graftprof intern stats
 
   size_t size() const { return ids.size(); }
@@ -1324,6 +1406,8 @@ void zip_span_cols(A& a, B& b, F&& f) {
   f(a.trace_of, b.trace_of);
   f(a.shape_id, b.shape_id);
   f(a.status_id, b.status_id);
+  f(a.id_hash, b.id_hash);
+  f(a.parent_idx, b.parent_idx);
 }
 
 inline void ThreadOut::reserve(size_t n) {
@@ -1348,6 +1432,68 @@ struct ShapeCache {
   std::vector<Entry> entries{kSize};
 };
 
+// shape + status intern + column push for ONE span record — the single
+// emission path shared by the JSON scanner and the columnar-frame decoder
+// (bit-exact parity between the two wire formats rides on this being the
+// only place a row enters the thread-local tables). The (big) span-id
+// table is deferred to the prefetched finish_chunk phase.
+inline void emit_span(ThreadOut* to, const SpanRec& rec, int32_t global_group,
+                      SvMap& status_map, sv& last_status,
+                      int32_t& last_status_id, ShapeCache& shape_cache) {
+  bool ins;
+  Shape sh;
+  sh.f[0] = rec.name;
+  sh.f[1] = rec.url;
+  sh.f[2] = rec.method;
+  sh.f[3] = rec.svc;
+  sh.f[4] = rec.ns;
+  sh.f[5] = rec.rev;
+  sh.f[6] = rec.mesh;
+  sh.key_present = rec.present & kKeyBits;
+  sh.url_present = rec.url_present ? 1 : 0;
+  int32_t sid = -1;
+  // identical to shape_hash(sh): the cache key IS the table hash, so
+  // a miss reuses it and never re-hashes the long fields
+  uint64_t h2 = hash_sv(rec.name) * 31 + hash_sv(rec.url) +
+                (rec.present & kKeyBits);
+  ShapeCache::Entry& ce =
+      shape_cache.entries[h2 & (ShapeCache::kSize - 1)];
+  if (ce.h2 == h2 && ce.id >= 0 &&
+      shape_eq(to->shapes.shapes[ce.id], sh)) {
+    sid = ce.id;
+  } else {
+    sid = to->shapes.intern(sh, h2);
+    ce.h2 = h2;
+    ce.id = sid;
+  }
+  Shape& stored = to->shapes.shapes[sid];
+  double ts_ms = rec.timestamp_raw / 1000.0;
+  if (!stored.has_ts || ts_ms > stored.max_ts_ms) {
+    stored.max_ts_ms = ts_ms;
+    stored.has_ts = true;
+  }
+  sv st = rec.status_present ? rec.status : sv("", 0);
+  int32_t stid;
+  if (last_status_id >= 0 && st == last_status) {
+    stid = last_status_id;
+  } else {
+    stid = status_map.intern(st, static_cast<int32_t>(to->statuses.size()),
+                             &ins);
+    if (ins) to->statuses.push_back(st);
+    last_status = st;
+    last_status_id = stid;
+  }
+  to->ids.push_back(rec.id);
+  to->parents.push_back(rec.parent_id);
+  to->hasp.push_back(rec.has_parent ? 1 : 0);
+  to->kind.push_back(rec.kind);
+  to->latency_ms.push_back(rec.latency_ms);
+  to->timestamp_raw.push_back(rec.timestamp_raw);
+  to->trace_of.push_back(global_group);
+  to->shape_id.push_back(sid);
+  to->status_id.push_back(stid);
+}
+
 // parse the spans of one kept group into `to` (local tables)
 bool parse_group_spans(Scanner& s, int32_t global_group, ThreadOut* to,
                        KeyPredictor& span_pred, KeyPredictor& tag_pred,
@@ -1355,7 +1501,6 @@ bool parse_group_spans(Scanner& s, int32_t global_group, ThreadOut* to,
                        int32_t& last_status_id, ShapeCache& shape_cache) {
   if (!s.eat('[')) return false;
   bool first_span = true;
-  bool ins;
   while (s.ok) {
     s.ws();
     if (s.peek(']')) {
@@ -1366,60 +1511,8 @@ bool parse_group_spans(Scanner& s, int32_t global_group, ThreadOut* to,
     first_span = false;
     SpanRec rec;
     if (!parse_span(s, &rec, span_pred, tag_pred)) return false;
-
-    // shape + status intern on the thread-local tables; the (big) span-id
-    // table is deferred to the prefetched build phase
-    Shape sh;
-    sh.f[0] = rec.name;
-    sh.f[1] = rec.url;
-    sh.f[2] = rec.method;
-    sh.f[3] = rec.svc;
-    sh.f[4] = rec.ns;
-    sh.f[5] = rec.rev;
-    sh.f[6] = rec.mesh;
-    sh.key_present = rec.present & kKeyBits;
-    sh.url_present = rec.url_present ? 1 : 0;
-    int32_t sid = -1;
-    // identical to shape_hash(sh): the cache key IS the table hash, so
-    // a miss reuses it and never re-hashes the long fields
-    uint64_t h2 = hash_sv(rec.name) * 31 + hash_sv(rec.url) +
-                  (rec.present & kKeyBits);
-    ShapeCache::Entry& ce =
-        shape_cache.entries[h2 & (ShapeCache::kSize - 1)];
-    if (ce.h2 == h2 && ce.id >= 0 &&
-        shape_eq(to->shapes.shapes[ce.id], sh)) {
-      sid = ce.id;
-    } else {
-      sid = to->shapes.intern(sh, h2);
-      ce.h2 = h2;
-      ce.id = sid;
-    }
-    Shape& stored = to->shapes.shapes[sid];
-    double ts_ms = rec.timestamp_raw / 1000.0;
-    if (!stored.has_ts || ts_ms > stored.max_ts_ms) {
-      stored.max_ts_ms = ts_ms;
-      stored.has_ts = true;
-    }
-    sv st = rec.status_present ? rec.status : sv("", 0);
-    int32_t stid;
-    if (last_status_id >= 0 && st == last_status) {
-      stid = last_status_id;
-    } else {
-      stid = status_map.intern(st, static_cast<int32_t>(to->statuses.size()),
-                               &ins);
-      if (ins) to->statuses.push_back(st);
-      last_status = st;
-      last_status_id = stid;
-    }
-    to->ids.push_back(rec.id);
-    to->parents.push_back(rec.parent_id);
-    to->hasp.push_back(rec.has_parent ? 1 : 0);
-    to->kind.push_back(rec.kind);
-    to->latency_ms.push_back(rec.latency_ms);
-    to->timestamp_raw.push_back(rec.timestamp_raw);
-    to->trace_of.push_back(global_group);
-    to->shape_id.push_back(sid);
-    to->status_id.push_back(stid);
+    emit_span(to, rec, global_group, status_map, last_status,
+              last_status_id, shape_cache);
   }
   return s.ok;
 }
@@ -1616,6 +1709,56 @@ struct ParseSession {
 
 // -- phase 2: parallel group parsing ----------------------------------------
 
+// build the chunk-private span-id table and resolve same-chunk parents —
+// all inside the parallel phase, zero shared state. Every row keeps its
+// id hash (id_hash column) so the assemble fold never re-hashes, and a
+// parent that resolves inside its own chunk (the overwhelming case: a
+// parent lives in its own trace group, and groups never split across
+// chunks) skips the global table entirely. parent_idx -2 marks the rare
+// cross-chunk reference the assemble phase retries against the folded
+// table.
+void finish_chunk(ThreadOut* to) {
+  size_t cnt = to->size();
+  to->id_hash.resize(cnt);
+  to->parent_idx.assign(cnt, -1);
+  to->tab.init(cnt);
+  if (cnt == 0) return;
+  const sv* ids = to->ids.data();
+  uint64_t* hs = to->id_hash.data();
+  for (size_t b = 0; b < cnt; b += kPrefetchBlock) {
+    size_t e = b + kPrefetchBlock < cnt ? b + kPrefetchBlock : cnt;
+    for (size_t i = b; i < e; ++i) {
+      hs[i] = SvMap::key_hash(ids[i]);
+      __builtin_prefetch(&to->tab.hashes[hs[i] & to->tab.mask], 1, 1);
+    }
+    for (size_t i = b; i < e; ++i) {
+      int64_t slot =
+          to->tab.insert(ids[i], hs[i], static_cast<int32_t>(i), ids);
+      if (slot >= 0)
+        to->local_dups.emplace_back(slot, static_cast<int32_t>(i));
+    }
+  }
+  const sv* parents = to->parents.data();
+  const uint8_t* hasp = to->hasp.data();
+  uint64_t phash[kPrefetchBlock];
+  for (size_t b = 0; b < cnt; b += kPrefetchBlock) {
+    size_t e = b + kPrefetchBlock < cnt ? b + kPrefetchBlock : cnt;
+    for (size_t i = b; i < e; ++i) {
+      if (!hasp[i]) {
+        phash[i - b] = 0;
+        continue;
+      }
+      phash[i - b] = SvMap::key_hash(parents[i]);
+      __builtin_prefetch(&to->tab.hashes[phash[i - b] & to->tab.mask], 0, 1);
+    }
+    for (size_t i = b; i < e; ++i) {
+      if (!hasp[i]) continue;
+      int32_t r = to->tab.find(parents[i], phash[i - b], ids);
+      to->parent_idx[i] = r >= 0 ? r : -2;
+    }
+  }
+}
+
 void parse_range(const std::vector<GroupRange>& kept, size_t g0, size_t g1,
                  ThreadOut* to) {
   uint64_t t0 = now_us();
@@ -1637,143 +1780,11 @@ void parse_range(const std::vector<GroupRange>& kept, size_t g0, size_t g1,
       break;
     }
   }
+  if (to->ok) finish_chunk(to);
   to->intern_probes += status_map.probes;
   to->intern_hits += status_map.hits;
   to->done_us = now_us();
   to->busy_us = to->done_us - t0;
-}
-
-// -- phase 3: shared span-id table with atomic claims -----------------------
-// Claim protocol: CAS the hash word 0 -> h; the winner then publishes its
-// row with release. A prober that sees a matching hash spins for the row
-// (claims publish within a few instructions), compares key bytes through
-// the flat id array, and either records a duplicate or walks on (distinct
-// key, same 64-bit hash). Single-threaded this degenerates to uncontended
-// atomics -- one code path for both modes.
-
-struct SpanIdTable {
-  struct Slot {
-    std::atomic<uint64_t> hash;
-    std::atomic<int32_t> row;
-  };
-  std::unique_ptr<Slot[]> slots;
-  size_t mask;
-
-  explicit SpanIdTable(size_t n_rows) {
-    size_t n = 64;
-    while (n < n_rows * 2) n <<= 1;
-    slots.reset(new Slot[n]);
-    for (size_t i = 0; i < n; ++i) {
-      slots[i].hash.store(0, std::memory_order_relaxed);
-      slots[i].row.store(-1, std::memory_order_relaxed);
-    }
-    mask = n - 1;
-  }
-
-  // returns -1 when `row` claimed the slot, else the slot index of the
-  // existing claim (a duplicate id). `contended` (graftprof) counts CAS
-  // losses and row spin-wait entries — cross-shard claim contention.
-  int64_t claim(sv key, uint64_t h, int32_t row, const sv* ids,
-                uint64_t* contended = nullptr) {
-    size_t j = h & mask;
-    for (;;) {
-      uint64_t cur = slots[j].hash.load(std::memory_order_acquire);
-      if (cur == 0) {
-        if (slots[j].hash.compare_exchange_strong(
-                cur, h, std::memory_order_acq_rel)) {
-          slots[j].row.store(row, std::memory_order_release);
-          return -1;
-        }
-        // lost the race; cur now holds the winner's hash -- fall through
-        if (contended != nullptr) ++*contended;
-      }
-      if (cur == h) {
-        int32_t r = slots[j].row.load(std::memory_order_acquire);
-        if (r < 0) {
-          if (contended != nullptr) ++*contended;
-          do {
-            cpu_relax();
-          } while ((r = slots[j].row.load(std::memory_order_acquire)) < 0);
-        }
-        const sv& k = ids[r];
-        // empty ids carry nullptr data; memcmp(nullptr, ..., 0) is UB
-        if (k.size() == key.size() &&
-            (key.empty() ||
-             std::memcmp(k.data(), key.data(), key.size()) == 0))
-          return static_cast<int64_t>(j);
-        // same hash, different key: keep probing
-      }
-      j = (j + 1) & mask;
-    }
-  }
-
-  // read-only lookup (post-build); -1 when absent
-  int32_t find(sv key, uint64_t h, const sv* ids) const {
-    size_t j = h & mask;
-    for (;;) {
-      uint64_t cur = slots[j].hash.load(std::memory_order_acquire);
-      if (cur == 0) return -1;
-      if (cur == h) {
-        int32_t r = slots[j].row.load(std::memory_order_acquire);
-        if (r >= 0) {
-          const sv& k = ids[r];
-          // empty ids carry nullptr data (span without an "id" probed
-          // by an empty parentId); memcmp(nullptr, ..., 0) is UB
-          if (k.size() == key.size() &&
-              (key.empty() ||
-               std::memcmp(k.data(), key.data(), key.size()) == 0))
-            return r;
-        }
-      }
-      j = (j + 1) & mask;
-    }
-  }
-};
-
-constexpr size_t kPrefetchBlock = 32;
-
-// insert rows [r0, r1) into the table in prefetched blocks; duplicate
-// claims append (slot, row) to `dups`; `contended` counts claim races
-void build_table_range(SpanIdTable& tab, const sv* ids, size_t r0, size_t r1,
-                       std::vector<std::pair<int64_t, int32_t>>* dups,
-                       uint64_t* contended) {
-  uint64_t hashes[kPrefetchBlock];
-  for (size_t b = r0; b < r1; b += kPrefetchBlock) {
-    size_t e = b + kPrefetchBlock < r1 ? b + kPrefetchBlock : r1;
-    for (size_t i = b; i < e; ++i) {
-      uint64_t h = SvMap::key_hash(ids[i]);
-      hashes[i - b] = h;
-      __builtin_prefetch(&tab.slots[h & tab.mask], 1, 1);
-    }
-    for (size_t i = b; i < e; ++i) {
-      int64_t slot = tab.claim(ids[i], hashes[i - b],
-                               static_cast<int32_t>(i), ids, contended);
-      if (slot >= 0) dups->emplace_back(slot, static_cast<int32_t>(i));
-    }
-  }
-}
-
-// resolve parent ids for rows [r0, r1) in prefetched blocks
-void resolve_parents_range(const SpanIdTable& tab, const sv* ids,
-                           const sv* parents, const uint8_t* has_parent,
-                           size_t r0, size_t r1, int32_t* parent_idx) {
-  uint64_t hashes[kPrefetchBlock];
-  for (size_t b = r0; b < r1; b += kPrefetchBlock) {
-    size_t e = b + kPrefetchBlock < r1 ? b + kPrefetchBlock : r1;
-    for (size_t i = b; i < e; ++i) {
-      if (!has_parent[i]) {
-        hashes[i - b] = 0;
-        continue;
-      }
-      uint64_t h = SvMap::key_hash(parents[i]);
-      hashes[i - b] = h;
-      __builtin_prefetch(&tab.slots[h & tab.mask], 0, 1);
-    }
-    for (size_t i = b; i < e; ++i) {
-      parent_idx[i] =
-          has_parent[i] ? tab.find(parents[i], hashes[i - b], ids) : -1;
-    }
-  }
 }
 
 // -- assembled result (pre-serialization) -----------------------------------
@@ -1790,6 +1801,7 @@ struct Assembled {
   std::vector<int32_t> trace_of;
   std::vector<int32_t> shape_id;   // global ids
   std::vector<int32_t> status_id;  // global ids
+  std::vector<uint64_t> id_hash;   // per-row span-id hash (from the chunks)
   std::vector<int32_t> parent_idx;
   ShapeTable shapes;        // global
   std::vector<sv> statuses;  // global
@@ -1810,10 +1822,14 @@ struct Assembled {
   uint32_t threads = 1;
 };
 
-// merge thread outputs + build span table + dedup fixup + parents.
-// `outs` rows are consumed (moved into the flat arrays).
+// merge chunk outputs + fold span tables + dedup fixup + parents.
+// `outs` holds one ThreadOut per work-stealing CHUNK (ascending document
+// order); `n_workers` is the worker-thread count and `worker_done` (when
+// non-empty) each worker's barrier-arrival timestamp for the graftprof
+// skew accounting. `outs` rows are consumed (moved into the flat arrays).
 void assemble(std::vector<ThreadOut>& outs, PrescanResult&& ps,
-              Assembled* as, unsigned n_threads) {
+              Assembled* as, unsigned n_workers,
+              const std::vector<uint64_t>& worker_done) {
   uint64_t m0 = now_us();
   as->kept = std::move(ps.kept);
 
@@ -1821,16 +1837,16 @@ void assemble(std::vector<ThreadOut>& outs, PrescanResult&& ps,
   for (auto& t : outs) n += t.size();
   as->n = n;
 
-  // graftprof: fold each worker's shape-table probe stats into its
+  // graftprof: fold each chunk's shape-table probe stats into its
   // ThreadOut — and pin its span count — before the columns/tables
-  // move/merge below (the single-worker path moves them out wholesale)
+  // move/merge below (the single-chunk path moves them out wholesale)
   std::vector<uint64_t> shard_sizes(outs.size(), 0);
   for (size_t ti = 0; ti < outs.size(); ++ti) {
     ThreadOut& t = outs[ti];
     shard_sizes[ti] = t.size();
     t.intern_probes += t.shapes.probes;
     t.intern_hits += t.shapes.hits;
-    // zero the table's own stats so a move into as->shapes (single-worker
+    // zero the table's own stats so a move into as->shapes (single-chunk
     // path) can't double-count them in the final flush
     t.shapes.probes = t.shapes.hits = 0;
   }
@@ -1896,6 +1912,10 @@ void assemble(std::vector<ThreadOut>& outs, PrescanResult&& ps,
       for (size_t i = 0; i < cnt; ++i) {
         as->shape_id[base + i] = shape_remap[as->shape_id[base + i]];
         as->status_id[base + i] = status_remap[as->status_id[base + i]];
+        // chunk-local parent rows shift by the chunk's document base
+        // (-1 absent and -2 retry-globally pass through unchanged)
+        if (as->parent_idx[base + i] >= 0)
+          as->parent_idx[base + i] += static_cast<int32_t>(base);
       }
     };
     if (n < 4096) {  // small windows: spawn cost dwarfs the copy
@@ -1914,39 +1934,47 @@ void assemble(std::vector<ThreadOut>& outs, PrescanResult&& ps,
   std::vector<sv>& parents = as->parents;
   std::vector<uint8_t>& hasp = as->hasp;
 
-  SpanIdTable table(n);
-  std::vector<std::vector<std::pair<int64_t, int32_t>>> dup_lists(n_threads);
-  std::vector<uint64_t> claim_contended(n_threads, 0);
-  if (n_threads <= 1 || n < 4096) {
-    build_table_range(table, ids.data(), 0, n, &dup_lists[0],
-                      &claim_contended[0]);
+  // single-pass fold of the per-chunk id tables into one flat table: no
+  // atomics, no CAS, no spin-waits. The parallel phase already hashed
+  // every id (id_hash column) and detected intra-chunk duplicates, so
+  // the fold is one sequential prefetched insert per row in document
+  // order; a collision here IS a cross-chunk duplicate. With a single
+  // chunk the chunk table simply becomes the global table.
+  uint64_t f0 = now_us();
+  FlatIdTable table;
+  std::vector<std::pair<int64_t, int32_t>> dups;
+  if (outs.size() == 1) {
+    table = std::move(outs[0].tab);
+    dups = std::move(outs[0].local_dups);
   } else {
-    std::vector<std::thread> ths;
-    size_t per = (n + n_threads - 1) / n_threads;
-    for (unsigned t = 0; t < n_threads; ++t) {
-      size_t r0 = t * per, r1 = std::min(n, r0 + per);
-      if (r0 >= r1) break;
-      ths.emplace_back(build_table_range, std::ref(table), ids.data(), r0,
-                       r1, &dup_lists[t], &claim_contended[t]);
+    table.init(n);
+    const uint64_t* hs = as->id_hash.data();
+    const sv* idp = ids.data();
+    for (size_t b = 0; b < n; b += kPrefetchBlock) {
+      size_t e = b + kPrefetchBlock < n ? b + kPrefetchBlock : n;
+      for (size_t i = b; i < e; ++i)
+        __builtin_prefetch(&table.hashes[hs[i] & table.mask], 1, 1);
+      for (size_t i = b; i < e; ++i) {
+        int64_t slot =
+            table.insert(idp[i], hs[i], static_cast<int32_t>(i), idp);
+        if (slot >= 0) dups.emplace_back(slot, static_cast<int32_t>(i));
+      }
     }
-    for (auto& th : ths) th.join();
   }
+  uint64_t fold_us = now_us() - f0;
 
   // duplicate fixup in document order: first position survives, last
-  // written fields win, later rows die (the sequential path never appends
-  // a row for a duplicate id)
-  std::vector<std::pair<int64_t, int32_t>> dups;
-  for (auto& dl : dup_lists) dups.insert(dups.end(), dl.begin(), dl.end());
+  // written fields win, later rows die
   std::vector<uint8_t> dead;
+  std::vector<int32_t> winner_pre;  // dead pre-compaction row -> winner row
+  std::vector<int32_t> remap;       // pre- -> post-compaction rows
   bool had_duplicates = !dups.empty();
   if (had_duplicates) {
     dead.assign(n, 0);
+    winner_pre.assign(n, -1);
     // gather claimants per slot
     std::vector<std::pair<int64_t, int32_t>> all = dups;
-    for (auto& d : dups) {
-      int32_t w = table.slots[d.first].row.load(std::memory_order_relaxed);
-      all.emplace_back(d.first, w);
-    }
+    for (auto& d : dups) all.emplace_back(d.first, table.rows[d.first]);
     std::sort(all.begin(), all.end());
     all.erase(std::unique(all.begin(), all.end()), all.end());
     size_t i = 0;
@@ -1960,7 +1988,10 @@ void assemble(std::vector<ThreadOut>& outs, PrescanResult&& ps,
       }
       // survivor keeps its position/trace_of; fields come from the last
       for (size_t k = i; k < j; ++k)
-        if (all[k].second != first) dead[all[k].second] = 1;
+        if (all[k].second != first) {
+          dead[all[k].second] = 1;
+          winner_pre[all[k].second] = first;
+        }
       if (last != first) {
         // survivor keeps its position and GROUP; every other field
         // comes from the last occurrence (JS-Map last-wins)
@@ -1968,11 +1999,11 @@ void assemble(std::vector<ThreadOut>& outs, PrescanResult&& ps,
         as->span_cols([&](auto& c) { c[first] = c[last]; });
         as->trace_of[first] = keep_group;
       }
-      table.slots[all[i].first].row.store(first, std::memory_order_relaxed);
+      table.rows[all[i].first] = first;
       i = j;
     }
     // compaction: drop dead rows (renumbers everything after them)
-    std::vector<int32_t> remap(n, -1);
+    remap.assign(n, -1);
     size_t w = 0;
     for (size_t r = 0; r < n; ++r) {
       if (dead[r]) continue;
@@ -1987,10 +2018,8 @@ void assemble(std::vector<ThreadOut>& outs, PrescanResult&& ps,
     n = w;
     // rebuild table rows through the remap
     for (size_t s2 = 0; s2 <= table.mask; ++s2) {
-      int32_t r = table.slots[s2].row.load(std::memory_order_relaxed);
-      if (r >= 0) {
-        table.slots[s2].row.store(remap[r], std::memory_order_relaxed);
-      }
+      int32_t r = table.rows[s2];
+      if (r >= 0) table.rows[s2] = remap[r];
     }
     // last-wins overwrites may have left shape/status tables holding
     // values seen only in dead records; rebuild over the FINAL rows
@@ -2024,60 +2053,78 @@ void assemble(std::vector<ThreadOut>& outs, PrescanResult&& ps,
     }
   }
 
-  // parent resolution (prefetched, parallel)
-  as->parent_idx.assign(n, -1);
-  if (n_threads <= 1 || n < 4096) {
-    resolve_parents_range(table, ids.data(), parents.data(), hasp.data(), 0,
-                          n, as->parent_idx.data());
-  } else {
-    std::vector<std::thread> ths;
-    size_t per = (n + n_threads - 1) / n_threads;
-    for (unsigned t = 0; t < n_threads; ++t) {
-      size_t r0 = t * per, r1 = std::min(n, r0 + per);
-      if (r0 >= r1) break;
-      ths.emplace_back(resolve_parents_range, std::cref(table), ids.data(),
-                       parents.data(), hasp.data(), r0, r1,
-                       as->parent_idx.data());
+  // parent fixup: chunk-local resolutions reference pre-compaction rows;
+  // route them through the remap (a resolution landing on a dead
+  // duplicate redirects to that id's survivor — exactly what a global
+  // lookup would have returned)
+  if (had_duplicates) {
+    for (size_t r = 0; r < n; ++r) {
+      int32_t p = as->parent_idx[r];
+      if (p < 0) continue;
+      int32_t p2 = remap[p];
+      if (p2 < 0) p2 = remap[winner_pre[p]];
+      as->parent_idx[r] = p2;
     }
-    for (auto& th : ths) th.join();
+  }
+  // the rare cross-chunk references (-2: parent id absent from its own
+  // chunk) retry against the folded table — ~0 rows in practice, since
+  // a parent lives inside its own trace group
+  for (size_t r = 0; r < n; ++r) {
+    if (as->parent_idx[r] != -2) continue;
+    uint64_t h = SvMap::key_hash(parents[r]);
+    as->parent_idx[r] =
+        hasp[r] ? table.find(parents[r], h, ids.data()) : -1;
   }
 
   as->ok = true;
   as->merge_us = static_cast<uint32_t>(now_us() - m0);
 
   // graftprof flush: one locked update per parse. Per-shard "merge
-  // lock-wait" is the barrier skew — how long each finished worker sat
-  // waiting for the slowest shard before assemble could start (zero in
-  // sequential mode, where done_us never gets set by parse_range's twin).
+  // lock-wait" is the barrier skew — how long each finished WORKER sat
+  // at the assemble barrier for the slowest one. Chunks aggregate onto
+  // their owning worker; with work-stealing the skew is bounded by one
+  // chunk's wall, so this plane reads ~0 on a balanced window (zero in
+  // sequential mode, where worker_done carries no timestamps).
   {
+    std::vector<uint64_t> wbusy(n_workers, 0), wspans(n_workers, 0);
+    std::vector<uint64_t> wdone(n_workers, 0);
+    for (size_t wi = 0; wi < worker_done.size() && wi < wdone.size(); ++wi)
+      wdone[wi] = worker_done[wi];
+    for (size_t ti = 0; ti < outs.size(); ++ti) {
+      uint32_t wi = outs[ti].worker < n_workers ? outs[ti].worker : 0;
+      wbusy[wi] += outs[ti].busy_us;
+      wspans[wi] += shard_sizes[ti];
+      if (worker_done.empty())
+        wdone[wi] = std::max(wdone[wi], outs[ti].done_us);
+    }
     uint64_t done_max = 0;
-    for (auto& t : outs) done_max = std::max(done_max, t.done_us);
-    uint64_t contended = 0;
-    for (uint64_t c : claim_contended) contended += c;
+    for (uint64_t d : wdone) done_max = std::max(done_max, d);
     std::lock_guard<std::mutex> g(g_prof.mu);
     g_prof.parses += 1;
     g_prof.spans += n;
     g_prof.merge_ns += static_cast<uint64_t>(as->merge_us) * 1000;
-    g_prof.claim_contended += contended;
+    g_prof.fold_ns += fold_us * 1000;
+    g_prof.fold_chunks += outs.size();
     g_prof.intern_probes += as->shapes.probes;
     g_prof.intern_hits += as->shapes.hits;
-    uint64_t pending = outs.size();
+    for (auto& t : outs) {
+      g_prof.intern_probes += t.intern_probes;
+      g_prof.intern_hits += t.intern_hits;
+    }
+    uint64_t pending = n_workers;
     if (pending > g_prof.merge_queue_depth_peak)
       g_prof.merge_queue_depth_peak = pending;
     g_prof.shards_used =
-        static_cast<uint32_t>(std::min<size_t>(outs.size(), kProfMaxShards));
+        static_cast<uint32_t>(std::min<uint32_t>(n_workers, kProfMaxShards));
     for (uint32_t ti = 0; ti < kProfMaxShards; ++ti) {
-      if (ti < outs.size()) {
-        ThreadOut& t = outs[ti];
+      if (ti < n_workers) {
         uint64_t wait_us =
-            (t.done_us != 0 && done_max > t.done_us) ? done_max - t.done_us
+            (wdone[ti] != 0 && done_max > wdone[ti]) ? done_max - wdone[ti]
                                                      : 0;
-        g_prof.shard_parse_ns[ti] = t.busy_us * 1000;
+        g_prof.shard_parse_ns[ti] = wbusy[ti] * 1000;
         g_prof.shard_wait_ns[ti] = wait_us * 1000;
-        g_prof.shard_spans[ti] = shard_sizes[ti];
+        g_prof.shard_spans[ti] = wspans[ti];
         g_prof.merge_lock_wait_ns += wait_us * 1000;
-        g_prof.intern_probes += t.intern_probes;
-        g_prof.intern_hits += t.intern_hits;
       } else {
         g_prof.shard_parse_ns[ti] = 0;
         g_prof.shard_wait_ns[ti] = 0;
@@ -2098,11 +2145,253 @@ unsigned pick_threads(int requested) {
 constexpr uint32_t kMergeUsBits = 25;
 constexpr uint32_t kMergeUsMask = (1u << kMergeUsBits) - 1;
 
+// -- columnar wire frame ("KMZC") -------------------------------------------
+// Compact SoA binary frame emitted by the Envoy WASM filter so production
+// ingest skips Zipkin JSON entirely (docs/INGEST_WIRE.md is the spec;
+// kmamiz_tpu/core/wire.py carries the reference Python codec). Layout
+// (little-endian):
+//   0  "KMZC"          magic
+//   4  u8  version     (1)
+//   5  u8  flags       (0, reserved)
+//   6  u16 reserved    (0)
+//   8  u32 body_len    byte length of everything after the 16-byte header
+//   12 u32 crc32(body) IEEE polynomial (zlib.crc32 / Go hash/crc32)
+//   16 body:
+//     u32 n_strings, then per string u32 len + bytes (the string table)
+//     u32 n_groups,  then per group i32 tid_sid (-1 = absent) + u32 n_spans
+//     u32 n_spans_total, then fixed-width SoA columns, each n_spans_total
+//     entries in document order:
+//       i32 id_sid, i32 parent_sid, i32 name_sid, i32 url_sid,
+//       i32 method_sid, i32 svc_sid, i32 ns_sid, i32 rev_sid, i32 mesh_sid,
+//       i32 status_sid, i8 kind (0 | 1 SERVER | 2 CLIENT), i64 timestamp_us,
+//       i64 duration_us
+// A sid of -1 means the field is ABSENT (distinct from an empty string,
+// matching the JSON path's presence bits). Any malformed byte — bad magic,
+// unknown version, short body, CRC mismatch, out-of-range sid, bad kind —
+// rejects the whole frame (nullptr return -> quarantine), exactly like
+// malformed JSON.
+
+constexpr uint32_t kColMagic = 0x435A4D4B;  // "KMZC" read as LE u32
+constexpr uint8_t kColVersion = 1;
+
+// work-stealing chunk granularity: chunks-per-worker factor (default 4;
+// KMAMIZ_PARSE_SHARDS through the Python binding's km_set_parse_shards).
+// Higher = finer stealing = lower barrier skew, at slightly more
+// per-chunk table/fold overhead.
+std::atomic<int> g_chunk_factor{4};
+
+struct Crc32Table {
+  uint32_t t[256];
+  Crc32Table() {
+    for (uint32_t i = 0; i < 256; ++i) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; ++k)
+        c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      t[i] = c;
+    }
+  }
+};
+
+uint32_t crc32_ieee(const uint8_t* p, size_t n) {
+  static const Crc32Table tab;  // magic-static: thread-safe init
+  uint32_t c = 0xFFFFFFFFu;
+  for (size_t i = 0; i < n; ++i) c = tab.t[(c ^ p[i]) & 0xFF] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
+struct ColReader {
+  const uint8_t* p;
+  const uint8_t* end;
+  bool ok = true;
+  size_t left() const { return static_cast<size_t>(end - p); }
+  bool need(size_t n) {
+    if (left() < n) ok = false;
+    return ok;
+  }
+  uint32_t u32() {
+    if (!need(4)) return 0;
+    uint32_t v;
+    std::memcpy(&v, p, 4);
+    p += 4;
+    return v;
+  }
+  const uint8_t* bytes(size_t n) {
+    if (!need(n)) return nullptr;
+    const uint8_t* q = p;
+    p += n;
+    return q;
+  }
+};
+
+// decode one columnar frame into the SAME assembled result the JSON
+// pipeline produces: rows route through emit_span (shared with the JSON
+// scanner), group dedup mirrors prescan (intra-payload seen set + skip
+// table/SkipSet, kNoneSentinel for absent trace ids, empty groups skipped
+// unregistered), and the output serializes through the unchanged v1 /
+// session wire — so JSON and columnar ingest are bit-exact by
+// construction, not by parallel implementations.
+bool parse_columnar_window(const char* buf, size_t len,
+                           const std::vector<std::pair<sv, bool>>& skip,
+                           const SkipSet* ss, std::vector<ThreadOut>& outs,
+                           Assembled* as) {
+  uint64_t p0 = now_us();
+  if (len < 16) return false;
+  const uint8_t* u = reinterpret_cast<const uint8_t*>(buf);
+  if (u[4] != kColVersion || u[5] != 0) return false;
+  uint32_t body_len, crc;
+  std::memcpy(&body_len, u + 8, 4);
+  std::memcpy(&crc, u + 12, 4);
+  if (static_cast<size_t>(body_len) + 16 != len) return false;
+  if (crc32_ieee(u + 16, body_len) != crc) return false;
+
+  ColReader r{u + 16, u + len};
+  uint32_t n_strings = r.u32();
+  if (!r.ok || n_strings > r.left() / 4) return false;
+  std::vector<sv> strs;
+  strs.reserve(n_strings);
+  for (uint32_t i = 0; i < n_strings; ++i) {
+    uint32_t sl = r.u32();
+    const uint8_t* q = r.bytes(sl);
+    if (!r.ok) return false;
+    strs.push_back(sv(reinterpret_cast<const char*>(q), sl));
+  }
+  int64_t nstr = static_cast<int64_t>(n_strings);
+
+  uint32_t n_groups = r.u32();
+  if (!r.ok || n_groups > r.left() / 8) return false;
+  std::vector<std::pair<int32_t, uint32_t>> groups;
+  groups.reserve(n_groups);
+  uint64_t span_sum = 0;
+  for (uint32_t g = 0; g < n_groups; ++g) {
+    int32_t tid_sid = static_cast<int32_t>(r.u32());
+    uint32_t cnt = r.u32();
+    if (tid_sid < -1 || tid_sid >= nstr) return false;
+    groups.emplace_back(tid_sid, cnt);
+    span_sum += cnt;
+  }
+  uint32_t n_total = r.u32();
+  if (!r.ok || span_sum != n_total) return false;
+  // fixed-width columns: 10 x i32 + 1 x i8 + 2 x i64 = 57 bytes per span,
+  // and they must consume the body EXACTLY (no trailing garbage)
+  if (r.left() != static_cast<size_t>(n_total) * 57) return false;
+  const uint8_t* col_i32[10];
+  for (int c = 0; c < 10; ++c)
+    col_i32[c] = r.bytes(static_cast<size_t>(n_total) * 4);
+  const uint8_t* col_kind = r.bytes(n_total);
+  const uint8_t* col_ts = r.bytes(static_cast<size_t>(n_total) * 8);
+  const uint8_t* col_dur = r.bytes(static_cast<size_t>(n_total) * 8);
+  if (!r.ok) return false;
+
+  auto rd_i32 = [](const uint8_t* col, size_t i) {
+    int32_t v;
+    std::memcpy(&v, col + i * 4, 4);
+    return v;
+  };
+  auto rd_i64 = [](const uint8_t* col, size_t i) {
+    int64_t v;
+    std::memcpy(&v, col + i * 8, 8);
+    return v;
+  };
+  // validate every sid/kind up front (skipped groups included): a frame
+  // either decodes whole or rejects whole
+  for (int c = 0; c < 10; ++c)
+    for (size_t i = 0; i < n_total; ++i) {
+      int32_t v = rd_i32(col_i32[c], i);
+      if (v < -1 || v >= nstr) return false;
+    }
+  for (size_t i = 0; i < n_total; ++i)
+    if (col_kind[i] > 2) return false;
+  auto sid_sv = [&](int32_t sid) { return sid >= 0 ? strs[sid] : sv("", 0); };
+
+  outs.resize(1);
+  ThreadOut* to = &outs[0];
+  to->reserve(n_total);
+  PrescanResult ps;
+  SvMap seen(skip.size() + 64);
+  bool ins;
+  for (auto& e : skip)
+    seen.intern(e.second ? e.first : kNoneSentinel, 1, &ins);
+  SvMap status_map(64);
+  sv last_status;
+  int32_t last_status_id = -1;
+  auto shape_cache = std::make_unique<ShapeCache>();
+
+  size_t row = 0;
+  for (auto& gr : groups) {
+    size_t base = row;
+    uint32_t cnt = gr.second;
+    row += cnt;
+    if (cnt == 0) continue;  // empty group: skipped, not registered
+    bool tid_present = gr.first >= 0;
+    sv tid = tid_present ? strs[gr.first] : sv("", 0);
+    sv seen_key = tid_present ? tid : kNoneSentinel;
+    if (seen.find(seen_key) != nullptr ||
+        (ss != nullptr && ss->contains(seen_key)))
+      continue;  // whole group already processed
+    seen.intern(seen_key, 1, &ins);
+    int32_t gidx = static_cast<int32_t>(ps.kept.size());
+    ps.kept.push_back(GroupRange{buf, buf, tid, tid_present});
+    for (size_t i = base; i < base + cnt; ++i) {
+      SpanRec rec;
+      rec.id = sid_sv(rd_i32(col_i32[0], i));
+      int32_t sid = rd_i32(col_i32[1], i);
+      rec.has_parent = sid >= 0;
+      rec.parent_id = sid_sv(sid);
+      rec.name = sid_sv(rd_i32(col_i32[2], i));
+      sid = rd_i32(col_i32[3], i);
+      rec.url_present = sid >= 0;
+      rec.url = sid_sv(sid);
+      sid = rd_i32(col_i32[4], i);
+      if (sid >= 0) rec.present |= kHasMethod;
+      rec.method = sid_sv(sid);
+      sid = rd_i32(col_i32[5], i);
+      if (sid >= 0) rec.present |= kHasSvc;
+      rec.svc = sid_sv(sid);
+      sid = rd_i32(col_i32[6], i);
+      if (sid >= 0) rec.present |= kHasNs;
+      rec.ns = sid_sv(sid);
+      sid = rd_i32(col_i32[7], i);
+      if (sid >= 0) rec.present |= kHasRev;
+      rec.rev = sid_sv(sid);
+      sid = rd_i32(col_i32[8], i);
+      if (sid >= 0) rec.present |= kHasMesh;
+      rec.mesh = sid_sv(sid);
+      sid = rd_i32(col_i32[9], i);
+      rec.status_present = sid >= 0;
+      rec.status = sid_sv(sid);
+      rec.kind = static_cast<int8_t>(col_kind[i]);
+      rec.timestamp_raw = static_cast<double>(rd_i64(col_ts, i));
+      rec.latency_ms = static_cast<double>(rd_i64(col_dur, i)) / 1000.0;
+      emit_span(to, rec, gidx, status_map, last_status, last_status_id,
+                *shape_cache);
+    }
+  }
+  finish_chunk(to);
+  to->intern_probes += status_map.probes;
+  to->intern_hits += status_map.hits;
+  ps.ok = true;
+  as->prescan_us = 0;
+  as->parse_us = static_cast<uint32_t>(now_us() - p0);
+  assemble(outs, std::move(ps), as, 1, {});
+  return as->ok;
+}
+
 bool parse_pipeline(const char* json, size_t json_len,
                     const std::vector<std::pair<sv, bool>>& skip,
                     Arena* arena, std::vector<ThreadOut>& outs,
                     Assembled* as, int n_threads_req,
                     const SkipSet* ss = nullptr) {
+  // columnar fast path: EVERY entry point (blob / skipset / session)
+  // accepts "KMZC" frames through the same funnel — a JSON body can
+  // never start with 'K', so the magic is unambiguous
+  if (json_len >= 4) {
+    uint32_t m;
+    std::memcpy(&m, json, 4);
+    if (m == kColMagic) {
+      as->threads = 1;  // one sequential decode pass (no JSON to scan)
+      return parse_columnar_window(json, json_len, skip, ss, outs, as);
+    }
+  }
   unsigned n_threads = pick_threads(n_threads_req);
   as->threads = n_threads;
 
@@ -2112,9 +2401,10 @@ bool parse_pipeline(const char* json, size_t json_len,
     outs.resize(1);
     PrescanResult ps = prescan(json, json_len, skip, arena, &outs[0], ss);
     if (!ps.ok || !outs[0].ok) return false;
+    finish_chunk(&outs[0]);  // id table + local parents, still parse time
     as->prescan_us = 0;
     as->parse_us = static_cast<uint32_t>(now_us() - p0);
-    assemble(outs, std::move(ps), as, 1);
+    assemble(outs, std::move(ps), as, 1, {});
     return as->ok;
   }
 
@@ -2123,39 +2413,59 @@ bool parse_pipeline(const char* json, size_t json_len,
   uint64_t p1 = now_us();
   as->prescan_us = static_cast<uint32_t>(p1 - p0);
 
-  // contiguous, byte-balanced group ranges preserve document order
+  // contiguous, byte-balanced group ranges preserve document order.
+  // Work-stealing: ~4 chunks per worker claimed off a shared cursor, so
+  // the barrier skew (graftprof "merge lock-wait") is bounded by ONE
+  // chunk's wall instead of one worker's whole range — a worker that
+  // drew cheap groups steals the tail instead of idling at the barrier.
   size_t total_bytes = 0;
   for (auto& g : ps.kept)
     total_bytes += static_cast<size_t>(g.end - g.begin);
   size_t n_groups = ps.kept.size();
   unsigned workers =
       static_cast<unsigned>(std::min<size_t>(n_threads, n_groups ? n_groups : 1));
-  outs.resize(workers);
-  std::vector<size_t> cuts(workers + 1, n_groups);
+  size_t factor = static_cast<size_t>(
+      std::max(1, g_chunk_factor.load(std::memory_order_relaxed)));
+  size_t n_chunks = std::min<size_t>(
+      std::min<size_t>(static_cast<size_t>(workers) * factor,
+                       n_groups ? n_groups : 1),
+      kProfMaxShards);
+  if (n_chunks < workers) n_chunks = workers;
+  outs.resize(n_chunks);
+  std::vector<size_t> cuts(n_chunks + 1, n_groups);
   cuts[0] = 0;
   size_t acc = 0, w = 1;
-  size_t per = total_bytes / workers + 1;
-  for (size_t g = 0; g < n_groups && w < workers; ++g) {
+  size_t per = total_bytes / n_chunks + 1;
+  for (size_t g = 0; g < n_groups && w < n_chunks; ++g) {
     acc += static_cast<size_t>(ps.kept[g].end - ps.kept[g].begin);
     if (acc >= per * w) cuts[w++] = g + 1;
   }
-  std::vector<std::thread> ths;
-  for (unsigned t = 0; t < workers; ++t) {
-    if (cuts[t] >= cuts[t + 1]) {
-      outs[t].ok = true;
-      continue;
+  std::atomic<size_t> cursor{0};
+  std::vector<uint64_t> worker_done(workers, 0);
+  auto worker_fn = [&](unsigned wi) {
+    for (;;) {
+      size_t c = cursor.fetch_add(1, std::memory_order_relaxed);
+      if (c >= n_chunks) break;
+      outs[c].worker = wi;
+      if (cuts[c] < cuts[c + 1])
+        parse_range(ps.kept, cuts[c], cuts[c + 1], &outs[c]);
     }
-    ths.emplace_back(parse_range, std::cref(ps.kept), cuts[t], cuts[t + 1],
-                     &outs[t]);
-  }
+    worker_done[wi] = now_us();
+  };
+  std::vector<std::thread> ths;
+  for (unsigned t = 1; t < workers; ++t) ths.emplace_back(worker_fn, t);
+  worker_fn(0);
   for (auto& th : ths) th.join();
   for (auto& t : outs)
     if (!t.ok) return false;
+  std::vector<uint64_t> wbusy(workers, 0);
+  for (auto& t : outs)
+    wbusy[t.worker < workers ? t.worker : 0] += t.busy_us;
   uint64_t busy_max = 0;
-  for (auto& t : outs) busy_max = std::max(busy_max, t.busy_us);
+  for (uint64_t b : wbusy) busy_max = std::max(busy_max, b);
   as->parse_us = static_cast<uint32_t>(busy_max);
 
-  assemble(outs, std::move(ps), as, workers);
+  assemble(outs, std::move(ps), as, workers, worker_done);
   return as->ok;
 }
 
@@ -2462,16 +2772,29 @@ unsigned char* km_parse_spans(const char* skip_blob, size_t skip_len,
   return km_parse_spans_mt(skip_blob, skip_len, json, json_len, 0, out_len);
 }
 
+// capability probe for the Python binding: bit 0 = columnar ("KMZC")
+// frames accepted by every parse entry point. A stale prebuilt .so
+// missing this symbol predates the columnar wire — the binding then
+// transcodes frames to Zipkin JSON in Python before parsing.
+unsigned int km_wire_caps() { return 1u; }
+
+// KMAMIZ_PARSE_SHARDS: work-stealing chunks-per-worker factor (1..64)
+void km_set_parse_shards(int factor) {
+  if (factor >= 1 && factor <= 64)
+    g_chunk_factor.store(factor, std::memory_order_relaxed);
+}
+
 // -- graftprof counter snapshot ---------------------------------------------
 // Wire (little-endian, km_free to release):
 //   u32 version, u32 shards_used,
 //   u64 parses, spans, merge_ns, merge_lock_wait_ns,
 //       merge_queue_depth_peak, claim_contended, intern_probes, intern_hits,
+//       fold_ns, fold_chunks,                      (v2+)
 //   then shards_used * (u64 parse_ns, u64 wait_ns, u64 spans)
 unsigned char* km_prof_snapshot(size_t* out_len) {
   *out_len = 0;
   std::lock_guard<std::mutex> g(g_prof.mu);
-  size_t sz = 8 + 8 * 8 + static_cast<size_t>(g_prof.shards_used) * 24;
+  size_t sz = 8 + 8 * 10 + static_cast<size_t>(g_prof.shards_used) * 24;
   unsigned char* buf = static_cast<unsigned char*>(std::malloc(sz));
   if (buf == nullptr) return nullptr;
   unsigned char* w = buf;
@@ -2493,6 +2816,8 @@ unsigned char* km_prof_snapshot(size_t* out_len) {
   w_u64(g_prof.claim_contended);
   w_u64(g_prof.intern_probes);
   w_u64(g_prof.intern_hits);
+  w_u64(g_prof.fold_ns);
+  w_u64(g_prof.fold_chunks);
   for (uint32_t ti = 0; ti < g_prof.shards_used; ++ti) {
     w_u64(g_prof.shard_parse_ns[ti]);
     w_u64(g_prof.shard_wait_ns[ti]);
@@ -2512,6 +2837,8 @@ void km_prof_reset() {
   g_prof.claim_contended = 0;
   g_prof.intern_probes = 0;
   g_prof.intern_hits = 0;
+  g_prof.fold_ns = 0;
+  g_prof.fold_chunks = 0;
   g_prof.shards_used = 0;
   for (uint32_t ti = 0; ti < kProfMaxShards; ++ti) {
     g_prof.shard_parse_ns[ti] = 0;

@@ -29,6 +29,7 @@ ALL_RULES = {
     "unguarded-shared-state",
     "hot-path-metric-label",
     "hot-path-clock",
+    "prof-counter-wire",
 }
 
 
@@ -92,6 +93,26 @@ class TestFrameworkMechanics:
         assert {f.rule for f in result.findings} == {"unguarded-shared-state"}
         with pytest.raises(ValueError, match="unknown rule"):
             framework.lint_paths(str(FIXTURE_ROOT), rules=["no-such-rule"])
+
+    def test_prof_counter_wire_flags_both_directions(self):
+        # the fixture struct has `new_counter_ns` the decoder never
+        # learned AND the decoder lists `ghost_ns` the struct dropped;
+        # both findings anchor on the _PROF_SCALARS assignment line
+        result = framework.lint_paths(
+            str(FIXTURE_ROOT), rules=["prof-counter-wire"], tables=({}, {})
+        )
+        msgs = sorted(f.message for f in result.findings)
+        assert len(msgs) == 2
+        assert any("new_counter_ns" in m and "not listed" in m for m in msgs)
+        assert any("ghost_ns" in m and "stale" in m for m in msgs)
+
+    def test_prof_counter_wire_clean_without_native_tree(self, tmp_path):
+        # fixture repos without native/kmamiz_spans.cpp are out of scope
+        pkg = tmp_path / "kmamiz_tpu" / "native"
+        pkg.mkdir(parents=True)
+        (pkg / "__init__.py").write_text('_PROF_SCALARS = ("parses",)\n')
+        result = framework.lint_paths(str(tmp_path))
+        assert not result.findings
 
     def test_suppression_comment_above_line(self, tmp_path):
         pkg = tmp_path / "kmamiz_tpu" / "server"

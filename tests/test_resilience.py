@@ -469,6 +469,132 @@ def test_kill_between_wal_append_and_merge_replays_bitexact(
     assert res_metrics.get("walReplays") == 1
 
 
+def test_wal_kind_byte_roundtrip(tmp_path):
+    """v2 framing: the record self-describes its wire format (ISSUE 12
+    satellite) and replay_records surfaces it."""
+    from kmamiz_tpu.core import wire
+    from kmamiz_tpu.resilience.wal import KIND_COLUMNAR, KIND_JSON
+
+    wal = IngestWAL(str(tmp_path / "wal"))
+    json_payload = json.dumps([[mk_span("tk", "s1")]]).encode()
+    col_payload = wire.encode_groups([[mk_span("tk2", "s2")]])
+    wal.append(json_payload)
+    wal.append(col_payload)
+    wal.close()
+    records = list(IngestWAL(str(tmp_path / "wal")).replay_records())
+    assert records == [
+        (KIND_JSON, json_payload),
+        (KIND_COLUMNAR, col_payload),
+    ]
+    # bytes-only replay stays the stable surface the processor uses
+    assert list(IngestWAL(str(tmp_path / "wal")).replay()) == [
+        json_payload,
+        col_payload,
+    ]
+
+
+def test_wal_v1_segment_back_compat(tmp_path):
+    """A pre-upgrade segment (no magic, no kind byte) replays as JSON
+    records, and the next append rotates to a fresh v2 segment instead
+    of mixing framings inside the v1 file."""
+    import struct
+    import zlib
+
+    from kmamiz_tpu.resilience.wal import KIND_JSON
+
+    wal_dir = tmp_path / "wal"
+    wal_dir.mkdir()
+    old = b"legacy-payload"
+    (wal_dir / "000000.wal").write_bytes(
+        struct.pack("<II", len(old), zlib.crc32(old)) + old
+    )
+    wal = IngestWAL(str(wal_dir))
+    assert list(wal.replay_records()) == [(KIND_JSON, old)]
+    wal.append(b"new-payload")
+    wal.close()
+    segments = sorted(wal_dir.glob("*.wal"))
+    assert len(segments) == 2  # v1 history untouched, v2 segment opened
+    assert list(IngestWAL(str(wal_dir)).replay()) == [old, b"new-payload"]
+
+
+def test_wal_kind_byte_contradiction_stops_replay(tmp_path):
+    """A kind byte that disagrees with the payload is corruption: replay
+    stops cleanly before the lying record."""
+    import struct
+    import zlib
+
+    from kmamiz_tpu.resilience.wal import KIND_COLUMNAR, _SEGMENT_MAGIC
+
+    wal = IngestWAL(str(tmp_path / "wal"))
+    wal.append(b"first-good")
+    wal.close()
+    (segment,) = sorted((tmp_path / "wal").glob("*.wal"))
+    lie = b"not-a-columnar-frame"
+    segment.write_bytes(
+        segment.read_bytes()
+        + struct.pack("<IIB", len(lie), zlib.crc32(lie), KIND_COLUMNAR)
+        + lie
+    )
+    assert segment.read_bytes().startswith(_SEGMENT_MAGIC)
+    assert list(IngestWAL(str(tmp_path / "wal")).replay()) == [b"first-good"]
+
+
+def test_kill_with_columnar_window_replays_bitexact(monkeypatch, tmp_path):
+    """The crash-replay pillar over a MIXED JSON + columnar WAL: the
+    recovered graph equals a no-crash run ingesting the same windows
+    through the real native parser (both wire formats route through the
+    same emit path, so the signature is the oracle)."""
+    from kmamiz_tpu import native
+    from kmamiz_tpu.core import wire
+
+    if not native.available():
+        pytest.skip("native span loader not built")
+    monkeypatch.setenv("KMAMIZ_QUARANTINE_DIR", str(tmp_path / "quarantine"))
+
+    json_chunks = clean_chunks(n_traces=8, per_chunk=2, prefix="cw")
+    col_chunk = wire.encode_groups(
+        [
+            [
+                mk_span("colT1", "colA"),
+                mk_span("colT1", "colB", parent="colA", svc="down7",
+                        url="http://down7.ns/api/9"),
+            ],
+            [mk_span("colT2", "colC", svc="down8")],
+        ]
+    )
+    chunks = json_chunks + [col_chunk]
+
+    def build():
+        return DataProcessor(
+            trace_source=lambda *a: [], use_device_stats=False
+        )
+
+    reference = build()
+    for raw in chunks:
+        reference.ingest_raw_window(raw)
+    reference_sig = graph_signature(reference.graph)
+
+    monkeypatch.setenv("KMAMIZ_WAL", "1")
+    monkeypatch.setenv("KMAMIZ_WAL_DIR", str(tmp_path / "wal"))
+    crashing = build()
+    for raw in chunks[:-1]:
+        crashing.ingest_raw_window(raw)
+    # crash point: the COLUMNAR window is durably appended, merge never ran
+    crashing._wal_append(chunks[-1])
+    del crashing
+
+    from kmamiz_tpu.resilience.wal import KIND_COLUMNAR
+
+    kinds = [k for k, _ in IngestWAL(str(tmp_path / "wal")).replay_records()]
+    assert kinds[-1] == KIND_COLUMNAR and KIND_COLUMNAR not in kinds[:-1]
+
+    recovered = build()
+    replay = recovered.replay_wal()
+    assert replay["replayed"] == len(chunks)
+    assert replay["quarantined"] == 0
+    assert graph_signature(recovered.graph) == reference_sig
+
+
 def test_wal_off_by_default(dp):
     processor = dp()
     assert processor._wal is None

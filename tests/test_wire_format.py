@@ -1,0 +1,280 @@
+"""Columnar ingest wire format (KMZC) parity pins — docs/INGEST_WIRE.md.
+
+The contract under test (ISSUE 12 tentpole 2): the SAME spans ingested
+as Zipkin JSON and as a columnar frame produce IDENTICAL graphs — the
+`graph_signature` (sha256 over the masked edge triples) is the
+bit-exactness oracle — and a malformed frame takes the SAME quarantine
+path a malformed JSON body takes. Three decoders share the layout (the
+native fast path, the pure-Python reference codec, the Go encoder in
+envoy/filter/main.go); these tests pin native vs Python against each
+other so a layout drift in either shows up as a parity break.
+"""
+from __future__ import annotations
+
+import json
+import struct
+import zlib
+
+import numpy as np
+import pytest
+
+from kmamiz_tpu import native
+from kmamiz_tpu.core import wire
+from kmamiz_tpu.resilience import quarantine as res_quarantine
+from kmamiz_tpu.resilience.chaos import graph_signature
+from kmamiz_tpu.server.processor import DataProcessor
+
+needs_native = pytest.mark.skipif(
+    not native.available(), reason="native span loader not built"
+)
+
+
+def mk_span(tid, sid, parent=None, svc="svc", url=None, **over):
+    span = {
+        "traceId": tid,
+        "id": sid,
+        "kind": "SERVER",
+        "name": f"{svc}.ns.svc.cluster.local:80/*",
+        "timestamp": 1_700_000_000_000_000,
+        "duration": 1000,
+        "tags": {
+            "http.method": "GET",
+            "http.status_code": "200",
+            "http.url": url or f"http://{svc}.ns/api",
+            "istio.canonical_revision": "v1",
+            "istio.canonical_service": svc,
+            "istio.mesh_id": "cluster.local",
+            "istio.namespace": "ns",
+        },
+    }
+    if parent is not None:
+        span["parentId"] = parent
+    span.update(over)
+    return span
+
+
+def _seeded_groups(seed=7, n_traces=40):
+    """Deterministic adversarial trace groups: every shape the JSON
+    scanner special-cases — absent/None traceIds, duplicate span ids,
+    orphan parents, non-SERVER/CLIENT kinds, missing tags, non-string
+    tag values, empty groups."""
+    import random
+
+    rng = random.Random(seed)
+    groups = []
+    for t in range(n_traces):
+        tid = f"trace-{seed}-{t}"
+        spans = [mk_span(tid, f"{t}-root", svc=f"svc{t % 7}")]
+        for c in range(rng.randrange(0, 4)):
+            child = mk_span(
+                tid,
+                f"{t}-c{c}",
+                parent=f"{t}-root",
+                svc=f"down{(t + c) % 5}",
+                url=f"http://down{(t + c) % 5}.ns/api/{c}",
+            )
+            roll = rng.random()
+            if roll < 0.15:
+                child["kind"] = rng.choice(["CLIENT", "PRODUCER", "CONSUMER"])
+            elif roll < 0.25:
+                child.pop("kind")
+            if rng.random() < 0.15:
+                child["tags"].pop("http.url")
+                child["tags"].pop("http.method")
+            if rng.random() < 0.1:
+                child["parentId"] = f"{t}-orphan-parent"
+            if rng.random() < 0.1:
+                child["tags"]["http.status_code"] = 500  # non-string: dropped
+            spans.append(child)
+        if rng.random() < 0.1:
+            spans.append(dict(spans[-1]))  # duplicate span id in-trace
+        groups.append(spans)
+        if rng.random() < 0.12:
+            groups.append([])  # empty group
+        if rng.random() < 0.12:
+            bare = mk_span(tid, f"{t}-bare", svc="bare")
+            del bare["traceId"]  # absent tid group
+            groups.append([bare])
+    return groups
+
+
+def _assert_parse_parity(a: dict, b: dict) -> None:
+    """Every data key bit-exact; "timings" (wall/thread accounting)
+    legitimately differs between runs."""
+    assert a is not None and b is not None
+    assert set(a) == set(b)
+    for key in a:
+        if key == "timings":
+            continue
+        va, vb = a[key], b[key]
+        if isinstance(va, np.ndarray):
+            assert np.array_equal(va, vb), f"column {key} diverged"
+        else:
+            assert va == vb, f"column {key} diverged"
+
+
+def _ingest_signature(raw: bytes) -> str:
+    dp = DataProcessor(trace_source=lambda *a: [], use_device_stats=False)
+    out = dp.ingest_raw_window(raw)
+    assert out["spans"] > 0
+    return graph_signature(dp.graph)
+
+
+# -- codec round trip ---------------------------------------------------------
+
+
+class TestCodecRoundTrip:
+    def test_decode_inverts_encode(self):
+        groups = _seeded_groups(seed=3)
+        frame = wire.encode_groups(groups)
+        decoded = wire.decode_groups(frame)
+        assert decoded is not None
+        # re-encoding the decode is a fixed point: string table order and
+        # every column byte are reproduced exactly
+        assert wire.encode_groups(decoded) == frame
+
+    def test_absent_vs_empty_string_distinct(self):
+        with_empty = [[mk_span("t1", "s1")]]
+        with_empty[0][0]["tags"]["http.url"] = ""
+        without = [[mk_span("t1", "s2")]]
+        without[0][0]["tags"].pop("http.url")
+        d_empty = wire.decode_groups(wire.encode_groups(with_empty))
+        d_absent = wire.decode_groups(wire.encode_groups(without))
+        assert d_empty[0][0]["tags"]["http.url"] == ""
+        assert "http.url" not in d_absent[0][0].get("tags", {})
+
+    def test_frame_is_compact(self):
+        groups = _seeded_groups(seed=11)
+        raw_json = json.dumps(groups, separators=(",", ":")).encode()
+        frame = wire.encode_groups(groups)
+        assert len(frame) < len(raw_json) / 2  # measured ~4.5x smaller
+
+    @pytest.mark.parametrize(
+        "mutate",
+        [
+            lambda b: b[:-1],                      # truncated body
+            lambda b: b[: len(b) // 2],            # truncated mid-column
+            lambda b: b"XMZC" + b[4:],             # bad magic
+            lambda b: b[:4] + b"\x09" + b[5:],     # unknown version
+            lambda b: b[:-1] + bytes([b[-1] ^ 1]), # flipped bit: CRC fails
+            lambda b: b[:12] + b"\xff\xff\xff\xff" + b[16:],  # bad crc field
+        ],
+    )
+    def test_malformed_frames_reject_whole(self, mutate):
+        frame = wire.encode_groups(_seeded_groups(seed=5, n_traces=6))
+        assert wire.decode_groups(mutate(frame)) is None
+        assert wire.columnar_to_json(mutate(frame)) is None
+
+    def test_out_of_range_sid_rejects(self):
+        frame = bytearray(wire.encode_groups([[mk_span("t", "s")]]))
+        # first span column entry lives right after the string/group
+        # tables; corrupt a known sid to an absurd index and re-CRC so
+        # ONLY the sid validation can catch it
+        body = bytearray(frame[wire._HEADER.size:])
+        # walk to the id-column start: n_strings + entries, groups, n
+        off = 0
+        (n_strings,) = struct.unpack_from("<I", body, off)
+        off += 4
+        for _ in range(n_strings):
+            (slen,) = struct.unpack_from("<I", body, off)
+            off += 4 + slen
+        (n_groups,) = struct.unpack_from("<I", body, off)
+        off += 4 + 8 * n_groups + 4
+        struct.pack_into("<i", body, off, 10_000)
+        header = wire._HEADER.pack(
+            wire.MAGIC, wire.VERSION, 0, 0, len(body), zlib.crc32(bytes(body))
+        )
+        assert wire.decode_groups(header + bytes(body)) is None
+
+
+# -- native vs JSON parity ----------------------------------------------------
+
+
+@needs_native
+class TestNativeParity:
+    def test_parse_spans_bit_exact_vs_json(self):
+        groups = _seeded_groups(seed=13)
+        raw_json = json.dumps(groups).encode()
+        frame = wire.encode_groups(groups)
+        _assert_parse_parity(
+            native.parse_spans(raw_json), native.parse_spans(frame)
+        )
+
+    @pytest.mark.parametrize("seed", [1, 2, 3, 4, 5])
+    def test_graph_signature_identical_both_paths(self, seed, monkeypatch,
+                                                  tmp_path):
+        monkeypatch.setenv(
+            "KMAMIZ_QUARANTINE_DIR", str(tmp_path / "quarantine")
+        )
+        groups = _seeded_groups(seed=seed)
+        sig_json = _ingest_signature(json.dumps(groups).encode())
+        sig_col = _ingest_signature(wire.encode_groups(groups))
+        assert sig_json == sig_col
+
+    def test_transcode_fallback_bit_exact(self):
+        """The stale-.so path (no km_wire_caps: frame -> JSON -> JSON
+        scanner) must land on the same rows the native columnar decoder
+        produces."""
+        groups = _seeded_groups(seed=17)
+        frame = wire.encode_groups(groups)
+        _assert_parse_parity(
+            native.parse_spans(frame),
+            native.parse_spans(wire.columnar_to_json(frame)),
+        )
+
+    def test_columnar_accepted_via_every_entry_point(self):
+        """The magic check sits at the top of the shared parse pipeline,
+        so the skipset and session entry points take columnar frames
+        too."""
+        groups = [[mk_span("ep-t1", "a"), mk_span("ep-t1", "b", parent="a")]]
+        frame = wire.encode_groups(groups)
+        out_skip = native.parse_spans(frame, skipset=native.SkipSet())
+        assert out_skip is not None and out_skip["n_spans"] == 2
+        out_sess = native.parse_spans(frame, session=native.ParseSession())
+        assert out_sess is not None and out_sess["n_spans"] == 2
+
+
+# -- quarantine parity --------------------------------------------------------
+
+
+class TestQuarantineParity:
+    def test_valid_frame_classifies_clean(self):
+        frame = wire.encode_groups(_seeded_groups(seed=23, n_traces=4))
+        assert res_quarantine.classify_payload(frame) is None
+
+    def test_truncated_and_corrupt_frames_classify_parse_error(self):
+        frame = wire.encode_groups(_seeded_groups(seed=29, n_traces=4))
+        for bad in (frame[:-5], frame[:20],
+                    frame[:-1] + bytes([frame[-1] ^ 0xFF])):
+            assert (
+                res_quarantine.classify_payload(bad)
+                == res_quarantine.REASON_PARSE_ERROR
+            )
+
+    @needs_native
+    def test_corrupt_frame_quarantines_like_corrupt_json(
+        self, monkeypatch, tmp_path
+    ):
+        """End to end: a corrupt frame diverts with a reason code and
+        the surviving windows build the same graph as never having seen
+        it — the identical fail-open posture the JSON path has."""
+        monkeypatch.setenv(
+            "KMAMIZ_QUARANTINE_DIR", str(tmp_path / "quarantine")
+        )
+        good = _seeded_groups(seed=31, n_traces=10)
+        good_frame = wire.encode_groups(good)
+        corrupt = good_frame[:-7]
+
+        clean = DataProcessor(
+            trace_source=lambda *a: [], use_device_stats=False
+        )
+        clean.ingest_raw_window(good_frame)
+        expect = graph_signature(clean.graph)
+
+        poisoned = DataProcessor(
+            trace_source=lambda *a: [], use_device_stats=False
+        )
+        out_bad = poisoned.ingest_raw_window(corrupt)
+        assert out_bad["quarantined"] == 1 and out_bad["spans"] == 0
+        poisoned.ingest_raw_window(good_frame)
+        assert graph_signature(poisoned.graph) == expect

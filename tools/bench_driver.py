@@ -1,0 +1,122 @@
+"""Bench artifact recorder: run bench.py, wrap its result, fail loudly.
+
+Writes the ``BENCH_r<N>.json`` wrapper shape every round has used —
+``{"n": N, "cmd": [...], "rc": int, "tail": str, "parsed": dict}`` —
+but REFUSES to record an unparsable round: BENCH_r04/r05 were silently
+written with ``"parsed": null`` (the bench crashed past its JSON line;
+the wrapper shrugged), and the SLO gate then skipped them for two PRs.
+Now a round with no parseable result line exits nonzero with the reason
+on stderr and writes NOTHING, so the broken run is fixed instead of
+archived; ``tools/slo_report.py --check`` enforces the same contract on
+the reading side.
+
+    python tools/bench_driver.py                 # next round number, repo root
+    python tools/bench_driver.py --n 6           # explicit round
+    python tools/bench_driver.py -- --quick      # args after -- go to bench.py
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import subprocess
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+#: keep enough of stdout+stderr for slo_report's last-JSON-line fallback
+#: and for a human reading a failed round's traceback
+_TAIL_BYTES = 65536
+
+
+def parse_result(tail: str):
+    """The LAST parseable JSON object line in the output, or None."""
+    for line in reversed(tail.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                doc = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(doc, dict):
+                return doc
+    return None
+
+
+def next_round(root: str) -> int:
+    best = 0
+    for name in os.listdir(root):
+        m = re.fullmatch(r"BENCH_r(\d+)\.json", name)
+        if m:
+            best = max(best, int(m.group(1)))
+    return best + 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--n", type=int, default=None, help="round number")
+    ap.add_argument("--root", default=_ROOT, help="artifact directory")
+    ap.add_argument(
+        "--timeout", type=float, default=3600.0, help="bench wall cap (s)"
+    )
+    ap.add_argument(
+        "bench_args", nargs="*", help="extra args passed through to bench.py"
+    )
+    args = ap.parse_args(argv)
+
+    n = args.n if args.n is not None else next_round(args.root)
+    cmd = [sys.executable, os.path.join(_ROOT, "bench.py"), *args.bench_args]
+    try:
+        proc = subprocess.run(
+            cmd,
+            cwd=_ROOT,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            timeout=args.timeout,
+        )
+        out, rc = proc.stdout or "", proc.returncode
+    except subprocess.TimeoutExpired as err:
+        captured = err.stdout or b""
+        if isinstance(captured, bytes):
+            captured = captured.decode("utf-8", "replace")
+        print(
+            f"bench round r{n:02d} timed out after {args.timeout:.0f}s; "
+            "no artifact written",
+            file=sys.stderr,
+        )
+        sys.stderr.write(captured[-2000:])
+        return 3
+
+    tail = out[-_TAIL_BYTES:]
+    parsed = parse_result(tail)
+    if parsed is None:
+        # the failure mode that produced the null-parsed r04/r05
+        # artifacts: refuse to archive it
+        print(
+            f"bench round r{n:02d} produced no parseable JSON result line "
+            f"(rc={rc}); no artifact written — last output follows",
+            file=sys.stderr,
+        )
+        sys.stderr.write(tail[-2000:] + "\n")
+        return 3
+    if rc != 0:
+        print(
+            f"bench round r{n:02d} exited rc={rc}; no artifact written",
+            file=sys.stderr,
+        )
+        sys.stderr.write(tail[-2000:] + "\n")
+        return rc
+
+    wrapper = {"n": n, "cmd": cmd, "rc": rc, "tail": tail, "parsed": parsed}
+    path = os.path.join(args.root, f"BENCH_r{n:02d}.json")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(wrapper, f)
+    os.replace(tmp, path)
+    print(f"recorded {path}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -89,6 +89,64 @@ _PROF_KEY_PHASE = {
     "prof_transfer_ms_p95": "host-transfer",
     "prof_device_walk_ms_p95": "walk",
 }
+# parse thread-scaling gate (ISSUE 12): the t2 merge regression (a
+# shared atomic intern table serializing the merge) showed up as
+# wall(t2) >> wall(t1) — 4.3x on BENCH_r03 — long before any p95 moved.
+# The expected shape is host-dependent, so the artifact's own
+# e2e_host_cores picks the check: with real cores, t1 -> t2 -> t4 wall
+# must stay monotone NON-INCREASING within jitter slack; on a 1-core
+# box extra threads only timeslice (they cannot speed up), so the gate
+# instead bounds every tN wall to a fixed multiple of t1 — catching the
+# contention collapse while tolerating scheduler overhead. Both checks
+# are candidate-local: no baseline needed, so one bad round can never
+# become the new baseline.
+_SCALING_KEY = "parse_thread_scaling_1core"
+_SCALING_REL_SLACK = 0.15  # best-of-2 walls still jitter on a busy box
+_SCALING_ABS_SLACK_MS = 2.0
+_SCALING_1CORE_FACTOR = 1.5  # timeslice overhead ceiling vs the t1 wall
+
+
+def check_thread_scaling(result: dict):
+    """Violation strings for pathological parse-scaling walls ([] when
+    healthy, absent, or fewer than two thread counts recorded)."""
+    scaling = result.get(_SCALING_KEY)
+    if not isinstance(scaling, dict):
+        return []
+    walls = []
+    for label, row in scaling.items():
+        if not (isinstance(label, str) and label[:1] == "t"):
+            continue
+        try:
+            threads = int(label[1:])
+            wall = float(row["wall_ms"])
+        except (KeyError, TypeError, ValueError):
+            return [f"{_SCALING_KEY}[{label}] is malformed: {row!r}"]
+        walls.append((threads, wall))
+    walls.sort()
+    violations = []
+    multicore = result.get("e2e_host_cores", 0) and result["e2e_host_cores"] > 1
+    if multicore:
+        for (t_lo, w_lo), (t_hi, w_hi) in zip(walls, walls[1:]):
+            if w_hi > w_lo * (1.0 + _SCALING_REL_SLACK) + _SCALING_ABS_SLACK_MS:
+                violations.append(
+                    f"{_SCALING_KEY} not monotone: t{t_hi} wall "
+                    f"{w_hi}ms > t{t_lo} wall {w_lo}ms (+"
+                    f"{(w_hi - w_lo) / max(w_lo, 1e-9) * 100:.0f}%, slack "
+                    f"{_SCALING_REL_SLACK * 100:.0f}% + "
+                    f"{_SCALING_ABS_SLACK_MS}ms)"
+                )
+    elif walls:
+        _, w_base = walls[0]
+        bound = w_base * _SCALING_1CORE_FACTOR + _SCALING_ABS_SLACK_MS
+        for t_hi, w_hi in walls[1:]:
+            if w_hi > bound:
+                violations.append(
+                    f"{_SCALING_KEY} contention blowup on 1-core host: "
+                    f"t{t_hi} wall {w_hi}ms > {_SCALING_1CORE_FACTOR}x "
+                    f"t{walls[0][0]} wall {w_base}ms + "
+                    f"{_SCALING_ABS_SLACK_MS}ms"
+                )
+    return violations
 
 
 def gated_keys():
@@ -232,13 +290,28 @@ def main(argv=None) -> int:
             print(f"could not parse candidate {cand_label}", file=sys.stderr)
             return 2
     else:
-        candidate, cand_path, baseline_pool = newest_parseable(artifacts)
+        # gating is strict about the candidate: a null-parsed wrapper is
+        # a broken recording, not a skippable round (BENCH_r04/r05 were
+        # silently walked past for two PRs) — rerecord it, don't gate
+        # around it. Only BASELINE selection may walk past historical
+        # unparseable rounds.
+        if not artifacts:
+            print("no BENCH_r*.json artifacts found", file=sys.stderr)
+            return 2
+        cand_path = artifacts[-1]
+        candidate = load_result(cand_path)
         if candidate is None:
-            print("no parseable BENCH_r*.json artifacts found", file=sys.stderr)
+            print(
+                f"{os.path.basename(cand_path)}: no parseable bench result "
+                '("parsed": null and no JSON line in tail) — re-record the '
+                "round with tools/bench_driver.py instead of gating past it",
+                file=sys.stderr,
+            )
             return 2
         cand_label = os.path.basename(cand_path)
+        baseline_pool = artifacts[:-1]
         if not baseline_pool:
-            print("need >=2 parseable artifacts for --check without a candidate")
+            print("need >=2 artifacts for --check without a candidate")
             return 0
     baseline = None
     base_label = None
@@ -252,18 +325,22 @@ def main(argv=None) -> int:
         return 0
 
     regressions, compared = check(candidate, baseline, args.threshold)
+    # candidate-local invariant, gated regardless of baseline overlap
+    scaling_violations = check_thread_scaling(candidate)
     print(render(candidate, cand_label))
     print(f"baseline: {base_label}; compared {len(compared)} key(s)")
+    for msg in scaling_violations:
+        print(f"REGRESSION {msg}")
     if not compared:
         print("no overlapping SLO keys (baseline predates graftscope)")
-        return 0
+        return 1 if scaling_violations else 0
     for key, old, new in regressions:
         print(
             f"REGRESSION {key}: {old} -> {new} "
             f"({(new - old) / max(abs(old), 1e-9) * 100:+.1f}%, "
             f"threshold {args.threshold * 100:.0f}%)"
         )
-    if regressions:
+    if regressions or scaling_violations:
         return 1
     print("all gated SLO keys within threshold")
     return 0

@@ -841,6 +841,11 @@ def main() -> None:
             # metric, which also folds in cohesion — its one-off 100k cost:
             # ~2.5 s/refresh, scorer compile ~10 min, measured 2026-07-30)
             (src_f, dst_f, dist_f, mask_f), snap_edges = refresh_snapshot
+            # the store's tracked dist bounds -> the sparse scorer's static
+            # promise (3 here: merged dists are 1..7); None keeps the
+            # legacy lexsort path, so the metric reflects whichever
+            # backend KMAMIZ_SPARSE selects
+            dist_bits_big = big._scorer_dist_bits()
             ep_service_b = jnp.asarray(
                 rng.integers(0, N_SVC_BIG, N_EP_BIG, dtype=np.int32)
             )
@@ -864,6 +869,7 @@ def main() -> None:
                         ep_ml_b,
                         ep_record_b,
                         num_services=N_SVC_BIG,
+                        dist_bits=dist_bits_big,
                     )
                     risk = scorers.risk_scores(
                         s.relying_factor,
@@ -898,6 +904,58 @@ def main() -> None:
             ep_service_b = ep_ml_b = ep_record_b = None  # noqa: F841
             replicas_b = req_b = None  # noqa: F841
             refresh_chain_big = None  # noqa: F841 - closure pins the arrays
+
+    # ---- capacity growth: repack vs segment-append A/B ---------------------
+    # one capacity doubling on a small warm store under each growth mode
+    # (KMAMIZ_STORE_GROW). The repack crossing recompiles graph.fit_edges
+    # at the doubled width; the segment crossing re-splits into the
+    # always-present overflow tail with zero new programs. The wall-clock
+    # gap IS the compile bill the segment policy removes from the hot
+    # path — tiny here (2k-wide arrays on CPU), ~a minute per program at
+    # the 100k scale over the dev tunnel (see the scale section notes).
+    grow_extras = {
+        "graph_capacity_grow_ms": None,
+        "graph_capacity_grow_repack_ms": None,
+    }
+    try:
+        GROW_ROWS, GROW_BATCHES = 300, 4  # 3 warm merges, 4th crosses 1024
+
+        def _grow_batches():
+            # globally-distinct (src, dst) pairs so dedup never collapses
+            # the count: 1200 edges after batch 4 > cap 1024, within the
+            # 256-row tail (no consolidation; repack doubles to 2048)
+            for i in range(GROW_BATCHES):
+                k = np.arange(i * GROW_ROWS, (i + 1) * GROW_ROWS)
+                yield (
+                    (k % 797).astype(np.int32),
+                    (k // 797).astype(np.int32),
+                    np.full(GROW_ROWS, 1 + i % 7, dtype=np.int32),
+                )
+
+        for mode, grow_key in (
+            ("repack", "graph_capacity_grow_repack_ms"),
+            ("segment", "graph_capacity_grow_ms"),
+        ):
+            gg = EndpointGraph(capacity=1024, grow=mode)
+            *warm, crossing = list(_grow_batches())
+            for s_b, d_b, ds_b in warm:
+                gg.merge_edges(s_b, d_b, ds_b)
+                gg.n_edges  # drain the deferred count
+            t0 = time.perf_counter()
+            gg.merge_edges(*crossing)
+            gg.n_edges
+            grow_extras[grow_key] = round((time.perf_counter() - t0) * 1000, 2)
+            del gg
+        if grow_extras["graph_capacity_grow_ms"]:
+            grow_extras["graph_capacity_grow_speedup"] = round(
+                grow_extras["graph_capacity_grow_repack_ms"]
+                / grow_extras["graph_capacity_grow_ms"],
+                1,
+            )
+    except Exception as err:  # noqa: BLE001 - keys stay present, value None
+        grow_extras["graph_capacity_grow_error"] = (
+            f"{type(err).__name__}: {err}"[:300]
+        )
 
     # ---- end-to-end DP tick at the reference's own scale -------------------
     # the reference caps realtime ticks at 2,500 traces / 5 s; this times the
@@ -1018,6 +1076,13 @@ def main() -> None:
         ),
         "prof_transfer_ms_p95": prof_ring.phase_p95_ms("host-transfer"),
         "prof_device_walk_ms_p95": prof_ring.phase_p95_ms("walk"),
+        # sparse-walk attribution rides its own phase name (the processor
+        # switches the walk span to "walk_sparse" under KMAMIZ_SPARSE) so
+        # graftprof --diff can compare walk backends; 0.0 when the dense
+        # walk served this run
+        "prof_device_walk_sparse_ms_p95": prof_ring.phase_p95_ms(
+            "walk_sparse"
+        ),
     }
 
     # scorer read path between merges: the first read after a merge
@@ -1889,6 +1954,7 @@ def main() -> None:
             else None
         ),
         "graph_refresh_pass": bool(refresh_ms <= 50.0),
+        **grow_extras,
         "http_instability_10k_endpoints_ms": round(http_api_refresh_ms, 1),
         "walk_mxu_packed_ms": round(walk_mxu_ms, 1),
         "walk_flat_gather_ms": round(walk_flat_ms, 1),

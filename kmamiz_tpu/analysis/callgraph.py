@@ -38,6 +38,11 @@ DEFAULT_SEED_MODULES = (
     "kmamiz_tpu/control/admission.py",
     "kmamiz_tpu/control/policy.py",
     "kmamiz_tpu/control/warmup.py",
+    # the fused SDDMM/SpMM kernels sit under every sparse-backend
+    # consumer (scorers, packed walk, graphsage, stlgt bias) — seed the
+    # module itself so the hot-path rules see its helpers even when the
+    # consumer dispatch is behind the KMAMIZ_SPARSE knob
+    "kmamiz_tpu/ops/sparse.py",
 )
 
 

@@ -94,6 +94,24 @@ class Settings:
     span_batch_pad: int = field(
         default_factory=lambda: int(os.environ.get("KMAMIZ_SPAN_BATCH_PAD", "2"))
     )  # pad batches to powers of this base to bound recompilation
+    # -- sparse kernels / capacity growth (docs/SPARSE_KERNELS.md) -----
+    # ops/sparse.py and graph/store.py read these env vars directly (the
+    # knobs must work in bare kernel benchmarks without a Settings
+    # instance); mirrored here so one `Settings()` dump shows them.
+    sparse_backend: str = field(
+        default_factory=lambda: os.environ.get("KMAMIZ_SPARSE", "sparse")
+    )  # xla | sparse | pallas | pallas_interpret
+    sparse_tile: int = field(
+        default_factory=lambda: int(os.environ.get("KMAMIZ_SPARSE_TILE", "256"))
+    )  # edge-tile rows per fused-kernel grid step (multiple of 8)
+    store_grow: str = field(
+        default_factory=lambda: os.environ.get("KMAMIZ_STORE_GROW", "segment")
+    )  # segment = compile-free overflow tail; repack = pow2 re-pad
+    store_tail_shift: int = field(
+        default_factory=lambda: int(
+            os.environ.get("KMAMIZ_STORE_TAIL_SHIFT", "3")
+        )
+    )  # tail rows = max(256, capacity >> shift); 3 = 12.5% headroom
 
     # resilience layer (kmamiz_tpu/resilience/, docs/RESILIENCE.md).
     # The modules read these env vars directly (they must work without a

@@ -672,6 +672,13 @@ REGISTERED_JIT_SITES: Dict[str, set] = {
         "_window_merge_packed",
         "_edge_mask",
         "_fit_edges",
+        "_split_segments",
+        "_bulk_dist_bounds",
+        "_cat_segments",
+    },
+    "kmamiz_tpu/ops/sparse.py": {
+        "fused_gated_bias",
+        "fused_neighbor_sums",
     },
     "kmamiz_tpu/ops/window.py": {
         "skip_client_parents",
@@ -681,7 +688,8 @@ REGISTERED_JIT_SITES: Dict[str, set] = {
         "service_stats",
     },
     "kmamiz_tpu/ops/scorers.py": {
-        "service_scores",
+        "service_scores_xla",
+        "service_scores_sparse",
         "usage_cohesion",
         "risk_scores",
         "dirty_edge_subset",

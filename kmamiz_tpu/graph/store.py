@@ -38,6 +38,7 @@ from kmamiz_tpu.ops import scorers as scorer_ops
 from kmamiz_tpu.ops.double_buffer import UploadPipeline
 from kmamiz_tpu.telemetry.profiling import events as prof_events
 from kmamiz_tpu.telemetry.tracing import phase_span
+from kmamiz_tpu.ops import sparse
 from kmamiz_tpu.ops import window as window_ops
 from kmamiz_tpu.ops.sortutil import (
     EDGE_KEY_MAX_DIST,
@@ -87,6 +88,48 @@ def _merge_edges(src_a, dst_a, dist_a, mask_a, src_b, dst_b, dist_b, mask_b):
     return s, d, ds, valid
 
 
+@programs.register("graph.split_segments")
+@partial(jax.jit, static_argnames=("cap", "tail_cap"))
+def _split_segments(src, dst, dist, cap, tail_cap):
+    """Split a merged edge set into a `cap`-row main segment plus a
+    `tail_cap`-row overflow tail — the segment-append growth path.
+    compact_unique packs valid edges first, so slicing at `cap` is
+    exact; rows past cap+tail_cap are SENTINEL by construction (the
+    caller consolidates before the tail can overflow). Both output
+    shapes are static, so a capacity crossing re-runs this same warm
+    program instead of recompiling the store's program set."""
+    main = _fit_edges(src, dst, dist, cap=cap)
+    if int(src.shape[0]) <= cap:
+        fill = jnp.full(tail_cap, SENTINEL, dtype=jnp.int32)
+        return (*main, fill, fill, fill)
+    tail = _fit_edges(src[cap:], dst[cap:], dist[cap:], cap=tail_cap)
+    return (*main, *tail)
+
+
+@programs.register("graph.bulk_dist_bounds")
+@jax.jit
+def _bulk_dist_bounds(dist, mask):
+    """Masked (min, max) distance of a bulk edge batch — the packed-key
+    drain gate's bounds update, jitted so a device-resident bulk merge
+    stays transfer-clean under jax.transfer_guard (the eager form baked
+    the neutral element as an implicit host->device constant)."""
+    masked = jnp.where(mask, dist, 1)
+    return jnp.stack([jnp.min(masked), jnp.max(masked)])
+
+
+@programs.register("graph.cat_segments")
+@jax.jit
+def _cat_segments(src, dst, dist, t_src, t_dst, t_dist):
+    """Flatten the main + tail segments into the single column view
+    consumers (scorers, walk unions, edge_arrays) read. Jitted so the
+    snapshot never pays an eager concat whose baked constants trip
+    jax.transfer_guard on the hot tick."""
+    s = jnp.concatenate([src, t_src])
+    d = jnp.concatenate([dst, t_dst])
+    ds = jnp.concatenate([dist, t_dist])
+    return s, d, ds, s != SENTINEL
+
+
 @programs.register("graph.window_merge")
 @partial(jax.jit, static_argnames=("max_depth",))
 def _window_merge(
@@ -121,14 +164,56 @@ def _window_merge(
     return s, d, ds, v, v.sum()
 
 
+def _sparse_walk_default() -> bool:
+    """Whether the store's packed walks take the flat-gather sparse
+    variant: on under any non-xla KMAMIZ_SPARSE backend on non-TPU hosts
+    (the one-hot einsum's O(T*L*L) flops only pay off on the MXU)."""
+    return sparse.use_sparse() and jax.default_backend() != "tpu"
+
+
+def _grow_mode_default() -> str:
+    """KMAMIZ_STORE_GROW: 'segment' (default) pins the main edge arrays
+    at a fixed capacity and absorbs growth into a pre-allocated overflow
+    tail segment, so crossing a capacity boundary re-runs only programs
+    that are already warm (zero new compiles on the crossing tick);
+    'repack' is the legacy policy — full re-pad to the next pow2 per
+    doubling, recompiling every capacity-shaped program mid-serve."""
+    v = os.environ.get("KMAMIZ_STORE_GROW", "segment").strip().lower()
+    return v if v in ("segment", "repack") else "segment"
+
+
+def _tail_shift() -> int:
+    """KMAMIZ_STORE_TAIL_SHIFT: tail capacity = main >> shift (default
+    3 -> 12.5% headroom before a consolidation repack)."""
+    try:
+        return max(0, int(os.environ.get("KMAMIZ_STORE_TAIL_SHIFT", "3")))
+    except ValueError:
+        return 3
+
+
+def _walk_packed(sparse_walk: bool):
+    """Select the packed ancestor-walk kernel: the MXU one-hot einsum
+    (TPU default) or the flat-gather sparse variant (bit-exact, no
+    [T, L, L] adjacency — what CPU hosts want). The choice is a STATIC
+    jit arg on every window program so both variants compile as distinct
+    registered programs and graftprof attributes them separately."""
+    return (
+        window_ops.dependency_edges_packed_sparse
+        if sparse_walk
+        else window_ops.dependency_edges_packed
+    )
+
+
 @programs.register("graph.window_edges_packed")
-@partial(jax.jit, static_argnames=("max_depth",))
-def _window_edges_packed(parent_slot, kind, valid, endpoint_id, max_depth):
+@partial(jax.jit, static_argnames=("max_depth", "sparse_walk"))
+def _window_edges_packed(
+    parent_slot, kind, valid, endpoint_id, max_depth, sparse_walk=False
+):
     """Walk-only kernel: this window's flat (ancestor, descendant,
     distance, mask) candidate columns, store untouched. The staged-merge
     overflow fallback re-walks a window through this when its compacted
     prefix truncated (see _drain_staged_locked)."""
-    edges = window_ops.dependency_edges_packed(
+    edges = _walk_packed(sparse_walk)(
         parent_slot, kind, valid, endpoint_id, max_depth=max_depth
     )
     return (
@@ -140,9 +225,19 @@ def _window_edges_packed(parent_slot, kind, valid, endpoint_id, max_depth):
 
 
 @programs.register("graph.window_edges_compact")
-@partial(jax.jit, static_argnames=("max_depth", "stage_cap", "packed_key"))
+@partial(
+    jax.jit,
+    static_argnames=("max_depth", "stage_cap", "packed_key", "sparse_walk"),
+)
 def _window_edges_compact(
-    parent_slot, kind, valid, endpoint_id, max_depth, stage_cap, packed_key
+    parent_slot,
+    kind,
+    valid,
+    endpoint_id,
+    max_depth,
+    stage_cap,
+    packed_key,
+    sparse_walk=False,
 ):
     """Staged-merge kernel for the streaming path: walk this window's
     candidates and self-compact them to a sorted unique prefix, sliced to
@@ -156,7 +251,7 @@ def _window_edges_compact(
 
     packed_key selects the single-int32-key sort (2x cheaper); the caller
     guarantees the id/dist bounds (sortutil.EDGE_KEY_*)."""
-    edges = window_ops.dependency_edges_packed(
+    edges = _walk_packed(sparse_walk)(
         parent_slot, kind, valid, endpoint_id, max_depth=max_depth
     )
     cols = (
@@ -173,16 +268,26 @@ def _window_edges_compact(
 
 
 @programs.register("graph.window_merge_packed")
-@partial(jax.jit, static_argnames=("max_depth",))
+@partial(jax.jit, static_argnames=("max_depth", "sparse_walk"))
 def _window_merge_packed(
-    parent_slot, kind, valid, endpoint_id, src, dst, dist, mask, max_depth
+    parent_slot,
+    kind,
+    valid,
+    endpoint_id,
+    src,
+    dst,
+    dist,
+    mask,
+    max_depth,
+    sparse_walk=False,
 ):
     """_window_merge over trace-packed [T, L] rows: the ancestor walk runs
     as batched one-hot einsums on the MXU (dependency_edges_packed), ~10x
-    cheaper than the flat gather walk at 1M spans. max_depth is capped to
-    the window's longest possible chain (pow2-bucketed so XLA compiles a
-    bounded number of depths)."""
-    edges = window_ops.dependency_edges_packed(
+    cheaper than the flat gather walk at 1M spans; sparse_walk swaps in
+    the flat-gather variant for CPU hosts (bit-exact, see _walk_packed).
+    max_depth is capped to the window's longest possible chain
+    (pow2-bucketed so XLA compiles a bounded number of depths)."""
+    edges = _walk_packed(sparse_walk)(
         parent_slot, kind, valid, endpoint_id, max_depth=max_depth
     )
     s, d, ds, v = _merge_edges(
@@ -212,8 +317,27 @@ class EndpointGraph:
 
     Capacity policy (bench.py's graph_scale_* extras characterize it to
     100k endpoints / ~5.2M edges): edge arrays are padded to
-    power-of-2 capacities and grow by doubling when a union's valid count
-    exceeds the current capacity (_apply_merged). Consequences:
+    power-of-2 capacities. Two growth modes (KMAMIZ_STORE_GROW / the
+    `grow` ctor arg):
+
+    - 'segment' (default, ISSUE 13): the main arrays stay at a fixed
+      pow2 capacity C and every store also carries a SENTINEL-padded
+      overflow tail of T = C >> KMAMIZ_STORE_TAIL_SHIFT rows (min 256).
+      Unions and consumer snapshots always read the flat C+T view
+      (graph.cat_segments), and every merge re-splits the union output
+      back into (C, T) via graph.split_segments — so a merge whose
+      valid count crosses C runs EXACTLY the same warm programs as any
+      other merge: the capacity crossing is compile-free. Only when the
+      tail itself would overflow (valid > C + T, i.e. >12.5% growth at
+      the default shift) does the store consolidate to the next pow2
+      main — the one recompiling event, ~8x rarer than the legacy
+      per-doubling repack, and one prewarm_compile can precompile its
+      shapes ahead of time while the tail absorbs growth.
+    - 'repack': the legacy policy — grow by doubling when a union's
+      valid count exceeds the current capacity (_apply_merged), full
+      re-pad + program-set recompile per doubling.
+
+    Consequences (both modes):
     - XLA program count is O(log(max_edges) * distinct window shapes):
       each (window-bucket, store-capacity) pair compiles once, and
       capacities only double, so a store that grows to E edges passes
@@ -237,6 +361,7 @@ class EndpointGraph:
         ml_interner: Optional[StringInterner] = None,
         capacity: int = 1024,
         tenant: str = "default",
+        grow: Optional[str] = None,
     ) -> None:
         self.tenant = tenant
         self.interner = interner or EndpointInterner()
@@ -244,6 +369,17 @@ class EndpointGraph:
         self._src = jnp.full(capacity, SENTINEL, dtype=jnp.int32)
         self._dst = jnp.full(capacity, SENTINEL, dtype=jnp.int32)
         self._dist = jnp.full(capacity, SENTINEL, dtype=jnp.int32)
+        # segment growth mode: the (src, dst, dist) overflow tail that
+        # absorbs capacity crossings compile-free (class docstring);
+        # None under the legacy repack policy
+        self._grow = (grow or _grow_mode_default()).strip().lower()
+        if self._grow not in ("segment", "repack"):
+            raise ValueError(f"unknown grow mode: {self._grow!r}")
+        if self._grow == "segment":
+            fill = jnp.full(self._tail_cap(capacity), SENTINEL, jnp.int32)
+            self._tail = (fill, fill, fill)
+        else:
+            self._tail = None
         self._n_edges = 0
         # host->device copy time of the LAST merge_window call (ms),
         # for casual introspection only — concurrent mergers use
@@ -349,6 +485,8 @@ class EndpointGraph:
 
         with self._lock:
             edges = nb(self._src) + nb(self._dst) + nb(self._dist)
+            if self._tail is not None:
+                edges += sum(nb(a) for a in self._tail)
             staged = sum(
                 nb(a)
                 for entry in self._staged
@@ -369,10 +507,25 @@ class EndpointGraph:
 
     # -- capacity management -------------------------------------------------
 
+    @staticmethod
+    def _tail_cap(cap: int) -> int:
+        """Tail-segment rows for a main capacity (segment growth mode):
+        cap >> KMAMIZ_STORE_TAIL_SHIFT, floored at 256."""
+        return max(256, cap >> _tail_shift())
+
     @property
     def capacity(self) -> int:
+        """Main-segment capacity (the pow2 policy capacity). In segment
+        growth mode the store can hold up to capacity + tail_capacity
+        edges before consolidating."""
         self._finalize_pending()
         return int(self._src.shape[0])
+
+    @property
+    def tail_capacity(self) -> int:
+        """Overflow-tail rows (segment growth mode); 0 under repack."""
+        self._finalize_pending()
+        return int(self._tail[0].shape[0]) if self._tail is not None else 0
 
     @property
     def n_edges(self) -> int:
@@ -537,6 +690,7 @@ class EndpointGraph:
                     max_depth=depth,
                     stage_cap=self._stage_cap(),
                     packed_key=packed_key,
+                    sparse_walk=_sparse_walk_default(),
                 )
             if hasattr(count, "copy_to_host_async"):
                 count.copy_to_host_async()
@@ -578,11 +732,9 @@ class EndpointGraph:
             self._max_dist = max(self._max_dist, depth)
             src, dst, dist, _valid, valid_count = _window_merge_packed(
                 *dev_in,
-                self._src,
-                self._dst,
-                self._dist,
-                _edge_mask(self._src),
+                *self._store_cols_locked(),
                 max_depth=depth,
+                sparse_walk=_sparse_walk_default(),
             )
         else:  # overlong trace / cross-trace parent: flat gather fallback
             # size the walk to the window's TRUE longest parent chain
@@ -603,10 +755,7 @@ class EndpointGraph:
             )
             src, dst, dist, _valid, valid_count = _window_merge(
                 *dev_in,
-                self._src,
-                self._dst,
-                self._dist,
-                _edge_mask(self._src),
+                *self._store_cols_locked(),
                 max_depth=depth,
             )
         # Defer the count sync: dispatch is async, so the tick returns without
@@ -662,10 +811,7 @@ class EndpointGraph:
                 src, dst, dist
             )
             s, d, ds, v = _merge_edges(
-                self._src,
-                self._dst,
-                self._dist,
-                _edge_mask(self._src),
+                *self._store_cols_locked(),
                 d_src,
                 d_dst,
                 d_dist,
@@ -678,9 +824,14 @@ class EndpointGraph:
             return transfer_ms
 
     def capacity_bucket(self) -> int:
-        """The pow2 edge capacity this graph's padded arrays occupy — the
-        tenant arena's bucketing key (kmamiz_tpu/tenancy/arena.py):
-        same-bucket graphs dispatch identical compiled program shapes."""
+        """The pow2 main-segment capacity this graph's padded arrays
+        occupy — the tenant arena's bucketing key
+        (kmamiz_tpu/tenancy/arena.py): same-bucket graphs dispatch
+        identical compiled program shapes. In segment growth mode the
+        tail capacity is a pure function of the main capacity (and the
+        process-wide KMAMIZ_STORE_TAIL_SHIFT), so the main capacity
+        alone still keys the shape set; mixing grow modes across
+        same-bucket tenants of one arena is unsupported."""
         return self.capacity
 
     def intern_window_edges(self, edges):
@@ -817,11 +968,43 @@ class EndpointGraph:
         pending, self._pending = self._pending, None
         self._apply_merged(*pending)
 
+    def _store_cols_locked(self):
+        """The store's flat (src, dst, dist, mask) column view — what
+        union kernels and consumer snapshots read. The main arrays in
+        repack mode; the warm graph.cat_segments concat of main + tail
+        in segment mode, so tail-resident edges are visible everywhere
+        the main ones are."""
+        if self._tail is None:
+            return self._src, self._dst, self._dist, _edge_mask(self._src)
+        return _cat_segments(self._src, self._dst, self._dist, *self._tail)
+
     def _apply_merged(self, src, dst, dist, valid_count) -> None:
-        """Adopt a merged edge set: fetch the count and re-pad to the next
-        power-of-2 capacity."""
+        """Adopt a merged edge set: fetch the count, then re-split into
+        the fixed (main, tail) segments (segment mode — every array
+        shape stays constant across a capacity crossing, so the
+        crossing compiles nothing new; consolidation to a larger main
+        happens only when the tail would overflow) or re-pad to the
+        next power-of-2 capacity (repack mode)."""
         # graftlint: disable=host-sync-in-hot-path -- one async-prefetched scalar per merge drives the capacity policy
         valid_count = int(jax.device_get(valid_count))
+        if self._tail is not None:
+            # both widths are pow2 by construction (_pow2 main, max(256,
+            # main >> shift) tail); the bucketing here is an identity
+            # that pins the invariant
+            cap = _pow2(int(self._src.shape[0]))
+            tail_cap = _pow2(int(self._tail[0].shape[0]))
+            if valid_count > cap + tail_cap:
+                # tail exhausted: consolidate into the next pow2 main —
+                # the one recompiling event of segment mode (rare and
+                # amortized; valid > cap + tail implies the new cap is
+                # at least a doubling, so capacity stays monotone)
+                cap = _pow2(valid_count)
+                tail_cap = self._tail_cap(cap)
+            out = _split_segments(src, dst, dist, cap=cap, tail_cap=tail_cap)
+            self._src, self._dst, self._dist = out[:3]
+            self._tail = out[3:]
+            self._n_edges = valid_count
+            return
         new_cap = _pow2(valid_count, minimum=int(self._src.shape[0]))
         merged_len = int(src.shape[0])
         if new_cap == merged_len:
@@ -853,12 +1036,8 @@ class EndpointGraph:
                 if k < int(s0.shape[0]):
                     s0, d0, ds0 = s0[:k], d0[:k], ds0[:k]
             return [s0], [d0], [ds0], [_edge_mask(s0)]
-        return (
-            [self._src],
-            [self._dst],
-            [self._dist],
-            [_edge_mask(self._src)],
-        )
+        src, dst, dist, mask = self._store_cols_locked()
+        return [src], [dst], [dist], [mask]
 
     def _preunion_staged_locked(self) -> None:
         """Collapse the staged windows so far into one dispatched-but-
@@ -1046,7 +1225,9 @@ class EndpointGraph:
         compacted prefix truncated — correctness never depends on the
         stage cap."""
         if mesh is None:
-            return _window_edges_packed(*dev_in, max_depth=depth)
+            return _window_edges_packed(
+                *dev_in, max_depth=depth, sparse_walk=_sparse_walk_default()
+            )
         from kmamiz_tpu.parallel.mesh import sharded_dependency_edges_packed
 
         a_, d_, ds_, m_ = sharded_dependency_edges_packed(
@@ -1079,7 +1260,11 @@ class EndpointGraph:
 
         with self._lock:
             self._finalize_pending_locked()
+            # segment mode: unions read the flat main+tail view, so the
+            # lowered store-column width includes the tail
             cap = int(self._src.shape[0])
+            if self._tail is not None:
+                cap += int(self._tail[0].shape[0])
             packed_key = (
                 len(self.interner.endpoints) <= EDGE_KEY_MAX_EP
                 and self._min_dist >= 1
@@ -1133,11 +1318,10 @@ class EndpointGraph:
         (immutable jnp arrays: safe to use after the lock releases)."""
         with self._lock:
             self._finalize_pending_locked()
-            # _edge_mask, not an eager `!= SENTINEL`: the fold path runs
-            # under jax.transfer_guard("disallow") and the eager compare
-            # uploads the sentinel as an implicit host->device constant
-            mask = _edge_mask(self._src)
-            return self._src, self._dst, self._dist, mask
+            # _store_cols_locked, not eager ops: the fold path runs
+            # under jax.transfer_guard("disallow") and an eager compare
+            # or concat uploads baked host constants
+            return self._store_cols_locked()
 
     def invalidate_labels(self) -> None:
         """Call when the label mapping changes; per-endpoint tables rebuild
@@ -1259,8 +1443,7 @@ class EndpointGraph:
         # ep_cap when the fresh mask sizes from a stale table (ADVICE r2)
         with self._lock:
             self._finalize_pending_locked()
-            mask = _edge_mask(self._src)
-            src, dst, dist = self._src, self._dst, self._dist
+            src, dst, dist, mask = self._store_cols_locked()
             ep_service, ep_ml, ep_record, ep_cap = self._ep_tables_locked(
                 label_of
             )
@@ -1275,6 +1458,22 @@ class EndpointGraph:
             ep_record = ep_record & fresh
         svc_cap = _pow2(max(len(self.interner.services), 1))
         return src, dst, dist, mask, ep_service, ep_ml, ep_record, svc_cap
+
+    def _scorer_dist_bits(self) -> "int | None":
+        """STATIC dist-bound promise for the sparse scorer dispatch,
+        derived from the tracked _min_dist/_max_dist bounds: 3 when every
+        distance this store has ever merged fits 0 <= d < 8 (the fast
+        single-pass relying-factor form), 4 up to d < 16 (covers the
+        depth-8 walk bucket and EDGE_KEY_MAX_DIST; the scorer takes its
+        per-distance fallback), else None -> legacy path. _max_dist is a
+        conservative UPPER bound (walk depths), so widening never lies."""
+        if self._min_dist < 0:
+            return None
+        if self._max_dist < 8:
+            return 3
+        if self._max_dist < 16:
+            return 4
+        return None
 
     def service_scores(self, label_of=None, now_ms=None) -> scorer_ops.ServiceScores:
         """Cached service scorers. Repeated reads between merges are O(1)
@@ -1325,6 +1524,7 @@ class EndpointGraph:
             jax.device_put(ep_ml),
             jax.device_put(ep_record),
             num_services=svc_cap,
+            dist_bits=self._scorer_dist_bits(),
         )
 
     def usage_cohesion(self, now_ms=None) -> scorer_ops.CohesionScores:
@@ -1380,8 +1580,7 @@ class EndpointGraph:
         mask fingerprint, dirty journal + floor."""
         with self._lock:
             self._finalize_pending_locked()
-            mask = _edge_mask(self._src)
-            src, dst, dist = self._src, self._dst, self._dist
+            src, dst, dist, mask = self._store_cols_locked()
             ep_service, ep_ml, ep_record, ep_cap = self._ep_tables_locked(
                 label_of
             )
@@ -1546,6 +1745,7 @@ class EndpointGraph:
                 ep_ml_d,
                 ep_record_d,
                 num_services=svc_cap,
+                dist_bits=self._scorer_dist_bits(),
             )
         return scorer_ops.usage_cohesion(
             src,
@@ -1607,6 +1807,7 @@ class EndpointGraph:
             ep_ml_d,
             ep_record_d,
             num_services=svc_cap,
+            dist_bits=self._scorer_dist_bits(),
         )
         with self._lock:
             self.scorer_stats["incremental"] += 1
@@ -1629,7 +1830,7 @@ class EndpointGraph:
             mask = (
                 jnp.asarray(valid, dtype=bool)
                 if valid is not None
-                else src != SENTINEL
+                else _edge_mask(src)
             )
             # pow2-pad the inputs so variable-length batches share union
             # programs (same rationale as load_dependencies: each
@@ -1644,18 +1845,14 @@ class EndpointGraph:
                     [mask, jnp.zeros(cap - int(mask.shape[0]), bool)]
                 )
             # keep the packed-key drain gate honest: bulk edges carry
-            # caller-provided distances (ONE device fetch for both bounds)
-            masked_dist = jnp.where(mask, dist, 1)
-            lo, hi = np.asarray(
-                jnp.stack([jnp.min(masked_dist), jnp.max(masked_dist)])
-            )
+            # caller-provided distances (ONE explicit device fetch for
+            # both bounds; the masked min/max runs jitted so a
+            # device-resident batch merges transfer-clean)
+            lo, hi = jax.device_get(_bulk_dist_bounds(dist, mask))
             self._max_dist = max(self._max_dist, int(hi))
             self._min_dist = min(self._min_dist, int(lo))
             s, d, ds, v = _merge_edges(
-                self._src,
-                self._dst,
-                self._dist,
-                self._src != SENTINEL,
+                *self._store_cols_locked(),
                 src,
                 dst,
                 dist,
@@ -1729,10 +1926,7 @@ class EndpointGraph:
         dst[: len(dst_l)] = dst_l
         dist[: len(dist_l)] = dist_l
         s, d, ds, v = _merge_edges(
-            self._src,
-            self._dst,
-            self._dist,
-            self._src != SENTINEL,
+            *self._store_cols_locked(),
             jnp.asarray(src),
             jnp.asarray(dst),
             jnp.asarray(dist),

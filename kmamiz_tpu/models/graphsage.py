@@ -20,6 +20,8 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+from kmamiz_tpu.ops import sparse
+
 NUM_FEATURES = 10  # incl. sin/cos hour-of-day
 
 
@@ -159,8 +161,25 @@ def neighbor_mean(
     """Mean of neighbor states over both edge directions (segment mean).
 
     deg omitted keeps the self-contained single-layer form; callers with
-    several layers over one topology (forward) pass the hoisted degree."""
+    several layers over one topology (forward) pass the hoisted degree.
+
+    Under the pallas backends the whole gather -> mask -> two segment_sums
+    chain runs as one fused SpMM kernel (ops/sparse.py) when the node
+    table fits the VMEM budget; the division stays out here so the
+    normalization matches the XLA path exactly."""
     n = h.shape[0]
+    if sparse.fused_enabled() and sparse.fused_fits(n):
+        agg, fused_deg = sparse.fused_neighbor_sums(
+            h.astype(jnp.float32),
+            src_ep,
+            dst_ep,
+            edge_mask,
+            tile=sparse.tile_size(),
+            interpret=sparse.fused_interpret(),
+        )
+        if deg is None:
+            deg = fused_deg
+        return (agg / jnp.maximum(deg, 1.0)[:, None]).astype(h.dtype)
     src = jnp.where(edge_mask, src_ep, n)
     dst = jnp.where(edge_mask, dst_ep, n)
     dst_h = h[jnp.minimum(dst, n - 1)] * edge_mask[:, None]

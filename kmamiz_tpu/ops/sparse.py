@@ -1,0 +1,391 @@
+"""graftsparse: fused SDDMM/SpMM kernels over the flat CSR edge arrays.
+
+The device-compute spine has four consumers of per-edge gather ->
+elementwise -> segment-reduce chains: the service scorers
+(ops/scorers.py), the packed ancestor walk (graph/store.py windows), the
+GraphSAGE ``neighbor_mean`` and the STLGT sigmoid-gated neighbor bias.
+At the 100k-endpoint / 4M-edge regime the XLA formulations either
+materialize padded-dense intermediates (the [T, L, L] one-hot walk) or
+pay a 5-key comparator lexsort over 8M direction rows (~6.7 s of the
+8.9 s refresh, measured same-box). This module is the shared sparse
+backend behind all four:
+
+- **Fused SDDMM/SpMM Pallas kernels** (FusedMM, arXiv:2011.06391; dense-
+  hardware sparse GNN training, arXiv:1906.11786): one kernel does
+  edge-gather (one-hot MXU matmul against the node table), the per-edge
+  elementwise SDDMM half (dot + sigmoid gate), and the SpMM
+  segment-reduce back to endpoint rows — blocked over EDGE TILES with the
+  node table resident in VMEM, so no [E, H] message array ever lands in
+  HBM and the padded-dense adjacency is never materialized. Used by the
+  STLGT neighbor bias (gated mode) and GraphSAGE neighbor sums (plain
+  mode) when the backend is ``pallas``/``pallas_interpret``.
+- **Sparse counting primitives** for the scorer rewrite
+  (``dense_rank_pairs``, ``run_start_index``): the scorers replace the
+  8M-row 5-key lexsort with packed-int32 single-key UNSTABLE sorts per
+  direction table (unstable 1-key sort of 4M rows measures ~0.3 s vs
+  ~1.8 s/pass stable and ~6.7 s for the 5-key comparator, same box) —
+  see scorers.py for the counting core built on these.
+
+Backend knob (mirrored in config.Settings):
+
+- ``KMAMIZ_SPARSE=sparse`` (default): scorers use the packed-key sparse
+  counting path, the dependency walk picks the flat-gather variant on
+  CPU hosts (the MXU packed walk stays default on TPU, where it measures
+  >=50x faster); GraphSAGE/STLGT keep their gather/segment-sum XLA code,
+  which already IS the sparse formulation for those shapes.
+- ``KMAMIZ_SPARSE=pallas``: additionally routes the STLGT bias and
+  GraphSAGE neighbor sums through the fused Pallas kernel (auto-falls
+  back to interpret mode off-TPU, and to XLA when the node table
+  exceeds the VMEM budget — see ``fused_fits``).
+- ``KMAMIZ_SPARSE=pallas_interpret``: fused kernels in interpret mode
+  everywhere (CI/CPU parity testing).
+- ``KMAMIZ_SPARSE=xla``: every consumer keeps the legacy dense/XLA path
+  bit-for-bit (the fallback the parity tests pin against).
+
+``KMAMIZ_SPARSE_TILE`` sets the edge-tile block (default 256, f32
+(8, 128)-aligned); ``KMAMIZ_SPARSE_NODE_MAX`` bounds the VMEM-resident
+node table for the fused kernels (default 2048 rows; at tile=256 that is
+two 2 MB one-hot tiles + three node tables well inside 16 MB VMEM).
+
+Parity contract (pinned by tests/test_ops_sparse.py and the per-consumer
+parity tests): integer-derived lanes are bit-exact across backends;
+float reductions whose addend ORDER changes (relying factor, fused-kernel
+matmul accumulation) are pinned at fp32 tolerance.
+"""
+from __future__ import annotations
+
+import os
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from kmamiz_tpu.core import programs
+
+# jax renamed TPUCompilerParams -> CompilerParams (~0.6); take whichever
+# this jax ships
+_CompilerParams = getattr(
+    pltpu, "CompilerParams", getattr(pltpu, "TPUCompilerParams", None)
+)
+
+_VALID_BACKENDS = ("xla", "sparse", "pallas", "pallas_interpret")
+
+_backend_cache: Optional[str] = None
+_tile_cache: Optional[int] = None
+_node_max_cache: Optional[int] = None
+
+
+def backend() -> str:
+    """Process-wide sparse backend, cached after first read (the store and
+    scorers bake it into registered-program dispatch; tests flipping the
+    env var must call reset_for_tests — conftest does)."""
+    global _backend_cache
+    if _backend_cache is None:
+        val = os.environ.get("KMAMIZ_SPARSE", "sparse").strip().lower()
+        if val not in _VALID_BACKENDS:
+            raise ValueError(
+                f"KMAMIZ_SPARSE={val!r} not in {_VALID_BACKENDS}"
+            )
+        _backend_cache = val
+    return _backend_cache
+
+
+def tile_size() -> int:
+    """Edge-tile block for the fused kernels (KMAMIZ_SPARSE_TILE)."""
+    global _tile_cache
+    if _tile_cache is None:
+        t = int(os.environ.get("KMAMIZ_SPARSE_TILE", "256"))
+        if t < 8 or t % 8:
+            raise ValueError(f"KMAMIZ_SPARSE_TILE={t} must be a multiple of 8")
+        _tile_cache = t
+    return _tile_cache
+
+
+def node_budget() -> int:
+    """Max VMEM-resident node-table rows for the fused kernels."""
+    global _node_max_cache
+    if _node_max_cache is None:
+        _node_max_cache = int(os.environ.get("KMAMIZ_SPARSE_NODE_MAX", "2048"))
+    return _node_max_cache
+
+
+def reset_for_tests() -> None:
+    """Drop the cached knob reads (tests monkeypatching KMAMIZ_SPARSE*)."""
+    global _backend_cache, _tile_cache, _node_max_cache
+    _backend_cache = None
+    _tile_cache = None
+    _node_max_cache = None
+
+
+def use_sparse() -> bool:
+    """Sparse counting/walk paths enabled (any backend but xla)."""
+    return backend() != "xla"
+
+
+def fused_enabled() -> bool:
+    """Fused Pallas SDDMM/SpMM kernels requested for the model consumers."""
+    return backend() in ("pallas", "pallas_interpret")
+
+
+def fused_interpret() -> bool:
+    """Interpret-mode flag for the fused kernels: forced by the
+    pallas_interpret backend, and automatic off-TPU (Mosaic kernels only
+    compile for TPU; CPU CI runs the same kernel interpreted)."""
+    return backend() == "pallas_interpret" or jax.default_backend() != "tpu"
+
+
+def fused_fits(num_nodes: int) -> bool:
+    """Whether the node table fits the fused kernels' VMEM budget; larger
+    windows fall back to the XLA gather/segment-sum path."""
+    return num_nodes <= node_budget()
+
+
+def _pad_to(n: int, mult: int) -> int:
+    return -(-n // mult) * mult
+
+
+# ---------------------------------------------------------------------------
+# fused SDDMM/SpMM kernel (edge-tile grid, VMEM-resident node table)
+# ---------------------------------------------------------------------------
+#
+# grid = (e_pad // tile,), "arbitrary": the bias/degree outputs accumulate
+# across every edge tile into the same [N, H] / [1, N] VMEM block
+# (initialized at tile 0), while the per-edge gate writes one [1, tile]
+# block per step. Gathers and scatters both ride the MXU as one-hot
+# matmuls over [tile, N] masks built in-kernel from broadcasted_iota —
+# the only O(E*N) object is a single VMEM tile, never an HBM array.
+
+
+def _fused_kernel(
+    src_ref,
+    dst_ref,
+    mask_ref,
+    v_ref,
+    *rest,
+    gated: bool,
+    inv_sqrt_h: float,
+):
+    if gated:
+        q_ref, k_ref, b_ref, bias_ref, deg_ref, gate_ref = rest
+    else:
+        bias_ref, deg_ref, gate_ref = rest
+
+    step = pl.program_id(0)
+
+    @pl.when(step == 0)
+    def _init():
+        bias_ref[:, :] = jnp.zeros_like(bias_ref)
+        deg_ref[:, :] = jnp.zeros_like(deg_ref)
+
+    src = src_ref[0, :]  # [T] int32, parked at n_pad when invalid
+    dst = dst_ref[0, :]
+    m = mask_ref[0, :]  # [T] f32
+
+    tile = src.shape[0]
+    n_pad = v_ref.shape[0]
+    local = jax.lax.broadcasted_iota(jnp.int32, (tile, n_pad), 1)
+    # parked ids (n_pad) match no iota column -> all-zero one-hot rows,
+    # so invalid edges gather zeros and scatter nothing
+    oh_src = (src[:, None] == local).astype(jnp.float32)
+    oh_dst = (dst[:, None] == local).astype(jnp.float32)
+
+    _dot = partial(
+        jax.lax.dot_general,
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )
+    row_dot = partial(_dot, dimension_numbers=(((1,), (0,)), ((), ())))
+    # contract the EDGE axis of both operands: [T, N] x [T, H] -> [N, H]
+    scatter_dot = partial(_dot, dimension_numbers=(((0,), (0,)), ((), ())))
+
+    v_src = row_dot(oh_src, v_ref[:, :])  # [T, H] edge-gather (SpMM in)
+    v_dst = row_dot(oh_dst, v_ref[:, :])
+
+    if gated:
+        q_e = row_dot(oh_src, q_ref[:, :])
+        k_e = row_dot(oh_dst, k_ref[:, :])
+        # SDDMM half: per-edge scaled dot + sigmoid gate on the VPU
+        aff = jnp.sum(q_e * k_e, axis=1) * inv_sqrt_h
+        g = jax.nn.sigmoid(aff + b_ref[0, 0]) * m
+    else:
+        g = m
+    gate_ref[0, :] = g
+
+    gv_src = g[:, None] * v_src
+    gv_dst = g[:, None] * v_dst
+    # SpMM half: segment-reduce both directions back to endpoint rows
+    bias_ref[:, :] += scatter_dot(oh_dst, gv_src) + scatter_dot(oh_src, gv_dst)
+    deg_ref[0, :] += (
+        row_dot(g[None, :], oh_dst)[0, :] + row_dot(g[None, :], oh_src)[0, :]
+    )
+
+
+def _fused_call(
+    src_ep,
+    dst_ep,
+    edge_mask,
+    v,
+    q,
+    k,
+    b_edge,
+    gated: bool,
+    tile: int,
+    interpret: bool,
+):
+    n, h = v.shape
+    e = src_ep.shape[0]
+    e_pad = _pad_to(max(e, 1), tile)
+    n_pad = _pad_to(n + 1, 128)  # +1 spill column keeps the park id in-grid
+    h_pad = _pad_to(max(h, 1), 128)
+
+    def _park(ep):
+        ep = jnp.where(edge_mask, jnp.clip(ep, 0, n - 1), n_pad)
+        return jnp.pad(
+            ep.astype(jnp.int32), (0, e_pad - e), constant_values=n_pad
+        )[None, :]
+
+    src_p = _park(src_ep)
+    dst_p = _park(dst_ep)
+    mask_p = jnp.pad(edge_mask.astype(jnp.float32), (0, e_pad - e))[None, :]
+
+    def _table(t):
+        return jnp.pad(t.astype(jnp.float32), ((0, n_pad - n), (0, h_pad - h)))
+
+    edge_spec = pl.BlockSpec((1, tile), lambda i: (0, i))
+    table_spec = pl.BlockSpec((n_pad, h_pad), lambda i: (0, 0))
+
+    in_specs = [edge_spec, edge_spec, edge_spec, table_spec]
+    operands = [src_p, dst_p, mask_p, _table(v)]
+    if gated:
+        in_specs += [table_spec, table_spec, pl.BlockSpec((1, 1), lambda i: (0, 0))]
+        operands += [_table(q), _table(k), b_edge.reshape(1, 1).astype(jnp.float32)]
+
+    bias, deg, gate = pl.pallas_call(
+        partial(
+            _fused_kernel,
+            gated=gated,
+            inv_sqrt_h=1.0 / float(max(h, 1)) ** 0.5,
+        ),
+        grid=(e_pad // tile,),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((n_pad, h_pad), lambda i: (0, 0)),
+            pl.BlockSpec((1, n_pad), lambda i: (0, 0)),
+            pl.BlockSpec((1, tile), lambda i: (0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n_pad, h_pad), jnp.float32),
+            jax.ShapeDtypeStruct((1, n_pad), jnp.float32),
+            jax.ShapeDtypeStruct((1, e_pad), jnp.float32),
+        ],
+        compiler_params=_CompilerParams(dimension_semantics=("arbitrary",)),
+        interpret=interpret,
+    )(*operands)
+    return bias[:n, :h], deg[0, :n], gate[0, :e]
+
+
+@programs.register("sparse.fused_gated_bias")
+@partial(jax.jit, static_argnames=("tile", "interpret"))
+def fused_gated_bias(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    b_edge: jnp.ndarray,
+    src_ep: jnp.ndarray,
+    dst_ep: jnp.ndarray,
+    edge_mask: jnp.ndarray,
+    tile: int = 256,
+    interpret: bool = False,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Fused STLGT neighbor bias: SDDMM gate
+    ``sigmoid((q[src] . k[dst]) / sqrt(H) + b_edge) * mask`` and the
+    bidirectional gated SpMM in one kernel.
+
+    Returns (bias_sum[N, H], gate_deg[N], gate[E]) — UN-normalized sums;
+    the model divides by max(gate_deg, 1) exactly as the XLA path does.
+    """
+    return _fused_call(
+        src_ep, dst_ep, edge_mask, v, q, k, b_edge,
+        gated=True, tile=tile, interpret=interpret,
+    )
+
+
+@programs.register("sparse.fused_neighbor_sums")
+@partial(jax.jit, static_argnames=("tile", "interpret"))
+def fused_neighbor_sums(
+    h: jnp.ndarray,
+    src_ep: jnp.ndarray,
+    dst_ep: jnp.ndarray,
+    edge_mask: jnp.ndarray,
+    tile: int = 256,
+    interpret: bool = False,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Fused GraphSAGE neighbor aggregation: bidirectional masked SpMM
+    plus the degree reduction in one kernel.
+
+    Returns (agg[N, F], deg[N]); ``neighbor_mean`` divides agg by
+    max(deg, 1) exactly as the XLA path does.
+    """
+    agg, deg, _gate = _fused_call(
+        src_ep, dst_ep, edge_mask, h, None, None, None,
+        gated=False, tile=tile, interpret=interpret,
+    )
+    return agg, deg
+
+
+# ---------------------------------------------------------------------------
+# sparse counting primitives (scorer building blocks, plain XLA)
+# ---------------------------------------------------------------------------
+
+
+def dense_rank_pairs(
+    a: jnp.ndarray, b: jnp.ndarray
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Dense rank of (a, b) pairs: returns (gid[N] int32, a_of_gid[N])
+    where gid is the 0-based rank of row (a[i], b[i]) in the sorted
+    distinct-pair order and a_of_gid[g] recovers a for group g (slots
+    past the group count are 0). The rank order is (a, b)-lexicographic,
+    so within any fixed a the gid is monotone in b and CONTIGUOUS per a —
+    the property the sparse scorer's packed by-side keys rely on. One
+    2-key sort + one scatter over N rows (~10 ms at 100k endpoints,
+    measured same-box vs ~6.7 s for the 8M-row 5-key lexsort it replaces).
+    """
+    n = a.shape[0]
+    iota = jnp.arange(n, dtype=jnp.int32)
+    s_a, s_b, s_i = jax.lax.sort(
+        (a.astype(jnp.int32), b.astype(jnp.int32), iota), num_keys=2
+    )
+    first = jnp.concatenate(
+        [
+            jnp.ones(1, dtype=bool),
+            (s_a[1:] != s_a[:-1]) | (s_b[1:] != s_b[:-1]),
+        ]
+    )
+    rank_sorted = jnp.cumsum(first.astype(jnp.int32)) - 1
+    gid = jnp.zeros(n, jnp.int32).at[s_i].set(rank_sorted)
+    # idempotent per-group scatter: every row of group g writes the same a
+    a_of_gid = jnp.zeros(n, jnp.int32).at[rank_sorted].max(s_a)
+    return gid, a_of_gid
+
+
+def run_start_index(first: jnp.ndarray) -> jnp.ndarray:
+    """For each row of a sorted table, the index of its run's first row
+    (``first`` marks run boundaries). A cummax over (first ? i : -1) —
+    no scatter, no segment ids. Rows before any boundary clamp to 0."""
+    n = first.shape[0]
+    iota = jnp.arange(n, dtype=jnp.int32)
+    return jnp.maximum(
+        jax.lax.cummax(jnp.where(first, iota, jnp.int32(-1))), 0
+    )
+
+
+def exclusive_cumsum(flags: jnp.ndarray) -> jnp.ndarray:
+    """int32 exclusive prefix sum with a trailing total, length N+1:
+    out[i] = number of set flags strictly before i. Boundary differences
+    out[hi] - out[lo] over it are bit-exact distinct counts."""
+    return jnp.concatenate(
+        [jnp.zeros(1, jnp.int32), jnp.cumsum(flags.astype(jnp.int32))]
+    )

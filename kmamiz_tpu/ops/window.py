@@ -213,6 +213,86 @@ def dependency_edges_packed(
     )
 
 
+@programs.register("window.dependency_edges_packed_sparse")
+@partial(jax.jit, static_argnames=("max_depth", "max_client_skip"))
+def dependency_edges_packed_sparse(
+    parent_slot: jnp.ndarray,
+    kind: jnp.ndarray,
+    valid: jnp.ndarray,
+    endpoint_id: jnp.ndarray,
+    max_depth: int = MAX_DEPTH,
+    max_client_skip: int = MAX_CLIENT_SKIP,
+) -> PackedEdges:
+    """dependency_edges_packed without the [T, L, L] one-hot adjacency:
+    every hop is a row-local int32 take_along_axis over the packed rows.
+    On CPU hosts the gather is a plain indexed load and the one-hot
+    einsum's O(T*L*L) flops are pure overhead, so the sparse backend
+    routes the walk here (the MXU einsum stays the TPU default — see
+    graph/store.py's sparse_walk dispatch).
+
+    Bit-exact against dependency_edges_packed: same CLIENT-skip pointer
+    doubling, same truncation, same PackedEdges layout — integer gathers
+    cannot even round where the einsum needed Precision.HIGHEST.
+    """
+    t_rows, l_slots = parent_slot.shape
+    iota = jnp.arange(l_slots, dtype=jnp.int32)
+
+    def gather_slot(idx, x):
+        # out[t, j] = x[t, idx[t, j]] with -1 passthrough
+        g = jnp.take_along_axis(x, jnp.maximum(idx, 0), axis=1)
+        return jnp.where(idx < 0, -1, g)
+
+    is_client = kind == KIND_CLIENT
+    safe_parent = jnp.where(valid & (parent_slot >= 0), parent_slot, -1)
+
+    # CLIENT-skip by pointer doubling, mirroring dependency_edges_packed
+    h = jnp.where(is_client, safe_parent, iota[None, :])
+    result = jnp.broadcast_to(iota[None, :], h.shape)
+    k = max_client_skip
+    power = h
+    while k:
+        if k & 1:
+            result = gather_slot(result, power)
+        k >>= 1
+        if k:
+            power = gather_slot(power, power)
+    skip_raw = gather_slot(safe_parent, result)
+    still_client = (skip_raw >= 0) & jnp.take_along_axis(
+        is_client, jnp.maximum(skip_raw, 0), axis=1
+    )
+    skip = jnp.where(still_client, -1, skip_raw)
+
+    is_server = valid & (kind == KIND_SERVER)
+    row_base = (jnp.arange(t_rows, dtype=jnp.int32) * l_slots)[:, None]
+
+    anc = skip
+    anc_eps, anc_slots, masks = [], [], []
+    for _ in range(max_depth):
+        anc_safe = jnp.maximum(anc, 0)
+        step_mask = (anc >= 0) & is_server
+        ep_d = jnp.take_along_axis(endpoint_id, anc_safe, axis=1)
+        anc_eps.append(jnp.where(step_mask, ep_d, -1))
+        anc_slots.append(jnp.where(step_mask, row_base + anc, -1))
+        masks.append(step_mask)
+        nxt = jnp.take_along_axis(skip, anc_safe, axis=1)
+        anc = jnp.where(anc < 0, -1, nxt)
+
+    def stack(parts):
+        return jnp.stack(parts, axis=-1).reshape(t_rows * l_slots, max_depth)
+
+    mask = stack(masks)
+    distances = jnp.arange(1, max_depth + 1, dtype=jnp.int32)[None, :]
+    return PackedEdges(
+        ancestor_ep=stack(anc_eps),
+        descendant_ep=jnp.where(
+            mask, endpoint_id.reshape(-1, 1), -1
+        ),
+        distance=jnp.where(mask, distances, 0),
+        mask=mask,
+        ancestor_slot=stack(anc_slots),
+    )
+
+
 class WindowStats(NamedTuple):
     """Per-(endpoint, status) segment statistics for one window."""
 

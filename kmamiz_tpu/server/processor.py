@@ -57,6 +57,7 @@ DEFAULT_STREAM_CHUNKS = 4
 #: parsed-but-unmerged chunks the raw-ingest ring may hold (see
 #: DataProcessor._stream_depth; env override KMAMIZ_INGEST_DEPTH)
 DEFAULT_STREAM_DEPTH = 2
+from kmamiz_tpu.graph import store as store_mod
 from kmamiz_tpu.graph.store import EndpointGraph
 from kmamiz_tpu.ops import window as window_ops
 
@@ -385,7 +386,13 @@ class DataProcessor:
             if self._use_device_stats and trace_groups and records:
                 stats_job = DeviceStatsJob(records)
 
-        with step_timer.phase("dependencies"), phase_span("walk"):
+        # the walk stage's phase name tracks the active walk backend so
+        # graftprof --diff compares dense vs sparse runs phase-for-phase
+        # instead of folding both into "walk" (ISSUE 13 satellite)
+        walk_phase = (
+            "walk_sparse" if store_mod._sparse_walk_default() else "walk"
+        )
+        with step_timer.phase("dependencies"), phase_span(walk_phase):
             dependencies = traces.to_endpoint_dependencies()
             # the raw pre-filter window edges; combine_with returns a new
             # instance without them, so capture before combining

@@ -42,6 +42,11 @@ PHASES = (
     "pack",
     "host-transfer",
     "walk",
+    # same tick stage as "walk" but under the KMAMIZ_SPARSE flat-gather
+    # walk dispatch (graph/store._sparse_walk_default) — a distinct name
+    # so graftprof --diff can compare walk backends instead of folding
+    # both into one phase
+    "walk_sparse",
     "scorers",
     "encode-serve",
     # STLGT continual-training refresh (models/stlgt/trainer.py): a

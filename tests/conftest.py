@@ -58,6 +58,7 @@ def _reset_resilience_state():
     every test starts from a clean slate."""
     from kmamiz_tpu import control, scenarios, telemetry, tenancy
     from kmamiz_tpu.models import stlgt
+    from kmamiz_tpu.ops import sparse
     from kmamiz_tpu.resilience import breaker, metrics, quarantine
 
     breaker.reset_for_tests()
@@ -68,6 +69,9 @@ def _reset_resilience_state():
     scenarios.reset_for_tests()
     stlgt.reset_for_tests()
     control.reset_for_tests()
+    # the sparse backend knob is cached after first read; a test that
+    # monkeypatches KMAMIZ_SPARSE* must not leak its choice forward
+    sparse.reset_for_tests()
     yield
 
 

@@ -74,7 +74,9 @@ class TestArena:
         assert arena.get("acme") is g
         view = arena[("acme", g.version)]
         assert view.tenant == "acme"
-        assert view.capacity == g.capacity
+        # view.capacity is the flat snapshot width: main + overflow tail
+        # under the default segment growth mode
+        assert view.capacity == g.capacity + g.tail_capacity
         with pytest.raises(KeyError):
             arena[("acme", g.version + 1)]  # stale index
 

@@ -40,6 +40,10 @@ _EXTRA_GATED = (
     "dp_tick_ms_2500_traces",
     "dp_tick_cached_ms",
     "graph_refresh_ms_100k",
+    # worst single merge wall across the 100k-endpoint scale section
+    # (ISSUE 13): the segment-append growth path must not trade refresh
+    # latency for merge-wall regressions
+    "graph_merge_wall_ms_100k",
     "tenant_batched_tick_ms_8",
     "tenant_join_compile_count",
     "scenario_worst_p99_tick_ms",
@@ -52,6 +56,9 @@ _EXTRA_GATED = (
     "prof_merge_lockwait_ms_p95",
     "prof_transfer_ms_p95",
     "prof_device_walk_ms_p95",
+    # sparse flat-gather walk backend (ISSUE 13): its own phase name so
+    # --diff compares walk backends instead of folding both into one
+    "prof_device_walk_sparse_ms_p95",
     # STLGT continual-model latency pair (ISSUE 10): the per-fold train
     # tick and the served quantile forward behind /model/forecast
     "stlgt_train_tick_ms",
@@ -65,7 +72,7 @@ _EXTRA_GATED = (
 # boolean pass/fail keys: any True -> False flip is a regression (bool
 # is an int subclass, so the numeric threshold check would wave a
 # True -> False transition through as 1.0 -> 0.0 "improvement")
-_BOOL_GATED = ("scenario_matrix_pass",)
+_BOOL_GATED = ("scenario_matrix_pass", "graph_refresh_pass")
 # higher-is-BETTER float floors: the numeric check above only catches
 # increases, so a coverage collapse would read as an "improvement".
 # stlgt_p99_coverage is a [0,1] calibration rate where relative
@@ -88,6 +95,7 @@ _PROF_KEY_PHASE = {
     "prof_merge_lockwait_ms_p95": "native-merge-lockwait",
     "prof_transfer_ms_p95": "host-transfer",
     "prof_device_walk_ms_p95": "walk",
+    "prof_device_walk_sparse_ms_p95": "walk_sparse",
 }
 # parse thread-scaling gate (ISSUE 12): the t2 merge regression (a
 # shared atomic intern table serializing the merge) showed up as

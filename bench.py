@@ -957,6 +957,70 @@ def main() -> None:
             f"{type(err).__name__}: {err}"[:300]
         )
 
+    # ---- graftcost predictive prewarm: crossing stall A/B ------------------
+    # the same segment-store consolidation, prewarm ON vs OFF, one
+    # subprocess per arm (compile caches are process-global — an
+    # in-process A/B would leak warmth from the first arm into the
+    # second; the persistent XLA cache is disabled for both arms so OFF
+    # really pays the compile). Identical ramps, asserted bit-exact.
+    cost_extras = {
+        "capacity_growth_stall_ms": None,
+        "capacity_growth_stall_off_ms": None,
+        "capacity_growth_stall_reduction": None,
+        "capacity_growth_mid_compiles": None,
+        "capacity_growth_bit_exact": None,
+        "cost_prewarm_hit_rate": None,
+    }
+    try:
+        import subprocess
+
+        arms = {}
+        for arm in ("off", "on"):
+            probe_env = {
+                **os.environ,
+                "JAX_PLATFORMS": os.environ.get("JAX_PLATFORMS", "cpu"),
+            }
+            # both arms fully cold and hint-free: the OFF arm must
+            # actually pay the crossing compile it is measuring
+            probe_env.pop("KMAMIZ_COMPILE_CACHE_DIR", None)
+            probe_env.pop("KMAMIZ_SHAPE_HINTS", None)
+            proc = subprocess.run(
+                [
+                    sys.executable,
+                    "-m",
+                    "kmamiz_tpu.cost.growth_probe",
+                    "--prewarm",
+                    arm,
+                ],
+                cwd=str(Path(__file__).parent),
+                env=probe_env,
+                capture_output=True,
+                text=True,
+                timeout=600,
+            )
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"growth probe ({arm}) rc={proc.returncode}: "
+                    f"{proc.stderr[-300:]}"
+                )
+            arms[arm] = json.loads(proc.stdout.strip().splitlines()[-1])
+        off_arm, on_arm = arms["off"], arms["on"]
+        cost_extras["capacity_growth_stall_ms"] = on_arm["stall_ms"]
+        cost_extras["capacity_growth_stall_off_ms"] = off_arm["stall_ms"]
+        cost_extras["capacity_growth_stall_reduction"] = round(
+            off_arm["stall_ms"] / max(on_arm["stall_ms"], 1e-9), 1
+        )
+        cost_extras["capacity_growth_mid_compiles"] = on_arm["mid_compiles"]
+        cost_extras["capacity_growth_bit_exact"] = (
+            off_arm["signature"] == on_arm["signature"]
+        )
+        cost_extras["cost_prewarm_hit_rate"] = on_arm.get("hit_rate")
+        cost_extras["capacity_growth_steady_ms"] = on_arm.get("steady_ms")
+    except Exception as err:  # noqa: BLE001 - keys stay present, value None
+        cost_extras["capacity_growth_error"] = (
+            f"{type(err).__name__}: {err}"[:300]
+        )
+
     # ---- end-to-end DP tick at the reference's own scale -------------------
     # the reference caps realtime ticks at 2,500 traces / 5 s; this times the
     # FULL DataProcessor.collect (host parse + device kernels + response
@@ -1955,6 +2019,7 @@ def main() -> None:
         ),
         "graph_refresh_pass": bool(refresh_ms <= 50.0),
         **grow_extras,
+        **cost_extras,
         "http_instability_10k_endpoints_ms": round(http_api_refresh_ms, 1),
         "walk_mxu_packed_ms": round(walk_mxu_ms, 1),
         "walk_flat_gather_ms": round(walk_flat_ms, 1),

@@ -733,6 +733,11 @@ _STATE_SCOPES = (
     # cost table, warmed-breaker sets) are swapped from the fold path
     # while every serving thread reads verdicts per tick
     "kmamiz_tpu/control/",
+    # the graftcost plane's model weights, growth tracker, and
+    # warmed/pending bookkeeping take writes from merge finalizes on
+    # server threads while the background prewarm thread and /timings
+    # readers run concurrently
+    "kmamiz_tpu/cost/",
 )
 
 

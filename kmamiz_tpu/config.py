@@ -203,8 +203,8 @@ class Settings:
         )
     )  # matrix seed: one integer composes every topology/traffic/storyline
     scenario_matrix: int = field(
-        default_factory=lambda: int(os.environ.get("KMAMIZ_SCENARIO_MATRIX", "7"))
-    )  # matrix size; archetype i % 7 at index i
+        default_factory=lambda: int(os.environ.get("KMAMIZ_SCENARIO_MATRIX", "8"))
+    )  # matrix size; archetype i % 8 at index i
     scenario_ticks: int = field(
         default_factory=lambda: int(os.environ.get("KMAMIZ_SCENARIO_TICKS", "10"))
     )  # soak length per scenario, in DP ticks
@@ -321,6 +321,24 @@ class Settings:
             os.environ.get("KMAMIZ_CONTROL_PROBE_S", "1.0")
         )
     )  # shortened breaker probe cooldown while warmed
+
+    # graftcost program-cost model (kmamiz_tpu/cost/, docs/COST_MODEL.md).
+    # The cost plane reads these env vars directly (its hooks fire from
+    # merge finalizes before any Settings instance need exist); the
+    # fields mirror them so one `Settings()` dump shows everything.
+    cost_enabled: bool = field(
+        default_factory=lambda: os.environ.get("KMAMIZ_COST", "0")
+        not in ("0", "false", "")
+    )  # master gate for the learned cost plane (default OFF)
+    cost_prewarm: str = field(
+        default_factory=lambda: os.environ.get("KMAMIZ_COST_PREWARM", "1")
+    )  # "1" background-thread prewarm, "sync" harness-drained, "0" forecast only
+    cost_horizon: int = field(
+        default_factory=lambda: int(os.environ.get("KMAMIZ_COST_HORIZON", "3"))
+    )  # crossings projected within this many merges arm predictive prewarm
+    cost_examples: int = field(
+        default_factory=lambda: int(os.environ.get("KMAMIZ_COST_EXAMPLES", "256"))
+    )  # fixed ridge-fit table rows (pow2-clamped 32..4096; one shape = one compile)
 
     def __post_init__(self) -> None:
         k8s_host = os.environ.get("KUBERNETES_SERVICE_HOST")

@@ -194,7 +194,11 @@ class Program:
         self.last_compile_ms = 0.0
         self.prewarmed = 0
         self.prewarm_ms = 0.0
+        self.run_ewma_ms = 0.0  # warm-dispatch wall EWMA (graftcost label)
         self._specs: Dict[str, Any] = {}  # canonical json -> spec
+        # canonical json -> (spec, compile_ms, run_ms): the cost-model
+        # training labels (run_ms 0.0 until a warm call lands)
+        self._labels: Dict[str, Tuple[Any, float, float]] = {}
         self._suppress_record = False
 
     # -- delegation ---------------------------------------------------------
@@ -214,11 +218,19 @@ class Program:
                 self.compiles += grew
                 self.compile_ms += elapsed_ms
                 self.last_compile_ms = elapsed_ms
+            elif before is not None:
+                # warm dispatch: the per-program run-cost label the
+                # graftcost regressor trains its run-ms head on
+                self.run_ewma_ms = (
+                    elapsed_ms
+                    if self.run_ewma_ms == 0.0
+                    else 0.8 * self.run_ewma_ms + 0.2 * elapsed_ms
+                )
         if grew:
             if _prof_device_attr is not None:
                 _prof_device_attr.note_compile(self.name, grew, elapsed_ms)
             if not self._suppress_record:
-                self._record_spec(args, kwargs)
+                self._record_spec(args, kwargs, compile_ms=elapsed_ms)
         return out
 
     def __getattr__(self, item):
@@ -231,7 +243,7 @@ class Program:
             return None
 
     # -- shape hints --------------------------------------------------------
-    def _record_spec(self, args, kwargs) -> None:
+    def _record_spec(self, args, kwargs, compile_ms: float = 0.0) -> None:
         try:
             import jax
 
@@ -248,6 +260,14 @@ class Program:
             return
         key = json.dumps(spec, sort_keys=True)
         with self._lock:
+            if compile_ms > 0.0 and (
+                key in self._labels or len(self._labels) < _MAX_HINTS_PER_PROGRAM
+            ):
+                # keep the max observed wall per bucket: a cache-evicted
+                # recompile of a known spec still paid the full trace
+                prev = self._labels.get(key)
+                if prev is None or compile_ms > prev[1]:
+                    self._labels[key] = (spec, compile_ms, 0.0)
             if key in self._specs:
                 return
             if len(self._specs) >= _MAX_HINTS_PER_PROGRAM:
@@ -269,6 +289,34 @@ class Program:
                     and len(self._specs) < _MAX_HINTS_PER_PROGRAM
                 ):
                     self._specs[key] = spec
+
+    # -- cost labels (graftcost training rows) ------------------------------
+    def labels(self) -> List[Tuple[Any, float, float]]:
+        """(spec, compile_ms, run_ms) rows observed by this process plus
+        adopted history. A live row whose warm wall hasn't landed yet
+        borrows the program-level run EWMA."""
+        with self._lock:
+            ewma = self.run_ewma_ms
+            return [
+                (spec, compile_ms, run_ms if run_ms > 0.0 else ewma)
+                for spec, compile_ms, run_ms in self._labels.values()
+            ]
+
+    def adopt_labels(self, labelled: List[Tuple[Any, float, float]]) -> None:
+        """Merge persisted label rows (restart path): live observations
+        of the same bucket win."""
+        with self._lock:
+            for spec, compile_ms, run_ms in labelled:
+                key = json.dumps(spec, sort_keys=True)
+                if (
+                    key not in self._labels
+                    and len(self._labels) < _MAX_HINTS_PER_PROGRAM
+                ):
+                    self._labels[key] = (
+                        spec,
+                        float(compile_ms),
+                        float(run_ms),
+                    )
 
     # -- prewarm ------------------------------------------------------------
     def prewarm_spec(self, spec: Any) -> bool:
@@ -312,6 +360,7 @@ class Program:
                 "lastCompileMs": round(self.last_compile_ms, 1),
                 "prewarmed": self.prewarmed,
                 "prewarmMs": round(self.prewarm_ms, 1),
+                "runEwmaMs": round(self.run_ewma_ms, 3),
                 "cacheSize": self._cache_entries(),
                 "buckets": [_bucket_label(s) for s in self._specs.values()],
             }
@@ -389,6 +438,7 @@ def _ensure_registered() -> None:
         "kmamiz_tpu.models.stacked",
         "kmamiz_tpu.models.stlgt.trainer",
         "kmamiz_tpu.models.stlgt.serving",
+        "kmamiz_tpu.cost.model",
     ):
         try:
             importlib.import_module(mod)
@@ -492,6 +542,18 @@ def save_hints(path: Optional[str] = None) -> Optional[str]:
             for name, p in sorted(all_programs().items())
             if p.specs()
         },
+        # sibling key, same version: readers of "programs" (including
+        # older processes — load_hints filters on len(spec) == 2 and
+        # never looks here) are unaffected. These are the graftcost
+        # training rows that survive a restart.
+        "labels": {
+            name: [
+                {"spec": spec, "compileMs": round(c, 3), "runMs": round(r, 3)}
+                for spec, c, r in p.labels()
+            ]
+            for name, p in sorted(all_programs().items())
+            if p.labels()
+        },
     }
     tmp = f"{path}.tmp.{os.getpid()}"
     with _hints_lock:
@@ -520,6 +582,52 @@ def load_hints(path: Optional[str] = None) -> Dict[str, List[Any]]:
     except (OSError, ValueError, TypeError) as e:
         logger.warning("bad shape-hint file %s: %s", path, e)
         return {}
+
+
+def load_labels(
+    path: Optional[str] = None,
+) -> Dict[str, List[Tuple[Any, float, float]]]:
+    """Persisted cost labels: {name: [(spec, compile_ms, run_ms)]}.
+    Empty when unconfigured, absent (pre-label hint file), or bad."""
+    path = path or hints_path()
+    if not path or not os.path.exists(path):
+        return {}
+    try:
+        with open(path) as f:
+            payload = json.load(f)
+        if payload.get("version") != _HINTS_VERSION:
+            return {}
+        out: Dict[str, List[Tuple[Any, float, float]]] = {}
+        for name, rows in payload.get("labels", {}).items():
+            keep = []
+            for row in rows:
+                spec = row.get("spec")
+                if not (isinstance(spec, list) and len(spec) == 2):
+                    continue
+                keep.append(
+                    (
+                        (spec[0], spec[1]),
+                        float(row.get("compileMs", 0.0)),
+                        float(row.get("runMs", 0.0)),
+                    )
+                )
+            if keep:
+                out[name] = keep
+        return out
+    except (OSError, ValueError, TypeError) as e:
+        logger.warning("bad shape-hint labels in %s: %s", path, e)
+        return {}
+
+
+def adopt_labels(
+    labelled: Dict[str, List[Tuple[Any, float, float]]]
+) -> None:
+    """Feed persisted label history back into the live programs so the
+    cost model trains from day-one history at boot."""
+    for name, rows in labelled.items():
+        prog = get(name)
+        if prog is not None:
+            prog.adopt_labels(rows)
 
 
 def _autosave_hints() -> None:
@@ -579,23 +687,39 @@ def run_prewarm(
     except Exception:  # noqa: BLE001 - never let the probe block boot
         logger.exception("native prewarm probe failed")
     hints = load_hints() if hints is None else hints
+    labels = load_labels()
+    adopt_labels(labels)
     report = {
         "hintedPrograms": len(hints),
         "warmed": 0,
         "failed": 0,
+        "ranked": False,
         "defaultGraphPrograms": 0,
     }
+    pairs: List[Tuple[str, Any]] = []
     for name, specs in sorted(hints.items()):
-        prog = get(name)
-        if prog is None:
+        if get(name) is None:
             report["failed"] += len(specs)
             logger.warning("hint for unregistered program %s", name)
             continue
-        for spec in specs:
-            if prog.prewarm_spec(spec):
-                report["warmed"] += 1
-            else:
-                report["failed"] += 1
+        pairs.extend((name, spec) for spec in specs)
+    # graftcost boot ranking: longest predicted compile first, so
+    # readiness is bounded by the expensive programs instead of queuing
+    # them behind trivia. Falls back to the stable name order on any
+    # failure — ranking must never block a cold boot.
+    try:
+        from kmamiz_tpu import cost as _cost
+
+        pairs = _cost.ranked_prewarm_order(pairs, labels)
+        report["ranked"] = True
+    except Exception:  # noqa: BLE001 - name-ordered replay still correct
+        logger.exception("prewarm ranking failed; using name order")
+    for name, spec in pairs:
+        prog = get(name)
+        if prog is not None and prog.prewarm_spec(spec):
+            report["warmed"] += 1
+        else:
+            report["failed"] += 1
     graph_hinted = any(n.startswith("graph.") for n in hints)
     if graph is not None and not graph_hinted:
         try:
@@ -696,6 +820,8 @@ REGISTERED_JIT_SITES: Dict[str, set] = {
         "merge_service_lanes",
     },
     "kmamiz_tpu/server/processor.py": {"_pack_stats"},
+    # graftcost continual trainer (registered as cost.ridge_fit)
+    "kmamiz_tpu/cost/model.py": {"_ridge_fit"},
     # scanner resolves inline jits to the nearest def: "fwd" is the
     # body _jitted_forward jits (registered as models.forecast_forward),
     # "run" the epoch blocks of epoch_runner/dp_epoch_runner

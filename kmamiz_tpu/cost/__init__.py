@@ -1,0 +1,412 @@
+"""graftcost — learned program-cost model over the registry (docs/COST_MODEL.md).
+
+The program registry continuously generates a TpuGraphs-shaped dataset
+(every compile: argument spec + measured wall; every warm call: run
+wall). graftcost trains a small ridge regressor over it (cost/model.py
+on cost/features.py) and spends the predictions in three places:
+
+- **predictive prewarm**: the per-tenant growth forecaster
+  (tenancy/growth.py, fed by the store's own merge finalizes) projects
+  the next segment-consolidation crossing; imminent crossings trigger
+  spec transposition (cost/prewarm.py) so the post-crossing shapes are
+  warm BEFORE the crossing lands — zero mid-tick compiles at a
+  capacity doubling (the ROADMAP item-6 gate);
+- **boot prewarm ranking**: ``programs.run_prewarm`` orders the hint
+  replay longest-predicted-compile-first, so restart readiness is
+  bounded by the big programs, not queued behind trivia;
+- **cost-aware tick ordering**: per-tenant predicted run cost (by
+  arena capacity bucket) folds into the TickRouter's graftpilot batch
+  ordering.
+
+Timing contract (the graftpilot posture): training and prewarm planning
+run at fold boundaries / between ticks / on the background thread —
+never on the warm tick. The store's merge-finalize hook
+(``observe_merge``) is one lock-guarded ring append plus integer
+arithmetic; the router read is one dict lookup against a table computed
+at refresh time.
+
+Gated off by default: KMAMIZ_COST=1 enables the plane.
+KMAMIZ_COST_PREWARM: "1" (default) prewarms on a daemon thread when a
+crossing is imminent, "sync" defers execution to an explicit
+``run_pending_prewarms()`` call (the deterministic harness mode),
+"0" forecasts but never prewarms.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+from kmamiz_tpu.cost import features, model, prewarm
+from kmamiz_tpu.cost.model import CostModel
+from kmamiz_tpu.tenancy import growth
+from kmamiz_tpu.telemetry.profiling import events as prof_events
+from kmamiz_tpu.telemetry.registry import REGISTRY
+
+logger = logging.getLogger("kmamiz_tpu.cost")
+
+# ---------------------------------------------------------------------------
+# metrics: handles preallocated at import (observe_merge is reachable
+# from the tick's merge finalize — no per-call label formatting there)
+# ---------------------------------------------------------------------------
+EXAMPLES = REGISTRY.gauge(
+    "kmamiz_cost_examples",
+    "Labelled (program, spec) rows behind the last cost-model fit",
+)
+MAE_COMPILE_MS = REGISTRY.gauge(
+    "kmamiz_cost_mae_compile_ms",
+    "Mean absolute compile-ms prediction error at the last fit",
+)
+MAE_RUN_MS = REGISTRY.gauge(
+    "kmamiz_cost_mae_run_ms",
+    "Mean absolute warm-run-ms prediction error at the last fit",
+)
+PREWARM_HITS = REGISTRY.counter(
+    "kmamiz_cost_prewarm_hits_total",
+    "Capacity consolidations that landed on a predictively warmed bucket",
+)
+PREWARM_MISSES = REGISTRY.counter(
+    "kmamiz_cost_prewarm_misses_total",
+    "Capacity consolidations that landed cold despite graftcost being on",
+)
+PREDICTIVE_PREWARMS = REGISTRY.counter(
+    "kmamiz_cost_predictive_prewarms_total",
+    "Predictive prewarm rounds executed ahead of a forecast crossing",
+)
+PREWARMED_SPECS = REGISTRY.counter(
+    "kmamiz_cost_prewarmed_specs_total",
+    "Transposed specs warmed by predictive prewarm rounds",
+)
+TRAIN_MS = REGISTRY.histogram(
+    "kmamiz_cost_train_ms",
+    "Cost-model refresh latency (fold boundary / prewarm trigger)",
+)
+
+
+# ---------------------------------------------------------------------------
+# config
+# ---------------------------------------------------------------------------
+def enabled() -> bool:
+    """Master gate — graftcost is opt-in (KMAMIZ_COST=1)."""
+    return os.environ.get("KMAMIZ_COST", "0") not in ("0", "false", "")
+
+
+def prewarm_mode() -> str:
+    got = os.environ.get("KMAMIZ_COST_PREWARM", "1").strip().lower()
+    return got if got in ("0", "1", "sync") else "1"
+
+
+def horizon_merges() -> int:
+    """Crossings projected within this many merges trigger prewarm."""
+    try:
+        return max(1, int(os.environ.get("KMAMIZ_COST_HORIZON", "3")))
+    except ValueError:
+        return 3
+
+
+def _tail_shift() -> int:
+    try:
+        return int(os.environ.get("KMAMIZ_STORE_TAIL_SHIFT", "3"))
+    except ValueError:
+        return 3
+
+
+# ---------------------------------------------------------------------------
+# the plane
+# ---------------------------------------------------------------------------
+class GraftCost:
+    """Process-wide cost plane: model + growth tracker + prewarm
+    bookkeeping. All mutables lock-guarded — observe_merge is called
+    from merge finalizes on server threads while the background prewarm
+    thread and /timings readers run concurrently."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.model = CostModel()
+        self.tracker = growth.GrowthTracker()
+        self._warmed: Dict[str, set] = {}  # tenant -> {(main, tail)}
+        self._pending: Dict[str, growth.GrowthForecast] = {}
+        self._width_costs: Dict[int, float] = {}  # flat width -> run ms
+        self._hits = 0
+        self._misses = 0
+        self._rounds = 0
+        self._last_crossing: Optional[dict] = None
+
+    # -- merge-finalize hook (tick-reachable: keep it cheap) ----------------
+    def observe_merge(
+        self, tenant: str, valid: int, main_cap: int, tail_cap: int
+    ) -> None:
+        self.tracker.observe(tenant, valid, main_cap, tail_cap)
+        fc = self.tracker.forecast(tenant, _tail_shift())
+        if fc is None or not fc.imminent(horizon_merges()):
+            return
+        target = (fc.new_main, fc.new_tail)
+        with self._lock:
+            if target in self._warmed.get(tenant, ()):
+                return
+            already = tenant in self._pending
+            self._pending[tenant] = fc
+        if not already and prewarm_mode() == "1":
+            threading.Thread(
+                target=self.run_pending_prewarms,
+                name="kmamiz-cost-prewarm",
+                daemon=True,
+            ).start()
+
+    def note_capacity_change(
+        self, tenant: str, old_main: int, new_main: int, new_tail: int
+    ) -> None:
+        """Consolidation accounting: did predictive prewarm get there
+        first? (The scorecard floor ``cost_prewarm_hit_rate``.)"""
+        with self._lock:
+            hit = (new_main, new_tail) in self._warmed.get(tenant, ())
+            if hit:
+                self._hits += 1
+            else:
+                self._misses += 1
+            self._pending.pop(tenant, None)
+            self._last_crossing = {
+                "tenant": tenant,
+                "fromMain": int(old_main),
+                "toMain": int(new_main),
+                "toTail": int(new_tail),
+                "hit": hit,
+            }
+        (PREWARM_HITS if hit else PREWARM_MISSES).inc()
+
+    # -- prewarm execution (off the tick) -----------------------------------
+    def run_pending_prewarms(self) -> dict:
+        """Drain pending crossings: refresh the model, transpose every
+        warm spec to the projected (main, tail), replay longest-first.
+        Sync-mode harnesses call this between ticks; background mode
+        runs it on the daemon thread observe_merge spawned."""
+        with self._lock:
+            pending, self._pending = dict(self._pending), {}
+        if not pending:
+            return {"rounds": 0, "warmed": 0, "failed": 0}
+        try:
+            self.refresh()
+        except Exception:  # noqa: BLE001 - ranking degrades, prewarm survives
+            logger.exception("cost refresh before prewarm failed")
+        warmed_total = failed_total = 0
+        for tenant, fc in sorted(pending.items()):
+            mapping = prewarm.growth_mapping(
+                fc.main, fc.tail, fc.new_main, fc.new_tail
+            )
+            pairs = prewarm.predictive_pairs(
+                mapping,
+                delta=(fc.main + fc.tail, fc.new_main + fc.new_tail),
+            )
+            pairs = prewarm.rank_by_predicted_compile(
+                pairs, self.model if self.model.trained() else None
+            )
+            warmed, failed = prewarm.execute(pairs)
+            warmed_total += warmed
+            failed_total += failed
+            with self._lock:
+                self._warmed.setdefault(tenant, set()).add(
+                    (fc.new_main, fc.new_tail)
+                )
+                self._rounds += 1
+            PREDICTIVE_PREWARMS.inc()
+            if warmed:
+                PREWARMED_SPECS.inc(warmed)
+            logger.info(
+                "predictive prewarm %s: %d->%d (+%d tail), %d warmed %d failed",
+                tenant, fc.main, fc.new_main, fc.new_tail, warmed, failed,
+            )
+        return {
+            "rounds": len(pending),
+            "warmed": warmed_total,
+            "failed": failed_total,
+        }
+
+    # -- training -----------------------------------------------------------
+    def refresh(self, persisted: Optional[dict] = None) -> dict:
+        """Retrain from persisted label history + the live registry and
+        recompute the per-width run-cost table the router reads."""
+        t0 = prof_events.now_ms()
+        if persisted is None:
+            from kmamiz_tpu.core import programs as _programs
+
+            persisted = _programs.load_labels()
+        rows = model.training_rows(persisted)
+        report = self.model.fit(rows)
+        EXAMPLES.set(float(report["examples"]))
+        MAE_COMPILE_MS.set(report["maeCompileMs"])
+        MAE_RUN_MS.set(report["maeRunMs"])
+        width_costs = self._compute_width_costs()
+        with self._lock:
+            self._width_costs = width_costs
+        TRAIN_MS.observe(prof_events.now_ms() - t0)
+        return report
+
+    def _compute_width_costs(self) -> Dict[int, float]:
+        """Predicted per-tick run cost of the store-width-shaped (graph
+        family) programs, summed per flat store width — the tenant cost
+        is one lookup by its arena bucket's width."""
+        from kmamiz_tpu.core import programs as _programs
+
+        pairs: List[Tuple[str, Any]] = []
+        widths: List[int] = []
+        for name, prog in sorted(_programs.all_programs().items()):
+            if not name.startswith("graph."):
+                continue
+            for spec in prog.specs():
+                dims = [
+                    d
+                    for d in features.spec_dims(spec)
+                    if d >= 256 and (d & (d - 1)) == 0
+                ]
+                if not dims:
+                    continue
+                pairs.append((name, spec))
+                widths.append(max(dims))
+        preds = self.model.predict_many(pairs)
+        if preds is None:
+            return {}
+        out: Dict[int, float] = {}
+        for width, row in zip(widths, preds):
+            out[width] = out.get(width, 0.0) + float(row[1])
+        return out
+
+    # -- consumers ----------------------------------------------------------
+    def predicted_tenant_costs(self) -> Dict[str, float]:
+        with self._lock:
+            width_costs = dict(self._width_costs)
+        if not width_costs:
+            return {}
+        try:
+            from kmamiz_tpu.tenancy.arena import default_arena
+
+            shift = _tail_shift()
+            out: Dict[str, float] = {}
+            for cap, tenants in default_arena().buckets().items():
+                ms = width_costs.get(int(cap) + growth.tail_rows(int(cap), shift))
+                if ms is None:
+                    continue
+                for t in tenants:
+                    out[str(t)] = round(ms, 3)
+            return out
+        except Exception:  # noqa: BLE001 - ordering is best-effort
+            return {}
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            hits, misses = self._hits, self._misses
+            snap = {
+                "model": self.model.snapshot(),
+                "growth": self.tracker.snapshot(),
+                "warmed": {
+                    t: sorted(f"{m}+{tl}" for m, tl in caps)
+                    for t, caps in sorted(self._warmed.items())
+                },
+                "pendingTenants": sorted(self._pending),
+                "prewarmRounds": self._rounds,
+                "prewarmHits": hits,
+                "prewarmMisses": misses,
+                "hitRate": round(hits / (hits + misses), 3)
+                if (hits + misses)
+                else None,
+                "lastCrossing": self._last_crossing,
+                "widthCosts": {
+                    str(w): round(ms, 3)
+                    for w, ms in sorted(self._width_costs.items())
+                },
+            }
+        return snap
+
+
+_COST: Optional[GraftCost] = None
+_COST_LOCK = threading.Lock()
+
+
+def get_cost() -> GraftCost:
+    global _COST
+    with _COST_LOCK:
+        if _COST is None:
+            _COST = GraftCost()
+        return _COST
+
+
+def reset_for_tests() -> None:
+    """Drop the singleton (conftest autouse): fresh model, tracker,
+    warmed-bucket bookkeeping."""
+    global _COST
+    with _COST_LOCK:
+        _COST = None
+
+
+# -- module-level facade (the hook surface the rest of the repo calls) ------
+def observe_merge(
+    tenant: str, valid: int, main_cap: int, tail_cap: int
+) -> None:
+    """Merge-finalize hook (graph/store.py): record one observation and
+    arm predictive prewarm when a crossing is imminent. One env read;
+    everything else is integer arithmetic + one ring append."""
+    if not enabled():
+        return
+    get_cost().observe_merge(tenant or "default", valid, main_cap, tail_cap)
+
+
+def note_capacity_change(
+    tenant: str, old_main: int, new_main: int, new_tail: int
+) -> None:
+    if not enabled():
+        return
+    get_cost().note_capacity_change(
+        tenant or "default", old_main, new_main, new_tail
+    )
+
+
+def run_pending_prewarms() -> dict:
+    if not enabled():
+        return {"rounds": 0, "warmed": 0, "failed": 0}
+    return get_cost().run_pending_prewarms()
+
+
+def refresh(persisted: Optional[dict] = None) -> Optional[dict]:
+    if not enabled():
+        return None
+    return get_cost().refresh(persisted)
+
+
+def on_fold(tenant: Optional[str]) -> Optional[dict]:
+    """Fold-boundary hook (server/processor.py): continual retrain from
+    the live registry. The fit program has one fixed shape (model.py),
+    so steady-state folds re-run a warm program — the trainer can never
+    become the stall it predicts."""
+    if not enabled():
+        return None
+    return get_cost().refresh()
+
+
+def predicted_tenant_costs() -> Dict[str, float]:
+    """Per-tenant predicted run-cost table for the TickRouter's batch
+    ordering; {} until a refresh has run."""
+    if not enabled():
+        return {}
+    inst = _COST
+    return inst.predicted_tenant_costs() if inst is not None else {}
+
+
+def ranked_prewarm_order(
+    pairs: List[Tuple[str, Any]],
+    labels: Optional[Dict[str, List[Tuple[Any, float, float]]]] = None,
+) -> List[Tuple[str, Any]]:
+    """Boot-ranking consumer: longest-predicted-compile-first ordering
+    for ``programs.run_prewarm``. Works ungated — with an untrained
+    model it falls back to observed compile labels, then name order."""
+    inst = _COST
+    mdl = inst.model if inst is not None and inst.model.trained() else None
+    return prewarm.rank_by_predicted_compile(pairs, mdl, labels)
+
+
+def snapshot() -> dict:
+    """Cost-plane posture for /timings and debugging surfaces."""
+    base = {"enabled": enabled(), "prewarm": prewarm_mode()}
+    inst = _COST
+    if inst is None:
+        return {**base, "model": {"trained": False}, "prewarmHits": 0}
+    return {**base, **inst.snapshot()}

@@ -1,0 +1,163 @@
+"""graftcost feature extractor: (program, spec) -> fixed-width vector.
+
+The program registry's shape hints are the TpuGraphs-shaped dataset the
+live system generates for free (PAPERS.md): every compiled bucket is a
+JSON spec of array shapes, dtypes, and static scalars, and every compile
+carries its measured wall. This module turns one (program name, spec)
+pair into a deterministic ``DIM``-wide float32 vector the ridge
+regressor in :mod:`.model` trains on:
+
+- size terms: log2 total/max array elements, leaf counts, max rank, and
+  the log2 of the largest power-of-2 dimension (the capacity-bucket
+  proxy — the store pads every growable axis to pow2, so this feature
+  IS the bucket the spec compiles for);
+- dtype mix: fraction of array leaves that are f32 / integer / bool /
+  other (compile cost differs by lowering path);
+- static-value buckets: count of static scalars and the log2 of their
+  absolute-int mass (``cap=2048`` style static args shift compile cost
+  the shape dims alone cannot see);
+- program family: an 8-way one-hot over ``zlib.crc32`` of the name's
+  family prefix (``graph.``, ``scorers.``, ...). crc32 — never Python
+  ``hash()``, which is salted per process and would de-determinize the
+  table.
+
+Everything here is pure host arithmetic over already-encoded specs: no
+JAX, no clocks, no I/O — callable from any thread at any time.
+"""
+from __future__ import annotations
+
+import math
+import zlib
+from typing import Any, List, Tuple
+
+import numpy as np
+
+#: feature vector width (the regressor's input dim)
+DIM = 20
+
+#: family one-hot slots (features 12..19)
+N_FAMILIES = 8
+
+
+def _log2p(x: float) -> float:
+    return math.log2(1.0 + max(0.0, float(x)))
+
+
+def _walk(node: Any, arrays: List[Tuple[Tuple[int, ...], str]], scalars: List[Any]) -> None:
+    """Collect array leaves ``(shape, dtype)`` and static scalar leaves
+    from one encoded spec subtree (the ``programs._encode`` grammar)."""
+    if node is None or isinstance(node, (bool, int, float, str)):
+        scalars.append(node)
+        return
+    if isinstance(node, list):
+        for v in node:
+            _walk(v, arrays, scalars)
+        return
+    if isinstance(node, dict):
+        if "__arr__" in node:
+            shape, dtype, _weak = node["__arr__"]
+            arrays.append((tuple(int(d) for d in shape), str(dtype)))
+            return
+        if "__tuple__" in node:
+            for v in node["__tuple__"]:
+                _walk(v, arrays, scalars)
+            return
+        if "__nt__" in node:
+            for v in node.get("items", ()):
+                _walk(v, arrays, scalars)
+            return
+        for _k, v in sorted(node.items()):
+            _walk(v, arrays, scalars)
+
+
+def family_slot(name: str) -> int:
+    """Deterministic family bucket: crc32 of the name's first dotted
+    component (``graph.split_segments`` -> ``graph``)."""
+    prefix = name.split(".", 1)[0] if name else ""
+    return zlib.crc32(prefix.encode("utf-8")) % N_FAMILIES
+
+
+def spec_dims(spec: Any) -> List[int]:
+    """Every array dimension plus every positive static int in the spec
+    (the transposition surface predictive prewarm rewrites)."""
+    arrays: List[Tuple[Tuple[int, ...], str]] = []
+    scalars: List[Any] = []
+    args, kwargs = spec
+    for a in args:
+        _walk(a, arrays, scalars)
+    _walk(kwargs, arrays, scalars)
+    dims: List[int] = []
+    for shape, _dt in arrays:
+        dims.extend(shape)
+    for s in scalars:
+        if isinstance(s, bool):
+            continue
+        if isinstance(s, int) and s > 0:
+            dims.append(s)
+    return dims
+
+
+def feature_vector(name: str, spec: Any) -> np.ndarray:
+    """One (program, spec) pair as a ``DIM``-wide float32 vector.
+    Deterministic across processes — the table a restarted trainer
+    rebuilds from persisted labels is bit-identical."""
+    arrays: List[Tuple[Tuple[int, ...], str]] = []
+    scalars: List[Any] = []
+    args, kwargs = spec
+    for a in args:
+        _walk(a, arrays, scalars)
+    _walk(kwargs, arrays, scalars)
+
+    total_elems = 0
+    max_elems = 0
+    max_rank = 0
+    max_lead = 0
+    f32 = ints = bools = other = 0
+    for shape, dtype in arrays:
+        elems = 1
+        for d in shape:
+            elems *= max(1, int(d))
+        total_elems += elems
+        max_elems = max(max_elems, elems)
+        max_rank = max(max_rank, len(shape))
+        if shape:
+            max_lead = max(max_lead, int(shape[0]))
+        if dtype.startswith("float32"):
+            f32 += 1
+        elif dtype.startswith(("int", "uint")):
+            ints += 1
+        elif dtype.startswith("bool"):
+            bools += 1
+        else:
+            other += 1
+    n_arrays = len(arrays)
+    static_ints = [
+        s for s in scalars if isinstance(s, int) and not isinstance(s, bool)
+    ]
+    # largest pow2 dim >= 256: the capacity-bucket proxy (0 when none)
+    pow2_dims = [
+        d for d in spec_dims(spec) if d >= 256 and (d & (d - 1)) == 0
+    ]
+    vec = np.zeros(DIM, dtype=np.float32)
+    vec[0] = 1.0  # bias
+    vec[1] = _log2p(total_elems)
+    vec[2] = _log2p(max_elems)
+    vec[3] = float(n_arrays)
+    vec[4] = float(len(scalars))
+    vec[5] = float(max_rank)
+    vec[6] = _log2p(max_lead)
+    denom = float(max(1, n_arrays))
+    vec[7] = f32 / denom
+    vec[8] = ints / denom
+    vec[9] = bools / denom
+    vec[10] = _log2p(sum(abs(s) for s in static_ints))
+    vec[11] = _log2p(max(pow2_dims) if pow2_dims else 0)
+    vec[12 + family_slot(name)] = 1.0
+    return vec
+
+
+def feature_table(rows) -> np.ndarray:
+    """Stack ``(name, spec)`` pairs into an ``[N, DIM]`` float32 table."""
+    if not rows:
+        return np.zeros((0, DIM), dtype=np.float32)
+    return np.stack([feature_vector(name, spec) for name, spec in rows])

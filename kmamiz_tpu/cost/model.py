@@ -1,0 +1,185 @@
+"""graftcost regressor: a JAX-trained ridge head over the feature table.
+
+Two targets per (program, spec) row — ``log1p(compile_ms)`` and
+``log1p(run_ms)`` — fit jointly in closed form:
+
+    W = solve(XᵀX + λI, XᵀY)        X: [CAP, DIM]   Y: [CAP, 2]
+
+The fit is a registered jitted program (``cost.ridge_fit``) dispatched
+at a FIXED example capacity: rows are zero-padded (a zero row adds
+nothing to XᵀX or XᵀY), so continual retraining from the growing live
+registry re-runs one warm program forever — the fit itself can never
+become the compile stall it exists to predict. Training runs at fold
+boundaries / prewarm triggers, never on the warm tick; predictions are
+a host-side numpy dot against the last device-fetched ``W`` so the
+serving edge (TickRouter ordering, boot ranking) stays device-free.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from kmamiz_tpu.core import programs
+from kmamiz_tpu.cost import features
+
+#: ridge penalty — small: the table is tiny and well-conditioned by the
+#: bias + one-hot columns
+RIDGE_LAMBDA = 1e-3
+
+_DEFAULT_EXAMPLE_CAP = 256
+
+
+def example_cap() -> int:
+    """Fixed training-table rows (KMAMIZ_COST_EXAMPLES, pow2-clamped).
+    One shape forever = one compile forever."""
+    try:
+        cap = int(os.environ.get("KMAMIZ_COST_EXAMPLES", _DEFAULT_EXAMPLE_CAP))
+    except ValueError:
+        cap = _DEFAULT_EXAMPLE_CAP
+    cap = max(32, min(4096, cap))
+    # round up to pow2 so an env tweak still lands on a padded bucket
+    p = 32
+    while p < cap:
+        p <<= 1
+    return p
+
+
+def _build_ridge_fit():
+    import jax
+    import jax.numpy as jnp
+
+    @programs.register("cost.ridge_fit")
+    @jax.jit
+    def _ridge_fit(x, y):
+        xtx = x.T @ x + RIDGE_LAMBDA * jnp.eye(x.shape[1], dtype=x.dtype)
+        return jnp.linalg.solve(xtx, x.T @ y)
+
+    return _ridge_fit
+
+
+_ridge_fit_prog = _build_ridge_fit()
+
+
+class CostModel:
+    """Thread-safe continual regressor. ``fit`` swaps ``W`` under the
+    lock; ``predict*`` reads it with one lock-guarded copy."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._w: Optional[np.ndarray] = None  # [DIM, 2]
+        self.version = 0
+        self.examples = 0
+        self.mae_compile_ms = 0.0
+        self.mae_run_ms = 0.0
+
+    # -- training -----------------------------------------------------------
+    def fit(self, rows: List[Tuple[str, Any, float, float]]) -> dict:
+        """Train from ``(name, spec, compile_ms, run_ms)`` rows. Rows
+        beyond the fixed example cap keep the most recent (the registry
+        yields them in insertion order). Returns a report dict."""
+        import jax
+
+        cap = example_cap()
+        rows = rows[-cap:]
+        n = len(rows)
+        x = np.zeros((cap, features.DIM), dtype=np.float32)
+        y = np.zeros((cap, 2), dtype=np.float32)
+        for i, (name, spec, compile_ms, run_ms) in enumerate(rows):
+            x[i] = features.feature_vector(name, spec)
+            y[i, 0] = np.log1p(max(0.0, float(compile_ms)))
+            y[i, 1] = np.log1p(max(0.0, float(run_ms)))
+        # explicit transfers: the fold path may run under transfer_guard
+        w = np.asarray(
+            jax.device_get(  # graftlint: disable=host-sync-in-hot-path -- fold-boundary train fetch, off the warm tick
+                _ridge_fit_prog(jax.device_put(x), jax.device_put(y))
+            ),
+            dtype=np.float32,
+        )
+        pred = np.expm1(np.clip(x[:n] @ w, 0.0, 30.0))
+        actual = np.expm1(y[:n])
+        mae = (
+            np.abs(pred - actual).mean(axis=0)
+            if n
+            else np.zeros(2, dtype=np.float32)
+        )
+        with self._lock:
+            self._w = w
+            self.version += 1
+            self.examples = n
+            self.mae_compile_ms = float(mae[0])
+            self.mae_run_ms = float(mae[1])
+            return {
+                "version": self.version,
+                "examples": n,
+                "maeCompileMs": round(self.mae_compile_ms, 3),
+                "maeRunMs": round(self.mae_run_ms, 3),
+            }
+
+    # -- inference ----------------------------------------------------------
+    def _weights(self) -> Optional[np.ndarray]:
+        with self._lock:
+            return self._w
+
+    def trained(self) -> bool:
+        return self._weights() is not None
+
+    def predict(self, name: str, spec: Any) -> Optional[Tuple[float, float]]:
+        """(compile_ms, run_ms) prediction, or None before any fit."""
+        w = self._weights()
+        if w is None:
+            return None
+        out = np.expm1(
+            np.clip(features.feature_vector(name, spec) @ w, 0.0, 30.0)
+        )
+        return float(out[0]), float(out[1])
+
+    def predict_many(
+        self, pairs: List[Tuple[str, Any]]
+    ) -> Optional[np.ndarray]:
+        """[N, 2] (compile_ms, run_ms) predictions, or None untrained."""
+        w = self._weights()
+        if w is None or not pairs:
+            return None
+        x = features.feature_table(pairs)
+        return np.expm1(np.clip(x @ w, 0.0, 30.0))
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "trained": self._w is not None,
+                "version": self.version,
+                "examples": self.examples,
+                "maeCompileMs": round(self.mae_compile_ms, 3),
+                "maeRunMs": round(self.mae_run_ms, 3),
+            }
+
+
+def training_rows(
+    persisted: Optional[Dict[str, List[Tuple[Any, float, float]]]] = None,
+) -> List[Tuple[str, Any, float, float]]:
+    """The union of persisted label history (boot: satellite of the
+    shape-hint file) and the live registry's labels, persisted first so
+    live observations of the same spec win the recency cut."""
+    rows: List[Tuple[str, Any, float, float]] = []
+    seen = set()
+    live: List[Tuple[str, Any, float, float]] = []
+    for name, prog in sorted(programs.all_programs().items()):
+        for spec, compile_ms, run_ms in prog.labels():
+            live.append((name, spec, compile_ms, run_ms))
+    for name, labelled in sorted((persisted or {}).items()):
+        for spec, compile_ms, run_ms in labelled:
+            key = (name, repr(spec))
+            if key in seen:
+                continue
+            seen.add(key)
+            rows.append((name, spec, compile_ms, run_ms))
+    for name, spec, compile_ms, run_ms in live:
+        key = (name, repr(spec))
+        if key in seen:
+            continue
+        seen.add(key)
+        rows.append((name, spec, compile_ms, run_ms))
+    return rows

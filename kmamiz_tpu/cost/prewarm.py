@@ -1,0 +1,195 @@
+"""graftcost predictive prewarm: transpose warm specs to the next bucket.
+
+A capacity consolidation (graph/store.py segment mode: ``valid > main +
+tail``) re-runs the store-width-shaped programs at the next pow2 main
+capacity — a multi-program compile wall if those shapes are cold. The
+growth forecaster (tenancy/growth.py) predicts the crossing a few
+merges ahead; this module manufactures the post-crossing argument specs
+*from the registry's own warm specs* by dimension transposition:
+
+    mapping = {old_main: new_main, old_tail: new_tail,
+               old_main+old_tail: new_main+new_tail}
+
+Three rewrite rules cover the store's actual program shapes across a
+crossing:
+
+- **exact dims**: array dims and static ints equal to a mapping key
+  rewrite to its value (the flat column width every scorer and merge
+  kernel sees, the static ``cap``/``tail_cap`` of split_segments);
+- **flat delta** (``graph.`` family only): ``_merge_edges`` outputs are
+  exact row *sums* — flat store width + window block — so a dim
+  strictly greater than the old flat width shifts by ``new_flat -
+  old_flat`` (1280+TL -> 2304+TL). Only the graph family's widths
+  compose this way; model/scorer dims past the flat width are
+  unrelated and must not shift;
+- **statics-only**: the consolidation call itself runs the NEW static
+  cap against the OLD merged width (the union that produced it ran
+  against the old store), so each spec also transposes with arrays
+  untouched and only static scalars mapped.
+
+A transposed spec replays through the ordinary ``Program.prewarm_spec``
+zero-fill path, so the dispatch cache holds the post-crossing programs
+before the crossing lands. Warming a shape the store never reaches is
+harmless (wasted background compile, counted); missing one is a
+mid-tick stall — which is why the scenario gate counts per-tick compile
+deltas, not intentions.
+"""
+from __future__ import annotations
+
+import json
+import logging
+from typing import Any, Dict, List, Optional, Tuple
+
+from kmamiz_tpu.core import programs
+
+logger = logging.getLogger("kmamiz_tpu.cost.prewarm")
+
+#: programs whose argument widths compose additively from the store's
+#: flat width (merge-output consumers) — the flat-delta rule's scope
+GRAPH_FAMILY = "graph."
+
+
+def growth_mapping(
+    old_main: int, old_tail: int, new_main: int, new_tail: int
+) -> Dict[int, int]:
+    """The exact-dimension rewrite for one predicted consolidation.
+    Identity entries are dropped (a tail that stays 256 wide must not
+    rewrite every unrelated 256)."""
+    mapping = {
+        old_main: new_main,
+        old_tail: new_tail,
+        old_main + old_tail: new_main + new_tail,
+    }
+    return {k: v for k, v in mapping.items() if k != v and k > 0}
+
+
+def transpose_spec(
+    spec: Any,
+    mapping: Dict[int, int],
+    delta: Optional[Tuple[int, int]] = None,
+    statics_only: bool = False,
+) -> Any:
+    """Rewrite one encoded spec (the ``programs._encode`` grammar).
+    ``delta=(old_flat, new_flat)`` shifts array dims strictly greater
+    than ``old_flat`` by the flat growth (merge-output sums);
+    ``statics_only`` leaves arrays untouched and maps static ints only
+    (the consolidation-call variant). Pure."""
+    old_flat, shift = (delta[0], delta[1] - delta[0]) if delta else (0, 0)
+
+    def dim(d: int) -> int:
+        if d in mapping:
+            return mapping[d]
+        if shift and d > old_flat:
+            return d + shift
+        return d
+
+    def tr(node: Any) -> Any:
+        if isinstance(node, bool) or node is None or isinstance(
+            node, (float, str)
+        ):
+            return node
+        if isinstance(node, int):
+            return mapping.get(node, node)
+        if isinstance(node, list):
+            return [tr(v) for v in node]
+        if isinstance(node, dict):
+            if "__arr__" in node:
+                if statics_only:
+                    return node
+                shape, dtype, weak = node["__arr__"]
+                return {
+                    "__arr__": [[dim(int(d)) for d in shape], dtype, weak]
+                }
+            if "__tuple__" in node:
+                return {"__tuple__": [tr(v) for v in node["__tuple__"]]}
+            if "__nt__" in node:
+                return {"__nt__": node["__nt__"], "items": [tr(v) for v in node["items"]]}
+            return {k: tr(v) for k, v in node.items()}
+        return node
+
+    args, kwargs = spec
+    return ([tr(a) for a in args], {k: tr(v) for k, v in kwargs.items()})
+
+
+def predictive_pairs(
+    mapping: Dict[int, int], delta: Optional[Tuple[int, int]] = None
+) -> List[Tuple[str, Any]]:
+    """Every (program, transposed spec) the rules change, deduped — the
+    prewarm plan for one predicted crossing. Graph-family specs yield up
+    to two variants each (full transpose for post-crossing steady
+    state, statics-only for the consolidation call itself)."""
+    if not mapping:
+        return []
+    out: List[Tuple[str, Any]] = []
+    seen = set()
+
+    def add(name: str, warped: Any, original: Any) -> None:
+        if warped == original:
+            return
+        key = (name, json.dumps(warped, sort_keys=True))
+        if key in seen:
+            return
+        seen.add(key)
+        out.append((name, warped))
+
+    for name, prog in sorted(programs.all_programs().items()):
+        in_family = name.startswith(GRAPH_FAMILY)
+        for spec in prog.specs():
+            add(
+                name,
+                transpose_spec(
+                    spec, mapping, delta=delta if in_family else None
+                ),
+                spec,
+            )
+            if in_family:
+                add(
+                    name,
+                    transpose_spec(spec, mapping, statics_only=True),
+                    spec,
+                )
+    return out
+
+
+def rank_by_predicted_compile(
+    pairs: List[Tuple[str, Any]],
+    model,
+    labels: Optional[Dict[str, List[Tuple[Any, float, float]]]] = None,
+) -> List[Tuple[str, Any]]:
+    """Longest-predicted-compile-first ordering (the boot-ranking
+    consumer). Falls back to observed compile labels, then to the
+    stable name order, so ranking never blocks a cold boot."""
+    if not pairs:
+        return pairs
+    preds = model.predict_many(pairs) if model is not None else None
+    by_label: Dict[str, float] = {}
+    for name, labelled in (labels or {}).items():
+        for _spec, compile_ms, _run_ms in labelled:
+            by_label[name] = max(by_label.get(name, 0.0), float(compile_ms))
+
+    def score(i: int) -> float:
+        if preds is not None:
+            return float(preds[i, 0])
+        return by_label.get(pairs[i][0], 0.0)
+
+    order = sorted(
+        range(len(pairs)), key=lambda i: (-score(i), pairs[i][0], i)
+    )
+    return [pairs[i] for i in order]
+
+
+def execute(pairs: List[Tuple[str, Any]]) -> Tuple[int, int]:
+    """Replay the plan through ``Program.prewarm_spec``; returns
+    (warmed, failed). Runs off the tick — on the graftcost background
+    thread or between harness ticks in sync mode."""
+    warmed = failed = 0
+    for name, spec in pairs:
+        prog = programs.get(name)
+        if prog is None:
+            failed += 1
+            continue
+        if prog.prewarm_spec(spec):
+            warmed += 1
+        else:
+            failed += 1
+    return warmed, failed

@@ -15,6 +15,7 @@ The reference's combineWith overwrites same-window duplicate records
 """
 from __future__ import annotations
 
+import logging
 import os
 import threading
 from functools import partial
@@ -47,6 +48,8 @@ from kmamiz_tpu.ops.sortutil import (
     compact_unique,
     compact_unique_edges_packed,
 )
+
+logger = logging.getLogger("kmamiz_tpu.graph.store")
 
 
 @programs.register("graph.edge_mask")
@@ -993,6 +996,7 @@ class EndpointGraph:
             # that pins the invariant
             cap = _pow2(int(self._src.shape[0]))
             tail_cap = _pow2(int(self._tail[0].shape[0]))
+            old_cap, old_tail = cap, tail_cap
             if valid_count > cap + tail_cap:
                 # tail exhausted: consolidate into the next pow2 main —
                 # the one recompiling event of segment mode (rare and
@@ -1000,6 +1004,7 @@ class EndpointGraph:
                 # at least a doubling, so capacity stays monotone)
                 cap = _pow2(valid_count)
                 tail_cap = self._tail_cap(cap)
+            self._note_growth(valid_count, old_cap, old_tail, cap, tail_cap)
             out = _split_segments(src, dst, dist, cap=cap, tail_cap=tail_cap)
             self._src, self._dst, self._dist = out[:3]
             self._tail = out[3:]
@@ -1014,6 +1019,26 @@ class EndpointGraph:
                 src, dst, dist, cap=new_cap
             )
         self._n_edges = valid_count
+
+    def _note_growth(
+        self, valid: int, old_cap: int, old_tail: int, cap: int, tail_cap: int
+    ) -> None:
+        """graftcost hook (segment mode only): every finalized merge
+        feeds the per-tenant growth forecaster with the valid count the
+        capacity policy already fetched, and a consolidation reports
+        whether predictive prewarm warmed the target bucket first. Env-
+        gated lazy import, swallow-all: the cost plane observes the
+        store, never steers it — and never holds it up."""
+        try:
+            from kmamiz_tpu import cost as _cost
+
+            if not _cost.enabled():
+                return
+            _cost.observe_merge(self.tenant, valid, old_cap, old_tail)
+            if cap != old_cap or tail_cap != old_tail:
+                _cost.note_capacity_change(self.tenant, old_cap, cap, tail_cap)
+        except Exception:  # noqa: BLE001 - observers must not break merges
+            logger.exception("growth-note hook failed")
 
     def _base_edge_cols(self):
         """Starting columns for a union: the pre-union result when one

@@ -3,12 +3,12 @@
 A *scenario* is one closed-loop soak specification: per-tenant sampled
 topologies (:mod:`.topology`), traffic curves (:mod:`.traffic`), and a
 failure storyline (:mod:`.storyline`), all drawn from one integer seed.
-The seven archetypes cover the production failure space the resilience
-and tenancy layers were built for; a matrix of size N instantiates the
-first N archetypes (cycling with fresh seeds past seven), and the
-ordering guarantees any matrix of ≥ 4 contains the cascade,
-multi-tenant, and kill-9/WAL-replay scenarios the acceptance gate
-requires.
+The eight archetypes cover the production failure space the resilience,
+tenancy, and cost layers were built for; a matrix of size N
+instantiates the first N archetypes (cycling with fresh seeds past
+eight), and the ordering guarantees any matrix of ≥ 4 contains the
+cascade, multi-tenant, and kill-9/WAL-replay scenarios the acceptance
+gate requires.
 
 Everything random happens here, at compose time. ``spec_signature``
 hashes the complete composed content (topology canonical YAML digests,
@@ -51,6 +51,12 @@ ARCHETYPES: Tuple[Tuple[str, Tuple[Tuple[str, str, str, Tuple[str, ...]], ...]],
     (
         "rolling-deploy-mesh",
         (("default", "mesh", "ramp", ("rolling-deploy", "tick-stall")),),
+    ),
+    # appended (never reordered): the bench matrix (first 3) and the
+    # acceptance minimum (first 6) keep their archetype sets
+    (
+        "capacity-growth-chain",
+        (("default", "chain", "steady", ("capacity-growth",)),),
     ),
 )
 
@@ -147,7 +153,7 @@ def scenario_matrix(
     size: Optional[int] = None,
     n_ticks: Optional[int] = None,
 ) -> Tuple[ScenarioSpec, ...]:
-    """The seeded matrix: archetype ``i % 7`` at index ``i``. Defaults
+    """The seeded matrix: archetype ``i % 8`` at index ``i``. Defaults
     come from the ``KMAMIZ_SCENARIO_*`` env knobs."""
     seed = default_seed() if seed is None else seed
     size = default_matrix_size() if size is None else size

@@ -25,7 +25,12 @@ A storyline is a tuple of :class:`Event` records, each active over a
   deadline (stale serve, straggler merges late, recovery measured);
 - ``kill9-replay``   — the run crashes (SIGKILL between WAL append and
   merge) at the event tick and restarts, replaying the ingest WAL
-  bit-exact before the soak continues.
+  bit-exact before the soak continues;
+- ``capacity-growth`` — one tenant's endpoint count ramps linearly
+  across its edge store's segment-consolidation threshold mid-soak
+  (unique ``/grow/<k>`` endpoints per tick), exercising graftcost's
+  predictive prewarm: the gate demands zero mid-tick compiles at the
+  crossing with prewarm on.
 
 Events are fully resolved at compose time (all RNG draws happen here),
 so a storyline replays identically however the runner's wall clock
@@ -43,7 +48,12 @@ from typing import Dict, List, Tuple
 import numpy as np
 
 from kmamiz_tpu.resilience.chaos import FaultPlan, mutate_payload
-from kmamiz_tpu.scenarios.topology import Topology, downstream_of, entry_services
+from kmamiz_tpu.scenarios.topology import (
+    BASE_TIMESTAMP_US,
+    Topology,
+    downstream_of,
+    entry_services,
+)
 from kmamiz_tpu.simulator import faults as sim_faults
 from kmamiz_tpu.simulator import naming
 from kmamiz_tpu.simulator.overload import estimate_error_rate_with_overload
@@ -57,6 +67,7 @@ STORYLINE_KINDS = (
     "upstream-flap",
     "tick-stall",
     "kill9-replay",
+    "capacity-growth",
 )
 
 #: downstream services whose overload-modeled error rate crosses this
@@ -280,6 +291,131 @@ def compose_kill9(
     return Event("kill9-replay", at, 1)
 
 
+# -- capacity growth (graftcost predictive-prewarm gate) ----------------------
+
+#: unique growth endpoints over the ramp — enough to push the default
+#: 1024-main + 256-tail edge store past its consolidation threshold
+#: (1280) from a small base mesh, with headroom
+GROWTH_TOTAL_ENDPOINTS = 1500
+
+
+def compose_capacity_growth(
+    topo: Topology, rng: random.Random, n_ticks: int
+) -> Event:
+    """Ramp one tenant across a capacity-bucket boundary mid-soak: every
+    ramp tick emits ``per_tick`` 2-span traces entry -> ``/grow/<k>``
+    with monotonically increasing ``k`` — each unique k is one new
+    endpoint and one new edge. The ramp ends two ticks before the soak
+    does, so the post-crossing steady state is measured too."""
+    entry = entry_services(topo)[0]
+    others = [s for s in topo.services if s != entry]
+    grow_svc = rng.choice(others or [entry])
+    at = 1
+    duration = max(2, n_ticks - 3)
+    per_tick = -(-GROWTH_TOTAL_ENDPOINTS // duration)
+    return Event(
+        "capacity-growth", at, duration, params=(entry, grow_svc, per_tick)
+    )
+
+
+def _growth_span(
+    topo: Topology,
+    trace_id: str,
+    span_id: str,
+    parent_id,
+    svc: str,
+    url_path: str,
+    ts_us: int,
+) -> dict:
+    """topology._span with an explicit URL path — growth endpoints live
+    outside the ``/api/<u>`` grid the sampler enumerates."""
+    host = f"{svc}.{topo.namespace}.svc.cluster.local"
+    return {
+        "traceId": trace_id,
+        "id": span_id,
+        "parentId": parent_id,
+        "kind": "SERVER",
+        "name": f"{host}:80/*",
+        "timestamp": ts_us,
+        "duration": 1_000,
+        "tags": {
+            "http.method": "GET",
+            "http.status_code": "200",
+            "http.url": f"http://{host}{url_path}",
+            "istio.canonical_revision": "v1",
+            "istio.canonical_service": svc,
+            "istio.mesh_id": "cluster.local",
+            "istio.namespace": topo.namespace,
+        },
+    }
+
+
+def _growth_pair(
+    event: Event, topo: Topology, trace_id: str, url_path: str, ts_us: int
+) -> List[dict]:
+    entry, grow_svc, _per_tick = event.params
+    root = _growth_span(
+        topo, trace_id, f"{trace_id}-0", None, entry, "/api/0", ts_us
+    )
+    leaf = _growth_span(
+        topo, trace_id, f"{trace_id}-1", f"{trace_id}-0", grow_svc, url_path,
+        ts_us + 1,
+    )
+    return [root, leaf]
+
+
+def growth_groups(
+    event: Event, topo: Topology, prefix: str, tick: int
+) -> List[List[dict]]:
+    """The ramp's trace groups at ``tick``: ``per_tick`` 2-span chains
+    entry ``/api/0`` -> grow-svc ``/grow/<k>``, ``k`` strictly
+    increasing across the ramp. Pure (tick, index) arithmetic — no
+    runtime RNG, so recovery re-posts are idempotent like every other
+    scenario window."""
+    if event.kind != "capacity-growth" or not event.active(tick):
+        return []
+    _entry, _grow_svc, per_tick = event.params
+    base = (tick - event.at_tick) * per_tick
+    ts0 = BASE_TIMESTAMP_US + tick * 1_000_000
+    return [
+        _growth_pair(
+            event,
+            topo,
+            f"{prefix}-g{base + j}",
+            f"/grow/{base + j}",
+            ts0 + j * 10,
+        )
+        for j in range(per_tick)
+    ]
+
+
+def growth_twin_groups(
+    event: Event, topo: Topology, prefix: str, tick: int
+) -> List[List[dict]]:
+    """Shape twins for the window rehearsal: the same group-length
+    multiset AND the same count of brand-new edges as
+    :func:`growth_groups` — the merge kernels bucket on the window's
+    new-unique-edge count, not just span shape, so the twins must mint
+    ``per_tick`` fresh ``/warm/<tick>-<j>`` endpoints of their own.
+    That spends a few hundred capacity rows pre-snapshot (far under the
+    consolidation threshold), leaving the measured soak to perform the
+    actual crossing against fully compiled buckets."""
+    if event.kind != "capacity-growth" or not event.active(tick):
+        return []
+    _entry, _grow_svc, per_tick = event.params
+    ts0 = BASE_TIMESTAMP_US + tick * 1_000_000 + 500_000
+    return [
+        _growth_pair(
+            event,
+            topo,
+            f"{prefix}-gt{tick}-{j}",
+            f"/warm/{tick}-{j}",
+            ts0 + j * 10,
+        )
+        for j in range(per_tick)
+    ]
+
+
 _COMPOSERS = {
     "cascade": compose_cascade,
     "partial-outage": compose_partial_outage,
@@ -288,6 +424,7 @@ _COMPOSERS = {
     "upstream-flap": compose_upstream_flap,
     "tick-stall": compose_tick_stall,
     "kill9-replay": compose_kill9,
+    "capacity-growth": compose_capacity_growth,
 }
 
 

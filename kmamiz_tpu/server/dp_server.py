@@ -24,6 +24,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from kmamiz_tpu import control as ctl_plane
+from kmamiz_tpu import cost as cost_plane
 from kmamiz_tpu.analysis import guards
 from kmamiz_tpu.core import programs
 from kmamiz_tpu.resilience import metrics as res_metrics
@@ -273,6 +274,7 @@ def make_handler(processor: DataProcessor, router=None):
                         "tenancy": router.summary(),
                         "tenants": tel_slo.TENANTS.snapshot(),
                         "control": ctl_plane.snapshot(),
+                        "cost": cost_plane.snapshot(),
                     },
                 )
                 return

@@ -718,6 +718,17 @@ class DataProcessor:
             _control.on_fold(self.tenant, self.forecast_snapshot)
         except Exception:
             res_metrics.incr("controlFoldErrors")
+        # graftcost continual retrain (KMAMIZ_COST=1, docs/COST_MODEL.md):
+        # refit the program-cost regressor from the registry's label rows
+        # at the fold boundary. The fit is one fixed-shape warm program
+        # (cost/model.py), so this is a bounded off-tick cost — and the
+        # same containment posture as the two hooks above.
+        try:
+            from kmamiz_tpu import cost as _cost
+
+            _cost.on_fold(self.tenant)
+        except Exception:
+            res_metrics.incr("costFoldErrors")
 
     # -- history persistence (VERDICT r4 #4) ---------------------------------
 

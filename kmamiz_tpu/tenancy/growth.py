@@ -1,0 +1,133 @@
+"""Per-tenant arena growth forecaster (graftcost input plane).
+
+Every finalized merge reports its host-fetched ``valid_count`` (the
+store already pays that one scalar fetch for the capacity policy), so
+growth tracking is free: a bounded ring of ``(valid, main, tail)``
+observations per tenant and a linear edges-per-merge slope over the
+ring's window. ``forecast`` answers the only question predictive
+prewarm asks: *how many merges until this tenant's valid count crosses
+main + tail* (the segment-mode consolidation threshold — the one
+recompiling event of capacity growth), and what (main, tail) the store
+will consolidate to when it does (the ``_pow2``/tail-shift policy from
+graph/store.py, mirrored here so the prewarm plan targets the exact
+shapes ``_apply_merged`` will pick).
+
+Pure host arithmetic under one lock; no JAX, no clocks, no env reads —
+the caller (kmamiz_tpu.cost) owns gating and policy.
+"""
+from __future__ import annotations
+
+import threading
+from collections import deque
+from dataclasses import dataclass
+from typing import Deque, Dict, Optional, Tuple
+
+#: observations kept per tenant (merges, not ticks — one per finalize)
+WINDOW = 16
+
+#: minimum observations before a slope is trusted
+MIN_POINTS = 2
+
+
+def _pow2(n: int, minimum: int = 1) -> int:
+    p = max(1, minimum)
+    while p < n:
+        p <<= 1
+    return p
+
+
+def tail_rows(main_cap: int, tail_shift: int) -> int:
+    """graph/store.py's tail policy: ``max(256, main >> shift)``."""
+    return max(256, main_cap >> max(0, tail_shift))
+
+
+@dataclass(frozen=True)
+class GrowthForecast:
+    """One tenant's projected consolidation."""
+
+    tenant: str
+    valid: int
+    slope_per_merge: float
+    main: int  # current main-segment capacity
+    tail: int  # current tail rows
+    threshold: int  # main + tail: crossing this consolidates
+    merges_to_crossing: Optional[int]  # None: flat or shrinking
+    new_main: int
+    new_tail: int
+
+    def imminent(self, horizon_merges: int) -> bool:
+        return (
+            self.merges_to_crossing is not None
+            and self.merges_to_crossing <= max(1, horizon_merges)
+        )
+
+
+class GrowthTracker:
+    """Lock-guarded per-tenant observation rings."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._rings: Dict[str, Deque[Tuple[int, int, int]]] = {}
+
+    def observe(
+        self, tenant: str, valid: int, main_cap: int, tail_cap: int
+    ) -> None:
+        with self._lock:
+            ring = self._rings.get(tenant)
+            if ring is None:
+                ring = deque(maxlen=WINDOW)
+                self._rings[tenant] = ring
+            ring.append((int(valid), int(main_cap), int(tail_cap)))
+
+    def forecast(
+        self, tenant: str, tail_shift: int = 3
+    ) -> Optional[GrowthForecast]:
+        with self._lock:
+            ring = self._rings.get(tenant)
+            if ring is None or len(ring) < MIN_POINTS:
+                return None
+            points = list(ring)
+        valid, main_cap, tail_cap = points[-1]
+        first_valid = points[0][0]
+        slope = (valid - first_valid) / max(1, len(points) - 1)
+        threshold = main_cap + tail_cap
+        merges: Optional[int] = None
+        if valid > threshold:
+            merges = 0
+        elif slope > 0.0:
+            merges = max(1, int((threshold + 1 - valid) / slope + 0.999))
+        # the consolidation policy's exact target: _pow2 of the first
+        # over-threshold valid count, tail re-derived from the new main
+        projected = max(threshold + 1, valid + int(slope + 0.5))
+        new_main = _pow2(projected, minimum=main_cap)
+        new_tail = tail_rows(new_main, tail_shift)
+        return GrowthForecast(
+            tenant=tenant,
+            valid=valid,
+            slope_per_merge=round(slope, 3),
+            main=main_cap,
+            tail=tail_cap,
+            threshold=threshold,
+            merges_to_crossing=merges,
+            new_main=new_main,
+            new_tail=new_tail,
+        )
+
+    def tenants(self) -> Tuple[str, ...]:
+        with self._lock:
+            return tuple(sorted(self._rings))
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                t: {
+                    "points": len(ring),
+                    "valid": ring[-1][0],
+                    "threshold": ring[-1][1] + ring[-1][2],
+                }
+                for t, ring in sorted(self._rings.items())
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._rings.clear()

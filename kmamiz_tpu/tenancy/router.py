@@ -360,9 +360,20 @@ class TickRouter:
                 from kmamiz_tpu import control
 
                 if control.enabled():
+                    costs = dict(control.predicted_costs())
+                    # graftcost lever: the learned per-tenant run-cost
+                    # table (predicted warm ms of the tenant's bucket-
+                    # width programs) fills tenants graftpilot has no
+                    # forecast for yet; a graftpilot forecast, being
+                    # live-observed, wins on overlap.
+                    from kmamiz_tpu import cost as graftcost
+
+                    if graftcost.enabled():
+                        for t, ms in graftcost.predicted_tenant_costs().items():
+                            costs.setdefault(t, ms)
                     batch = control.policy.order_batch(
                         batch,
-                        control.predicted_costs(),
+                        costs,
                         lambda it: it.tenant,
                     )
             try:

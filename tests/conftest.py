@@ -56,7 +56,7 @@ def _reset_resilience_state():
     breakers, counters, the default quarantine binding). A breaker a
     test trips must not short-circuit the next test's upstream calls, so
     every test starts from a clean slate."""
-    from kmamiz_tpu import control, scenarios, telemetry, tenancy
+    from kmamiz_tpu import control, cost, scenarios, telemetry, tenancy
     from kmamiz_tpu.models import stlgt
     from kmamiz_tpu.ops import sparse
     from kmamiz_tpu.resilience import breaker, metrics, quarantine
@@ -69,6 +69,7 @@ def _reset_resilience_state():
     scenarios.reset_for_tests()
     stlgt.reset_for_tests()
     control.reset_for_tests()
+    cost.reset_for_tests()
     # the sparse backend knob is cached after first read; a test that
     # monkeypatches KMAMIZ_SPARSE* must not leak its choice forward
     sparse.reset_for_tests()

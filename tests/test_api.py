@@ -1371,7 +1371,13 @@ class TestRouterHttpSemantics:
                 b"Transfer-Encoding: chunked\r\n\r\n"
                 b"5\r\nhello\r\n3\r\nabc\r\n0\r\n\r\n"
             )
-            assert b'"len": 8' in s.recv(65536)
+            # headers and body may land in separate TCP segments
+            s.settimeout(5)
+            got = b""
+            while b'"len": 8' not in got:
+                chunk = s.recv(65536)
+                assert chunk, f"connection closed early: {got!r}"
+                got += chunk
             s.close()
         finally:
             srv.stop()

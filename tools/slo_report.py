@@ -68,6 +68,12 @@ _EXTRA_GATED = (
     # 3% of dp_tick — bench asserts the ratio, this gates the drift)
     "control_decision_ms",
     "control_tick_overhead_ms",
+    # graftcost crossing pair (ROADMAP item 6): the segment crossing
+    # wall on a warm store and the prewarm-ON consolidation stall (the
+    # A/B's treated arm — it must stay at steady-merge cost, not drift
+    # back toward the OFF arm's compile wall)
+    "graph_capacity_grow_ms",
+    "capacity_growth_stall_ms",
 )
 # boolean pass/fail keys: any True -> False flip is a regression (bool
 # is an int subclass, so the numeric threshold check would wave a
@@ -78,7 +84,14 @@ _BOOL_GATED = ("scenario_matrix_pass", "graph_refresh_pass")
 # stlgt_p99_coverage is a [0,1] calibration rate where relative
 # thresholds are meaningless near 1.0 — the gate is absolute: new below
 # old minus the slack regresses
-_FLOOR_GATED = ("stlgt_p99_coverage", "control_counterfactual_prevented")
+_FLOOR_GATED = (
+    "stlgt_p99_coverage",
+    "control_counterfactual_prevented",
+    # predictive-prewarm hit rate over the bench A/B's consolidations:
+    # a collapse to cold crossings must fail the round even though the
+    # numeric check would read 1.0 -> 0.0 as an improvement
+    "cost_prewarm_hit_rate",
+)
 _ABS_SLACK_FLOOR = 0.02
 # absolute slack per key class: rates jitter in the 3rd decimal on tiny
 # denominators, recompile counts are integers, latencies get 0.5 ms

@@ -43,6 +43,11 @@ DEFAULT_SEED_MODULES = (
     # module itself so the hot-path rules see its helpers even when the
     # consumer dispatch is behind the KMAMIZ_SPARSE knob
     "kmamiz_tpu/ops/sparse.py",
+    # graftstream: the micro-tick engine's produce/consume loops run
+    # every prepared window through prepare/merge/finish — hot by seed
+    # so the hot-path rules reach it even though the dispatch sits
+    # behind the KMAMIZ_STREAM knob
+    "kmamiz_tpu/server/stream.py",
 )
 
 

@@ -203,8 +203,8 @@ class Settings:
         )
     )  # matrix seed: one integer composes every topology/traffic/storyline
     scenario_matrix: int = field(
-        default_factory=lambda: int(os.environ.get("KMAMIZ_SCENARIO_MATRIX", "8"))
-    )  # matrix size; archetype i % 8 at index i
+        default_factory=lambda: int(os.environ.get("KMAMIZ_SCENARIO_MATRIX", "9"))
+    )  # matrix size; archetype i % len(ARCHETYPES) at index i
     scenario_ticks: int = field(
         default_factory=lambda: int(os.environ.get("KMAMIZ_SCENARIO_TICKS", "10"))
     )  # soak length per scenario, in DP ticks
@@ -339,6 +339,23 @@ class Settings:
     cost_examples: int = field(
         default_factory=lambda: int(os.environ.get("KMAMIZ_COST_EXAMPLES", "256"))
     )  # fixed ridge-fit table rows (pow2-clamped 32..4096; one shape = one compile)
+
+    # graftstream micro-tick pipeline (kmamiz_tpu/server/stream.py, the
+    # "Streaming micro-ticks" section of docs/TICK_PIPELINE.md). The
+    # stream engine reads these env vars directly on the hot path; the
+    # fields mirror them so one `Settings()` dump shows everything.
+    stream_enabled: bool = field(
+        default_factory=lambda: os.environ.get("KMAMIZ_STREAM", "0")
+        not in ("0", "false", "")
+    )  # overlapped micro-tick engine (default OFF: serial parity reference)
+    stream_depth: int = field(
+        default_factory=lambda: int(os.environ.get("KMAMIZ_STREAM_DEPTH", "2"))
+    )  # prepared-tick hand-off queue bound (clamped 1..8)
+    stream_epoch_ticks: int = field(
+        default_factory=lambda: int(
+            os.environ.get("KMAMIZ_STREAM_EPOCH_TICKS", "32")
+        )
+    )  # micro-ticks per watchdog deadline-cache epoch (floor 1)
 
     def __post_init__(self) -> None:
         k8s_host = os.environ.get("KUBERNETES_SERVICE_HOST")

@@ -958,6 +958,23 @@ class EndpointGraph:
         with self._lock:
             self._finalize_pending_locked()
 
+    def stage_fence(self) -> dict:
+        """Explicit stage hand-off fence for the micro-tick stream engine
+        (server/stream.py): retire every in-flight upload and resolve any
+        deferred merge BEFORE the score/serve stage reads the graph,
+        while the next window's prepare stage is already parsing on the
+        native shards. This is the same fence `_finalize_pending` applies
+        lazily at read time — naming it keeps the merge->score hand-off
+        auditable (and counted in upload stats) instead of implicit.
+        Returns a small snapshot for the engine's stage accounting."""
+        with self._lock:
+            self._uploads.note_fence()
+            self._finalize_pending_locked()
+            return {
+                "version": self._version,
+                "in_flight": self._uploads.stats()["in_flight"],
+            }
+
     def _finalize_pending_locked(self) -> None:
         # retire any still-streaming uploads first: this IS the read
         # fence the pipeline defers its waits to (in steady state the

@@ -62,6 +62,15 @@ class UploadPipeline:
         self.uploads = 0
         self.blocked_ms = 0.0
         self.peak_in_flight = 0
+        # stage hand-off fences noted by the stream engine (graftstream):
+        # each one is a merge->score boundary that drained this pipeline
+        self.fences = 0
+
+    def note_fence(self) -> None:
+        """Count one explicit stage hand-off fence (GraphStore
+        stage_fence); the drain itself is the caller's, this only keeps
+        the pipelining observable in stats()."""
+        self.fences += 1
 
     def put(self, host_arrays, sharding=None):
         """Issue one group of device_puts; returns (device_arrays,
@@ -107,10 +116,22 @@ class UploadPipeline:
         return waited
 
     def stats(self) -> dict:
+        # depth 0 is the legacy synchronous mode: put() blocks inline and
+        # never accounts blocked_ms, so per-upload stall rates are only
+        # meaningful when pipelined — report the mode explicitly and keep
+        # every derived rate guarded (uploads can be 0 on a fresh store)
+        pipelined = self.depth > 0
         return {
             "depth": self.depth,
+            "mode": "pipelined" if pipelined else "sync",
             "uploads": self.uploads,
             "in_flight": len(self._in_flight),
             "peak_in_flight": self.peak_in_flight,
             "blocked_ms": round(self.blocked_ms, 1),
+            "fences": self.fences,
+            "blocked_ms_per_upload": (
+                round(self.blocked_ms / self.uploads, 3)
+                if pipelined and self.uploads
+                else 0.0
+            ),
         }

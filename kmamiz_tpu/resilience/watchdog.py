@@ -61,6 +61,13 @@ class TickWatchdog:
         # None -> consult the env on every run, so a live server honors
         # KMAMIZ_TICK_DEADLINE_MS changes without a restart
         self._deadline_ms = deadline_ms
+        # stream-epoch cache: micro-ticks make the per-run env re-read
+        # hot (thousands of getenv+float parses per second), so the
+        # stream engine brackets each epoch with begin/end_stream_epoch
+        # and runs against one cached parse. A mid-stream env change
+        # still lands — at the next epoch boundary, which is the
+        # granularity the knob meaningfully has under streaming.
+        self._epoch_deadline_ms: Optional[float] = None
         self._on_late_result = on_late_result
         self._lock = threading.Lock()
         # in_flight: a worker thread is still executing a tick.
@@ -71,20 +78,41 @@ class TickWatchdog:
 
     @property
     def deadline_ms(self) -> float:
-        return (
-            self._deadline_ms
-            if self._deadline_ms is not None
-            else deadline_ms_from_env()
-        )
+        if self._deadline_ms is not None:  # ctor pin wins outright
+            return self._deadline_ms
+        epoch = self._epoch_deadline_ms
+        if epoch is not None:  # inside a stream epoch: the cached parse
+            return epoch
+        return deadline_ms_from_env()
+
+    def begin_stream_epoch(self) -> float:
+        """Cache the KMAMIZ_TICK_DEADLINE_MS parse for one stream epoch;
+        returns the cached value. Idempotent per epoch boundary — each
+        call re-reads the env, so calling it again IS the next epoch."""
+        with self._lock:
+            self._epoch_deadline_ms = deadline_ms_from_env()
+            return self._epoch_deadline_ms
+
+    def end_stream_epoch(self) -> None:
+        """Drop the epoch cache: back to per-run env reads."""
+        with self._lock:
+            self._epoch_deadline_ms = None
 
     @property
     def enabled(self) -> bool:
         return self.deadline_ms > 0
 
-    def run(self, fn: Callable[[], object]) -> object:
+    def run(
+        self,
+        fn: Callable[[], object],
+        overrun_reason: Optional[str] = None,
+    ) -> object:
         """Run fn under the deadline. Returns fn's result, re-raises
         fn's exception, or raises TickDeadlineExceeded on overrun /
-        straggler overlap."""
+        straggler overlap. `overrun_reason` renames the genuine-overrun
+        trip (the stream engine passes ``stream-overrun`` so the stale
+        payload says which mode degraded); straggler overlap always
+        reports ``tick-in-flight``."""
         deadline_ms = self.deadline_ms
         if deadline_ms <= 0:
             return fn()
@@ -141,5 +169,6 @@ class TickWatchdog:
             if box["error"] is not None:
                 raise box["error"]
             return box["result"]
-        metrics.watchdog_tripped(REASON_DEADLINE)
-        raise TickDeadlineExceeded(REASON_DEADLINE, deadline_ms)
+        reason = overrun_reason or REASON_DEADLINE
+        metrics.watchdog_tripped(reason)
+        raise TickDeadlineExceeded(reason, deadline_ms)

@@ -3,10 +3,10 @@
 A *scenario* is one closed-loop soak specification: per-tenant sampled
 topologies (:mod:`.topology`), traffic curves (:mod:`.traffic`), and a
 failure storyline (:mod:`.storyline`), all drawn from one integer seed.
-The eight archetypes cover the production failure space the resilience,
-tenancy, and cost layers were built for; a matrix of size N
-instantiates the first N archetypes (cycling with fresh seeds past
-eight), and the ordering guarantees any matrix of ≥ 4 contains the
+The nine archetypes cover the production failure space the resilience,
+tenancy, cost, and streaming layers were built for; a matrix of size N
+instantiates the first N archetypes (cycling with fresh seeds past the
+vocabulary), and the ordering guarantees any matrix of ≥ 4 contains the
 cascade, multi-tenant, and kill-9/WAL-replay scenarios the acceptance
 gate requires.
 
@@ -57,6 +57,13 @@ ARCHETYPES: Tuple[Tuple[str, Tuple[Tuple[str, str, str, Tuple[str, ...]], ...]],
     (
         "capacity-growth-chain",
         (("default", "chain", "steady", ("capacity-growth",)),),
+    ),
+    # graftstream soak: a bursty fanout under the micro-tick engine with
+    # a mid-stream tick stall, so the matrix exercises the freshness SLO
+    # AND its degraded mode (watchdog -> last-good stale serve)
+    (
+        "streaming-freshness",
+        (("default", "fanout", "burst", ("tick-stall",)),),
     ),
 )
 
@@ -153,8 +160,8 @@ def scenario_matrix(
     size: Optional[int] = None,
     n_ticks: Optional[int] = None,
 ) -> Tuple[ScenarioSpec, ...]:
-    """The seeded matrix: archetype ``i % 8`` at index ``i``. Defaults
-    come from the ``KMAMIZ_SCENARIO_*`` env knobs."""
+    """The seeded matrix: archetype ``i % len(ARCHETYPES)`` at index
+    ``i``. Defaults come from the ``KMAMIZ_SCENARIO_*`` env knobs."""
     seed = default_seed() if seed is None else seed
     size = default_matrix_size() if size is None else size
     n_ticks = default_ticks() if n_ticks is None else n_ticks

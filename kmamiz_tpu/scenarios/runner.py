@@ -68,6 +68,11 @@ RECOVERY_SLEEP_S = 0.05
 STALL_DEADLINE_MS = 250
 STALL_SLEEP_S = 1.0
 
+#: graftstream freshness SLO: span-arrival -> forecast-visible p99
+#: ceiling for the streaming-freshness archetype (matches the bench
+#: gate on stream_freshness_ms_p99 in tools/slo_report.py)
+FRESHNESS_SLO_MS = 250.0
+
 #: must sit under chaos.mutate_payload's "bomb" size (~4.1 KB) so a
 #: poison-storm bomb always trips the ingest cap (chaos_probe's cap)
 POISON_SIZE_CAP = 4000
@@ -379,6 +384,20 @@ def run_scenario(
             # the mid-tick compile gate measures the crossing alone
             "KMAMIZ_COST": "1" if has_growth else None,
             "KMAMIZ_COST_PREWARM": "sync" if has_growth else None,
+            # the streaming archetype runs every tick through the
+            # graftstream micro-tick engine so the soak exercises the
+            # freshness SLO and its stale-serve degraded mode; every
+            # other archetype pins the serial parity reference
+            "KMAMIZ_STREAM": (
+                "1" if spec.archetype == "streaming-freshness" else "0"
+            ),
+            # epoch length 1: the tick-stall storyline flips the
+            # deadline env mid-stream and expects it live on the very
+            # next micro-tick (the soak exercises the epoch boundary,
+            # not the steady cache)
+            "KMAMIZ_STREAM_EPOCH_TICKS": (
+                "1" if spec.archetype == "streaming-freshness" else None
+            ),
         }
         stack.enter_context(scoped_env(env))
         _reset_shared_state()
@@ -395,12 +414,16 @@ def _reset_shared_state() -> None:
     plane."""
     from kmamiz_tpu import control, cost, tenancy
     from kmamiz_tpu.resilience import breaker, quarantine
+    from kmamiz_tpu.server import stream as stream_mod
+    from kmamiz_tpu.telemetry import freshness
 
     breaker.reset_for_tests()
     quarantine.reset_for_tests()
     tenancy.reset_for_tests()
     control.reset_for_tests()
     cost.reset_for_tests()
+    stream_mod.reset_for_tests()
+    freshness.reset_for_tests()
 
 
 def _run_scenario_inner(spec: ScenarioSpec, tmpdir: str, verbose: bool) -> dict:
@@ -511,7 +534,11 @@ def _run_scenario_inner(spec: ScenarioSpec, tmpdir: str, verbose: bool) -> dict:
     finally:
         server.stop()
 
+    from kmamiz_tpu.telemetry import freshness as tel_freshness
+
     ref_sigs = _reference_signatures(spec, state)
+    fresh = tel_freshness.snapshot()
+    streaming = spec.archetype == "streaming-freshness"
     lat = sorted(state["latencies"])
     recovery_ms = max(state["recoveries"].values(), default=0.0)
     degrading = spec.has_event("upstream-flap") or spec.has_event("tick-stall")
@@ -556,6 +583,20 @@ def _run_scenario_inner(spec: ScenarioSpec, tmpdir: str, verbose: bool) -> dict:
         ),
         "recovered_to_fresh": state["recovered_all"],
         "wal_replayed": state["wal"]["ok"] if state["wal"] else True,
+        # freshness SLO (graftstream): the streaming soak must either
+        # hold the 250 ms arrival->visible p99 or demonstrably take the
+        # degraded mode (stale serve) under its mid-stream stall — a
+        # silent freshness collapse with fresh 200s is the failure this
+        # gate exists to catch. Non-streaming archetypes pass through.
+        "freshness_slo": (
+            fresh["samples"] > 0
+            and (
+                fresh["freshness_ms_p99"] < FRESHNESS_SLO_MS
+                or state["stale"] >= 1
+            )
+        )
+        if streaming
+        else True,
     }
     card = {
         "name": spec.name,
@@ -585,6 +626,7 @@ def _run_scenario_inner(spec: ScenarioSpec, tmpdir: str, verbose: bool) -> dict:
             for t in (growth_tenants or [])
         },
         "signatures": live_sigs,
+        "freshness": fresh,
         "wal": state["wal"],
         "errors": state["errors"][:4],
         "gates": gates,

@@ -33,9 +33,11 @@ from kmamiz_tpu.resilience.watchdog import (
     TickDeadlineExceeded,
     TickWatchdog,
 )
+from kmamiz_tpu.server import stream as stream_mod
 from kmamiz_tpu.server.processor import DataProcessor
 from kmamiz_tpu.telemetry import REGISTRY as TEL_REGISTRY
 from kmamiz_tpu.telemetry import TRACER
+from kmamiz_tpu.telemetry import freshness as tel_freshness
 from kmamiz_tpu.telemetry.profiling import events as prof_events
 
 logger = logging.getLogger("kmamiz_tpu.dp_server")
@@ -275,6 +277,8 @@ def make_handler(processor: DataProcessor, router=None):
                         "tenants": tel_slo.TENANTS.snapshot(),
                         "control": ctl_plane.snapshot(),
                         "cost": cost_plane.snapshot(),
+                        "freshness": tel_freshness.snapshot(),
+                        "stream": stream_mod.stats(),
                     },
                 )
                 return
@@ -476,6 +480,13 @@ def make_handler(processor: DataProcessor, router=None):
                         # watchdog deadline spans the whole gathered
                         # batch in this mode.
                         result = router.submit(tenant, request)
+                    elif stream_mod.stream_enabled():
+                        # graftstream micro-tick: same stage order with
+                        # the explicit merge->score fence and per-epoch
+                        # watchdog deadline caching (server/stream.py)
+                        result = stream_mod.engine_for(
+                            rt.processor, rt.watchdog
+                        ).collect(request)
                     else:
                         result = rt.processor.collect(request)
                 if guard_report is not None and guard_report.recompiled:
@@ -485,8 +496,27 @@ def make_handler(processor: DataProcessor, router=None):
                     )
                 return result
 
+            streaming = stream_mod.stream_enabled()
+            if streaming:
+                # epoch accounting BEFORE the watchdog reads its
+                # deadline: at an epoch boundary this re-reads the env
+                # parse the deadline property serves for the whole epoch
+                stream_mod.engine_for(
+                    rt.processor, rt.watchdog
+                ).note_micro_tick()
+            else:
+                # leaving stream mode must not strand a cached epoch
+                # deadline on the serial path
+                rt.watchdog.end_stream_epoch()
             try:
-                response = rt.watchdog.run(_tick)
+                response = rt.watchdog.run(
+                    _tick,
+                    overrun_reason=(
+                        stream_mod.REASON_STREAM_OVERRUN
+                        if streaming
+                        else None
+                    ),
+                )
             except TickDeadlineExceeded as e:
                 # tick overran its deadline (or a straggler is still in
                 # flight): serve the tenant's last-good graph, explicitly

@@ -44,6 +44,7 @@ from kmamiz_tpu.domain.traces import Traces
 from kmamiz_tpu.resilience import metrics as res_metrics
 from kmamiz_tpu.resilience import quarantine as res_quarantine
 from kmamiz_tpu.resilience.wal import IngestWAL
+from kmamiz_tpu.telemetry import freshness as tel_freshness
 from kmamiz_tpu.telemetry import slo as tel_slo
 from kmamiz_tpu.telemetry.profiling import events as prof_events
 from kmamiz_tpu.telemetry.tracing import TRACER, phase_span
@@ -119,6 +120,7 @@ class _PreparedTick:
         "request",
         "t_start",
         "wall_t0",
+        "arrival_ns",
         "req_time",
         "trace_groups",
         "realtime",
@@ -133,6 +135,10 @@ class _PreparedTick:
         self.request = request
         self.t_start = 0.0
         self.wall_t0 = 0.0
+        # freshness watermark: stamped at native parse (prepare_tick),
+        # carried through merge/score, observed when the response — the
+        # forecast-visible state — is assembled (finish_tick)
+        self.arrival_ns = 0
         self.req_time = 0
         self.trace_groups = []
         self.realtime = None
@@ -327,6 +333,7 @@ class DataProcessor:
         p = _PreparedTick(request)
         p.t_start = self._now_ms()  # domain time: dedup stamps, req default
         p.wall_t0 = prof_events.now_ms()
+        p.arrival_ns = prof_events.now_ns()
         tel_slo.TICKS.inc()
         t_start = p.t_start
         look_back = request.get("lookBack", 30_000)
@@ -493,6 +500,14 @@ class DataProcessor:
         elapsed = prof_events.now_ms() - p.wall_t0
         tel_slo.SCORECARD.observe_tick(elapsed)
         tel_slo.TENANTS.observe_tick(self.tenant, elapsed)
+        if p.arrival_ns:
+            # freshness plane: the watermark stamped at parse is now
+            # forecast-visible; under the stream engine prepare(N+1)
+            # overlaps merge(N), so this elapsed tracks true visibility
+            # latency, not the serialized sum of stages
+            fresh_ns = prof_events.now_ns() - p.arrival_ns
+            tel_freshness.observe(fresh_ns / 1e6)
+            prof_events.emit("freshness", fresh_ns)
         with phase_span("assemble"):
             # response-shape encoding is assembly work too (the HTTP
             # byte encode is the server's separate encode-serve span)

@@ -24,6 +24,7 @@ from .registry import REGISTRY, MetricsRegistry  # noqa: F401
 from .tracing import TRACER, phase_span, telemetry_enabled  # noqa: F401
 from .slo import SCORECARD, TENANTS  # noqa: F401
 from . import device  # noqa: F401  (registers its scrape callback)
+from . import freshness  # noqa: F401  (registers its scrape callback)
 from . import profiling  # noqa: F401  (registers its scrape callback + hooks)
 
 
@@ -36,4 +37,5 @@ def reset_for_tests() -> None:
     TRACER.reset_for_tests()
     SCORECARD.reset_for_tests()
     TENANTS.reset_for_tests()
+    freshness.reset_for_tests()
     profiling.reset_for_tests()

@@ -169,6 +169,13 @@ def phase_p95_ms(name: str) -> float:
     return round(percentile(durs, 0.95), 3)
 
 
+def phase_percentile_ms(name: str, q: float) -> float:
+    """Arbitrary-quantile variant of phase_p95_ms — the freshness plane
+    gates on p99 (`prof_freshness_ms_p99`), not the per-phase p95."""
+    durs = sorted(phase_durations_ms().get(name, []))
+    return round(percentile(durs, q), 3)
+
+
 def reset_for_tests() -> None:
     global _ring, _idx, _tick_seq, _cur_tick
     _ring = [None] * _ring_size()

@@ -25,9 +25,10 @@ PROFILE_KIND = "kmamiz-graftprof"
 PROFILE_VERSION = 1
 
 # events that overlap host phases (native deltas ride inside the parse/
-# merge spans; compiles ride inside whatever phase triggered them) —
-# they inform but must not double-count in the attribution sum
-_NON_ATTRIBUTED = set(NATIVE_EVENTS) | {"compile"}
+# merge spans; compiles ride inside whatever phase triggered them; the
+# freshness watermark spans the whole arrival->visible window) — they
+# inform but must not double-count in the attribution sum
+_NON_ATTRIBUTED = set(NATIVE_EVENTS) | {"compile", "freshness"}
 
 #: per-phase relative regression thresholds for diff(); phases not
 #: listed use "default". merge/lock-wait get headroom — they are the

@@ -328,15 +328,28 @@ class TickRouter:
 
     # -- gather-window micro-batching (HTTP coalescing) ----------------------
 
+    def _direct_tick(self, tenant: str, request: dict) -> dict:
+        """One tenant's direct tick: the graftstream micro-tick engine
+        when KMAMIZ_STREAM is on (explicit merge->score fence + epoch
+        deadline caching), the plain serial collect otherwise."""
+        rt = self.runtime(tenant)
+        from kmamiz_tpu.server import stream as stream_mod
+
+        if stream_mod.stream_enabled():
+            eng = stream_mod.engine_for(rt.processor, rt.watchdog)
+            eng.note_micro_tick()
+            return eng.collect(request)
+        return rt.processor.collect(request)
+
     def submit(self, tenant: str, request: dict) -> dict:
         """One tick, coalescing with concurrent submits when the gather
         window is on: the first arrival becomes the leader, sleeps the
         window out, and dispatches everything queued behind it as one
         batched_collect. Window 0 (default) short-circuits to the
-        tenant's direct serial tick."""
+        tenant's direct tick (micro-tick engine under KMAMIZ_STREAM)."""
         window = batch_window_ms()
         if window <= 0:
-            return self.runtime(tenant).processor.collect(request)
+            return self._direct_tick(tenant, request)
         item = _PendingTick(tenant, request)
         with self._q_lock:
             self._queue.append(item)
@@ -392,9 +405,9 @@ class TickRouter:
             # follower: bounded wait, then self-serve (a dying leader
             # must not wedge every queued tenant behind its window)
             if not item.done.wait(timeout=window / 1000.0 + 30.0):
-                return self.runtime(tenant).processor.collect(request)
+                return self._direct_tick(tenant, request)
         if item.error is not None:
             raise item.error
         if item.result is None:  # leader never picked us up (shutdown race)
-            return self.runtime(tenant).processor.collect(request)
+            return self._direct_tick(tenant, request)
         return item.result

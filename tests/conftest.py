@@ -60,6 +60,7 @@ def _reset_resilience_state():
     from kmamiz_tpu.models import stlgt
     from kmamiz_tpu.ops import sparse
     from kmamiz_tpu.resilience import breaker, metrics, quarantine
+    from kmamiz_tpu.server import stream
 
     breaker.reset_for_tests()
     metrics.reset_for_tests()
@@ -70,6 +71,8 @@ def _reset_resilience_state():
     stlgt.reset_for_tests()
     control.reset_for_tests()
     cost.reset_for_tests()
+    # graftstream module counters (micro-ticks, fences, high water)
+    stream.reset_for_tests()
     # the sparse backend knob is cached after first read; a test that
     # monkeypatches KMAMIZ_SPARSE* must not leak its choice forward
     sparse.reset_for_tests()

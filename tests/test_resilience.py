@@ -711,6 +711,86 @@ def test_scheduler_job_failure_surfaces_in_metrics():
     assert "job blew up" in state["lastError"]
 
 
+# -- graftstream degraded mode: stream-overrun stale serve --------------------
+
+
+class TestStreamOverrunStaleServe:
+    """Satellite of the graftstream pipeline (server/stream.py): an
+    overrunning micro-tick degrades exactly like a batch-tick overrun —
+    200 + last-good — but the staleness metadata names the streaming
+    mode (``staleReason == "stream-overrun"``) and the degraded serve
+    compiles nothing."""
+
+    def _tick(self, base, unique_id):
+        import urllib.error
+        import urllib.request
+
+        body = {
+            "uniqueId": unique_id,
+            "lookBack": 30_000,
+            "time": int(time.time() * 1000),
+        }
+        req = urllib.request.Request(
+            base,
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                return resp.status, json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+
+    def test_overrun_serves_last_good_with_stream_reason(self, monkeypatch):
+        from kmamiz_tpu.core import programs
+        from kmamiz_tpu.server.dp_server import DataProcessorServer
+        from kmamiz_tpu.synth import make_raw_window
+
+        monkeypatch.setenv("KMAMIZ_STREAM", "1")
+        # epoch length 1: every micro-tick is an epoch boundary, so the
+        # deadline flip below is live on the very next POST
+        monkeypatch.setenv("KMAMIZ_STREAM_EPOCH_TICKS", "1")
+        monkeypatch.delenv("KMAMIZ_TICK_DEADLINE_MS", raising=False)
+
+        gate = {"stall_s": 0.0, "n": 0}
+
+        def source(_lb, _t, _lim):
+            if gate["stall_s"]:
+                time.sleep(gate["stall_s"])
+            gate["n"] += 1
+            return json.loads(
+                make_raw_window(
+                    24, 3, t_start=gate["n"] * 10_000,
+                    trace_prefix=f"so{gate['n']}",
+                )
+            )
+
+        processor = DataProcessor(trace_source=source, use_device_stats=False)
+        server = DataProcessorServer(processor, host="127.0.0.1", port=0)
+        server.start()
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            # two fresh micro-ticks through the stream engine: last-good
+            # established, every merge shape compiled
+            for uid in ("so-warm1", "so-warm2"):
+                status, body = self._tick(base, uid)
+                assert status == 200 and not body.get("stale")
+
+            snapshot = programs.snapshot()
+            gate["stall_s"] = 0.5
+            monkeypatch.setenv("KMAMIZ_TICK_DEADLINE_MS", "50")
+            status, body = self._tick(base, "so-stalled")
+            assert status == 200
+            assert body.get("stale") is True
+            assert body["staleReason"] == "stream-overrun"
+            # the degraded serve is the cached last-good payload: zero
+            # new program compiles on the stale path
+            assert programs.new_compiles_since(snapshot) == {}
+        finally:
+            gate["stall_s"] = 0.0
+            server.stop()
+
+
 def test_dp_timeout_env_knob(monkeypatch):
     from kmamiz_tpu.server.operator import _dp_timeout_s
 

@@ -1,0 +1,443 @@
+"""graftstream pins (kmamiz_tpu/server/stream.py, docs/TICK_PIPELINE.md).
+
+The acceptance contract of the overlapped micro-tick pipeline:
+
+  (a) running a request sequence through `StreamEngine.run_stream` is
+      BIT-EXACT with the serial tick (`KMAMIZ_STREAM=0`): identical
+      responses and identical per-tenant `graph_signature`;
+  (b) a warm stream compiles nothing — the overlap reuses the exact
+      programs the serial tick compiled (`new_compiles == {}` under
+      `transfer_guard("disallow")`);
+  (c) the watchdog's deadline parse is cached per stream EPOCH: a
+      mid-epoch `KMAMIZ_TICK_DEADLINE_MS` change lands at the next
+      epoch boundary, never mid-epoch, and a genuine overrun is
+      labeled ``stream-overrun``;
+  (d) the stage hand-off fence and the double-buffer stats stay
+      observable (depth-0 sync mode explicit, no division by zero).
+
+The HTTP degraded-mode pin (stale serve with
+``staleReason == "stream-overrun"``) lives in test_resilience.py next
+to the other watchdog/stale machinery.
+"""
+from __future__ import annotations
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from kmamiz_tpu.analysis import guards
+from kmamiz_tpu.ops.double_buffer import UploadPipeline
+from kmamiz_tpu.resilience.chaos import graph_signature
+from kmamiz_tpu.resilience.watchdog import (
+    REASON_IN_FLIGHT,
+    TickDeadlineExceeded,
+    TickWatchdog,
+)
+from kmamiz_tpu.server import stream
+from kmamiz_tpu.server.processor import DataProcessor
+from kmamiz_tpu.synth import make_raw_window
+from kmamiz_tpu.telemetry import freshness as tel_freshness
+
+
+def _strip_volatile(response: dict) -> dict:
+    out = dict(response)
+    out.pop("log", None)
+    return out
+
+
+def _feed(n_windows: int, prefix: str, traces: int = 24, spans: int = 4):
+    """n identical-shape, distinct-content windows — regenerated fresh
+    per call so twin processors never share mutable parsed spans."""
+    return [
+        json.loads(
+            make_raw_window(
+                traces, spans, t_start=i * 10_000, trace_prefix=f"{prefix}{i}"
+            )
+        )
+        for i in range(n_windows)
+    ]
+
+
+def _requests(n: int, prefix: str):
+    return [
+        {
+            "uniqueId": f"{prefix}{i}",
+            "lookBack": 30_000,
+            "time": 1_000_000 + i * 10_000,
+        }
+        for i in range(n)
+    ]
+
+
+def _popping_source(feed):
+    return lambda _lb, _t, _lim: feed.pop(0)
+
+
+# -- knobs --------------------------------------------------------------------
+
+
+class TestKnobs:
+    def test_stream_off_by_default(self, monkeypatch):
+        monkeypatch.delenv("KMAMIZ_STREAM", raising=False)
+        assert not stream.stream_enabled()
+
+    @pytest.mark.parametrize("raw", ["0", "false", ""])
+    def test_stream_off_values(self, monkeypatch, raw):
+        monkeypatch.setenv("KMAMIZ_STREAM", raw)
+        assert not stream.stream_enabled()
+
+    def test_stream_on(self, monkeypatch):
+        monkeypatch.setenv("KMAMIZ_STREAM", "1")
+        assert stream.stream_enabled()
+
+    def test_depth_default_and_clamps(self, monkeypatch):
+        monkeypatch.delenv("KMAMIZ_STREAM_DEPTH", raising=False)
+        assert stream.stream_depth() == stream.DEFAULT_DEPTH
+        monkeypatch.setenv("KMAMIZ_STREAM_DEPTH", "0")
+        assert stream.stream_depth() == 1  # floor: depth 1 still overlaps
+        monkeypatch.setenv("KMAMIZ_STREAM_DEPTH", "99")
+        assert stream.stream_depth() == stream.MAX_DEPTH
+        monkeypatch.setenv("KMAMIZ_STREAM_DEPTH", "not-a-number")
+        assert stream.stream_depth() == stream.DEFAULT_DEPTH
+
+    def test_epoch_ticks_default_and_floor(self, monkeypatch):
+        monkeypatch.delenv("KMAMIZ_STREAM_EPOCH_TICKS", raising=False)
+        assert stream.stream_epoch_ticks() == stream.DEFAULT_EPOCH_TICKS
+        monkeypatch.setenv("KMAMIZ_STREAM_EPOCH_TICKS", "0")
+        assert stream.stream_epoch_ticks() == 1
+        monkeypatch.setenv("KMAMIZ_STREAM_EPOCH_TICKS", "junk")
+        assert stream.stream_epoch_ticks() == stream.DEFAULT_EPOCH_TICKS
+
+    def test_config_mirrors_stream_knobs(self, monkeypatch):
+        from kmamiz_tpu.config import Settings
+
+        monkeypatch.setenv("KMAMIZ_STREAM", "1")
+        monkeypatch.setenv("KMAMIZ_STREAM_DEPTH", "4")
+        monkeypatch.setenv("KMAMIZ_STREAM_EPOCH_TICKS", "7")
+        settings = Settings()
+        assert settings.stream_enabled is True
+        assert settings.stream_depth == 4
+        assert settings.stream_epoch_ticks == 7
+
+
+# -- (a) bit-exact parity vs the serial tick ----------------------------------
+
+
+class TestBitExactParity:
+    def test_run_stream_matches_serial_responses_and_signature(self):
+        n = 6
+        requests = _requests(n, "par")
+
+        dp_serial = DataProcessor(
+            trace_source=_popping_source(_feed(n, "par")),
+            use_device_stats=False,
+        )
+        serial = [dp_serial.collect(dict(r)) for r in requests]
+        dp_serial.graph.n_edges
+
+        dp_stream = DataProcessor(
+            trace_source=_popping_source(_feed(n, "par")),
+            use_device_stats=False,
+        )
+        engine = stream.StreamEngine(dp_stream)
+        streamed = engine.run_stream([dict(r) for r in requests])
+        dp_stream.graph.n_edges
+
+        assert len(streamed) == len(serial) == n
+        # responses come back in request order and are bit-identical
+        for got, want in zip(streamed, serial):
+            assert json.dumps(
+                _strip_volatile(got), sort_keys=True, default=str
+            ) == json.dumps(_strip_volatile(want), sort_keys=True, default=str)
+        assert graph_signature(dp_stream.graph) == graph_signature(
+            dp_serial.graph
+        )
+
+    def test_collect_micro_tick_matches_serial(self):
+        requests = _requests(3, "mic")
+
+        dp_serial = DataProcessor(
+            trace_source=_popping_source(_feed(3, "mic")),
+            use_device_stats=False,
+        )
+        serial = [dp_serial.collect(dict(r)) for r in requests]
+
+        dp_stream = DataProcessor(
+            trace_source=_popping_source(_feed(3, "mic")),
+            use_device_stats=False,
+        )
+        engine = stream.engine_for(dp_stream)
+        streamed = [engine.collect(dict(r)) for r in requests]
+
+        for got, want in zip(streamed, serial):
+            assert _strip_volatile(got) == _strip_volatile(want)
+        assert graph_signature(dp_stream.graph) == graph_signature(
+            dp_serial.graph
+        )
+
+    @pytest.mark.parametrize("depth", ["1", "8"])
+    def test_parity_holds_at_every_depth(self, monkeypatch, depth):
+        monkeypatch.setenv("KMAMIZ_STREAM_DEPTH", depth)
+        n = 4
+        requests = _requests(n, f"dep{depth}-")
+
+        dp_serial = DataProcessor(
+            trace_source=_popping_source(_feed(n, f"dep{depth}-")),
+            use_device_stats=False,
+        )
+        for r in requests:
+            dp_serial.collect(dict(r))
+
+        dp_stream = DataProcessor(
+            trace_source=_popping_source(_feed(n, f"dep{depth}-")),
+            use_device_stats=False,
+        )
+        stream.StreamEngine(dp_stream).run_stream([dict(r) for r in requests])
+        assert graph_signature(dp_stream.graph) == graph_signature(
+            dp_serial.graph
+        )
+
+
+# -- (b) warm stream compiles nothing -----------------------------------------
+
+
+class TestWarmStreamZeroRecompiles:
+    def test_warm_stream_is_transfer_clean_and_compiles_nothing(
+        self, monkeypatch
+    ):
+        monkeypatch.setenv("KMAMIZ_MESH", "0")
+        # warm the compile caches: two serial ticks on distinct windows
+        # of the streaming shape, exactly like TestGuardedTick
+        for i, seed_t in enumerate((0, 10_000)):
+            window = json.loads(
+                make_raw_window(24, 4, t_start=seed_t, trace_prefix=f"wst{i}")
+            )
+            dp = DataProcessor(
+                trace_source=lambda _lb, _t, _lim, w=window: w,
+                use_device_stats=False,
+            )
+            dp.collect(
+                {
+                    "uniqueId": f"warm{seed_t}",
+                    "lookBack": 30_000,
+                    "time": 1_000_000 + seed_t,
+                }
+            )
+            dp.graph.n_edges
+
+        dp_stream = DataProcessor(
+            trace_source=_popping_source(_feed(3, "wstrun")),
+            use_device_stats=False,
+        )
+        engine = stream.StreamEngine(dp_stream)
+        with guards.hot_path_guard("disallow") as report:
+            responses = engine.run_stream(_requests(3, "wstrun"))
+            dp_stream.graph.n_edges
+        assert len(responses) == 3
+        # steady state: the overlapped pipeline reuses the exact programs
+        # the serial warmup compiled — zero new compiles
+        assert report.new_compiles == {}, report.new_compiles
+
+
+# -- (c) watchdog: epoch-cached deadline + stream-overrun label ---------------
+
+
+class TestWatchdogStreamEpoch:
+    def test_mid_epoch_env_change_lands_at_next_boundary(self, monkeypatch):
+        monkeypatch.setenv("KMAMIZ_TICK_DEADLINE_MS", "50")
+        watchdog = TickWatchdog()
+        assert watchdog.begin_stream_epoch() == 50.0
+        # mid-epoch: the cached parse serves, the env change is invisible
+        monkeypatch.setenv("KMAMIZ_TICK_DEADLINE_MS", "75")
+        assert watchdog.deadline_ms == 50.0
+        # the next epoch boundary re-reads the env
+        assert watchdog.begin_stream_epoch() == 75.0
+        assert watchdog.deadline_ms == 75.0
+        # leaving stream mode restores per-run env reads
+        watchdog.end_stream_epoch()
+        monkeypatch.setenv("KMAMIZ_TICK_DEADLINE_MS", "10")
+        assert watchdog.deadline_ms == 10.0
+
+    def test_ctor_pin_beats_epoch_cache(self, monkeypatch):
+        monkeypatch.setenv("KMAMIZ_TICK_DEADLINE_MS", "50")
+        watchdog = TickWatchdog(deadline_ms=10)
+        watchdog.begin_stream_epoch()
+        assert watchdog.deadline_ms == 10
+
+    def test_engine_epoch_accounting_drives_the_cache(self, monkeypatch):
+        """The mid-stream env change takes effect exactly at the next
+        epoch boundary when the ENGINE does the accounting (the path
+        dp_server drives before every watchdog.run)."""
+        monkeypatch.setenv("KMAMIZ_STREAM_EPOCH_TICKS", "2")
+        monkeypatch.setenv("KMAMIZ_TICK_DEADLINE_MS", "40")
+        watchdog = TickWatchdog()
+        engine = stream.StreamEngine(processor=None, watchdog=watchdog)
+
+        engine.note_micro_tick()  # tick 0: epoch boundary -> caches 40
+        monkeypatch.setenv("KMAMIZ_TICK_DEADLINE_MS", "90")
+        engine.note_micro_tick()  # tick 1: mid-epoch -> still 40
+        assert watchdog.deadline_ms == 40.0
+        engine.note_micro_tick()  # tick 2: next boundary -> 90 lands
+        assert watchdog.deadline_ms == 90.0
+
+    def test_overrun_renamed_stream_overrun_in_flight_kept(self):
+        from kmamiz_tpu.resilience import metrics as res_metrics
+
+        watchdog = TickWatchdog(deadline_ms=50)
+        release = threading.Event()
+
+        def straggler():
+            release.wait(5.0)
+            return "late"
+
+        try:
+            with pytest.raises(TickDeadlineExceeded) as err:
+                watchdog.run(
+                    straggler, overrun_reason=stream.REASON_STREAM_OVERRUN
+                )
+            assert err.value.reason == stream.REASON_STREAM_OVERRUN
+            # straggler overlap keeps its own label: only the genuine
+            # overrun is renamed
+            with pytest.raises(TickDeadlineExceeded) as err:
+                watchdog.run(
+                    lambda: "never",
+                    overrun_reason=stream.REASON_STREAM_OVERRUN,
+                )
+            assert err.value.reason == REASON_IN_FLIGHT
+            by_reason = res_metrics.watchdog_state()["byReason"]
+            assert by_reason[stream.REASON_STREAM_OVERRUN] == 1
+        finally:
+            release.set()
+
+
+# -- (d) stage fence + double-buffer stats ------------------------------------
+
+
+class TestUploadPipelineStats:
+    def test_depth0_sync_mode_is_explicit_and_division_safe(self):
+        pipe = UploadPipeline(depth=0)
+        fresh = pipe.stats()
+        # uploads == 0: every derived rate must stay defined
+        assert fresh["mode"] == "sync"
+        assert fresh["depth"] == 0
+        assert fresh["uploads"] == 0
+        assert fresh["blocked_ms_per_upload"] == 0.0
+
+        pipe.put([np.arange(4, dtype=np.float32)])
+        after = pipe.stats()
+        assert after["uploads"] == 1
+        assert after["in_flight"] == 0  # sync: nothing ever left in flight
+        # depth 0 blocks inline and accounts NO pipeline stall, so the
+        # per-upload stall rate stays 0.0 instead of dividing junk
+        assert after["blocked_ms"] == 0.0
+        assert after["blocked_ms_per_upload"] == 0.0
+
+    def test_pipelined_mode_reports_rates_and_fences(self):
+        pipe = UploadPipeline(depth=2)
+        assert pipe.stats()["mode"] == "pipelined"
+        assert pipe.stats()["blocked_ms_per_upload"] == 0.0  # 0 uploads
+        for _ in range(3):
+            pipe.put([np.arange(4, dtype=np.float32)])
+        pipe.note_fence()
+        pipe.drain()
+        stats = pipe.stats()
+        assert stats["uploads"] == 3
+        assert stats["fences"] == 1
+        assert stats["in_flight"] == 0
+        assert stats["blocked_ms_per_upload"] >= 0.0
+
+    def test_stage_fence_counts_and_snapshots(self):
+        window = json.loads(make_raw_window(12, 3, trace_prefix="sf"))
+        dp = DataProcessor(
+            trace_source=lambda _lb, _t, _lim: window, use_device_stats=False
+        )
+        dp.collect({"uniqueId": "sf1", "lookBack": 30_000, "time": 1_000_000})
+        before = dp.graph.upload_stats()["fences"]
+        snap = dp.graph.stage_fence()
+        assert dp.graph.upload_stats()["fences"] == before + 1
+        # the fence retires everything: nothing may stay in flight and
+        # the snapshot reflects the post-finalize version
+        assert snap["in_flight"] == 0
+        assert snap["version"] == dp.graph.version
+
+
+# -- freshness plane ----------------------------------------------------------
+
+
+class TestFreshnessPlane:
+    def test_collect_observes_arrival_to_visible(self):
+        tel_freshness.reset_for_tests()
+        window = json.loads(make_raw_window(12, 3, trace_prefix="fr"))
+        dp = DataProcessor(
+            trace_source=lambda _lb, _t, _lim: window, use_device_stats=False
+        )
+        dp.collect({"uniqueId": "fr1", "lookBack": 30_000, "time": 1_000_000})
+        snap = tel_freshness.snapshot()
+        assert snap["samples"] >= 1
+        for key in (
+            "freshness_ms_p50",
+            "freshness_ms_p95",
+            "freshness_ms_p99",
+            "freshness_ms_max",
+        ):
+            assert snap[key] >= 0.0
+        assert snap["freshness_ms_p50"] <= snap["freshness_ms_p99"]
+
+    def test_stream_run_observes_every_tick(self):
+        tel_freshness.reset_for_tests()
+        dp = DataProcessor(
+            trace_source=_popping_source(_feed(4, "frs")),
+            use_device_stats=False,
+        )
+        stream.StreamEngine(dp).run_stream(_requests(4, "frs"))
+        assert tel_freshness.snapshot()["samples"] == 4
+
+    def test_reset_clears_samples(self):
+        tel_freshness.observe(3.5)
+        assert tel_freshness.snapshot()["samples"] >= 1
+        tel_freshness.reset_for_tests()
+        snap = tel_freshness.snapshot()
+        assert snap["samples"] == 0
+        assert snap["freshness_ms_max"] == 0.0
+
+
+# -- engine plumbing ----------------------------------------------------------
+
+
+class TestEnginePlumbing:
+    def test_engine_for_attaches_once_and_backfills_watchdog(self):
+        dp = DataProcessor(trace_source=lambda *a: [], use_device_stats=False)
+        engine = stream.engine_for(dp)
+        assert stream.engine_for(dp) is engine
+        assert engine.watchdog is None
+        watchdog = TickWatchdog(deadline_ms=1_000)
+        assert stream.engine_for(dp, watchdog) is engine
+        assert engine.watchdog is watchdog
+        # first attached watchdog sticks (one per tenant runtime)
+        assert stream.engine_for(dp, TickWatchdog()).watchdog is watchdog
+
+    def test_run_stream_propagates_prepare_error(self, monkeypatch):
+        dp = DataProcessor(trace_source=lambda *a: [], use_device_stats=False)
+
+        def boom(_request):
+            raise RuntimeError("prepare exploded")
+
+        monkeypatch.setattr(dp, "prepare_tick", boom)
+        with pytest.raises(RuntimeError, match="prepare exploded"):
+            stream.StreamEngine(dp).run_stream(_requests(2, "err"))
+
+    def test_module_stats_track_and_reset(self):
+        stream.reset_for_tests()
+        dp = DataProcessor(
+            trace_source=_popping_source(_feed(2, "st")),
+            use_device_stats=False,
+        )
+        stream.StreamEngine(dp).run_stream(_requests(2, "st"))
+        stats = stream.stats()
+        assert stats["streams"] == 1
+        assert stats["micro_ticks"] == 2
+        assert stats["fences"] == 2
+        stream.reset_for_tests()
+        assert all(v == 0 for v in stream.stats().values())

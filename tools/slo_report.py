@@ -74,11 +74,24 @@ _EXTRA_GATED = (
     # back toward the OFF arm's compile wall)
     "graph_capacity_grow_ms",
     "capacity_growth_stall_ms",
+    # graftstream freshness pair (ISSUE 16): the worst arrival->visible
+    # p99 across the burst + diurnal curves (also hard-capped below at
+    # _FRESHNESS_CEILING_MS) and the graftprof plane's own p99; the
+    # steady-recompile count rides the integer slack (one-compile drift
+    # already fails)
+    "stream_freshness_ms_p99",
+    "prof_freshness_ms_p99",
+    "stream_steady_recompiles",
 )
 # boolean pass/fail keys: any True -> False flip is a regression (bool
 # is an int subclass, so the numeric threshold check would wave a
 # True -> False transition through as 1.0 -> 0.0 "improvement")
-_BOOL_GATED = ("scenario_matrix_pass", "graph_refresh_pass")
+_BOOL_GATED = (
+    "scenario_matrix_pass",
+    "graph_refresh_pass",
+    # the transfer-guarded warm stream must keep compiling NOTHING
+    "stream_zero_recompiles_pass",
+)
 # higher-is-BETTER float floors: the numeric check above only catches
 # increases, so a coverage collapse would read as an "improvement".
 # stlgt_p99_coverage is a [0,1] calibration rate where relative
@@ -91,6 +104,9 @@ _FLOOR_GATED = (
     # a collapse to cold crossings must fail the round even though the
     # numeric check would read 1.0 -> 0.0 as an improvement
     "cost_prewarm_hit_rate",
+    # stream-vs-serial wall ratio: the overlap collapsing back to the
+    # serial wall reads as a lower number — gate it as a floor
+    "stream_vs_batch_speedup",
 )
 _ABS_SLACK_FLOOR = 0.02
 # absolute slack per key class: rates jitter in the 3rd decimal on tiny
@@ -125,6 +141,28 @@ _SCALING_KEY = "parse_thread_scaling_1core"
 _SCALING_REL_SLACK = 0.15  # best-of-2 walls still jitter on a busy box
 _SCALING_ABS_SLACK_MS = 2.0
 _SCALING_1CORE_FACTOR = 1.5  # timeslice overhead ceiling vs the t1 wall
+
+# graftstream freshness SLO (ISSUE 16): span-arrival -> forecast-visible
+# p99 must stay under this ceiling under the burst + diurnal curves.
+# Candidate-local and absolute — a slow creep that stays within the
+# relative threshold each round must still fail the moment it crosses.
+_FRESHNESS_CEILING_MS = 250.0
+_FRESHNESS_KEY = "stream_freshness_ms_p99"
+
+
+def check_freshness_ceiling(result: dict):
+    """Violation strings when the candidate's stream freshness p99
+    breaches the absolute SLO ([] when healthy or the key is absent —
+    a failed bench section emits None, which the driver flags)."""
+    p99 = result.get(_FRESHNESS_KEY)
+    if not isinstance(p99, (int, float)) or isinstance(p99, bool):
+        return []
+    if p99 >= _FRESHNESS_CEILING_MS:
+        return [
+            f"{_FRESHNESS_KEY} breached the absolute SLO: {p99}ms >= "
+            f"{_FRESHNESS_CEILING_MS}ms ceiling"
+        ]
+    return []
 
 
 def check_thread_scaling(result: dict):
@@ -346,8 +384,9 @@ def main(argv=None) -> int:
         return 0
 
     regressions, compared = check(candidate, baseline, args.threshold)
-    # candidate-local invariant, gated regardless of baseline overlap
+    # candidate-local invariants, gated regardless of baseline overlap
     scaling_violations = check_thread_scaling(candidate)
+    scaling_violations += check_freshness_ceiling(candidate)
     print(render(candidate, cand_label))
     print(f"baseline: {base_label}; compared {len(compared)} key(s)")
     for msg in scaling_violations:

@@ -1922,6 +1922,48 @@ def main() -> None:
                 f"{type(err).__name__}: {err}"[:300]
             )
 
+    # ---- graftfleet scale-out (ROADMAP item 2 / docs/FLEET.md) -------------
+    # tools/fleet_bench.py in a fresh subprocess: four real worker
+    # processes behind HTTPTransport — single-worker vs 4-worker ingest
+    # rate, per-worker efficiency, and one live migration with a frame
+    # injected mid-handoff. The six keys are ALWAYS present (None on
+    # skip/failure) and gated by tools/slo_report.py: lost spans as
+    # higher-is-worse, migration pass as a bool, the rate/efficiency
+    # pair as floors plus the host-core-guarded absolute efficiency
+    # check. KMAMIZ_BENCH_FLEET=0 skips.
+    fleet_extras = {
+        "fleet_spans_per_sec_1": None,
+        "fleet_spans_per_sec_4": None,
+        "fleet_scale_efficiency": None,
+        "fleet_migration_lost_spans": None,
+        "fleet_migration_pass": None,
+        "fleet_host_cores": os.cpu_count(),
+    }
+    try:
+        fleet_budget_ok = (
+            time.perf_counter() - BENCH_T0
+            < int(os.environ.get("KMAMIZ_BENCH_BUDGET_S", 3000)) - 275
+        )
+    except ValueError:
+        fleet_budget_ok = True
+    if os.environ.get("KMAMIZ_BENCH_FLEET", "1") != "0" and fleet_budget_ok:
+        import subprocess
+
+        try:
+            out = subprocess.run(
+                [sys.executable, "tools/fleet_bench.py", "--frames", "16"],
+                capture_output=True,
+                text=True,
+                timeout=900,
+            )
+            fleet_extras.update(
+                json.loads(out.stdout.strip().splitlines()[-1])
+            )
+        except Exception as err:  # noqa: BLE001 - extra, not headline
+            fleet_extras["fleet_bench_error"] = (
+                f"{type(err).__name__}: {err}"[:300]
+            )
+
     # ---- graftpilot control plane (ISSUE 11) -------------------------------
     # the controller's two latencies — the fold-boundary decision
     # recompute (Controller.ingest over synthetic forecast views) and the
@@ -2180,6 +2222,7 @@ def main() -> None:
         **chaos_extras,
         **tenancy_extras,
         **scenario_extras,
+        **fleet_extras,
         **control_extras,
         "chained_iters": ITERS,
         "tunnel_rtt_ms": round(rtt * 1000, 1),

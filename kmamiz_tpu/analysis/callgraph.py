@@ -48,6 +48,12 @@ DEFAULT_SEED_MODULES = (
     # so the hot-path rules reach it even though the dispatch sits
     # behind the KMAMIZ_STREAM knob
     "kmamiz_tpu/server/stream.py",
+    # graftfleet: route_ingest sits on every frame's path and the
+    # worker's ingest/drain/replay verbs ARE the DP hot loop when the
+    # fleet fronts it — hot by seed so the rules reach them even though
+    # fleet mode hides behind KMAMIZ_FLEET_SIZE
+    "kmamiz_tpu/fleet/coordinator.py",
+    "kmamiz_tpu/fleet/worker.py",
 )
 
 

@@ -725,6 +725,10 @@ _STATE_SCOPES = (
     # per-tenant source queues) are written from the driving thread, the
     # reader thread, and HTTP handler threads of the live soak server
     "kmamiz_tpu/scenarios/",
+    # the fleet coordinator's routing state (overrides, drain flags,
+    # queues) is written by request threads AND the migration driver;
+    # the module counters take increments from every worker thread
+    "kmamiz_tpu/fleet/",
     # the STLGT continual trainer's ring/stale/params state is written
     # from the processor's fold path while /model/forecast and
     # /model/stlgt read it from server threads

@@ -203,7 +203,7 @@ class Settings:
         )
     )  # matrix seed: one integer composes every topology/traffic/storyline
     scenario_matrix: int = field(
-        default_factory=lambda: int(os.environ.get("KMAMIZ_SCENARIO_MATRIX", "9"))
+        default_factory=lambda: int(os.environ.get("KMAMIZ_SCENARIO_MATRIX", "10"))
     )  # matrix size; archetype i % len(ARCHETYPES) at index i
     scenario_ticks: int = field(
         default_factory=lambda: int(os.environ.get("KMAMIZ_SCENARIO_TICKS", "10"))
@@ -213,6 +213,30 @@ class Settings:
             "KMAMIZ_SCENARIO_STORYLINES", "all"
         )
     )  # comma list filtering the storyline vocabulary ("all" = everything)
+
+    # graftfleet (kmamiz_tpu/fleet/, docs/FLEET.md). The fleet modules
+    # read these env vars directly (the ring must be buildable before
+    # any Settings instance exists); the fields mirror them so one
+    # `Settings()` dump shows everything.
+    fleet_size: int = field(
+        default_factory=lambda: int(os.environ.get("KMAMIZ_FLEET_SIZE", "1"))
+    )  # front-end workers behind the coordinator (>= 2 enables fleet mode)
+    fleet_vnodes: int = field(
+        default_factory=lambda: int(os.environ.get("KMAMIZ_FLEET_VNODES", "64"))
+    )  # virtual nodes per worker on the consistent-hash ring
+    fleet_seed: int = field(
+        default_factory=lambda: int(os.environ.get("KMAMIZ_FLEET_SEED", "0"))
+    )  # ring hash seed; same seed => same tenant placement everywhere
+    fleet_coord_port: int = field(
+        default_factory=lambda: int(
+            os.environ.get("KMAMIZ_FLEET_COORD_PORT", "0")
+        )
+    )  # coordinator HTTP port (0 = ephemeral / in-process only)
+    fleet_drain_timeout_ms: float = field(
+        default_factory=lambda: float(
+            os.environ.get("KMAMIZ_FLEET_DRAIN_TIMEOUT_MS", "5000")
+        )
+    )  # migration drain budget; a handoff past this aborts to the source
 
     # graftprof profiler (kmamiz_tpu/telemetry/profiling/, the
     # "Profiling" section of docs/OBSERVABILITY.md). The profiling

@@ -1,0 +1,190 @@
+"""graftfleet: sharded multi-process ingest fleet (docs/FLEET.md).
+
+One host's front end (PR 12/15: sharded native parse, KMZC columnar
+wire, per-tenant WAL) scales out behind one logical DP endpoint: N
+worker processes own disjoint tenant sets assigned by a seeded
+consistent-hash ring (:mod:`.ring`), a coordinator folds their
+host-local graphs through the existing shape-keyed merge programs
+(:mod:`.coordinator`), and tenants move between workers live via
+WAL-handoff migration (:mod:`.migration`) with graftpilot/graftcost
+forecasts scoring the placement (:mod:`.placement`).
+
+Env knobs (docs/ENVIRONMENT.md):
+
+- ``KMAMIZ_FLEET_SIZE`` — worker count behind the endpoint (default 1;
+  >= 2 turns fleet routing on).
+- ``KMAMIZ_FLEET_VNODES`` — virtual nodes per worker on the ring
+  (default 64).
+- ``KMAMIZ_FLEET_SEED`` — ring hash seed; every process that shares it
+  computes identical tenant placements (default 0).
+- ``KMAMIZ_FLEET_COORD_PORT`` — coordinator bind port (default 0 = an
+  ephemeral port, test-friendly).
+- ``KMAMIZ_FLEET_DRAIN_TIMEOUT_MS`` — ceiling on a migration's drain
+  phase before it aborts back to the source (default 5000).
+
+This module owns the fleet-wide counters surfaced as the ``fleet``
+section of ``/timings`` (snapshot); like every other subsystem registry
+they are process-wide, so tests reset them via ``reset_for_tests``.
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+_DEFAULT_VNODES = 64
+_DEFAULT_DRAIN_TIMEOUT_MS = 5000.0
+
+
+def fleet_size() -> int:
+    """Workers behind the logical endpoint (KMAMIZ_FLEET_SIZE, >= 1)."""
+    try:
+        return max(1, int(os.environ.get("KMAMIZ_FLEET_SIZE", "1")))
+    except ValueError:
+        return 1
+
+
+def fleet_vnodes() -> int:
+    """Virtual nodes per worker on the ring (KMAMIZ_FLEET_VNODES)."""
+    try:
+        return max(1, int(os.environ.get("KMAMIZ_FLEET_VNODES", str(_DEFAULT_VNODES))))
+    except ValueError:
+        return _DEFAULT_VNODES
+
+
+def fleet_seed() -> int:
+    """Ring hash seed (KMAMIZ_FLEET_SEED) — identical across processes
+    by construction, so every front end routes a tenant the same way."""
+    try:
+        return int(os.environ.get("KMAMIZ_FLEET_SEED", "0"))
+    except ValueError:
+        return 0
+
+
+def coordinator_port() -> int:
+    """Coordinator bind port (KMAMIZ_FLEET_COORD_PORT, 0 = ephemeral)."""
+    try:
+        return max(0, int(os.environ.get("KMAMIZ_FLEET_COORD_PORT", "0")))
+    except ValueError:
+        return 0
+
+
+def drain_timeout_ms() -> float:
+    """Migration drain-phase ceiling (KMAMIZ_FLEET_DRAIN_TIMEOUT_MS)."""
+    try:
+        return max(
+            0.0,
+            float(
+                os.environ.get(
+                    "KMAMIZ_FLEET_DRAIN_TIMEOUT_MS",
+                    str(_DEFAULT_DRAIN_TIMEOUT_MS),
+                )
+            ),
+        )
+    except ValueError:
+        return _DEFAULT_DRAIN_TIMEOUT_MS
+
+
+def enabled() -> bool:
+    """Fleet routing mode is on when more than one worker is configured."""
+    return fleet_size() >= 2
+
+
+# -- fleet-wide counters (the `fleet` /timings section) ----------------------
+# each also mirrors into a graftscope registry counter (preallocated at
+# import — incr runs on the frame-routing hot path), feeding the
+# grafana Fleet row's kmamiz_fleet_* series
+from kmamiz_tpu.telemetry.registry import REGISTRY
+
+_PROM_COUNTERS = {
+    "framesRouted": REGISTRY.counter(
+        "kmamiz_fleet_frames_routed_total",
+        "Ingest frames the coordinator dispatched to a ring owner",
+    ),
+    "framesQueuedDuringDrain": REGISTRY.counter(
+        "kmamiz_fleet_frames_queued_total",
+        "Frames parked in a drain queue while their tenant migrated",
+    ),
+    "folds": REGISTRY.counter(
+        "kmamiz_fleet_folds_total",
+        "Hierarchical level-two folds into an aggregate graph",
+    ),
+    "foldedEdges": REGISTRY.counter(
+        "kmamiz_fleet_folded_edges_total",
+        "Live edges set-unioned by coordinator folds",
+    ),
+    "migrationsStarted": REGISTRY.counter(
+        "kmamiz_fleet_migrations_started_total",
+        "Live tenant migrations entered (drain began)",
+    ),
+    "migrationsCompleted": REGISTRY.counter(
+        "kmamiz_fleet_migrations_completed_total",
+        "Migrations that replayed bit-exact and flipped the ring entry",
+    ),
+    "migrationsAborted": REGISTRY.counter(
+        "kmamiz_fleet_migrations_aborted_total",
+        "Migrations aborted back to the source (no split-brain path)",
+    ),
+}
+
+_counters_lock = threading.Lock()
+
+
+def _fresh_counters() -> dict:
+    return {
+        "framesRouted": 0,
+        "framesQueuedDuringDrain": 0,
+        "folds": 0,
+        "foldedEdges": 0,
+        "migrationsStarted": 0,
+        "migrationsCompleted": 0,
+        "migrationsAborted": 0,
+    }
+
+
+_counters = _fresh_counters()
+
+
+def incr(name: str, by: int = 1) -> None:
+    with _counters_lock:
+        _counters[name] = _counters.get(name, 0) + by
+    handle = _PROM_COUNTERS.get(name)
+    if handle is not None:
+        handle.inc(by)
+
+
+def snapshot() -> dict:
+    """The `fleet` section of /timings: static knob values plus the
+    routing/migration counters accumulated since the last reset."""
+    with _counters_lock:
+        counters = dict(_counters)
+    return {
+        "size": fleet_size(),
+        "vnodes": fleet_vnodes(),
+        "seed": fleet_seed(),
+        "enabled": enabled(),
+        **counters,
+    }
+
+
+def reset_for_tests() -> None:
+    """Drop the process-wide fleet counters (conftest autouse)."""
+    global _counters
+    with _counters_lock:
+        _counters = _fresh_counters()
+
+
+from kmamiz_tpu.fleet.ring import HashRing, RingError  # noqa: E402
+
+__all__ = [
+    "HashRing",
+    "RingError",
+    "coordinator_port",
+    "drain_timeout_ms",
+    "enabled",
+    "fleet_seed",
+    "fleet_size",
+    "fleet_vnodes",
+    "incr",
+    "reset_for_tests",
+    "snapshot",
+]

@@ -1,0 +1,106 @@
+"""Live tenant migration: drain -> ship WAL -> replay -> flip ring.
+
+The handoff protocol (docs/FLEET.md):
+
+1. **drain** — the coordinator marks the tenant draining (new frames
+   queue, nothing is dropped) and asks the source worker to quiesce at
+   the graph's ``stage_fence()``; the source answers with its pre-drain
+   ``graph_signature`` and durable record count.
+2. **ship** — the source's per-tenant WAL namespace serializes into one
+   handoff blob (``IngestWAL.export_handoff``).
+3. **replay** — the target imports the blob into a fresh WAL namespace
+   and replays it through a fresh processor; replay order drives
+   interner id assignment, so the rebuilt graph must hash bit-exact to
+   the source's pre-drain signature. A mismatch is corruption, not a
+   judgment call: the migration aborts.
+4. **flip** — only after the signature check does the coordinator flip
+   the ring entry and release the drained queue to the target.
+
+ANY failure — source unreachable (kill -9 mid-handoff), torn blob whose
+replay diverges, signature mismatch, drain timeout — takes the abort
+path: the ring entry never flipped, the queue releases back to the
+source, and the tenant keeps serving from its intact last-good state on
+the source. There is no intermediate state in which two workers both
+claim the tenant.
+"""
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from kmamiz_tpu import fleet as fleet_mod
+
+
+class MigrationError(RuntimeError):
+    """The handoff failed; the coordinator has already aborted back to
+    the source when this is raised from migrate_tenant."""
+
+
+def migrate_tenant(
+    coordinator,
+    tenant: str,
+    target: str,
+    drain_timeout_ms: Optional[float] = None,
+) -> dict:
+    """Move one tenant to ``target`` through the WAL-handoff protocol.
+    Returns a result dict (``ok``, ``source``, ``target``,
+    ``signature``, ``records``, ``queuedReleased``); raises
+    MigrationError after aborting when any stage fails."""
+    if drain_timeout_ms is None:
+        drain_timeout_ms = fleet_mod.drain_timeout_ms()
+    transport = coordinator.transport
+    source = coordinator.begin_drain(tenant)
+    fleet_mod.incr("migrationsStarted")
+    t0 = time.monotonic()
+    try:
+        if source == target:
+            raise MigrationError(
+                f"tenant {tenant!r} already lives on {target!r}"
+            )
+        if target not in coordinator.ring.workers:
+            raise MigrationError(f"target {target!r} is not on the ring")
+        pre = transport.drain(source, tenant)
+        blob = transport.wal_export(source, tenant)
+        _check_drain_budget(t0, drain_timeout_ms, tenant)
+        imported = transport.wal_import(target, tenant, blob)
+        if imported["signature"] != pre["signature"]:
+            raise MigrationError(
+                f"tenant {tenant!r} replay diverged: target "
+                f"{imported['signature'][:12]} != source pre-drain "
+                f"{pre['signature'][:12]}"
+            )
+        if imported["records"] != pre["walRecords"]:
+            raise MigrationError(
+                f"tenant {tenant!r} handoff lost records: shipped "
+                f"{imported['records']} of {pre['walRecords']}"
+            )
+    except Exception as err:
+        released = coordinator.abort_migration(tenant)
+        fleet_mod.incr("migrationsAborted")
+        if isinstance(err, MigrationError):
+            raise
+        raise MigrationError(
+            f"tenant {tenant!r} migration {source!r} -> {target!r} "
+            f"failed: {err}"
+        ) from err
+    released = coordinator.commit_migration(tenant, target)
+    fleet_mod.incr("migrationsCompleted")
+    return {
+        "ok": True,
+        "tenant": tenant,
+        "source": source,
+        "target": target,
+        "signature": imported["signature"],
+        "records": imported["records"],
+        "queuedReleased": len(released),
+        "drainMs": round((time.monotonic() - t0) * 1000.0, 1),
+    }
+
+
+def _check_drain_budget(t0: float, budget_ms: float, tenant: str) -> None:
+    elapsed_ms = (time.monotonic() - t0) * 1000.0
+    if budget_ms and elapsed_ms > budget_ms:
+        raise MigrationError(
+            f"tenant {tenant!r} drain exceeded "
+            f"{budget_ms:.0f}ms (took {elapsed_ms:.0f}ms)"
+        )

@@ -1,0 +1,124 @@
+"""Forecast-driven placement: which worker should own a tenant next.
+
+Pure scoring over observable state — no I/O, no clocks — so the same
+inputs always produce the same plan (the scenario runner replays
+placement decisions deterministically). Cost estimates come from the
+planes that already forecast per-tenant load: graftpilot's predicted
+tick costs (``control.predicted_costs``) and graftcost's learned
+program-cost model (``cost.predicted_tenant_costs``); a tenant neither
+plane has seen yet scores at the default weight, so placement works
+ungated and merely sharpens as forecasts arrive.
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from kmamiz_tpu import control as ctl_plane
+from kmamiz_tpu import cost as cost_plane
+from kmamiz_tpu.fleet.ring import HashRing
+
+#: weight for a tenant with no forecast from either plane
+DEFAULT_TENANT_WEIGHT = 1.0
+
+
+def tenant_weights(tenants: Iterable[str]) -> Dict[str, float]:
+    """Forecasted relative load per tenant: the max of graftpilot's
+    predicted tick cost and graftcost's predicted run cost (both in ms;
+    max, not sum, because they estimate the same underlying work from
+    different signals), floored at the default weight."""
+    pilot = ctl_plane.predicted_costs()
+    learned = cost_plane.predicted_tenant_costs()
+    weights = {}
+    for tenant in tenants:
+        forecast = max(
+            float(pilot.get(tenant, 0.0)), float(learned.get(tenant, 0.0))
+        )
+        weights[tenant] = forecast if forecast > 0.0 else DEFAULT_TENANT_WEIGHT
+    return weights
+
+
+def worker_loads(
+    ring: HashRing,
+    tenants: Iterable[str],
+    weights: Optional[Dict[str, float]] = None,
+    overrides: Optional[Dict[str, str]] = None,
+) -> Dict[str, float]:
+    """worker -> summed forecast weight under the current placement
+    (ring plus any migration overrides)."""
+    tenants = list(tenants)
+    if weights is None:
+        weights = tenant_weights(tenants)
+    overrides = overrides or {}
+    loads = {worker: 0.0 for worker in ring.workers}
+    for tenant in tenants:
+        owner = overrides.get(tenant) or ring.owner(tenant)
+        loads[owner] = loads.get(owner, 0.0) + weights.get(
+            tenant, DEFAULT_TENANT_WEIGHT
+        )
+    return loads
+
+
+def pick_target(
+    ring: HashRing,
+    tenant: str,
+    tenants: Iterable[str],
+    weights: Optional[Dict[str, float]] = None,
+    overrides: Optional[Dict[str, str]] = None,
+) -> str:
+    """Least-loaded worker for a tenant about to move, its own weight
+    excluded from every candidate (moving it empties its slot at the
+    source). Deterministic tie-break on worker id."""
+    tenants = list(tenants)
+    if weights is None:
+        weights = tenant_weights(tenants)
+    loads = worker_loads(ring, tenants, weights=weights, overrides=overrides)
+    overrides = overrides or {}
+    current = overrides.get(tenant) or ring.owner(tenant)
+    own = weights.get(tenant, DEFAULT_TENANT_WEIGHT)
+    loads[current] -= own
+    return min(sorted(loads), key=lambda worker: loads[worker])
+
+
+def rebalance_plan(
+    ring: HashRing,
+    tenants: Iterable[str],
+    weights: Optional[Dict[str, float]] = None,
+    overrides: Optional[Dict[str, str]] = None,
+    imbalance_ratio: float = 2.0,
+    max_moves: int = 1,
+) -> List[Tuple[str, str, str]]:
+    """(tenant, source, target) moves that shrink forecast imbalance.
+
+    Conservative by design: migrations cost a drain + replay, so the
+    plan proposes at most ``max_moves`` and only while the hottest
+    worker carries more than ``imbalance_ratio`` times the coldest's
+    forecast load. Each proposed move takes the hottest worker's
+    heaviest tenant to the coldest worker — the move with the best
+    imbalance reduction per migration."""
+    tenants = list(tenants)
+    if weights is None:
+        weights = tenant_weights(tenants)
+    overrides = dict(overrides or {})
+    moves: List[Tuple[str, str, str]] = []
+    for _ in range(max(0, max_moves)):
+        loads = worker_loads(
+            ring, tenants, weights=weights, overrides=overrides
+        )
+        hot = max(sorted(loads), key=lambda worker: loads[worker])
+        cold = min(sorted(loads), key=lambda worker: loads[worker])
+        if hot == cold or loads[hot] <= loads[cold] * imbalance_ratio:
+            break
+        owned = [
+            t
+            for t in tenants
+            if (overrides.get(t) or ring.owner(t)) == hot
+        ]
+        if len(owned) <= 1:
+            break  # one hot tenant IS the load; moving it just moves the hotspot
+        victim = max(
+            sorted(owned),
+            key=lambda t: weights.get(t, DEFAULT_TENANT_WEIGHT),
+        )
+        moves.append((victim, hot, cold))
+        overrides[victim] = cold
+    return moves

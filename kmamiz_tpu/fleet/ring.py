@@ -1,0 +1,121 @@
+"""Seeded consistent-hash ring: tenant -> worker placement.
+
+Every fleet process — workers, the coordinator, and any front end — must
+agree on which worker owns a tenant WITHOUT talking to each other, so
+placement is a pure function of (seed, worker ids, vnode count, tenant
+name). Hashes are sha256 over explicit strings: Python's builtin
+``hash`` is salted per process (PYTHONHASHSEED) and would scatter the
+fleet's routing tables.
+
+Each worker projects ``vnodes`` points onto a 64-bit ring; a tenant maps
+to the first worker point clockwise of its own hash. Vnodes give the
+classic consistent-hashing properties the migration path depends on:
+
+- adding or removing one worker moves only the tenants whose arc it
+  owned (minimal disruption — the resize tests pin this), and
+- load spreads near-uniformly without any central assignment state.
+
+Worker ids and tenants share the tenancy arena's name charset
+(`tenancy/arena.valid_tenant`): both become path components (per-worker
+WAL namespaces live under ``<wal-dir>/workers/<worker-id>``), so the
+same traversal-safe validation applies.
+"""
+from __future__ import annotations
+
+import bisect
+import hashlib
+from typing import Dict, Iterable, List, Sequence, Tuple
+
+from kmamiz_tpu.tenancy.arena import valid_tenant
+
+
+class RingError(ValueError):
+    """Invalid ring construction (bad/duplicate worker id, bad tenant)."""
+
+
+def _point(seed: int, key: str) -> int:
+    """Deterministic 64-bit ring coordinate for a key under a seed."""
+    digest = hashlib.sha256(f"{seed}|{key}".encode("utf-8")).digest()
+    return int.from_bytes(digest[:8], "big")
+
+
+class HashRing:
+    """Immutable seeded ring over a fixed worker set."""
+
+    __slots__ = ("_workers", "_vnodes", "_seed", "_points", "_keys")
+
+    def __init__(
+        self, workers: Sequence[str], vnodes: int = 64, seed: int = 0
+    ) -> None:
+        if not workers:
+            raise RingError("ring needs at least one worker")
+        if vnodes < 1:
+            raise RingError(f"vnodes must be >= 1, got {vnodes}")
+        seen = set()
+        for worker in workers:
+            if not isinstance(worker, str) or not valid_tenant(worker):
+                raise RingError(f"invalid worker id: {worker!r}")
+            if worker in seen:
+                raise RingError(f"duplicate worker id: {worker!r}")
+            seen.add(worker)
+        self._workers: Tuple[str, ...] = tuple(workers)
+        self._vnodes = int(vnodes)
+        self._seed = int(seed)
+        points: List[Tuple[int, str]] = []
+        for worker in self._workers:
+            for i in range(self._vnodes):
+                # the worker id is part of the hashed string, so equal
+                # points across workers (astronomically rare) still sort
+                # deterministically by the (point, worker) pair
+                points.append((_point(self._seed, f"{worker}#{i}"), worker))
+        points.sort()
+        self._points = points
+        self._keys = [p for p, _w in points]
+
+    @property
+    def workers(self) -> Tuple[str, ...]:
+        return self._workers
+
+    @property
+    def vnodes(self) -> int:
+        return self._vnodes
+
+    @property
+    def seed(self) -> int:
+        return self._seed
+
+    def owner(self, tenant: str) -> str:
+        """The worker owning a tenant: first vnode clockwise of the
+        tenant's hash (wrapping past the top of the ring)."""
+        if not isinstance(tenant, str) or not valid_tenant(tenant):
+            raise RingError(f"invalid tenant name: {tenant!r}")
+        h = _point(self._seed, f"tenant|{tenant}")
+        i = bisect.bisect_right(self._keys, h)
+        if i == len(self._keys):
+            i = 0
+        return self._points[i][1]
+
+    def assignment(self, tenants: Iterable[str]) -> Dict[str, str]:
+        """tenant -> worker for a tenant set (one bisect per tenant)."""
+        return {tenant: self.owner(tenant) for tenant in tenants}
+
+    def with_workers(self, workers: Sequence[str]) -> "HashRing":
+        """A resized ring sharing this one's seed and vnode count — the
+        grow/shrink path; only tenants on the changed arcs move."""
+        return HashRing(workers, vnodes=self._vnodes, seed=self._seed)
+
+    def spread(self, tenants: Iterable[str]) -> Dict[str, int]:
+        """worker -> owned-tenant count (placement diagnostics)."""
+        counts = {worker: 0 for worker in self._workers}
+        for tenant in tenants:
+            counts[self.owner(tenant)] += 1
+        return counts
+
+    def describe(self) -> dict:
+        """Ring table snapshot for /timings and the grafana panel."""
+        return {
+            "workers": list(self._workers),
+            "vnodes": self._vnodes,
+            "seed": self._seed,
+            "points": len(self._points),
+        }

@@ -1905,6 +1905,55 @@ class EndpointGraph:
                 count.copy_to_host_async()
             self._pending = (s, d, ds, count)
 
+    # -- cross-process fold (graftfleet, docs/FLEET.md) ----------------------
+
+    def export_named_edges(self) -> dict:
+        """Name-based edge snapshot for the fleet's hierarchical merge:
+        ``{"names", "src", "dst", "dist"}`` where src/dst index into
+        ``names`` (uniqueEndpointName strings), NOT into this store's
+        interner ids. Interner ids are assignment-order-local to a
+        process, so a cross-process fold must ship names and let the
+        importing store re-intern under its own order."""
+        src, dst, dist, mask = (np.asarray(a) for a in self.edge_arrays())
+        live = np.nonzero(mask)[0]
+        used = sorted({int(src[i]) for i in live} | {int(dst[i]) for i in live})
+        compact = {eid: idx for idx, eid in enumerate(used)}
+        return {
+            "names": [self.interner.endpoints.lookup(eid) for eid in used],
+            "src": [compact[int(src[i])] for i in live],
+            "dst": [compact[int(dst[i])] for i in live],
+            "dist": [int(dist[i]) for i in live],
+        }
+
+    def fold_named_edges(self, export: dict) -> int:
+        """Fold a worker's exported edge snapshot into this store: intern
+        the shipped endpoint names (id order local to THIS store), then
+        bulk set-union through merge_edges — the pow2-padded path, so a
+        fold whose padded shape was rehearsed dispatches only warm union
+        programs (a worker joining the fleet compiles nothing). Returns
+        the number of live edges folded."""
+        names = list(export.get("names", ()))
+        src_idx = np.asarray(export.get("src", ()), dtype=np.int64)
+        dst_idx = np.asarray(export.get("dst", ()), dtype=np.int64)
+        dist = np.asarray(export.get("dist", ()), dtype=np.int32)
+        if not (src_idx.shape == dst_idx.shape == dist.shape):
+            raise ValueError("named-edge export columns disagree on length")
+        if names and src_idx.size and int(
+            max(src_idx.max(), dst_idx.max())
+        ) >= len(names):
+            raise ValueError("named-edge export indexes past its name table")
+        ids = np.fromiter(
+            (self.interner.intern_endpoint(str(n)) for n in names),
+            dtype=np.int32,
+            count=len(names),
+        )
+        with self._lock:
+            self._ensure_ep_arrays(len(self.interner.endpoints))
+        if src_idx.size == 0:
+            return 0
+        self.merge_edges(ids[src_idx], ids[dst_idx], dist)
+        return int(src_idx.size)
+
     # -- warm start from the persisted dependency cache ----------------------
 
     def load_dependencies(self, records) -> None:

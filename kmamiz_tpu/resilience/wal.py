@@ -48,6 +48,10 @@ logger = logging.getLogger("kmamiz_tpu.resilience.wal")
 _HEADER = struct.Struct("<II")  # v1: payload_len, crc32
 _HEADER_V2 = struct.Struct("<IIB")  # payload_len, crc32, kind
 _SEGMENT_MAGIC = b"KMWL\x02\x00\x00\x00"
+# fleet migration handoff blob (docs/FLEET.md): the magic plus a stream
+# of v2 record frames — segment boundaries deliberately collapse so the
+# importing worker rebuilds its own segment layout
+_HANDOFF_MAGIC = b"KMHO\x01\x00\x00\x00"
 
 #: record wire-format kinds (the v2 frame kind byte)
 KIND_JSON = 0
@@ -288,6 +292,72 @@ class IngestWAL:
 
     def record_count(self) -> int:
         return sum(1 for _ in self.replay())
+
+    # -- fleet migration handoff (docs/FLEET.md) -----------------------------
+
+    def export_handoff(self) -> bytes:
+        """Serialize every durable record into one shippable blob: the
+        handoff magic followed by v2 frames. Built through
+        replay_records, so a torn tail on the SOURCE is already dropped
+        cleanly — the blob carries only records that would survive a
+        local crash replay (the target must not reconstruct MORE state
+        than the source would after kill -9)."""
+        parts = [_HANDOFF_MAGIC]
+        for kind, payload in self.replay_records():
+            parts.append(
+                _HEADER_V2.pack(len(payload), zlib.crc32(payload), kind)
+            )
+            parts.append(payload)
+        return b"".join(parts)
+
+    def import_handoff(self, data: bytes) -> int:
+        """Append a shipped handoff blob's records into this WAL,
+        oldest first; returns the record count imported. The same
+        stop-clean contract as replay_records: a torn tail on the
+        SHIPPED bytes (source died mid-export, truncated transfer)
+        imports the intact prefix; a crc mismatch or a kind byte that
+        contradicts its payload stops the import at the last good
+        record instead of raising. A missing magic is a protocol error
+        (wrong endpoint, not a torn stream) and raises ValueError."""
+        if data[: len(_HANDOFF_MAGIC)] != _HANDOFF_MAGIC:
+            raise ValueError("handoff blob missing KMHO magic")
+        offset = len(_HANDOFF_MAGIC)
+        imported = 0
+        while offset + _HEADER_V2.size <= len(data):
+            length, crc, kind = _HEADER_V2.unpack_from(data, offset)
+            start = offset + _HEADER_V2.size
+            end = start + length
+            if end > len(data):
+                logger.warning(
+                    "wal: torn handoff record at +%d, stopping import", offset
+                )
+                return imported
+            payload = data[start:end]
+            if zlib.crc32(payload) != crc:
+                logger.warning(
+                    "wal: handoff crc mismatch at +%d, stopping import", offset
+                )
+                return imported
+            is_columnar = payload[:4] == b"KMZC"
+            if kind not in (KIND_JSON, KIND_COLUMNAR) or (
+                kind == KIND_COLUMNAR
+            ) != is_columnar:
+                logger.warning(
+                    "wal: handoff kind byte %d contradicts payload at +%d, "
+                    "stopping import",
+                    kind,
+                    offset,
+                )
+                return imported
+            self.append(payload, kind)
+            imported += 1
+            offset = end
+        if offset != len(data):
+            logger.warning(
+                "wal: %d trailing handoff bytes, stopping import",
+                len(data) - offset,
+            )
+        return imported
 
     def truncate(self) -> None:
         """Drop all segments (their contents are captured by a durable

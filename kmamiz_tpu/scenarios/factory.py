@@ -3,8 +3,8 @@
 A *scenario* is one closed-loop soak specification: per-tenant sampled
 topologies (:mod:`.topology`), traffic curves (:mod:`.traffic`), and a
 failure storyline (:mod:`.storyline`), all drawn from one integer seed.
-The nine archetypes cover the production failure space the resilience,
-tenancy, cost, and streaming layers were built for; a matrix of size N
+The ten archetypes cover the production failure space the resilience,
+tenancy, cost, streaming, and fleet layers were built for; a matrix of size N
 instantiates the first N archetypes (cycling with fresh seeds past the
 vocabulary), and the ordering guarantees any matrix of ≥ 4 contains the
 cascade, multi-tenant, and kill-9/WAL-replay scenarios the acceptance
@@ -64,6 +64,18 @@ ARCHETYPES: Tuple[Tuple[str, Tuple[Tuple[str, str, str, Tuple[str, ...]], ...]],
     (
         "streaming-freshness",
         (("default", "fanout", "burst", ("tick-stall",)),),
+    ),
+    # graftfleet soak (docs/FLEET.md): three tenants spread across a
+    # 4-worker ring by consistent hash; alpha live-migrates mid-soak
+    # (drain -> WAL handoff -> replay -> ring flip) while beta/gamma
+    # traffic keeps flowing on their own workers
+    (
+        "fleet-migration",
+        (
+            ("alpha", "fanout", "steady", ("tenant-migration",)),
+            ("beta", "chain", "steady", ()),
+            ("gamma", "mesh", "steady", ()),
+        ),
     ),
 )
 

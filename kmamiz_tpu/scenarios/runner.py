@@ -401,7 +401,15 @@ def run_scenario(
         }
         stack.enter_context(scoped_env(env))
         _reset_shared_state()
-        card = _run_scenario_inner(spec, tmpdir, verbose)
+        if spec.archetype == "fleet-migration":
+            # archetype 10 runs the graftfleet harness: a 4-worker ring
+            # behind one coordinator, with the live WAL-handoff
+            # migration fired mid-soak (fleet/soak.py)
+            from kmamiz_tpu.fleet.soak import run_fleet_scenario
+
+            card = run_fleet_scenario(spec, tmpdir, verbose)
+        else:
+            card = _run_scenario_inner(spec, tmpdir, verbose)
     with _RUNS_LOCK:
         _RUNS.append(card)
     return card
@@ -412,7 +420,7 @@ def _reset_shared_state() -> None:
     binding (the default instance caches its directory at first use), a
     fresh tenant arena, a fresh graftpilot controller, a fresh graftcost
     plane."""
-    from kmamiz_tpu import control, cost, tenancy
+    from kmamiz_tpu import control, cost, fleet, tenancy
     from kmamiz_tpu.resilience import breaker, quarantine
     from kmamiz_tpu.server import stream as stream_mod
     from kmamiz_tpu.telemetry import freshness
@@ -424,6 +432,7 @@ def _reset_shared_state() -> None:
     cost.reset_for_tests()
     stream_mod.reset_for_tests()
     freshness.reset_for_tests()
+    fleet.reset_for_tests()
 
 
 def _run_scenario_inner(spec: ScenarioSpec, tmpdir: str, verbose: bool) -> dict:

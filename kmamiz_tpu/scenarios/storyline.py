@@ -30,7 +30,13 @@ A storyline is a tuple of :class:`Event` records, each active over a
   across its edge store's segment-consolidation threshold mid-soak
   (unique ``/grow/<k>`` endpoints per tick), exercising graftcost's
   predictive prewarm: the gate demands zero mid-tick compiles at the
-  crossing with prewarm on.
+  crossing with prewarm on;
+- ``tenant-migration`` — the fleet coordinator live-migrates the tenant
+  to another worker at the event tick (drain -> WAL handoff -> replay
+  -> ring flip, fleet/migration.py) while its traffic keeps flowing;
+  the gates demand zero lost spans, a bit-exact post-migration
+  ``graph_signature`` vs a serial reference replay, and zero
+  steady-state recompiles across the handoff.
 
 Events are fully resolved at compose time (all RNG draws happen here),
 so a storyline replays identically however the runner's wall clock
@@ -68,6 +74,7 @@ STORYLINE_KINDS = (
     "tick-stall",
     "kill9-replay",
     "capacity-growth",
+    "tenant-migration",
 )
 
 #: downstream services whose overload-modeled error rate crosses this
@@ -291,6 +298,17 @@ def compose_kill9(
     return Event("kill9-replay", at, 1)
 
 
+def compose_tenant_migration(
+    topo: Topology, rng: random.Random, n_ticks: int
+) -> Event:
+    """Fire the live migration strictly mid-soak: at least two warm
+    ticks land on the source first (so the handoff ships a non-trivial
+    WAL) and at least two more run on the target afterward (so the
+    post-flip steady state is measured, recompiles included)."""
+    at = rng.randint(2, max(2, n_ticks - 3))
+    return Event("tenant-migration", at, 1)
+
+
 # -- capacity growth (graftcost predictive-prewarm gate) ----------------------
 
 #: unique growth endpoints over the ramp — enough to push the default
@@ -425,6 +443,7 @@ _COMPOSERS = {
     "tick-stall": compose_tick_stall,
     "kill9-replay": compose_kill9,
     "capacity-growth": compose_capacity_growth,
+    "tenant-migration": compose_tenant_migration,
 }
 
 

@@ -25,6 +25,7 @@ from typing import Optional
 
 from kmamiz_tpu import control as ctl_plane
 from kmamiz_tpu import cost as cost_plane
+from kmamiz_tpu import fleet as fleet_mod
 from kmamiz_tpu.analysis import guards
 from kmamiz_tpu.core import programs
 from kmamiz_tpu.resilience import metrics as res_metrics
@@ -258,12 +259,63 @@ def make_handler(processor: DataProcessor, router=None):
                 },
             )
 
+        def _send_bytes(
+            self, status: int, body: bytes, content_type: str
+        ) -> None:
+            self.send_response(status)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
         def do_GET(self) -> None:  # health check (main.rs:28-31)
             route = self._route()
             if route is None:
                 return
-            _tenant, path = route
+            tenant, path = route
             path = path.split("?", 1)[0].rstrip("/")
+            if path == "/fleet/signature":
+                # the tenant's current graph content hash — the fleet
+                # migration's bit-exactness oracle (docs/FLEET.md)
+                from kmamiz_tpu.resilience.chaos import graph_signature
+
+                rt = self._runtime(tenant)
+                if rt is None:
+                    return
+                self._send_json(
+                    200,
+                    {
+                        "tenant": tenant,
+                        "signature": graph_signature(rt.processor.graph),
+                    },
+                )
+                return
+            if path == "/fleet/export":
+                # name-based edge snapshot for the coordinator's
+                # hierarchical fold (graph/store.export_named_edges)
+                rt = self._runtime(tenant)
+                if rt is None:
+                    return
+                self._send_json(
+                    200, rt.processor.graph.export_named_edges()
+                )
+                return
+            if path == "/fleet/wal":
+                # the tenant's WAL namespace as one handoff blob
+                rt = self._runtime(tenant)
+                if rt is None:
+                    return
+                wal = rt.processor.wal
+                if wal is None:
+                    self._send_json(
+                        409,
+                        {"error": "WAL disabled (KMAMIZ_WAL=0): no handoff"},
+                    )
+                    return
+                self._send_bytes(
+                    200, wal.export_handoff(), "application/octet-stream"
+                )
+                return
             if path == "/timings":
                 from kmamiz_tpu.core.profiling import step_timer
 
@@ -279,6 +331,7 @@ def make_handler(processor: DataProcessor, router=None):
                         "cost": cost_plane.snapshot(),
                         "freshness": tel_freshness.snapshot(),
                         "stream": stream_mod.stats(),
+                        "fleet": fleet_mod.snapshot(),
                     },
                 )
                 return
@@ -363,6 +416,63 @@ def make_handler(processor: DataProcessor, router=None):
                     req.get("durationMs", 100), req.get("dir")
                 )
                 self._send_json(200 if out.get("ok") else 409, out)
+                return
+
+            if post_path == "/fleet/drain":
+                # migration step 1: quiesce the tenant at the graph's
+                # stage_fence and answer the pre-drain signature +
+                # durable record count the target must reproduce
+                from kmamiz_tpu.resilience.chaos import graph_signature
+
+                rt = self._runtime(tenant)
+                if rt is None:
+                    return
+                rt.processor.graph.stage_fence()
+                wal = rt.processor.wal
+                self._send_json(
+                    200,
+                    {
+                        "tenant": tenant,
+                        "signature": graph_signature(rt.processor.graph),
+                        "walRecords": (
+                            wal.record_count() if wal is not None else 0
+                        ),
+                    },
+                )
+                return
+
+            if post_path == "/fleet/wal-import":
+                # migration step 3 (target side): fresh processor, fresh
+                # WAL namespace, import the shipped blob, replay it in
+                # order — then atomically install the rebuilt runtime so
+                # the first post-flip request serves the migrated graph
+                from kmamiz_tpu.resilience.chaos import graph_signature
+
+                proc = processor.sibling_for_tenant(tenant)
+                if proc.wal is None:
+                    self._send_json(
+                        409,
+                        {"error": "WAL disabled (KMAMIZ_WAL=0): no import"},
+                    )
+                    return
+                try:
+                    proc.wal.truncate()
+                    records = proc.wal.import_handoff(raw)
+                    replayed = proc.replay_wal()
+                except ValueError as e:
+                    self._send_json(400, {"error": str(e)})
+                    return
+                router.install_runtime(tenant, _make_runtime(tenant, proc))
+                self._send_json(
+                    200,
+                    {
+                        "tenant": tenant,
+                        "records": records,
+                        "replayed": replayed["replayed"],
+                        "spans": replayed["spans"],
+                        "signature": graph_signature(proc.graph),
+                    },
+                )
                 return
 
             if post_path == "/ingest":

@@ -160,6 +160,7 @@ class DataProcessor:
         use_device_stats: bool = True,
         now_ms: Callable[[], float] = prof_events.wall_ms,
         tenant: str = "default",
+        wal: object = "from_env",
     ) -> None:
         _tune_gc()
         self.tenant = tenant
@@ -212,9 +213,19 @@ class DataProcessor:
         # KMAMIZ_WAL=1: every successfully parsed ingest payload appends
         # BEFORE its graph merge, so a kill -9 mid-tick replays to a
         # bit-exact graph on restart (replay_wal). _wal_replaying
-        # suppresses re-appends while the replay itself runs.
-        self._wal = IngestWAL.from_env(tenant=tenant)
+        # suppresses re-appends while the replay itself runs. A fleet
+        # worker passes an explicit IngestWAL (or None) so each worker's
+        # tenant processors log under the WORKER's namespace instead of
+        # the env-wide one (fleet/worker.py); the "from_env" sentinel
+        # keeps the env-configured default for every other caller.
+        self._wal = IngestWAL.from_env(tenant=tenant) if wal == "from_env" else wal
         self._wal_replaying = False
+
+    @property
+    def wal(self) -> Optional[IngestWAL]:
+        """This processor's ingest WAL (None when durability is off) —
+        the fleet migration path exports/imports handoff blobs here."""
+        return self._wal
 
     def sibling_for_tenant(self, tenant: str) -> "DataProcessor":
         """A fresh DataProcessor for another tenant sharing this one's

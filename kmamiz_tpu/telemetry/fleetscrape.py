@@ -1,0 +1,116 @@
+"""Fleet scrape aggregation: N worker /metrics pages -> one exposition.
+
+Each fleet worker process runs its own unified registry and serves its
+own Prometheus text page; a fleet deployment wants ONE scrape target.
+This module merges worker pages sample-by-sample — counters and sums
+add, every series also re-emits per worker under a ``worker`` label so
+the grafana fleet row can chart per-worker spans/s next to the fleet
+total — without importing any worker state: input is the exposition
+text itself, so the aggregator works identically over HTTP-scraped
+subprocess workers and in-process test fixtures.
+
+Histogram series aggregate soundly under addition (bucket counts, sums,
+and counts are all counters); gauges add too, which is the correct
+fleet semantics for the occupancy-style gauges the registry exports
+(queue depths, arena buckets) — a fleet-wide depth IS the sum.
+"""
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Tuple
+
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>[^}]*)\})?"
+    r"\s+(?P<value>[^\s]+)\s*$"
+)
+
+
+def parse_exposition(text: str) -> List[Tuple[str, str, float]]:
+    """(metric name, label body, value) samples from one exposition
+    page. Comment/HELP/TYPE lines and malformed samples are skipped —
+    the aggregator must survive a worker mid-restart serving a torn
+    page."""
+    samples = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            continue
+        try:
+            value = float(m.group("value"))
+        except ValueError:
+            continue
+        samples.append((m.group("name"), m.group("labels") or "", value))
+    return samples
+
+
+def _with_worker_label(labels: str, worker: str) -> str:
+    tag = f'worker="{worker}"'
+    return f"{labels},{tag}" if labels else tag
+
+
+def aggregate(pages: Dict[str, str]) -> Dict[str, Dict[str, float]]:
+    """{metric: {label body: value}} summed across worker pages, plus
+    the per-worker breakdown under an added ``worker`` label. ``pages``
+    maps worker id -> that worker's exposition text."""
+    merged: Dict[str, Dict[str, float]] = {}
+    for worker in sorted(pages):
+        for name, labels, value in parse_exposition(pages[worker]):
+            series = merged.setdefault(name, {})
+            series[labels] = series.get(labels, 0.0) + value
+            per_worker = _with_worker_label(labels, worker)
+            series[per_worker] = series.get(per_worker, 0.0) + value
+    return merged
+
+
+def render(pages: Dict[str, str]) -> str:
+    """One merged exposition page (fleet totals + per-worker series).
+    HELP/TYPE metadata is intentionally dropped: the upstream pages
+    disagree on nothing but sample values, and a scraper that wants
+    metadata reads any single worker."""
+    merged = aggregate(pages)
+    out: List[str] = []
+    for name in sorted(merged):
+        for labels in sorted(merged[name]):
+            suffix = f"{{{labels}}}" if labels else ""
+            value = merged[name][labels]
+            rendered = repr(value) if value != int(value) else str(int(value))
+            out.append(f"{name}{suffix} {rendered}")
+    return "\n".join(out) + ("\n" if out else "")
+
+
+def spans_per_worker(
+    pages: Dict[str, str], metric: str = "kmamiz_ingest_payloads_total"
+) -> Dict[str, float]:
+    """Per-worker total of one counter family (label-summed) — the
+    grafana fleet row's per-worker spans/s series feed."""
+    totals = {}
+    for worker, text in pages.items():
+        totals[worker] = sum(
+            value
+            for name, _labels, value in parse_exposition(text)
+            if name == metric
+        )
+    return totals
+
+
+def scrape_workers(
+    endpoints: Dict[str, str], timeout_s: float = 10.0
+) -> Dict[str, str]:
+    """Fetch every worker's /metrics page; a dead worker contributes an
+    empty page (scrapes must not fail fleet-wide on one kill -9)."""
+    import urllib.error
+    import urllib.request
+
+    pages = {}
+    for worker, base in endpoints.items():
+        url = f"{base.rstrip('/')}/metrics"
+        try:
+            with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+                pages[worker] = resp.read().decode("utf-8", "replace")
+        except (urllib.error.URLError, OSError, TimeoutError):
+            pages[worker] = ""
+    return pages

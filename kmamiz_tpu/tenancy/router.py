@@ -151,6 +151,17 @@ class TickRouter:
                 self._runtimes[tenant] = rt
             return rt
 
+    def install_runtime(self, tenant: str, runtime: TenantRuntime) -> None:
+        """Atomically replace (or create) a tenant's runtime — the fleet
+        migration import (POST /fleet/wal-import) installs the freshly
+        replayed processor here, so the first request after the ring
+        flip serves the migrated graph instead of lazily re-creating an
+        empty sibling."""
+        if tenant != DEFAULT_TENANT and not valid_tenant(tenant):
+            raise TenantNameError(f"invalid tenant name: {tenant!r}")
+        with self._lock:
+            self._runtimes[tenant] = runtime
+
     def tenants(self) -> List[str]:
         with self._lock:
             return sorted(self._runtimes)

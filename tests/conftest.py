@@ -56,7 +56,7 @@ def _reset_resilience_state():
     breakers, counters, the default quarantine binding). A breaker a
     test trips must not short-circuit the next test's upstream calls, so
     every test starts from a clean slate."""
-    from kmamiz_tpu import control, cost, scenarios, telemetry, tenancy
+    from kmamiz_tpu import control, cost, fleet, scenarios, telemetry, tenancy
     from kmamiz_tpu.models import stlgt
     from kmamiz_tpu.ops import sparse
     from kmamiz_tpu.resilience import breaker, metrics, quarantine
@@ -76,6 +76,8 @@ def _reset_resilience_state():
     # the sparse backend knob is cached after first read; a test that
     # monkeypatches KMAMIZ_SPARSE* must not leak its choice forward
     sparse.reset_for_tests()
+    # graftfleet module counters (frames routed/queued, folds, migrations)
+    fleet.reset_for_tests()
     yield
 
 

@@ -1,0 +1,295 @@
+"""graftfleet: ring placement, WAL handoff edges, live migration,
+the coordinator's drain queue, the hierarchical fold, and the metrics
+scrape aggregator (docs/FLEET.md)."""
+import json
+import random
+
+import pytest
+
+from kmamiz_tpu import fleet
+from kmamiz_tpu.fleet import migration as migration_mod
+from kmamiz_tpu.fleet.coordinator import FleetCoordinator, LocalTransport
+from kmamiz_tpu.fleet.ring import HashRing, RingError
+from kmamiz_tpu.fleet.worker import FleetWorker
+from kmamiz_tpu.resilience.chaos import graph_signature
+from kmamiz_tpu.resilience.wal import (
+    _HANDOFF_MAGIC,
+    _HEADER_V2,
+    KIND_COLUMNAR,
+    IngestWAL,
+    zlib,
+)
+from kmamiz_tpu.scenarios.topology import sample_topology, trace_group
+
+
+# ---------------------------------------------------------------------------
+# consistent-hash ring
+
+
+def test_ring_deterministic_for_seed():
+    tenants = [f"tenant-{i}" for i in range(100)]
+    a = HashRing(["w0", "w1", "w2", "w3"], vnodes=32, seed=7)
+    b = HashRing(["w3", "w1", "w0", "w2"], vnodes=32, seed=7)  # order-free
+    assert a.assignment(tenants) == b.assignment(tenants)
+
+
+def test_ring_seed_changes_placement():
+    tenants = [f"tenant-{i}" for i in range(100)]
+    a = HashRing(["w0", "w1", "w2", "w3"], seed=0).assignment(tenants)
+    b = HashRing(["w0", "w1", "w2", "w3"], seed=1).assignment(tenants)
+    assert a != b
+
+
+def test_ring_minimal_disruption_on_grow():
+    tenants = [f"tenant-{i}" for i in range(200)]
+    before = HashRing(["w0", "w1", "w2", "w3"])
+    after = before.with_workers(["w0", "w1", "w2", "w3", "w4"])
+    placed, moved = before.assignment(tenants), after.assignment(tenants)
+    moves = {t for t in tenants if placed[t] != moved[t]}
+    # every displaced tenant lands on the NEW worker, and only the
+    # new worker's ~1/5 arc moves (consistent hashing's whole point)
+    assert all(moved[t] == "w4" for t in moves)
+    assert 0 < len(moves) < len(tenants) // 2
+
+
+def test_ring_rejects_duplicates_and_empties():
+    with pytest.raises(RingError):
+        HashRing(["w0", "w0"])
+    with pytest.raises(RingError):
+        HashRing([])
+    with pytest.raises(RingError):
+        HashRing(["w0"], vnodes=0)
+
+
+def test_ring_worker_and_tenant_charset_parity():
+    # worker ids and tenant names share the arena's charset rules, so a
+    # ring entry can never produce an invalid WAL path component
+    with pytest.raises(RingError):
+        HashRing(["w0", "../escape"])
+    ring = HashRing(["w0", "w1"])
+    with pytest.raises(RingError):
+        ring.owner("bad/../name")
+    # the charset IS the arena's: any arena-valid name places fine
+    ring.owner("ok-tenant_1.x")
+
+
+# ---------------------------------------------------------------------------
+# WAL handoff blob edges
+
+
+def _handoff_wal(tmp_path, name="src"):
+    wal = IngestWAL(str(tmp_path / name))
+    for i in range(3):
+        wal.append(json.dumps([{"rec": i}]).encode())
+    return wal
+
+
+def test_handoff_roundtrip_preserves_records(tmp_path):
+    src = _handoff_wal(tmp_path)
+    dst = IngestWAL(str(tmp_path / "dst"))
+    assert dst.import_handoff(src.export_handoff()) == 3
+    assert [p for _k, p in dst.replay_records()] == [
+        p for _k, p in src.replay_records()
+    ]
+
+
+def test_handoff_torn_tail_imports_intact_prefix(tmp_path):
+    blob = _handoff_wal(tmp_path).export_handoff()
+    dst = IngestWAL(str(tmp_path / "dst"))
+    assert dst.import_handoff(blob[:-3]) == 2  # last record torn mid-payload
+    assert dst.record_count() == 2
+
+
+def test_handoff_crc_mismatch_stops_clean(tmp_path):
+    blob = bytearray(_handoff_wal(tmp_path).export_handoff())
+    blob[-1] ^= 0xFF  # corrupt the last record's payload
+    dst = IngestWAL(str(tmp_path / "dst"))
+    assert dst.import_handoff(bytes(blob)) == 2
+
+
+def test_handoff_kind_contradiction_stops_clean(tmp_path):
+    payload = json.dumps([{"rec": 0}]).encode()  # JSON, not KMZC
+    blob = (
+        _HANDOFF_MAGIC
+        + _HEADER_V2.pack(len(payload), zlib.crc32(payload), KIND_COLUMNAR)
+        + payload
+    )
+    dst = IngestWAL(str(tmp_path / "dst"))
+    assert dst.import_handoff(blob) == 0
+    assert dst.record_count() == 0
+
+
+def test_handoff_missing_magic_raises(tmp_path):
+    dst = IngestWAL(str(tmp_path / "dst"))
+    with pytest.raises(ValueError):
+        dst.import_handoff(b"not a handoff blob")
+
+
+# ---------------------------------------------------------------------------
+# workers, coordinator, migration (in-process LocalTransport)
+
+
+def _window(tenant, tick, prefix="tf"):
+    topo = sample_topology("chain", random.Random(3), f"{prefix}-{tenant}")
+    return json.dumps(
+        [trace_group(topo, f"{prefix}-{tenant}", tick, i) for i in range(2)]
+    ).encode()
+
+
+@pytest.fixture
+def small_fleet(tmp_path):
+    ring = HashRing(["w0", "w1"])
+    workers = {
+        w: FleetWorker(w, wal_root=str(tmp_path / "wal"))
+        for w in ring.workers
+    }
+    coordinator = FleetCoordinator(ring, LocalTransport(workers))
+    return ring, workers, coordinator
+
+
+def test_migration_bit_exact_zero_loss(small_fleet):
+    ring, workers, coordinator = small_fleet
+    tenant = "alpha"
+    for tick in range(3):
+        assert coordinator.route_ingest(tenant, _window(tenant, tick))
+    source = coordinator.owner(tenant)
+    target = next(w for w in ring.workers if w != source)
+    pre_sig = workers[source].signature(tenant)
+
+    out = migration_mod.migrate_tenant(coordinator, tenant, target)
+    assert out["ok"] and out["records"] == 3
+    assert out["signature"] == pre_sig  # replayed graph is bit-exact
+    assert coordinator.owner(tenant) == target
+    assert workers[target].signature(tenant) == pre_sig
+    # post-flip traffic flows to the target
+    coordinator.route_ingest(tenant, _window(tenant, 9))
+    assert workers[target].summary()["frames"] >= 1
+
+
+def test_migration_drain_queue_releases_to_target(small_fleet):
+    ring, workers, coordinator = small_fleet
+    tenant = "alpha"
+    coordinator.route_ingest(tenant, _window(tenant, 0))
+    source = coordinator.owner(tenant)
+    target = next(w for w in ring.workers if w != source)
+
+    class MidHandoff:
+        def __init__(self, inner):
+            self._inner = inner
+
+        def __getattr__(self, name):
+            return getattr(self._inner, name)
+
+        def wal_export(self, worker_id, t):
+            # a frame races the handoff: it must park, not route
+            assert coordinator.route_ingest(t, _window(t, 5)) is None
+            return self._inner.wal_export(worker_id, t)
+
+    real = coordinator.transport
+    coordinator.swap_transport(MidHandoff(real))
+    try:
+        out = migration_mod.migrate_tenant(coordinator, tenant, target)
+    finally:
+        coordinator.swap_transport(real)
+    assert out["queuedReleased"] == 1
+    # the queued frame landed on the TARGET (source was never retouched)
+    assert workers[target].summary()["frames"] == 1
+    assert fleet.snapshot()["framesQueuedDuringDrain"] == 1
+
+
+def test_migration_aborts_when_source_dies_mid_handoff(small_fleet):
+    ring, workers, coordinator = small_fleet
+    tenant = "alpha"
+    coordinator.route_ingest(tenant, _window(tenant, 0))
+    source = coordinator.owner(tenant)
+    target = next(w for w in ring.workers if w != source)
+    pre_sig = workers[source].signature(tenant)
+
+    class Kill9:
+        def __init__(self, inner):
+            self._inner = inner
+
+        def __getattr__(self, name):
+            return getattr(self._inner, name)
+
+        def wal_export(self, worker_id, t):
+            raise ConnectionError("source killed mid-handoff")
+
+    real = coordinator.transport
+    coordinator.swap_transport(Kill9(real))
+    try:
+        with pytest.raises(migration_mod.MigrationError):
+            migration_mod.migrate_tenant(coordinator, tenant, target)
+    finally:
+        coordinator.swap_transport(real)
+    # no split-brain: ownership unchanged, source serves from last-good
+    assert coordinator.owner(tenant) == source
+    assert workers[source].signature(tenant) == pre_sig
+    assert coordinator.route_ingest(tenant, _window(tenant, 7)) is not None
+    assert fleet.snapshot()["migrationsAborted"] == 1
+
+
+def test_coordinator_fold_matches_tenant_edge_sum(small_fleet):
+    from kmamiz_tpu.graph.store import EndpointGraph
+
+    ring, workers, coordinator = small_fleet
+    tenants = ["alpha", "beta"]
+    for tenant in tenants:
+        for tick in range(2):
+            coordinator.route_ingest(tenant, _window(tenant, tick))
+    aggregate = EndpointGraph()
+    folded = coordinator.fold(tenants, aggregate)
+    per_tenant = sum(
+        int(workers[coordinator.owner(t)].processor(t).graph.n_edges)
+        for t in tenants
+    )
+    # disjoint tenant namespaces: the two-level merge neither loses nor
+    # invents edges
+    assert folded == per_tenant == int(aggregate.n_edges)
+
+
+def test_worker_without_wal_root_refuses_migration(small_fleet):
+    worker = FleetWorker("w9")
+    worker.ingest("alpha", _window("alpha", 0))
+    with pytest.raises(RuntimeError):
+        worker.wal_export("alpha")
+
+
+def test_fleet_migration_archetype_composes():
+    from kmamiz_tpu.scenarios.factory import build_scenario
+
+    spec = build_scenario("fleet-migration", 0, 9, 10)
+    assert [p.tenant for p in spec.tenants] == ["alpha", "beta", "gamma"]
+    assert spec.has_event("tenant-migration")
+    (tick,) = [
+        ev.at_tick for _t, ev in spec.events()
+        if ev.kind == "tenant-migration"
+    ]
+    assert 0 < tick < spec.n_ticks
+
+
+# ---------------------------------------------------------------------------
+# metrics scrape aggregation
+
+
+def test_fleetscrape_aggregates_and_labels_per_worker():
+    from kmamiz_tpu.telemetry import fleetscrape
+
+    pages = {
+        "w0": "# HELP noise\nkmamiz_ingest_payloads_total 3\n"
+        'kmamiz_tick_ms{q="p99"} 10\n',
+        "w1": "kmamiz_ingest_payloads_total 5\nmalformed{{{ 1\n",
+        "w2": "",  # dead worker: empty page must not break the merge
+    }
+    merged = fleetscrape.aggregate(pages)
+    assert merged["kmamiz_ingest_payloads_total"][""] == 8.0
+    assert merged["kmamiz_ingest_payloads_total"]['worker="w0"'] == 3.0
+    assert merged["kmamiz_tick_ms"]['q="p99",worker="w0"'] == 10.0
+    assert fleetscrape.spans_per_worker(pages) == {
+        "w0": 3.0,
+        "w1": 5.0,
+        "w2": 0.0,
+    }
+    page = fleetscrape.render(pages)
+    assert "kmamiz_ingest_payloads_total 8" in page
+    assert 'kmamiz_ingest_payloads_total{worker="w1"} 5' in page

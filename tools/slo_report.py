@@ -82,6 +82,11 @@ _EXTRA_GATED = (
     "stream_freshness_ms_p99",
     "prof_freshness_ms_p99",
     "stream_steady_recompiles",
+    # graftfleet (ROADMAP item 2 / docs/FLEET.md): spans dropped across
+    # the bench's live migration — the drain-queue handoff promises
+    # zero, so ANY loss is a regression (integer slack already makes
+    # one lost span fail)
+    "fleet_migration_lost_spans",
 )
 # boolean pass/fail keys: any True -> False flip is a regression (bool
 # is an int subclass, so the numeric threshold check would wave a
@@ -91,6 +96,9 @@ _BOOL_GATED = (
     "graph_refresh_pass",
     # the transfer-guarded warm stream must keep compiling NOTHING
     "stream_zero_recompiles_pass",
+    # the bench's fleet migration (drain -> WAL handoff -> replay ->
+    # ring flip) must keep landing bit-exact with zero loss
+    "fleet_migration_pass",
 )
 # higher-is-BETTER float floors: the numeric check above only catches
 # increases, so a coverage collapse would read as an "improvement".
@@ -107,6 +115,13 @@ _FLOOR_GATED = (
     # stream-vs-serial wall ratio: the overlap collapsing back to the
     # serial wall reads as a lower number — gate it as a floor
     "stream_vs_batch_speedup",
+    # graftfleet scaling pair: the 4-worker aggregate throughput and
+    # its per-worker efficiency vs the 1-worker baseline — a scaling
+    # collapse reads as lower numbers, so both gate as floors (and the
+    # efficiency also has the candidate-local absolute check below,
+    # host-core-guarded)
+    "fleet_spans_per_sec_4",
+    "fleet_scale_efficiency",
 )
 _ABS_SLACK_FLOOR = 0.02
 # absolute slack per key class: rates jitter in the 3rd decimal on tiny
@@ -161,6 +176,37 @@ def check_freshness_ceiling(result: dict):
         return [
             f"{_FRESHNESS_KEY} breached the absolute SLO: {p99}ms >= "
             f"{_FRESHNESS_CEILING_MS}ms ceiling"
+        ]
+    return []
+
+
+# graftfleet scale-out gate (ROADMAP item 2): 4 workers must hold >= 3x
+# the single-worker ingest rate, i.e. per-worker efficiency >= 0.75. The
+# expected shape is host-dependent exactly like the parse-scaling gate
+# above: 4 worker processes on a 1-core box only timeslice (no speedup
+# is physically available), so the absolute floor only arms when the
+# artifact's own host-core count could seat the workers. The floor-gated
+# baseline comparison above still catches relative collapses everywhere.
+_FLEET_EFFICIENCY_KEY = "fleet_scale_efficiency"
+_FLEET_EFFICIENCY_FLOOR = 0.75
+_FLEET_MIN_CORES = 4
+
+
+def check_fleet_scale(result: dict):
+    """Violation strings when the candidate's fleet efficiency misses
+    the absolute scale-out floor ([] when healthy, absent — a skipped
+    fleet section emits None — or the host cannot seat 4 workers)."""
+    cores = result.get("fleet_host_cores", result.get("e2e_host_cores"))
+    if not isinstance(cores, int) or cores < _FLEET_MIN_CORES:
+        return []
+    eff = result.get(_FLEET_EFFICIENCY_KEY)
+    if not isinstance(eff, (int, float)) or isinstance(eff, bool):
+        return []
+    if eff < _FLEET_EFFICIENCY_FLOOR:
+        return [
+            f"{_FLEET_EFFICIENCY_KEY} below the scale-out floor on a "
+            f"{cores}-core host: {eff} < {_FLEET_EFFICIENCY_FLOOR} "
+            f"(4-worker aggregate must hold >= 3x one worker)"
         ]
     return []
 
@@ -387,6 +433,7 @@ def main(argv=None) -> int:
     # candidate-local invariants, gated regardless of baseline overlap
     scaling_violations = check_thread_scaling(candidate)
     scaling_violations += check_freshness_ceiling(candidate)
+    scaling_violations += check_fleet_scale(candidate)
     print(render(candidate, cand_label))
     print(f"baseline: {base_label}; compared {len(compared)} key(s)")
     for msg in scaling_violations:

@@ -104,6 +104,10 @@ _PROM_COUNTERS = {
         "kmamiz_fleet_frames_queued_total",
         "Frames parked in a drain queue while their tenant migrated",
     ),
+    "framesRequeued": REGISTRY.counter(
+        "kmamiz_fleet_frames_requeued_total",
+        "Queued frames put back after a failed release (none dropped)",
+    ),
     "folds": REGISTRY.counter(
         "kmamiz_fleet_folds_total",
         "Hierarchical level-two folds into an aggregate graph",
@@ -133,6 +137,7 @@ def _fresh_counters() -> dict:
     return {
         "framesRouted": 0,
         "framesQueuedDuringDrain": 0,
+        "framesRequeued": 0,
         "folds": 0,
         "foldedEdges": 0,
         "migrationsStarted": 0,

@@ -19,6 +19,7 @@ worker processes — bench, ``KMAMIZ_FLEET_PROC=1``).
 from __future__ import annotations
 
 import json
+import logging
 import threading
 import urllib.error
 import urllib.request
@@ -26,6 +27,9 @@ from typing import Dict, Iterable, List, Optional
 
 from kmamiz_tpu import fleet as fleet_mod
 from kmamiz_tpu.fleet.ring import HashRing, RingError
+from kmamiz_tpu.telemetry.profiling.events import now_ms
+
+logger = logging.getLogger(__name__)
 
 
 class TransportError(RuntimeError):
@@ -61,6 +65,15 @@ class LocalTransport:
 
     def wal_import(self, worker_id: str, tenant: str, data: bytes) -> dict:
         return self._worker(worker_id).wal_import(tenant, data)
+
+    def commit_import(self, worker_id: str, tenant: str) -> dict:
+        return self._worker(worker_id).commit_import(tenant)
+
+    def abort_import(self, worker_id: str, tenant: str) -> dict:
+        return self._worker(worker_id).abort_import(tenant)
+
+    def drop_tenant(self, worker_id: str, tenant: str) -> dict:
+        return self._worker(worker_id).drop_tenant(tenant)
 
     def timings(self, worker_id: str) -> dict:
         worker = self._worker(worker_id)
@@ -123,6 +136,19 @@ class HTTPTransport:
             self._url(worker_id, tenant, "/fleet/wal-import"), data
         )
 
+    def commit_import(self, worker_id: str, tenant: str) -> dict:
+        return self._request(
+            self._url(worker_id, tenant, "/fleet/wal-commit"), b""
+        )
+
+    def abort_import(self, worker_id: str, tenant: str) -> dict:
+        return self._request(
+            self._url(worker_id, tenant, "/fleet/wal-abort"), b""
+        )
+
+    def drop_tenant(self, worker_id: str, tenant: str) -> dict:
+        return self._request(self._url(worker_id, tenant, "/fleet/drop"), b"")
+
     def timings(self, worker_id: str) -> dict:
         return self._request(self._url(worker_id, None, "/timings"))
 
@@ -137,9 +163,15 @@ class FleetCoordinator:
         # thread: every read/write holds _lock (graftlint's
         # unguarded-shared-state rule scans this module)
         self._lock = threading.RLock()
+        # begin_drain waits on this until the tenant's in-flight ingest
+        # sends (dispatched pre-drain, still on the wire) have landed, so
+        # a frame can never slip onto the source AFTER drain() captured
+        # the signature/record count it must reproduce on the target
+        self._barrier = threading.Condition(self._lock)
         self._overrides: Dict[str, str] = {}
         self._draining: set = set()
         self._queues: Dict[str, List[bytes]] = {}
+        self._inflight: Dict[str, int] = {}
 
     @property
     def transport(self):
@@ -173,17 +205,44 @@ class FleetCoordinator:
         """Send one frame to the tenant's owner; while the tenant is
         draining for migration the frame parks in its queue instead
         (released to whichever side the migration resolves to), so a
-        handoff never drops an in-flight window. Returns the worker's
-        ingest summary, or None for a queued frame."""
+        handoff never drops an in-flight window. A backlog left behind
+        by an earlier failed queue release delivers first, preserving
+        arrival order. Returns the worker's ingest summary, or None for
+        a frame that is (still) queued."""
         with self._lock:
             if tenant in self._draining:
                 self._queues.setdefault(tenant, []).append(raw)
                 fleet_mod.incr("framesQueuedDuringDrain")
                 return None
             worker_id = self.owner(tenant)
-        summary = self._transport.ingest(worker_id, tenant, raw)
+            backlog = self._queues.pop(tenant, None)
+            if backlog:
+                backlog.append(raw)
+            else:
+                backlog = None
+                self._inflight[tenant] = self._inflight.get(tenant, 0) + 1
+        if backlog is not None:
+            summaries = self._flush(tenant, worker_id, backlog)
+            # the new frame is last in the backlog: its summary came
+            # back only if the whole backlog flushed
+            if len(summaries) == len(backlog):
+                return summaries[-1]
+            return None
+        try:
+            summary = self._transport.ingest(worker_id, tenant, raw)
+        finally:
+            self._ingest_done(tenant)
         fleet_mod.incr("framesRouted")
         return summary
+
+    def _ingest_done(self, tenant: str) -> None:
+        with self._lock:
+            n = self._inflight.get(tenant, 0) - 1
+            if n > 0:
+                self._inflight[tenant] = n
+            else:
+                self._inflight.pop(tenant, None)
+            self._barrier.notify_all()
 
     # -- hierarchical fold ---------------------------------------------------
 
@@ -204,14 +263,34 @@ class FleetCoordinator:
 
     # -- migration hooks (fleet/migration.py drives these) -------------------
 
-    def begin_drain(self, tenant: str) -> str:
-        """Mark a tenant draining; frames queue from here on. Returns
-        the current owner (the migration source)."""
+    def begin_drain(
+        self, tenant: str, barrier_timeout_s: Optional[float] = None
+    ) -> str:
+        """Mark a tenant draining (frames queue from here on) and wait
+        for the tenant's in-flight ingest sends to land before
+        returning, so the source's drain() snapshot cannot race a frame
+        already on the wire. Returns the current owner (the migration
+        source). A barrier timeout rolls the drain flag back — frames
+        queued while waiting stay parked and route_ingest's backlog
+        path delivers them — and raises RingError."""
+        if barrier_timeout_s is None:
+            timeout_ms = fleet_mod.drain_timeout_ms()
+            barrier_timeout_s = timeout_ms / 1000.0 if timeout_ms else 30.0
         with self._lock:
             if tenant in self._draining:
                 raise RingError(f"tenant {tenant!r} is already draining")
             self._draining.add(tenant)
             self._queues.setdefault(tenant, [])
+            deadline = now_ms() + barrier_timeout_s * 1000.0
+            while self._inflight.get(tenant, 0):
+                remaining = (deadline - now_ms()) / 1000.0
+                if remaining <= 0:
+                    self._draining.discard(tenant)
+                    raise RingError(
+                        f"tenant {tenant!r} drain barrier timed out with "
+                        f"{self._inflight[tenant]} ingest send(s) in flight"
+                    )
+                self._barrier.wait(remaining)
             return self.owner(tenant)
 
     def commit_migration(self, tenant: str, target: str) -> List[dict]:
@@ -237,12 +316,47 @@ class FleetCoordinator:
             owner = self.owner(tenant)
         return self._flush(tenant, owner, queued)
 
-    def _flush(self, tenant: str, worker_id: str, queued: List[bytes]):
-        summaries = []
-        for raw in queued:
-            summaries.append(self._transport.ingest(worker_id, tenant, raw))
+    def _flush(
+        self, tenant: str, worker_id: str, queued: List[bytes]
+    ) -> List[dict]:
+        """Replay parked frames to ``worker_id`` in arrival order.
+        Never raises and never loses a frame: a send that fails (worker
+        unreachable mid-release — the kill -9 abort path) or a fresh
+        drain starting mid-flush puts the unsent remainder back at the
+        FRONT of the tenant's queue, where the next drain resolution or
+        route_ingest's backlog path delivers it. Returns the summaries
+        of the frames that did land."""
+        summaries: List[dict] = []
+        for pos, raw in enumerate(queued):
+            with self._lock:
+                if tenant in self._draining:
+                    self._requeue_locked(tenant, queued[pos:])
+                    return summaries
+                self._inflight[tenant] = self._inflight.get(tenant, 0) + 1
+            try:
+                summaries.append(
+                    self._transport.ingest(worker_id, tenant, raw)
+                )
+            except Exception as err:  # noqa: BLE001 - frames must survive
+                with self._lock:
+                    self._requeue_locked(tenant, queued[pos:])
+                logger.warning(
+                    "fleet flush to %s failed for tenant %s (%s frame(s) "
+                    "re-queued): %s",
+                    worker_id,
+                    tenant,
+                    len(queued) - pos,
+                    err,
+                )
+                return summaries
+            finally:
+                self._ingest_done(tenant)
             fleet_mod.incr("framesRouted")
         return summaries
+
+    def _requeue_locked(self, tenant: str, frames: List[bytes]) -> None:
+        self._queues.setdefault(tenant, [])[:0] = frames
+        fleet_mod.incr("framesRequeued", len(frames))
 
     def snapshot(self) -> dict:
         """Routing-state view for /timings and the grafana ring panel."""
@@ -254,4 +368,5 @@ class FleetCoordinator:
                 "queuedFrames": {
                     t: len(q) for t, q in self._queues.items() if q
                 },
+                "inflight": dict(self._inflight),
             }

@@ -12,16 +12,22 @@ The handoff protocol (docs/FLEET.md):
    and replays it through a fresh processor; replay order drives
    interner id assignment, so the rebuilt graph must hash bit-exact to
    the source's pre-drain signature. A mismatch is corruption, not a
-   judgment call: the migration aborts.
-4. **flip** — only after the signature check does the coordinator flip
-   the ring entry and release the drained queue to the target.
+   judgment call: the migration aborts. The rebuilt processor only
+   STAGES on the target (``wal_import`` is phase one of a two-phase
+   install) — it does not serve until the verification commits it.
+4. **flip** — only after the signature check does the coordinator
+   commit the staged processor on the target (``commit_import``), flip
+   the ring entry, and release the drained queue to the target; the
+   source then drops its copy, so exactly one worker serves the tenant.
 
 ANY failure — source unreachable (kill -9 mid-handoff), torn blob whose
 replay diverges, signature mismatch, drain timeout — takes the abort
-path: the ring entry never flipped, the queue releases back to the
-source, and the tenant keeps serving from its intact last-good state on
-the source. There is no intermediate state in which two workers both
-claim the tenant.
+path: the staged import is discarded (``abort_import``), the ring entry
+never flipped, the queue releases back to the source, and the tenant
+keeps serving from its intact last-good state on the source. A queue
+release that itself hits an unreachable worker re-queues the unsent
+frames instead of dropping them (coordinator._flush). There is no
+intermediate state in which two workers both claim the tenant.
 """
 from __future__ import annotations
 
@@ -49,20 +55,27 @@ def migrate_tenant(
     if drain_timeout_ms is None:
         drain_timeout_ms = fleet_mod.drain_timeout_ms()
     transport = coordinator.transport
+    # validate BEFORE begin_drain: a trivially bad request (unknown
+    # target, tenant already there) must fail without ever pausing the
+    # tenant's traffic or taking the abort/flush path
+    if target not in coordinator.ring.workers:
+        raise MigrationError(f"target {target!r} is not on the ring")
+    if coordinator.owner(tenant) == target:
+        raise MigrationError(f"tenant {tenant!r} already lives on {target!r}")
     source = coordinator.begin_drain(tenant)
     fleet_mod.incr("migrationsStarted")
     t0 = time.monotonic()
+    staged = False
     try:
-        if source == target:
+        if source == target:  # owner flipped between the check and drain
             raise MigrationError(
                 f"tenant {tenant!r} already lives on {target!r}"
             )
-        if target not in coordinator.ring.workers:
-            raise MigrationError(f"target {target!r} is not on the ring")
         pre = transport.drain(source, tenant)
         blob = transport.wal_export(source, tenant)
         _check_drain_budget(t0, drain_timeout_ms, tenant)
         imported = transport.wal_import(target, tenant, blob)
+        staged = True
         if imported["signature"] != pre["signature"]:
             raise MigrationError(
                 f"tenant {tenant!r} replay diverged: target "
@@ -74,8 +87,17 @@ def migrate_tenant(
                 f"tenant {tenant!r} handoff lost records: shipped "
                 f"{imported['records']} of {pre['walRecords']}"
             )
+        # verification passed: install the staged processor on the
+        # target FIRST, so the flip below releases the queue into the
+        # migrated graph, never a lazily-created empty one
+        transport.commit_import(target, tenant)
     except Exception as err:
-        released = coordinator.abort_migration(tenant)
+        if staged:
+            try:  # best-effort: the target may be unreachable too
+                transport.abort_import(target, tenant)
+            except Exception:  # noqa: BLE001 - abort must not mask err
+                pass
+        coordinator.abort_migration(tenant)
         fleet_mod.incr("migrationsAborted")
         if isinstance(err, MigrationError):
             raise
@@ -85,6 +107,12 @@ def migrate_tenant(
         ) from err
     released = coordinator.commit_migration(tenant, target)
     fleet_mod.incr("migrationsCompleted")
+    try:
+        # the source forgets the tenant: exactly one worker serves it
+        # post-flip even if the coordinator later rebuilds its overrides
+        transport.drop_tenant(source, tenant)
+    except Exception:  # noqa: BLE001 - committed; cleanup is best-effort
+        pass
     return {
         "ok": True,
         "tenant": tenant,

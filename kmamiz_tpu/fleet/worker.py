@@ -52,6 +52,10 @@ class FleetWorker:
         # and the migration-time swap both serialize here
         self._lock = threading.RLock()
         self._procs: Dict[str, "DataProcessor"] = {}
+        # replayed-but-unverified migration imports stage here until the
+        # coordinator's signature check commits (or aborts) them — an
+        # aborted handoff never leaves a divergent graph serving
+        self._pending_imports: Dict[str, "DataProcessor"] = {}
         self._frames = 0
         self._spans = 0
 
@@ -90,13 +94,18 @@ class FleetWorker:
         with self._lock:
             return sorted(self._procs)
 
-    def drop_tenant(self, tenant: str) -> None:
+    def drop_tenant(self, tenant: str) -> dict:
         """Forget a migrated-away tenant (its WAL directory stays on
         disk as the abort-path safety net until the next import)."""
         with self._lock:
             proc = self._procs.pop(tenant, None)
         if proc is not None and proc.wal is not None:
             proc.wal.close()
+        return {
+            "tenant": tenant,
+            "worker": self.worker_id,
+            "dropped": proc is not None,
+        }
 
     # -- ingest / fold surface ----------------------------------------------
 
@@ -143,8 +152,11 @@ class FleetWorker:
         map, empty graph, truncated WAL namespace) imports the shipped
         records and replays them in order — id assignment follows replay
         order, so the rebuilt graph's signature is bit-exact with the
-        source's pre-drain one. The new processor replaces any stale
-        entry only after the replay succeeds."""
+        source's pre-drain one. The rebuilt processor only STAGES here
+        (phase one): it starts serving when the coordinator's
+        signature/record-count verification calls commit_import, and an
+        aborted migration discards it via abort_import without ever
+        touching the tenant's live entry."""
         proc = self._fresh_processor(tenant)
         if proc.wal is None:
             raise RuntimeError(
@@ -154,10 +166,14 @@ class FleetWorker:
         imported = proc.wal.import_handoff(data)
         replayed = proc.replay_wal()
         with self._lock:
-            old = self._procs.get(tenant)
-            self._procs[tenant] = proc
-        if old is not None and old.wal is not None and old.wal is not proc.wal:
-            old.wal.close()
+            stale = self._pending_imports.pop(tenant, None)
+            self._pending_imports[tenant] = proc
+        if (
+            stale is not None
+            and stale.wal is not None
+            and stale.wal is not proc.wal
+        ):
+            stale.wal.close()
         return {
             "tenant": tenant,
             "worker": self.worker_id,
@@ -165,6 +181,37 @@ class FleetWorker:
             "replayed": replayed["replayed"],
             "spans": replayed["spans"],
             "signature": graph_signature(proc.graph),
+        }
+
+    def commit_import(self, tenant: str) -> dict:
+        """Phase two: the coordinator verified the replay — install the
+        staged processor as the tenant's live entry (replacing any stale
+        one) so the first post-flip frame serves the migrated graph."""
+        with self._lock:
+            proc = self._pending_imports.pop(tenant, None)
+            if proc is None:
+                raise RingError(
+                    f"no pending import for tenant {tenant!r} on worker "
+                    f"{self.worker_id!r}"
+                )
+            old = self._procs.get(tenant)
+            self._procs[tenant] = proc
+        if old is not None and old.wal is not None and old.wal is not proc.wal:
+            old.wal.close()
+        return {"tenant": tenant, "worker": self.worker_id, "installed": True}
+
+    def abort_import(self, tenant: str) -> dict:
+        """The migration aborted: discard the staged processor. The
+        tenant's live entry (if any) was never touched, so this worker
+        keeps serving exactly what it served before the handoff."""
+        with self._lock:
+            proc = self._pending_imports.pop(tenant, None)
+        if proc is not None and proc.wal is not None:
+            proc.wal.close()
+        return {
+            "tenant": tenant,
+            "worker": self.worker_id,
+            "dropped": proc is not None,
         }
 
     def summary(self) -> dict:

@@ -1938,10 +1938,17 @@ class EndpointGraph:
         dist = np.asarray(export.get("dist", ()), dtype=np.int32)
         if not (src_idx.shape == dst_idx.shape == dist.shape):
             raise ValueError("named-edge export columns disagree on length")
-        if names and src_idx.size and int(
-            max(src_idx.max(), dst_idx.max())
-        ) >= len(names):
-            raise ValueError("named-edge export indexes past its name table")
+        if src_idx.size:
+            if not names:
+                raise ValueError(
+                    "named-edge export has edges but no name table"
+                )
+            lo = int(min(src_idx.min(), dst_idx.min()))
+            hi = int(max(src_idx.max(), dst_idx.max()))
+            if lo < 0 or hi >= len(names):
+                raise ValueError(
+                    "named-edge export indexes past its name table"
+                )
         ids = np.fromiter(
             (self.interner.intern_endpoint(str(n)) for n in names),
             dtype=np.int32,

@@ -194,6 +194,14 @@ def make_handler(processor: DataProcessor, router=None):
 
         router = TickRouter(_factory)
 
+    # fleet migration two-phase import: /fleet/wal-import replays the
+    # shipped blob into a runtime that parks HERE; only the
+    # coordinator's post-verification /fleet/wal-commit installs it into
+    # the router (an aborted handoff discards it via /fleet/wal-abort,
+    # never having touched the tenant's live runtime)
+    pending_lock = threading.Lock()
+    pending_imports: dict = {}
+
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
 
@@ -444,8 +452,9 @@ def make_handler(processor: DataProcessor, router=None):
             if post_path == "/fleet/wal-import":
                 # migration step 3 (target side): fresh processor, fresh
                 # WAL namespace, import the shipped blob, replay it in
-                # order — then atomically install the rebuilt runtime so
-                # the first post-flip request serves the migrated graph
+                # order — the rebuilt runtime STAGES (phase one) until
+                # the coordinator's verification commits it, so an
+                # aborted migration never leaves a divergent graph live
                 from kmamiz_tpu.resilience.chaos import graph_signature
 
                 proc = processor.sibling_for_tenant(tenant)
@@ -462,7 +471,15 @@ def make_handler(processor: DataProcessor, router=None):
                 except ValueError as e:
                     self._send_json(400, {"error": str(e)})
                     return
-                router.install_runtime(tenant, _make_runtime(tenant, proc))
+                with pending_lock:
+                    stale = pending_imports.pop(tenant, None)
+                    pending_imports[tenant] = _make_runtime(tenant, proc)
+                if (
+                    stale is not None
+                    and stale.processor.wal is not None
+                    and stale.processor.wal is not proc.wal
+                ):
+                    stale.processor.wal.close()
                 self._send_json(
                     200,
                     {
@@ -472,6 +489,43 @@ def make_handler(processor: DataProcessor, router=None):
                         "spans": replayed["spans"],
                         "signature": graph_signature(proc.graph),
                     },
+                )
+                return
+
+            if post_path == "/fleet/wal-commit":
+                # migration step 4 (target side): the replay verified —
+                # atomically install the staged runtime so the first
+                # post-flip request serves the migrated graph
+                with pending_lock:
+                    rt = pending_imports.pop(tenant, None)
+                if rt is None:
+                    self._send_json(
+                        409,
+                        {"error": f"no pending import for tenant {tenant!r}"},
+                    )
+                    return
+                router.install_runtime(tenant, rt)
+                self._send_json(200, {"tenant": tenant, "installed": True})
+                return
+
+            if post_path == "/fleet/wal-abort":
+                # abort path: discard the staged runtime; the tenant's
+                # live runtime (if any) was never touched
+                with pending_lock:
+                    rt = pending_imports.pop(tenant, None)
+                if rt is not None and rt.processor.wal is not None:
+                    rt.processor.wal.close()
+                self._send_json(
+                    200, {"tenant": tenant, "dropped": rt is not None}
+                )
+                return
+
+            if post_path == "/fleet/drop":
+                # post-commit source cleanup: forget the migrated-away
+                # tenant so exactly one worker keeps live state for it
+                self._send_json(
+                    200,
+                    {"tenant": tenant, "dropped": router.drop_runtime(tenant)},
                 )
                 return
 

@@ -162,6 +162,17 @@ class TickRouter:
         with self._lock:
             self._runtimes[tenant] = runtime
 
+    def drop_runtime(self, tenant: str) -> bool:
+        """Remove a tenant's runtime (fleet migration: the source
+        forgets a migrated-away tenant after the ring flip). Its WAL
+        directory stays on disk as the abort-path safety net; only the
+        open handle closes. Returns whether a runtime existed."""
+        with self._lock:
+            rt = self._runtimes.pop(tenant, None)
+        if rt is not None and rt.processor.wal is not None:
+            rt.processor.wal.close()
+        return rt is not None
+
     def tenants(self) -> List[str]:
         with self._lock:
             return sorted(self._runtimes)

@@ -3,6 +3,7 @@ the coordinator's drain queue, the hierarchical fold, and the metrics
 scrape aggregator (docs/FLEET.md)."""
 import json
 import random
+import threading
 
 import pytest
 
@@ -227,6 +228,178 @@ def test_migration_aborts_when_source_dies_mid_handoff(small_fleet):
     assert workers[source].signature(tenant) == pre_sig
     assert coordinator.route_ingest(tenant, _window(tenant, 7)) is not None
     assert fleet.snapshot()["migrationsAborted"] == 1
+
+
+def test_migration_abort_flush_failure_requeues_frames(small_fleet):
+    """kill -9 worst case: the source is unreachable for BOTH the
+    handoff and the abort-path queue release. The queued frame must
+    survive (re-queued, never dropped), the abort counter must still
+    tick, and the frame delivers once the source is reachable again."""
+    ring, workers, coordinator = small_fleet
+    tenant = "alpha"
+    coordinator.route_ingest(tenant, _window(tenant, 0))
+    source = coordinator.owner(tenant)
+    target = next(w for w in ring.workers if w != source)
+    pre_frames = workers[source].summary()["frames"]
+
+    class Dead:
+        def __init__(self, inner):
+            self._inner = inner
+
+        def __getattr__(self, name):
+            return getattr(self._inner, name)
+
+        def wal_export(self, worker_id, t):
+            # a frame races the handoff: it parks in the drain queue
+            assert coordinator.route_ingest(t, _window(t, 5)) is None
+            raise ConnectionError("source killed mid-handoff")
+
+        def ingest(self, worker_id, t, raw):
+            raise ConnectionError("source still unreachable")
+
+    real = coordinator.transport
+    coordinator.swap_transport(Dead(real))
+    try:
+        with pytest.raises(migration_mod.MigrationError):
+            migration_mod.migrate_tenant(coordinator, tenant, target)
+    finally:
+        coordinator.swap_transport(real)
+    snap = fleet.snapshot()
+    assert snap["migrationsAborted"] == 1  # flush failure didn't mask it
+    assert snap["framesRequeued"] == 1
+    assert coordinator.snapshot()["queuedFrames"] == {tenant: 1}
+    assert coordinator.owner(tenant) == source
+    # the next routed frame delivers the backlog first, in order
+    assert coordinator.route_ingest(tenant, _window(tenant, 6)) is not None
+    assert workers[source].summary()["frames"] == pre_frames + 2
+    assert coordinator.snapshot()["queuedFrames"] == {}
+
+
+def test_migration_abort_discards_staged_import(small_fleet):
+    """Two-phase install: a replay that diverges is discarded on abort —
+    the target keeps NO live or staged state for the tenant."""
+    ring, workers, coordinator = small_fleet
+    tenant = "alpha"
+    for tick in range(2):
+        coordinator.route_ingest(tenant, _window(tenant, tick))
+    source = coordinator.owner(tenant)
+    target = next(w for w in ring.workers if w != source)
+
+    class Diverge:
+        def __init__(self, inner):
+            self._inner = inner
+
+        def __getattr__(self, name):
+            return getattr(self._inner, name)
+
+        def wal_import(self, worker_id, t, data):
+            out = self._inner.wal_import(worker_id, t, data)
+            return {**out, "signature": "deadbeef" * 8}
+
+    real = coordinator.transport
+    coordinator.swap_transport(Diverge(real))
+    try:
+        with pytest.raises(migration_mod.MigrationError, match="diverged"):
+            migration_mod.migrate_tenant(coordinator, tenant, target)
+    finally:
+        coordinator.swap_transport(real)
+    assert coordinator.owner(tenant) == source
+    assert tenant not in workers[target].tenants()
+    assert tenant not in workers[target]._pending_imports
+
+
+def test_migration_commit_drops_source_copy(small_fleet):
+    ring, workers, coordinator = small_fleet
+    tenant = "alpha"
+    for tick in range(2):
+        coordinator.route_ingest(tenant, _window(tenant, tick))
+    source = coordinator.owner(tenant)
+    target = next(w for w in ring.workers if w != source)
+    assert migration_mod.migrate_tenant(coordinator, tenant, target)["ok"]
+    # exactly one worker holds live state for the tenant post-flip —
+    # a coordinator restart that reverts to ring ownership cannot find
+    # a stale copy on the source
+    assert tenant in workers[target].tenants()
+    assert tenant not in workers[source].tenants()
+
+
+def test_migration_invalid_target_never_pauses_traffic(small_fleet):
+    ring, workers, coordinator = small_fleet
+    tenant = "alpha"
+    coordinator.route_ingest(tenant, _window(tenant, 0))
+    source = coordinator.owner(tenant)
+    with pytest.raises(migration_mod.MigrationError):
+        migration_mod.migrate_tenant(coordinator, tenant, "w9")  # off-ring
+    with pytest.raises(migration_mod.MigrationError):
+        migration_mod.migrate_tenant(coordinator, tenant, source)  # no-op
+    # neither bad request drained the tenant or touched a queue
+    snap = coordinator.snapshot()
+    assert snap["draining"] == [] and snap["queuedFrames"] == {}
+    assert fleet.snapshot()["migrationsStarted"] == 0
+    assert fleet.snapshot()["migrationsAborted"] == 0
+    assert coordinator.route_ingest(tenant, _window(tenant, 1)) is not None
+
+
+def test_begin_drain_waits_for_inflight_send(small_fleet):
+    """The drain barrier: a frame already on the wire must land BEFORE
+    the source's drain snapshot, so begin_drain blocks on it."""
+    ring, workers, coordinator = small_fleet
+    tenant = "alpha"
+    entered, release, drained = (
+        threading.Event(),
+        threading.Event(),
+        threading.Event(),
+    )
+    real = coordinator.transport
+
+    class Slow:
+        def __getattr__(self, name):
+            return getattr(real, name)
+
+        def ingest(self, worker_id, t, raw):
+            entered.set()
+            assert release.wait(10)
+            return real.ingest(worker_id, t, raw)
+
+    coordinator.swap_transport(Slow())
+    sender = threading.Thread(
+        target=coordinator.route_ingest, args=(tenant, _window(tenant, 0))
+    )
+    sender.start()
+    assert entered.wait(10)
+
+    def drain():
+        coordinator.begin_drain(tenant)
+        drained.set()
+
+    drainer = threading.Thread(target=drain)
+    drainer.start()
+    assert not drained.wait(0.3)  # barrier holds while the send flies
+    release.set()
+    assert drained.wait(10)  # ...and releases once it lands
+    sender.join(10)
+    drainer.join(10)
+    coordinator.swap_transport(real)
+    coordinator.abort_migration(tenant)
+    assert workers[coordinator.owner(tenant)].summary()["frames"] == 1
+
+
+def test_fold_named_edges_rejects_malformed_export():
+    from kmamiz_tpu.graph.store import EndpointGraph
+
+    g = EndpointGraph()
+    empty = {"names": [], "src": [], "dst": [], "dist": []}
+    assert g.fold_named_edges(empty) == 0
+    with pytest.raises(ValueError):  # edges but no name table
+        g.fold_named_edges({"names": [], "src": [0], "dst": [0], "dist": [1]})
+    with pytest.raises(ValueError):  # negative index must not wrap
+        g.fold_named_edges(
+            {"names": ["a", "b"], "src": [-1], "dst": [0], "dist": [1]}
+        )
+    with pytest.raises(ValueError):  # index past the table
+        g.fold_named_edges(
+            {"names": ["a"], "src": [0], "dst": [1], "dist": [1]}
+        )
 
 
 def test_coordinator_fold_matches_tenant_edge_sum(small_fleet):

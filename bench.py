@@ -2162,6 +2162,14 @@ def main() -> None:
     lint_result = lint_framework.lint_repo()
     graftlint_repo_ms = (time.perf_counter() - t0) * 1000
 
+    # graftrace: the 3 concurrency rules alone (lock-model build is the
+    # dominant cost; tools/graftrace.py --strict runs exactly this)
+    from tools.graftrace import CONCURRENCY_RULES
+
+    t0 = time.perf_counter()
+    trace_result = lint_framework.lint_repo(list(CONCURRENCY_RULES))
+    graftrace_repo_ms = (time.perf_counter() - t0) * 1000
+
     # SLO scorecard over this run's DP ticks (telemetry/slo.py): bench is
     # the first consumer of the headline keys ROADMAP item 5 asks for;
     # tools/slo_report.py --check gates regressions against these
@@ -2177,6 +2185,9 @@ def main() -> None:
         "graftlint_repo_ms": round(graftlint_repo_ms, 1),
         "graftlint_findings": len(lint_result.findings),
         "graftlint_suppressed": len(lint_result.suppressed),
+        "graftrace_repo_ms": round(graftrace_repo_ms, 1),
+        "graftrace_findings": len(trace_result.findings),
+        "graftrace_suppressed": len(trace_result.suppressed),
         "device_chain_spans_per_sec": round(spans_per_sec, 0),
         **e2e_extras,
         "e2e_bytes_per_span": round(e2e_bytes_per_span, 0),

@@ -54,6 +54,13 @@ DEFAULT_SEED_MODULES = (
     # fleet mode hides behind KMAMIZ_FLEET_SIZE
     "kmamiz_tpu/fleet/coordinator.py",
     "kmamiz_tpu/fleet/worker.py",
+    # the placement scorer, the migration protocol, and the soak driver
+    # run inside the archetype-10 scenario's tick loop — seed them so the
+    # hot-path rules see the whole fleet subsystem, not just the two
+    # verbs the coordinator/worker seeds happen to reach
+    "kmamiz_tpu/fleet/placement.py",
+    "kmamiz_tpu/fleet/migration.py",
+    "kmamiz_tpu/fleet/soak.py",
 )
 
 

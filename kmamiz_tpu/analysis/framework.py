@@ -116,6 +116,7 @@ def rule(name: str, doc: str):
 
 def all_rules() -> Dict[str, Rule]:
     from kmamiz_tpu.analysis import rules as _  # noqa: F401  (registers)
+    from kmamiz_tpu.analysis.concurrency import rules as _c  # noqa: F401
 
     return dict(_RULES)
 

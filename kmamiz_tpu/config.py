@@ -237,6 +237,12 @@ class Settings:
             os.environ.get("KMAMIZ_FLEET_DRAIN_TIMEOUT_MS", "5000")
         )
     )  # migration drain budget; a handoff past this aborts to the source
+    lock_witness: bool = field(
+        default_factory=lambda: os.environ.get("KMAMIZ_LOCK_WITNESS", "0")
+        == "1"
+    )  # graftrace runtime lock witness (analysis/concurrency/witness.py);
+    # the witness module reads the env var directly at arm time — this
+    # field mirrors it so one `Settings()` dump shows everything
 
     # graftprof profiler (kmamiz_tpu/telemetry/profiling/, the
     # "Profiling" section of docs/OBSERVABILITY.md). The profiling

@@ -31,10 +31,10 @@ intermediate state in which two workers both claim the tenant.
 """
 from __future__ import annotations
 
-import time
 from typing import Optional
 
 from kmamiz_tpu import fleet as fleet_mod
+from kmamiz_tpu.telemetry.profiling import events as prof_events
 
 
 class MigrationError(RuntimeError):
@@ -64,7 +64,7 @@ def migrate_tenant(
         raise MigrationError(f"tenant {tenant!r} already lives on {target!r}")
     source = coordinator.begin_drain(tenant)
     fleet_mod.incr("migrationsStarted")
-    t0 = time.monotonic()
+    t0_ms = prof_events.now_ms()
     staged = False
     try:
         if source == target:  # owner flipped between the check and drain
@@ -73,7 +73,7 @@ def migrate_tenant(
             )
         pre = transport.drain(source, tenant)
         blob = transport.wal_export(source, tenant)
-        _check_drain_budget(t0, drain_timeout_ms, tenant)
+        _check_drain_budget(t0_ms, drain_timeout_ms, tenant)
         imported = transport.wal_import(target, tenant, blob)
         staged = True
         if imported["signature"] != pre["signature"]:
@@ -121,12 +121,12 @@ def migrate_tenant(
         "signature": imported["signature"],
         "records": imported["records"],
         "queuedReleased": len(released),
-        "drainMs": round((time.monotonic() - t0) * 1000.0, 1),
+        "drainMs": round(prof_events.now_ms() - t0_ms, 1),
     }
 
 
-def _check_drain_budget(t0: float, budget_ms: float, tenant: str) -> None:
-    elapsed_ms = (time.monotonic() - t0) * 1000.0
+def _check_drain_budget(t0_ms: float, budget_ms: float, tenant: str) -> None:
+    elapsed_ms = prof_events.now_ms() - t0_ms
     if budget_ms and elapsed_ms > budget_ms:
         raise MigrationError(
             f"tenant {tenant!r} drain exceeded "

@@ -29,7 +29,6 @@ from __future__ import annotations
 
 import json
 import os
-import time
 from typing import Dict, List
 
 from kmamiz_tpu import fleet as fleet_mod
@@ -37,6 +36,7 @@ from kmamiz_tpu.fleet import migration as migration_mod
 from kmamiz_tpu.fleet import placement
 from kmamiz_tpu.fleet.coordinator import FleetCoordinator, LocalTransport
 from kmamiz_tpu.fleet.ring import HashRing
+from kmamiz_tpu.telemetry.profiling import events as prof_events
 from kmamiz_tpu.fleet.worker import FleetWorker
 
 class _MidHandoffTransport:
@@ -69,7 +69,7 @@ def run_fleet_scenario(
     from kmamiz_tpu.scenarios.topology import trace_group
     from kmamiz_tpu.telemetry.slo import percentile
 
-    t_start = time.time()
+    t_start_ms = prof_events.now_ms()
     size = max(2, fleet_mod.fleet_size()) if fleet_mod.enabled() else 4
     ring = HashRing(
         [f"w{i}" for i in range(size)],
@@ -107,9 +107,9 @@ def run_fleet_scenario(
         state["expected"][tenant].append(raw)
         for group in json.loads(raw):
             state["expected_traces"][tenant].append(group[0]["traceId"])
-        t0 = time.perf_counter()
+        t0 = prof_events.now_ms()
         summary = coordinator.route_ingest(tenant, raw)
-        state["latencies"].append((time.perf_counter() - t0) * 1000.0)
+        state["latencies"].append(prof_events.now_ms() - t0)
         state["posts"] += 1
         if summary is not None and summary.get("quarantined"):
             state["errors"].append(
@@ -288,6 +288,25 @@ def run_fleet_scenario(
         ),
         "fold_consistent": folded_edges == sum(live_edges.values()),
     }
+    from kmamiz_tpu.analysis.concurrency import witness
+
+    lock_witness = None
+    if witness.installed():
+        report = witness.check()
+        gates["lock_witness_acyclic"] = report.acyclic
+        # a witnessed edge the static model missed is an extractor blind
+        # spot — the soak fails so the model gets fixed, not ignored
+        gates["lock_witness_covered"] = (
+            not report.uncovered and not report.unknown_sites
+        )
+        lock_witness = {
+            "edges": report.edge_count,
+            "acquires": report.acquire_count,
+            "cycles": report.cycles,
+            "uncovered": [list(p) for p in report.uncovered],
+            "unknownSites": report.unknown_sites,
+            "peerEdges": report.peer_edges,
+        }
     lat = sorted(state["latencies"])
     card = {
         "name": spec.name,
@@ -318,10 +337,11 @@ def run_fleet_scenario(
             "workers": {w: workers[w].summary() for w in ring.workers},
         },
         "wal": None,
+        "lock_witness": lock_witness,
         "errors": state["errors"][:4],
         "gates": gates,
         "pass": all(gates.values()),
-        "wall_s": round(time.time() - t_start, 1),
+        "wall_s": round((prof_events.now_ms() - t_start_ms) / 1000.0, 1),
     }
     if not card["pass"]:
         from kmamiz_tpu.telemetry.profiling import recorder

@@ -1719,10 +1719,11 @@ class EndpointGraph:
             int(mesh.shape["spans"]) if use_mesh else None,
         )
         memo_key = base_key + (snap["version"],)
-        hit = self._scorer_memo.get(memo_key)
-        if hit is not None:
-            with self._lock:
+        with self._lock:
+            hit = self._scorer_memo.get(memo_key)
+            if hit is not None:
                 self.scorer_stats["hits"] += 1
+        if hit is not None:
             return hit
         with step_timer.phase("scorers"):
             result = self._compute_scores(
@@ -1768,7 +1769,8 @@ class EndpointGraph:
                 ep_record_d,
                 num_services=svc_cap,
             )
-        prev = self._scorer_prev.get(base_key)
+        with self._lock:
+            prev = self._scorer_prev.get(base_key)
         if prev is not None:
             inc = self._incremental_scores(
                 kind, snap, prev, mask, ep_service_d, ep_ml_d, ep_record_d

@@ -212,6 +212,7 @@ class IngestWAL:
             self._fh.write(frame)
             self._fh.flush()
             if self._fsync:
+                # graftlint: disable=blocking-call-under-lock -- durability order must equal append order, and rotation may close the fd the moment the lock drops
                 os.fsync(self._fh.fileno())
             self._records_appended += 1
             self._rotate_if_needed_locked()

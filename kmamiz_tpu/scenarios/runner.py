@@ -400,6 +400,13 @@ def run_scenario(
             ),
         }
         stack.enter_context(scoped_env(env))
+        # lock-witness (KMAMIZ_LOCK_WITNESS=1): every lock the scenario
+        # constructs from here on records real acquisition orders; the
+        # fleet soak cross-checks them against the static graftrace model
+        from kmamiz_tpu.analysis.concurrency import witness
+
+        if witness.enabled():
+            stack.enter_context(witness.armed())
         _reset_shared_state()
         if spec.archetype == "fleet-migration":
             # archetype 10 runs the graftfleet harness: a 4-worker ring

@@ -325,6 +325,7 @@ def make_handler(processor: DataProcessor, router=None):
                 )
                 return
             if path == "/timings":
+                from kmamiz_tpu.analysis.concurrency import witness
                 from kmamiz_tpu.core.profiling import step_timer
 
                 self._send_json(
@@ -340,6 +341,7 @@ def make_handler(processor: DataProcessor, router=None):
                         "freshness": tel_freshness.snapshot(),
                         "stream": stream_mod.stats(),
                         "fleet": fleet_mod.snapshot(),
+                        "lockWitness": witness.snapshot(),
                     },
                 )
                 return
@@ -784,6 +786,13 @@ def main() -> None:
     from kmamiz_tpu.core import compile_cache
 
     compile_cache.enable_from_env()
+    # arm the lock witness BEFORE the processor exists so every lock the
+    # serving stack creates is wrapped (KMAMIZ_LOCK_WITNESS=1; the
+    # scenario runner does the same for soaks — docs/STATIC_ANALYSIS.md)
+    from kmamiz_tpu.analysis.concurrency import witness as lock_witness
+
+    if lock_witness.enabled():
+        lock_witness.install()
     zipkin = ZipkinClient(os.environ.get("ZIPKIN_URL", ""))
     k8s = None
     kube_host = os.environ.get("KUBEAPI_HOST", "")

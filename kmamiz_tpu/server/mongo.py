@@ -131,6 +131,7 @@ class MongoClient:
 
     def _connect(self) -> socket.socket:
         if self._sock is None:
+            # graftlint: disable=blocking-call-under-lock -- single-socket client: every command needs this connection, so waiting callers gain nothing from connecting outside the lock
             s = socket.create_connection(self._addr, timeout=self._timeout)
             s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             try:

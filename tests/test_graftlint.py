@@ -30,6 +30,10 @@ ALL_RULES = {
     "hot-path-metric-label",
     "hot-path-clock",
     "prof-counter-wire",
+    # graftrace concurrency rules (analysis/concurrency/, tools/graftrace.py)
+    "lock-order-cycle",
+    "blocking-call-under-lock",
+    "inconsistent-guard",
 }
 
 

@@ -1,0 +1,337 @@
+"""graftrace concurrency analyzer: fixture-corpus marker equality for
+the three rules, the repo-clean strict gate (CLI + in-process), the
+lock-model views, the runtime lock witness, and the regression pins for
+the repo findings the analyzer surfaced (graph/store.py scorer reads).
+"""
+from __future__ import annotations
+
+import re
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from kmamiz_tpu.analysis import framework
+from kmamiz_tpu.analysis.concurrency import locks, witness
+
+REPO_ROOT = Path(__file__).parent.parent
+FIXTURE_ROOT = Path(__file__).parent / "fixtures" / "lint"
+_EXPECT_RE = re.compile(r"#\s*EXPECT:\s*([\w,\s-]+)")
+
+CONCURRENCY_RULES = (
+    "lock-order-cycle",
+    "blocking-call-under-lock",
+    "inconsistent-guard",
+)
+
+
+def _expected_markers():
+    expected = set()
+    for path in sorted(FIXTURE_ROOT.rglob("*.py")):
+        rel = path.relative_to(FIXTURE_ROOT).as_posix()
+        for lineno, line in enumerate(path.read_text().splitlines(), 1):
+            m = _EXPECT_RE.search(line)
+            if not m:
+                continue
+            for rule in m.group(1).split(","):
+                rule = rule.strip()
+                if rule in CONCURRENCY_RULES:
+                    expected.add((rel, lineno, rule))
+    return expected
+
+
+@pytest.fixture(scope="module")
+def corpus_result():
+    return framework.lint_paths(
+        str(FIXTURE_ROOT), rules=list(CONCURRENCY_RULES), tables=({}, {})
+    )
+
+
+class TestFixtureCorpus:
+    def test_findings_match_markers_exactly(self, corpus_result):
+        got = {(f.path, f.line, f.rule) for f in corpus_result.findings}
+        expected = _expected_markers()
+        assert got == expected, (
+            f"missing: {sorted(expected - got)}\n"
+            f"unexpected: {sorted(got - expected)}"
+        )
+
+    def test_each_rule_catches_its_seeded_violation(self, corpus_result):
+        assert {f.rule for f in corpus_result.findings} == set(
+            CONCURRENCY_RULES
+        )
+
+    def test_clean_twins_are_silent(self, corpus_result):
+        assert not [
+            f
+            for f in corpus_result.findings
+            if f.path.endswith("_clean.py")
+        ]
+
+    def test_cycle_finding_carries_the_full_path(self, corpus_result):
+        (f,) = [
+            f for f in corpus_result.findings if f.rule == "lock-order-cycle"
+        ]
+        # both directions of the 2-cycle, with file:line provenance
+        assert f.message.count("->") == 2
+        assert "_ingest_lock" in f.message and "_publish_lock" in f.message
+        assert re.search(r"deadlock\.py:\d+", f.message)
+
+    def test_guard_finding_names_majority_lock_and_votes(self, corpus_result):
+        (f,) = [
+            f for f in corpus_result.findings if f.rule == "inconsistent-guard"
+        ]
+        assert "Router._lock" in f.message
+        assert "2/3" in f.message
+        assert "Router._aux" in f.message
+
+
+class TestRepoClean:
+    """Tier-1: the repo itself must be graftrace-clean (strict)."""
+
+    def test_repo_has_no_unsuppressed_findings(self):
+        result = framework.lint_repo(list(CONCURRENCY_RULES))
+        assert not result.findings, "\n" + framework.render_text(result)
+
+    def test_every_suppression_has_a_reason(self):
+        result = framework.lint_repo(list(CONCURRENCY_RULES))
+        missing = result.missing_reasons()
+        assert not missing, (
+            "suppressions without `-- <why>`: "
+            + ", ".join(f"{p}:{s.line}" for p, s in missing)
+        )
+
+    def test_cli_strict_exits_zero(self):
+        proc = subprocess.run(
+            [
+                sys.executable,
+                str(REPO_ROOT / "tools" / "graftrace.py"),
+                "--strict",
+            ],
+            cwd=REPO_ROOT,
+            capture_output=True,
+            text=True,
+            timeout=300,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "0 finding(s)" in proc.stdout
+
+
+class TestLockModel:
+    @pytest.fixture(scope="class")
+    def model(self):
+        return locks.repo_model()
+
+    def test_inventories_known_locks(self, model):
+        for lid in (
+            "kmamiz_tpu/graph/store.py:EndpointGraph._lock",
+            "kmamiz_tpu/fleet/coordinator.py:FleetCoordinator._lock",
+            "kmamiz_tpu/telemetry/registry.py:Counter._lock",
+            "kmamiz_tpu/fleet/__init__.py:_counters_lock",
+        ):
+            assert lid in model.locks, lid
+
+    def test_condition_aliases_to_underlying_lock(self, model):
+        barrier = "kmamiz_tpu/fleet/coordinator.py:FleetCoordinator._barrier"
+        assert model.locks[barrier].alias_of == (
+            "kmamiz_tpu/fleet/coordinator.py:FleetCoordinator._lock"
+        )
+
+    def test_repo_order_graph_is_acyclic(self, model):
+        assert locks.find_cycles(model) == []
+
+    def test_declared_edges_are_live_not_stale(self, model):
+        # a DECLARED_EDGES entry naming a lock the extractor no longer
+        # sees must surface as a lock-order-cycle finding, not rot
+        assert model.stale_declared == []
+        assert (
+            "kmamiz_tpu/graph/store.py:EndpointGraph._lock",
+            "kmamiz_tpu/core/programs.py:Program._lock",
+        ) in model.wide_edge_pairs
+
+    def test_package_init_call_edges_resolve(self, model):
+        # `fleet_mod.incr(...)` under the coordinator lock reaches the
+        # counters lock in fleet/__init__.py — the package-__init__
+        # resolution this model needs so the witness coverage holds
+        pair = (
+            "kmamiz_tpu/fleet/coordinator.py:FleetCoordinator._lock",
+            "kmamiz_tpu/fleet/__init__.py:_counters_lock",
+        )
+        assert pair in model.wide_edge_pairs
+
+    def test_annotated_parameter_lock_resolves(self, model):
+        # `with session.lock:` where the signature says
+        # `session: RawIngestSession` must name the session lock
+        pair = (
+            "kmamiz_tpu/core/spans.py:RawIngestSession.lock",
+            "kmamiz_tpu/core/interning.py:EndpointInterner._intern_lock",
+        )
+        assert pair in model.wide_edge_pairs
+
+
+class TestWitness:
+    def test_disabled_by_default(self, monkeypatch):
+        monkeypatch.delenv(witness.ENV_WITNESS, raising=False)
+        assert not witness.enabled()
+        monkeypatch.setenv(witness.ENV_WITNESS, "1")
+        assert witness.enabled()
+
+    def test_armed_wraps_repo_created_locks_only(self):
+        import threading
+
+        from kmamiz_tpu.telemetry.registry import Counter
+
+        with witness.armed():
+            repo_lock = Counter()._lock  # created inside registry.py
+            local_lock = threading.Lock()  # created here, in tests/
+            assert type(repo_lock).__name__ == "_WitnessLock"
+            assert type(local_lock).__name__ != "_WitnessLock"
+        assert not witness.installed()
+
+    def test_records_order_edges_and_finds_cycles(self):
+        from kmamiz_tpu.telemetry.registry import Counter, Gauge
+
+        with witness.armed():
+            c, g = Counter(), Gauge()
+            c._lock.acquire()
+            g._lock.acquire()  # edge Counter._lock -> Gauge._lock
+            g._lock.release()
+            c._lock.release()
+            g._lock.acquire()
+            c._lock.acquire()  # reverse edge: closes the cycle
+            c._lock.release()
+            g._lock.release()
+        report = witness.check(static=(set(), set()))
+        assert report.edge_count == 2
+        assert not report.acyclic and len(report.cycles) == 1
+        assert any("registry.py" in s for s in report.cycles[0])
+        # both sites are unknown to the (empty) static model handed in
+        assert report.unknown_sites and report.uncovered
+
+    def test_witnessed_edge_missing_from_static_model_is_a_finding(self):
+        from kmamiz_tpu.telemetry.registry import Counter, Gauge
+
+        with witness.armed():
+            c, g = Counter(), Gauge()
+            with c._lock:
+                with g._lock:
+                    pass
+        report = witness.check()  # real static model
+        # the sites themselves are known (the extractor inventories
+        # registry.py), but nothing in the repo nests Counter under
+        # Gauge — the witness must flag the blind spot, not absorb it
+        assert report.unknown_sites == []
+        assert ("kmamiz_tpu/telemetry/registry.py:57",
+                "kmamiz_tpu/telemetry/registry.py:79") in [
+            tuple(p) for p in report.uncovered
+        ]
+        assert not report.ok
+
+    def test_clean_witness_state_is_ok(self):
+        report = witness.check()
+        assert report.edge_count == 0 and report.ok
+
+    def test_snapshot_shape_and_hold_accounting(self):
+        from kmamiz_tpu.telemetry.registry import Counter
+
+        with witness.armed():
+            Counter().inc()
+        snap = witness.snapshot()
+        assert snap["enabled"] is False  # env not set in tests
+        site = "kmamiz_tpu/telemetry/registry.py:57"
+        assert site in snap["locks"]
+        assert snap["locks"][site]["acquires"] >= 1
+        assert snap["locks"][site]["maxHoldMs"] >= 0.0
+
+    def test_rlock_reentry_records_one_acquire_depth(self):
+        from kmamiz_tpu.graph.store import EndpointGraph
+
+        with witness.armed():
+            lk = EndpointGraph.__new__(EndpointGraph)  # no full init
+            import threading
+
+            lk._lock = threading.RLock()
+        # the RLock was created in THIS file (tests/), so it stays raw —
+        # re-entry semantics of witnessed RLocks are covered by the soak;
+        # here we just pin that non-repo creation sites stay unwrapped
+        assert type(lk._lock).__name__ != "_WitnessLock"
+
+
+class TestStoreScorerLocking:
+    """Regression pins for the two inconsistent-guard findings graftrace
+    surfaced in graph/store.py: the scorer memo read and the
+    incremental-prev read now happen under self._lock. The memo hit
+    must stay bit-exact and still count as a hit."""
+
+    def test_scorer_memo_hit_is_locked_and_bit_exact(self, pdas_traces):
+        from kmamiz_tpu.core.spans import spans_to_batch
+        from kmamiz_tpu.graph.store import EndpointGraph
+
+        batch = spans_to_batch([pdas_traces])
+        graph = EndpointGraph(interner=batch.interner)
+        graph.merge_window(batch)
+        first = graph.service_scores()
+        hits_before = graph.scorer_cache_stats()["hits"]
+        second = graph.service_scores()
+        assert graph.scorer_cache_stats()["hits"] == hits_before + 1
+        assert np.array_equal(
+            np.asarray(first.instability), np.asarray(second.instability)
+        )
+        assert np.array_equal(np.asarray(first.ais), np.asarray(second.ais))
+
+
+class TestWitnessedSoak:
+    def test_fleet_migration_soak_under_witness(self, monkeypatch):
+        """Acceptance gate: the lock-witnessed fleet-migration soak
+        (seed 0) passes every existing gate with zero witnessed cycles
+        and zero witnessed edges missing from the static model."""
+        from kmamiz_tpu import native
+        from kmamiz_tpu.scenarios.factory import build_scenario
+        from kmamiz_tpu.scenarios.runner import run_scenario
+
+        if not native.available():
+            pytest.skip("native extension unavailable")
+        monkeypatch.setenv(witness.ENV_WITNESS, "1")
+        spec = build_scenario("fleet-migration", 0, 9, 10)
+        card = run_scenario(spec)
+        assert card["pass"], card["gates"]
+        assert card["gates"]["lock_witness_acyclic"] is True
+        assert card["gates"]["lock_witness_covered"] is True
+        lw = card["lock_witness"]
+        assert lw["edges"] > 0 and lw["acquires"] > 0
+        assert lw["cycles"] == [] and lw["uncovered"] == []
+        assert lw["unknownSites"] == []
+
+
+class TestCLI:
+    def test_list_rules(self, capsys):
+        from tools.graftrace import main
+
+        assert main(["--list-rules"]) == 0
+        out = capsys.readouterr().out
+        for rule in CONCURRENCY_RULES:
+            assert rule in out
+
+    def test_locks_table_lists_inventory(self, capsys):
+        from tools.graftrace import main
+
+        assert main(["--locks"]) == 0
+        out = capsys.readouterr().out
+        assert "EndpointGraph._lock" in out
+        assert "lock site(s)" in out
+
+    def test_dot_graph_is_wellformed(self, capsys):
+        from tools.graftrace import main
+
+        assert main(["--dot"]) == 0
+        out = capsys.readouterr().out
+        assert out.startswith("digraph graftrace {")
+        assert out.rstrip().endswith("}")
+        assert "->" in out
+
+    def test_rejects_non_concurrency_rule(self, capsys):
+        from tools.graftrace import main
+
+        assert main(["--rules", "dtype-drift"]) == 2

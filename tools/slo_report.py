@@ -87,6 +87,11 @@ _EXTRA_GATED = (
     # zero, so ANY loss is a regression (integer slack already makes
     # one lost span fail)
     "fleet_migration_lost_spans",
+    # graftrace (ISSUE 19 / docs/STATIC_ANALYSIS.md): the concurrency
+    # lint pass must stay cheap enough to run pre-merge, and findings
+    # must stay at ZERO — integer slack already makes one finding fail
+    "graftrace_repo_ms",
+    "graftrace_findings",
 )
 # boolean pass/fail keys: any True -> False flip is a regression (bool
 # is an int subclass, so the numeric threshold check would wave a

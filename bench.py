@@ -1922,6 +1922,65 @@ def main() -> None:
                 f"{type(err).__name__}: {err}"[:300]
             )
 
+    # ---- graftsoak sweep smoke (ROADMAP item 4 / docs/SCENARIOS.md) --------
+    # one budget-guarded mini-sweep in a fresh tools/graftsoak.py
+    # subprocess: a handful of cost-ordered cells across 2 workers plus
+    # ONE seeded poison cell, proving the whole soak stack — manifest,
+    # claims, namespaced flight boxes, baseline bisection, triage
+    # dedupe — fires end to end every bench round. The three keys are
+    # ALWAYS present (None on skip/failure) and gated by
+    # tools/slo_report.py (pass-rate + triaged-fraction floors);
+    # KMAMIZ_BENCH_SOAK=0 skips.
+    soak_extras = {
+        "soak_smoke_pass_rate": None,
+        "soak_triaged_fraction": None,
+        "soak_cells_per_min": None,
+    }
+    try:
+        soak_budget_ok = (
+            time.perf_counter() - BENCH_T0
+            < int(os.environ.get("KMAMIZ_BENCH_BUDGET_S", 3000)) - 290
+        )
+    except ValueError:
+        soak_budget_ok = True
+    if os.environ.get("KMAMIZ_BENCH_SOAK", "1") != "0" and soak_budget_ok:
+        import subprocess
+        import tempfile
+
+        try:
+            with tempfile.TemporaryDirectory(
+                prefix="kmamiz-bench-soak-"
+            ) as soak_dir:
+                out = subprocess.run(
+                    [
+                        sys.executable,
+                        "tools/graftsoak.py",
+                        "--cells",
+                        "5",
+                        "--ticks",
+                        "4",
+                        "--workers",
+                        "2",
+                        "--poison",
+                        "1",
+                        "--soak-dir",
+                        soak_dir,
+                    ],
+                    capture_output=True,
+                    text=True,
+                    timeout=600,
+                )
+                sweep = json.loads(out.stdout.strip().splitlines()[-1])
+            soak_extras = {
+                "soak_smoke_pass_rate": sweep["soak_pass_rate"],
+                "soak_triaged_fraction": sweep["soak_triaged_fraction"],
+                "soak_cells_per_min": sweep["soak_cells_per_min"],
+                "soak_smoke_cells": sweep["cells_total"],
+                "soak_smoke_bugs": len(sweep["bugs"]),
+            }
+        except Exception as err:  # noqa: BLE001 - extra, not headline
+            soak_extras["soak_error"] = f"{type(err).__name__}: {err}"[:300]
+
     # ---- graftfleet scale-out (ROADMAP item 2 / docs/FLEET.md) -------------
     # tools/fleet_bench.py in a fresh subprocess: four real worker
     # processes behind HTTPTransport — single-worker vs 4-worker ingest
@@ -2233,6 +2292,7 @@ def main() -> None:
         **chaos_extras,
         **tenancy_extras,
         **scenario_extras,
+        **soak_extras,
         **fleet_extras,
         **control_extras,
         "chained_iters": ITERS,

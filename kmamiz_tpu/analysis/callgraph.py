@@ -61,6 +61,12 @@ DEFAULT_SEED_MODULES = (
     "kmamiz_tpu/fleet/placement.py",
     "kmamiz_tpu/fleet/migration.py",
     "kmamiz_tpu/fleet/soak.py",
+    # graftsoak: the WAL-replay scenario's ingest loop drives the DP
+    # ingest hot path record by record, and the sweep worker's
+    # claim/run/record cycle wraps every scenario the sweep executes —
+    # seed both so the hot-path rules cover the soak plane
+    "kmamiz_tpu/soak/walreplay.py",
+    "kmamiz_tpu/soak/worker.py",
 )
 
 

@@ -742,6 +742,12 @@ _STATE_SCOPES = (
     # server threads while the background prewarm thread and /timings
     # readers run concurrently
     "kmamiz_tpu/cost/",
+    # the graftsoak engine's completed-sweep registry is appended from
+    # whichever thread drove run_sweep while tests and observability
+    # readers snapshot it; the manifest layer itself is cross-PROCESS
+    # shared state (O_EXCL claims + atomic renames stand in for locks,
+    # but any in-process mutable module state still needs one)
+    "kmamiz_tpu/soak/",
 )
 
 

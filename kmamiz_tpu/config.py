@@ -203,7 +203,7 @@ class Settings:
         )
     )  # matrix seed: one integer composes every topology/traffic/storyline
     scenario_matrix: int = field(
-        default_factory=lambda: int(os.environ.get("KMAMIZ_SCENARIO_MATRIX", "10"))
+        default_factory=lambda: int(os.environ.get("KMAMIZ_SCENARIO_MATRIX", "11"))
     )  # matrix size; archetype i % len(ARCHETYPES) at index i
     scenario_ticks: int = field(
         default_factory=lambda: int(os.environ.get("KMAMIZ_SCENARIO_TICKS", "10"))
@@ -386,6 +386,37 @@ class Settings:
             os.environ.get("KMAMIZ_STREAM_EPOCH_TICKS", "32")
         )
     )  # micro-ticks per watchdog deadline-cache epoch (floor 1)
+
+    # graftsoak sweep engine (kmamiz_tpu/soak/, docs/SCENARIOS.md).
+    # The soak engine and its worker subprocesses read these env vars
+    # directly (workers start fresh interpreters); the fields mirror
+    # them so one `Settings()` dump shows everything.
+    soak_dir: str = field(
+        default_factory=lambda: os.environ.get(
+            "KMAMIZ_SOAK_DIR", os.path.join("kmamiz-data", "soak")
+        )
+    )  # sweep manifest / per-cell records / flight boxes root
+    soak_workers: int = field(
+        default_factory=lambda: int(
+            os.environ.get(
+                "KMAMIZ_SOAK_WORKERS", min(4, max(1, os.cpu_count() or 1))
+            )
+        )
+    )  # worker subprocesses claiming cells from the shared manifest
+    soak_ticks: int = field(
+        default_factory=lambda: int(os.environ.get("KMAMIZ_SOAK_TICKS", "6"))
+    )  # measured ticks per sweep cell (matrix default stays 10)
+    soak_archetypes: str = field(
+        default_factory=lambda: os.environ.get("KMAMIZ_SOAK_ARCHETYPES", "")
+    )  # csv archetype override ("" = all minus subprocess-heavy)
+    soak_pass_floor: float = field(
+        default_factory=lambda: float(
+            os.environ.get("KMAMIZ_SOAK_PASS_FLOOR", "0.9999")
+        )
+    )  # four nines: non-poison cell pass rate the sweep gates on
+    soak_bundle: str = field(
+        default_factory=lambda: os.environ.get("KMAMIZ_SOAK_BUNDLE", "")
+    )  # recorded WAL bundle dir for the wal-replay archetype ("" = synthesize)
 
     def __post_init__(self) -> None:
         k8s_host = os.environ.get("KUBERNETES_SERVICE_HOST")

@@ -17,6 +17,7 @@ from kmamiz_tpu.scenarios.factory import (
 )
 from kmamiz_tpu.scenarios.labeled import labeled_windows
 from kmamiz_tpu.scenarios.runner import (
+    crashed_card,
     recorded_runs,
     run_counterfactual,
     run_matrix,

@@ -77,6 +77,16 @@ ARCHETYPES: Tuple[Tuple[str, Tuple[Tuple[str, str, str, Tuple[str, ...]], ...]],
             ("gamma", "mesh", "steady", ()),
         ),
     ),
+    # graftsoak production replay (docs/SCENARIOS.md#wal-replay): a
+    # recorded WAL v2 window (KMAMIZ_SOAK_BUNDLE, or a bundle
+    # synthesized from this composed topology x traffic) replayed
+    # through a live server and gated bit-exact against a reference
+    # built from the same records (soak/walreplay.py). No storyline:
+    # the recording IS the storyline.
+    (
+        "wal-replay",
+        (("default", "fanout", "burst", ()),),
+    ),
 )
 
 #: per-scenario child-seed stride (prime, far above any matrix size)

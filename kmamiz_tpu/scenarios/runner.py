@@ -41,6 +41,8 @@ import time
 import urllib.request
 from typing import Dict, Iterator, List, Optional, Tuple
 
+from kmamiz_tpu.telemetry.profiling import events as prof_events
+
 from kmamiz_tpu.scenarios.factory import (
     SEED_STRIDE,
     ScenarioSpec,
@@ -204,7 +206,7 @@ def _post_tick(
             "lookBack": 30_000,
             # real clock: the processed-trace TTL prunes against ingest
             # time, so a virtual epoch here would strand dedup entries
-            "time": int(time.time() * 1000),
+            "time": int(prof_events.wall_ms()),
         }
     ).encode()
     req = urllib.request.Request(
@@ -213,10 +215,10 @@ def _post_tick(
         headers={"Content-Type": "application/json"},
         method="POST",
     )
-    t0 = time.perf_counter()
+    t0 = prof_events.now_ms()
     with urllib.request.urlopen(req, timeout=timeout_s) as resp:
         payload = json.loads(resp.read())
-        return resp.status, payload, (time.perf_counter() - t0) * 1000
+        return resp.status, payload, prof_events.now_ms() - t0
 
 
 def _post_ingest(port: int, tenant: str, raw: bytes) -> dict:
@@ -415,6 +417,13 @@ def run_scenario(
             from kmamiz_tpu.fleet.soak import run_fleet_scenario
 
             card = run_fleet_scenario(spec, tmpdir, verbose)
+        elif spec.archetype == "wal-replay":
+            # archetype 11 replays a recorded WAL window through the
+            # factory harness, gated bit-exact against a reference
+            # built from the same records (soak/walreplay.py)
+            from kmamiz_tpu.soak.walreplay import run_wal_replay_scenario
+
+            card = run_wal_replay_scenario(spec, tmpdir, verbose)
         else:
             card = _run_scenario_inner(spec, tmpdir, verbose)
     with _RUNS_LOCK:
@@ -451,7 +460,7 @@ def _run_scenario_inner(spec: ScenarioSpec, tmpdir: str, verbose: bool) -> dict:
     from kmamiz_tpu.tenancy.router import TickRouter
     from kmamiz_tpu.telemetry.slo import percentile
 
-    t_start = time.time()
+    t_start = prof_events.now_ms()
     state: dict = {
         "latencies": [],
         "stale": 0,
@@ -642,12 +651,13 @@ def _run_scenario_inner(spec: ScenarioSpec, tmpdir: str, verbose: bool) -> dict:
             for t in (growth_tenants or [])
         },
         "signatures": live_sigs,
+        "ref_signatures": ref_sigs,
         "freshness": fresh,
         "wal": state["wal"],
         "errors": state["errors"][:4],
         "gates": gates,
         "pass": all(gates.values()),
-        "wall_s": round(time.time() - t_start, 1),
+        "wall_s": round((prof_events.now_ms() - t_start) / 1000, 1),
     }
     if has_growth:
         from kmamiz_tpu import cost
@@ -660,8 +670,14 @@ def _run_scenario_inner(spec: ScenarioSpec, tmpdir: str, verbose: bool) -> dict:
         from kmamiz_tpu.telemetry.profiling import recorder
 
         failed = sorted(g for g, ok in gates.items() if not ok)
+        base_seed = (spec.seed - spec.index) // SEED_STRIDE
         card["flight_artifact"] = recorder.record(
-            f"scenario-{spec.name}", ",".join(failed), force=True
+            f"scenario-{spec.name}",
+            ",".join(failed),
+            force=True,
+            # per-cell evidence namespace: under a sweep, this cell's
+            # retention/debounce never evicts another cell's box
+            namespace=f"{spec.archetype}-{base_seed}",
         )
     if verbose:
         print(
@@ -902,7 +918,7 @@ def _drive(
                 # recovery-to-fresh (breaker cooldown + half-open probe)
                 src.push(groups)
                 state["expected"][plan.tenant].append(("collect", groups))
-                t0 = time.perf_counter()
+                t0 = prof_events.now_ms()
                 fresh = False
                 for _attempt in range(RECOVERY_ATTEMPTS):
                     status, body, ms = _post_tick(port, plan.tenant, uid)
@@ -912,7 +928,7 @@ def _drive(
                         break
                     state["stale"] += 1
                     time.sleep(RECOVERY_SLEEP_S)
-                recovery_ms = (time.perf_counter() - t0) * 1000
+                recovery_ms = prof_events.now_ms() - t0
                 state["recoveries"][f"{plan.tenant}@t{tick}"] = recovery_ms
                 if not fresh:
                     state["recovered_all"] = False
@@ -996,7 +1012,7 @@ def _reference_signatures(spec: ScenarioSpec, state: dict) -> Dict[str, str]:
                         {
                             "uniqueId": f"ref-{plan.tenant}-{i}",
                             "lookBack": 30_000,
-                            "time": int(time.time() * 1000),
+                            "time": int(prof_events.wall_ms()),
                         }
                     )
             sigs[plan.tenant] = graph_signature(ref.graph)
@@ -1310,14 +1326,90 @@ def run_counterfactual(
     return card
 
 
+def crashed_card(
+    spec: Optional[ScenarioSpec],
+    exc: BaseException,
+    archetype: Optional[str] = None,
+    wall_s: float = 0.0,
+) -> dict:
+    """A failed scorecard for a scenario that threw instead of scoring:
+    gate ``crashed`` False, exception text captured, every headline key
+    the table/bench readers expect present. ``spec`` may be None when
+    compose itself crashed (pass ``archetype`` so triage can bucket)."""
+    import traceback
+
+    from kmamiz_tpu.scenarios.factory import spec_signature
+    from kmamiz_tpu.telemetry.profiling import recorder
+
+    name = spec.name if spec is not None else f"{archetype or 'unknown'}-?"
+    arch = spec.archetype if spec is not None else (archetype or "unknown")
+    base_seed = (
+        (spec.seed - spec.index) // SEED_STRIDE if spec is not None else 0
+    )
+    card = {
+        "name": name,
+        "archetype": arch,
+        "spec_signature": spec_signature(spec) if spec is not None else None,
+        "n_ticks": spec.n_ticks if spec is not None else 0,
+        "tenants": [p.tenant for p in spec.tenants] if spec is not None else [],
+        "posts": 0,
+        "stale_serves": 0,
+        "stale_rate": 0.0,
+        "p50_tick_ms": 0.0,
+        "p95_tick_ms": 0.0,
+        "p99_tick_ms": 0.0,
+        "lost_spans": 0,
+        "missing_traces": [],
+        "quarantined": 0,
+        "expected_poisons": 0,
+        "recovery_ms": 0.0,
+        "recoveries": {},
+        "steady_recompiles": 0,
+        "mid_tick_compiles": 0,
+        "mid_tick_detail": [],
+        "capacity": {},
+        "signatures": {},
+        "ref_signatures": {},
+        "freshness": {},
+        "wal": None,
+        "errors": [f"{type(exc).__name__}: {exc}"],
+        "crash": traceback.format_exception_only(type(exc), exc)[-1].strip(),
+        "traceback": traceback.format_exc()[-2000:],
+        "gates": {"crashed": False},
+        "pass": False,
+        "wall_s": round(wall_s, 1),
+    }
+    card["flight_artifact"] = recorder.record(
+        f"scenario-{name}",
+        f"crashed: {card['crash']}",
+        force=True,
+        namespace=f"{arch}-{base_seed}",
+    )
+    return card
+
+
 def run_matrix(
     specs, verbose: bool = False
 ) -> List[dict]:
-    """Run every scenario, each inside its own temp sandbox."""
+    """Run every scenario, each inside its own temp sandbox. A scenario
+    that throws during its run becomes a ``crashed``-gate failed card —
+    one bad cell never aborts the rest of the matrix."""
     results = []
     for spec in specs:
+        t0 = time.time()
         with tempfile.TemporaryDirectory(prefix="kmamiz-scn-") as tmp:
-            results.append(run_scenario(spec, tmpdir=tmp, verbose=verbose))
+            try:
+                card = run_scenario(spec, tmpdir=tmp, verbose=verbose)
+            except Exception as exc:  # noqa: BLE001 - contained into the scorecard
+                card = crashed_card(spec, exc, wall_s=time.time() - t0)
+                with _RUNS_LOCK:
+                    _RUNS.append(card)
+                if verbose:
+                    print(
+                        f"{spec.name}: CRASHED {card['crash']}",
+                        file=sys.stderr,
+                    )
+        results.append(card)
     return results
 
 

@@ -1,0 +1,64 @@
+"""graftsoak: thousand-scenario production-replay soak at four nines.
+
+Three pillars over the existing scenario factory (docs/SCENARIOS.md):
+
+* **Sweep engine** (:mod:`.engine`, :mod:`.manifest`, :mod:`.cells`,
+  driven by ``tools/graftsoak.py``) — a multiprocess pool fanning
+  ``(archetype, seed)`` cells across worker subprocesses, ordered by
+  graftcost-predicted per-scenario cost (longest first), with a
+  resumable on-disk manifest of atomic per-cell result records under
+  ``KMAMIZ_SOAK_DIR``.
+* **WAL-replay scenario source** (:mod:`.walreplay`, recorded by
+  ``python -m kmamiz_tpu.soak.capture``) — a recorded WAL v2 window
+  replayed through the factory harness as archetype 11, gated
+  bit-exact against a reference built from the same records.
+* **Auto-triage** (:mod:`.triage`) — every failing cell's flight box
+  bisected against the archetype's last passing flight, blamed
+  phase/tenant/gate emitted into the cell record, failures deduped by
+  triage signature in the soak report.
+"""
+from kmamiz_tpu.soak.cells import (
+    COLD_PROCESS,
+    SUBPROCESS_HEAVY,
+    enumerate_cells,
+    sweep_archetypes,
+    sweep_ticks,
+)
+from kmamiz_tpu.soak.engine import (
+    build_report,
+    pass_floor,
+    plan_sweep,
+    recorded_sweeps,
+    run_sweep,
+    soak_workers,
+)
+from kmamiz_tpu.soak.manifest import SoakManifest, default_soak_dir
+from kmamiz_tpu.soak.triage import dedupe, triage_card
+from kmamiz_tpu.soak.walreplay import run_wal_replay_scenario
+
+__all__ = [
+    "COLD_PROCESS",
+    "SUBPROCESS_HEAVY",
+    "SoakManifest",
+    "build_report",
+    "dedupe",
+    "default_soak_dir",
+    "enumerate_cells",
+    "pass_floor",
+    "plan_sweep",
+    "recorded_sweeps",
+    "reset_for_tests",
+    "run_sweep",
+    "run_wal_replay_scenario",
+    "soak_workers",
+    "sweep_archetypes",
+    "sweep_ticks",
+    "triage_card",
+]
+
+
+def reset_for_tests() -> None:
+    """Clear soak-global state (the completed-sweep registry)."""
+    from kmamiz_tpu.soak import engine
+
+    engine.reset_for_tests()

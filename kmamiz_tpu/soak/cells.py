@@ -1,0 +1,116 @@
+"""Sweep cell enumeration: (archetype, seed) grid, cost-ordered.
+
+A *cell* is one scenario the sweep will run: an archetype name plus a
+matrix seed. Cell identity is ``<archetype>-s<seed>`` and each cell
+composes its spec at the archetype's CANONICAL matrix index — the same
+``build_scenario(archetype, seed, index, ticks)`` call no matter how
+large the sweep is, so a cell re-run in isolation reproduces the sweep
+cell bit-exactly (``spec_signature`` is the oracle).
+
+Two archetype groups are opt-in for sweeps (override with
+``KMAMIZ_SOAK_ARCHETYPES=name,name,...``):
+
+* ``SUBPROCESS_HEAVY`` — archetypes that fork whole interpreter trees
+  per cell (kill-9 crash children, the 4-worker fleet ring): at
+  thousands of cells they would multiply process spawns without adding
+  coverage the nightly matrix gate doesn't already have.
+* ``COLD_PROCESS`` — archetypes whose verdict is only deterministic in
+  a cold interpreter. ``capacity-growth-chain`` fits its between-tick
+  prewarm predictor from the compile-cost evidence its own warmup
+  generates; in a warm sweep worker the program registry serves cached
+  shapes, warmup compiles nothing, the predictor has nothing to fit,
+  and the consolidation's compiles land mid-tick or not depending on
+  which cells ran before — an order-dependent verdict that would poison
+  a four-nines pass rate and the resume-bit-identical report contract.
+  The nightly matrix (one cold process) still gates it.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence
+
+from kmamiz_tpu.cost.scenario import fit_observed, predicted_scenario_cost_s
+from kmamiz_tpu.scenarios.factory import ARCHETYPES, build_scenario
+
+#: subprocess-per-cell archetypes, excluded from sweeps by default
+SUBPROCESS_HEAVY = ("kill9-wal-replay", "fleet-migration")
+
+#: archetypes whose gates are only deterministic in a cold interpreter
+#: (see module docstring), excluded from sweeps by default
+COLD_PROCESS = ("capacity-growth-chain",)
+
+DEFAULT_SWEEP_TICKS = 6
+
+
+def sweep_ticks() -> int:
+    try:
+        return max(
+            1, int(os.environ.get("KMAMIZ_SOAK_TICKS", DEFAULT_SWEEP_TICKS))
+        )
+    except ValueError:
+        return DEFAULT_SWEEP_TICKS
+
+
+def sweep_archetypes() -> List[str]:
+    """The archetype vocabulary a sweep cycles through."""
+    raw = os.environ.get("KMAMIZ_SOAK_ARCHETYPES", "")
+    known = [name for name, _t in ARCHETYPES]
+    if raw.strip():
+        picked = [a.strip() for a in raw.split(",") if a.strip()]
+        bad = [a for a in picked if a not in known]
+        if bad:
+            raise ValueError(f"unknown archetype(s) in KMAMIZ_SOAK_ARCHETYPES: {bad}")
+        return picked
+    excluded = set(SUBPROCESS_HEAVY) | set(COLD_PROCESS)
+    return [a for a in known if a not in excluded]
+
+
+def archetype_index(archetype: str) -> int:
+    """The archetype's canonical matrix index (its ARCHETYPES slot)."""
+    for i, (name, _t) in enumerate(ARCHETYPES):
+        if name == archetype:
+            return i
+    raise ValueError(f"unknown archetype: {archetype!r}")
+
+
+def cell_id(archetype: str, seed: int) -> str:
+    return f"{archetype}-s{seed}"
+
+
+def enumerate_cells(
+    n_cells: int,
+    seed0: int = 0,
+    archetypes: Optional[Sequence[str]] = None,
+    ticks: Optional[int] = None,
+    observed: Optional[Dict[str, float]] = None,
+) -> List[dict]:
+    """The sweep plan: ``n_cells`` cells cycling the archetype
+    vocabulary across ascending seeds, each priced by the graftcost
+    scenario plane and sorted longest-first (LPT — the expensive tail
+    starts immediately instead of straggling last)."""
+    archs = list(archetypes) if archetypes else sweep_archetypes()
+    ticks = sweep_ticks() if ticks is None else ticks
+    cells = []
+    for i in range(n_cells):
+        archetype = archs[i % len(archs)]
+        seed = seed0 + i // len(archs)
+        spec = build_scenario(archetype, seed, archetype_index(archetype), ticks)
+        cells.append(
+            {
+                "id": cell_id(archetype, seed),
+                "archetype": archetype,
+                "seed": seed,
+                "index": archetype_index(archetype),
+                "ticks": ticks,
+                "predicted_s": predicted_scenario_cost_s(spec, observed),
+            }
+        )
+    cells.sort(key=lambda c: (-c["predicted_s"], c["id"]))
+    return cells
+
+
+def observed_ratios(results: Dict[str, dict]) -> Dict[str, float]:
+    """Per-archetype cost corrections from a prior (partial) sweep's
+    finished records — resumed and repeated sweeps order by what cells
+    actually cost last time."""
+    return fit_observed(results.values())

@@ -1,0 +1,255 @@
+"""WAL-replay scenario source: recorded production windows as a
+first-class scenario (archetype 11, ``wal-replay``).
+
+The fsynced WAL v2 already captures every raw ingest window bit-exact;
+this module closes the loop by replaying a recorded window back through
+the factory harness — a real ``DataProcessorServer`` fed each durable
+record over POST /ingest — and holding the result to the same gates as
+every other archetype. The reference signature is computed from the
+SAME records (``resilience/wal.replay_records`` into a fresh
+processor), so the oracle is the recording itself: real traffic
+shapes, bit-exact or the gate fails.
+
+Bundle resolution:
+
+* ``KMAMIZ_SOAK_BUNDLE`` points at a captured bundle directory
+  (``python -m kmamiz_tpu.soak.capture`` writes one from a live
+  server's WAL or a WAL directory on disk) — the production-replay
+  path.
+* Otherwise the cell SYNTHESIZES a bundle from its own composed spec
+  (topology × traffic through a real WAL append, every third window
+  columnar-framed), so archetype 11 runs self-contained in the matrix
+  and the sweep — same replay machinery, deterministic content.
+
+Torn tails truncate clean by construction: both the reference and the
+live replay iterate ``replay_records``, whose stop-clean contract drops
+a torn trailing frame on BOTH sides — the cell scores the intact
+prefix instead of failing.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Optional, Tuple
+
+from kmamiz_tpu.resilience.wal import IngestWAL
+from kmamiz_tpu.telemetry.profiling import events as prof_events
+
+BUNDLE_KIND = "kmamiz-soak-bundle"
+BUNDLE_VERSION = 1
+
+
+def bundle_env() -> Optional[str]:
+    return os.environ.get("KMAMIZ_SOAK_BUNDLE") or None
+
+
+def bundle_wal_dir(bundle_dir: str) -> str:
+    return os.path.join(bundle_dir, "wal")
+
+
+def write_bundle_meta(bundle_dir: str, **fields) -> dict:
+    meta = {
+        "kind": BUNDLE_KIND,
+        "version": BUNDLE_VERSION,
+        **fields,
+    }
+    os.makedirs(bundle_dir, exist_ok=True)
+    tmp = os.path.join(bundle_dir, "bundle.json.tmp")
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(meta, f, indent=1, sort_keys=True)
+    os.replace(tmp, os.path.join(bundle_dir, "bundle.json"))
+    return meta
+
+
+def read_bundle_meta(bundle_dir: str) -> dict:
+    with open(os.path.join(bundle_dir, "bundle.json"), encoding="utf-8") as f:
+        meta = json.load(f)
+    if meta.get("kind") != BUNDLE_KIND:
+        raise ValueError(f"not a soak bundle: {bundle_dir}")
+    return meta
+
+
+def load_bundle_records(bundle_dir: str) -> List[Tuple[int, bytes]]:
+    """Every durable record of the bundle's WAL, oldest first, torn
+    tail dropped (stop-clean). Read-only: replay never appends."""
+    wal = IngestWAL(bundle_wal_dir(bundle_dir))
+    try:
+        return list(wal.replay_records())
+    finally:
+        wal.close()
+
+
+def synthesize_bundle(spec, bundle_dir: str) -> dict:
+    """A deterministic stand-in recording composed from the spec's own
+    topology × traffic: one window per tick through a REAL WAL append
+    (v2 frames, fsync off — content is what's under test), every third
+    window columnar so KIND_COLUMNAR replays are always exercised."""
+    from kmamiz_tpu.core import wire
+    from kmamiz_tpu.scenarios.topology import trace_group
+
+    plan = spec.tenants[0]
+    wal = IngestWAL(bundle_wal_dir(bundle_dir), fsync=False)
+    windows = 0
+    try:
+        for tick in range(spec.n_ticks):
+            groups = [
+                trace_group(
+                    plan.topology, f"{spec.name}-rep", tick, i
+                )
+                for i in range(max(1, plan.traffic[tick]))
+            ]
+            if tick % 3 == 2:
+                wal.append(wire.encode_groups(groups))
+            else:
+                wal.append(json.dumps(groups).encode())
+            windows += 1
+    finally:
+        wal.close()
+    return write_bundle_meta(
+        bundle_dir,
+        records=windows,
+        tenant=plan.tenant,
+        source=f"synthesized:{spec.name}",
+        created_unix=int(prof_events.wall_ms() / 1000),
+    )
+
+
+def run_wal_replay_scenario(spec, tmpdir: str, verbose: bool = False) -> dict:
+    """Drive one wal-replay cell end to end; returns its scorecard
+    (same gate vocabulary as the other archetypes)."""
+    import urllib.error
+
+    from kmamiz_tpu.core import programs
+    from kmamiz_tpu.resilience.chaos import graph_signature
+    from kmamiz_tpu.scenarios.factory import spec_signature
+    from kmamiz_tpu.scenarios.runner import _post_ingest
+    from kmamiz_tpu.server.dp_server import DataProcessorServer, _make_runtime
+    from kmamiz_tpu.server.processor import DataProcessor
+    from kmamiz_tpu.telemetry.slo import percentile
+    from kmamiz_tpu.tenancy.router import TickRouter
+
+    t_start = prof_events.now_ms()
+    tenant = spec.tenants[0].tenant
+    errors: List[str] = []
+
+    bundle_dir = bundle_env()
+    if bundle_dir is None:
+        bundle_dir = os.path.join(tmpdir, "bundle")
+        meta = synthesize_bundle(spec, bundle_dir)
+    else:
+        meta = read_bundle_meta(bundle_dir)
+    records = load_bundle_records(bundle_dir)
+    torn_dropped = max(0, int(meta.get("records", len(records))) - len(records))
+
+    # reference pass: the recording itself is the oracle — a fresh
+    # processor ingests every durable record directly; this also warms
+    # every program shape the live replay will need (the registry is
+    # process-global), so the steady-state recompile gate below
+    # measures the replay alone
+    ref_dp = DataProcessor(
+        trace_source=lambda *_a: [], use_device_stats=False
+    )
+    ref_spans = 0
+    for _kind, payload in records:
+        ref_spans += int(ref_dp.ingest_raw_window(payload).get("spans", 0))
+    ref_sig = graph_signature(ref_dp.graph)
+
+    snapshot = programs.snapshot()
+
+    # live pass through the factory harness: each record POSTed to a
+    # real server, exactly the path production ingest takes
+    live_dp = DataProcessor(
+        trace_source=lambda *_a: [], use_device_stats=False, tenant=tenant
+    )
+    router = TickRouter(lambda t: _make_runtime(t, live_dp))
+    server = DataProcessorServer(
+        live_dp, host="127.0.0.1", port=0, router=router
+    )
+    server.start()
+    latencies: List[float] = []
+    live_spans = 0
+    quarantined = 0
+    posts = 0
+    try:
+        for _kind, payload in records:
+            t0 = prof_events.now_ms()
+            try:
+                resp = _post_ingest(server.port, tenant, payload)
+            except (OSError, urllib.error.URLError) as exc:
+                errors.append(f"ingest: {type(exc).__name__}: {exc}")
+                continue
+            latencies.append(prof_events.now_ms() - t0)
+            posts += 1
+            live_spans += int(resp.get("spans", 0))
+            quarantined += int(resp.get("quarantined", 0))
+        live_sig = graph_signature(live_dp.graph)
+    finally:
+        server.stop()
+
+    steady_recompiles = sum(programs.new_compiles_since(snapshot).values())
+    lat = sorted(latencies)
+    gates = {
+        "no_errors": not errors,
+        "bit_exact": live_sig == ref_sig,
+        "replayed_all": posts == len(records),
+        "zero_lost_spans": live_spans == ref_spans,
+        "zero_steady_recompiles": steady_recompiles == 0,
+        "quarantine_exact": quarantined == 0,
+    }
+    card = {
+        "name": spec.name,
+        "archetype": spec.archetype,
+        "spec_signature": spec_signature(spec),
+        "n_ticks": spec.n_ticks,
+        "tenants": [tenant],
+        "posts": posts,
+        "stale_serves": 0,
+        "stale_rate": 0.0,
+        "p50_tick_ms": round(percentile(lat, 0.50), 2),
+        "p95_tick_ms": round(percentile(lat, 0.95), 2),
+        "p99_tick_ms": round(percentile(lat, 0.99), 2),
+        "lost_spans": max(0, ref_spans - live_spans),
+        "missing_traces": [],
+        "quarantined": quarantined,
+        "expected_poisons": 0,
+        "recovery_ms": 0.0,
+        "recoveries": {},
+        "steady_recompiles": steady_recompiles,
+        "mid_tick_compiles": 0,
+        "mid_tick_detail": [],
+        "capacity": {},
+        "signatures": {tenant: live_sig},
+        "ref_signatures": {tenant: ref_sig},
+        "freshness": {},
+        "wal": {
+            "ok": gates["replayed_all"] and gates["bit_exact"],
+            "records": len(records),
+            "spans": live_spans,
+            "torn_dropped": torn_dropped,
+            "source": meta.get("source", bundle_dir),
+        },
+        "errors": errors[:4],
+        "gates": gates,
+        "pass": all(gates.values()),
+        "wall_s": round((prof_events.now_ms() - t_start) / 1000, 1),
+    }
+    if not card["pass"]:
+        from kmamiz_tpu.scenarios.factory import SEED_STRIDE
+        from kmamiz_tpu.telemetry.profiling import recorder
+
+        base_seed = (spec.seed - spec.index) // SEED_STRIDE
+        failed = sorted(g for g, ok in gates.items() if not ok)
+        card["flight_artifact"] = recorder.record(
+            f"scenario-{spec.name}",
+            ",".join(failed),
+            force=True,
+            namespace=f"{spec.archetype}-{base_seed}",
+        )
+    if verbose:
+        import sys
+
+        print(
+            f"{spec.name}: pass={card['pass']} gates={gates}",
+            file=sys.stderr,
+        )
+    return card

@@ -16,6 +16,12 @@ hundreds of artifacts), keeps bounded retention
 atomically (tmp + rename) so a reader never sees a torn file. Trigger
 sites import this module lazily — the resilience layer must not pay for
 profiling at import time.
+
+Sweep safety: a caller may pass ``namespace`` (the soak runner uses
+``<archetype>-<seed>``) to get ``flight-<namespace>-*.json`` names with
+retention AND debounce applied per namespace — two scenario cells
+failing back-to-back can never evict or suppress each other's evidence
+box (docs/OBSERVABILITY.md).
 """
 from __future__ import annotations
 
@@ -36,10 +42,12 @@ ARTIFACT_KIND = "kmamiz-flight"
 ARTIFACT_VERSION = 1
 
 _lock = threading.Lock()
-_last_dump_monotonic = 0.0
+_last_dump_by_ns: dict = {}
 _seq = itertools.count(1)
 
 _SAFE_TRIGGER = re.compile(r"[^A-Za-z0-9_.-]+")
+#: a legacy (un-namespaced) artifact: flight-<epoch ms>-<seq>-<slug>.json
+_LEGACY_NAME = re.compile(r"^flight-\d{13}-")
 
 
 def flight_dir() -> str:
@@ -104,51 +112,85 @@ def build_artifact(trigger: str, detail: str = "") -> dict:
 
 
 def record(
-    trigger: str, detail: str = "", force: bool = False
+    trigger: str,
+    detail: str = "",
+    force: bool = False,
+    namespace: Optional[str] = None,
 ) -> Optional[str]:
     """Dump a flight artifact; returns its path, or None when skipped
     (profiling off, debounced) or failed. NEVER raises — the trigger
-    sites are the resilience layer's own failure paths."""
+    sites are the resilience layer's own failure paths. ``namespace``
+    isolates a scenario cell's evidence: its own filename prefix, its
+    own debounce clock, its own retention budget."""
     try:
-        return _record(trigger, detail, force)
+        return _record(trigger, detail, force, namespace)
     except Exception as exc:  # noqa: BLE001 - recorder must not re-fail a failure path
         logger.warning("flight recorder dump failed: %s", exc)
         return None
 
 
-def _record(trigger: str, detail: str, force: bool) -> Optional[str]:
-    global _last_dump_monotonic
+def _safe_namespace(namespace: Optional[str]) -> Optional[str]:
+    if namespace is None:
+        return None
+    ns = _SAFE_TRIGGER.sub("-", str(namespace)).strip("-")
+    # a purely-numeric namespace could collide with the legacy
+    # epoch-ms name pattern; anchor it with a letter
+    return f"ns-{ns}" if not ns or ns.isdigit() else ns
+
+
+def _record(
+    trigger: str, detail: str, force: bool, namespace: Optional[str]
+) -> Optional[str]:
     events.refresh_from_env()
     if not events.prof_enabled() and not force:
         return None
+    ns = _safe_namespace(namespace)
     now = time.monotonic()
     with _lock:
-        if not force and (now - _last_dump_monotonic) < _debounce_s():
+        last = _last_dump_by_ns.get(ns, 0.0)
+        if not force and (now - last) < _debounce_s():
             return None
-        _last_dump_monotonic = now
+        _last_dump_by_ns[ns] = now
         seq = next(_seq)
     artifact = build_artifact(trigger, detail)
+    if ns is not None:
+        artifact["namespace"] = ns
     out_dir = flight_dir()
     os.makedirs(out_dir, exist_ok=True)
     slug = _SAFE_TRIGGER.sub("-", trigger) or "trigger"
-    fname = f"flight-{int(time.time() * 1000):013d}-{seq:04d}-{slug}.json"
+    stamp = f"{int(time.time() * 1000):013d}-{seq:04d}-{slug}.json"
+    fname = f"flight-{ns}-{stamp}" if ns is not None else f"flight-{stamp}"
     path = os.path.join(out_dir, fname)
     tmp = path + ".tmp"
     with open(tmp, "w", encoding="utf-8") as f:
         json.dump(artifact, f, separators=(",", ":"))
     os.replace(tmp, path)
-    _prune(out_dir)
+    _prune(out_dir, ns)
     return path
 
 
-def _prune(out_dir: str) -> None:
-    """Bounded retention: keep the newest flight_max() artifacts (the
-    timestamped names sort chronologically)."""
+def _prune(out_dir: str, namespace: Optional[str] = None) -> None:
+    """Bounded retention PER NAMESPACE: keep the newest flight_max()
+    artifacts of this record's namespace (timestamped names sort
+    chronologically within one namespace). Legacy un-namespaced
+    artifacts form their own retention group, so a sweep's per-cell
+    evidence never evicts an operator's ad-hoc dumps (or vice versa)."""
+    if namespace is None:
+        def mine(name: str) -> bool:
+            return bool(_LEGACY_NAME.match(name))
+    else:
+        prefix = f"flight-{namespace}-"
+
+        def mine(name: str) -> bool:
+            return name.startswith(prefix) and bool(
+                _LEGACY_NAME.match("flight-" + name[len(prefix):])
+            )
+
     try:
         names = sorted(
             n
             for n in os.listdir(out_dir)
-            if n.startswith("flight-") and n.endswith(".json")
+            if n.startswith("flight-") and n.endswith(".json") and mine(n)
         )
     except OSError:
         return
@@ -160,7 +202,7 @@ def _prune(out_dir: str) -> None:
 
 
 def reset_for_tests() -> None:
-    global _last_dump_monotonic, _seq
+    global _seq
     with _lock:
-        _last_dump_monotonic = 0.0
+        _last_dump_by_ns.clear()
         _seq = itertools.count(1)

@@ -78,6 +78,10 @@ def _reset_resilience_state():
     sparse.reset_for_tests()
     # graftfleet module counters (frames routed/queued, folds, migrations)
     fleet.reset_for_tests()
+    # graftsoak completed-sweep registry
+    from kmamiz_tpu import soak
+
+    soak.reset_for_tests()
     # graftrace lock witness: uninstall the threading.Lock/RLock patch
     # and drop witnessed order edges so one armed test can't leak edges
     # (or the patch itself) into the next test's coverage check

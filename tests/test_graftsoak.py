@@ -1,0 +1,708 @@
+"""graftsoak: the thousand-cell sweep (docs/SCENARIOS.md §6).
+
+Fast tiers cover the pure planes (cell enumeration + LPT ordering,
+manifest claims/resume, triage blame + dedupe, crash containment, the
+namespaced flight recorder, the WAL-replay scenario source and its edge
+cases) plus an in-process mini-sweep through the real engine loop with
+a stubbed scenario runner. The slow tier runs the acceptance sweep for
+real: 200 cells at four nines with a seeded poison cell, plus
+kill-mid-run resume reproducing the identical report.
+"""
+import json
+import os
+import signal
+import struct
+import subprocess
+import sys
+import time
+import zlib
+
+import pytest
+
+from kmamiz_tpu import soak
+from kmamiz_tpu.scenarios.factory import ARCHETYPES, build_scenario
+from kmamiz_tpu.soak import cells as cells_mod
+from kmamiz_tpu.soak import engine, triage, walreplay, worker
+from kmamiz_tpu.soak.manifest import SoakManifest, read_json
+
+
+def _arch_index(name):
+    return cells_mod.archetype_index(name)
+
+
+# ---------------------------------------------------------------------------
+# cell enumeration + LPT ordering
+# ---------------------------------------------------------------------------
+
+
+class TestCells:
+    def test_enumeration_is_deterministic_and_lpt_ordered(self):
+        a = cells_mod.enumerate_cells(12, seed0=3, ticks=4)
+        b = cells_mod.enumerate_cells(12, seed0=3, ticks=4)
+        assert a == b
+        costs = [c["predicted_s"] for c in a]
+        assert costs == sorted(costs, reverse=True)
+        assert len({c["id"] for c in a}) == 12
+
+    def test_cycles_archetypes_across_ascending_seeds(self):
+        archs = ["steady-chain", "cascade-fanout"]
+        cells = cells_mod.enumerate_cells(5, seed0=0, archetypes=archs, ticks=4)
+        by_id = {c["id"]: c for c in cells}
+        assert set(by_id) == {
+            "steady-chain-s0", "cascade-fanout-s0",
+            "steady-chain-s1", "cascade-fanout-s1",
+            "steady-chain-s2",
+        }
+        # each cell composes at the archetype's canonical matrix index
+        for c in cells:
+            assert c["index"] == _arch_index(c["archetype"])
+
+    def test_default_vocabulary_excludes_heavy_and_cold_process(self):
+        archs = cells_mod.sweep_archetypes()
+        for excluded in soak.SUBPROCESS_HEAVY + soak.COLD_PROCESS:
+            assert excluded not in archs
+        assert "wal-replay" in archs
+        assert set(archs) < {name for name, _t in ARCHETYPES}
+        # ...but an explicit override may still opt them in
+        assert "capacity-growth-chain" in soak.COLD_PROCESS
+
+    def test_archetype_env_override_validates(self, monkeypatch):
+        monkeypatch.setenv("KMAMIZ_SOAK_ARCHETYPES", "steady-chain,wal-replay")
+        assert cells_mod.sweep_archetypes() == ["steady-chain", "wal-replay"]
+        monkeypatch.setenv("KMAMIZ_SOAK_ARCHETYPES", "no-such-archetype")
+        with pytest.raises(ValueError, match="no-such-archetype"):
+            cells_mod.sweep_archetypes()
+
+    def test_observed_ratios_reorder_the_plan(self):
+        # an archetype observed 100x costlier than predicted must front-run
+        base = cells_mod.enumerate_cells(4, archetypes=["steady-chain", "outage-cycle"], ticks=4)
+        cheap = next(c for c in base if c["archetype"] == "steady-chain")
+        observed = {"steady-chain": 100.0}
+        boosted = cells_mod.enumerate_cells(
+            4, archetypes=["steady-chain", "outage-cycle"], ticks=4,
+            observed=observed,
+        )
+        assert boosted[0]["archetype"] == "steady-chain"
+        assert boosted[0]["predicted_s"] > cheap["predicted_s"]
+
+
+# ---------------------------------------------------------------------------
+# manifest: claims, stale-claim release, incremental pending
+# ---------------------------------------------------------------------------
+
+
+def _tiny_plan(man, n=3, poison=0):
+    return engine.plan_sweep(
+        man, n, archetypes=["steady-chain"], ticks=4, poison=poison
+    )
+
+
+class TestManifest:
+    def test_claim_is_exclusive(self, tmp_path):
+        man = SoakManifest(str(tmp_path))
+        _tiny_plan(man)
+        assert man.claim("steady-chain-s0") is True
+        assert man.claim("steady-chain-s0") is False
+
+    def test_stale_claims_cleared_only_without_result(self, tmp_path):
+        man = SoakManifest(str(tmp_path))
+        _tiny_plan(man)
+        man.claim("steady-chain-s0")
+        man.claim("steady-chain-s1")
+        man.record_result("steady-chain-s0", {"id": "steady-chain-s0", "pass": True})
+        cleared = man.clear_stale_claims()
+        assert cleared == ["steady-chain-s1"]
+        # the finished cell keeps its claim — it will not re-run
+        assert man.claim("steady-chain-s0") is False
+        assert man.claim("steady-chain-s1") is True
+
+    def test_pending_is_incremental_and_reruns_failures(self, tmp_path):
+        man = SoakManifest(str(tmp_path))
+        _tiny_plan(man, n=3)
+        man.record_result(
+            "steady-chain-s0",
+            {"id": "steady-chain-s0", "ticks": 4, "pass": True},
+        )
+        man.claim("steady-chain-s1")
+        man.record_result(
+            "steady-chain-s1",
+            {"id": "steady-chain-s1", "ticks": 4, "pass": False},
+        )
+        ids = [c["id"] for c in man.pending_cells(rerun_failed=False)]
+        assert ids == ["steady-chain-s2"]
+        ids = [c["id"] for c in man.pending_cells(rerun_failed=True)]
+        assert sorted(ids) == ["steady-chain-s1", "steady-chain-s2"]
+        # the failed record and its claim were dropped for re-execution
+        assert man.load_results().keys() == {"steady-chain-s0"}
+        assert man.claim("steady-chain-s1") is True
+
+    def test_replan_with_other_ticks_invalidates_results(self, tmp_path):
+        man = SoakManifest(str(tmp_path))
+        engine.plan_sweep(man, 2, archetypes=["steady-chain"], ticks=6)
+        man.record_result(
+            "steady-chain-s0",
+            {"id": "steady-chain-s0", "ticks": 6, "pass": True},
+        )
+        man.claim("steady-chain-s0")
+        # re-plan at a different tick count: the old record must not
+        # pass for the new cell, even without rerun_failed
+        engine.plan_sweep(man, 2, archetypes=["steady-chain"], ticks=4)
+        ids = [c["id"] for c in man.pending_cells(rerun_failed=False)]
+        assert sorted(ids) == ["steady-chain-s0", "steady-chain-s1"]
+        assert man.load_results() == {}
+        assert man.claim("steady-chain-s0") is True
+
+    def test_plan_reuse_and_deterministic_poison(self, tmp_path):
+        man = SoakManifest(str(tmp_path))
+        first = _tiny_plan(man, n=3, poison=1)
+        again = _tiny_plan(man, n=3, poison=1)
+        assert again == first  # manifest reused verbatim (resume contract)
+        assert first["poison"] == ["steady-chain-s0"]  # lexically first
+        poisoned = [c for c in first["cells"] if c.get("poison")]
+        assert [c["id"] for c in poisoned] == ["steady-chain-s0"]
+        # a different poison pick is a different plan
+        changed = _tiny_plan(man, n=3, poison=2)
+        assert changed["poison"] == ["steady-chain-s0", "steady-chain-s1"]
+
+
+# ---------------------------------------------------------------------------
+# triage: blame + dedupe
+# ---------------------------------------------------------------------------
+
+
+def _failed_card(**over):
+    card = {
+        "name": "cascade-fanout-s7i1",
+        "archetype": "cascade-fanout",
+        "tenants": ["alpha", "beta"],
+        "gates": {"bit_exact": False, "no_errors": True},
+        "signatures": {"alpha": "x", "beta": "live"},
+        "ref_signatures": {"alpha": "x", "beta": "ref"},
+        "errors": [],
+        "pass": False,
+    }
+    card.update(over)
+    return card
+
+
+class TestTriage:
+    def test_blame_signature_from_deterministic_parts(self):
+        tri = triage.triage_card(_failed_card())
+        assert tri["blamed_gate"] == "bit_exact"
+        assert tri["blamed_phase"] == "merge"
+        assert tri["blamed_tenant"] == "beta"  # signature divergence
+        assert tri["signature"] == "cascade-fanout|bit_exact|merge|beta"
+        assert tri["baseline"] is False
+
+    def test_tenant_falls_back_to_error_line_then_matrix(self):
+        card = _failed_card(
+            signatures={}, ref_signatures={},
+            errors=["tick 3: tenant beta source flapped"],
+        )
+        assert triage.blamed_tenant(card) == "beta"
+        card = _failed_card(signatures={}, ref_signatures={}, errors=[])
+        assert triage.blamed_tenant(card) == "matrix"
+        card = _failed_card(
+            signatures={}, ref_signatures={}, tenants=["solo"], errors=[]
+        )
+        assert triage.blamed_tenant(card) == "solo"
+
+    def test_dedupe_same_signature_is_one_bug(self):
+        tri = triage.triage_card(_failed_card())
+        recs = [
+            {"id": "cascade-fanout-s7", "triage": tri},
+            {"id": "cascade-fanout-s9", "triage": tri},
+            {"id": "outage-cycle-s1", "triage": {"signature": "other|g|p|t"}},
+        ]
+        bugs = triage.dedupe(recs)
+        assert bugs[0]["count"] == 2
+        assert bugs[0]["cells"] == ["cascade-fanout-s7", "cascade-fanout-s9"]
+        assert len(bugs) == 2
+
+
+# ---------------------------------------------------------------------------
+# crash containment: one bad cell never aborts the sweep or the matrix
+# ---------------------------------------------------------------------------
+
+
+class TestCrashContainment:
+    def test_crashed_card_has_full_shape(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("KMAMIZ_PROF_FLIGHT_DIR", str(tmp_path))
+        from kmamiz_tpu.scenarios import runner
+
+        card = runner.crashed_card(None, ValueError("boom"), archetype="outage-cycle")
+        assert card["pass"] is False
+        assert card["gates"] == {"crashed": False}
+        assert card["errors"] == ["ValueError: boom"]
+        assert "boom" in card["crash"]
+        assert card["archetype"] == "outage-cycle"
+        # the table/bench readers index these without .get
+        for key in ("p99_tick_ms", "stale_serves", "lost_spans", "quarantined",
+                    "expected_poisons", "recovery_ms", "steady_recompiles",
+                    "wall_s"):
+            assert key in card
+        tri = triage.triage_card(card)
+        assert tri["blamed_gate"] == "crashed"
+        assert tri["blamed_phase"] == "compose"
+
+    def test_run_matrix_contains_a_crashing_scenario(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("KMAMIZ_PROF_FLIGHT_DIR", str(tmp_path))
+        from kmamiz_tpu.scenarios import runner
+
+        def explode(spec, tmpdir=None, verbose=False):
+            raise RuntimeError(f"compose died for {spec.name}")
+
+        monkeypatch.setattr(runner, "run_scenario", explode)
+        specs = [build_scenario("steady-chain", 0, 0, 4)]
+        cards = runner.run_matrix(specs)
+        assert len(cards) == 1
+        assert cards[0]["pass"] is False
+        assert cards[0]["gates"]["crashed"] is False
+        assert "compose died" in cards[0]["errors"][0]
+        # the soak table renders the crashed card without raising
+        from tools.scenario_soak import _table, headline
+
+        assert headline(cards)["scenario_matrix_pass"] is False
+        assert "steady-chain" in _table(cards)
+
+
+# ---------------------------------------------------------------------------
+# flight recorder: per-cell namespaces
+# ---------------------------------------------------------------------------
+
+
+class TestFlightNamespaces:
+    def test_namespaces_have_isolated_retention(self, tmp_path, monkeypatch):
+        from kmamiz_tpu.telemetry.profiling import recorder
+
+        monkeypatch.setenv("KMAMIZ_PROF_FLIGHT_DIR", str(tmp_path))
+        monkeypatch.setenv("KMAMIZ_PROF_FLIGHT_MAX", "1")
+        p1 = recorder.record("scenario-a", "g", force=True, namespace="arch-1")
+        p2 = recorder.record("scenario-b", "g", force=True, namespace="arch-2")
+        legacy = recorder.record("watchdog", "g", force=True)
+        assert p1 and p2 and legacy
+        names = sorted(os.listdir(tmp_path))
+        # one box per namespace plus the legacy group — nobody evicted
+        assert len(names) == 3
+        assert any(n.startswith("flight-arch-1-") for n in names)
+        assert any(n.startswith("flight-arch-2-") for n in names)
+        # within one namespace the retention budget still applies
+        recorder.record("scenario-a2", "g", force=True, namespace="arch-1")
+        kept = [n for n in os.listdir(tmp_path) if n.startswith("flight-arch-1-")]
+        assert len(kept) == 1 and "scenario-a2" in kept[0]
+        # ...and the other groups were untouched
+        assert len(os.listdir(tmp_path)) == 3
+
+    def test_debounce_is_per_namespace(self, tmp_path, monkeypatch):
+        from kmamiz_tpu.telemetry.profiling import recorder
+
+        monkeypatch.setenv("KMAMIZ_PROF_FLIGHT_DIR", str(tmp_path))
+        monkeypatch.setenv("KMAMIZ_PROF", "1")
+        assert recorder.record("breach", "x", namespace="cell-a")
+        # same namespace inside the debounce window: skipped
+        assert recorder.record("breach", "x", namespace="cell-a") is None
+        # a different cell's namespace has its own clock
+        assert recorder.record("breach", "x", namespace="cell-b")
+
+    def test_numeric_namespace_cannot_shadow_legacy_names(self):
+        from kmamiz_tpu.telemetry.profiling import recorder
+
+        assert recorder._safe_namespace("1234567890123") == "ns-1234567890123"
+        assert recorder._safe_namespace("cascade-fanout-3") == "cascade-fanout-3"
+
+
+# ---------------------------------------------------------------------------
+# WAL-replay scenario source
+# ---------------------------------------------------------------------------
+
+
+def _ingest_all(payloads):
+    """Signature + span count of a fresh processor fed the payloads."""
+    from kmamiz_tpu.resilience.chaos import graph_signature
+    from kmamiz_tpu.server.processor import DataProcessor
+
+    dp = DataProcessor(trace_source=lambda *_a: [], use_device_stats=False)
+    spans = 0
+    for payload in payloads:
+        spans += int(dp.ingest_raw_window(payload).get("spans", 0))
+    return graph_signature(dp.graph), spans
+
+
+def _window(tick, i=0):
+    import random
+
+    from kmamiz_tpu.scenarios.topology import sample_topology, trace_group
+
+    topo = sample_topology("chain", random.Random(7), "walrep")
+    return json.dumps([trace_group(topo, "walrep", tick, i)]).encode()
+
+
+class TestWalReplaySource:
+    def test_mixed_v1_v2_segments_replay_bit_exact(self, tmp_path):
+        from kmamiz_tpu.resilience.wal import IngestWAL
+
+        wal_dir = tmp_path / "wal"
+        wal_dir.mkdir()
+        # a pre-upgrade v1 segment: bare [len][crc][payload] frames
+        v1_payloads = [_window(0), _window(1)]
+        frames = b"".join(
+            struct.pack("<II", len(p), zlib.crc32(p)) + p for p in v1_payloads
+        )
+        (wal_dir / "000000.wal").write_bytes(frames)
+        # live appends continue in v2 framing (new magic'd segment)
+        wal = IngestWAL(str(wal_dir))
+        wal.append(_window(2))
+        wal.close()
+        records = list(IngestWAL(str(wal_dir)).replay_records())
+        assert len(records) == 3
+        payloads = [p for _k, p in records]
+        assert payloads[:2] == v1_payloads
+        sig_a, spans_a = _ingest_all(payloads)
+        sig_b, spans_b = _ingest_all(payloads)
+        assert sig_a == sig_b and spans_a == spans_b and spans_a > 0
+
+    def test_torn_tail_truncates_clean(self, tmp_path):
+        from kmamiz_tpu.resilience.wal import IngestWAL
+
+        wal_dir = tmp_path / "bundle" / "wal"
+        wal = IngestWAL(str(wal_dir), fsync=False)
+        for tick in range(3):
+            wal.append(_window(tick))
+        wal.close()
+        seg = sorted(wal_dir.glob("*.wal"))[-1]
+        seg.write_bytes(seg.read_bytes()[:-5])  # tear the last frame
+        records = walreplay.load_bundle_records(str(tmp_path / "bundle"))
+        assert len(records) == 2  # stop-clean: intact prefix only
+        sig, spans = _ingest_all([p for _k, p in records])
+        ref_sig, ref_spans = _ingest_all([_window(0), _window(1)])
+        assert sig == ref_sig and spans == ref_spans
+
+    def test_synthesized_bundle_mixes_columnar_frames(self, tmp_path):
+        from kmamiz_tpu.resilience.wal import KIND_COLUMNAR, KIND_JSON
+
+        spec = build_scenario("wal-replay", 0, _arch_index("wal-replay"), 6)
+        meta = walreplay.synthesize_bundle(spec, str(tmp_path / "b"))
+        assert meta["records"] == 6
+        records = walreplay.load_bundle_records(str(tmp_path / "b"))
+        kinds = {k for k, _p in records}
+        assert kinds == {KIND_JSON, KIND_COLUMNAR}
+        # both wire framings land on the same graph as a direct ingest
+        sig_a, spans_a = _ingest_all([p for _k, p in records])
+        sig_b, spans_b = _ingest_all([p for _k, p in records])
+        assert sig_a == sig_b and spans_a == spans_b > 0
+
+    def test_capture_from_wal_dir_preserves_segments(self, tmp_path):
+        from kmamiz_tpu.resilience.wal import IngestWAL
+        from kmamiz_tpu.soak import capture
+
+        src = tmp_path / "src"
+        wal = IngestWAL(str(src), fsync=False)
+        for tick in range(4):
+            wal.append(_window(tick))
+        wal.close()
+        out = tmp_path / "bundle"
+        meta = capture.capture_from_wal_dir(str(src), str(out))
+        assert meta["records"] == 4
+        copied = walreplay.load_bundle_records(str(out))
+        assert [p for _k, p in copied] == [
+            p for _k, p in IngestWAL(str(src)).replay_records()
+        ]
+
+    def test_wal_replay_scenario_passes_end_to_end(self):
+        import tempfile
+
+        from kmamiz_tpu.scenarios import runner
+
+        spec = build_scenario("wal-replay", 0, _arch_index("wal-replay"), 3)
+        with tempfile.TemporaryDirectory() as tmp:
+            card = runner.run_scenario(spec, tmpdir=tmp)
+        assert card["pass"] is True, card["gates"]
+        assert card["wal"]["records"] == 3
+        assert card["wal"]["torn_dropped"] == 0
+        assert card["ref_signatures"] == card["signatures"]
+        for gate in ("bit_exact", "replayed_all", "zero_lost_spans",
+                     "zero_steady_recompiles", "quarantine_exact"):
+            assert gate in card["gates"]
+
+
+# ---------------------------------------------------------------------------
+# worker + engine (in-process mini-sweep with a stubbed scenario runner)
+# ---------------------------------------------------------------------------
+
+
+class _FakeSpec:
+    def __init__(self, archetype, seed, index, ticks):
+        self.archetype = archetype
+        self.seed = seed
+        self.index = index
+        self.n_ticks = ticks
+        self.name = f"{archetype}-s{seed}i{index}"
+        self.tenants = []
+
+
+def _fast_card(spec, ok=True):
+    return {
+        "name": spec.name,
+        "archetype": spec.archetype,
+        "spec_signature": f"sig-{spec.name}",
+        "tenants": ["default"],
+        "gates": {"bit_exact": ok, "no_errors": True},
+        "signatures": {"default": "live"},
+        "ref_signatures": {"default": "live" if ok else "ref"},
+        "errors": [],
+        "p99_tick_ms": 1.0,
+        "lost_spans": 0,
+        "pass": ok,
+    }
+
+
+@pytest.fixture
+def stub_runner(monkeypatch, tmp_path):
+    """Replace compose + run with instant fakes; failures are keyed by
+    a set of cell seeds the test controls."""
+    from kmamiz_tpu.scenarios import factory, runner
+
+    failing = set()
+    monkeypatch.setenv("KMAMIZ_PROF_FLIGHT_DIR", str(tmp_path / "flights"))
+    monkeypatch.setattr(
+        factory, "build_scenario",
+        lambda a, s, i, t: _FakeSpec(a, s, i, t),
+    )
+    monkeypatch.setattr(
+        runner, "run_scenario",
+        lambda spec, tmpdir=None, verbose=False: _fast_card(
+            spec, ok=spec.seed not in failing
+        ),
+    )
+    return failing
+
+
+class TestWorker:
+    def test_run_cell_pass_refreshes_baseline(self, tmp_path, stub_runner):
+        man = SoakManifest(str(tmp_path / "soak"))
+        plan = _tiny_plan(man, n=1)
+        rec = worker.run_cell(man, plan["cells"][0])
+        assert rec["pass"] is True and rec["triage"] is None
+        assert man.load_results()["steady-chain-s0"]["pass"] is True
+        baseline = read_json(man.baseline_path("steady-chain"))
+        assert baseline and baseline["kind"] == "kmamiz-flight"
+
+    def test_run_cell_failure_gets_triage(self, tmp_path, stub_runner):
+        stub_runner.add(0)
+        man = SoakManifest(str(tmp_path / "soak"))
+        plan = _tiny_plan(man, n=1)
+        rec = worker.run_cell(man, plan["cells"][0])
+        assert rec["pass"] is False
+        assert rec["gates_failed"] == ["bit_exact"]
+        assert rec["triage"]["signature"] == "steady-chain|bit_exact|merge|default"
+
+    def test_poison_cell_forced_to_fail_with_evidence(self, tmp_path, stub_runner):
+        man = SoakManifest(str(tmp_path / "soak"))
+        plan = _tiny_plan(man, n=1, poison=1)
+        rec = worker.run_cell(man, plan["cells"][0])
+        assert rec["poison"] is True and rec["pass"] is False
+        assert rec["gates_failed"] == ["soak_poison"]
+        assert rec["triage"]["blamed_phase"] == "poison"
+        assert rec["flight_artifact"] and os.path.exists(rec["flight_artifact"])
+
+    def test_crashing_cell_is_contained(self, tmp_path, monkeypatch):
+        from kmamiz_tpu.scenarios import factory
+
+        monkeypatch.setenv("KMAMIZ_PROF_FLIGHT_DIR", str(tmp_path / "flights"))
+
+        def explode(a, s, i, t):
+            raise RuntimeError("compose exploded")
+
+        monkeypatch.setattr(factory, "build_scenario", explode)
+        man = SoakManifest(str(tmp_path / "soak"))
+        plan = _tiny_plan(man, n=1)
+        rec = worker.run_cell(man, plan["cells"][0])
+        assert rec["pass"] is False
+        assert rec["gates_failed"] == ["crashed"]
+        assert "compose exploded" in rec["errors"][0]
+        assert rec["triage"]["blamed_phase"] == "compose"
+
+
+class TestEngineInProcess:
+    @pytest.fixture
+    def inline_workers(self, monkeypatch):
+        """Run the real worker loop inline instead of subprocesses."""
+
+        class _Done:
+            def wait(self):
+                return 0
+
+        def spawn(man, n, run_id, verbose):
+            monkeypatch.setenv("KMAMIZ_SOAK_RUN_ID", run_id)
+            worker.work_loop(man.root)
+            return [_Done()]
+
+        monkeypatch.setattr(engine, "_spawn_workers", spawn)
+
+    def test_sweep_report_poison_and_resume(
+        self, tmp_path, stub_runner, inline_workers
+    ):
+        root = str(tmp_path / "soak")
+        report = engine.run_sweep(
+            n_cells=6, archetypes=["steady-chain", "outage-cycle"],
+            ticks=4, poison=1, soak_dir=root, workers=1,
+        )
+        assert report["complete"] and report["cells_executed"] == 6
+        assert report["pass_rate"] == 1.0  # poison excluded from the rate
+        assert report["triaged_fraction"] == 1.0
+        assert report["soak_pass"] is True
+        assert report["poison_cells"] == ["outage-cycle-s0"]
+        assert report["bugs"][0]["blamed_gate"] == "soak_poison"
+        # resume without rerunning failures: zero cells execute and the
+        # deterministic report fields come out identical
+        again = engine.run_sweep(
+            n_cells=6, archetypes=["steady-chain", "outage-cycle"],
+            ticks=4, poison=1, soak_dir=root, workers=1, rerun_failed=False,
+        )
+        assert again["cells_executed"] == 0
+        for key in ("cells", "bugs", "pass_rate", "triaged_fraction",
+                    "soak_pass", "poison_cells"):
+            assert again[key] == report[key], key
+        # default rerun re-executes exactly the failed (poison) cell
+        rerun = engine.run_sweep(
+            n_cells=6, archetypes=["steady-chain", "outage-cycle"],
+            ticks=4, poison=1, soak_dir=root, workers=1,
+        )
+        assert rerun["cells_executed"] == 1
+        assert rerun["cells"] == report["cells"]
+
+    def test_real_failure_blocks_soak_pass_but_is_triaged(
+        self, tmp_path, stub_runner, inline_workers
+    ):
+        stub_runner.add(1)  # every archetype's s1 cell fails bit_exact
+        report = engine.run_sweep(
+            n_cells=4, archetypes=["steady-chain", "outage-cycle"],
+            ticks=4, poison=0, soak_dir=str(tmp_path / "soak"), workers=1,
+        )
+        assert report["complete"] is True
+        assert report["real_failures"] == 2
+        assert report["pass_rate"] == 0.5
+        assert report["soak_pass"] is False
+        assert report["triaged_fraction"] == 1.0
+        sigs = {b["signature"] for b in report["bugs"]}
+        assert sigs == {
+            "steady-chain|bit_exact|merge|default",
+            "outage-cycle|bit_exact|merge|default",
+        }
+
+    def test_recorded_sweeps_registry(self, tmp_path, stub_runner, inline_workers):
+        assert soak.recorded_sweeps() == []
+        engine.run_sweep(
+            n_cells=1, archetypes=["steady-chain"], ticks=4,
+            soak_dir=str(tmp_path / "soak"), workers=1,
+        )
+        assert len(soak.recorded_sweeps()) == 1
+
+
+# ---------------------------------------------------------------------------
+# graftprof --diff blame + CLI surface
+# ---------------------------------------------------------------------------
+
+
+class TestTriageDiffCli:
+    def test_diff_emits_blame_for_scenario_flights(self, tmp_path, capsys):
+        from kmamiz_tpu.telemetry.profiling import recorder
+        from tools.graftprof import main
+
+        base = recorder.build_artifact("soak-baseline-x", "last passing cell")
+        cand = recorder.build_artifact(
+            "scenario-cascade-fanout-s7i1", "bit_exact,no_errors"
+        )
+        bp, cp = tmp_path / "base.json", tmp_path / "cand.json"
+        bp.write_text(json.dumps(base))
+        cp.write_text(json.dumps(cand))
+        assert main(["--diff", str(bp), str(cp)]) == 0
+        doc = json.loads(capsys.readouterr().out.strip())
+        blame = doc["blame"]
+        assert blame["scenario"] == "cascade-fanout-s7i1"
+        assert blame["blamed_gate"] == "bit_exact"
+        assert blame["blamed_phase"] == "merge"
+        assert blame["failed_gates"] == ["bit_exact", "no_errors"]
+        # a non-scenario candidate carries no blame block
+        assert main(["--diff", str(bp), str(bp)]) == 0
+        doc = json.loads(capsys.readouterr().out.strip())
+        assert "blame" not in doc
+
+    def test_slo_report_floors_soak_rates(self):
+        import tools.slo_report as slo_report
+
+        base = {"soak_smoke_pass_rate": 1.0, "soak_triaged_fraction": 1.0}
+        cand = {"soak_smoke_pass_rate": 0.5, "soak_triaged_fraction": 1.0}
+        regressions, _compared = slo_report.check(cand, base, 0.10)
+        assert [k for k, _o, _n in regressions] == ["soak_smoke_pass_rate"]
+
+
+# ---------------------------------------------------------------------------
+# slow tier: the acceptance sweep, for real
+# ---------------------------------------------------------------------------
+
+
+def _cli_sweep(root, cells, extra=(), timeout=3000):
+    proc = subprocess.run(
+        [sys.executable, "tools/graftsoak.py", "--cells", str(cells),
+         "--ticks", "4", "--workers", "2", "--poison", "1",
+         "--soak-dir", root, *extra],
+        cwd="/root/repo",
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        capture_output=True, text=True, timeout=timeout,
+    )
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    return proc.returncode, json.loads(lines[-1])
+
+
+@pytest.mark.slow
+class TestAcceptanceSweep:
+    def test_200_cells_at_four_nines_with_poison_attributed(self, tmp_path):
+        root = str(tmp_path / "soak")
+        code, report = _cli_sweep(root, 200)
+        assert report["complete"] is True
+        assert report["cells_total"] == 200
+        assert report["pass_rate"] >= 0.9999, report["failures"]
+        assert report["triaged_fraction"] >= 1.0
+        assert len(report["poison_cells"]) == 1
+        poison_bug = [
+            b for b in report["bugs"] if b["blamed_gate"] == "soak_poison"
+        ]
+        assert poison_bug and poison_bug[0]["cells"] == report["poison_cells"]
+        assert (code == 0) == report["soak_pass"]
+
+    def test_kill_mid_sweep_resumes_to_identical_report(self, tmp_path):
+        root = str(tmp_path / "soak")
+        # launch, let a few cells land, kill -9 the driver + workers
+        proc = subprocess.Popen(
+            [sys.executable, "tools/graftsoak.py", "--cells", "12",
+             "--ticks", "4", "--workers", "2", "--poison", "0",
+             "--soak-dir", root],
+            cwd="/root/repo",
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            start_new_session=True,
+        )
+        man = SoakManifest(root)
+        deadline = time.time() + 240
+        while time.time() < deadline and len(man.load_results()) < 3:
+            time.sleep(1)
+        os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+        proc.wait()
+        done_before = set(man.load_results())
+        assert done_before, "sweep never started"
+        # resume: only the remaining cells execute, report is complete
+        code, report = _cli_sweep(root, 12, extra=("--poison", "0"))
+        assert code == 0 and report["complete"] is True
+        assert report["cells_total"] == 12
+        assert report["cells_executed"] == 12 - len(done_before)
+        results = man.load_results()
+        for cell_id in done_before:  # finished cells were NOT re-run
+            assert results[cell_id]["run_id"] != report["run_id"]
+        # a rerun over the complete sweep executes nothing and reproduces
+        # every deterministic report field
+        code2, again = _cli_sweep(root, 12, extra=("--poison", "0"))
+        assert code2 == 0 and again["cells_executed"] == 0
+        for key in ("cells", "bugs", "pass_rate", "triaged_fraction",
+                    "poison_cells", "soak_pass", "cells_total"):
+            assert again[key] == report[key], key

@@ -10,7 +10,11 @@ profile, or a "kmamiz-flight" recorder dump — both render identically):
 
     # regression gate: candidate vs baseline per-phase p95, exit 1 on
     # any phase past its threshold (tools/slo_report.py --check uses the
-    # same thresholds for the prof_* bench keys)
+    # same thresholds for the prof_* bench keys). When the candidate is
+    # a failed scenario cell's flight box, the output also carries a
+    # "blame" block — the gate/phase attribution the graftsoak sweep
+    # records per cell (bisect a failure against the sweep's last
+    # passing flight for the same archetype; docs/OBSERVABILITY.md)
     python tools/graftprof.py --diff baseline.json candidate.json
 
     # seeded capture: drive a synthetic collect-tick + raw-ingest
@@ -35,6 +39,34 @@ sys.path.insert(0, "/root/repo")
 def _load(path: str) -> dict:
     with open(path, encoding="utf-8") as f:
         return json.load(f)
+
+
+def _flight_blame(cand_doc: dict, regressions) -> dict:
+    """Auto-triage bisection for a scenario flight candidate: the
+    runner stamps the failed gates into the flight's ``detail``; map
+    the first (sorted, deterministic) onto its owning phase and attach
+    the diff's regressed phases as supporting evidence. Empty dict for
+    non-scenario candidates."""
+    if cand_doc.get("kind") != "kmamiz-flight":
+        return {}
+    trigger = str(cand_doc.get("trigger", ""))
+    if not trigger.startswith("scenario-"):
+        return {}
+    from kmamiz_tpu.soak.triage import GATE_PHASE
+
+    detail = str(cand_doc.get("detail", ""))
+    if detail.startswith("crashed"):
+        gates = ["crashed"]
+    else:
+        gates = sorted(g for g in detail.split(",") if g)
+    gate = gates[0] if gates else "unknown"
+    return {
+        "scenario": trigger[len("scenario-"):],
+        "blamed_gate": gate,
+        "blamed_phase": GATE_PHASE.get(gate, "unknown"),
+        "failed_gates": gates,
+        "regressed_phases": [r["phase"] for r in regressions[:4]],
+    }
 
 
 def _capture(out_path: str, ticks: int, threads: int, seed: int) -> dict:
@@ -162,7 +194,8 @@ def main(argv=None) -> int:
         return 0
 
     if args.diff:
-        base, cand = (report.from_any(_load(p)) for p in args.diff)
+        base_doc, cand_doc = (_load(p) for p in args.diff)
+        base, cand = (report.from_any(d) for d in (base_doc, cand_doc))
         thresholds = (
             {"default": args.threshold} if args.threshold is not None else None
         )
@@ -174,7 +207,16 @@ def main(argv=None) -> int:
                 f"(x{r['ratio']}, threshold +{int(r['threshold'] * 100)}%)",
                 file=sys.stderr,
             )
-        print(json.dumps({"regressions": regressions}))
+        out = {"regressions": regressions}
+        blame = _flight_blame(cand_doc, regressions)
+        if blame:
+            out["blame"] = blame
+            print(
+                f"BLAME {blame['scenario']}: gate={blame['blamed_gate']} "
+                f"phase={blame['blamed_phase']}",
+                file=sys.stderr,
+            )
+        print(json.dumps(out))
         return 1 if regressions else 0
 
     paths = [p for p in args.artifact if p != "report"]

@@ -127,6 +127,12 @@ _FLOOR_GATED = (
     # host-core-guarded)
     "fleet_spans_per_sec_4",
     "fleet_scale_efficiency",
+    # graftsoak sweep smoke: the mini-sweep's non-poison pass rate and
+    # its triaged fraction (every failure must carry a triage blame) —
+    # both [0,1] rates where a collapse reads as a lower number, so
+    # both gate as floors
+    "soak_smoke_pass_rate",
+    "soak_triaged_fraction",
 )
 _ABS_SLACK_FLOOR = 0.02
 # absolute slack per key class: rates jitter in the 3rd decimal on tiny
